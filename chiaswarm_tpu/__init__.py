@@ -1,0 +1,27 @@
+"""chiaswarm_tpu — a TPU-native distributed generative-AI worker framework.
+
+Brand-new JAX/XLA/Flax/Pallas implementation of the capabilities of the
+chiaSWARM worker node (reference: swarm/__init__.py:1, version 0.23.6):
+a stateless node that polls a central "hive" job queue over HTTP, executes
+generative workloads on accelerators, and uploads base64 artifact envelopes.
+
+Layer map (TPU-first, not a port — see SURVEY.md §7):
+
+- ``core``       — device mesh, chip pool, RNG, compiled-pipeline cache
+- ``ops``        — attention (Pallas flash attention + reference), fused ops
+- ``models``     — Flax modules: CLIP/OpenCLIP text encoders, UNet, VAE,
+                   ControlNet (SD 1.5 / 2.x / SDXL families)
+- ``schedulers`` — jittable pure-function diffusion schedulers
+                   (DDPM/DDIM/Euler/DPM-Solver++ with Karras sigmas)
+- ``pipelines``  — jitted end-to-end generate functions + workload registry
+- ``parallel``   — sharding rules, data/tensor/sequence parallelism,
+                   ring attention, multi-host initialization
+- ``train``      — sharded training step (diffusion loss, LoRA)
+- ``node``       — async worker daemon, hive protocol client, job dispatch,
+                   artifact envelope, settings
+- ``convert``    — torch/safetensors checkpoint -> Flax param conversion
+"""
+
+__version__ = "0.1.0"
+
+WORKER_VERSION = __version__

@@ -1,0 +1,14 @@
+"""Core runtime: device mesh construction, chip pool, RNG, compile cache."""
+
+from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh, local_chip_count
+from chiaswarm_tpu.core.rng import draw_seed, key_for_seed
+from chiaswarm_tpu.core.chip_pool import ChipPool
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_chip_count",
+    "draw_seed",
+    "key_for_seed",
+    "ChipPool",
+]

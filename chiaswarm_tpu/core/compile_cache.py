@@ -1,0 +1,137 @@
+"""Resident compiled-pipeline cache with shape bucketing.
+
+The reference reloads model weights from disk on every job
+(swarm/diffusion/diffusion_func.py:41-46) — tolerable on CUDA where module
+construction is cheap. On TPU, XLA compilation dominates: recompiling a
+denoise loop per job (or per odd image size) is fatal to throughput. This
+component has no reference analog and exists precisely because of the XLA
+compilation model (SURVEY.md §7 "hard parts" #3):
+
+- **Shape bucketing**: arbitrary requested resolutions/batch sizes snap to a
+  small lattice of compiled shapes (latent sizes multiple of 64px at the
+  image level, batch in powers of two). One compiled executable serves every
+  job that lands in its bucket.
+- **Param residency**: converted model weights stay on device between jobs,
+  keyed by (model_name, dtype), LRU-evicted under an HBM budget.
+- **Executable LRU**: jitted pipeline callables keyed by
+  (model key, static config, bucketed shapes).
+
+Thread-safe; the worker's executor threads share one cache per process.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Any, Callable, Hashable
+
+_POW2 = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def bucket_batch(n: int) -> int:
+    """Round batch up to the next power of two (caps recompiles at
+    log2(max_batch) executables per pipeline)."""
+    if n < 1:
+        raise ValueError("batch must be >= 1")
+    for p in _POW2:
+        if n <= p:
+            return p
+    raise ValueError(f"batch {n} exceeds supported maximum {_POW2[-1]}")
+
+
+def bucket_image_size(height: int, width: int, *, multiple: int = 64,
+                      min_size: int = 256, max_size: int = 1024) -> tuple[int, int]:
+    """Snap a requested image size onto the compiled lattice.
+
+    Mirrors the reference's size clamp (swarm/job_arguments.py:14,96-102 caps
+    at 1024x1024) but additionally quantizes to ``multiple`` so XLA sees a
+    bounded shape set. Images are generated at the bucketed size and
+    center-cropped/resized on host to the exact request when they differ.
+    """
+
+    def snap(v: int) -> int:
+        v = max(min_size, min(max_size, v))
+        return ((v + multiple - 1) // multiple) * multiple
+
+    return snap(height), snap(width)
+
+
+@dataclasses.dataclass
+class _Entry:
+    value: Any
+    size_bytes: int
+
+
+class LruCache:
+    """A byte-budgeted LRU used for both param trees and executables."""
+
+    def __init__(self, budget_bytes: int | None = None, max_items: int | None = None):
+        self._budget = budget_bytes
+        self._max_items = max_items
+        self._entries: collections.OrderedDict[Hashable, _Entry] = collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_create(self, key: Hashable, factory: Callable[[], Any],
+                      size_bytes: int = 0) -> Any:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry.value
+            self.misses += 1
+        # Build outside the lock: factories compile/convert and can take
+        # minutes; concurrent misses on the *same* key are rare (jobs for one
+        # model serialize on the slot) and harmless (last write wins).
+        value = factory()
+        with self._lock:
+            self._entries[key] = _Entry(value, size_bytes)
+            self._entries.move_to_end(key)
+            self._bytes += size_bytes
+            self._evict_locked()
+        return value
+
+    def _evict_locked(self) -> None:
+        while self._entries and (
+            (self._budget is not None and self._bytes > self._budget)
+            or (self._max_items is not None and len(self._entries) > self._max_items)
+        ):
+            if len(self._entries) == 1:
+                break  # never evict the entry we just inserted
+            _, entry = self._entries.popitem(last=False)
+            self._bytes -= entry.size_bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def stats(self) -> dict[str, int]:
+        return {
+            "items": len(self._entries),
+            "bytes": self._bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+        }
+
+
+class CompileCache:
+    """Process-wide residency for params and compiled pipelines."""
+
+    def __init__(self, param_budget_bytes: int = 24 * 1024**3,
+                 max_executables: int = 16) -> None:
+        self.params = LruCache(budget_bytes=param_budget_bytes)
+        self.executables = LruCache(max_items=max_executables)
+
+    def cached_params(self, key: Hashable, loader: Callable[[], Any],
+                      size_bytes: int = 0) -> Any:
+        return self.params.get_or_create(key, loader, size_bytes)
+
+    def cached_executable(self, key: Hashable, builder: Callable[[], Any]) -> Any:
+        return self.executables.get_or_create(key, builder)
+
+
+GLOBAL_CACHE = CompileCache()

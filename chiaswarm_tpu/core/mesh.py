@@ -1,0 +1,120 @@
+"""Device mesh construction — the TPU-native replacement for the reference's
+per-GPU device pool (swarm/gpu/device.py, swarm/gpu/device_pool.py).
+
+Where the reference treats each CUDA GPU as an isolated executor, a TPU pod
+is a single SPMD machine: we build a ``jax.sharding.Mesh`` over the chips and
+express parallelism as named axes:
+
+- ``"data"``  — batch / job-level data parallelism (ICI all-reduce free for
+  inference; gradient psum for training)
+- ``"model"`` — tensor parallelism (weight sharding for models larger than
+  one chip's HBM, e.g. SDXL at high batch or cascade stages)
+- ``"seq"``   — sequence/context parallelism (ring attention over ICI for
+  long token counts: video, long-context transformers)
+
+Multi-host pods use ``jax.distributed.initialize`` (DCN for the control
+plane, ICI for collectives) — see chiaswarm_tpu.parallel.distributed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+DEFAULT_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named request for a device mesh.
+
+    ``shape`` maps axis name -> size. Sizes of ``-1`` mean "absorb all
+    remaining devices" (at most one axis may be -1). Axes not listed get
+    size 1. The product must equal (or, with a -1, divide) the device count.
+    """
+
+    shape: dict[str, int] = dataclasses.field(
+        default_factory=lambda: {DATA_AXIS: -1}
+    )
+    axis_order: Sequence[str] = DEFAULT_AXES
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {axis: 1 for axis in self.axis_order}
+        for axis, size in self.shape.items():
+            if axis not in sizes:
+                raise ValueError(f"unknown mesh axis {axis!r}; known: {list(sizes)}")
+            sizes[axis] = size
+        wildcard = [a for a, s in sizes.items() if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcard:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"cannot factor {n_devices} devices into {sizes} "
+                    f"(fixed product {fixed} does not divide)"
+                )
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices but {n_devices} are present"
+            )
+        return sizes
+
+
+def local_chip_count() -> int:
+    return jax.local_device_count()
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all addressable devices).
+
+    Device order follows ``jax.devices()`` which already reflects ICI
+    topology locality; the trailing (fastest-varying) mesh axis therefore
+    rides the tightest ICI links — put the heaviest-communication axis
+    (``seq`` for ring attention, else ``model``) last via ``axis_order``.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices) if devices is not None else list(jax.devices())
+    sizes = spec.resolve(len(devices))
+    axis_names = tuple(spec.axis_order)
+    shape = tuple(sizes[a] for a in axis_names)
+    device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, axis_names)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A 1x1x1 mesh for one chip — lets every pipeline be written against a
+    mesh unconditionally (no separate single-chip code path)."""
+    device = device or jax.devices()[0]
+    return build_mesh(MeshSpec({DATA_AXIS: 1, MODEL_AXIS: 1, SEQ_AXIS: 1}),
+                      devices=[device])
+
+
+def host_cpu_mesh(n: int = 8) -> Mesh:
+    """Testing helper: a CPU mesh (requires
+    XLA_FLAGS=--xla_force_host_platform_device_count=N set before jax import,
+    as done in tests/conftest.py)."""
+    cpus = jax.devices("cpu")
+    return build_mesh(MeshSpec({DATA_AXIS: -1}), devices=cpus[:n])
+
+
+def env_forced_host_devices() -> int | None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    for token in flags.split():
+        if token.startswith("--xla_force_host_platform_device_count="):
+            return int(token.split("=", 1)[1])
+    return None

@@ -1,0 +1,31 @@
+"""Seed handling — replaces the reference's per-device torch.Generator
+(swarm/gpu/device.py:36-41) with stateless jax.random keys.
+
+The reference draws a fresh seed with ``torch.seed()`` when the job does not
+pin one and records it into the result config so any image is reproducible
+(swarm/gpu/device.py:43). We keep that contract: ``draw_seed`` produces a
+uint63 seed from os.urandom, ``key_for_seed`` folds it into a PRNGKey, and
+the worker records the integer seed in every artifact envelope.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import jax
+
+
+def draw_seed() -> int:
+    """A fresh non-negative 63-bit seed (json-safe, torch.seed()-like range)."""
+    return secrets.randbits(63)
+
+
+def key_for_seed(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(int(seed) & 0x7FFF_FFFF_FFFF_FFFF)
+
+
+def per_sample_keys(seed: int, batch: int) -> jax.Array:
+    """Independent keys per batch element so batched generation matches N
+    independent single-image runs with seeds seed, seed+1, ... (host-side
+    loop: batch is small and this runs once per job, outside jit)."""
+    return jax.numpy.stack([key_for_seed(seed + i) for i in range(batch)])

@@ -1,0 +1,1 @@
+"""Node runtime: worker daemon, hive protocol, dispatch, artifacts, settings."""

@@ -1,0 +1,140 @@
+"""Layered node configuration.
+
+Capability parity with the reference's config system (swarm/settings.py:7-69):
+a JSON settings file under a configurable root directory, overridden by
+environment variables, with helpers to persist auxiliary files (e.g. the
+hive model catalog). Wire-compatible field names and env vars are kept so a
+chiaSWARM operator can point this worker at the same hive unchanged.
+
+Precedence (lowest to highest): built-in defaults < settings.json < env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+_ENV_OVERRIDES = {
+    # reference env vars (swarm/settings.py:36-38) kept for drop-in parity
+    "SDAAS_URI": "hive_uri",
+    "SDAAS_TOKEN": "hive_token",
+    "SDAAS_WORKERNAME": "worker_name",
+    # native names
+    "SWARM_TPU_URI": "hive_uri",
+    "SWARM_TPU_TOKEN": "hive_token",
+    "SWARM_TPU_WORKERNAME": "worker_name",
+    "SWARM_TPU_LOG_LEVEL": "log_level",
+}
+
+_ROOT_ENV_VARS = ("SWARM_TPU_ROOT", "SDAAS_ROOT")
+
+
+@dataclasses.dataclass
+class Settings:
+    """Node settings.
+
+    Field names mirror the reference settings file (swarm/settings.py:7-15)
+    via ``to_legacy_json``/``from_json`` so existing ``settings.json`` files
+    keep working.
+    """
+
+    hive_uri: str = "https://chiaswarm.ai"
+    hive_token: str = ""
+    worker_name: str = "tpu-worker"
+    log_level: str = "INFO"
+    log_filename: str = "swarm-tpu.log"
+    huggingface_token: str = ""
+    # TPU-native additions
+    mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8} ; None = auto
+    precision: str = "bfloat16"
+    use_flash_attention: bool = True
+    compile_cache_size: int = 4
+    max_image_size: int = 1024
+    default_steps: int = 30
+
+    @staticmethod
+    def _legacy_key_map() -> dict[str, str]:
+        return {
+            "sdaas_uri": "hive_uri",
+            "sdaas_token": "hive_token",
+        }
+
+    @classmethod
+    def from_json(cls, data: dict[str, Any]) -> "Settings":
+        legacy = cls._legacy_key_map()
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs: dict[str, Any] = {}
+        for key, value in data.items():
+            key = legacy.get(key, key)
+            if key in fields:
+                kwargs[key] = value
+        return cls(**kwargs)
+
+    def to_json(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_legacy_json(self) -> dict[str, Any]:
+        """Emit the reference's field names for round-trip compatibility."""
+        data = self.to_json()
+        data["sdaas_uri"] = data.pop("hive_uri")
+        data["sdaas_token"] = data.pop("hive_token")
+        return data
+
+
+def settings_root() -> Path:
+    """Resolve the settings directory (reference: swarm/settings.py:53-64)."""
+    for var in _ROOT_ENV_VARS:
+        root = os.environ.get(var)
+        if root:
+            return Path(root).expanduser()
+    return Path.home() / ".swarm-tpu"
+
+
+def settings_path() -> Path:
+    return settings_root() / "settings.json"
+
+
+def load_settings() -> Settings:
+    """Load settings.json (if present) and apply env overrides."""
+    path = settings_path()
+    if path.exists():
+        with open(path, "r", encoding="utf-8") as fh:
+            settings = Settings.from_json(json.load(fh))
+    else:
+        settings = Settings()
+    for env, field in _ENV_OVERRIDES.items():
+        value = os.environ.get(env)
+        if value:
+            setattr(settings, field, value)
+    return settings
+
+
+def save_settings(settings: Settings) -> Path:
+    root = settings_root()
+    root.mkdir(parents=True, exist_ok=True)
+    path = settings_path()
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(settings.to_json(), fh, indent=2)
+    return path
+
+
+def save_file(data: Any, filename: str) -> Path:
+    """Persist an auxiliary JSON document under the settings root
+    (reference: swarm/settings.py:67-69, used for the hive model catalog)."""
+    root = settings_root()
+    root.mkdir(parents=True, exist_ok=True)
+    path = root / filename
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+    return path
+
+
+def load_file(filename: str) -> Any | None:
+    path = settings_root() / filename
+    if not path.exists():
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)

@@ -1,0 +1,44 @@
+"""Jittable diffusion schedulers.
+
+Pure-function replacements for the diffusers scheduler objects the reference
+resolves dynamically by class name at job time (swarm/job_arguments.py:143-148,
+swarm/diffusion/diffusion_func.py:71-74 forces DPMSolverMultistep + Karras
+sigmas). Every scheduler here is a set of pure functions over immutable
+arrays, usable inside ``lax.scan``/``fori_loop`` under ``jit`` — no Python
+state, no data-dependent control flow.
+
+Scheduler names accepted by :func:`resolve` mirror the diffusers class names
+the hive sends so the job wire format keeps working.
+"""
+
+from chiaswarm_tpu.schedulers.common import (
+    NoiseSchedule,
+    make_noise_schedule,
+    add_noise,
+    velocity_target,
+)
+from chiaswarm_tpu.schedulers.sampling import (
+    SamplerConfig,
+    SamplingSchedule,
+    make_sampling_schedule,
+    scale_model_input,
+    sampler_step,
+    init_noise_scale,
+    SAMPLERS,
+    resolve,
+)
+
+__all__ = [
+    "NoiseSchedule",
+    "make_noise_schedule",
+    "add_noise",
+    "velocity_target",
+    "SamplerConfig",
+    "SamplingSchedule",
+    "make_sampling_schedule",
+    "scale_model_input",
+    "sampler_step",
+    "init_noise_scale",
+    "SAMPLERS",
+    "resolve",
+]

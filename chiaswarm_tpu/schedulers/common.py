@@ -1,0 +1,111 @@
+"""Shared noise-schedule math (beta ladders, alpha-bar, Karras sigmas).
+
+Replaces the numerical core of the diffusers schedulers the reference uses
+(resolved by name at swarm/job_arguments.py:143-148). Everything is a pure
+function of arrays; nothing here holds state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleConfig:
+    """Static description of a model's training noise schedule."""
+
+    num_train_timesteps: int = 1000
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    beta_schedule: str = "scaled_linear"  # "linear" | "scaled_linear" | "squaredcos_cap_v2"
+    prediction_type: str = "epsilon"      # "epsilon" | "v_prediction" | "sample"
+
+
+class NoiseSchedule(NamedTuple):
+    """Precomputed per-train-timestep tables."""
+
+    betas: jnp.ndarray            # (T_train,)
+    alphas_cumprod: jnp.ndarray   # (T_train,)
+    sigmas: jnp.ndarray           # (T_train,) k-diffusion sigma(t) = sqrt((1-a)/a)
+
+
+def make_betas(config: ScheduleConfig) -> jnp.ndarray:
+    T = config.num_train_timesteps
+    if config.beta_schedule == "linear":
+        return jnp.linspace(config.beta_start, config.beta_end, T, dtype=jnp.float32)
+    if config.beta_schedule == "scaled_linear":
+        return jnp.linspace(
+            config.beta_start ** 0.5, config.beta_end ** 0.5, T, dtype=jnp.float32
+        ) ** 2
+    if config.beta_schedule == "squaredcos_cap_v2":
+        # cosine schedule (used by the DeepFloyd-IF family)
+        def alpha_bar(t):
+            return jnp.cos((t + 0.008) / 1.008 * jnp.pi / 2) ** 2
+
+        t1 = jnp.arange(T, dtype=jnp.float32) / T
+        t2 = (jnp.arange(T, dtype=jnp.float32) + 1) / T
+        return jnp.clip(1.0 - alpha_bar(t2) / alpha_bar(t1), 0.0, 0.999)
+    raise ValueError(f"unknown beta schedule {config.beta_schedule!r}")
+
+
+def make_noise_schedule(config: ScheduleConfig) -> NoiseSchedule:
+    betas = make_betas(config)
+    alphas_cumprod = jnp.cumprod(1.0 - betas)
+    sigmas = jnp.sqrt((1.0 - alphas_cumprod) / alphas_cumprod)
+    return NoiseSchedule(betas=betas, alphas_cumprod=alphas_cumprod, sigmas=sigmas)
+
+
+def karras_sigmas(sigma_min: jnp.ndarray, sigma_max: jnp.ndarray, n: int,
+                  rho: float = 7.0) -> jnp.ndarray:
+    """Karras et al. (2022) sigma ladder, high to low, length n."""
+    ramp = jnp.linspace(0.0, 1.0, n)
+    min_inv_rho = sigma_min ** (1.0 / rho)
+    max_inv_rho = sigma_max ** (1.0 / rho)
+    return (max_inv_rho + ramp * (min_inv_rho - max_inv_rho)) ** rho
+
+
+def sigma_to_timestep(schedule: NoiseSchedule, sigma: jnp.ndarray) -> jnp.ndarray:
+    """Map sigma -> (fractional) train timestep by log-sigma interpolation,
+    so models conditioned on discrete timesteps accept Karras sigmas."""
+    log_sigma = jnp.log(jnp.maximum(sigma, 1e-10))
+    log_table = jnp.log(schedule.sigmas)
+    return jnp.interp(log_sigma, log_table, jnp.arange(log_table.shape[0], dtype=jnp.float32))
+
+
+def add_noise(schedule: NoiseSchedule, x0: jnp.ndarray, noise: jnp.ndarray,
+              t: jnp.ndarray) -> jnp.ndarray:
+    """Forward process q(x_t | x_0) — used by img2img/inpaint init and by the
+    training loss."""
+    a = schedule.alphas_cumprod[t].astype(x0.dtype)
+    a = a.reshape(a.shape + (1,) * (x0.ndim - a.ndim))
+    return jnp.sqrt(a) * x0 + jnp.sqrt(1.0 - a) * noise
+
+
+def velocity_target(schedule: NoiseSchedule, x0: jnp.ndarray, noise: jnp.ndarray,
+                    t: jnp.ndarray) -> jnp.ndarray:
+    """v-prediction target (SD 2.1-style): v = sqrt(a) eps - sqrt(1-a) x0."""
+    a = schedule.alphas_cumprod[t].astype(x0.dtype)
+    a = a.reshape(a.shape + (1,) * (x0.ndim - a.ndim))
+    return jnp.sqrt(a) * noise - jnp.sqrt(1.0 - a) * x0
+
+
+def denoised_from_model_output(model_output: jnp.ndarray, sample: jnp.ndarray,
+                               sigma: jnp.ndarray, prediction_type: str) -> jnp.ndarray:
+    """Convert a model output at noise level ``sigma`` into a denoised (x0)
+    estimate, for samples living in k-diffusion space x = x0 + sigma * eps.
+
+    ``sigma`` broadcasts over the sample's trailing dims.
+    """
+    sigma = jnp.asarray(sigma, dtype=jnp.float32)
+    sigma = sigma.reshape(sigma.shape + (1,) * (sample.ndim - sigma.ndim))
+    if prediction_type == "epsilon":
+        return sample - sigma * model_output
+    if prediction_type == "v_prediction":
+        s2 = sigma ** 2
+        return sample / (s2 + 1.0) - model_output * sigma / jnp.sqrt(s2 + 1.0)
+    if prediction_type == "sample":
+        return model_output
+    raise ValueError(f"unknown prediction type {prediction_type!r}")

@@ -1,0 +1,1 @@
+"""Host-side utilities: settings, logging, image/video/audio IO, guarded fetch."""

@@ -1,0 +1,33 @@
+"""Hermetic test config: force an 8-device CPU platform BEFORE jax imports,
+so multi-chip mesh/sharding code is exercised without a TPU (SURVEY.md §4)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize preloads jax with a TPU plugin before any
+# conftest runs; re-pointing the config re-selects the backend (lazy CPU
+# client init still honors the XLA_FLAGS set above).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    return jax.devices("cpu")
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec({"data": 4, "model": 2}))

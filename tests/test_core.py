@@ -1,0 +1,99 @@
+import jax
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.core.chip_pool import ChipPool, SlotBusy
+from chiaswarm_tpu.core.compile_cache import (
+    LruCache,
+    bucket_batch,
+    bucket_image_size,
+)
+from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+from chiaswarm_tpu.core.rng import draw_seed, key_for_seed, per_sample_keys
+
+
+def test_mesh_auto_factorization():
+    mesh = build_mesh(MeshSpec({"data": -1}))
+    assert mesh.devices.size == 8
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 8, "model": 1, "seq": 1,
+    }
+
+
+def test_mesh_explicit_shape(mesh8):
+    assert dict(zip(mesh8.axis_names, mesh8.devices.shape)) == {
+        "data": 4, "model": 2, "seq": 1,
+    }
+
+
+def test_mesh_bad_shape_raises():
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec({"data": 3}))
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec({"data": -1, "model": -1}))
+
+
+def test_chip_pool_slots_and_seed_recording():
+    pool = ChipPool(n_slots=4)
+    assert len(pool) == 4
+    slot = pool.slots[0]
+    assert slot.descriptor()["chips"] == 2
+
+    def callback(s, model_name, seed=None, **kw):
+        assert model_name == "m"
+        assert isinstance(seed, int)
+        return {"ok": True}, {"model": model_name}
+
+    artifacts, config = slot(callback, model_name="m")
+    assert artifacts == {"ok": True}
+    assert isinstance(config["seed"], int)
+
+    _, config2 = slot(callback, model_name="m", seed=123)
+    assert config2["seed"] == 123
+
+
+def test_chip_pool_busy_raises():
+    pool = ChipPool(n_slots=1)
+    slot = pool.slots[0]
+
+    def reentrant(s, model_name, seed=None, **kw):
+        with pytest.raises(SlotBusy):
+            slot(lambda *a, **k: ({}, {}))
+        return {}, {}
+
+    slot(reentrant, model_name=None)
+
+
+def test_rng_determinism():
+    k1 = key_for_seed(42)
+    k2 = key_for_seed(42)
+    assert (jax.random.normal(k1, (4,)) == jax.random.normal(k2, (4,))).all()
+    seeds = {draw_seed() for _ in range(8)}
+    assert len(seeds) == 8
+    keys = per_sample_keys(7, 3)
+    assert keys.shape[0] == 3
+    assert np.array_equal(np.asarray(keys[1]), np.asarray(key_for_seed(8)))
+
+
+def test_bucketing():
+    assert bucket_batch(1) == 1
+    assert bucket_batch(3) == 4
+    assert bucket_image_size(512, 512) == (512, 512)
+    assert bucket_image_size(500, 700) == (512, 704)
+    assert bucket_image_size(4000, 100) == (1024, 256)
+
+
+def test_lru_cache_eviction_and_stats():
+    cache = LruCache(max_items=2)
+    cache.get_or_create("a", lambda: 1)
+    cache.get_or_create("b", lambda: 2)
+    cache.get_or_create("a", lambda: -1)  # hit, refreshes
+    cache.get_or_create("c", lambda: 3)   # evicts b
+    assert cache.get_or_create("a", lambda: -1) == 1
+    assert cache.get_or_create("b", lambda: 99) == 99  # was evicted
+    assert cache.stats["hits"] == 2
+
+    budget = LruCache(budget_bytes=100)
+    budget.get_or_create("x", lambda: "x", size_bytes=60)
+    budget.get_or_create("y", lambda: "y", size_bytes=60)  # evicts x
+    assert budget.stats["bytes"] == 60

@@ -89,8 +89,13 @@ class LruCache:
         # model serialize on the slot) and harmless (last write wins).
         value = factory()
         with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                # concurrent miss on the same key: keep the first result and
+                # drop ours, so byte accounting stays exact.
+                self._entries.move_to_end(key)
+                return existing.value
             self._entries[key] = _Entry(value, size_bytes)
-            self._entries.move_to_end(key)
             self._bytes += size_bytes
             self._evict_locked()
         return value

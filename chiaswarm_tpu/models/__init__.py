@@ -1,0 +1,25 @@
+"""Flax model zoo: text encoders, UNet, VAE, ControlNet.
+
+Architecture configs for the model families the reference serves through
+diffusers' dynamic class loading (swarm/type_helpers.py:1-3,
+swarm/job_arguments.py:143-148): Stable Diffusion 1.5 / 2.1, SDXL,
+latent upscaler, plus tiny hermetic-test variants.
+"""
+
+from chiaswarm_tpu.models.configs import (
+    TextEncoderConfig,
+    UNetConfig,
+    VAEConfig,
+    ModelFamily,
+    FAMILIES,
+    get_family,
+)
+
+__all__ = [
+    "TextEncoderConfig",
+    "UNetConfig",
+    "VAEConfig",
+    "ModelFamily",
+    "FAMILIES",
+    "get_family",
+]

@@ -1,0 +1,124 @@
+"""CLIP-style text encoder (Flax) — the conditioning tower for every SD family.
+
+Replaces the torch CLIPTextModel the reference loads inside each diffusers
+pipeline (swarm/diffusion/diffusion_func.py:41-46). Covers the three towers
+used across SD1.x (ViT-L quick-gelu), SD2.x (ViT-H gelu, clip-skip), and
+SDXL (ViT-L penultimate + OpenCLIP bigG with text projection & pooled
+output) via :class:`TextEncoderConfig`.
+
+TPU notes: pure encoder, static 77-token length, causal mask baked as a
+constant — the whole prompt encode jits into a single fused program and is
+negligible next to the denoise loop.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.configs import TextEncoderConfig
+from chiaswarm_tpu.ops.attention import attention
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * nn.sigmoid(1.702 * x)
+    if name == "gelu":
+        return nn.gelu
+    raise ValueError(f"unknown activation {name!r}")
+
+
+class ClipAttention(nn.Module):
+    config: TextEncoderConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.Dense(cfg.hidden_size, dtype=self.dtype, name=name)
+        b, l, _ = x.shape
+        split = lambda t: t.reshape(b, l, cfg.num_heads, head_dim)
+        q, k, v = split(dense("q_proj")(x)), split(dense("k_proj")(x)), split(dense("v_proj")(x))
+        # causal mask via additive bias on the logits; sequence is a fixed 77
+        # tokens so we fold the mask rather than calling the flash kernel.
+        scale = head_dim ** -0.5
+        logits = jnp.einsum("blhd,bshd->bhls", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        logits = logits + mask
+        weights = nn.softmax(logits, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhls,bshd->blhd", weights, v).reshape(b, l, -1)
+        return dense("out_proj")(out)
+
+
+class ClipLayer(nn.Module):
+    config: TextEncoderConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        residual = x
+        x = nn.LayerNorm(dtype=self.dtype, name="layer_norm1")(x)
+        x = ClipAttention(cfg, dtype=self.dtype, name="self_attn")(x, mask)
+        x = residual + x
+        residual = x
+        x = nn.LayerNorm(dtype=self.dtype, name="layer_norm2")(x)
+        x = nn.Dense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(x)
+        x = _act(cfg.hidden_act)(x)
+        x = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(x)
+        return residual + x
+
+
+class ClipTextEncoder(nn.Module):
+    """Returns (sequence_embeddings, pooled_embedding).
+
+    ``sequence_embeddings`` honors ``config.output_layer`` (clip-skip) and
+    ``config.final_layer_norm``; ``pooled_embedding`` is the EOS-token state
+    of the *final* layer after the final LayerNorm, passed through the text
+    projection when ``projection_dim`` is set (the SDXL pooled conditioning).
+    """
+
+    config: TextEncoderConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.config
+        b, l = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype,
+                       name="token_embedding")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=self.dtype, name="position_embedding")(
+            jnp.arange(l)[None, :].repeat(b, axis=0)
+        )
+        x = tok + pos
+
+        causal = jnp.triu(jnp.full((l, l), -1e9, dtype=jnp.float32), k=1)
+        mask = causal[None, None, :, :]
+
+        hidden_states = []
+        for i in range(cfg.num_layers):
+            hidden_states.append(x)
+            x = ClipLayer(cfg, dtype=self.dtype, name=f"layers_{i}")(x, mask)
+        hidden_states.append(x)  # index -1 == final layer output
+
+        # Single LN module reused on different inputs (shared params): the
+        # pooled path always reads the final-LN state even when the sequence
+        # readout skips it (OpenCLIP bigG / SDXL penultimate readout).
+        final_ln = nn.LayerNorm(dtype=self.dtype, name="final_layer_norm")
+        final = final_ln(x)
+
+        readout = x if cfg.output_layer == -1 else hidden_states[cfg.output_layer]
+        seq = final_ln(readout) if cfg.final_layer_norm else readout
+
+        # pooled = final-LN state at the EOS position (highest token id ==
+        # eos for CLIP's vocab ordering; we use argmax like HF does)
+        eos_idx = jnp.argmax((input_ids == cfg.eos_token_id).astype(jnp.int32), axis=-1)
+        pooled = jnp.take_along_axis(
+            final, eos_idx[:, None, None].repeat(final.shape[-1], axis=-1), axis=1
+        )[:, 0, :]
+        if cfg.projection_dim is not None:
+            pooled = nn.Dense(cfg.projection_dim, use_bias=False,
+                              dtype=self.dtype, name="text_projection")(pooled)
+        return seq, pooled

@@ -1,0 +1,19 @@
+"""Shared building-block helpers for the Flax model zoo."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def num_groups(channels: int) -> int:
+    """32 GroupNorm groups when divisible (the SD standard); largest divisor
+    <= 32 otherwise (tiny hermetic-test widths)."""
+    g = min(32, channels)
+    while channels % g:
+        g -= 1
+    return g
+
+
+def upsample2x_nearest(x: jnp.ndarray) -> jnp.ndarray:
+    """Nearest-neighbor x2 on NHWC — lowers to cheap broadcast-reshapes."""
+    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)

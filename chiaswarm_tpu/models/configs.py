@@ -1,0 +1,220 @@
+"""Static architecture configs for every supported model family.
+
+The reference is model-agnostic: the hive names a diffusers pipeline class
+and checkpoint per job (swarm/job_arguments.py:104-151). Our equivalent seam
+is a *family registry*: a hive model name maps to a :class:`ModelFamily`
+(architecture + schedule defaults), and the checkpoint converter
+(chiaswarm_tpu.convert) maps its weights onto these Flax modules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class TextEncoderConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 77
+    hidden_act: str = "quick_gelu"  # "quick_gelu" | "gelu"
+    # which hidden layer to read out (-1 = final, -2 = penultimate "clip skip")
+    output_layer: int = -1
+    final_layer_norm: bool = True
+    projection_dim: int | None = None  # OpenCLIP text projection (SDXL enc 2)
+    eos_token_id: int = 49407
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    sample_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: Sequence[int] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    # per-resolution: 0 = plain ResNet block, N = transformer depth
+    transformer_depth: Sequence[int] = (1, 1, 1, 0)
+    attention_head_dim: int | Sequence[int] = 8  # SD1.5 stores head *count*
+    head_dim_is_count: bool = True               # SD1.5 quirk; False = per-head dim
+    cross_attention_dim: int = 768
+    use_linear_projection: bool = False
+    # SDXL micro-conditioning: concat(sin(time_ids), pooled_text) -> MLP
+    addition_embed_dim: int | None = None        # 256 for SDXL
+    addition_pooled_dim: int | None = None       # 1280 for SDXL
+    freq_shift: int = 0
+    flip_sin_to_cos: bool = True
+    dtype: str = "bfloat16"
+
+    def heads_for(self, channels: int, level: int) -> tuple[int, int]:
+        """(num_heads, head_dim) at a block level."""
+        hd = self.attention_head_dim
+        if isinstance(hd, (tuple, list)):
+            hd = hd[level]
+        if self.head_dim_is_count:
+            num_heads = int(hd)
+            return num_heads, channels // num_heads
+        head_dim = int(hd)
+        return channels // head_dim, head_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Sequence[int] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    scaling_factor: float = 0.18215
+    dtype: str = "bfloat16"
+
+    @property
+    def downscale(self) -> int:
+        return 2 ** (len(self.block_out_channels) - 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFamily:
+    """Everything static the pipelines need to run one checkpoint family."""
+
+    name: str
+    unet: UNetConfig
+    vae: VAEConfig
+    text_encoders: Sequence[TextEncoderConfig]
+    prediction_type: str = "epsilon"
+    beta_schedule: str = "scaled_linear"
+    default_size: int = 512
+    # SDXL conditions on (orig_size, crop_topleft, target_size) time ids
+    needs_time_ids: bool = False
+
+
+_CLIP_L = TextEncoderConfig()  # ViT-L/14 text tower: SD1.x, SDXL enc 1
+_CLIP_H = TextEncoderConfig(   # OpenCLIP ViT-H text tower: SD2.x
+    hidden_size=1024, intermediate_size=4096, num_layers=23, num_heads=16,
+    hidden_act="gelu",
+)
+_CLIP_BIGG = TextEncoderConfig(  # OpenCLIP ViT-bigG text tower: SDXL enc 2
+    hidden_size=1280, intermediate_size=5120, num_layers=32, num_heads=20,
+    hidden_act="gelu", projection_dim=1280, output_layer=-2,
+    final_layer_norm=False,
+)
+
+SD15 = ModelFamily(
+    name="sd15",
+    unet=UNetConfig(),
+    vae=VAEConfig(),
+    text_encoders=(_CLIP_L,),
+    default_size=512,
+)
+
+SD21 = ModelFamily(
+    name="sd21",
+    unet=UNetConfig(
+        cross_attention_dim=1024,
+        attention_head_dim=64,
+        head_dim_is_count=False,
+        use_linear_projection=True,
+    ),
+    vae=VAEConfig(),
+    text_encoders=(_CLIP_H,),
+    prediction_type="v_prediction",
+    default_size=768,
+)
+
+SDXL = ModelFamily(
+    name="sdxl",
+    unet=UNetConfig(
+        block_out_channels=(320, 640, 1280),
+        # level 0 is a plain DownBlock in real SDXL checkpoints (attention
+        # only at the two lower resolutions)
+        transformer_depth=(0, 2, 10),
+        attention_head_dim=64,
+        head_dim_is_count=False,
+        cross_attention_dim=2048,
+        use_linear_projection=True,
+        addition_embed_dim=256,
+        addition_pooled_dim=1280,
+    ),
+    vae=VAEConfig(scaling_factor=0.13025),
+    text_encoders=(
+        dataclasses.replace(_CLIP_L, output_layer=-2, final_layer_norm=False),
+        _CLIP_BIGG,
+    ),
+    default_size=1024,
+    needs_time_ids=True,
+)
+
+# Hermetic-test family: full architecture shape, toy widths — runs on CPU in
+# seconds (the tiny-model registry called for by SURVEY.md §4).
+TINY = ModelFamily(
+    name="tiny",
+    unet=UNetConfig(
+        block_out_channels=(32, 64),
+        layers_per_block=1,
+        transformer_depth=(1, 1),
+        attention_head_dim=4,
+        head_dim_is_count=True,
+        cross_attention_dim=32,
+        dtype="float32",
+    ),
+    vae=VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                  dtype="float32"),
+    text_encoders=(
+        TextEncoderConfig(vocab_size=1000, hidden_size=32,
+                          intermediate_size=64, num_layers=2, num_heads=4,
+                          max_position_embeddings=77, eos_token_id=999),
+    ),
+    default_size=64,
+)
+
+TINY_XL = ModelFamily(
+    name="tiny_xl",
+    unet=UNetConfig(
+        block_out_channels=(32, 64),
+        layers_per_block=1,
+        transformer_depth=(0, 2),  # mirrors SDXL's attention-free first level
+        attention_head_dim=8,
+        head_dim_is_count=False,
+        cross_attention_dim=64,
+        use_linear_projection=True,
+        addition_embed_dim=32,
+        addition_pooled_dim=32,
+        dtype="float32",
+    ),
+    vae=VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                  scaling_factor=0.13025, dtype="float32"),
+    text_encoders=(
+        TextEncoderConfig(vocab_size=1000, hidden_size=32,
+                          intermediate_size=64, num_layers=2, num_heads=4,
+                          eos_token_id=999),
+        TextEncoderConfig(vocab_size=1000, hidden_size=32,
+                          intermediate_size=64, num_layers=2, num_heads=4,
+                          projection_dim=32, output_layer=-2,
+                          final_layer_norm=False, eos_token_id=999),
+    ),
+    default_size=64,
+    needs_time_ids=True,
+)
+
+FAMILIES: dict[str, ModelFamily] = {
+    f.name: f for f in (SD15, SD21, SDXL, TINY, TINY_XL)
+}
+
+# hive model-name prefixes -> family (the dispatch the reference does via
+# server-sent pipeline class names, swarm/job_arguments.py:104-151)
+_NAME_HINTS = (
+    ("xl", "sdxl"),
+    ("stable-diffusion-2", "sd21"),
+    ("sd2", "sd21"),
+)
+
+
+def get_family(model_name: str) -> ModelFamily:
+    low = (model_name or "").lower()
+    for hint, family in _NAME_HINTS:
+        if hint in low:
+            return FAMILIES[family]
+    if low in FAMILIES:
+        return FAMILIES[low]
+    return FAMILIES["sd15"]

@@ -1,0 +1,316 @@
+"""Conditional diffusion UNet (Flax, NHWC) for SD 1.x / 2.x / SDXL families.
+
+This is the hot-loop model of the whole framework — the denoise step the
+reference runs inside ``pipeline(**kwargs)`` (swarm/diffusion/
+diffusion_func.py:96) spends ~all its FLOPs here. TPU-first choices:
+
+- NHWC layout throughout (XLA:TPU's native conv layout; channels ride the
+  128-lane dimension).
+- Attention runs through chiaswarm_tpu.ops.attention — spatial self-attention
+  dispatches to the Pallas flash kernel on TPU, text cross-attention stays on
+  the fused-einsum path (tiny KV).
+- Fractional timesteps supported (Karras-sigma conditioning interpolates the
+  timestep table — schedulers/common.py:sigma_to_timestep).
+- No Python control flow on traced values; the module is shape-static and
+  jits into one executable per (batch, resolution) bucket.
+
+Covers: SD1.5 (head-count attention, conv projections), SD2.1 (head-dim 64,
+linear projections, v-prediction handled by the scheduler), SDXL (mixed
+transformer depth [1,2,10], dual-text conditioning + pooled/time-id
+micro-conditioning embeddings).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.configs import UNetConfig
+from chiaswarm_tpu.models.common import num_groups as _num_groups
+from chiaswarm_tpu.models.common import upsample2x_nearest
+from chiaswarm_tpu.ops.attention import attention
+
+
+def timestep_embedding(timesteps: jnp.ndarray, dim: int,
+                       flip_sin_to_cos: bool = True,
+                       freq_shift: float = 0.0,
+                       max_period: float = 10000.0) -> jnp.ndarray:
+    """Sinusoidal embedding, (B,) -> (B, dim). fp32 regardless of model dtype."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -jnp.log(max_period) * jnp.arange(half, dtype=jnp.float32)
+        / (half - freq_shift)
+    )
+    args = timesteps.astype(jnp.float32)[:, None] * freqs[None, :]
+    sin, cos = jnp.sin(args), jnp.cos(args)
+    emb = jnp.concatenate([cos, sin], axis=-1) if flip_sin_to_cos else \
+        jnp.concatenate([sin, cos], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class TimestepEmbedding(nn.Module):
+    """Two-layer MLP lifting the sinusoidal embedding to the block width."""
+
+    out_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = nn.Dense(self.out_dim, dtype=self.dtype, name="linear_1")(x)
+        x = nn.silu(x)
+        return nn.Dense(self.out_dim, dtype=self.dtype, name="linear_2")(x)
+
+
+class ResnetBlock(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, temb: jnp.ndarray) -> jnp.ndarray:
+        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-5, dtype=jnp.float32,
+                         name="norm1")(x)
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="conv1")(h)
+        t = nn.Dense(self.out_channels, dtype=self.dtype,
+                     name="time_emb_proj")(nn.silu(temb))
+        h = h + t[:, None, None, :]
+        h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]), epsilon=1e-5, dtype=jnp.float32,
+                         name="norm2")(h)
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        name="conv_shortcut")(x)
+        return x + h
+
+
+class FeedForward(nn.Module):
+    """GEGLU feed-forward (transformer MLP used by SD's attention blocks)."""
+
+    dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        inner = self.dim * 4
+        x = nn.Dense(inner * 2, dtype=self.dtype, name="proj_in")(x)
+        x, gate = jnp.split(x, 2, axis=-1)
+        x = x * nn.gelu(gate)
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj_out")(x)
+
+
+class CrossAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, context: jnp.ndarray | None) -> jnp.ndarray:
+        context = x if context is None else context
+        inner = self.num_heads * self.head_dim
+        b, l, _ = x.shape
+        s = context.shape[1]
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(context)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(context)
+        q = q.reshape(b, l, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        out = attention(q, k, v, impl=self.attn_impl).reshape(b, l, inner)
+        return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+        # spatial self-attention (flash-kernel eligible)
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
+        x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
+                               self.attn_impl, name="attn1")(h, None)
+        # text cross-attention (small KV -> einsum path)
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
+        x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
+                               "xla", name="attn2")(h, context)
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
+        return x + FeedForward(x.shape[-1], self.dtype, name="ff")(h)
+
+
+class SpatialTransformer(nn.Module):
+    """GroupNorm -> project -> depth x TransformerBlock -> project + residual."""
+
+    depth: int
+    num_heads: int
+    head_dim: int
+    use_linear_projection: bool
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        residual = x
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
+                         name="norm")(x).astype(self.dtype)
+        if self.use_linear_projection:
+            x = x.reshape(b, h * w, c)
+            x = nn.Dense(c, dtype=self.dtype, name="proj_in")(x)
+        else:
+            x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_in")(x)
+            x = x.reshape(b, h * w, c)
+        for i in range(self.depth):
+            x = TransformerBlock(self.num_heads, self.head_dim, self.dtype,
+                                 self.attn_impl,
+                                 name=f"transformer_blocks_{i}")(x, context)
+        if self.use_linear_projection:
+            x = nn.Dense(c, dtype=self.dtype, name="proj_out")(x)
+            x = x.reshape(b, h, w, c)
+        else:
+            x = x.reshape(b, h, w, c)
+            x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_out")(x)
+        return x + residual
+
+
+class Downsample(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return nn.Conv(self.channels, (3, 3), strides=(2, 2), padding=1,
+                       dtype=self.dtype, name="conv")(x)
+
+
+class Upsample(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = upsample2x_nearest(x)
+        return nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype,
+                       name="conv")(x)
+
+
+class UNet(nn.Module):
+    """Returns the model prediction (epsilon/v per family) for NHWC latents.
+
+    ``down_residuals``/``mid_residual`` inputs accept ControlNet residual
+    injections (models/controlnet.py) — ``None`` for plain generation.
+    """
+
+    config: UNetConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(
+        self,
+        sample: jnp.ndarray,               # (B, H, W, C_latent)
+        timesteps: jnp.ndarray,            # (B,) float32 (fractional ok)
+        encoder_hidden_states: jnp.ndarray,  # (B, S, cross_attention_dim)
+        added_cond: dict[str, jnp.ndarray] | None = None,  # SDXL micro-cond
+        down_residuals: tuple[jnp.ndarray, ...] | None = None,
+        mid_residual: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.dtype
+        channels = list(cfg.block_out_channels)
+        time_embed_dim = channels[0] * 4
+
+        temb = timestep_embedding(timesteps, channels[0],
+                                  cfg.flip_sin_to_cos, cfg.freq_shift)
+        temb = TimestepEmbedding(time_embed_dim, dtype=dtype,
+                                 name="time_embedding")(temb.astype(dtype))
+
+        if cfg.addition_embed_dim is not None:
+            if added_cond is None:
+                raise ValueError("this family requires added_cond "
+                                 "(text_embeds + time_ids)")
+            time_ids = added_cond["time_ids"]          # (B, 6)
+            text_embeds = added_cond["text_embeds"]    # (B, pooled_dim)
+            b = time_ids.shape[0]
+            ids_emb = timestep_embedding(
+                time_ids.reshape(-1), cfg.addition_embed_dim,
+                cfg.flip_sin_to_cos, cfg.freq_shift,
+            ).reshape(b, -1)
+            add = jnp.concatenate([text_embeds.astype(jnp.float32), ids_emb],
+                                  axis=-1)
+            temb = temb + TimestepEmbedding(
+                time_embed_dim, dtype=dtype, name="add_embedding"
+            )(add.astype(dtype))
+
+        context = encoder_hidden_states.astype(dtype)
+        sample = sample.astype(dtype)
+
+        x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
+                    name="conv_in")(sample)
+        skips = [x]
+
+        # ---- down path
+        for level, ch in enumerate(channels):
+            depth = cfg.transformer_depth[level]
+            heads, head_dim = cfg.heads_for(ch, level)
+            for j in range(cfg.layers_per_block):
+                x = ResnetBlock(ch, dtype,
+                                name=f"down_{level}_resnets_{j}")(x, temb)
+                if depth > 0:
+                    x = SpatialTransformer(
+                        depth, heads, head_dim, cfg.use_linear_projection,
+                        dtype, name=f"down_{level}_attentions_{j}",
+                    )(x, context)
+                skips.append(x)
+            if level < len(channels) - 1:
+                x = Downsample(ch, dtype, name=f"down_{level}_downsample")(x)
+                skips.append(x)
+
+        if down_residuals is not None:
+            skips = [s + r for s, r in zip(skips, down_residuals)]
+
+        # ---- mid
+        mid_ch = channels[-1]
+        mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(channels) - 1)
+        mid_depth = max(d for d in cfg.transformer_depth) or 1
+        x = ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(x, temb)
+        x = SpatialTransformer(mid_depth, mid_heads, mid_head_dim,
+                               cfg.use_linear_projection, dtype,
+                               name="mid_attention")(x, context)
+        x = ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(x, temb)
+        if mid_residual is not None:
+            x = x + mid_residual
+
+        # ---- up path (mirrors down, consumes skips)
+        for rev, ch in enumerate(reversed(channels)):
+            level = len(channels) - 1 - rev
+            depth = cfg.transformer_depth[level]
+            heads, head_dim = cfg.heads_for(ch, level)
+            for j in range(cfg.layers_per_block + 1):
+                skip = skips.pop()
+                x = jnp.concatenate([x, skip], axis=-1)
+                x = ResnetBlock(ch, dtype,
+                                name=f"up_{level}_resnets_{j}")(x, temb)
+                if depth > 0:
+                    x = SpatialTransformer(
+                        depth, heads, head_dim, cfg.use_linear_projection,
+                        dtype, name=f"up_{level}_attentions_{j}",
+                    )(x, context)
+            if level > 0:
+                x = Upsample(ch, dtype, name=f"up_{level}_upsample")(x)
+
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-5, dtype=jnp.float32,
+                         name="conv_norm_out")(x)
+        x = nn.silu(x).astype(dtype)
+        x = nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
+                    name="conv_out")(x)
+        return x

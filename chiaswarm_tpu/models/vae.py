@@ -1,0 +1,226 @@
+"""AutoencoderKL (Flax, NHWC): latent encode/decode for all SD families.
+
+Replaces the diffusers VAE the reference runs inside its pipelines, including
+the memory-pressure features it toggles on small GPUs
+(swarm/diffusion/diffusion_func.py:89-92 ``enable_vae_slicing`` /
+``enable_vae_tiling``): here decode can run *tiled* as a jitted scan over
+fixed-size latent tiles with overlap blending — bounded VMEM/HBM at any
+resolution, no Python-loop fallback.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.configs import VAEConfig
+from chiaswarm_tpu.models.common import num_groups as _num_groups
+from chiaswarm_tpu.models.common import upsample2x_nearest
+from chiaswarm_tpu.ops.attention import attention
+
+
+class VaeResnetBlock(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
+                         name="norm1")(x)
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="conv1")(h)
+        h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
+                         name="norm2")(h)
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        name="conv_shortcut")(x)
+        return x + h
+
+
+class VaeAttention(nn.Module):
+    """Single-head spatial attention in the VAE mid block."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, w, c = x.shape
+        residual = x
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
+                         name="group_norm")(x).astype(self.dtype)
+        x = x.reshape(b, h * w, c)
+        q = nn.Dense(c, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(c, dtype=self.dtype, name="to_k")(x)
+        v = nn.Dense(c, dtype=self.dtype, name="to_v")(x)
+        out = attention(q[:, :, None, :], k[:, :, None, :], v[:, :, None, :],
+                        impl="xla")[:, :, 0, :]
+        out = nn.Dense(c, dtype=self.dtype, name="to_out")(out)
+        return out.reshape(b, h, w, c) + residual
+
+
+class VaeMid(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = VaeResnetBlock(self.channels, self.dtype, name="resnets_0")(x)
+        x = VaeAttention(self.dtype, name="attentions_0")(x)
+        return VaeResnetBlock(self.channels, self.dtype, name="resnets_1")(x)
+
+
+class Encoder(nn.Module):
+    config: VAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        chans = list(cfg.block_out_channels)
+        x = nn.Conv(chans[0], (3, 3), padding=1, dtype=self.dtype,
+                    name="conv_in")(x.astype(self.dtype))
+        for level, ch in enumerate(chans):
+            for j in range(cfg.layers_per_block):
+                x = VaeResnetBlock(ch, self.dtype,
+                                   name=f"down_{level}_resnets_{j}")(x)
+            if level < len(chans) - 1:
+                x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=((0, 1), (0, 1)),
+                            dtype=self.dtype, name=f"down_{level}_downsample")(x)
+        x = VaeMid(chans[-1], self.dtype, name="mid")(x)
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
+                         name="conv_norm_out")(x)
+        x = nn.silu(x).astype(self.dtype)
+        # 2x latent channels: mean + logvar moments
+        x = nn.Conv(2 * cfg.latent_channels, (3, 3), padding=1,
+                    dtype=jnp.float32, name="conv_out")(x)
+        return nn.Conv(2 * cfg.latent_channels, (1, 1), dtype=jnp.float32,
+                       name="quant_conv")(x)
+
+
+class Decoder(nn.Module):
+    config: VAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        chans = list(cfg.block_out_channels)
+        z = nn.Conv(cfg.latent_channels, (1, 1), dtype=self.dtype,
+                    name="post_quant_conv")(z.astype(self.dtype))
+        x = nn.Conv(chans[-1], (3, 3), padding=1, dtype=self.dtype,
+                    name="conv_in")(z)
+        x = VaeMid(chans[-1], self.dtype, name="mid")(x)
+        for rev, ch in enumerate(reversed(chans)):
+            level = len(chans) - 1 - rev
+            for j in range(cfg.layers_per_block + 1):
+                x = VaeResnetBlock(ch, self.dtype,
+                                   name=f"up_{level}_resnets_{j}")(x)
+            if level > 0:
+                x = upsample2x_nearest(x)
+                x = nn.Conv(ch, (3, 3), padding=1, dtype=self.dtype,
+                            name=f"up_{level}_upsample")(x)
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
+                         name="conv_norm_out")(x)
+        x = nn.silu(x).astype(self.dtype)
+        return nn.Conv(cfg.in_channels, (3, 3), padding=1, dtype=jnp.float32,
+                       name="conv_out")(x)
+
+
+class AutoencoderKL(nn.Module):
+    """encode: image (B,H,W,3) in [-1,1] -> scaled latents.
+    decode: scaled latents -> image in [-1,1]."""
+
+    config: VAEConfig
+
+    def setup(self) -> None:
+        dtype = jnp.dtype(self.config.dtype)
+        self.encoder = Encoder(self.config, dtype, name="encoder")
+        self.decoder = Decoder(self.config, dtype, name="decoder")
+
+    def encode_moments(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        moments = self.encoder(x)
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def encode(self, x: jnp.ndarray, rng: jax.Array | None = None) -> jnp.ndarray:
+        mean, logvar = self.encode_moments(x)
+        if rng is not None:
+            mean = mean + jnp.exp(0.5 * logvar) * jax.random.normal(
+                rng, mean.shape, dtype=mean.dtype
+            )
+        return mean * self.config.scaling_factor
+
+    def decode(self, z: jnp.ndarray) -> jnp.ndarray:
+        return self.decoder(z / self.config.scaling_factor)
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # autoencoding round trip (used by tests/training)
+        return self.decode(self.encode(x))
+
+
+def tiled_decode(
+    vae: AutoencoderKL,
+    params,
+    z: jnp.ndarray,
+    *,
+    tile: int = 64,
+    overlap: int = 8,
+) -> jnp.ndarray:
+    """Memory-bounded decode: fixed-size latent tiles with linear overlap
+    blending (TPU-native analog of diffusers' enable_vae_tiling, toggled by
+    the reference at swarm/diffusion/diffusion_func.py:89-92).
+
+    Tiles are decoded sequentially under one jit (XLA unrolls a static tile
+    grid — shapes never change), so peak activation memory is one tile's.
+    """
+    b, h, w, c = z.shape
+    stride = tile - overlap
+    f = vae.config.downscale
+
+    def decode_tile(zt):
+        return vae.apply(params, zt, method=AutoencoderKL.decode)
+
+    rows = max(1, -(-(h - overlap) // stride))
+    cols = max(1, -(-(w - overlap) // stride))
+    out_h, out_w = h * f, w * f
+    canvas = jnp.zeros((b, out_h, out_w, vae.config.in_channels), jnp.float32)
+    weight = jnp.zeros((1, out_h, out_w, 1), jnp.float32)
+
+    # strictly positive crossfade ramp: (i+1)/(ov+1) so tile borders keep
+    # nonzero weight (image edges are covered by exactly one tile and must
+    # not be zeroed); normalization below makes overlaps sum to 1.
+    ov = max(overlap * f, 1)
+    idx = jnp.arange(tile * f, dtype=jnp.float32)
+    ramp = jnp.minimum((idx + 1.0) / (ov + 1.0), 1.0)
+    edge = jnp.minimum(ramp, ramp[::-1])
+    tile_w = edge[None, :, None, None] * edge[None, None, :, None]
+
+    for i in range(rows):
+        for j in range(cols):
+            y0 = min(i * stride, max(h - tile, 0))
+            x0 = min(j * stride, max(w - tile, 0))
+            zt = jax.lax.dynamic_slice(
+                z, (0, y0, x0, 0), (b, min(tile, h), min(tile, w), c)
+            )
+            img = decode_tile(zt).astype(jnp.float32)
+            tw = tile_w[:, : img.shape[1], : img.shape[2], :]
+            canvas = jax.lax.dynamic_update_slice(
+                canvas,
+                jax.lax.dynamic_slice(
+                    canvas, (0, y0 * f, x0 * f, 0), img.shape
+                ) + img * tw,
+                (0, y0 * f, x0 * f, 0),
+            )
+            weight = jax.lax.dynamic_update_slice(
+                weight,
+                jax.lax.dynamic_slice(
+                    weight, (0, y0 * f, x0 * f, 0), (1, img.shape[1], img.shape[2], 1)
+                ) + tw,
+                (0, y0 * f, x0 * f, 0),
+            )
+    return canvas / jnp.maximum(weight, 1e-8)

@@ -1,0 +1,5 @@
+"""Compute ops: attention (XLA reference + Pallas flash kernel), fused helpers."""
+
+from chiaswarm_tpu.ops.attention import attention, AttentionImpl
+
+__all__ = ["attention", "AttentionImpl"]

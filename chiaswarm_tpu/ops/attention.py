@@ -1,0 +1,86 @@
+"""Attention dispatch — the TPU replacement for the reference's xformers
+memory-efficient attention (enabled at swarm/diffusion/diffusion_func.py:86-87).
+
+Three implementations behind one function:
+
+- ``"xla"``      — plain einsum softmax attention; XLA fuses it well for the
+                   small/medium sequence lengths of image latents. Always
+                   correct; the golden reference for kernel tests.
+- ``"flash"``    — Pallas blockwise flash-attention kernel (ops/flash_attention.py),
+                   O(L) memory, targets the MXU; used on TPU for large token
+                   counts (SDXL 1024px self-attention = 4096 tokens, video).
+- ``"auto"``     — flash on TPU when shapes qualify, else xla.
+
+All take (B, L, H, D) query / (B, S, H, D) key-value tensors and return
+(B, L, H, D). Head-batched layouts keep the last dim = head_dim (128-lane
+friendly) and let the kernel tile L/S onto the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Literal
+
+import jax
+import jax.numpy as jnp
+
+AttentionImpl = Literal["auto", "xla", "flash"]
+
+
+def _xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   scale: float) -> jnp.ndarray:
+    # (B, L, H, D) x (B, S, H, D) -> (B, H, L, S)
+    logits = jnp.einsum("blhd,bshd->bhls", q, k,
+                        preferred_element_type=jnp.float32)
+    weights = jax.nn.softmax(logits * scale, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhls,bshd->blhd", weights, v)
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_available() -> bool:
+    try:
+        from chiaswarm_tpu.ops import flash_attention  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _on_tpu(x: jnp.ndarray) -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    impl: AttentionImpl = "auto",
+) -> jnp.ndarray:
+    """Multi-head scaled dot-product attention, (B, L, H, D) layout."""
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"expected (B, L, H, D) tensors, got {q.shape}")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    use_flash = False
+    if impl == "flash":
+        use_flash = True
+    elif impl == "auto":
+        # flash pays off once L is large enough to block; tiny KV
+        # (cross-attention with 77 text tokens) stays on the einsum path.
+        use_flash = (
+            _on_tpu(q)
+            and _flash_available()
+            and q.shape[1] >= 512
+            and k.shape[1] >= 128
+        )
+
+    if use_flash:
+        from chiaswarm_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, scale=scale)
+    return _xla_attention(q, k, v, scale)

@@ -41,7 +41,7 @@ def bucket_batch(n: int) -> int:
 
 
 def bucket_image_size(height: int, width: int, *, multiple: int = 64,
-                      min_size: int = 256, max_size: int = 1024) -> tuple[int, int]:
+                      min_size: int = 64, max_size: int = 1024) -> tuple[int, int]:
     """Snap a requested image size onto the compiled lattice.
 
     Mirrors the reference's size clamp (swarm/job_arguments.py:14,96-102 caps

@@ -1,0 +1,153 @@
+"""Prompt tokenizers.
+
+The reference delegates tokenization to the CLIPTokenizer bundled inside each
+diffusers pipeline (loaded per job, swarm/diffusion/diffusion_func.py:41-46).
+Here tokenization is a host-side component with two implementations:
+
+- :class:`ClipBpeTokenizer` — a self-contained CLIP byte-pair-encoding
+  tokenizer reading the standard ``vocab.json`` + ``merges.txt`` files from a
+  local checkpoint directory (no network, no transformers dependency).
+- :class:`HashTokenizer` — deterministic hashing tokenizer for hermetic
+  tests and random-weight benchmarks where real vocab files are absent.
+
+Both produce fixed-length (77) id sequences with BOS/EOS/pad, the static
+shape every text encoder compiles against.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Protocol, Sequence
+
+import numpy as np
+
+
+class Tokenizer(Protocol):
+    max_length: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray: ...
+
+
+_WORD_RE = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d|[a-z]+|[0-9]|[^\sa-z0-9]+", re.IGNORECASE
+)
+
+
+def _basic_tokens(text: str) -> list[str]:
+    text = re.sub(r"\s+", " ", text.strip().lower())
+    return _WORD_RE.findall(text)
+
+
+class ClipBpeTokenizer:
+    """CLIP BPE over ``vocab.json``/``merges.txt`` (openai/clip format).
+
+    ASCII-oriented pre-tokenization (the CLIP regex's unicode classes reduced
+    to ASCII letter/digit classes); non-ASCII characters fall through as
+    single-symbol tokens and map to <unk>-free byte-level entries when the
+    vocab has them.
+    """
+
+    def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]],
+                 max_length: int = 77) -> None:
+        self.vocab = vocab
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.max_length = max_length
+        self.bos_id = vocab.get("<|startoftext|>", 49406)
+        self.eos_id = vocab.get("<|endoftext|>", 49407)
+        self._cache: dict[str, list[str]] = {}
+
+    @classmethod
+    def from_dir(cls, path: str | Path, max_length: int = 77) -> "ClipBpeTokenizer":
+        path = Path(path)
+        with open(path / "vocab.json", encoding="utf-8") as fh:
+            vocab = json.load(fh)
+        merges: list[tuple[str, str]] = []
+        with open(path / "merges.txt", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges, max_length)
+
+    def _bpe(self, token: str) -> list[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = list(token[:-1]) + [token[-1] + "</w>"]
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, 1 << 30))
+            if best not in self.ranks:
+                break
+            a, b = best
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = merged
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str) -> list[int]:
+        ids = [self.bos_id]
+        for tok in _basic_tokens(text):
+            for piece in self._bpe(tok):
+                ids.append(self.vocab.get(piece, self.eos_id))
+            if len(ids) >= self.max_length - 1:
+                break
+        ids = ids[: self.max_length - 1]
+        ids.append(self.eos_id)
+        ids += [self.eos_id] * (self.max_length - len(ids))  # CLIP pads w/ eos
+        return ids
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
+
+
+class HashTokenizer:
+    """Deterministic, vocab-file-free tokenizer for tiny/hermetic models."""
+
+    def __init__(self, vocab_size: int = 1000, max_length: int = 77,
+                 eos_id: int | None = None) -> None:
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.eos_id = eos_id if eos_id is not None else vocab_size - 1
+        self.bos_id = self.eos_id - 1
+
+    def encode(self, text: str) -> list[int]:
+        span = max(self.vocab_size - 2, 1)
+        ids = [self.bos_id]
+        for tok in _basic_tokens(text)[: self.max_length - 2]:
+            # FNV-1a for platform-stable hashing (hash() is salted per process)
+            h = 2166136261
+            for ch in tok.encode("utf-8"):
+                h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+            ids.append(h % span)
+        ids.append(self.eos_id)
+        ids += [self.eos_id] * (self.max_length - len(ids))
+        return ids[: self.max_length]
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
+
+
+def load_tokenizer(checkpoint_dir: str | Path | None, vocab_size: int = 49408,
+                   eos_id: int = 49407, max_length: int = 77) -> Tokenizer:
+    """ClipBpeTokenizer when vocab files exist locally, else HashTokenizer."""
+    if checkpoint_dir is not None:
+        path = Path(checkpoint_dir)
+        for sub in ("", "tokenizer"):
+            cand = path / sub if sub else path
+            if (cand / "vocab.json").exists() and (cand / "merges.txt").exists():
+                return ClipBpeTokenizer.from_dir(cand, max_length)
+    return HashTokenizer(vocab_size, max_length, eos_id)

@@ -1,0 +1,6 @@
+"""Jitted end-to-end generation pipelines + the workload callback registry."""
+
+from chiaswarm_tpu.pipelines.components import Components
+from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline, GenerateRequest
+
+__all__ = ["Components", "DiffusionPipeline", "GenerateRequest"]

@@ -1,0 +1,124 @@
+"""Model component bundles: modules + params + tokenizers for one checkpoint.
+
+The reference builds a diffusers pipeline object per job from the HF cache
+(swarm/diffusion/diffusion_func.py:41-46). The TPU equivalent is a
+:class:`Components` bundle that stays resident (core/compile_cache.py): the
+Flax modules are cheap static descriptions; the params live on device.
+
+Construction paths:
+- :meth:`Components.random` — random-init weights for hermetic tests and
+  architecture benchmarks (weights don't change FLOPs).
+- :meth:`Components.from_checkpoint` — converted torch/safetensors weights
+  via chiaswarm_tpu.convert (the initialize-time warm cache replacing
+  swarm/initialize.py:62-94).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.clip import ClipTextEncoder
+from chiaswarm_tpu.models.configs import FAMILIES, ModelFamily, get_family
+from chiaswarm_tpu.models.tokenizer import HashTokenizer, Tokenizer, load_tokenizer
+from chiaswarm_tpu.models.unet import UNet
+from chiaswarm_tpu.models.vae import AutoencoderKL
+
+
+@dataclasses.dataclass
+class Components:
+    family: ModelFamily
+    model_name: str
+    tokenizers: Sequence[Tokenizer]
+    text_encoders: Sequence[ClipTextEncoder]
+    unet: UNet
+    vae: AutoencoderKL
+    params: dict[str, Any]  # keys: text_encoder_{i}, unet, vae
+
+    @classmethod
+    def random(cls, family: ModelFamily | str, seed: int = 0,
+               model_name: str | None = None) -> "Components":
+        if isinstance(family, str):
+            family = FAMILIES[family]
+        key = jax.random.PRNGKey(seed)
+        text_encoders = [ClipTextEncoder(cfg) for cfg in family.text_encoders]
+        tokenizers = [
+            HashTokenizer(cfg.vocab_size, cfg.max_position_embeddings,
+                          cfg.eos_token_id)
+            for cfg in family.text_encoders
+        ]
+        unet = UNet(family.unet)
+        vae = AutoencoderKL(family.vae)
+
+        params: dict[str, Any] = {}
+        ids = jnp.zeros((1, family.text_encoders[0].max_position_embeddings),
+                        jnp.int32)
+        for i, te in enumerate(text_encoders):
+            key, sub = jax.random.split(key)
+            params[f"text_encoder_{i}"] = te.init(sub, ids)
+
+        latent = jnp.zeros(
+            (1, 8, 8, family.unet.sample_channels), jnp.float32
+        )
+        ctx = jnp.zeros((1, ids.shape[1], family.unet.cross_attention_dim),
+                        jnp.float32)
+        added = None
+        if family.unet.addition_embed_dim is not None:
+            added = {
+                "time_ids": jnp.zeros((1, 6), jnp.float32),
+                "text_embeds": jnp.zeros(
+                    (1, family.unet.addition_pooled_dim), jnp.float32
+                ),
+            }
+        key, sub = jax.random.split(key)
+        params["unet"] = unet.init(sub, latent, jnp.zeros((1,)), ctx, added)
+        key, sub = jax.random.split(key)
+        params["vae"] = vae.init(
+            sub, jnp.zeros((1, 16, 16, family.vae.in_channels), jnp.float32)
+        )
+        return cls(
+            family=family,
+            model_name=model_name or f"random/{family.name}",
+            tokenizers=tokenizers,
+            text_encoders=text_encoders,
+            unet=unet,
+            vae=vae,
+            params=params,
+        )
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str | Path,
+                        model_name: str | None = None,
+                        family: ModelFamily | str | None = None) -> "Components":
+        from chiaswarm_tpu.convert.torch_to_flax import load_checkpoint
+
+        checkpoint_dir = Path(checkpoint_dir)
+        model_name = model_name or checkpoint_dir.name
+        if family is None:
+            family = get_family(model_name)
+        elif isinstance(family, str):
+            family = FAMILIES[family]
+        params = load_checkpoint(checkpoint_dir, family)
+        text_encoders = [ClipTextEncoder(cfg) for cfg in family.text_encoders]
+        tokenizers = [
+            load_tokenizer(checkpoint_dir, cfg.vocab_size, cfg.eos_token_id,
+                           cfg.max_position_embeddings)
+            for cfg in family.text_encoders
+        ]
+        return cls(
+            family=family,
+            model_name=model_name,
+            tokenizers=tokenizers,
+            text_encoders=text_encoders,
+            unet=UNet(family.unet),
+            vae=AutoencoderKL(family.vae),
+            params=params,
+        )
+
+    def param_bytes(self) -> int:
+        leaves = jax.tree.leaves(self.params)
+        return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)

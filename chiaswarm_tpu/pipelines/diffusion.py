@@ -1,0 +1,298 @@
+"""The unified diffusion pipeline: txt2img / img2img / inpaint in ONE jitted
+program.
+
+The reference runs four diffusers pipeline classes for these modes, chosen by
+server-sent class names (swarm/job_arguments.py:104-151) and executed at
+swarm/diffusion/diffusion_func.py:96. TPU-first redesign: one compiled
+executable per (family, batch, size, steps, mode) bucket containing the whole
+flow — text encode -> (optional) init-latent prep -> lax.scan denoise loop
+with classifier-free guidance -> VAE decode. No host round-trips inside; the
+only host work is tokenization and uint8 conversion.
+
+Modes fold into static booleans:
+- txt2img: no init latents (pure noise at sigma_max)
+- img2img: init latents + noise at sigma[start] (strength -> start index,
+  mirroring the reference's strength semantics)
+- inpaint: img2img + per-step known-region re-projection (model-agnostic
+  "legacy" inpainting; 9-channel inpaint checkpoints plug in via family
+  config sample_channels)
+
+Guidance scale rides as a *traced* scalar so changing it never recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chiaswarm_tpu.core.compile_cache import (
+    GLOBAL_CACHE,
+    bucket_batch,
+    bucket_image_size,
+)
+from chiaswarm_tpu.core.rng import key_for_seed
+from chiaswarm_tpu.models.vae import AutoencoderKL
+from chiaswarm_tpu.pipelines.components import Components
+from chiaswarm_tpu.schedulers import (
+    SamplerConfig,
+    make_noise_schedule,
+    make_sampling_schedule,
+    resolve,
+    sampler_step,
+    scale_model_input,
+)
+from chiaswarm_tpu.schedulers.common import ScheduleConfig
+from chiaswarm_tpu.schedulers.sampling import init_sampler_state
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateRequest:
+    """One generation request (pre-normalized by the node dispatcher)."""
+
+    prompt: str
+    negative_prompt: str = ""
+    steps: int = 30
+    guidance_scale: float = 7.5
+    height: int = 512
+    width: int = 512
+    batch: int = 1
+    seed: int = 0
+    scheduler: str | None = None  # diffusers class name from the hive
+    # img2img / inpaint
+    init_image: np.ndarray | None = None   # (H, W, 3) uint8 or float [-1,1]
+    strength: float = 0.8
+    mask: np.ndarray | None = None         # (H, W) float, 1 = regenerate
+    tiled_decode: bool = False
+
+
+def _to_float_image(img: np.ndarray) -> np.ndarray:
+    img = np.asarray(img)
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 127.5 - 1.0
+    return img.astype(np.float32)
+
+
+class DiffusionPipeline:
+    """Resident, compile-cached executor for one Components bundle."""
+
+    def __init__(self, components: Components, attn_impl: str = "auto") -> None:
+        self.c = components
+        self.attn_impl = attn_impl
+        fam = components.family
+        self.schedule_config = ScheduleConfig(
+            beta_schedule=fam.beta_schedule,
+            prediction_type=fam.prediction_type,
+        )
+        self.noise_schedule = make_noise_schedule(self.schedule_config)
+
+    # ---------- host-side helpers ----------
+
+    def _tokenize(self, prompts: list[str]) -> list[np.ndarray]:
+        return [tok.encode_batch(prompts) for tok in self.c.tokenizers]
+
+    def _latent_hw(self, height: int, width: int) -> tuple[int, int]:
+        f = self.c.family.vae.downscale
+        return height // f, width // f
+
+    # ---------- jitted core ----------
+
+    def _build_fn(self, *, batch: int, height: int, width: int, steps: int,
+                  start_step: int, sampler: SamplerConfig, use_cfg: bool,
+                  has_init: bool, has_mask: bool, tiled: bool):
+        c = self.c
+        fam = c.family
+        lh, lw = self._latent_hw(height, width)
+        sched = make_sampling_schedule(self.noise_schedule, steps, sampler)
+        needs_xl = fam.unet.addition_embed_dim is not None
+
+        def encode_text(params, ids_list):
+            seqs, pooled = [], None
+            for i, te in enumerate(c.text_encoders):
+                seq, pool = te.apply(params[f"text_encoder_{i}"], ids_list[i])
+                seqs.append(seq)
+                pooled = pool  # SDXL: pooled comes from the last encoder
+            return jnp.concatenate(seqs, axis=-1) if len(seqs) > 1 else seqs[0], pooled
+
+        def fn(params, ids, neg_ids, key, guidance, init_latent, mask):
+            ctx, pooled = encode_text(params, ids)
+            if use_cfg:
+                nctx, npooled = encode_text(params, neg_ids)
+                ctx = jnp.concatenate([nctx, ctx], axis=0)
+                if pooled is not None:
+                    pooled = jnp.concatenate([npooled, pooled], axis=0)
+
+            added = None
+            if needs_xl:
+                time_ids = jnp.asarray(
+                    [height, width, 0, 0, height, width], jnp.float32
+                )[None, :].repeat(ctx.shape[0], axis=0)
+                added = {"time_ids": time_ids,
+                         "text_embeds": pooled[:, : fam.unet.addition_pooled_dim]}
+
+            key, nkey = jax.random.split(key)
+            noise = jax.random.normal(
+                nkey, (batch, lh, lw, fam.unet.sample_channels), jnp.float32
+            )
+            sigma_start = sched.sigmas[start_step]
+            if has_init:
+                x = init_latent + noise * sigma_start
+            else:
+                x = noise * sigma_start
+
+            if has_mask:
+                known = init_latent  # clean latents of the source image
+
+            def body(carry, idx):
+                x, state, key = carry
+                i = idx + start_step
+                inp = scale_model_input(sched, x, i)
+                if use_cfg:
+                    inp2 = jnp.concatenate([inp, inp], axis=0)
+                    t2 = sched.timesteps[i][None].repeat(2 * batch, axis=0)
+                    out = c.unet.apply(params["unet"], inp2, t2, ctx, added)
+                    eps_u, eps_c = jnp.split(out, 2, axis=0)
+                    eps = eps_u + guidance * (eps_c - eps_u)
+                else:
+                    t1 = sched.timesteps[i][None].repeat(batch, axis=0)
+                    eps = c.unet.apply(params["unet"], inp, t1, ctx, added)
+                key, skey = jax.random.split(key)
+                step_noise = jax.random.normal(skey, x.shape, jnp.float32)
+                x, state = sampler_step(sampler, sched, i, x, eps, state,
+                                        noise=step_noise)
+                if has_mask:
+                    # re-project known region onto the next noise level
+                    key, mkey = jax.random.split(key)
+                    renoise = jax.random.normal(mkey, x.shape, jnp.float32)
+                    known_t = known + renoise * sched.sigmas[i + 1]
+                    x = x * mask + known_t * (1.0 - mask)
+                return (x, state, key), None
+
+            n_steps = steps - start_step
+            (x, _, _), _ = jax.lax.scan(
+                body, (x, init_sampler_state(x), key), jnp.arange(n_steps)
+            )
+
+            if tiled:
+                from chiaswarm_tpu.models.vae import tiled_decode
+
+                img = tiled_decode(c.vae, params["vae"], x)
+            else:
+                img = c.vae.apply(params["vae"], x,
+                                  method=AutoencoderKL.decode)
+            return jnp.clip(img, -1.0, 1.0)
+
+        return jax.jit(fn)
+
+    def _get_fn(self, **static: Any):
+        key = (id(self.c), tuple(sorted(
+            (k, v if not dataclasses.is_dataclass(v) else
+             tuple(dataclasses.asdict(v).items()))
+            for k, v in static.items()
+        )))
+        return GLOBAL_CACHE.cached_executable(
+            key, lambda: self._build_fn(**static)
+        )
+
+    # ---------- public API ----------
+
+    def encode_init_image(self, image: np.ndarray, height: int, width: int,
+                          seed: int) -> jnp.ndarray:
+        """Host image -> scaled latents (the img2img/inpaint init)."""
+        img = _to_float_image(image)
+        if img.shape[:2] != (height, width):
+            raise ValueError(
+                f"init image {img.shape[:2]} != requested {(height, width)}; "
+                "resize on host first (node.job_args does this)"
+            )
+        x = jnp.asarray(img)[None]
+        return self.c.vae.apply(
+            self.c.params["vae"], x, key_for_seed(seed),
+            method=AutoencoderKL.encode,
+        )
+
+    def __call__(self, req: GenerateRequest) -> tuple[np.ndarray, dict]:
+        """Run a request. Returns (images uint8 (B,H,W,3), config dict)."""
+        fam = self.c.family
+        height, width = bucket_image_size(req.height, req.width)
+        batch = bucket_batch(req.batch)
+        steps = max(int(req.steps), 1)
+        sampler = resolve(req.scheduler,
+                          prediction_type=fam.prediction_type)
+        use_cfg = req.guidance_scale > 1.0
+        has_init = req.init_image is not None
+        has_mask = req.mask is not None
+
+        start_step = 0
+        init_latent = jnp.zeros((1,), jnp.float32)  # placeholder
+        mask_arr = jnp.zeros((1,), jnp.float32)
+        if has_init:
+            strength = float(np.clip(req.strength, 0.05, 1.0))
+            if not has_mask:
+                # img2img: skip the first (1-strength) of the ladder
+                start_step = min(int(round(steps * (1.0 - strength))),
+                                 steps - 1)
+            z = self.encode_init_image(req.init_image, height, width, req.seed)
+            init_latent = jnp.repeat(z, batch, axis=0)
+        if has_mask:
+            lh, lw = self._latent_hw(height, width)
+            m = np.asarray(req.mask, dtype=np.float32)
+            if m.shape != (lh, lw):
+                f = fam.vae.downscale
+                if m.shape != (lh * f, lw * f):
+                    # bring arbitrary mask sizes onto the bucketed pixel grid
+                    from PIL import Image
+
+                    m = np.asarray(Image.fromarray(
+                        (m * 255).clip(0, 255).astype(np.uint8)
+                    ).resize((lw * f, lh * f), Image.NEAREST),
+                        dtype=np.float32) / 255.0
+                # downsample to the latent grid by box-averaging
+                m = m.reshape(lh, f, lw, f).mean((1, 3))
+            mask_arr = jnp.asarray((m > 0.5).astype(np.float32))[None, :, :, None]
+
+        ids = self._tokenize([req.prompt] * batch)
+        neg = self._tokenize([req.negative_prompt or ""] * batch)
+
+        fn = self._get_fn(
+            batch=batch, height=height, width=width, steps=steps,
+            start_step=start_step, sampler=sampler, use_cfg=use_cfg,
+            has_init=has_init, has_mask=has_mask, tiled=req.tiled_decode,
+        )
+        img = fn(
+            self.c.params,
+            [jnp.asarray(i) for i in ids],
+            [jnp.asarray(i) for i in neg],
+            key_for_seed(req.seed),
+            jnp.float32(req.guidance_scale),
+            init_latent,
+            mask_arr,
+        )
+        img = np.asarray(jax.device_get(img))
+        img_u8 = ((img + 1.0) * 127.5).round().clip(0, 255).astype(np.uint8)
+        # un-bucket: crop/scale back to the exact requested size on host
+        if (height, width) != (req.height, req.width):
+            from PIL import Image
+
+            img_u8 = np.stack([
+                np.asarray(Image.fromarray(frame).resize(
+                    (req.width, req.height), Image.LANCZOS))
+                for frame in img_u8
+            ])
+        config = {
+            "model_name": self.c.model_name,
+            "family": fam.name,
+            "scheduler": sampler.kind,
+            "steps": steps,
+            "guidance_scale": float(req.guidance_scale),
+            "size": [req.height, req.width],
+            "compiled_size": [height, width],
+            "batch": batch,
+            "mode": ("inpaint" if has_mask else
+                     "img2img" if has_init else "txt2img"),
+        }
+        return img_u8[: req.batch], config

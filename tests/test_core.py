@@ -80,7 +80,8 @@ def test_bucketing():
     assert bucket_batch(3) == 4
     assert bucket_image_size(512, 512) == (512, 512)
     assert bucket_image_size(500, 700) == (512, 704)
-    assert bucket_image_size(4000, 100) == (1024, 256)
+    assert bucket_image_size(70, 60) == (128, 64)
+    assert bucket_image_size(4000, 100) == (1024, 128)
 
 
 def test_lru_cache_eviction_and_stats():

@@ -1,0 +1,120 @@
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.core.compile_cache import GLOBAL_CACHE
+from chiaswarm_tpu.pipelines import Components, DiffusionPipeline, GenerateRequest
+
+
+@pytest.fixture(scope="module")
+def tiny_pipeline():
+    return DiffusionPipeline(Components.random("tiny", seed=0))
+
+
+@pytest.fixture(scope="module")
+def tiny_xl_pipeline():
+    return DiffusionPipeline(Components.random("tiny_xl", seed=0))
+
+
+def test_txt2img_basic(tiny_pipeline):
+    req = GenerateRequest(prompt="a red fox", steps=4, height=64, width=64,
+                          seed=11, guidance_scale=5.0)
+    img, config = tiny_pipeline(req)
+    assert img.shape == (1, 64, 64, 3)
+    assert img.dtype == np.uint8
+    assert config["mode"] == "txt2img"
+    assert config["scheduler"] == "dpmpp_2m"
+    assert config["steps"] == 4
+
+    # determinism per seed
+    img2, _ = tiny_pipeline(req)
+    assert np.array_equal(img, img2)
+    img3, _ = tiny_pipeline(GenerateRequest(
+        prompt="a red fox", steps=4, height=64, width=64, seed=12,
+        guidance_scale=5.0))
+    assert not np.array_equal(img, img3)
+
+
+def test_txt2img_guidance_no_recompile(tiny_pipeline):
+    before = GLOBAL_CACHE.executables.stats["misses"]
+    for g in (3.0, 9.5):
+        tiny_pipeline(GenerateRequest(prompt="x", steps=4, height=64,
+                                      width=64, seed=1, guidance_scale=g))
+    after = GLOBAL_CACHE.executables.stats["misses"]
+    assert after - before <= 1  # same executable for both guidance values
+
+
+def test_txt2img_batch_and_odd_size(tiny_pipeline):
+    req = GenerateRequest(prompt="x", steps=2, height=70, width=60, batch=3,
+                          seed=5)
+    img, config = tiny_pipeline(req)
+    assert img.shape == (3, 70, 60, 3)      # exact request honored on host
+    assert config["batch"] == 4             # compiled at pow2 bucket
+    assert config["compiled_size"] == [128, 64]  # snapped to lattice
+
+
+def test_img2img_preserves_layout(tiny_pipeline):
+    rng = np.random.default_rng(0)
+    init = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    req = GenerateRequest(prompt="x", steps=6, height=64, width=64, seed=3,
+                          init_image=init, strength=0.4, guidance_scale=1.0)
+    img, config = tiny_pipeline(req)
+    assert config["mode"] == "img2img"
+    assert img.shape == (1, 64, 64, 3)
+
+    # strength=1.0 wipes more of the init than strength=0.2
+    low, _ = tiny_pipeline(GenerateRequest(
+        prompt="x", steps=6, height=64, width=64, seed=3, init_image=init,
+        strength=0.2, guidance_scale=1.0))
+    high, _ = tiny_pipeline(GenerateRequest(
+        prompt="x", steps=6, height=64, width=64, seed=3, init_image=init,
+        strength=1.0, guidance_scale=1.0))
+    roundtrip, _ = tiny_pipeline(GenerateRequest(
+        prompt="x", steps=6, height=64, width=64, seed=3, init_image=init,
+        strength=0.05, guidance_scale=1.0))
+    d_low = np.abs(low.astype(int) - init.astype(int)).mean()
+    d_high = np.abs(high.astype(int) - init.astype(int)).mean()
+    assert d_low < d_high
+
+
+def test_inpaint_keeps_known_region(tiny_pipeline):
+    rng = np.random.default_rng(1)
+    init = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    mask = np.zeros((64, 64), np.float32)
+    mask[:, 32:] = 1.0  # regenerate the right half only
+    req = GenerateRequest(prompt="x", steps=5, height=64, width=64, seed=9,
+                          init_image=init, mask=mask, guidance_scale=1.0)
+    img, config = tiny_pipeline(req)
+    assert config["mode"] == "inpaint"
+
+    # an all-keep mask must reproduce the VAE roundtrip of the init image
+    keep_all, _ = tiny_pipeline(GenerateRequest(
+        prompt="x", steps=5, height=64, width=64, seed=9, init_image=init,
+        mask=np.zeros((64, 64), np.float32), guidance_scale=1.0))
+    regen_all, _ = tiny_pipeline(GenerateRequest(
+        prompt="x", steps=5, height=64, width=64, seed=9, init_image=init,
+        mask=np.ones((64, 64), np.float32), guidance_scale=1.0))
+    d_keep = np.abs(keep_all.astype(int) - init.astype(int)).mean()
+    d_regen = np.abs(regen_all.astype(int) - init.astype(int)).mean()
+    assert d_keep < d_regen
+
+
+def test_sdxl_family_pipeline(tiny_xl_pipeline):
+    req = GenerateRequest(prompt="a castle", steps=3, height=64, width=64,
+                          seed=2, guidance_scale=6.0)
+    img, config = tiny_xl_pipeline(req)
+    assert img.shape == (1, 64, 64, 3)
+    assert config["family"] == "tiny_xl"
+
+
+def test_scheduler_name_routing(tiny_pipeline):
+    for name, kind in [("EulerDiscreteScheduler", "euler"),
+                       ("DDIMScheduler", "ddim"),
+                       ("EulerAncestralDiscreteScheduler", "euler_ancestral")]:
+        img, config = tiny_pipeline(GenerateRequest(
+            prompt="y", steps=3, height=64, width=64, seed=1, scheduler=name))
+        assert config["scheduler"] == kind
+        assert img.shape == (1, 64, 64, 3)
+
+
+def test_components_param_bytes(tiny_pipeline):
+    assert tiny_pipeline.c.param_bytes() > 10_000

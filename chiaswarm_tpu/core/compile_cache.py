@@ -41,7 +41,7 @@ def bucket_batch(n: int) -> int:
 
 
 def bucket_image_size(height: int, width: int, *, multiple: int = 64,
-                      min_size: int = 64, max_size: int = 1024) -> tuple[int, int]:
+                      min_size: int = 256, max_size: int = 1024) -> tuple[int, int]:
     """Snap a requested image size onto the compiled lattice.
 
     Mirrors the reference's size clamp (swarm/job_arguments.py:14,96-102 caps
@@ -76,7 +76,10 @@ class LruCache:
         self.misses = 0
 
     def get_or_create(self, key: Hashable, factory: Callable[[], Any],
-                      size_bytes: int = 0) -> Any:
+                      size_bytes: int = 0,
+                      size_of: Callable[[Any], int] | None = None) -> Any:
+        """``size_of`` computes the entry's byte size from the built value
+        (for factories whose footprint is only known after loading)."""
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
@@ -88,6 +91,8 @@ class LruCache:
         # minutes; concurrent misses on the *same* key are rare (jobs for one
         # model serialize on the slot) and harmless (last write wins).
         value = factory()
+        if size_of is not None:
+            size_bytes = size_of(value)
         with self._lock:
             existing = self._entries.get(key)
             if existing is not None:
@@ -132,8 +137,9 @@ class CompileCache:
         self.executables = LruCache(max_items=max_executables)
 
     def cached_params(self, key: Hashable, loader: Callable[[], Any],
-                      size_bytes: int = 0) -> Any:
-        return self.params.get_or_create(key, loader, size_bytes)
+                      size_bytes: int = 0,
+                      size_of: Callable[[Any], int] | None = None) -> Any:
+        return self.params.get_or_create(key, loader, size_bytes, size_of)
 
     def cached_executable(self, key: Hashable, builder: Callable[[], Any]) -> Any:
         return self.executables.get_or_create(key, builder)

@@ -47,6 +47,9 @@ class UNetConfig:
     freq_shift: int = 0
     flip_sin_to_cos: bool = True
     dtype: str = "bfloat16"
+    # attention dispatch for spatial self-attention: "auto" | "xla" | "flash"
+    # (ops/attention.py); text cross-attention always takes the einsum path
+    attn_impl: str = "auto"
 
     def heads_for(self, channels: int, level: int) -> tuple[int, int]:
         """(num_heads, head_dim) at a block level."""

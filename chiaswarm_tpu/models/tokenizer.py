@@ -102,7 +102,11 @@ class ClipBpeTokenizer:
         ids = [self.bos_id]
         for tok in _basic_tokens(text):
             for piece in self._bpe(tok):
-                ids.append(self.vocab.get(piece, self.eos_id))
+                pid = self.vocab.get(piece)
+                # drop unknown pieces: mapping them to eos would hijack the
+                # first-EOS pooled readout (models/clip.py argmax pooling)
+                if pid is not None:
+                    ids.append(pid)
             if len(ids) >= self.max_length - 1:
                 break
         ids = ids[: self.max_length - 1]

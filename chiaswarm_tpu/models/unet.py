@@ -268,7 +268,8 @@ class UNet(nn.Module):
                 if depth > 0:
                     x = SpatialTransformer(
                         depth, heads, head_dim, cfg.use_linear_projection,
-                        dtype, name=f"down_{level}_attentions_{j}",
+                        dtype, cfg.attn_impl,
+                        name=f"down_{level}_attentions_{j}",
                     )(x, context)
                 skips.append(x)
             if level < len(channels) - 1:
@@ -285,7 +286,7 @@ class UNet(nn.Module):
         x = ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(x, temb)
         x = SpatialTransformer(mid_depth, mid_heads, mid_head_dim,
                                cfg.use_linear_projection, dtype,
-                               name="mid_attention")(x, context)
+                               cfg.attn_impl, name="mid_attention")(x, context)
         x = ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(x, temb)
         if mid_residual is not None:
             x = x + mid_residual
@@ -303,7 +304,8 @@ class UNet(nn.Module):
                 if depth > 0:
                     x = SpatialTransformer(
                         depth, heads, head_dim, cfg.use_linear_projection,
-                        dtype, name=f"up_{level}_attentions_{j}",
+                        dtype, cfg.attn_impl,
+                        name=f"up_{level}_attentions_{j}",
                     )(x, context)
             if level > 0:
                 x = Upsample(ch, dtype, name=f"up_{level}_upsample")(x)

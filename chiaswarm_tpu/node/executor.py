@@ -1,0 +1,96 @@
+"""Job executor: async -> blocking bridge with error-as-artifact semantics.
+
+Capability parity with swarm/generator.py:12-95:
+
+- ``do_work`` hops from the event loop to a worker thread so generation
+  never blocks polling/uploads (reference: loop.run_in_executor, :12-14).
+- Error taxonomy drives hive retry behavior: argument-formatting errors and
+  ``ValueError`` raised by callbacks are **fatal** (``fatal_error: True`` —
+  the job's inputs are bad, do not redispatch, :34-41,:56-63); any other
+  exception returns an error artifact *without* the fatal flag so the hive
+  may retry elsewhere (:65-79).
+- Every failure renders as an artifact (image or JSON by requested
+  content type) so the user always receives a result object.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from chiaswarm_tpu import WORKER_VERSION
+from chiaswarm_tpu.node.job_args import format_args
+from chiaswarm_tpu.node.output_processor import (
+    encode_image,
+    image_from_text,
+    make_result,
+    make_text_result,
+)
+from chiaswarm_tpu.node.registry import ModelRegistry
+
+log = logging.getLogger("chiaswarm.executor")
+
+
+async def do_work(job: dict[str, Any], slot, registry: ModelRegistry) -> dict:
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, synchronous_do_work, job, slot, registry
+    )
+
+
+def _error_payload(exc: Exception, content_type: str) -> tuple[dict, dict]:
+    message = exc.args[0] if exc.args else "error generating result"
+    message = str(message)
+    config = {"error": message}
+    if content_type.startswith("image/"):
+        img = image_from_text(message)
+        artifacts = {
+            "primary": make_result(encode_image(img, content_type),
+                                   content_type)
+        }
+    else:
+        artifacts = {"primary": make_text_result(message)}
+    return artifacts, config
+
+
+def _result(job_id: Any, artifacts: dict, config: dict,
+            fatal: bool = False) -> dict[str, Any]:
+    result = {
+        "id": job_id,
+        "artifacts": artifacts,
+        "nsfw": config.get("nsfw", False),
+        "worker_version": WORKER_VERSION,
+        "pipeline_config": config,
+    }
+    if fatal:
+        result["fatal_error"] = True
+    return result
+
+
+def synchronous_do_work(job: dict[str, Any], slot,
+                        registry: ModelRegistry) -> dict[str, Any]:
+    job = dict(job)
+    job_id = job.pop("id", None)
+    content_type = job.get("content_type", "image/jpeg")
+    log.info("processing job %s", job_id)
+
+    try:
+        callback, kwargs = format_args(job, registry)
+    except Exception as exc:  # bad inputs: fatal, do not redispatch
+        log.warning("job %s failed formatting: %s", job_id, exc)
+        artifacts, config = _error_payload(exc, content_type)
+        return _result(job_id, artifacts, config, fatal=True)
+
+    try:
+        artifacts, config = slot(callback, **kwargs)
+    except ValueError as exc:  # callback-declared unrecoverable input error
+        log.warning("job %s fatal: %s", job_id, exc)
+        artifacts, config = _error_payload(exc, content_type)
+        return _result(job_id, artifacts, config, fatal=True)
+    except Exception as exc:  # transient: error artifact, hive may retry
+        log.exception("job %s errored", job_id)
+        artifacts, config = _error_payload(exc, content_type)
+        return _result(job_id, artifacts, config)
+
+    return _result(job_id, artifacts, config)

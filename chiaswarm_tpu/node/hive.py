@@ -1,0 +1,98 @@
+"""Hive protocol client — the HTTP control plane to the job queue.
+
+Wire-compatible with the reference's endpoints so a node can join the same
+swarm (SURVEY.md §2c):
+
+- ``GET  {uri}/api/work``    long-poll for jobs     (swarm/worker.py:58-110)
+- ``POST {uri}/api/results`` upload artifact envelopes (swarm/worker.py:145-163)
+- ``GET  {uri}/api/models``  model catalog          (swarm/initialize.py:97-116)
+
+Bearer-token auth; worker version + name ride as query params. The adaptive
+poll cadence (1 s after work, 11 s idle, 121 s after an error) is the
+protocol's congestion control and is preserved as constants here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+import aiohttp
+
+from chiaswarm_tpu import WORKER_VERSION
+
+log = logging.getLogger("chiaswarm.hive")
+
+POLL_BUSY_S = 1     # work found: the hive has more, come right back
+POLL_IDLE_S = 11    # nothing queued
+POLL_ERROR_S = 121  # network/hive error backoff
+
+
+class BadWorkerError(RuntimeError):
+    """HTTP 400 from the hive: this worker is misbehaving (e.g. not
+    returning results within expectations) — parity with
+    swarm/worker.py:92-97 where the hive does timeout-based failure
+    detection."""
+
+
+class HiveClient:
+    def __init__(self, uri: str, token: str, worker_name: str) -> None:
+        self.api = f"{uri.rstrip('/')}/api"
+        self.token = token
+        self.worker_name = worker_name
+
+    def _headers(self) -> dict[str, str]:
+        return {
+            "Content-type": "application/json",
+            "Authorization": f"Bearer {self.token}",
+            "user-agent": f"chiaSWARM.worker/{WORKER_VERSION}",
+        }
+
+    async def get_work(self, session: aiohttp.ClientSession) -> list[dict]:
+        """Fetch queued jobs; raises on non-200 (caller applies backoff)."""
+        async with session.get(
+            f"{self.api}/work",
+            params={
+                "worker_version": WORKER_VERSION,
+                "worker_name": self.worker_name,
+            },
+            headers=self._headers(),
+            timeout=aiohttp.ClientTimeout(total=10),
+        ) as response:
+            if response.status == 200:
+                payload = await response.json()
+                return list(payload.get("jobs", []))
+            if response.status == 400:
+                payload = await response.json()
+                raise BadWorkerError(payload.get("message", "bad worker"))
+            response.raise_for_status()
+            return []
+
+    async def post_result(self, session: aiohttp.ClientSession,
+                          result: dict[str, Any]) -> dict[str, Any]:
+        async with session.post(
+            f"{self.api}/results",
+            data=json.dumps(result),
+            headers=self._headers(),
+            timeout=aiohttp.ClientTimeout(total=60),
+        ) as response:
+            if response.status >= 400:
+                log.error("hive rejected result (%s): %s", response.status,
+                          response.reason)
+                response.raise_for_status()
+            try:
+                return await response.json()
+            except Exception:  # non-JSON 2xx body — accept the upload
+                return {"status": response.status}
+
+    async def get_models(self, session: aiohttp.ClientSession) -> list[dict]:
+        async with session.get(
+            f"{self.api}/models",
+            headers=self._headers(),
+            timeout=aiohttp.ClientTimeout(total=30),
+        ) as response:
+            response.raise_for_status()
+            payload = await response.json()
+            return payload.get("models", payload) if isinstance(payload, dict) \
+                else payload

@@ -1,0 +1,215 @@
+"""Job dispatch + argument normalization — THE routing table.
+
+Capability parity with swarm/job_arguments.py:17-190: a hive job dict maps
+to ``(callback, kwargs)`` by workflow; stable-diffusion jobs get their
+inputs rationalized (size clamp, input-image fetch with guards, ControlNet
+rewiring, instruct-pix2pix strength remap, default steps, server-listed
+unsupported-argument stripping).
+
+TPU-first differences: the server's diffusers *class names* don't resolve
+to classes here — ``pipeline_type`` folds into the unified jitted pipeline's
+static mode flags and ``scheduler_type`` maps through
+schedulers.resolve (same server contract, no dynamic imports); a
+``registry`` (node/registry.py) rides along so callbacks bind resident
+compiled models instead of loading weights per job.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+from typing import Any, Callable
+
+import numpy as np
+from PIL import Image, ImageOps
+
+from chiaswarm_tpu.node.registry import ModelRegistry
+
+log = logging.getLogger("chiaswarm.dispatch")
+
+MAX_SIZE = 1024
+MAX_IMAGE_BYTES = 3 * 1048576   # input guard, job_arguments.py:172-176
+DEFAULT_STEPS = 30              # job_arguments.py:139-141
+
+FormatResult = tuple[Callable[..., tuple[dict, dict]], dict[str, Any]]
+
+
+def format_args(job: dict[str, Any], registry: ModelRegistry) -> FormatResult:
+    """Route one hive job. Raises on malformed input (treated as a fatal,
+    non-retryable error by the executor — swarm/generator.py:34-41)."""
+    args = dict(job)
+    args["registry"] = registry
+    workflow = args.pop("workflow", None)
+
+    if workflow == "txt2audio":
+        from chiaswarm_tpu.workloads.audio import (
+            tts_callback, txt2audio_callback,
+        )
+
+        if args.get("model_name") == "suno/bark":
+            return tts_callback, args
+        return _format_audio_args(args)
+
+    if workflow == "stitch":
+        from chiaswarm_tpu.workloads.stitch import stitch_callback
+
+        return stitch_callback, args
+
+    if workflow == "img2txt":
+        from chiaswarm_tpu.workloads.caption import caption_callback
+
+        if "start_image_uri" in args:
+            args["image"] = np.asarray(
+                get_image(args.pop("start_image_uri"), None)
+            )
+        return caption_callback, args
+
+    if workflow == "vid2vid":
+        from chiaswarm_tpu.workloads.video import vid2vid_callback
+
+        return vid2vid_callback, args
+
+    if workflow == "txt2vid":
+        from chiaswarm_tpu.workloads.video import txt2vid_callback
+
+        return _format_txt2vid_args(args)
+
+    if str(args.get("model_name", "")).startswith("DeepFloyd/"):
+        from chiaswarm_tpu.workloads.cascade import cascade_callback
+
+        return cascade_callback, args
+
+    return _format_stable_diffusion_args(args)
+
+
+def _pop_parameters(args: dict[str, Any]) -> dict[str, Any]:
+    parameters = args.pop("parameters", {}) or {}
+    args.setdefault("prompt", "")
+    return parameters
+
+
+def _strip_unsupported(args: dict[str, Any], parameters: dict[str, Any]) -> None:
+    """Server-driven capability negotiation (job_arguments.py:150-151)."""
+    for name in parameters.get("unsupported_pipeline_arguments", []):
+        args.pop(name, None)
+
+
+def _format_audio_args(args: dict[str, Any]) -> FormatResult:
+    from chiaswarm_tpu.workloads.audio import txt2audio_callback
+
+    parameters = _pop_parameters(args)
+    args.setdefault("num_inference_steps", 25)
+    args["scheduler_type"] = parameters.pop("scheduler_type", None)
+    _strip_unsupported(args, parameters)
+    return txt2audio_callback, args
+
+
+def _format_txt2vid_args(args: dict[str, Any]) -> FormatResult:
+    from chiaswarm_tpu.workloads.video import txt2vid_callback
+
+    parameters = _pop_parameters(args)
+    args.setdefault("num_inference_steps", 25)
+    args.pop("num_images_per_prompt", None)
+    args["scheduler_type"] = parameters.pop("scheduler_type", None)
+    _strip_unsupported(args, parameters)
+    return txt2vid_callback, args
+
+
+def _format_stable_diffusion_args(args: dict[str, Any]) -> FormatResult:
+    from chiaswarm_tpu.workloads.diffusion import diffusion_callback
+
+    size = None
+    if "height" in args and "width" in args:
+        size = (int(args["height"]), int(args["width"]))
+        if size[0] > MAX_SIZE or size[1] > MAX_SIZE:
+            raise ValueError(
+                f"The max image size is ({MAX_SIZE}, {MAX_SIZE}); "
+                f"got ({size[0]}, {size[1]})."
+            )
+
+    parameters = _pop_parameters(args)
+    args["upscale"] = parameters.get("upscale", False)
+
+    if "start_image_uri" in args:
+        args.pop("height", None)
+        args.pop("width", None)
+        controlnet = parameters.get("controlnet")
+        image = get_image(args.pop("start_image_uri"), size, controlnet)
+        args["image"] = np.asarray(image)
+
+        if controlnet is not None:
+            args["controlnet_model_name"] = controlnet.get(
+                "controlnet_model_name", "lllyasviel/control_v11p_sd15_canny"
+            )
+            args["save_preprocessed_input"] = controlnet.get("preprocess",
+                                                             False)
+        if args.get("model_name") == "timbrooks/instruct-pix2pix":
+            # pix2pix conditions on image_guidance_scale (1-5), the hive
+            # sends strength (0-1) — same remap as job_arguments.py:128-131
+            args["image_guidance_scale"] = args.pop("strength", 0.6) * 5
+
+    if "mask_image_uri" in args:
+        args.pop("height", None)
+        args.pop("width", None)
+        mask = get_image(args.pop("mask_image_uri"), size)
+        args["mask_image"] = np.asarray(mask)
+
+    args.setdefault("num_inference_steps", DEFAULT_STEPS)
+    # server-named diffusers scheduler class -> our sampler registry
+    args["scheduler_type"] = parameters.pop("scheduler_type", None)
+    _strip_unsupported(args, parameters)
+    return diffusion_callback, args
+
+
+# ---- input fetching with trust-boundary guards ------------------------
+
+
+def download_image(url: str) -> Image.Image:
+    import requests
+
+    response = requests.get(url, allow_redirects=True, timeout=60)
+    response.raise_for_status()
+    # re-check after download: HEAD Content-Length can be absent or forged
+    if len(response.content) > MAX_IMAGE_BYTES:
+        raise ValueError(
+            f"Input image too large.\nMax size is {MAX_IMAGE_BYTES} bytes.\n"
+            f"Image was {len(response.content)}."
+        )
+    image = Image.open(io.BytesIO(response.content))
+    image = ImageOps.exif_transpose(image)
+    return image.convert("RGB")
+
+
+def get_image(uri: str, size: tuple[int, int] | None,
+              controlnet: dict | None = None) -> Image.Image:
+    """Fetch an input image with the open-network guards the reference
+    enforces (job_arguments.py:162-190): content-type must be an image,
+    payload capped at 3 MiB, downscaled to the requested / max size."""
+    import requests
+
+    head = requests.head(uri, allow_redirects=True, timeout=30)
+    content_type = head.headers.get("Content-Type", "")
+    content_length = int(head.headers.get("Content-Length", 0) or 0)
+    if not content_type.startswith("image"):
+        raise ValueError(
+            "Input does not appear to be an image.\n"
+            f"Content type was {content_type}."
+        )
+    if content_length > MAX_IMAGE_BYTES:
+        raise ValueError(
+            f"Input image too large.\nMax size is {MAX_IMAGE_BYTES} bytes.\n"
+            f"Image was {content_length}."
+        )
+
+    image = download_image(uri)
+    if size is not None and (image.height > size[0] or image.width > size[1]):
+        # PIL thumbnail takes (max_width, max_height); size is (H, W)
+        image.thumbnail((size[1], size[0]), Image.Resampling.LANCZOS)
+    elif image.height > MAX_SIZE or image.width > MAX_SIZE:
+        image.thumbnail((MAX_SIZE, MAX_SIZE), Image.Resampling.LANCZOS)
+
+    if controlnet is not None:
+        from chiaswarm_tpu.workloads.controlnet import preprocess_image
+
+        image = preprocess_image(image, controlnet)
+    return image

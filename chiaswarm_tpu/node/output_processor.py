@@ -1,0 +1,149 @@
+"""Artifact envelope: the uniform result format posted back to the hive.
+
+Capability parity with the reference's OutputProcessor
+(swarm/output_processor.py:10-136): every workload result becomes
+``{blob: base64, content_type, thumbnail: base64, sha256_hash}``; multi-image
+batches compose into square-ish grids; text results wrap as JSON; errors
+render as images so the user always sees *something* (the reference's
+error-as-artifact UX, swarm/generator.py:82-95).
+
+TPU-first difference: generation hands over a single uint8 numpy batch
+(device->host happens once, in the pipeline), so grid composition and PNG
+encode are pure-numpy/PIL host work with no framework coupling.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import io
+import json
+from typing import Any, Iterable
+
+import numpy as np
+from PIL import Image, ImageDraw
+
+THUMBNAIL_SIZE = 100
+
+# grid layouts: count -> (rows, cols); mirrors the 1/2/4/6/9-up behavior of
+# swarm/output_processor.py:90-118
+_GRIDS = {1: (1, 1), 2: (1, 2), 3: (1, 3), 4: (2, 2), 6: (2, 3), 9: (3, 3)}
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode("ascii")
+
+
+def encode_image(image: Image.Image, content_type: str = "image/png") -> bytes:
+    fmt = "PNG" if "png" in content_type else "JPEG"
+    buf = io.BytesIO()
+    if fmt == "JPEG" and image.mode != "RGB":
+        image = image.convert("RGB")
+    image.save(buf, format=fmt, quality=95)
+    return buf.getvalue()
+
+
+def thumbnail(image: Image.Image) -> bytes:
+    thumb = image.copy()
+    thumb.thumbnail((THUMBNAIL_SIZE, THUMBNAIL_SIZE))
+    return encode_image(thumb, "image/jpeg")
+
+
+def image_grid(images: list[Image.Image]) -> Image.Image:
+    """Compose N images into the canonical grid; odd counts pad with black."""
+    n = len(images)
+    if n == 1:
+        return images[0]
+    rows, cols = _GRIDS.get(n, ((n + 2) // 3, 3))
+    w, h = images[0].size
+    grid = Image.new("RGB", (cols * w, rows * h))
+    for i, img in enumerate(images[: rows * cols]):
+        grid.paste(img, ((i % cols) * w, (i // cols) * h))
+    return grid
+
+
+def image_from_text(message: str, size: tuple[int, int] = (512, 512)) -> Image.Image:
+    """Render an error/status message as an image (error-as-artifact UX)."""
+    img = Image.new("RGB", size, (24, 24, 28))
+    draw = ImageDraw.Draw(img)
+    margin, y, line_w = 16, 16, 56
+    words, line = message.split(), ""
+    for word in words:
+        if len(line) + len(word) + 1 > line_w:
+            draw.text((margin, y), line, fill=(230, 230, 230))
+            y += 18
+            line = word
+        else:
+            line = f"{line} {word}".strip()
+        if y > size[1] - 32:
+            break
+    draw.text((margin, y), line, fill=(230, 230, 230))
+    return img
+
+
+def make_result(blob: bytes, content_type: str,
+                thumb: bytes | None = None) -> dict[str, Any]:
+    """The wire envelope: blob + thumbnail + integrity hash
+    (sha256 parity with swarm/output_processor.py:46-58)."""
+    return {
+        "blob": _b64(blob),
+        "content_type": content_type,
+        "thumbnail": _b64(thumb if thumb is not None else blob),
+        "sha256_hash": hashlib.sha256(blob).hexdigest(),
+    }
+
+
+def make_text_result(text: str | dict) -> dict[str, Any]:
+    # string payloads wrap as {"caption": ...} — the wire shape hive clients
+    # expect for text artifacts (swarm/output_processor.py:61-70)
+    payload = json.dumps(text if isinstance(text, dict) else {"caption": text})
+    blob = payload.encode("utf-8")
+    return {
+        "blob": _b64(blob),
+        "content_type": "application/json",
+        "thumbnail": _b64(blob),
+        "sha256_hash": hashlib.sha256(blob).hexdigest(),
+    }
+
+
+class OutputProcessor:
+    """Collects named artifacts for one job and emits the result dict."""
+
+    def __init__(self, content_type: str = "image/png") -> None:
+        self.content_type = content_type
+        self._images: dict[str, list[Image.Image]] = {}
+        self._other: dict[str, dict[str, Any]] = {}
+
+    # ---- collection ----
+
+    def add_images(self, images: np.ndarray | Iterable[Image.Image],
+                   key: str = "primary") -> None:
+        if isinstance(images, np.ndarray):
+            if images.ndim == 3:
+                images = images[None]
+            images = [Image.fromarray(frame) for frame in images]
+        self._images.setdefault(key, []).extend(images)
+
+    def add_error(self, message: str, key: str = "primary") -> None:
+        self.add_images([image_from_text(message)], key)
+
+    def add_blob(self, blob: bytes, content_type: str, key: str,
+                 thumb: bytes | None = None) -> None:
+        self._other[key] = make_result(blob, content_type, thumb)
+
+    def add_text(self, text: str | dict, key: str = "primary") -> None:
+        self._other[key] = make_text_result(text)
+
+    # ---- emission ----
+
+    def get_results(self) -> dict[str, dict[str, Any]]:
+        out: dict[str, dict[str, Any]] = {}
+        for key, images in self._images.items():
+            composed = image_grid(images)
+            out[key] = make_result(
+                encode_image(composed, self.content_type),
+                self.content_type,
+                thumbnail(composed),
+            )
+        out.update(self._other)
+        return out

@@ -1,0 +1,97 @@
+"""Model registry: hive catalog + resident component bundles.
+
+Two reference behaviors merge here:
+
+1. the server-driven model catalog (``GET /api/models`` cached to
+   ``models.json``, swarm/initialize.py:97-116) whose per-model
+   ``parameters`` drive dispatch (swarm/job_arguments.py:104-151), and
+2. model loading — which the reference does per job from the HF cache
+   (swarm/diffusion/diffusion_func.py:41-46). On TPU weights stay resident
+   (core/compile_cache.py): loading + conversion + XLA compilation amortize
+   across jobs, which is the single biggest architectural departure
+   (SURVEY.md §7 "hard parts" #3).
+
+Checkpoints live under ``<settings root>/models/<name with / -> __>`` in
+HF-diffusers directory layout; ``allow_random=True`` (tests, benches)
+fabricates random weights of the right family instead.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+from chiaswarm_tpu.core.compile_cache import GLOBAL_CACHE
+from chiaswarm_tpu.models.configs import FAMILIES, ModelFamily, get_family
+from chiaswarm_tpu.node.settings import load_file, settings_root
+from chiaswarm_tpu.pipelines.components import Components
+from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline
+
+log = logging.getLogger("chiaswarm.registry")
+
+
+def model_dir(model_name: str) -> Path:
+    return settings_root() / "models" / model_name.replace("/", "__")
+
+
+class ModelRegistry:
+    def __init__(self, catalog: list[dict] | None = None,
+                 allow_random: bool = False,
+                 attn_impl: str = "auto") -> None:
+        if catalog is None:
+            catalog = load_file("models.json") or []
+        self._catalog = {m.get("name", m.get("model_name", "")): m
+                         for m in catalog}
+        self.allow_random = allow_random
+        self.attn_impl = attn_impl
+
+    # ---- catalog (server-driven config, job_arguments.py:104-151) ----
+
+    def entry(self, model_name: str) -> dict[str, Any]:
+        return self._catalog.get(model_name, {})
+
+    def parameters(self, model_name: str) -> dict[str, Any]:
+        return dict(self.entry(model_name).get("parameters", {}))
+
+    def known_models(self) -> list[str]:
+        return list(self._catalog)
+
+    # ---- residency ----
+
+    def family_for(self, model_name: str) -> ModelFamily:
+        fam = self.entry(model_name).get("family")
+        if fam and fam in FAMILIES:
+            return FAMILIES[fam]
+        return get_family(model_name)
+
+    def _load_components(self, model_name: str) -> Components:
+        ckpt = model_dir(model_name)
+        if ckpt.exists():
+            log.info("loading checkpoint %s from %s", model_name, ckpt)
+            return Components.from_checkpoint(
+                ckpt, model_name, self.family_for(model_name)
+            )
+        if self.allow_random:
+            log.warning("no checkpoint for %s; using random weights",
+                        model_name)
+            return Components.random(self.family_for(model_name),
+                                     model_name=model_name)
+        raise ValueError(
+            f"model {model_name!r} is not available on this node "
+            f"(no checkpoint at {ckpt}); run `swarm-tpu init` to fetch it"
+        )
+
+    def pipeline(self, model_name: str) -> DiffusionPipeline:
+        """Resident pipeline (components + params + compiled executables),
+        one LRU entry under the HBM byte budget: evicting the entry drops
+        the only strong reference to the param tree."""
+        return GLOBAL_CACHE.cached_params(
+            ("pipeline", model_name),
+            lambda: DiffusionPipeline(self._load_components(model_name),
+                                      attn_impl=self.attn_impl),
+            size_of=lambda pipe: pipe.c.param_bytes(),
+        )
+
+    def components(self, model_name: str) -> Components:
+        return self.pipeline(model_name).c

@@ -1,0 +1,187 @@
+"""The worker daemon: poll the hive, execute on mesh slots, upload results.
+
+Capability parity with swarm/worker.py:21-195, with the reference's
+concurrency bug fixed: the reference acquires the GPU semaphore both while
+*polling* and while *executing* (worker.py:60,108 + 118,127), serializing
+the two on single-GPU nodes (SURVEY.md §3.1). Here backpressure is the
+bounded ``work_queue`` alone — the poll loop simply waits for queue space,
+and each slot task owns its own execution; no shared semaphore.
+
+Startup gates mirror the reference's (worker.py:166-181): an accelerator
+must be present (TPU/virtual-CPU mesh instead of CUDA), logging configured,
+and matmul precision pinned (bf16 — the TPU analog of TF32 knobs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+import aiohttp
+import jax
+
+from chiaswarm_tpu.core.chip_pool import ChipPool
+from chiaswarm_tpu.node.executor import do_work
+from chiaswarm_tpu.node.hive import (
+    POLL_BUSY_S,
+    POLL_ERROR_S,
+    POLL_IDLE_S,
+    BadWorkerError,
+    HiveClient,
+)
+from chiaswarm_tpu.node.logging_setup import setup_logging
+from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.node.settings import Settings, load_settings
+
+log = logging.getLogger("chiaswarm.worker")
+
+
+class Worker:
+    """One node process: N mesh-slot executors + poll/upload tasks.
+
+    Designed as a class (vs the reference's module globals) so tests can run
+    multiple hermetic workers against a FakeHive in one process.
+    """
+
+    def __init__(self, settings: Settings | None = None,
+                 pool: ChipPool | None = None,
+                 registry: ModelRegistry | None = None,
+                 hive: HiveClient | None = None) -> None:
+        self.settings = settings or load_settings()
+        self.pool = pool if pool is not None else self._default_pool()
+        self.registry = registry or ModelRegistry(
+            attn_impl="auto" if self.settings.use_flash_attention else "xla"
+        )
+        self.hive = hive or HiveClient(
+            self.settings.hive_uri, self.settings.hive_token,
+            self.settings.worker_name,
+        )
+        self.work_queue: asyncio.Queue = asyncio.Queue(maxsize=len(self.pool))
+        self.result_queue: asyncio.Queue = asyncio.Queue()
+        self._stop = asyncio.Event()
+        self.jobs_done = 0
+
+    def _default_pool(self) -> ChipPool:
+        from chiaswarm_tpu.core.mesh import MeshSpec
+
+        spec = (MeshSpec(dict(self.settings.mesh_shape))
+                if self.settings.mesh_shape else None)
+        return ChipPool(n_slots=1, mesh_spec=spec)
+
+    # ---- lifecycle ----
+
+    def startup(self) -> None:
+        devices = jax.devices()
+        if not devices:
+            raise RuntimeError("no accelerator devices present; quitting")
+        from chiaswarm_tpu.node.settings import settings_root
+
+        setup_logging(settings_root() / "logs", self.settings.log_filename,
+                      self.settings.log_level)
+        log.info("worker %s: %d device(s), %d slot(s), backend=%s",
+                 self.settings.worker_name, len(devices), len(self.pool),
+                 jax.default_backend())
+        # bf16 matmuls on the MXU — the TPU analog of the reference's
+        # TF32/cudnn.benchmark startup knobs (swarm/worker.py:179-181)
+        jax.config.update("jax_default_matmul_precision", "bfloat16")
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    async def run(self) -> None:
+        self.startup()
+        tasks = [
+            asyncio.create_task(self._slot_worker(slot), name=f"slot{i}")
+            for i, slot in enumerate(self.pool)
+        ]
+        tasks.append(asyncio.create_task(self._result_worker(),
+                                         name="results"))
+        tasks.append(asyncio.create_task(self._poll_loop(), name="poll"))
+        try:
+            await self._stop.wait()
+        finally:
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    # ---- tasks ----
+
+    async def _poll_loop(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while not self._stop.is_set():
+                # natural backpressure: wait for queue space, not a semaphore
+                while self.work_queue.full():
+                    await asyncio.sleep(1)
+                delay = await self._ask_for_work(session)
+                try:
+                    await asyncio.wait_for(self._stop.wait(), timeout=delay)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def _ask_for_work(self, session: aiohttp.ClientSession) -> float:
+        try:
+            jobs = await self.hive.get_work(session)
+        except BadWorkerError as exc:
+            log.error("hive flagged this worker: %s", exc)
+            return POLL_ERROR_S
+        except Exception as exc:
+            log.warning("poll failed: %s", exc)
+            return POLL_ERROR_S
+        for job in jobs:
+            log.info("got job %s", job.get("id"))
+            await self.work_queue.put(job)
+        return POLL_BUSY_S if jobs else POLL_IDLE_S
+
+    async def _slot_worker(self, slot) -> None:
+        while True:
+            job = await self.work_queue.get()
+            try:
+                result = await do_work(job, slot, self.registry)
+                await self.result_queue.put(result)
+                self.jobs_done += 1
+            except Exception as exc:  # keep the loop alive, always
+                log.exception("slot worker error: %s", exc)
+            finally:
+                self.work_queue.task_done()
+
+    RESULT_RETRIES = 3
+    RESULT_RETRY_DELAY_S = 5.0
+
+    async def _result_worker(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while True:
+                result = await self.result_queue.get()
+                try:
+                    await self._upload_with_retry(session, result)
+                finally:
+                    self.result_queue.task_done()
+
+    async def _upload_with_retry(self, session, result) -> None:
+        """A completed job's result embodies real chip time; a transient
+        upload blip must not discard it (and a dropped result gets this
+        worker flagged by the hive's timeout-based failure detection)."""
+        for attempt in range(1, self.RESULT_RETRIES + 1):
+            try:
+                response = await self.hive.post_result(session, result)
+                log.info("uploaded result %s: %s", result.get("id"), response)
+                return
+            except Exception as exc:
+                log.warning("result upload attempt %d/%d failed: %s",
+                            attempt, self.RESULT_RETRIES, exc)
+                if attempt < self.RESULT_RETRIES:
+                    await asyncio.sleep(self.RESULT_RETRY_DELAY_S * attempt)
+        log.error("dropping result %s after %d failed uploads",
+                  result.get("id"), self.RESULT_RETRIES)
+
+
+async def run_worker(settings: Settings | None = None) -> None:
+    await Worker(settings).run()
+
+
+def main() -> None:  # `python -m chiaswarm_tpu.node.worker`
+    asyncio.run(run_worker())
+
+
+if __name__ == "__main__":
+    main()

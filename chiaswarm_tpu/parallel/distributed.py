@@ -1,0 +1,77 @@
+"""Multi-host distributed runtime init.
+
+The reference's inter-node story is HTTP to the hive only — there is no
+collective backend of any kind (SURVEY.md §2c, verified: no
+NCCL/MPI/torch.distributed anywhere in the reference). A TPU pod *is* a
+collective machine, so this module owns the two deployment modes:
+
+1. **Fleet mode** (default, mirrors the reference): every host runs an
+   independent worker polling the hive; jobs are data-parallel across hosts
+   with no cross-host collectives. Nothing to initialize.
+2. **Pod mode**: one logical worker spans all hosts of a slice
+   (`jax.distributed.initialize`); the mesh covers every chip and big-batch
+   or model-sharded jobs run as one multi-controller SPMD program with
+   XLA collectives riding ICI (and DCN between slices).
+
+Env contract (standard JAX multi-controller): COORDINATOR_ADDRESS,
+NUM_PROCESSES, PROCESS_ID — or TPU metadata auto-detection when present.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger("chiaswarm.distributed")
+
+_initialized = False
+
+
+def init_pod(coordinator: str | None = None, num_processes: int | None = None,
+             process_id: int | None = None) -> None:
+    """Initialize the multi-controller runtime (idempotent).
+
+    Call before any jax device op when running pod mode. On single-host
+    (or under the CPU test platform) this is a no-op fallback.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("COORDINATOR_ADDRESS")
+    num_processes = num_processes or _env_int("NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("PROCESS_ID")
+    try:
+        if coordinator:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            # TPU metadata server path (no args) — only meaningful on TPU VMs
+            if jax.default_backend() == "tpu":
+                jax.distributed.initialize()
+        _initialized = True
+        log.info("pod mode: process %s/%s, %d global devices",
+                 jax.process_index(), jax.process_count(),
+                 len(jax.devices()))
+    except Exception as exc:  # single host / already-initialized / CPU tests
+        log.info("pod init skipped (%s); running single-controller", exc)
+        _initialized = True
+
+
+def _env_int(name: str) -> int | None:
+    raw = os.environ.get(name)
+    return int(raw) if raw else None
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def local_data_shard(global_batch: int) -> tuple[int, int]:
+    """(start, size) of this host's slice of a pod-wide batch."""
+    per = global_batch // jax.process_count()
+    return jax.process_index() * per, per

@@ -1,0 +1,99 @@
+"""Ring attention: sequence/context-parallel attention over a mesh axis.
+
+The reference has no long-context story at all (SURVEY.md §5: "Long-context
+/ sequence parallelism: ABSENT") — its answer to memory pressure is
+attention *slicing* on one GPU (swarm/diffusion/diffusion_func.py:85-88).
+The TPU-native answer is to shard the sequence across chips and rotate KV
+blocks around the ICI ring with `lax.ppermute`, combining partial softmax
+results with the flash-attention running-max recurrence. Memory per chip is
+O(L/n); the KV rotation overlaps with the local attention compute (XLA
+schedules the ppermute DMA asynchronously).
+
+Use inside `shard_map` with q/k/v sharded on the sequence dimension:
+
+    mesh = build_mesh(MeshSpec({"seq": 8}))
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=P(None, "seq", None, None),
+        out_specs=P(None, "seq", None, None),
+    )(q, k, v)
+
+Layout is (B, L, H, D), matching chiaswarm_tpu.ops.attention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _partial_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       scale: float):
+    """Unnormalized attention over one KV block.
+
+    Returns (o, m, l): o = exp(logits - m) @ v, m = rowmax, l = rowsum,
+    shapes o:(B,L,H,D) fp32, m/l:(B,H,L) fp32.
+    """
+    logits = jnp.einsum("blhd,bshd->bhls", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    m = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhls,bshd->blhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Full (non-causal) attention with L and S sharded on ``axis_name``.
+
+    Each device holds a (B, L/n, H, D) query shard and a (B, S/n, H, D)
+    KV shard; after n ppermute rotations every query has attended to every
+    key. Non-causal because diffusion spatial/video attention is
+    bidirectional; a causal variant would skip post-self blocks.
+    """
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    b, lq, h, d = q.shape
+    # mark the zero-init carries as device-varying over the ring axis
+    # (shard_map's varying-axis type system requires carry in/out to agree)
+    if hasattr(jax.lax, "pcast"):
+        vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    else:  # older jax
+        vary = lambda x: jax.lax.pvary(x, axis_name)
+    o0 = vary(jnp.zeros((b, lq, h, d), jnp.float32))
+    m0 = vary(jnp.full((b, h, lq), _NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((b, h, lq), jnp.float32))
+
+    def body(carry, _):
+        k_blk, v_blk, o_acc, m_acc, l_acc = carry
+        o_i, m_i, l_i = _partial_attention(q, k_blk, v_blk, scale)
+        m_new = jnp.maximum(m_acc, m_i)
+        a_old = jnp.exp(m_acc - m_new)
+        a_new = jnp.exp(m_i - m_new)
+        # (B,H,L) -> (B,L,H,1) to scale the (B,L,H,D) partials
+        bcast = lambda x: x.transpose(0, 2, 1)[..., None]
+        o_acc = o_acc * bcast(a_old) + o_i * bcast(a_new)
+        l_acc = l_acc * a_old + l_i * a_new
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, o_acc, m_new, l_acc), None
+
+    (_, _, o, m, l), _ = jax.lax.scan(
+        body, (k, v, o0, m0, l0), None, length=n
+    )
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -1,0 +1,95 @@
+"""GSPMD tensor-parallel partition rules for the model zoo.
+
+The reference never shards a model — each job's weights live wholly on one
+GPU (`pipeline.to("cuda:N")`, swarm/diffusion/diffusion_func.py:46). For
+models larger than one chip's HBM (SDXL at high batch, cascades, video) the
+TPU-native answer is Megatron-style tensor parallelism expressed purely as
+*weight sharding annotations*: we lay out the attention/MLP projection
+matrices over the ``model`` mesh axis and let GSPMD insert the collectives
+(all-gather/reduce-scatter over ICI) during compilation.
+
+Column/row pattern per transformer block (so the pair needs only ONE
+all-reduce on the residual, not per-matmul gathers):
+
+- q/k/v projections, MLP up-projection: column-parallel — kernel
+  P(None, "model"), bias P("model"): each chip computes its head slice.
+- output projection, MLP down-projection: row-parallel — kernel
+  P("model", None), bias replicated; GSPMD emits the psum.
+
+Convolutions and norms stay replicated: for UNet resnet convs the win is
+small relative to the halo/collective cost, and batch ("data") parallelism
+covers them. This matches the scaling-book recipe: annotate the big
+matmuls, let the compiler place collectives, profile, iterate.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from chiaswarm_tpu.core.mesh import MODEL_AXIS
+
+# column-parallel producers (output dim sharded) and row-parallel consumers
+# (input dim sharded); names cover the UNet (to_q/.../ff), the CLIP towers
+# (q_proj/.../fc1/fc2) and the VAE mid-attention.
+_COLUMN = frozenset({"to_q", "to_k", "to_v", "q_proj", "k_proj", "v_proj",
+                     "fc1"})
+_ROW = frozenset({"to_out", "out_proj", "fc2"})
+_MLP_GLU_UP = "proj_in"     # GEGLU up-projection inside FeedForward ("ff")
+_MLP_DOWN = "proj_out"
+
+
+def _spec_for(path: tuple[str, ...], ndim: int) -> P:
+    if ndim == 0 or not path:
+        return P()
+    leaf = path[-1]
+    parent = path[-2] if len(path) >= 2 else ""
+    grandparent = path[-3] if len(path) >= 3 else ""
+    in_ff = parent == "ff" or grandparent == "ff"
+
+    column = parent in _COLUMN or (in_ff and parent == _MLP_GLU_UP)
+    row = parent in _ROW or (in_ff and parent == _MLP_DOWN)
+
+    if leaf == "kernel" and ndim == 2:
+        if column:
+            return P(None, MODEL_AXIS)
+        if row:
+            return P(MODEL_AXIS, None)
+    if leaf == "bias" and ndim == 1 and column:
+        return P(MODEL_AXIS)
+    return P()  # replicated: convs, norms, embeddings, time MLPs
+
+
+def param_partition_specs(params: Any) -> Any:
+    """PartitionSpec pytree matching ``params`` (Components.params or any
+    sub-tree)."""
+
+    def spec(path, leaf) -> P:
+        names = tuple(
+            k.key if hasattr(k, "key") else str(k) for k in path
+        )
+        return _spec_for(names, getattr(leaf, "ndim", 0))
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree for ``params`` on ``mesh``."""
+    specs = param_partition_specs(params)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place ``params`` onto ``mesh`` according to the partition rules.
+
+    With |model| = 1 every spec degenerates to replication, so single-chip
+    and multi-chip share one code path (same stance as
+    core/mesh.py:single_device_mesh).
+    """
+    shardings = param_shardings(params, mesh)
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -54,12 +54,15 @@ class Components:
         unet = UNet(family.unet)
         vae = AutoencoderKL(family.vae)
 
+        # jit every init: eager flax init dispatches thousands of tiny ops,
+        # which is pathologically slow from worker threads on remote-tunnel
+        # TPU platforms; one compiled program per module is thread-agnostic.
         params: dict[str, Any] = {}
         ids = jnp.zeros((1, family.text_encoders[0].max_position_embeddings),
                         jnp.int32)
         for i, te in enumerate(text_encoders):
             key, sub = jax.random.split(key)
-            params[f"text_encoder_{i}"] = te.init(sub, ids)
+            params[f"text_encoder_{i}"] = jax.jit(te.init)(sub, ids)
 
         latent = jnp.zeros(
             (1, 8, 8, family.unet.sample_channels), jnp.float32
@@ -75,9 +78,11 @@ class Components:
                 ),
             }
         key, sub = jax.random.split(key)
-        params["unet"] = unet.init(sub, latent, jnp.zeros((1,)), ctx, added)
+        params["unet"] = jax.jit(unet.init)(
+            sub, latent, jnp.zeros((1,)), ctx, added
+        )
         key, sub = jax.random.split(key)
-        params["vae"] = vae.init(
+        params["vae"] = jax.jit(vae.init)(
             sub, jnp.zeros((1, 16, 16, family.vae.in_channels), jnp.float32)
         )
         return cls(

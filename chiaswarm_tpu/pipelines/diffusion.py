@@ -77,12 +77,39 @@ def _to_float_image(img: np.ndarray) -> np.ndarray:
     return img.astype(np.float32)
 
 
+def _resize_batch(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """Host-side LANCZOS resize onto the bucketed grid (uint8 or float)."""
+    from PIL import Image
+
+    single = img.ndim == 3
+    frames = img[None] if single else img
+    as_u8 = frames.dtype == np.uint8
+    out = []
+    for frame in frames:
+        if not as_u8:
+            frame = ((frame + 1.0) * 127.5).clip(0, 255).astype(np.uint8)
+        resized = np.asarray(Image.fromarray(frame).resize(
+            (width, height), Image.LANCZOS))
+        out.append(resized if as_u8 else
+                   resized.astype(np.float32) / 127.5 - 1.0)
+    stacked = np.stack(out)
+    return stacked[0] if single else stacked
+
+
 class DiffusionPipeline:
     """Resident, compile-cached executor for one Components bundle."""
 
     def __init__(self, components: Components, attn_impl: str = "auto") -> None:
         self.c = components
-        self.attn_impl = attn_impl
+        if attn_impl != components.unet.config.attn_impl and attn_impl != "auto":
+            # modules are cheap static descriptions: rebuild the UNet with
+            # the forced attention dispatch (param tree is unchanged)
+            from chiaswarm_tpu.models.unet import UNet
+
+            components.unet = UNet(
+                dataclasses.replace(components.family.unet,
+                                    attn_impl=attn_impl)
+            )
         fam = components.family
         self.schedule_config = ScheduleConfig(
             beta_schedule=fam.beta_schedule,
@@ -104,15 +131,20 @@ class DiffusionPipeline:
     def _build_fn(self, *, batch: int, height: int, width: int, steps: int,
                   start_step: int, sampler: SamplerConfig, use_cfg: bool,
                   has_init: bool, has_mask: bool, tiled: bool):
-        c = self.c
-        fam = c.family
+        # capture only the static module descriptions — NOT the Components
+        # bundle, whose .params would otherwise stay pinned by the
+        # executable-cache closure after the param LRU evicts them
+        fam = self.c.family
+        text_encoders = tuple(self.c.text_encoders)
+        unet = self.c.unet
+        vae = self.c.vae
         lh, lw = self._latent_hw(height, width)
         sched = make_sampling_schedule(self.noise_schedule, steps, sampler)
         needs_xl = fam.unet.addition_embed_dim is not None
 
         def encode_text(params, ids_list):
             seqs, pooled = [], None
-            for i, te in enumerate(c.text_encoders):
+            for i, te in enumerate(text_encoders):
                 seq, pool = te.apply(params[f"text_encoder_{i}"], ids_list[i])
                 seqs.append(seq)
                 pooled = pool  # SDXL: pooled comes from the last encoder
@@ -154,16 +186,17 @@ class DiffusionPipeline:
                 if use_cfg:
                     inp2 = jnp.concatenate([inp, inp], axis=0)
                     t2 = sched.timesteps[i][None].repeat(2 * batch, axis=0)
-                    out = c.unet.apply(params["unet"], inp2, t2, ctx, added)
+                    out = unet.apply(params["unet"], inp2, t2, ctx, added)
                     eps_u, eps_c = jnp.split(out, 2, axis=0)
                     eps = eps_u + guidance * (eps_c - eps_u)
                 else:
                     t1 = sched.timesteps[i][None].repeat(batch, axis=0)
-                    eps = c.unet.apply(params["unet"], inp, t1, ctx, added)
+                    eps = unet.apply(params["unet"], inp, t1, ctx, added)
                 key, skey = jax.random.split(key)
                 step_noise = jax.random.normal(skey, x.shape, jnp.float32)
                 x, state = sampler_step(sampler, sched, i, x, eps, state,
-                                        noise=step_noise)
+                                        noise=step_noise,
+                                        start_index=start_step)
                 if has_mask:
                     # re-project known region onto the next noise level
                     key, mkey = jax.random.split(key)
@@ -180,10 +213,10 @@ class DiffusionPipeline:
             if tiled:
                 from chiaswarm_tpu.models.vae import tiled_decode
 
-                img = tiled_decode(c.vae, params["vae"], x)
+                img = tiled_decode(vae, params["vae"], x)
             else:
-                img = c.vae.apply(params["vae"], x,
-                                  method=AutoencoderKL.decode)
+                img = vae.apply(params["vae"], x,
+                                method=AutoencoderKL.decode)
             return jnp.clip(img, -1.0, 1.0)
 
         return jax.jit(fn)
@@ -202,23 +235,32 @@ class DiffusionPipeline:
 
     def encode_init_image(self, image: np.ndarray, height: int, width: int,
                           seed: int) -> jnp.ndarray:
-        """Host image -> scaled latents (the img2img/inpaint init)."""
+        """Host image(s) -> scaled latents (the img2img/inpaint init).
+
+        Accepts (H, W, 3) for one shared init or (B, H, W, 3) for per-item
+        inits (video frames riding the batch axis, workloads/video.py)."""
         img = _to_float_image(image)
-        if img.shape[:2] != (height, width):
+        if img.ndim == 3:
+            img = img[None]
+        if img.shape[1:3] != (height, width):
             raise ValueError(
-                f"init image {img.shape[:2]} != requested {(height, width)}; "
+                f"init image {img.shape[1:3]} != requested {(height, width)}; "
                 "resize on host first (node.job_args does this)"
             )
-        x = jnp.asarray(img)[None]
         return self.c.vae.apply(
-            self.c.params["vae"], x, key_for_seed(seed),
+            self.c.params["vae"], jnp.asarray(img), key_for_seed(seed),
             method=AutoencoderKL.encode,
         )
 
     def __call__(self, req: GenerateRequest) -> tuple[np.ndarray, dict]:
         """Run a request. Returns (images uint8 (B,H,W,3), config dict)."""
         fam = self.c.family
-        height, width = bucket_image_size(req.height, req.width)
+        height, width = bucket_image_size(
+            req.height, req.width,
+            # tiny hermetic families run at 64px; production families never
+            # compile below 256 (out-of-distribution for SD checkpoints)
+            min_size=min(256, fam.default_size),
+        )
         batch = bucket_batch(req.batch)
         steps = max(int(req.steps), 1)
         sampler = resolve(req.scheduler,
@@ -226,6 +268,8 @@ class DiffusionPipeline:
         use_cfg = req.guidance_scale > 1.0
         has_init = req.init_image is not None
         has_mask = req.mask is not None
+        if has_mask and not has_init:
+            raise ValueError("inpainting requires an init image with the mask")
 
         start_step = 0
         init_latent = jnp.zeros((1,), jnp.float32)  # placeholder
@@ -236,8 +280,18 @@ class DiffusionPipeline:
                 # img2img: skip the first (1-strength) of the ladder
                 start_step = min(int(round(steps * (1.0 - strength))),
                                  steps - 1)
-            z = self.encode_init_image(req.init_image, height, width, req.seed)
-            init_latent = jnp.repeat(z, batch, axis=0)
+            init = np.asarray(req.init_image)
+            if init.ndim == 4 and init.shape[1:3] != (height, width) or \
+               init.ndim == 3 and init.shape[:2] != (height, width):
+                init = _resize_batch(init, height, width)
+            z = self.encode_init_image(init, height, width, req.seed)
+            if z.shape[0] == 1:
+                init_latent = jnp.repeat(z, batch, axis=0)
+            elif z.shape[0] == batch:
+                init_latent = z
+            else:  # pad per-frame inits up to the bucketed batch
+                pad = jnp.repeat(z[-1:], batch - z.shape[0], axis=0)
+                init_latent = jnp.concatenate([z, pad], axis=0)
         if has_mask:
             lh, lw = self._latent_hw(height, width)
             m = np.asarray(req.mask, dtype=np.float32)
@@ -274,13 +328,19 @@ class DiffusionPipeline:
         )
         img = np.asarray(jax.device_get(img))
         img_u8 = ((img + 1.0) * 127.5).round().clip(0, 255).astype(np.uint8)
-        # un-bucket: crop/scale back to the exact requested size on host
+        # un-bucket: scale-to-cover + center-crop back to the exact request
+        # (plain resize would stretch when the bucket changed aspect ratio)
         if (height, width) != (req.height, req.width):
             from PIL import Image
 
+            scale = max(req.height / height, req.width / width)
+            rh, rw = (max(req.height, round(height * scale)),
+                      max(req.width, round(width * scale)))
+            y0, x0 = (rh - req.height) // 2, (rw - req.width) // 2
             img_u8 = np.stack([
                 np.asarray(Image.fromarray(frame).resize(
-                    (req.width, req.height), Image.LANCZOS))
+                    (rw, rh), Image.LANCZOS))[y0:y0 + req.height,
+                                              x0:x0 + req.width]
                 for frame in img_u8
             ])
         config = {

@@ -118,11 +118,14 @@ def sampler_step(
     model_output: jnp.ndarray,
     state: SamplerState,
     noise: jnp.ndarray | None = None,
+    start_index: int = 0,
 ) -> tuple[jnp.ndarray, SamplerState]:
     """One denoise step. ``i`` is the (traced) step index, 0..N-1.
 
     ``noise`` (same shape as sample) is consumed only by ancestral samplers;
-    deterministic samplers ignore it.
+    deterministic samplers ignore it. ``start_index`` is the first index the
+    loop actually executes (img2img starts partway down the ladder) — the
+    multistep history fallback keys off it, not off absolute 0.
     """
     sigma, sigma_next = _sigma_t(sched, i)
     compute = jnp.float32
@@ -155,9 +158,9 @@ def sampler_step(
         r = h_last / h
         old = state.old_denoised.astype(compute)
         denoised_d = (1.0 + 1.0 / (2.0 * r)) * denoised - (1.0 / (2.0 * r)) * old
-        # first step (no history) and final step (sigma_next==0) fall back to
-        # the first-order update — matches the multistep reference behavior.
-        first_or_last = jnp.logical_or(i == 0, sigma_next == 0.0)
+        # first executed step (no history) and final step (sigma_next==0)
+        # fall back to the first-order update.
+        first_or_last = jnp.logical_or(i == start_index, sigma_next == 0.0)
         use_d = jnp.where(first_or_last, denoised, denoised_d)
         x_next = (sigma_next / sigma) * x - jnp.expm1(-h) * use_d
     else:

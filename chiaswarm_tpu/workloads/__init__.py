@@ -1,0 +1,18 @@
+"""Workload callbacks — the uniform job-execution seam.
+
+Every workload is a plain function with the signature the dispatcher and
+chip pool agree on (the reference's load-bearing invariant,
+swarm/generator.py -> swarm/job_arguments.py -> swarm/gpu/device.py:26-47)::
+
+    callback(slot, model_name, *, seed, **kwargs) -> (artifacts, config)
+
+``slot`` is a core.chip_pool.MeshSlot (mesh + rng), artifacts is the
+envelope dict from node.output_processor, config is the reproducibility
+metadata posted to the hive (model, scheduler, seed, nsfw, timings).
+"""
+
+from chiaswarm_tpu.workloads.diffusion import diffusion_callback
+from chiaswarm_tpu.workloads.stitch import stitch_callback
+from chiaswarm_tpu.workloads.caption import caption_callback
+
+__all__ = ["diffusion_callback", "stitch_callback", "caption_callback"]

@@ -1,0 +1,54 @@
+"""Audio workloads: txt2audio (AudioLDM-class) and TTS (bark-class).
+
+Reference capabilities: swarm/audio/audioldm.py:12-36 (AudioLDM pipeline,
+wav 16 kHz -> mp3) and swarm/audio/bark.py:11-38 (suno-bark TTS). The Flax
+audio-latent-diffusion family is not in the model zoo yet; these callbacks
+declare the capability seam (dispatched from node/job_args.py) and fail
+fatally so the hive stops routing audio jobs to this node.
+
+When the models land: output is WAV via the stdlib ``wave`` module (this
+image has no ffmpeg, so mp3 transcode is gated off — content negotiation
+reports audio/wav).
+"""
+
+from __future__ import annotations
+
+import io
+import wave
+from typing import Any
+
+import numpy as np
+
+from chiaswarm_tpu.node.output_processor import make_result
+
+
+def pcm16_wav(samples: np.ndarray, sample_rate: int = 16000) -> bytes:
+    """float [-1,1] mono -> WAV bytes (the host-side encode path for when
+    the audio model family lands; unit-tested now)."""
+    pcm = (np.clip(samples, -1.0, 1.0) * 32767.0).astype("<i2")
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as wav:
+        wav.setnchannels(1)
+        wav.setsampwidth(2)
+        wav.setframerate(sample_rate)
+        wav.writeframes(pcm.tobytes())
+    return buf.getvalue()
+
+
+def audio_artifact(samples: np.ndarray, sample_rate: int = 16000) -> dict:
+    return make_result(pcm16_wav(samples, sample_rate), "audio/wav")
+
+
+def txt2audio_callback(slot, model_name: str, *, seed: int,
+                       **kwargs: Any):
+    raise ValueError(
+        f"txt2audio is not yet supported by this TPU worker "
+        f"(requested model {model_name!r})"
+    )
+
+
+def tts_callback(slot, model_name: str, *, seed: int, **kwargs: Any):
+    raise ValueError(
+        f"text-to-speech is not yet supported by this TPU worker "
+        f"(requested model {model_name!r})"
+    )

@@ -1,0 +1,71 @@
+"""Image captioning / VQA workload (img2txt).
+
+Capability parity with swarm/captioning/caption_image.py:6-40: the server
+names a processor + model class (BLIP-style) via job ``parameters``; a
+prompt makes it VQA, no prompt makes it unconditional captioning; output is
+a JSON text artifact. Errors are swallowed into an error artifact exactly
+like the reference (:35-40) — captioning failures should not poison a node.
+
+TPU path: transformers' Flax BLIP classes run under jit on the chip. The
+torch classes the hive may name are mapped to their Flax equivalents.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from chiaswarm_tpu.node.output_processor import make_text_result
+
+# hive-sent torch class names -> Flax equivalents
+_FLAX_CLASS = {
+    "BlipForConditionalGeneration": "FlaxBlipForConditionalGeneration",
+    "BlipForQuestionAnswering": "FlaxBlipForQuestionAnswering",
+}
+
+
+def caption_callback(slot, model_name: str, *, seed: int,
+                     image: np.ndarray | None = None,
+                     prompt: str = "",
+                     parameters: dict[str, Any] | None = None,
+                     **_ignored: Any):
+    config: dict[str, Any] = {"model_name": model_name}
+    try:
+        if image is None:
+            raise ValueError("img2txt requires start_image_uri")
+        parameters = parameters or {}
+        import transformers
+
+        processor_name = parameters.get("processor_type", "BlipProcessor")
+        model_cls_name = parameters.get(
+            "model_type", "BlipForConditionalGeneration"
+        )
+        model_cls_name = _FLAX_CLASS.get(model_cls_name, model_cls_name)
+        if not model_cls_name.startswith("Flax"):
+            model_cls_name = "Flax" + model_cls_name
+
+        processor = getattr(transformers, processor_name).from_pretrained(
+            model_name
+        )
+        model = getattr(transformers, model_cls_name).from_pretrained(
+            model_name, from_pt=True
+        )
+
+        from PIL import Image
+
+        pil = Image.fromarray(image) if isinstance(image, np.ndarray) else image
+        if prompt:
+            inputs = processor(pil, prompt, return_tensors="np")
+        else:
+            inputs = processor(pil, return_tensors="np")
+        out = model.generate(**inputs)
+        sequences = getattr(out, "sequences", out)
+        caption = processor.decode(
+            np.asarray(sequences)[0], skip_special_tokens=True
+        )
+        config["caption"] = caption
+        return {"primary": make_text_result(caption)}, config
+    except Exception as exc:  # error artifact, not a failed job (:35-40)
+        config["error"] = str(exc)
+        return {"primary": make_text_result(str(exc))}, config

@@ -1,0 +1,21 @@
+"""Cascaded pixel-space diffusion (DeepFloyd-IF-class models).
+
+Reference capability: swarm/diffusion/diffusion_func_if.py:14-92 — a
+three-stage cascade (64px base -> 256px super-res -> 1024px upscale) with
+prompt embeds shared across stages. The TPU design runs each stage as its
+own jitted program over the same mesh, with the text encoder (T5-class)
+evaluated once. The pixel-space UNet family is not in the model zoo yet;
+this callback declares the dispatch seam (node/job_args.py routes
+``DeepFloyd/`` model names here) and fails fatally until it lands.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def cascade_callback(slot, model_name: str, *, seed: int, **kwargs: Any):
+    raise ValueError(
+        f"cascaded pixel-space diffusion is not yet supported by this TPU "
+        f"worker (requested model {model_name!r})"
+    )

@@ -1,0 +1,100 @@
+"""ControlNet input preprocessors (host-side, CPU).
+
+Capability parity with swarm/controlnet/input_processor.py:17-272: the
+conditioning image is computed *before* generation from the user's input
+image, dispatched on ``controlnet["type"]``. These are CPU ops (OpenCV /
+PIL) by design — the reference keeps them off-GPU and we keep them off-TPU
+(SURVEY.md §2: "keep on CPU (host) — not TPU work").
+
+Implemented without auxiliary torch models (this image has no
+controlnet_aux): canny (cv2.Canny), tile (64-multiple resize), pix2pix
+(passthrough), scribble/softedge (Scharr-gradient sketch — a model-free
+stand-in for HED/PidiNet), shuffle (content shuffle), depth/normal/seg/
+mlsd/lineart/openpose raise until their Flax estimator models land.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+from PIL import Image
+
+_PREPROCESSORS: dict[str, Callable[[Image.Image], Image.Image]] = {}
+
+
+def _register(name: str):
+    def wrap(fn):
+        _PREPROCESSORS[name] = fn
+        return fn
+    return wrap
+
+
+@_register("canny")
+def image_to_canny(image: Image.Image) -> Image.Image:
+    import cv2
+
+    arr = np.asarray(image)
+    edges = cv2.Canny(arr, 100, 200)
+    return Image.fromarray(np.stack([edges] * 3, axis=-1))
+
+
+@_register("scribble")
+@_register("softedge")
+def image_to_soft_edges(image: Image.Image) -> Image.Image:
+    """Model-free soft-edge map: blurred Scharr gradient magnitude (stands
+    in for the reference's HED/PidiNet detectors, input_processor.py:17-60)."""
+    import cv2
+
+    gray = cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2GRAY)
+    gray = cv2.GaussianBlur(gray, (5, 5), 0)
+    gx = cv2.Scharr(gray, cv2.CV_32F, 1, 0)
+    gy = cv2.Scharr(gray, cv2.CV_32F, 0, 1)
+    mag = np.sqrt(gx ** 2 + gy ** 2)
+    mag = (255.0 * mag / max(float(mag.max()), 1e-6)).astype(np.uint8)
+    return Image.fromarray(np.stack([mag] * 3, axis=-1))
+
+
+@_register("tile")
+def image_to_tile(image: Image.Image) -> Image.Image:
+    """Round size down to a 64 multiple (input_processor.py:63-71)."""
+    w, h = image.size
+    w, h = max(64, w // 64 * 64), max(64, h // 64 * 64)
+    return image.resize((w, h), Image.Resampling.LANCZOS)
+
+
+@_register("pix2pix")
+def image_passthrough(image: Image.Image) -> Image.Image:
+    return image
+
+
+@_register("shuffle")
+def image_shuffle(image: Image.Image) -> Image.Image:
+    """Content shuffle: coarse spatial scramble of 32px blocks."""
+    rng = np.random.default_rng(0)
+    arr = np.asarray(image).copy()
+    h, w = arr.shape[:2]
+    bs = 32
+    blocks = [(y, x) for y in range(0, h - bs + 1, bs)
+              for x in range(0, w - bs + 1, bs)]
+    perm = rng.permutation(len(blocks))
+    out = arr.copy()
+    for (y, x), p in zip(blocks, perm):
+        sy, sx = blocks[p]
+        out[y:y + bs, x:x + bs] = arr[sy:sy + bs, sx:sx + bs]
+    return Image.fromarray(out)
+
+
+def preprocess_image(image: Image.Image, controlnet: dict[str, Any]) -> Image.Image:
+    """Dispatch on controlnet["type"] (input_processor.py:17-60). Types
+    requiring learned estimators raise until those models land."""
+    kind = str(controlnet.get("type", "canny")).lower()
+    if not controlnet.get("preprocess", True):
+        return image
+    fn = _PREPROCESSORS.get(kind)
+    if fn is None:
+        raise ValueError(
+            f"controlnet preprocessor {kind!r} is not yet supported on "
+            f"this TPU worker (available: {sorted(_PREPROCESSORS)})"
+        )
+    return fn(image)

@@ -1,0 +1,72 @@
+"""Stitch workload: collage prior job results into one image + HTML map.
+
+Capability parity with swarm/toolbox/stitch.py:10-110 (no accelerator use):
+download each job's result image, thumbnail to 144px with a 1-based index
+label, paste onto a square grid, and return image-map metadata so the hive
+UI can hyperlink each cell back to its source job.
+"""
+
+from __future__ import annotations
+
+import io
+import math
+from typing import Any
+
+from PIL import Image, ImageDraw
+
+from chiaswarm_tpu.node.output_processor import (
+    OutputProcessor,
+    make_result,
+    encode_image,
+    thumbnail,
+)
+
+THUMB = 144
+
+
+def _fetch_image(url: str) -> Image.Image:
+    import requests
+
+    response = requests.get(url, timeout=30)
+    response.raise_for_status()
+    return Image.open(io.BytesIO(response.content)).convert("RGB")
+
+
+def _thumb_with_label(image: Image.Image, index: int) -> Image.Image:
+    img = image.copy()
+    img.thumbnail((THUMB, THUMB), Image.Resampling.LANCZOS)
+    draw = ImageDraw.Draw(img)
+    draw.text((10, 10), str(index + 1), fill=(255, 255, 255))
+    return img
+
+
+def stitch_callback(slot, model_name: str, *, seed: int,
+                    jobs: list[dict] | None = None,
+                    images: list[Image.Image] | None = None,
+                    **_ignored: Any):
+    """``jobs`` carry ``resultUri`` links (hive schema); ``images`` allows
+    direct injection for tests."""
+    jobs = jobs or []
+    if images is None:
+        images = [_fetch_image(job["resultUri"]) for job in jobs]
+    thumbs = [_thumb_with_label(img, i) for i, img in enumerate(images)]
+
+    per_row = max(1, math.ceil(math.sqrt(len(thumbs))))
+    canvas = Image.new("RGB", (per_row * THUMB, per_row * THUMB))
+    image_map: list[dict[str, Any]] = []
+    for i, img in enumerate(thumbs):
+        x, y = (i % per_row) * THUMB, (i // per_row) * THUMB
+        canvas.paste(img, (x, y))
+        job = jobs[i] if i < len(jobs) else {}
+        href = job.get("resultUri", "")
+        image_map.append({
+            "shape": "rect",
+            "coords": f"{x},{y},{x + THUMB},{y + THUMB}",
+            "href": href,
+            "alt": job.get("model_name", f"Image {i + 1}"),
+            "filename": job.get("fileName", href),
+        })
+
+    blob = encode_image(canvas, "image/jpeg")
+    artifacts = {"primary": make_result(blob, "image/jpeg", thumbnail(canvas))}
+    return artifacts, {"model_name": model_name, "image_map": image_map}

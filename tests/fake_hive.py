@@ -1,0 +1,74 @@
+"""FakeHive: an in-process hive server for hermetic worker tests.
+
+Serves the three endpoints of the hive protocol (swarm/worker.py:66-78,
+150-158; swarm/initialize.py:101-107) plus static test assets (input
+images), so the whole poll -> execute -> upload loop runs with zero
+network. This is the testability gap SURVEY.md §4 commits to fixing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+from typing import Any
+
+from aiohttp import web
+
+
+class FakeHive:
+    def __init__(self) -> None:
+        self.jobs: list[dict[str, Any]] = []
+        self.results: list[dict[str, Any]] = []
+        self.models: list[dict[str, Any]] = []
+        self.result_event = asyncio.Event()
+        self._app = web.Application(client_max_size=256 * 1024 * 1024)
+        self._app.router.add_get("/api/work", self._work)
+        self._app.router.add_post("/api/results", self._results)
+        self._app.router.add_get("/api/models", self._models)
+        self._app.router.add_route("*", "/assets/image.png", self._image)
+        self._runner: web.AppRunner | None = None
+        self.uri = ""
+
+    # ---- endpoints ----
+
+    async def _work(self, request: web.Request) -> web.Response:
+        jobs, self.jobs = self.jobs, []
+        return web.json_response({"jobs": jobs})
+
+    async def _results(self, request: web.Request) -> web.Response:
+        self.results.append(await request.json())
+        self.result_event.set()
+        return web.json_response({"status": "ok"})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response({"models": self.models})
+
+    async def _image(self, request: web.Request) -> web.Response:
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.new("RGB", (96, 96), (200, 120, 40)).save(buf, format="PNG")
+        return web.Response(body=buf.getvalue(), content_type="image/png")
+
+    # ---- lifecycle ----
+
+    async def start(self) -> str:
+        self._runner = web.AppRunner(self._app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.uri = f"http://127.0.0.1:{port}"
+        return self.uri
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def wait_for_results(self, n: int, timeout: float = 120.0) -> None:
+        async def _wait():
+            while len(self.results) < n:
+                self.result_event.clear()
+                await self.result_event.wait()
+
+        await asyncio.wait_for(_wait(), timeout)

@@ -1,0 +1,245 @@
+"""Node-layer tests: artifact envelope, dispatcher, executor error taxonomy,
+and the full worker loop against the in-process FakeHive — all hermetic on
+the 8-device CPU platform (SURVEY.md §4)."""
+
+import asyncio
+import base64
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu import WORKER_VERSION
+from chiaswarm_tpu.core.chip_pool import ChipPool
+from chiaswarm_tpu.node.executor import synchronous_do_work
+from chiaswarm_tpu.node.job_args import format_args
+from chiaswarm_tpu.node.output_processor import (
+    OutputProcessor,
+    image_grid,
+    make_text_result,
+)
+from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.node.settings import Settings
+from chiaswarm_tpu.node.worker import Worker
+from chiaswarm_tpu.workloads.audio import pcm16_wav
+from chiaswarm_tpu.workloads.stitch import stitch_callback
+
+from tests.fake_hive import FakeHive
+
+
+@pytest.fixture()
+def registry():
+    return ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True,
+    )
+
+
+@pytest.fixture()
+def pool():
+    return ChipPool(n_slots=1)
+
+
+# ---------- output processor ----------
+
+def test_artifact_envelope_roundtrip():
+    proc = OutputProcessor("image/png")
+    imgs = np.zeros((2, 32, 32, 3), np.uint8)
+    imgs[0, :, :, 0] = 255
+    proc.add_images(imgs)
+    results = proc.get_results()
+    primary = results["primary"]
+    blob = base64.b64decode(primary["blob"])
+    assert primary["content_type"] == "image/png"
+    assert primary["sha256_hash"] == hashlib.sha256(blob).hexdigest()
+    assert len(base64.b64decode(primary["thumbnail"])) > 0
+
+
+def test_text_result_wire_shape():
+    result = make_text_result("a red fox")
+    payload = json.loads(base64.b64decode(result["blob"]))
+    assert payload == {"caption": "a red fox"}
+    assert result["content_type"] == "application/json"
+
+
+def test_image_grid_layouts():
+    from PIL import Image
+
+    imgs = [Image.new("RGB", (16, 16)) for _ in range(4)]
+    assert image_grid(imgs).size == (32, 32)      # 2x2
+    assert image_grid(imgs[:2]).size == (32, 16)  # 1x2
+    assert image_grid(imgs[:1]).size == (16, 16)
+
+
+def test_wav_encode():
+    samples = np.sin(np.linspace(0, 440 * 2 * np.pi, 16000)).astype(np.float32)
+    wav = pcm16_wav(samples, 16000)
+    assert wav[:4] == b"RIFF" and wav[8:12] == b"WAVE"
+
+
+# ---------- dispatcher ----------
+
+def test_format_rejects_oversize(registry):
+    with pytest.raises(ValueError, match="max image size"):
+        format_args({"model_name": "tiny", "height": 4096, "width": 4096,
+                     "prompt": "x"}, registry)
+
+
+def test_format_defaults_steps(registry):
+    cb, kwargs = format_args({"model_name": "tiny", "prompt": "x"}, registry)
+    assert kwargs["num_inference_steps"] == 30
+    assert cb.__name__ == "diffusion_callback"
+
+
+def test_format_strips_unsupported(registry):
+    _, kwargs = format_args({
+        "model_name": "tiny", "prompt": "x", "negative_prompt": "y",
+        "parameters": {"unsupported_pipeline_arguments": ["negative_prompt"]},
+    }, registry)
+    assert "negative_prompt" not in kwargs
+
+
+def test_format_routes_workflows(registry):
+    cb, _ = format_args({"workflow": "stitch", "model_name": "x",
+                         "jobs": []}, registry)
+    assert cb.__name__ == "stitch_callback"
+    cb, _ = format_args({"workflow": "txt2vid", "model_name": "x"}, registry)
+    assert cb.__name__ == "txt2vid_callback"
+    cb, _ = format_args({"model_name": "DeepFloyd/IF-I-XL-v1.0",
+                         "prompt": "x"}, registry)
+    assert cb.__name__ == "cascade_callback"
+
+
+# ---------- executor error taxonomy ----------
+
+def test_executor_runs_txt2img(registry, pool):
+    job = {"id": "job-1", "model_name": "tiny", "prompt": "a fish",
+           "num_inference_steps": 2, "height": 64, "width": 64,
+           "content_type": "image/png"}
+    result = synchronous_do_work(job, pool.slots[0], registry)
+    assert result["id"] == "job-1"
+    assert result["worker_version"] == WORKER_VERSION
+    assert "fatal_error" not in result
+    assert result["pipeline_config"]["seed"] >= 0
+    assert "primary" in result["artifacts"]
+
+
+def test_executor_format_error_is_fatal(registry, pool):
+    job = {"id": "job-2", "model_name": "tiny", "height": 9999,
+           "width": 9999, "prompt": "x"}
+    result = synchronous_do_work(job, pool.slots[0], registry)
+    assert result["fatal_error"] is True
+    assert "error" in result["pipeline_config"]
+    assert "primary" in result["artifacts"]  # error rendered as artifact
+
+
+def test_executor_unavailable_model_is_fatal(pool):
+    registry = ModelRegistry(catalog=[], allow_random=False)
+    job = {"id": "job-3", "model_name": "some/unknown-model", "prompt": "x",
+           "num_inference_steps": 1}
+    result = synchronous_do_work(job, pool.slots[0], registry)
+    assert result["fatal_error"] is True
+
+
+def test_executor_stub_workflow_is_fatal(registry, pool):
+    job = {"id": "job-4", "workflow": "txt2audio", "model_name": "cvssp/audioldm",
+           "prompt": "rain", "content_type": "audio/wav"}
+    result = synchronous_do_work(job, pool.slots[0], registry)
+    assert result["fatal_error"] is True
+    payload = json.loads(
+        base64.b64decode(result["artifacts"]["primary"]["blob"]))
+    assert "not yet supported" in payload["caption"]
+
+
+# ---------- workloads ----------
+
+def test_stitch_with_injected_images():
+    from PIL import Image
+
+    images = [Image.new("RGB", (64, 64), (i * 40, 10, 10)) for i in range(3)]
+    artifacts, config = stitch_callback(
+        None, "stitch", seed=0,
+        jobs=[{"resultUri": f"http://x/{i}"} for i in range(3)],
+        images=images,
+    )
+    assert "primary" in artifacts
+    assert len(config["image_map"]) == 3
+    assert config["image_map"][0]["shape"] == "rect"
+
+
+def test_vid2vid_frame_batched(registry):
+    from chiaswarm_tpu.workloads.video import vid2vid_callback
+
+    pool = ChipPool(n_slots=1)
+    frames = [np.full((64, 64, 3), 30 * i, np.uint8) for i in range(3)]
+    artifacts, config = vid2vid_callback(
+        pool.slots[0], "tiny", seed=5, registry=registry,
+        frames=frames, fps=8.0, num_inference_steps=2, strength=0.5,
+        prompt="watercolor", content_type="video/mp4",
+    )
+    assert config["frames"] == 3
+    assert config["compute_cost"] == 512 * 512 * 2 * 3
+    assert "primary" in artifacts and "thumbnail" in artifacts
+
+
+# ---------- full worker loop against FakeHive ----------
+
+def test_worker_end_to_end(registry):
+    async def scenario():
+        hive = FakeHive()
+        uri = await hive.start()
+        hive.jobs.append({
+            "id": "e2e-1", "model_name": "tiny", "prompt": "a house",
+            "num_inference_steps": 2, "height": 64, "width": 64,
+            "content_type": "image/png",
+        })
+        settings = Settings(hive_uri=uri, hive_token="t", worker_name="test")
+        worker = Worker(settings=settings, pool=ChipPool(n_slots=1),
+                        registry=registry)
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(1, timeout=120)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=10)
+            await hive.stop()
+
+        assert len(hive.results) == 1
+        result = hive.results[0]
+        assert result["id"] == "e2e-1"
+        assert "primary" in result["artifacts"]
+        assert result["pipeline_config"]["model_name"] == "tiny"
+        assert worker.jobs_done == 1
+
+    asyncio.run(scenario())
+
+
+def test_worker_input_image_fetch(registry):
+    """img2img through the worker: input image served by the FakeHive."""
+
+    async def scenario():
+        hive = FakeHive()
+        uri = await hive.start()
+        hive.jobs.append({
+            "id": "e2e-2", "model_name": "tiny", "prompt": "blue",
+            "num_inference_steps": 2, "strength": 0.6,
+            "start_image_uri": f"{uri}/assets/image.png",
+            "content_type": "image/png",
+        })
+        settings = Settings(hive_uri=uri, hive_token="t", worker_name="test")
+        worker = Worker(settings=settings, pool=ChipPool(n_slots=1),
+                        registry=registry)
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(1, timeout=180)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=10)
+            await hive.stop()
+
+        result = hive.results[0]
+        assert "fatal_error" not in result, result["pipeline_config"]
+        assert result["pipeline_config"]["mode"] == "img2img"
+
+    asyncio.run(scenario())

@@ -1,0 +1,54 @@
+"""Kernel tests: Pallas flash attention (interpret mode on CPU) vs the
+einsum reference — the golden-value strategy SURVEY.md §4 calls for."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.ops.attention import _xla_attention, attention
+from chiaswarm_tpu.ops.flash_attention import flash_attention
+
+
+@pytest.mark.parametrize(
+    "b,l,s,h,d",
+    [
+        (2, 64, 64, 4, 40),    # SD1.5-style self-attention head_dim 40
+        (1, 100, 77, 2, 64),   # cross-attention: text KV of 77 tokens
+        (1, 300, 300, 2, 80),  # non-multiple-of-block lengths
+        (2, 128, 128, 1, 128), # exact lane-width head dim
+    ],
+)
+def test_flash_matches_einsum(b, l, s, h, d):
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, l, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+    scale = d ** -0.5
+    ref = _xla_attention(q, k, v, scale)
+    got = flash_attention(q, k, v, block_q=64, block_kv=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_bf16_io():
+    kq, kk = jax.random.split(jax.random.PRNGKey(1))
+    q = jax.random.normal(kq, (1, 96, 2, 32), jnp.bfloat16)
+    kvv = jax.random.normal(kk, (1, 96, 2, 32), jnp.bfloat16)
+    out = flash_attention(q, kvv, kvv, block_q=32, block_kv=32,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = _xla_attention(q.astype(jnp.float32), kvv.astype(jnp.float32),
+                         kvv.astype(jnp.float32), 32 ** -0.5)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_attention_dispatch_explicit_flash():
+    """impl="flash" forces the Pallas kernel even on CPU (interpret)."""
+    q = jax.random.normal(jax.random.PRNGKey(2), (1, 64, 2, 16))
+    out_flash = attention(q, q, q, impl="flash")
+    out_xla = attention(q, q, q, impl="xla")
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_xla),
+                               rtol=2e-4, atol=2e-4)

@@ -73,7 +73,9 @@ def test_img2img_preserves_layout(tiny_pipeline):
         strength=0.05, guidance_scale=1.0))
     d_low = np.abs(low.astype(int) - init.astype(int)).mean()
     d_high = np.abs(high.astype(int) - init.astype(int)).mean()
+    d_rt = np.abs(roundtrip.astype(int) - init.astype(int)).mean()
     assert d_low < d_high
+    assert d_rt <= d_low  # strength 0.05 ~ VAE roundtrip of the init
 
 
 def test_inpaint_keeps_known_region(tiny_pipeline):

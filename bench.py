@@ -1,82 +1,7 @@
-"""Benchmark: SDXL-class 1024px txt2img throughput (images/sec/chip).
+"""Driver benchmark entry: prints ONE JSON line with the north-star metric
+(see chiaswarm_tpu/benchmark.py for the implementation and knobs)."""
 
-Measures the BASELINE.json north-star config — SDXL 1024x1024 txt2img,
-30 steps, classifier-free guidance — end to end through the jitted
-pipeline (text encode -> scan denoise -> VAE decode) on the default
-backend. Random weights (identical FLOPs/memory traffic to converted
-checkpoints). On non-TPU hosts it falls back to the tiny hermetic family
-so the script stays runnable anywhere.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-`vs_baseline` is vs the driver-set target of 4 images/sec/chip
-(BASELINE.json "north_star"; the reference itself publishes no numbers —
-BASELINE.md).
-"""
-
-from __future__ import annotations
-
-import json
-import os
-import time
-
-
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    from chiaswarm_tpu.pipelines.components import Components
-    from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline, GenerateRequest
-
-    on_tpu = jax.default_backend() == "tpu"
-    family = os.environ.get(
-        "CHIASWARM_BENCH_FAMILY", "sdxl" if on_tpu else "tiny"
-    )
-    size = int(os.environ.get("CHIASWARM_BENCH_SIZE",
-                              "1024" if on_tpu else "64"))
-    steps = int(os.environ.get("CHIASWARM_BENCH_STEPS",
-                               "30" if on_tpu else "4"))
-    batch = int(os.environ.get("CHIASWARM_BENCH_BATCH", "1"))
-    iters = int(os.environ.get("CHIASWARM_BENCH_ITERS", "3"))
-
-    c = Components.random(family, seed=0)
-    if on_tpu:
-        # store weights in bf16: ~half the HBM, and the UNet/VAE compute in
-        # bf16 anyway (models/configs.py dtype)
-        c.params = jax.tree.map(
-            lambda x: x.astype(jnp.bfloat16)
-            if x.dtype == jnp.float32 else x,
-            c.params,
-        )
-        c.params = jax.device_put(c.params, jax.devices()[0])
-    pipe = DiffusionPipeline(c)
-
-    def run(seed: int) -> float:
-        req = GenerateRequest(
-            prompt="a photograph of an astronaut riding a horse",
-            negative_prompt="blurry", steps=steps, guidance_scale=7.5,
-            height=size, width=size, batch=batch, seed=seed,
-        )
-        t0 = time.perf_counter()
-        imgs, _ = pipe(req)
-        assert imgs.shape[0] == batch
-        return time.perf_counter() - t0
-
-    run(0)  # compile + warm
-    times = [run(i + 1) for i in range(iters)]
-    p50 = sorted(times)[len(times) // 2]
-    imgs_per_sec = batch / p50
-
-    target = 4.0  # images/sec/chip, BASELINE.json north star
-    print(json.dumps({
-        "metric": f"{family} {size}px txt2img {steps} steps, images/sec/chip",
-        "value": round(imgs_per_sec, 4),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(imgs_per_sec / target, 4),
-        "p50_latency_s": round(p50, 3),
-        "batch": batch,
-        "backend": jax.default_backend(),
-    }))
-
+from chiaswarm_tpu.benchmark import main
 
 if __name__ == "__main__":
     main()

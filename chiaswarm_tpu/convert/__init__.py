@@ -1,0 +1,26 @@
+"""Checkpoint conversion: HF-diffusers torch layouts -> Flax param trees.
+
+Replaces the role of the reference's per-job ``from_pretrained`` weight
+loading (swarm/diffusion/diffusion_func.py:41-46) and the initialize-time
+warm cache (swarm/initialize.py:62-94): checkpoints convert ONCE into the
+framework's native layout (NHWC convs, (in, out) dense kernels) and stay
+resident on device.
+"""
+
+from chiaswarm_tpu.convert.torch_to_flax import (
+    convert_text_encoder,
+    convert_unet,
+    convert_vae,
+    load_checkpoint,
+    read_torch_weights,
+)
+from chiaswarm_tpu.convert.lora import merge_lora
+
+__all__ = [
+    "convert_text_encoder",
+    "convert_unet",
+    "convert_vae",
+    "load_checkpoint",
+    "read_torch_weights",
+    "merge_lora",
+]

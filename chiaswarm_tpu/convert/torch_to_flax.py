@@ -1,0 +1,335 @@
+"""HF-diffusers/transformers torch checkpoints -> this framework's Flax trees.
+
+Covers the three module classes of the SD families (models/configs.py):
+
+- UNet:          diffusers ``UNet2DConditionModel`` state dicts
+- VAE:           diffusers ``AutoencoderKL`` state dicts (old ``query``/
+                 ``proj_attn`` and new ``to_q``/``to_out.0`` attention names)
+- Text encoder:  transformers ``CLIPTextModel(WithProjection)``
+
+Layout transforms (torch -> flax):
+- conv weight (O, I, kH, kW) -> kernel (kH, kW, I, O)
+- linear weight (O, I)       -> kernel (I, O)
+- norm weight/bias           -> scale/bias
+- embedding weight           -> embedding
+
+Directory layout is the HF pipeline snapshot the reference's initializer
+fills (swarm/initialize.py:73-89): ``unet/``, ``vae/``, ``text_encoder/``
+(+ ``text_encoder_2/`` for SDXL), each holding ``*.safetensors`` or
+``*.bin``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from chiaswarm_tpu.models.configs import ModelFamily, UNetConfig, VAEConfig
+
+log = logging.getLogger("chiaswarm.convert")
+
+
+# ---------------------------------------------------------------- reading
+
+def read_torch_weights(path: str | Path) -> dict[str, np.ndarray]:
+    """Read every tensor under ``path`` (a module subdir or a single file)."""
+    path = Path(path)
+    files: list[Path] = []
+    if path.is_file():
+        files = [path]
+    else:
+        for pattern in ("*.safetensors", "*.bin", "*.pt", "*.ckpt"):
+            files.extend(sorted(path.glob(pattern)))
+    if not files:
+        raise FileNotFoundError(f"no weight files under {path}")
+
+    state: dict[str, np.ndarray] = {}
+    for file in files:
+        if file.suffix == ".safetensors":
+            from safetensors import safe_open
+
+            with safe_open(str(file), framework="np") as fh:
+                for key in fh.keys():
+                    state[key] = _to_numpy(fh.get_tensor(key))
+        else:
+            import torch
+
+            raw = torch.load(str(file), map_location="cpu",
+                             weights_only=True)
+            if isinstance(raw, dict) and "state_dict" in raw:
+                raw = raw["state_dict"]
+            for key, value in raw.items():
+                state[key] = _to_numpy(value)
+    return state
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        arr = t
+    else:  # torch tensor
+        arr = t.detach().to("cpu").float().numpy()
+    if arr.dtype not in (np.float32, np.float64, np.int32, np.int64):
+        arr = arr.astype(np.float32)
+    return np.asarray(arr, dtype=np.float32 if arr.dtype.kind == "f" else arr.dtype)
+
+
+# ------------------------------------------------------------- tree utils
+
+def _nest(flat: Mapping[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for path, value in flat.items():
+        node = tree
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return {"params": tree}
+
+
+_NORM_HINTS = ("norm", "layer_norm", "group_norm")
+
+
+def _place(flat: dict[str, np.ndarray], flax_path: str, name: str,
+           value: np.ndarray) -> None:
+    """Append one torch leaf under ``flax_path`` with layout transform."""
+    if name == "weight":
+        if value.ndim == 4:    # conv OIHW -> HWIO
+            flat[f"{flax_path}/kernel"] = value.transpose(2, 3, 1, 0)
+        elif value.ndim == 2:  # linear (O,I) -> (I,O)
+            flat[f"{flax_path}/kernel"] = value.T
+        else:                  # norm gamma
+            flat[f"{flax_path}/scale"] = value
+    elif name == "bias":
+        flat[f"{flax_path}/bias"] = value
+    else:
+        flat[f"{flax_path}/{name}"] = value
+
+
+# ----------------------------------------------------------------- UNet
+
+def convert_unet(state: Mapping[str, np.ndarray],
+                 config: UNetConfig) -> dict:
+    n_levels = len(config.block_out_channels)
+    flat: dict[str, np.ndarray] = {}
+    skipped: list[str] = []
+
+    for key, value in state.items():
+        parts = key.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        path = _unet_path(body, n_levels)
+        if path is None:
+            skipped.append(key)
+            continue
+        _place(flat, path, name, value)
+
+    if skipped:
+        log.info("unet conversion skipped %d non-module keys (e.g. %s)",
+                 len(skipped), skipped[0])
+    return _nest(flat)
+
+
+def _attention_inner(rest: list[str]) -> str | None:
+    """Names inside a SpatialTransformer (diffusers Transformer2DModel)."""
+    if not rest:
+        return None
+    head = rest[0]
+    if head in ("norm", "proj_in", "proj_out"):
+        return head
+    if head == "transformer_blocks":
+        i, inner = rest[1], rest[2:]
+        if not inner:
+            return None
+        sub = inner[0]
+        if sub in ("norm1", "norm2", "norm3"):
+            return f"transformer_blocks_{i}/{sub}"
+        if sub in ("attn1", "attn2"):
+            proj = inner[1]
+            if proj == "to_out":  # HF: to_out.0 (ModuleList w/ dropout)
+                return f"transformer_blocks_{i}/{sub}/to_out"
+            if proj in ("to_q", "to_k", "to_v"):
+                return f"transformer_blocks_{i}/{sub}/{proj}"
+            return None
+        if sub == "ff":  # ff.net.0.proj (GEGLU up) / ff.net.2 (down)
+            if inner[1] == "net" and inner[2] == "0" and inner[3] == "proj":
+                return f"transformer_blocks_{i}/ff/proj_in"
+            if inner[1] == "net" and inner[2] == "2":
+                return f"transformer_blocks_{i}/ff/proj_out"
+            return None
+    return None
+
+
+_RESNET_LEAVES = {"norm1", "conv1", "time_emb_proj", "norm2", "conv2",
+                  "conv_shortcut"}
+
+
+def _unet_path(body: list[str], n_levels: int) -> str | None:
+    joined = ".".join(body)
+    # top-level singletons
+    if joined in ("conv_in", "conv_norm_out", "conv_out"):
+        return joined
+    if body[0] in ("time_embedding", "add_embedding") and \
+            body[1] in ("linear_1", "linear_2"):
+        return f"{body[0]}/{body[1]}"
+
+    if body[0] in ("down_blocks", "up_blocks"):
+        level = int(body[1])
+        if body[0] == "up_blocks":
+            level = n_levels - 1 - level  # HF counts top-down; we bottom-up
+        kind = body[2]
+        if kind == "resnets" and body[4] in _RESNET_LEAVES:
+            return f"{body[0][:-7]}_{level}_resnets_{body[3]}/{body[4]}"
+        if kind == "attentions":
+            inner = _attention_inner(body[4:])
+            if inner is not None:
+                prefix = "down" if body[0] == "down_blocks" else "up"
+                return f"{prefix}_{level}_attentions_{body[3]}/{inner}"
+        if kind == "downsamplers" and body[4] == "conv":
+            return f"down_{level}_downsample/conv"
+        if kind == "upsamplers" and body[4] == "conv":
+            return f"up_{level}_upsample/conv"
+        return None
+
+    if body[0] == "mid_block":
+        if body[1] == "resnets" and body[3] in _RESNET_LEAVES:
+            return f"mid_resnets_{body[2]}/{body[3]}"
+        if body[1] == "attentions" and body[2] == "0":
+            inner = _attention_inner(body[3:])
+            if inner is not None:
+                return f"mid_attention/{inner}"
+    return None
+
+
+# ------------------------------------------------------------------ VAE
+
+# old diffusers VAE attention names -> canonical
+_VAE_ATTN_ALIASES = {"query": "to_q", "key": "to_k", "value": "to_v",
+                     "proj_attn": "to_out"}
+
+
+def convert_vae(state: Mapping[str, np.ndarray], config: VAEConfig) -> dict:
+    n_levels = len(config.block_out_channels)
+    flat: dict[str, np.ndarray] = {}
+
+    for key, value in state.items():
+        parts = key.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        path = _vae_path(body, n_levels)
+        if path is None:
+            log.debug("vae conversion skipped %s", key)
+            continue
+        # old-layout attention projections are stored (O, I, 1, 1)
+        if value.ndim == 4 and value.shape[2:] == (1, 1) and \
+                any(p in path for p in ("to_q", "to_k", "to_v", "to_out")):
+            value = value[:, :, 0, 0]
+        _place(flat, path, name, value)
+    return _nest(flat)
+
+
+def _vae_path(body: list[str], n_levels: int) -> str | None:
+    if body[0] == "quant_conv":
+        return "encoder/quant_conv"
+    if body[0] == "post_quant_conv":
+        return "decoder/post_quant_conv"
+    if body[0] not in ("encoder", "decoder"):
+        return None
+    side = body[0]
+    rest = body[1:]
+    joined = ".".join(rest)
+    if joined in ("conv_in", "conv_norm_out", "conv_out"):
+        return f"{side}/{rest[0]}"
+    if rest[0] in ("down_blocks", "up_blocks"):
+        level = int(rest[1])
+        if rest[0] == "up_blocks":
+            level = n_levels - 1 - level
+        if rest[2] == "resnets" and rest[4] in _RESNET_LEAVES:
+            prefix = "down" if rest[0] == "down_blocks" else "up"
+            return f"{side}/{prefix}_{level}_resnets_{rest[3]}/{rest[4]}"
+        if rest[2] == "downsamplers" and rest[4] == "conv":
+            return f"{side}/down_{level}_downsample"
+        if rest[2] == "upsamplers" and rest[4] == "conv":
+            return f"{side}/up_{level}_upsample"
+        return None
+    if rest[0] == "mid_block":
+        if rest[1] == "resnets" and rest[3] in _RESNET_LEAVES:
+            return f"{side}/mid/resnets_{rest[2]}/{rest[3]}"
+        if rest[1] == "attentions" and rest[2] == "0":
+            leaf = _VAE_ATTN_ALIASES.get(rest[3], rest[3])
+            if leaf == "to_out" and len(rest) > 4:  # to_out.0
+                pass
+            if leaf in ("to_q", "to_k", "to_v", "to_out", "group_norm"):
+                return f"{side}/mid/attentions_0/{leaf}"
+    return None
+
+
+# ---------------------------------------------------------- text encoder
+
+def convert_text_encoder(state: Mapping[str, np.ndarray]) -> dict:
+    flat: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        k = key
+        if k.startswith("text_model."):
+            k = k[len("text_model."):]
+        parts = k.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+
+        if body[:2] == ["embeddings", "token_embedding"]:
+            flat["token_embedding/embedding"] = value
+        elif body[:2] == ["embeddings", "position_embedding"]:
+            flat["position_embedding/embedding"] = value
+        elif body[:2] == ["encoder", "layers"]:
+            i = body[2]
+            sub = body[3]
+            if sub == "self_attn":
+                flat_key = f"layers_{i}/self_attn/{body[4]}"
+            elif sub in ("layer_norm1", "layer_norm2"):
+                flat_key = f"layers_{i}/{sub}"
+            elif sub == "mlp":
+                flat_key = f"layers_{i}/{body[4]}"
+            else:
+                continue
+            _place(flat, flat_key, name, value)
+            continue
+        elif body == ["final_layer_norm"]:
+            _place(flat, "final_layer_norm", name, value)
+        elif body == ["text_projection"]:
+            _place(flat, "text_projection", name, value)
+        else:
+            log.debug("text encoder conversion skipped %s", key)
+    return _nest(flat)
+
+
+# ------------------------------------------------------------- top level
+
+_SUBDIR_CANDIDATES = {
+    "unet": ("unet",),
+    "vae": ("vae",),
+    "text_encoder_0": ("text_encoder",),
+    "text_encoder_1": ("text_encoder_2",),
+}
+
+
+def load_checkpoint(checkpoint_dir: str | Path,
+                    family: ModelFamily) -> dict[str, Any]:
+    """HF pipeline snapshot dir -> Components.params tree (float32 host)."""
+    checkpoint_dir = Path(checkpoint_dir)
+    params: dict[str, Any] = {}
+
+    params["unet"] = convert_unet(
+        read_torch_weights(checkpoint_dir / "unet"), family.unet
+    )
+    params["vae"] = convert_vae(
+        read_torch_weights(checkpoint_dir / "vae"), family.vae
+    )
+    for i in range(len(family.text_encoders)):
+        sub = _SUBDIR_CANDIDATES[f"text_encoder_{i}"][0]
+        params[f"text_encoder_{i}"] = convert_text_encoder(
+            read_torch_weights(checkpoint_dir / sub)
+        )
+    return params

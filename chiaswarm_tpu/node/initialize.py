@@ -1,0 +1,153 @@
+"""Node bootstrap: configure hive credentials, fetch the model catalog,
+prefetch + convert checkpoints, and pre-warm compiles.
+
+Capability parity with swarm/initialize.py:19-120 (``--reset`` / ``--silent``
+interactive setup, ``GET /api/models`` cached to ``models.json``, per-model
+weight prefetch), plus the TPU-specific extra the reference doesn't need:
+optional ahead-of-time compilation of the hot shape buckets so the first
+real job doesn't pay XLA compile time.
+
+Zero-egress environments (no hub access) skip the download step cleanly —
+the registry falls back per job and `swarm-tpu smoke` still runs with
+random weights.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Any
+
+import aiohttp
+
+from chiaswarm_tpu.node.hive import HiveClient
+from chiaswarm_tpu.node.logging_setup import setup_logging
+from chiaswarm_tpu.node.registry import model_dir
+from chiaswarm_tpu.node.settings import (
+    Settings,
+    load_settings,
+    save_file,
+    save_settings,
+    settings_root,
+)
+
+log = logging.getLogger("chiaswarm.init")
+
+
+def prompt_settings(settings: Settings) -> Settings:
+    uri = input(f"hive uri [{settings.hive_uri}]: ").strip()
+    token = input("hive token (blank keeps current): ").strip()
+    name = input(f"worker name [{settings.worker_name}]: ").strip()
+    if uri:
+        settings.hive_uri = uri
+    if token:
+        settings.hive_token = token
+    if name:
+        settings.worker_name = name
+    return settings
+
+
+async def fetch_model_catalog(settings: Settings) -> list[dict[str, Any]]:
+    hive = HiveClient(settings.hive_uri, settings.hive_token,
+                      settings.worker_name)
+    async with aiohttp.ClientSession() as session:
+        models = await hive.get_models(session)
+    save_file(models, "models.json")
+    log.info("cached %d models from the hive catalog", len(models))
+    return models
+
+
+def prefetch_checkpoints(models: list[dict[str, Any]],
+                         settings: Settings) -> int:
+    """Download preloadable checkpoints into the local model store
+    (reference behavior at swarm/initialize.py:62-94). Needs hub access;
+    returns the number fetched."""
+    try:
+        from huggingface_hub import snapshot_download
+    except Exception:
+        log.warning("huggingface_hub unavailable; skipping prefetch")
+        return 0
+
+    fetched = 0
+    for model in models:
+        name = model.get("name") or model.get("model_name")
+        if not name or not model.get("parameters", {}).get("can_preload",
+                                                           True):
+            continue
+        target = model_dir(name)
+        if target.exists():
+            continue
+        try:
+            log.info("prefetching %s", name)
+            snapshot_download(
+                name, local_dir=str(target),
+                token=settings.huggingface_token or None,
+                allow_patterns=["*.safetensors", "*.json", "*.txt"],
+            )
+            fetched += 1
+        except Exception as exc:
+            log.warning("prefetch of %s failed: %s", name, exc)
+    return fetched
+
+
+def warm_compile(models: list[dict[str, Any]]) -> None:
+    """Ahead-of-time compile the default shape bucket per local model."""
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
+
+    registry = ModelRegistry(catalog=models, allow_random=False)
+    for model in models:
+        name = model.get("name") or model.get("model_name")
+        if not name or not model_dir(name).exists():
+            continue
+        try:
+            pipe = registry.pipeline(name)
+            size = pipe.c.family.default_size
+            pipe(GenerateRequest(prompt="warmup", steps=2, height=size,
+                                 width=size, seed=0))
+            log.info("warmed %s at %dpx", name, size)
+        except Exception as exc:
+            log.warning("warm compile of %s failed: %s", name, exc)
+
+
+async def init(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--reset", action="store_true",
+                        help="re-prompt for hive uri/token")
+    parser.add_argument("--silent", action="store_true",
+                        help="no prompts; use existing/env settings")
+    parser.add_argument("--no-prefetch", action="store_true")
+    parser.add_argument("--warm-compile", action="store_true")
+    args = parser.parse_args(argv)
+
+    settings = load_settings()
+    setup_logging(settings_root() / "logs", settings.log_filename,
+                  settings.log_level)
+    if args.reset or (not settings.hive_token and not args.silent):
+        settings = prompt_settings(settings)
+    save_settings(settings)
+
+    try:
+        models = await fetch_model_catalog(settings)
+    except Exception as exc:
+        log.warning("could not reach the hive (%s); using cached catalog",
+                    exc)
+        from chiaswarm_tpu.node.settings import load_file
+
+        models = load_file("models.json") or []
+
+    if not args.no_prefetch:
+        prefetch_checkpoints(models, settings)
+    if args.warm_compile:
+        warm_compile(models)
+    log.info("init complete: settings at %s", settings_root())
+    return 0
+
+
+def main() -> None:
+    raise SystemExit(asyncio.run(init()))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,11 +45,14 @@ def caption_callback(slot, model_name: str, *, seed: int,
         if not model_cls_name.startswith("Flax"):
             model_cls_name = "Flax" + model_cls_name
 
+        import os
+
+        offline = not os.environ.get("CHIASWARM_ALLOW_HUB_DOWNLOADS")
         processor = getattr(transformers, processor_name).from_pretrained(
-            model_name
+            model_name, local_files_only=offline
         )
         model = getattr(transformers, model_cls_name).from_pretrained(
-            model_name, from_pt=True
+            model_name, from_pt=True, local_files_only=offline
         )
 
         from PIL import Image

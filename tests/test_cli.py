@@ -1,0 +1,57 @@
+"""CLI-layer tests: smoke harness and init catalog fetch against FakeHive."""
+
+import asyncio
+import json
+import os
+
+from chiaswarm_tpu.node.smoke import SMOKE_JOBS, run_smoke
+
+from tests.fake_hive import FakeHive
+
+
+def test_smoke_txt2img_ok():
+    result = run_smoke("txt2img")
+    assert "error" not in result["pipeline_config"]
+    assert "primary" in result["artifacts"]
+
+
+def test_smoke_img2img_ok():
+    result = run_smoke("img2img")
+    assert "error" not in result["pipeline_config"]
+    assert result["pipeline_config"]["mode"] == "img2img"
+
+
+def test_smoke_stub_workflows_fail_fatally():
+    for wf in ("txt2audio", "txt2vid", "cascade"):
+        result = run_smoke(wf)
+        assert result.get("fatal_error") is True, wf
+        assert "not yet supported" in result["pipeline_config"]["error"]
+
+
+def test_smoke_covers_every_routed_workflow():
+    # the smoke matrix must keep pace with the dispatcher's routing table
+    assert {"txt2img", "img2img", "txt2audio", "txt2vid", "img2txt",
+            "cascade"} <= set(SMOKE_JOBS)
+
+
+def test_init_fetches_catalog(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+
+    async def scenario():
+        hive = FakeHive()
+        uri = await hive.start()
+        hive.models = [{"name": "tiny", "family": "tiny",
+                        "parameters": {"can_preload": False}}]
+        monkeypatch.setenv("SDAAS_URI", uri)
+        monkeypatch.setenv("SDAAS_TOKEN", "token")
+        from chiaswarm_tpu.node.initialize import init
+
+        code = await init(["--silent", "--no-prefetch"])
+        await hive.stop()
+        return code
+
+    assert asyncio.run(scenario()) == 0
+    catalog = json.loads((tmp_path / "models.json").read_text())
+    assert catalog[0]["name"] == "tiny"
+    settings = json.loads((tmp_path / "settings.json").read_text())
+    assert settings["hive_token"] == "token"

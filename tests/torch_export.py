@@ -1,0 +1,170 @@
+"""Test-side inverse exporter: Flax param trees -> HF-diffusers torch state
+dicts. Written independently of chiaswarm_tpu.convert (maps the *other*
+direction) so a naming bug in the converter cannot cancel out in tests."""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+
+def _flatten(tree, prefix=""):
+    for key, value in tree.items():
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(value, dict):
+            yield from _flatten(value, path)
+        else:
+            yield path, np.asarray(value)
+
+
+def _leaf(torch_key_base: str, leaf: str, value: np.ndarray,
+          out: dict) -> None:
+    if leaf == "kernel":
+        if value.ndim == 4:
+            out[f"{torch_key_base}.weight"] = value.transpose(3, 2, 0, 1)
+        else:
+            out[f"{torch_key_base}.weight"] = value.T
+    elif leaf == "scale":
+        out[f"{torch_key_base}.weight"] = value
+    elif leaf == "embedding":
+        out[f"{torch_key_base}.weight"] = value
+    else:
+        out[f"{torch_key_base}.{leaf}"] = value
+
+
+def _attn_inner_to_torch(parts: list[str]) -> str:
+    """['transformer_blocks_0', 'attn1', 'to_q'] -> torch suffix."""
+    head = parts[0]
+    m = re.fullmatch(r"transformer_blocks_(\d+)", head)
+    if not m:
+        return ".".join(parts)  # norm / proj_in / proj_out
+    i = m.group(1)
+    rest = parts[1:]
+    if rest[0] == "ff":
+        sub = "net.0.proj" if rest[1] == "proj_in" else "net.2"
+        return f"transformer_blocks.{i}.ff.{sub}"
+    if rest[0] in ("attn1", "attn2") and rest[1] == "to_out":
+        return f"transformer_blocks.{i}.{rest[0]}.to_out.0"
+    return f"transformer_blocks.{i}." + ".".join(rest)
+
+
+def export_unet(flax_params: dict, n_levels: int) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for path, value in _flatten(flax_params["params"]):
+        parts = path.split("/")
+        top, leaf = parts[0], parts[-1]
+        mid = parts[1:-1]
+
+        m = re.fullmatch(r"(down|up)_(\d+)_(resnets|attentions)_(\d+)", top)
+        md = re.fullmatch(r"(down|up)_(\d+)_(downsample|upsample)", top)
+        mm = re.fullmatch(r"mid_resnets_(\d+)", top)
+        if m:
+            side, level, kind, j = m.groups()
+            idx = int(level) if side == "down" else n_levels - 1 - int(level)
+            if kind == "resnets":
+                base = f"{side}_blocks.{idx}.resnets.{j}.{mid[0]}"
+            else:
+                base = (f"{side}_blocks.{idx}.attentions.{j}."
+                        + _attn_inner_to_torch(mid))
+        elif md:
+            side, level, kind = md.groups()
+            idx = int(level) if side == "down" else n_levels - 1 - int(level)
+            base = f"{side}_blocks.{idx}.{kind}rs.0.conv"  # downsamplers/upsamplers
+        elif mm:
+            base = f"mid_block.resnets.{mm.group(1)}.{mid[0]}"
+        elif top == "mid_attention":
+            base = "mid_block.attentions.0." + _attn_inner_to_torch(mid)
+        elif top in ("time_embedding", "add_embedding"):
+            base = f"{top}.{mid[0]}"
+        else:  # conv_in / conv_norm_out / conv_out
+            base = top
+        _leaf(base, leaf, value, out)
+    return out
+
+
+def export_vae(flax_params: dict, n_levels: int) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for path, value in _flatten(flax_params["params"]):
+        parts = path.split("/")
+        side, leaf = parts[0], parts[-1]
+        body = parts[1:-1]
+        top = body[0] if body else ""
+
+        if top == "quant_conv":
+            base = "quant_conv"
+        elif top == "post_quant_conv":
+            base = "post_quant_conv"
+        elif top == "mid":
+            if body[1].startswith("resnets_"):
+                j = body[1].split("_")[1]
+                base = f"{side}.mid_block.resnets.{j}.{body[2]}"
+            else:  # attentions_0
+                base = f"{side}.mid_block.attentions.0.{body[2]}"
+        else:
+            m = re.fullmatch(r"(down|up)_(\d+)_resnets_(\d+)", top)
+            md = re.fullmatch(r"(down|up)_(\d+)_(downsample|upsample)", top)
+            if m:
+                d, level, j = m.groups()
+                idx = int(level) if d == "down" else n_levels - 1 - int(level)
+                base = f"{side}.{d}_blocks.{idx}.resnets.{j}.{body[1]}"
+            elif md:
+                d, level, kind = md.groups()
+                idx = int(level) if d == "down" else n_levels - 1 - int(level)
+                base = f"{side}.{d}_blocks.{idx}.{kind}rs.0.conv"
+            else:
+                base = f"{side}.{top}"
+        _leaf(base, leaf, value, out)
+    return out
+
+
+def export_text_encoder(flax_params: dict) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    for path, value in _flatten(flax_params["params"]):
+        parts = path.split("/")
+        top, leaf = parts[0], parts[-1]
+        if top == "token_embedding":
+            base = "text_model.embeddings.token_embedding"
+        elif top == "position_embedding":
+            base = "text_model.embeddings.position_embedding"
+        elif top == "final_layer_norm":
+            base = "text_model.final_layer_norm"
+        elif top == "text_projection":
+            base = "text_projection"
+        else:
+            m = re.fullmatch(r"layers_(\d+)", top)
+            i = m.group(1)
+            sub = parts[1]
+            if sub == "self_attn":
+                base = f"text_model.encoder.layers.{i}.self_attn.{parts[2]}"
+            elif sub in ("fc1", "fc2"):
+                base = f"text_model.encoder.layers.{i}.mlp.{sub}"
+            else:
+                base = f"text_model.encoder.layers.{i}.{sub}"
+        _leaf(base, leaf, value, out)
+    return out
+
+
+def write_checkpoint(tmpdir, components) -> None:
+    """Write an HF-style snapshot (safetensors) for a Components bundle."""
+    from pathlib import Path
+
+    from safetensors.numpy import save_file
+
+    root = Path(tmpdir)
+    n_unet = len(components.family.unet.block_out_channels)
+    n_vae = len(components.family.vae.block_out_channels)
+
+    def dump(subdir: str, state: dict) -> None:
+        d = root / subdir
+        d.mkdir(parents=True, exist_ok=True)
+        save_file({k: np.ascontiguousarray(v) for k, v in state.items()},
+                  str(d / "model.safetensors"))
+
+    dump("unet", export_unet(components.params["unet"], n_unet))
+    dump("vae", export_vae(components.params["vae"], n_vae))
+    dump("text_encoder",
+         export_text_encoder(components.params["text_encoder_0"]))
+    if len(components.family.text_encoders) > 1:
+        dump("text_encoder_2",
+             export_text_encoder(components.params["text_encoder_1"]))

@@ -204,6 +204,50 @@ def _unet_path(body: list[str], n_levels: int) -> str | None:
     return None
 
 
+# ------------------------------------------------------------- ControlNet
+
+def convert_controlnet(state: Mapping[str, np.ndarray],
+                       config: UNetConfig) -> dict:
+    """diffusers ``ControlNetModel`` state dict -> ControlNetBundle.params
+    (``{"net": ..., "embed": ...}``, models/controlnet.py). The trunk
+    (conv_in/time_embedding/down_blocks/mid_block) reuses the UNet path
+    rules; the controlnet-specific heads are the zero convs and the hint
+    embedder."""
+    n_levels = len(config.block_out_channels)
+    net_flat: dict[str, np.ndarray] = {}
+    embed_flat: dict[str, np.ndarray] = {}
+    skipped: list[str] = []
+
+    for key, value in state.items():
+        parts = key.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        if body[0] == "controlnet_cond_embedding":
+            if body[1] in ("conv_in", "conv_out"):
+                _place(embed_flat, body[1], name, value)
+            elif body[1] == "blocks":
+                _place(embed_flat, f"blocks_{body[2]}", name, value)
+            else:
+                skipped.append(key)
+            continue
+        if body[0] == "controlnet_down_blocks":
+            _place(net_flat, f"controlnet_down_blocks_{body[1]}", name, value)
+            continue
+        if body[0] == "controlnet_mid_block":
+            _place(net_flat, "controlnet_mid_block", name, value)
+            continue
+        path = _unet_path(body, n_levels)
+        if path is None:
+            skipped.append(key)
+            continue
+        _place(net_flat, path, name, value)
+
+    if skipped:
+        log.info("controlnet conversion skipped %d keys (e.g. %s)",
+                 len(skipped), skipped[0])
+    return {"net": _nest(net_flat), "embed": _nest(embed_flat)}
+
+
 # ------------------------------------------------------------------ VAE
 
 # old diffusers VAE attention names -> canonical

@@ -1,0 +1,115 @@
+"""ControlNet (Flax, NHWC): conditioning branch for the diffusion UNet.
+
+Capability parity with the reference's ControlNet path — loading a
+``ControlNetModel`` next to the pipeline and running UNet+ControlNet in the
+hot loop (swarm/diffusion/diffusion_func.py:29-39,96;
+swarm/job_arguments.py:116-124). TPU-first differences:
+
+- The conditioning-image embedder (:class:`ControlCondEmbedding`) is
+  timestep-independent, so the pipeline evaluates it ONCE and hoists it out
+  of the ``lax.scan`` denoise loop; diffusers recomputes it every step.
+- The control branch shares this framework's UNet block modules (NHWC,
+  Pallas-flash-eligible attention) and the same parameter naming, so the
+  checkpoint converter (convert/torch_to_flax.py) maps diffusers
+  ``ControlNetModel`` state dicts with the same path rules as the UNet.
+- ``conditioning_scale`` is a traced scalar — changing it never recompiles.
+
+The residuals it returns feed the UNet's ``down_residuals``/``mid_residual``
+injection points (models/unet.py).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.configs import UNetConfig
+from chiaswarm_tpu.models.unet import down_trunk, mid_trunk, time_conditioning
+
+zeros_init = nn.initializers.zeros
+
+
+class ControlCondEmbedding(nn.Module):
+    """Conditioning image (B, H, W, 3) in [-1, 1] -> (B, H/8, W/8, C0).
+
+    The "hint" encoder: three stride-2 stages onto the latent grid, final
+    conv zero-initialized so an untrained ControlNet is a no-op.
+    """
+
+    out_channels: int
+    downscale: int = 8  # pixel -> latent grid factor (family.vae.downscale)
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def block_channels(self) -> tuple[int, ...]:
+        stages = max(self.downscale.bit_length() - 1, 0)  # log2(downscale)
+        return (16, 32, 96, 256)[: stages + 1]
+
+    @nn.compact
+    def __call__(self, cond: jnp.ndarray) -> jnp.ndarray:
+        x = cond.astype(self.dtype)
+        x = nn.Conv(self.block_channels[0], (3, 3), padding=1,
+                    dtype=self.dtype, name="conv_in")(x)
+        x = nn.silu(x)
+        for i in range(len(self.block_channels) - 1):
+            x = nn.Conv(self.block_channels[i], (3, 3), padding=1,
+                        dtype=self.dtype, name=f"blocks_{2 * i}")(x)
+            x = nn.silu(x)
+            x = nn.Conv(self.block_channels[i + 1], (3, 3), strides=(2, 2),
+                        padding=1, dtype=self.dtype,
+                        name=f"blocks_{2 * i + 1}")(x)
+            x = nn.silu(x)
+        return nn.Conv(self.out_channels, (3, 3), padding=1,
+                       kernel_init=zeros_init, dtype=self.dtype,
+                       name="conv_out")(x)
+
+
+class ControlNet(nn.Module):
+    """Control branch: mirrors the UNet down+mid path, emits zero-conv'd
+    residuals ``(down_residuals, mid_residual)`` for UNet injection.
+
+    ``cond_emb`` is the pre-embedded hint from :class:`ControlCondEmbedding`
+    (hoisted out of the denoise scan by the pipeline).
+    """
+
+    config: UNetConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(
+        self,
+        sample: jnp.ndarray,                 # (B, H/8, W/8, C_latent)
+        timesteps: jnp.ndarray,              # (B,)
+        encoder_hidden_states: jnp.ndarray,  # (B, S, cross_attention_dim)
+        cond_emb: jnp.ndarray,               # (B, H/8, W/8, C0) pre-embedded
+        added_cond: dict[str, jnp.ndarray] | None = None,
+        conditioning_scale: jnp.ndarray | float = 1.0,
+    ) -> tuple[tuple[jnp.ndarray, ...], jnp.ndarray]:
+        cfg = self.config
+        dtype = self.dtype
+        channels = list(cfg.block_out_channels)
+
+        temb = time_conditioning(cfg, dtype, timesteps, added_cond)
+        context = encoder_hidden_states.astype(dtype)
+        x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
+                    name="conv_in")(sample.astype(dtype))
+        x = x + cond_emb.astype(dtype)
+        x, skips = down_trunk(cfg, dtype, x, temb, context)
+        x = mid_trunk(cfg, dtype, x, temb, context)
+
+        mid_ch = channels[-1]
+        scale = jnp.asarray(conditioning_scale, jnp.float32)
+        down_residuals = tuple(
+            scale * nn.Conv(s.shape[-1], (1, 1), kernel_init=zeros_init,
+                            dtype=dtype,
+                            name=f"controlnet_down_blocks_{i}")(s)
+            for i, s in enumerate(skips)
+        )
+        mid_residual = scale * nn.Conv(
+            mid_ch, (1, 1), kernel_init=zeros_init, dtype=dtype,
+            name="controlnet_mid_block",
+        )(x)
+        return down_residuals, mid_residual

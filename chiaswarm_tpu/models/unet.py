@@ -201,6 +201,78 @@ class Upsample(nn.Module):
                        name="conv")(x)
 
 
+def time_conditioning(cfg: UNetConfig, dtype: jnp.dtype,
+                      timesteps: jnp.ndarray,
+                      added_cond: dict[str, jnp.ndarray] | None) -> jnp.ndarray:
+    """Timestep (+ SDXL micro-conditioning) embedding. Shared by the UNet
+    and the ControlNet trunk — creates the ``time_embedding`` /
+    ``add_embedding`` submodules in the CALLER's compact scope, so both
+    models keep identical parameter paths for the checkpoint converter."""
+    channels = list(cfg.block_out_channels)
+    time_embed_dim = channels[0] * 4
+    temb = timestep_embedding(timesteps, channels[0],
+                              cfg.flip_sin_to_cos, cfg.freq_shift)
+    temb = TimestepEmbedding(time_embed_dim, dtype=dtype,
+                             name="time_embedding")(temb.astype(dtype))
+    if cfg.addition_embed_dim is not None:
+        if added_cond is None:
+            raise ValueError("this family requires added_cond "
+                             "(text_embeds + time_ids)")
+        time_ids = added_cond["time_ids"]          # (B, 6)
+        text_embeds = added_cond["text_embeds"]    # (B, pooled_dim)
+        b = time_ids.shape[0]
+        ids_emb = timestep_embedding(
+            time_ids.reshape(-1), cfg.addition_embed_dim,
+            cfg.flip_sin_to_cos, cfg.freq_shift,
+        ).reshape(b, -1)
+        add = jnp.concatenate([text_embeds.astype(jnp.float32), ids_emb],
+                              axis=-1)
+        temb = temb + TimestepEmbedding(
+            time_embed_dim, dtype=dtype, name="add_embedding"
+        )(add.astype(dtype))
+    return temb
+
+
+def down_trunk(cfg: UNetConfig, dtype: jnp.dtype, x: jnp.ndarray,
+               temb: jnp.ndarray, context: jnp.ndarray,
+               ) -> tuple[jnp.ndarray, list[jnp.ndarray]]:
+    """Down path from the post-conv_in activation: returns (x, skips).
+    Shared verbatim by UNet and ControlNet (same submodule names)."""
+    channels = list(cfg.block_out_channels)
+    skips = [x]
+    for level, ch in enumerate(channels):
+        depth = cfg.transformer_depth[level]
+        heads, head_dim = cfg.heads_for(ch, level)
+        for j in range(cfg.layers_per_block):
+            x = ResnetBlock(ch, dtype,
+                            name=f"down_{level}_resnets_{j}")(x, temb)
+            if depth > 0:
+                x = SpatialTransformer(
+                    depth, heads, head_dim, cfg.use_linear_projection,
+                    dtype, cfg.attn_impl,
+                    name=f"down_{level}_attentions_{j}",
+                )(x, context)
+            skips.append(x)
+        if level < len(channels) - 1:
+            x = Downsample(ch, dtype, name=f"down_{level}_downsample")(x)
+            skips.append(x)
+    return x, skips
+
+
+def mid_trunk(cfg: UNetConfig, dtype: jnp.dtype, x: jnp.ndarray,
+              temb: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+    """Mid block (resnet -> transformer -> resnet), shared like down_trunk."""
+    channels = list(cfg.block_out_channels)
+    mid_ch = channels[-1]
+    mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(channels) - 1)
+    mid_depth = max(d for d in cfg.transformer_depth) or 1
+    x = ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(x, temb)
+    x = SpatialTransformer(mid_depth, mid_heads, mid_head_dim,
+                           cfg.use_linear_projection, dtype,
+                           cfg.attn_impl, name="mid_attention")(x, context)
+    return ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(x, temb)
+
+
 class UNet(nn.Module):
     """Returns the model prediction (epsilon/v per family) for NHWC latents.
 
@@ -227,67 +299,19 @@ class UNet(nn.Module):
         cfg = self.config
         dtype = self.dtype
         channels = list(cfg.block_out_channels)
-        time_embed_dim = channels[0] * 4
 
-        temb = timestep_embedding(timesteps, channels[0],
-                                  cfg.flip_sin_to_cos, cfg.freq_shift)
-        temb = TimestepEmbedding(time_embed_dim, dtype=dtype,
-                                 name="time_embedding")(temb.astype(dtype))
-
-        if cfg.addition_embed_dim is not None:
-            if added_cond is None:
-                raise ValueError("this family requires added_cond "
-                                 "(text_embeds + time_ids)")
-            time_ids = added_cond["time_ids"]          # (B, 6)
-            text_embeds = added_cond["text_embeds"]    # (B, pooled_dim)
-            b = time_ids.shape[0]
-            ids_emb = timestep_embedding(
-                time_ids.reshape(-1), cfg.addition_embed_dim,
-                cfg.flip_sin_to_cos, cfg.freq_shift,
-            ).reshape(b, -1)
-            add = jnp.concatenate([text_embeds.astype(jnp.float32), ids_emb],
-                                  axis=-1)
-            temb = temb + TimestepEmbedding(
-                time_embed_dim, dtype=dtype, name="add_embedding"
-            )(add.astype(dtype))
-
+        temb = time_conditioning(cfg, dtype, timesteps, added_cond)
         context = encoder_hidden_states.astype(dtype)
         sample = sample.astype(dtype)
 
         x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
                     name="conv_in")(sample)
-        skips = [x]
-
-        # ---- down path
-        for level, ch in enumerate(channels):
-            depth = cfg.transformer_depth[level]
-            heads, head_dim = cfg.heads_for(ch, level)
-            for j in range(cfg.layers_per_block):
-                x = ResnetBlock(ch, dtype,
-                                name=f"down_{level}_resnets_{j}")(x, temb)
-                if depth > 0:
-                    x = SpatialTransformer(
-                        depth, heads, head_dim, cfg.use_linear_projection,
-                        dtype, cfg.attn_impl,
-                        name=f"down_{level}_attentions_{j}",
-                    )(x, context)
-                skips.append(x)
-            if level < len(channels) - 1:
-                x = Downsample(ch, dtype, name=f"down_{level}_downsample")(x)
-                skips.append(x)
+        x, skips = down_trunk(cfg, dtype, x, temb, context)
 
         if down_residuals is not None:
             skips = [s + r for s, r in zip(skips, down_residuals)]
 
-        # ---- mid
-        mid_ch = channels[-1]
-        mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(channels) - 1)
-        mid_depth = max(d for d in cfg.transformer_depth) or 1
-        x = ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(x, temb)
-        x = SpatialTransformer(mid_depth, mid_heads, mid_head_dim,
-                               cfg.use_linear_projection, dtype,
-                               cfg.attn_impl, name="mid_attention")(x, context)
-        x = ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(x, temb)
+        x = mid_trunk(cfg, dtype, x, temb, context)
         if mid_residual is not None:
             x = x + mid_residual
 

@@ -95,3 +95,30 @@ class ModelRegistry:
 
     def components(self, model_name: str) -> Components:
         return self.pipeline(model_name).c
+
+    def controlnet(self, controlnet_name: str, family: ModelFamily):
+        """Resident ControlNetBundle (the per-job ControlNetModel load of
+        swarm/diffusion/diffusion_func.py:29-34, made resident + LRU'd)."""
+        from chiaswarm_tpu.pipelines.components import ControlNetBundle
+
+        def load() -> ControlNetBundle:
+            ckpt = model_dir(controlnet_name)
+            if ckpt.exists():
+                log.info("loading controlnet %s from %s",
+                         controlnet_name, ckpt)
+                return ControlNetBundle.from_checkpoint(
+                    ckpt, controlnet_name, family)
+            if self.allow_random:
+                log.warning("no checkpoint for controlnet %s; using random "
+                            "weights", controlnet_name)
+                return ControlNetBundle.random(family,
+                                               model_name=controlnet_name)
+            raise ValueError(
+                f"controlnet {controlnet_name!r} is not available on this "
+                f"node (no checkpoint at {ckpt})"
+            )
+
+        return GLOBAL_CACHE.cached_params(
+            ("controlnet", controlnet_name, family.name), load,
+            size_of=lambda b: b.param_bytes(),
+        )

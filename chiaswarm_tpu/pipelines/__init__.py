@@ -1,6 +1,7 @@
 """Jitted end-to-end generation pipelines + the workload callback registry."""
 
-from chiaswarm_tpu.pipelines.components import Components
+from chiaswarm_tpu.pipelines.components import Components, ControlNetBundle
 from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline, GenerateRequest
 
-__all__ = ["Components", "DiffusionPipeline", "GenerateRequest"]
+__all__ = ["Components", "ControlNetBundle", "DiffusionPipeline",
+           "GenerateRequest"]

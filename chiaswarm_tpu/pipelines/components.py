@@ -127,3 +127,85 @@ class Components:
     def param_bytes(self) -> int:
         leaves = jax.tree.leaves(self.params)
         return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+
+
+@dataclasses.dataclass
+class ControlNetBundle:
+    """A ControlNet checkpoint attachable to a base-family pipeline.
+
+    The reference loads a ``ControlNetModel`` next to the pipeline per job
+    (swarm/diffusion/diffusion_func.py:29-34); here the bundle is resident
+    and LRU-cached like every other param tree (node/registry.py). The
+    ``params`` dict holds two trees: ``net`` (the control branch) and
+    ``embed`` (the conditioning-image hint encoder, hoisted out of the
+    denoise scan by the pipeline).
+    """
+
+    family: ModelFamily
+    model_name: str
+    params: dict[str, Any]  # keys: net, embed
+
+    @classmethod
+    def random(cls, family: ModelFamily | str, seed: int = 0,
+               model_name: str | None = None) -> "ControlNetBundle":
+        from chiaswarm_tpu.models.controlnet import (
+            ControlCondEmbedding,
+            ControlNet,
+        )
+
+        if isinstance(family, str):
+            family = FAMILIES[family]
+        cfg = family.unet
+        key = jax.random.PRNGKey(seed)
+        net = ControlNet(cfg)
+        embed = ControlCondEmbedding(cfg.block_out_channels[0],
+                                     downscale=family.vae.downscale)
+        f = family.vae.downscale
+        lh = lw = 8
+        latent = jnp.zeros((1, lh, lw, cfg.sample_channels), jnp.float32)
+        cond = jnp.zeros((1, lh * f, lw * f, 3), jnp.float32)
+        ctx = jnp.zeros((1, 77, cfg.cross_attention_dim), jnp.float32)
+        added = None
+        if cfg.addition_embed_dim is not None:
+            added = {
+                "time_ids": jnp.zeros((1, 6), jnp.float32),
+                "text_embeds": jnp.zeros(
+                    (1, cfg.addition_pooled_dim), jnp.float32),
+            }
+        key, k1, k2 = jax.random.split(key, 3)
+        params = {
+            "embed": jax.jit(embed.init)(k1, cond),
+        }
+        cond_emb = embed.apply(params["embed"], cond)
+        params["net"] = jax.jit(net.init)(
+            k2, latent, jnp.zeros((1,)), ctx, cond_emb, added
+        )
+        return cls(family=family,
+                   model_name=model_name or f"random/controlnet-{family.name}",
+                   params=params)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str | Path,
+                        model_name: str | None = None,
+                        family: ModelFamily | str | None = None,
+                        ) -> "ControlNetBundle":
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_controlnet,
+            read_torch_weights,
+        )
+
+        checkpoint_dir = Path(checkpoint_dir)
+        if (checkpoint_dir / "controlnet").is_dir():  # full pipeline snapshot
+            checkpoint_dir = checkpoint_dir / "controlnet"
+        model_name = model_name or checkpoint_dir.name
+        if family is None:
+            family = get_family(model_name)
+        elif isinstance(family, str):
+            family = FAMILIES[family]
+        state = read_torch_weights(checkpoint_dir)
+        return cls(family=family, model_name=model_name,
+                   params=convert_controlnet(state, family.unet))
+
+    def param_bytes(self) -> int:
+        leaves = jax.tree.leaves(self.params)
+        return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)

@@ -68,6 +68,10 @@ class GenerateRequest:
     strength: float = 0.8
     mask: np.ndarray | None = None         # (H, W) float, 1 = regenerate
     tiled_decode: bool = False
+    # ControlNet (swarm/diffusion/diffusion_func.py:29-39)
+    controlnet: Any = None                 # ControlNetBundle
+    control_image: np.ndarray | None = None  # (H, W, 3) conditioning image
+    control_scale: float = 1.0             # traced; never recompiles
 
 
 def _to_float_image(img: np.ndarray) -> np.ndarray:
@@ -130,7 +134,8 @@ class DiffusionPipeline:
 
     def _build_fn(self, *, batch: int, height: int, width: int, steps: int,
                   start_step: int, sampler: SamplerConfig, use_cfg: bool,
-                  has_init: bool, has_mask: bool, tiled: bool):
+                  has_init: bool, has_mask: bool, tiled: bool,
+                  has_control: bool = False):
         # capture only the static module descriptions — NOT the Components
         # bundle, whose .params would otherwise stay pinned by the
         # executable-cache closure after the param LRU evicts them
@@ -142,6 +147,18 @@ class DiffusionPipeline:
         sched = make_sampling_schedule(self.noise_schedule, steps, sampler)
         needs_xl = fam.unet.addition_embed_dim is not None
 
+        control_net = control_embed = None
+        if has_control:
+            from chiaswarm_tpu.models.controlnet import (
+                ControlCondEmbedding,
+                ControlNet,
+            )
+
+            control_net = ControlNet(fam.unet)
+            control_embed = ControlCondEmbedding(
+                fam.unet.block_out_channels[0],
+                downscale=fam.vae.downscale)
+
         def encode_text(params, ids_list):
             seqs, pooled = [], None
             for i, te in enumerate(text_encoders):
@@ -150,7 +167,8 @@ class DiffusionPipeline:
                 pooled = pool  # SDXL: pooled comes from the last encoder
             return jnp.concatenate(seqs, axis=-1) if len(seqs) > 1 else seqs[0], pooled
 
-        def fn(params, ids, neg_ids, key, guidance, init_latent, mask):
+        def fn(params, ids, neg_ids, key, guidance, init_latent, mask,
+               control_params, control_cond, control_scale):
             ctx, pooled = encode_text(params, ids)
             if use_cfg:
                 nctx, npooled = encode_text(params, neg_ids)
@@ -179,6 +197,16 @@ class DiffusionPipeline:
             if has_mask:
                 known = init_latent  # clean latents of the source image
 
+            cond_emb = None
+            if has_control:
+                # hint embedding is timestep-independent: evaluate ONCE
+                # here, outside the scan (diffusers recomputes per step)
+                cond_emb = control_embed.apply(
+                    control_params["embed"], control_cond)
+                cond_emb = jnp.repeat(cond_emb, batch, axis=0)
+                if use_cfg:
+                    cond_emb = jnp.concatenate([cond_emb, cond_emb], axis=0)
+
             def body(carry, idx):
                 x, state, key = carry
                 i = idx + start_step
@@ -186,12 +214,24 @@ class DiffusionPipeline:
                 if use_cfg:
                     inp2 = jnp.concatenate([inp, inp], axis=0)
                     t2 = sched.timesteps[i][None].repeat(2 * batch, axis=0)
-                    out = unet.apply(params["unet"], inp2, t2, ctx, added)
+                    down_res = mid_res = None
+                    if has_control:
+                        down_res, mid_res = control_net.apply(
+                            control_params["net"], inp2, t2, ctx, cond_emb,
+                            added, control_scale)
+                    out = unet.apply(params["unet"], inp2, t2, ctx, added,
+                                     down_res, mid_res)
                     eps_u, eps_c = jnp.split(out, 2, axis=0)
                     eps = eps_u + guidance * (eps_c - eps_u)
                 else:
                     t1 = sched.timesteps[i][None].repeat(batch, axis=0)
-                    eps = unet.apply(params["unet"], inp, t1, ctx, added)
+                    down_res = mid_res = None
+                    if has_control:
+                        down_res, mid_res = control_net.apply(
+                            control_params["net"], inp, t1, ctx, cond_emb,
+                            added, control_scale)
+                    eps = unet.apply(params["unet"], inp, t1, ctx, added,
+                                     down_res, mid_res)
                 key, skey = jax.random.split(key)
                 step_noise = jax.random.normal(skey, x.shape, jnp.float32)
                 x, state = sampler_step(sampler, sched, i, x, eps, state,
@@ -309,6 +349,23 @@ class DiffusionPipeline:
                 m = m.reshape(lh, f, lw, f).mean((1, 3))
             mask_arr = jnp.asarray((m > 0.5).astype(np.float32))[None, :, :, None]
 
+        has_control = req.controlnet is not None
+        control_params = {"zero": jnp.zeros((1,), jnp.float32)}
+        control_cond = jnp.zeros((1,), jnp.float32)
+        if has_control:
+            if req.control_image is None:
+                raise ValueError("controlnet requires a conditioning image")
+            cond = np.asarray(req.control_image)
+            if cond.shape[:2] != (height, width):
+                cond = _resize_batch(cond, height, width)
+            # hint encoder expects [0, 1] (diffusers ControlNet training
+            # normalization), NOT the VAE's [-1, 1]
+            cond = np.asarray(cond, np.float32)
+            if req.control_image.dtype == np.uint8 or cond.max() > 1.0:
+                cond = cond / 255.0
+            control_cond = jnp.asarray(np.clip(cond, 0.0, 1.0))[None]
+            control_params = req.controlnet.params
+
         ids = self._tokenize([req.prompt] * batch)
         neg = self._tokenize([req.negative_prompt or ""] * batch)
 
@@ -316,6 +373,7 @@ class DiffusionPipeline:
             batch=batch, height=height, width=width, steps=steps,
             start_step=start_step, sampler=sampler, use_cfg=use_cfg,
             has_init=has_init, has_mask=has_mask, tiled=req.tiled_decode,
+            has_control=has_control,
         )
         img = fn(
             self.c.params,
@@ -325,6 +383,9 @@ class DiffusionPipeline:
             jnp.float32(req.guidance_scale),
             init_latent,
             mask_arr,
+            control_params,
+            control_cond,
+            jnp.float32(req.control_scale),
         )
         img = np.asarray(jax.device_get(img))
         img_u8 = ((img + 1.0) * 127.5).round().clip(0, 255).astype(np.uint8)
@@ -355,4 +416,7 @@ class DiffusionPipeline:
             "mode": ("inpaint" if has_mask else
                      "img2img" if has_init else "txt2img"),
         }
+        if has_control:
+            config["controlnet"] = req.controlnet.model_name
+            config["controlnet_scale"] = float(req.control_scale)
         return img_u8[: req.batch], config

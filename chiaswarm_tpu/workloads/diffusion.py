@@ -37,6 +37,9 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        scheduler_type: str | None = None,
                        content_type: str = "image/png",
                        upscale: bool = False,
+                       controlnet_model_name: str | None = None,
+                       controlnet_scale: float = 1.0,
+                       save_preprocessed_input: bool = False,
                        outputs: tuple[str, ...] = ("primary",),
                        **_ignored: Any):
     pipe = registry.pipeline(model_name)
@@ -46,6 +49,20 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         height, width = image.shape[:2]
     height = int(height or fam.default_size)
     width = int(width or fam.default_size)
+
+    controlnet = None
+    control_image = None
+    if controlnet_model_name is not None:
+        if mask_image is not None:
+            raise ValueError(
+                "controlnet jobs cannot also carry a mask_image; the input "
+                "image is the conditioning image, not an inpainting source"
+            )
+        # the fetched input IS the (preprocessed) conditioning image — it
+        # steers generation instead of seeding latents
+        # (swarm/job_arguments.py:116-124)
+        controlnet = registry.controlnet(controlnet_model_name, fam)
+        control_image, image = image, None
 
     if image_guidance_scale is not None:
         # instruct-pix2pix jobs arrive with image_guidance_scale =
@@ -74,6 +91,9 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         strength=float(strength),
         mask=mask,
         tiled_decode=max(height, width) > 1024,
+        controlnet=controlnet,
+        control_image=control_image,
+        control_scale=float(controlnet_scale),
     )
     t0 = time.perf_counter()
     images, config = pipe(req)
@@ -87,6 +107,11 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
 
     proc = OutputProcessor(content_type)
     proc.add_images(images)
+    if control_image is not None and save_preprocessed_input:
+        # echo the preprocessed conditioning image back as an extra
+        # artifact (swarm/diffusion/diffusion_func.py:36-39)
+        proc.add_images(np.asarray(control_image, dtype=np.uint8),
+                        key="preprocessed_input")
     artifacts = proc.get_results()
 
     config.update({

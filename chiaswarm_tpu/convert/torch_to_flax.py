@@ -349,6 +349,167 @@ def convert_text_encoder(state: Mapping[str, np.ndarray]) -> dict:
     return _nest(flat)
 
 
+# ------------------------------------------------------------------- T5
+
+def convert_t5(state: Mapping[str, np.ndarray]) -> dict:
+    """transformers ``T5EncoderModel`` state dict -> models/t5.py tree."""
+    flat: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        parts = key.split(".")
+        if parts[0] == "shared" or parts[:2] == ["encoder", "embed_tokens"]:
+            flat["token_embedding/embedding"] = value
+            continue
+        if parts[0] != "encoder":
+            log.debug("t5 conversion skipped %s", key)
+            continue
+        rest = parts[1:]
+        if rest[0] == "final_layer_norm":
+            flat["final_layer_norm/scale"] = value
+            continue
+        if rest[0] != "block":
+            log.debug("t5 conversion skipped %s", key)
+            continue
+        i = rest[1]
+        layer, sub = rest[3], rest[4]
+        if sub == "SelfAttention":
+            leaf = rest[5]
+            if leaf == "relative_attention_bias":
+                flat[f"block_{i}/attention/relative_attention_bias"] = value
+            else:
+                flat[f"block_{i}/attention/{leaf}/kernel"] = value.T
+        elif sub == "DenseReluDense":
+            flat[f"block_{i}/{rest[5]}/kernel"] = value.T
+        elif sub == "layer_norm":
+            which = "attn_norm" if layer == "0" else "ff_norm"
+            flat[f"block_{i}/{which}/scale"] = value
+    return _nest(flat)
+
+
+def load_cascade_checkpoint(checkpoint_dir: str | Path, model_name: str,
+                            family) -> "Any":
+    """IF-class cascade snapshot -> CascadeComponents.
+
+    Expected layout (assembled by the node initializer, since the
+    reference's three stages live in separate HF repos,
+    swarm/diffusion/diffusion_func_if.py:16-40):
+    ``text_encoder/`` (T5), ``unet/`` (stage 1), ``unet_sr/`` (stage 2).
+    """
+    from chiaswarm_tpu.models.t5 import T5Encoder
+    from chiaswarm_tpu.models.tokenizer import HashTokenizer, load_tokenizer
+    from chiaswarm_tpu.models.unet import UNet
+    from chiaswarm_tpu.pipelines.cascade import CascadeComponents
+
+    checkpoint_dir = Path(checkpoint_dir)
+    params = {
+        "t5": convert_t5(read_torch_weights(checkpoint_dir / "text_encoder")),
+        "unet1": convert_unet(read_torch_weights(checkpoint_dir / "unet"),
+                              family.stage1),
+        "unet2": convert_unet(read_torch_weights(checkpoint_dir / "unet_sr"),
+                              family.stage2),
+    }
+    tokenizer = load_tokenizer(checkpoint_dir, family.t5.vocab_size,
+                               family.t5.eos_token_id, family.t5.max_length)
+    return CascadeComponents(
+        family=family, model_name=model_name, tokenizer=tokenizer,
+        t5=T5Encoder(family.t5), unet1=UNet(family.stage1),
+        unet2=UNet(family.stage2), params=params,
+    )
+
+
+# -------------------------------------------------------------- vocoder
+
+def _fold_weight_norm(state: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Fold torch ``weight_norm`` (weight_g/weight_v pairs) into plain
+    ``weight`` tensors: w = g * v / ||v|| (norm over non-output dims)."""
+    out: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        if key.endswith(".weight_v"):
+            base = key[: -len(".weight_v")]
+            g = state[base + ".weight_g"]
+            v = value
+            axes = tuple(range(1, v.ndim))
+            norm = np.sqrt((v * v).sum(axis=axes, keepdims=True))
+            out[base + ".weight"] = (g * v / np.maximum(norm, 1e-12))
+        elif key.endswith(".weight_g"):
+            continue
+        else:
+            out[key] = value
+    return out
+
+
+def convert_hifigan(state: Mapping[str, np.ndarray],
+                    num_resblock_kernels: int) -> dict:
+    """transformers ``SpeechT5HifiGan`` state dict -> models/vocoder.py tree.
+
+    Torch layouts: Conv1d (O, I, K) -> (K, I, O); ConvTranspose1d
+    (I, O, K) -> (K, I, O). The flat ``resblocks.{k}`` list unrolls to
+    ``resblocks_{k // K}_{k % K}`` (K = number of resblock kernel sizes)."""
+    state = _fold_weight_norm(state)
+    flat: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        parts = key.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        if body[0] in ("conv_pre", "conv_post"):
+            path = body[0]
+        elif body[0] == "upsampler":
+            path = f"upsampler_{body[1]}"
+        elif body[0] == "resblocks":
+            k = int(body[1])
+            up, kern = divmod(k, num_resblock_kernels)
+            path = f"resblocks_{up}_{kern}/{body[2]}_{body[3]}"
+        else:
+            log.debug("hifigan conversion skipped %s", key)
+            continue
+        if name == "weight":
+            if body[0] == "upsampler":
+                # ConvTranspose1d (I, O, K) -> (K, I, O), spatially flipped:
+                # torch conv_transpose is the conv gradient (flipped kernel),
+                # flax ConvTranspose is a plain dilated correlation
+                flat[f"{path}/kernel"] = value.transpose(2, 0, 1)[::-1]
+            else:                        # Conv1d (O, I, K)
+                flat[f"{path}/kernel"] = value.transpose(2, 1, 0)
+        elif name == "bias":
+            flat[f"{path}/bias"] = value
+    return _nest(flat)
+
+
+def load_audio_checkpoint(checkpoint_dir: str | Path, model_name: str,
+                          family) -> "Any":
+    """AudioLDM-class snapshot -> AudioComponents. Layout: ``text_encoder/``
+    (CLAP text tower — best-effort CLIP-style mapping), ``unet/``, ``vae/``,
+    ``vocoder/`` (SpeechT5HifiGan)."""
+    from chiaswarm_tpu.models.clip import ClipTextEncoder
+    from chiaswarm_tpu.models.tokenizer import load_tokenizer
+    from chiaswarm_tpu.models.unet import UNet
+    from chiaswarm_tpu.models.vae import AutoencoderKL
+    from chiaswarm_tpu.models.vocoder import HifiGan
+    from chiaswarm_tpu.pipelines.audio import AudioComponents
+
+    checkpoint_dir = Path(checkpoint_dir)
+    params = {
+        "text_encoder": convert_text_encoder(
+            read_torch_weights(checkpoint_dir / "text_encoder")),
+        "unet": convert_unet(read_torch_weights(checkpoint_dir / "unet"),
+                             family.unet),
+        "vae": convert_vae(read_torch_weights(checkpoint_dir / "vae"),
+                           family.vae),
+        "vocoder": convert_hifigan(
+            read_torch_weights(checkpoint_dir / "vocoder"),
+            len(family.vocoder.resblock_kernel_sizes)),
+    }
+    tokenizer = load_tokenizer(checkpoint_dir,
+                               family.text_encoder.vocab_size,
+                               family.text_encoder.eos_token_id,
+                               family.text_encoder.max_position_embeddings)
+    return AudioComponents(
+        family=family, model_name=model_name, tokenizer=tokenizer,
+        text_encoder=ClipTextEncoder(family.text_encoder),
+        unet=UNet(family.unet), vae=AutoencoderKL(family.vae),
+        vocoder=HifiGan(family.vocoder), params=params,
+    )
+
+
 # ------------------------------------------------------------- top level
 
 _SUBDIR_CANDIDATES = {

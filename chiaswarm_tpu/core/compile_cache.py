@@ -29,6 +29,28 @@ from typing import Any, Callable, Hashable
 _POW2 = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
+def static_cache_key(owner: int, tag: str, static: dict) -> tuple:
+    """Hashable executable-cache key from a pipeline's static build args.
+
+    Shared by every pipeline's ``_get_fn`` (diffusion/upscale/cascade/
+    audio) so dataclass-valued statics (sampler configs, ...) normalize the
+    same way everywhere — including nested dataclasses and containers."""
+
+    def norm(v: Any) -> Hashable:
+        if dataclasses.is_dataclass(v) and not isinstance(v, type):
+            return tuple(sorted(
+                (f.name, norm(getattr(v, f.name)))
+                for f in dataclasses.fields(v)))
+        if isinstance(v, dict):
+            return tuple(sorted((k, norm(x)) for k, x in v.items()))
+        if isinstance(v, (list, tuple)):
+            return tuple(norm(x) for x in v)
+        return v
+
+    return (owner, tag, tuple(sorted((k, norm(v))
+                                     for k, v in static.items())))
+
+
 def bucket_batch(n: int) -> int:
     """Round batch up to the next power of two (caps recompiles at
     log2(max_batch) executables per pipeline)."""

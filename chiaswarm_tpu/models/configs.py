@@ -90,6 +90,9 @@ class ModelFamily:
     default_size: int = 512
     # SDXL conditions on (orig_size, crop_topleft, target_size) time ids
     needs_time_ids: bool = False
+    # pipeline class selector: "sd" (DiffusionPipeline) | "upscaler"
+    # (LatentUpscalePipeline, swarm/diffusion/upscale.py parity)
+    kind: str = "sd"
 
 
 _CLIP_L = TextEncoderConfig()  # ViT-L/14 text tower: SD1.x, SDXL enc 1
@@ -148,6 +151,29 @@ SDXL = ModelFamily(
     needs_time_ids=True,
 )
 
+# 2x latent upscaler (sd-x2-latent-upscaler-class): the UNet denoises the
+# 2x latent grid conditioned on the nearest-upsampled low-res latents
+# concatenated on channels (sample_channels = 2 * latent_channels). Run by
+# the reference after generation when the server flags ``upscale``
+# (swarm/diffusion/upscale.py:6-32, swarm/job_arguments.py:104-110).
+UPSCALER_X2 = ModelFamily(
+    name="upscaler_x2",
+    unet=UNetConfig(
+        sample_channels=8,
+        out_channels=4,
+        block_out_channels=(384, 768, 768),
+        transformer_depth=(1, 1, 1),
+        attention_head_dim=64,
+        head_dim_is_count=False,
+        cross_attention_dim=768,
+        use_linear_projection=True,
+    ),
+    vae=VAEConfig(),
+    text_encoders=(_CLIP_L,),
+    default_size=512,
+    kind="upscaler",
+)
+
 # Hermetic-test family: full architecture shape, toy widths — runs on CPU in
 # seconds (the tiny-model registry called for by SURVEY.md §4).
 TINY = ModelFamily(
@@ -200,13 +226,40 @@ TINY_XL = ModelFamily(
     needs_time_ids=True,
 )
 
+# Tiny upscaler family for hermetic tests (concat-conditioned 8ch UNet).
+TINY_UP = ModelFamily(
+    name="tiny_up",
+    unet=UNetConfig(
+        sample_channels=8,
+        out_channels=4,
+        block_out_channels=(32, 64),
+        layers_per_block=1,
+        transformer_depth=(1, 1),
+        attention_head_dim=4,
+        head_dim_is_count=True,
+        cross_attention_dim=32,
+        dtype="float32",
+    ),
+    vae=VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                  dtype="float32"),
+    text_encoders=(
+        TextEncoderConfig(vocab_size=1000, hidden_size=32,
+                          intermediate_size=64, num_layers=2, num_heads=4,
+                          max_position_embeddings=77, eos_token_id=999),
+    ),
+    default_size=64,
+    kind="upscaler",
+)
+
 FAMILIES: dict[str, ModelFamily] = {
-    f.name: f for f in (SD15, SD21, SDXL, TINY, TINY_XL)
+    f.name: f for f in (SD15, SD21, SDXL, UPSCALER_X2, TINY, TINY_XL, TINY_UP)
 }
 
 # hive model-name prefixes -> family (the dispatch the reference does via
 # server-sent pipeline class names, swarm/job_arguments.py:104-151)
 _NAME_HINTS = (
+    ("latent-upscaler", "upscaler_x2"),
+    ("upscale", "upscaler_x2"),
     ("xl", "sdxl"),
     ("stable-diffusion-2", "sd21"),
     ("sd2", "sd21"),
@@ -215,9 +268,14 @@ _NAME_HINTS = (
 
 def get_family(model_name: str) -> ModelFamily:
     low = (model_name or "").lower()
+    # exact family name (full or basename) wins over substring hints —
+    # "random/tiny_xl" must hit tiny_xl, not the "xl" hint
+    if low in FAMILIES:
+        return FAMILIES[low]
+    tail = low.rsplit("/", 1)[-1]
+    if tail in FAMILIES:
+        return FAMILIES[tail]
     for hint, family in _NAME_HINTS:
         if hint in low:
             return FAMILIES[family]
-    if low in FAMILIES:
-        return FAMILIES[low]
     return FAMILIES["sd15"]

@@ -1,0 +1,174 @@
+"""T5 text encoder (Flax) — the conditioning tower for pixel-space cascades.
+
+DeepFloyd-IF-class models condition on a T5-v1.1 encoder instead of CLIP
+(the reference loads it inside ``DiffusionPipeline.from_pretrained`` for
+``DeepFloyd/IF-I-XL-v1.0``, swarm/diffusion/diffusion_func_if.py:16-19 —
+prompt embeds are computed once and shared across all three cascade
+stages, :45-61). This module reproduces the real T5 encoder architecture
+so transformers ``T5EncoderModel`` checkpoints convert directly:
+
+- RMSNorm (no mean subtraction, no bias), pre-norm residual blocks
+- relative position bias (bucketed, bidirectional) owned by block 0 and
+  shared by all layers
+- attention without 1/sqrt(d) scaling (T5 folds it into initialization)
+- gated-GELU feed-forward (v1.1: ``wi_0`` * gelu -> ``wi_1`` product)
+
+TPU notes: static sequence length, one fused program per (batch, length)
+bucket; the encode cost is negligible next to the pixel diffusion stages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 4096        # T5-v1.1-XXL (IF's encoder)
+    d_kv: int = 64
+    d_ff: int = 10240
+    num_layers: int = 24
+    num_heads: int = 64
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    max_length: int = 77
+    layer_norm_epsilon: float = 1e-6
+    eos_token_id: int = 1
+    dtype: str = "bfloat16"
+
+
+def _rsqrt(var: jnp.ndarray, eps: float) -> jnp.ndarray:
+    return 1.0 / jnp.sqrt(var + eps)
+
+
+def relative_position_buckets(length: int, num_buckets: int,
+                              max_distance: int) -> np.ndarray:
+    """Bidirectional T5 bucket table, (length, length) int32, built on the
+    host once per compile (static shapes — no traced control flow)."""
+    context = np.arange(length)[:, None]
+    memory = np.arange(length)[None, :]
+    relative = memory - context
+    half = num_buckets // 2
+    bucket = np.where(relative > 0, half, 0)
+    rel = np.abs(relative)
+    max_exact = half // 2
+    is_small = rel < max_exact
+    log_ratio = np.log(np.maximum(rel, 1) / max_exact) / np.log(
+        max_distance / max_exact)
+    large = max_exact + (log_ratio * (half - max_exact)).astype(np.int64)
+    large = np.minimum(large, half - 1)
+    bucket = bucket + np.where(is_small, rel, large)
+    return bucket.astype(np.int32)
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 position_bias: jnp.ndarray | None) -> tuple[jnp.ndarray,
+                                                             jnp.ndarray]:
+        cfg = self.config
+        inner = cfg.num_heads * cfg.d_kv
+        b, l, _ = x.shape
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="k")(x)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="v")(x)
+        q = q.reshape(b, l, cfg.num_heads, cfg.d_kv).transpose(0, 2, 1, 3)
+        k = k.reshape(b, l, cfg.num_heads, cfg.d_kv).transpose(0, 2, 1, 3)
+        v = v.reshape(b, l, cfg.num_heads, cfg.d_kv).transpose(0, 2, 1, 3)
+
+        if self.has_relative_bias:
+            buckets = relative_position_buckets(
+                l, cfg.relative_attention_num_buckets,
+                cfg.relative_attention_max_distance)
+            table = self.param(
+                "relative_attention_bias",
+                nn.initializers.normal(1.0),
+                (cfg.relative_attention_num_buckets, cfg.num_heads),
+            )
+            # (L, L, H) -> (1, H, L, L)
+            position_bias = table[buckets].transpose(2, 0, 1)[None]
+
+        # T5: NO 1/sqrt(d) scaling
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32))
+        if position_bias is not None:
+            scores = scores + position_bias.astype(jnp.float32)
+        weights = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        out = out.transpose(0, 2, 1, 3).reshape(b, l, inner)
+        return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype,
+                        name="o")(out), position_bias
+
+
+class T5Block(nn.Module):
+    config: T5Config
+    has_relative_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 position_bias: jnp.ndarray | None) -> tuple[jnp.ndarray,
+                                                             jnp.ndarray]:
+        cfg = self.config
+        h = T5LayerNorm(cfg.layer_norm_epsilon, name="attn_norm")(x)
+        attn, position_bias = T5Attention(
+            cfg, self.has_relative_bias, self.dtype, name="attention"
+        )(h, position_bias)
+        x = x + attn
+        h = T5LayerNorm(cfg.layer_norm_epsilon, name="ff_norm")(x)
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype,
+                        name="wi_0")(h)
+        lin = nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype,
+                       name="wi_1")(h)
+        h = nn.gelu(gate, approximate=True) * lin
+        x = x + nn.Dense(cfg.d_model, use_bias=False, dtype=self.dtype,
+                         name="wo")(h)
+        return x, position_bias
+
+
+class T5LayerNorm(nn.Module):
+    """T5's RMSNorm: no mean subtraction, no bias, fp32 accumulation."""
+
+    epsilon: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        return (x32 * _rsqrt(var, self.epsilon) * scale).astype(dtype)
+
+
+class T5Encoder(nn.Module):
+    """(B, L) int32 token ids -> (B, L, d_model) float sequence."""
+
+    config: T5Config
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model,
+                       dtype=self.dtype, name="token_embedding")
+        x = emb(input_ids)
+        position_bias = None
+        for i in range(cfg.num_layers):
+            x, position_bias = T5Block(
+                cfg, has_relative_bias=(i == 0), dtype=self.dtype,
+                name=f"block_{i}",
+            )(x, position_bias)
+        return T5LayerNorm(cfg.layer_norm_epsilon,
+                           name="final_layer_norm")(x).astype(jnp.float32)

@@ -145,13 +145,51 @@ class HashTokenizer:
         return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
 
 
+class HFTokenizer:
+    """Wrapper over a serialized HuggingFace ``tokenizer.json`` (the fast-
+    tokenizer format T5/DeepFloyd snapshots ship instead of CLIP's
+    vocab.json+merges.txt). Pads/truncates to a static length so token ids
+    stay shape-stable for the jitted encoders."""
+
+    def __init__(self, tokenizer_file: str | Path, max_length: int = 77,
+                 pad_id: int = 0) -> None:
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(str(tokenizer_file))
+        self.max_length = max_length
+        self.pad_id = pad_id
+
+    def encode(self, text: str) -> list[int]:
+        ids = self._tok.encode(text).ids[: self.max_length]
+        ids += [self.pad_id] * (self.max_length - len(ids))
+        return ids
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
+
+
 def load_tokenizer(checkpoint_dir: str | Path | None, vocab_size: int = 49408,
                    eos_id: int = 49407, max_length: int = 77) -> Tokenizer:
-    """ClipBpeTokenizer when vocab files exist locally, else HashTokenizer."""
+    """ClipBpeTokenizer when CLIP vocab files exist locally, then a
+    serialized ``tokenizer.json`` (T5/sentencepiece-family snapshots), else
+    HashTokenizer. Falling back on a REAL checkpoint is loud: hash-bucketed
+    ids next to converted weights would silently condition on noise."""
     if checkpoint_dir is not None:
         path = Path(checkpoint_dir)
         for sub in ("", "tokenizer"):
             cand = path / sub if sub else path
             if (cand / "vocab.json").exists() and (cand / "merges.txt").exists():
                 return ClipBpeTokenizer.from_dir(cand, max_length)
+        for sub in ("", "tokenizer"):
+            cand = (path / sub if sub else path) / "tokenizer.json"
+            if cand.exists():
+                return HFTokenizer(cand, max_length)
+        if path.exists():
+            import logging
+
+            logging.getLogger("chiaswarm.tokenizer").warning(
+                "checkpoint %s has no recognized tokenizer files "
+                "(vocab.json+merges.txt or tokenizer.json); falling back to "
+                "HashTokenizer — generations will NOT match the reference "
+                "model", path)
     return HashTokenizer(vocab_size, max_length, eos_id)

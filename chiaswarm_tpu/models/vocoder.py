@@ -1,0 +1,94 @@
+"""HiFiGAN vocoder (Flax): mel spectrogram -> waveform.
+
+The final stage of the txt2audio path (AudioLDM-class models, parity with
+swarm/audio/audioldm.py:12-36 where the vocoder runs inside the diffusers
+``AudioLDMPipeline``). Mirrors transformers' ``SpeechT5HifiGan``: conv_pre
+-> N x (transposed-conv upsample + averaged multi-kernel dilated residual
+blocks) -> conv_post -> tanh. Weight-norm is folded into plain kernels at
+conversion time (convert/torch_to_flax.py), so inference is pure convs —
+one fused XLA program, MXU-friendly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class HifiGanConfig:
+    model_in_dim: int = 64              # mel bins
+    upsample_initial_channel: int = 512
+    upsample_rates: tuple[int, ...] = (4, 4, 4, 4)
+    upsample_kernel_sizes: tuple[int, ...] = (8, 8, 8, 8)
+    resblock_kernel_sizes: tuple[int, ...] = (3, 7, 11)
+    resblock_dilation_sizes: tuple[tuple[int, ...], ...] = (
+        (1, 3, 5), (1, 3, 5), (1, 3, 5))
+    sampling_rate: int = 16000
+    leaky_relu_slope: float = 0.1
+    dtype: str = "float32"
+
+    @property
+    def hop_length(self) -> int:
+        hop = 1
+        for r in self.upsample_rates:
+            hop *= r
+        return hop
+
+
+class ResBlock(nn.Module):
+    channels: int
+    kernel_size: int
+    dilations: tuple[int, ...]
+    slope: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i, d in enumerate(self.dilations):
+            h = nn.leaky_relu(x, self.slope)
+            h = nn.Conv(self.channels, (self.kernel_size,),
+                        kernel_dilation=(d,), padding="SAME",
+                        dtype=self.dtype, name=f"convs1_{i}")(h)
+            h = nn.leaky_relu(h, self.slope)
+            h = nn.Conv(self.channels, (self.kernel_size,), padding="SAME",
+                        dtype=self.dtype, name=f"convs2_{i}")(h)
+            x = x + h
+        return x
+
+
+class HifiGan(nn.Module):
+    """(B, T, mel_bins) -> (B, T * hop_length) float waveform in [-1, 1]."""
+
+    config: HifiGanConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(self, mel: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.dtype
+        x = nn.Conv(cfg.upsample_initial_channel, (7,), padding="SAME",
+                    dtype=dtype, name="conv_pre")(mel.astype(dtype))
+        for i, (rate, kernel) in enumerate(
+                zip(cfg.upsample_rates, cfg.upsample_kernel_sizes)):
+            ch = cfg.upsample_initial_channel // (2 ** (i + 1))
+            x = nn.leaky_relu(x, cfg.leaky_relu_slope)
+            x = nn.ConvTranspose(ch, (kernel,), strides=(rate,),
+                                 padding="SAME", dtype=dtype,
+                                 name=f"upsampler_{i}")(x)
+            acc = None
+            for j, (ks, dil) in enumerate(zip(cfg.resblock_kernel_sizes,
+                                              cfg.resblock_dilation_sizes)):
+                r = ResBlock(ch, ks, dil, cfg.leaky_relu_slope, dtype,
+                             name=f"resblocks_{i}_{j}")(x)
+                acc = r if acc is None else acc + r
+            x = acc / len(cfg.resblock_kernel_sizes)
+        x = nn.leaky_relu(x, cfg.leaky_relu_slope)
+        x = nn.Conv(1, (7,), padding="SAME", dtype=dtype,
+                    name="conv_post")(x)
+        return jnp.tanh(x)[..., 0].astype(jnp.float32)

@@ -82,19 +82,101 @@ class ModelRegistry:
             f"(no checkpoint at {ckpt}); run `swarm-tpu init` to fetch it"
         )
 
-    def pipeline(self, model_name: str) -> DiffusionPipeline:
+    def pipeline(self, model_name: str):
         """Resident pipeline (components + params + compiled executables),
         one LRU entry under the HBM byte budget: evicting the entry drops
-        the only strong reference to the param tree."""
+        the only strong reference to the param tree. The pipeline class is
+        selected by the family's ``kind`` ("sd" -> DiffusionPipeline,
+        "upscaler" -> LatentUpscalePipeline)."""
+
+        def build():
+            components = self._load_components(model_name)
+            if components.family.kind == "upscaler":
+                from chiaswarm_tpu.pipelines.upscale import (
+                    LatentUpscalePipeline,
+                )
+
+                return LatentUpscalePipeline(components,
+                                             attn_impl=self.attn_impl)
+            return DiffusionPipeline(components, attn_impl=self.attn_impl)
+
         return GLOBAL_CACHE.cached_params(
-            ("pipeline", model_name),
-            lambda: DiffusionPipeline(self._load_components(model_name),
-                                      attn_impl=self.attn_impl),
+            ("pipeline", model_name), build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
     def components(self, model_name: str) -> Components:
         return self.pipeline(model_name).c
+
+    def cascade_pipeline(self, model_name: str):
+        """Resident IF-class cascade (pipelines/cascade.py) — the
+        ``DeepFloyd/`` dispatch target (swarm/job_arguments.py:39-40)."""
+        from chiaswarm_tpu.pipelines.cascade import (
+            CascadeComponents,
+            CascadePipeline,
+            get_cascade_family,
+        )
+
+        def build():
+            ckpt = model_dir(model_name)
+            family = get_cascade_family(model_name)
+            if ckpt.exists():
+                from chiaswarm_tpu.convert.torch_to_flax import (
+                    load_cascade_checkpoint,
+                )
+
+                log.info("loading cascade %s from %s", model_name, ckpt)
+                return CascadePipeline(
+                    load_cascade_checkpoint(ckpt, model_name, family))
+            if self.allow_random:
+                log.warning("no checkpoint for cascade %s; using random "
+                            "weights", model_name)
+                return CascadePipeline(CascadeComponents.random(
+                    family, model_name=model_name))
+            raise ValueError(
+                f"cascade model {model_name!r} is not available on this "
+                f"node (no checkpoint at {ckpt})"
+            )
+
+        return GLOBAL_CACHE.cached_params(
+            ("cascade", model_name), build,
+            size_of=lambda pipe: pipe.c.param_bytes(),
+        )
+
+    def audio_pipeline(self, model_name: str):
+        """Resident AudioLDM-class txt2audio pipeline
+        (swarm/audio/audioldm.py:12-36 parity, pipelines/audio.py)."""
+        from chiaswarm_tpu.pipelines.audio import (
+            AudioComponents,
+            AudioPipeline,
+            get_audio_family,
+        )
+
+        def build():
+            ckpt = model_dir(model_name)
+            family = get_audio_family(model_name)
+            if ckpt.exists():
+                from chiaswarm_tpu.convert.torch_to_flax import (
+                    load_audio_checkpoint,
+                )
+
+                log.info("loading audio model %s from %s", model_name, ckpt)
+                return AudioPipeline(
+                    load_audio_checkpoint(ckpt, model_name, family))
+            if self.allow_random:
+                log.warning("no checkpoint for audio model %s; using random "
+                            "weights", model_name)
+                return AudioPipeline(AudioComponents.random(
+                    family, model_name=model_name))
+            raise ValueError(
+                f"audio model {model_name!r} is not available on this node "
+                f"(no checkpoint at {ckpt})"
+            )
+
+        return GLOBAL_CACHE.cached_params(
+            ("audio", model_name), build,
+            size_of=lambda pipe: pipe.c.param_bytes(),
+        )
 
     def controlnet(self, controlnet_name: str, family: ModelFamily):
         """Resident ControlNetBundle (the per-job ControlNetModel load of

@@ -34,6 +34,7 @@ from chiaswarm_tpu.core.compile_cache import (
     GLOBAL_CACHE,
     bucket_batch,
     bucket_image_size,
+    static_cache_key,
 )
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.vae import AutoencoderKL
@@ -262,13 +263,9 @@ class DiffusionPipeline:
         return jax.jit(fn)
 
     def _get_fn(self, **static: Any):
-        key = (id(self.c), tuple(sorted(
-            (k, v if not dataclasses.is_dataclass(v) else
-             tuple(dataclasses.asdict(v).items()))
-            for k, v in static.items()
-        )))
         return GLOBAL_CACHE.cached_executable(
-            key, lambda: self._build_fn(**static)
+            static_cache_key(id(self.c), "generate", static),
+            lambda: self._build_fn(**static)
         )
 
     # ---------- public API ----------

@@ -1,14 +1,11 @@
 """Audio workloads: txt2audio (AudioLDM-class) and TTS (bark-class).
 
 Reference capabilities: swarm/audio/audioldm.py:12-36 (AudioLDM pipeline,
-wav 16 kHz -> mp3) and swarm/audio/bark.py:11-38 (suno-bark TTS). The Flax
-audio-latent-diffusion family is not in the model zoo yet; these callbacks
-declare the capability seam (dispatched from node/job_args.py) and fail
-fatally so the hive stops routing audio jobs to this node.
-
-When the models land: output is WAV via the stdlib ``wave`` module (this
-image has no ffmpeg, so mp3 transcode is gated off — content negotiation
-reports audio/wav).
+default 20 steps / 10 s of 16 kHz audio) and swarm/audio/bark.py:11-38
+(suno-bark TTS). txt2audio runs the jitted mel-latent diffusion + HiFiGAN
+pipeline (pipelines/audio.py); output is WAV via the stdlib ``wave``
+module (this image has no ffmpeg, so the reference's wav -> mp3 transcode,
+audioldm.py:23-33, is gated off — content negotiation reports audio/wav).
 """
 
 from __future__ import annotations
@@ -40,11 +37,38 @@ def audio_artifact(samples: np.ndarray, sample_rate: int = 16000) -> dict:
 
 
 def txt2audio_callback(slot, model_name: str, *, seed: int,
-                       **kwargs: Any):
-    raise ValueError(
-        f"txt2audio is not yet supported by this TPU worker "
-        f"(requested model {model_name!r})"
+                       registry=None,
+                       prompt: str = "",
+                       negative_prompt: str = "",
+                       num_inference_steps: int = 20,
+                       guidance_scale: float = 2.5,
+                       audio_length_in_s: float = 10.0,
+                       scheduler_type: str | None = None,
+                       **_ignored: Any):
+    """AudioLDM-class txt2audio (swarm/audio/audioldm.py:12-36: default 20
+    steps, 10 s). Emits an audio/wav artifact."""
+    import time
+
+    if registry is None:
+        raise ValueError("txt2audio requires the model registry")
+    pipe = registry.audio_pipeline(model_name)
+    t0 = time.perf_counter()
+    wav, sr, config = pipe(
+        prompt=prompt or "",
+        negative_prompt=negative_prompt or "",
+        steps=int(num_inference_steps),
+        guidance_scale=float(guidance_scale),
+        duration_s=float(audio_length_in_s),
+        seed=seed,
+        scheduler=scheduler_type,
     )
+    elapsed = time.perf_counter() - t0
+    config.update({
+        "nsfw": False,
+        "generation_s": round(elapsed, 3),
+        "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
+    })
+    return {"primary": audio_artifact(wav[0], sr)}, config
 
 
 def tts_callback(slot, model_name: str, *, seed: int, **kwargs: Any):

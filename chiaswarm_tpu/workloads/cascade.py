@@ -1,21 +1,67 @@
-"""Cascaded pixel-space diffusion (DeepFloyd-IF-class models).
+"""Cascaded pixel-space diffusion workload (DeepFloyd-IF-class models).
 
-Reference capability: swarm/diffusion/diffusion_func_if.py:14-92 — a
-three-stage cascade (64px base -> 256px super-res -> 1024px upscale) with
-prompt embeds shared across stages. The TPU design runs each stage as its
-own jitted program over the same mesh, with the text encoder (T5-class)
-evaluated once. The pixel-space UNet family is not in the model zoo yet;
-this callback declares the dispatch seam (node/job_args.py routes
-``DeepFloyd/`` model names here) and fails fatally until it lands.
+Capability parity with swarm/diffusion/diffusion_func_if.py:14-92 — the
+``DeepFloyd/`` model-name prefix routes here (swarm/job_arguments.py:39-40).
+Three stages: 64px T5-conditioned base -> 256px super-res (prompt embeds
+shared, :45-61) -> upscale toward 1024px (:31-40; here two x2 latent-
+upscaler passes instead of the reference's SD-x4-upscaler). The whole
+cascade runs as jitted programs on the chip (pipelines/cascade.py).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
+from chiaswarm_tpu.node.output_processor import OutputProcessor
 
-def cascade_callback(slot, model_name: str, *, seed: int, **kwargs: Any):
-    raise ValueError(
-        f"cascaded pixel-space diffusion is not yet supported by this TPU "
-        f"worker (requested model {model_name!r})"
+
+def cascade_callback(slot, model_name: str, *, seed: int,
+                     registry,
+                     prompt: str = "",
+                     negative_prompt: str = "",
+                     num_inference_steps: int = 50,
+                     sr_steps: int = 30,
+                     guidance_scale: float = 7.0,
+                     num_images_per_prompt: int = 1,
+                     scheduler_type: str | None = None,
+                     content_type: str = "image/png",
+                     upscale: bool = True,
+                     upscaler_model_name: str = (
+                         "stabilityai/sd-x2-latent-upscaler"),
+                     **_ignored: Any):
+    pipe = registry.cascade_pipeline(model_name)
+
+    t0 = time.perf_counter()
+    images, config = pipe(
+        prompt=prompt or "",
+        negative_prompt=negative_prompt or "",
+        steps=int(num_inference_steps),
+        sr_steps=int(sr_steps),
+        guidance_scale=float(guidance_scale),
+        batch=max(1, int(num_images_per_prompt)),
+        seed=seed,
+        scheduler=scheduler_type,
     )
+    if upscale:
+        # stage 3: two x2 latent-upscale passes (256 -> 512 -> 1024),
+        # replacing diffusion_func_if.py:31-40's SD-x4-upscaler stage
+        upscaler = registry.pipeline(upscaler_model_name)
+        for _ in range(2):
+            images, up_config = upscaler(images, prompt=prompt or "",
+                                         seed=seed)
+        config.update(up_config)
+        config["upscaled_to"] = list(images.shape[1:3])
+    elapsed = time.perf_counter() - t0
+
+    proc = OutputProcessor(content_type)
+    proc.add_images(images)
+    artifacts = proc.get_results()
+
+    config.update({
+        "nsfw": False,
+        "images_per_sec": round(images.shape[0] / max(elapsed, 1e-9), 4),
+        "generation_s": round(elapsed, 3),
+        "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
+    })
+    return artifacts, config

@@ -37,6 +37,8 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        scheduler_type: str | None = None,
                        content_type: str = "image/png",
                        upscale: bool = False,
+                       upscaler_model_name: str = (
+                           "stabilityai/sd-x2-latent-upscaler"),
                        controlnet_model_name: str | None = None,
                        controlnet_scale: float = 1.0,
                        save_preprocessed_input: bool = False,
@@ -44,6 +46,11 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        **_ignored: Any):
     pipe = registry.pipeline(model_name)
     fam = pipe.c.family
+    if fam.kind != "sd":
+        raise ValueError(
+            f"model {model_name!r} is a {fam.kind} model, not a generation "
+            f"pipeline; upscalers run via the server's 'upscale' parameter"
+        )
 
     if image is not None:
         height, width = image.shape[:2]
@@ -100,10 +107,11 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
     elapsed = time.perf_counter() - t0
 
     if upscale:
-        # the reference runs sd-x2-latent-upscaler (swarm/diffusion/
-        # upscale.py); the jitted latent upscale pipeline lands with the
-        # cascade work — until then emit at generation size.
-        config["upscale"] = "unavailable"
+        # x2 latent upscale pass over the generated images, 20 steps at
+        # guidance 0 (swarm/diffusion/upscale.py:6-32)
+        upscaler = registry.pipeline(upscaler_model_name)
+        images, up_config = upscaler(images, prompt=prompt or "", seed=seed)
+        config.update(up_config)
 
     proc = OutputProcessor(content_type)
     proc.add_images(images)

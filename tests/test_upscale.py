@@ -1,0 +1,51 @@
+"""Latent 2x upscaler: pipeline, family routing, workload integration.
+
+Reference behaviors covered: the post-generation sd-x2-latent-upscaler pass
+at 20 steps / guidance 0 (swarm/diffusion/upscale.py:6-32) triggered by the
+server's ``upscale`` model parameter (swarm/job_arguments.py:104-110).
+"""
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.models.configs import get_family
+from chiaswarm_tpu.pipelines import Components
+from chiaswarm_tpu.pipelines.upscale import LatentUpscalePipeline
+
+
+@pytest.fixture(scope="module")
+def tiny_upscaler():
+    return LatentUpscalePipeline(Components.random("tiny_up", seed=0))
+
+
+def test_family_routing():
+    assert get_family("stabilityai/sd-x2-latent-upscaler").name == "upscaler_x2"
+    assert get_family("stabilityai/sd-x2-latent-upscaler").kind == "upscaler"
+    assert get_family("runwayml/stable-diffusion-v1-5").kind == "sd"
+
+
+def test_upscale_doubles_size(tiny_upscaler):
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+    out, config = tiny_upscaler(img, prompt="sharp photo", steps=3, seed=7)
+    assert out.shape == (1, 128, 128, 3)
+    assert out.dtype == np.uint8
+    assert config["scale"] == 2
+    # determinism per seed
+    out2, _ = tiny_upscaler(img, prompt="sharp photo", steps=3, seed=7)
+    assert np.array_equal(out, out2)
+
+
+def test_workload_upscale_flag():
+    """diffusion_callback with upscale=True emits 2x-size artifacts."""
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.workloads.diffusion import diffusion_callback
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    artifacts, config = diffusion_callback(
+        "slot0", "random/tiny", seed=3, registry=registry,
+        prompt="a pier", num_inference_steps=2, height=64, width=64,
+        upscale=True, upscaler_model_name="random/tiny_up")
+    assert "primary" in artifacts
+    assert config["scale"] == 2
+    assert config["upscaler"] == "random/tiny_up"

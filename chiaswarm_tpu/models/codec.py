@@ -1,0 +1,85 @@
+"""Neural codec decoder (Flax): RVQ code stacks -> waveform.
+
+The last stage of bark-class TTS (workloads/audio.py): the fine acoustic
+codes are EnCodec residual-vector-quantizer indices; decoding sums the
+per-codebook embeddings and runs a SEANet-style transposed-conv decoder.
+Mirrors EnCodec's 24 kHz decoder shape (ratios 8·5·4·2 -> hop 320) minus
+its LSTM block — inference here is pure convs, which XLA fuses into a
+handful of MXU-friendly kernels. Conversion from torch folds weight norm
+(convert/torch_to_flax.py:_fold_weight_norm).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecConfig:
+    n_codebooks: int = 8
+    codebook_size: int = 1024
+    codebook_dim: int = 128
+    hidden: int = 512
+    upsample_rates: tuple[int, ...] = (8, 5, 4, 2)
+    kernel_mult: int = 2              # transposed-conv kernel = 2 * rate
+    sampling_rate: int = 24000
+    dtype: str = "float32"
+
+    @property
+    def hop_length(self) -> int:
+        hop = 1
+        for r in self.upsample_rates:
+            hop *= r
+        return hop
+
+
+class DecoderResBlock(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.elu(x)
+        h = nn.Conv(self.channels // 2, (3,), padding="SAME",
+                    dtype=self.dtype, name="conv1")(h)
+        h = nn.elu(h)
+        h = nn.Conv(self.channels, (1,), dtype=self.dtype, name="conv2")(h)
+        return x + h
+
+
+class CodecDecoder(nn.Module):
+    """(B, n_codebooks, T) int codes -> (B, T * hop_length) waveform."""
+
+    config: CodecConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(self, codes: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.dtype
+        # RVQ: the quantized latent is the SUM of per-codebook embeddings
+        quantized = 0.0
+        for k in range(cfg.n_codebooks):
+            quantized = quantized + nn.Embed(
+                cfg.codebook_size, cfg.codebook_dim, dtype=dtype,
+                name=f"codebook_{k}")(codes[:, k])
+        x = nn.Conv(cfg.hidden, (7,), padding="SAME", dtype=dtype,
+                    name="conv_pre")(quantized)
+        ch = cfg.hidden
+        for i, rate in enumerate(cfg.upsample_rates):
+            ch = max(ch // 2, cfg.codebook_dim // 2)
+            x = nn.elu(x)
+            x = nn.ConvTranspose(ch, (cfg.kernel_mult * rate,),
+                                 strides=(rate,), padding="SAME",
+                                 dtype=dtype, name=f"upsample_{i}")(x)
+            x = DecoderResBlock(ch, dtype, name=f"resblock_{i}")(x)
+        x = nn.elu(x)
+        x = nn.Conv(1, (7,), padding="SAME", dtype=dtype,
+                    name="conv_post")(x)
+        return jnp.tanh(x)[..., 0].astype(jnp.float32)

@@ -1,0 +1,183 @@
+"""Causal GPT (Flax) with static-shape KV-cache decoding — the
+autoregressive engine for bark-class TTS (workloads/audio.py).
+
+The reference shells out to ``suno-bark`` (swarm/audio/bark.py:15-21),
+whose three stages are all plain GPTs (text->semantic, semantic->coarse
+codec, coarse->fine codec). TPU-first design choices:
+
+- the KV cache is a fixed-size ring of arrays carried through a
+  ``lax.scan`` — one compiled program generates the whole token stream
+  (no per-token dispatch, no dynamic shapes);
+- prefill (the prompt) runs as one batched forward, then decode appends
+  one token per scan step via ``dynamic_update_slice``;
+- sampling (temperature + top-k) happens on-chip inside the scan.
+
+Bark quirk kept: separate input and output vocab sizes per stage (the
+semantic stage reads text tokens but emits semantic tokens).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 129600          # bark text stage input vocab
+    output_vocab_size: int | None = None  # None -> same as vocab_size
+    n_layer: int = 12
+    n_head: int = 12
+    n_embd: int = 768
+    block_size: int = 1024            # max sequence length (cache size)
+    dtype: str = "float32"
+
+    @property
+    def out_vocab(self) -> int:
+        return self.output_vocab_size or self.vocab_size
+
+
+class Block(nn.Module):
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, cache_k, cache_v, index, valid_len):
+        """x: (B, T, C) new tokens at positions [index, index+T).
+        cache_k/v: (B, block_size, H, D) rings. Returns (y, k, v)."""
+        cfg = self.config
+        head_dim = cfg.n_embd // cfg.n_head
+        b, t, _ = x.shape
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(self.dtype)
+        qkv = nn.Dense(3 * cfg.n_embd, use_bias=False, dtype=self.dtype,
+                       name="attn_qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, cfg.n_head, head_dim)
+        k = k.reshape(b, t, cfg.n_head, head_dim)
+        v = v.reshape(b, t, cfg.n_head, head_dim)
+
+        cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, index, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, index, 0, 0))
+
+        # causal mask over the ring: key j visible to query i (absolute
+        # position index+i) iff j <= index+i and j < valid_len
+        kpos = jnp.arange(cfg.block_size)
+        qpos = index + jnp.arange(t)
+        mask = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < valid_len)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            cache_k.astype(jnp.float32))
+        scores = scores / jnp.sqrt(head_dim).astype(jnp.float32)
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        weights = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, cache_v)
+        out = out.reshape(b, t, cfg.n_embd)
+        x = x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
+                         name="attn_proj")(out)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
+        h = nn.Dense(4 * cfg.n_embd, use_bias=False, dtype=self.dtype,
+                     name="mlp_fc")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
+                         name="mlp_proj")(h)
+        return x, cache_k, cache_v
+
+
+class GPT(nn.Module):
+    """Forward over new tokens given a KV-cache ring; returns logits over
+    the OUTPUT vocab plus updated caches."""
+
+    config: GPTConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(self, ids, caches, index, valid_len):
+        """ids: (B, T) int32; caches: per-layer (k, v) tuple list;
+        index: scalar position of ids[0]; valid_len: scalar count of
+        valid cache positions after this call."""
+        cfg = self.config
+        b, t = ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=self.dtype,
+                       name="wte")(ids)
+        pos_table = self.param(
+            "wpe", nn.initializers.normal(0.02),
+            (cfg.block_size, cfg.n_embd))
+        pos = jax.lax.dynamic_slice(pos_table, (index, 0), (t, cfg.n_embd))
+        x = tok + pos[None].astype(self.dtype)
+
+        new_caches = []
+        for i in range(cfg.n_layer):
+            ck, cv = caches[i]
+            x, ck, cv = Block(cfg, self.dtype, name=f"h_{i}")(
+                x, ck, cv, index, valid_len)
+            new_caches.append((ck, cv))
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.out_vocab, use_bias=False, dtype=jnp.float32,
+                          name="lm_head")(x)
+        return logits, new_caches
+
+
+def init_caches(cfg: GPTConfig, batch: int) -> list[tuple[jnp.ndarray,
+                                                          jnp.ndarray]]:
+    head_dim = cfg.n_embd // cfg.n_head
+    shape = (batch, cfg.block_size, cfg.n_head, head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.n_layer)]
+
+
+def sample_token(key, logits, temperature: float, top_k: int):
+    """(B, V) logits -> (B,) sampled ids, on-chip top-k + temperature."""
+    logits = logits / jnp.maximum(temperature, 1e-5)
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("gpt", "max_new", "top_k", "prefill_len"))
+def generate(gpt: GPT, params: Any, prompt_ids: jnp.ndarray,
+             key: jax.Array, *, prefill_len: int, max_new: int,
+             temperature: float = 0.7, top_k: int = 50,
+             eos_id: int = -1) -> jnp.ndarray:
+    """Prefill + scan-decode ``max_new`` tokens. ``prompt_ids`` is
+    (B, prefill_len) (pad/truncate on host). Returns (B, max_new) int32;
+    positions after EOS repeat EOS (trim on host). ``temperature`` is a
+    TRACED operand (changing it never recompiles); ``top_k`` must stay
+    static for ``lax.top_k``."""
+    cfg = gpt.config
+    b = prompt_ids.shape[0]
+    caches = init_caches(cfg, b)
+    logits, caches = gpt.apply(params, prompt_ids, caches, 0,
+                               jnp.int32(prefill_len))
+    key, skey = jax.random.split(key)
+    first = sample_token(skey, logits[:, -1], temperature, top_k)
+
+    def body(carry, _):
+        caches, tok, idx, key, done = carry
+        logits, caches = gpt.apply(params, tok[:, None], caches, idx,
+                                   idx + 1)
+        key, skey = jax.random.split(key)
+        nxt = sample_token(skey, logits[:, 0], temperature, top_k)
+        nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+        done = done | (nxt == eos_id)
+        return (caches, nxt, idx + 1, key, done), nxt
+
+    done0 = first == eos_id
+    (_, _, _, _, _), toks = jax.lax.scan(
+        body, (caches, first, jnp.int32(prefill_len), key, done0),
+        None, length=max_new - 1)
+    return jnp.concatenate([first[:, None], toks.swapaxes(0, 1)], axis=1)

@@ -178,6 +178,31 @@ class ModelRegistry:
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
+    def tts_pipeline(self, model_name: str):
+        """Resident bark-class TTS pipeline (swarm/audio/bark.py:11-38
+        parity, pipelines/tts.py). No torch checkpoint converter yet —
+        random weights only (gated behind allow_random)."""
+        from chiaswarm_tpu.pipelines.tts import (
+            TTSComponents,
+            TTSPipeline,
+            get_tts_family,
+        )
+
+        def build():
+            family = get_tts_family(model_name)
+            if self.allow_random:
+                log.warning("tts model %s: using random weights", model_name)
+                return TTSPipeline(TTSComponents.random(
+                    family, model_name=model_name))
+            raise ValueError(
+                f"tts model {model_name!r} is not available on this node"
+            )
+
+        return GLOBAL_CACHE.cached_params(
+            ("tts", model_name), build,
+            size_of=lambda pipe: pipe.c.param_bytes(),
+        )
+
     def controlnet(self, controlnet_name: str, family: ModelFamily):
         """Resident ControlNetBundle (the per-job ControlNetModel load of
         swarm/diffusion/diffusion_func.py:29-34, made resident + LRU'd)."""

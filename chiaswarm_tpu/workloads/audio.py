@@ -36,6 +36,20 @@ def audio_artifact(samples: np.ndarray, sample_rate: int = 16000) -> dict:
     return make_result(pcm16_wav(samples, sample_rate), "audio/wav")
 
 
+def _finalize_audio(slot, t0: float, wav: np.ndarray, sr: int,
+                    config: dict) -> tuple[dict, dict]:
+    """Shared trailer for every audio workload: timing + slot metadata +
+    the WAV artifact envelope."""
+    import time
+
+    config.update({
+        "nsfw": False,
+        "generation_s": round(time.perf_counter() - t0, 3),
+        "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
+    })
+    return {"primary": audio_artifact(wav[0], sr)}, config
+
+
 def txt2audio_callback(slot, model_name: str, *, seed: int,
                        registry=None,
                        prompt: str = "",
@@ -62,17 +76,33 @@ def txt2audio_callback(slot, model_name: str, *, seed: int,
         seed=seed,
         scheduler=scheduler_type,
     )
-    elapsed = time.perf_counter() - t0
-    config.update({
-        "nsfw": False,
-        "generation_s": round(elapsed, 3),
-        "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
-    })
-    return {"primary": audio_artifact(wav[0], sr)}, config
+    return _finalize_audio(slot, t0, wav, sr, config)
 
 
-def tts_callback(slot, model_name: str, *, seed: int, **kwargs: Any):
-    raise ValueError(
-        f"text-to-speech is not yet supported by this TPU worker "
-        f"(requested model {model_name!r})"
+def tts_callback(slot, model_name: str, *, seed: int,
+                 registry=None,
+                 prompt: str = "",
+                 audio_length_in_s: float = 4.0,
+                 temperature: float = 0.7,
+                 voice_preset_tokens: list[int] | None = None,
+                 parameters: dict | None = None,
+                 **_ignored: Any):
+    """Bark-class TTS (swarm/audio/bark.py:11-38: generate_audio + wav
+    emit). Three GPT stages + codec decode, all on-chip
+    (pipelines/tts.py)."""
+    import time
+
+    if registry is None:
+        raise ValueError("tts requires the model registry")
+    parameters = parameters or {}
+    pipe = registry.tts_pipeline(model_name)
+    t0 = time.perf_counter()
+    wav, sr, config = pipe(
+        prompt or "",
+        duration_s=float(audio_length_in_s),
+        seed=seed,
+        temperature=float(temperature),
+        voice_preset_tokens=(voice_preset_tokens
+                             or parameters.get("voice_preset_tokens")),
     )
+    return _finalize_audio(slot, t0, wav, sr, config)

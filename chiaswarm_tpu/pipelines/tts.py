@@ -216,6 +216,12 @@ class TTSPipeline:
 
         # pad/trim fine frames to the coarse frame count, stack all books
         if ff < frames:
+            import logging
+
+            logging.getLogger("chiaswarm.tts").warning(
+                "fine stage delivered %d/%d frames (block_size=%d); the "
+                "tail of the non-coarse codebooks is zero-padded",
+                ff, frames, fam.fine.block_size)
             fine_codes = jnp.pad(fine_codes, ((0, 0), (0, 0),
                                               (0, frames - ff)))
         codes = jnp.concatenate([coarse_codes, fine_codes[:, :, :frames]],

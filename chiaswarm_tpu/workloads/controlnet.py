@@ -7,10 +7,15 @@ PIL) by design — the reference keeps them off-GPU and we keep them off-TPU
 (SURVEY.md §2: "keep on CPU (host) — not TPU work").
 
 Implemented without auxiliary torch models (this image has no
-controlnet_aux): canny (cv2.Canny), tile (64-multiple resize), pix2pix
-(passthrough), scribble/softedge (Scharr-gradient sketch — a model-free
-stand-in for HED/PidiNet), shuffle (content shuffle), depth/normal/seg/
-mlsd/lineart/openpose raise until their Flax estimator models land.
+controlnet_aux). Exact ports: canny (cv2.Canny), tile (64-multiple
+resize), pix2pix (passthrough), shuffle (content shuffle). Model-free
+stand-ins for the learned detectors (documented per function): scribble/
+softedge (Scharr sketch ~ HED/PidiNet), mlsd (probabilistic Hough line
+segments), lineart (dodge-sketch line extraction), depth (defocus +
+position-prior pseudo-depth ~ MiDaS), normalbae (normals from the
+pseudo-depth), seg (mean-shift posterization onto the ADE20K palette the
+reference carries at input_processor.py:118-272). openpose raises — a
+skeleton detector cannot be approximated without weights.
 """
 
 from __future__ import annotations
@@ -85,9 +90,115 @@ def image_shuffle(image: Image.Image) -> Image.Image:
     return Image.fromarray(out)
 
 
+@_register("mlsd")
+def image_to_line_segments(image: Image.Image) -> Image.Image:
+    """Model-free M-LSD stand-in: probabilistic Hough segments over Canny
+    edges, drawn white-on-black (the wireframe conditioning format)."""
+    import cv2
+
+    arr = np.asarray(image)
+    gray = cv2.cvtColor(arr, cv2.COLOR_RGB2GRAY)
+    edges = cv2.Canny(gray, 50, 150)
+    lines = cv2.HoughLinesP(edges, 1, np.pi / 180, threshold=40,
+                            minLineLength=24, maxLineGap=4)
+    out = np.zeros_like(arr)
+    if lines is not None:
+        for x1, y1, x2, y2 in np.asarray(lines).reshape(-1, 4):
+            cv2.line(out, (x1, y1), (x2, y2), (255, 255, 255), 2)
+    return Image.fromarray(out)
+
+
+@_register("lineart")
+def image_to_lineart(image: Image.Image) -> Image.Image:
+    """Model-free lineart stand-in: dodge-blend sketch (gray / blurred-gray)
+    inverted to white lines on black, the LineartDetector output format."""
+    import cv2
+
+    gray = cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2GRAY)
+    blur = cv2.GaussianBlur(gray, (21, 21), 0)
+    sketch = cv2.divide(gray, np.maximum(blur, 1), scale=256)
+    lines = 255 - sketch  # dark strokes -> bright lines
+    lines = cv2.normalize(lines, None, 0, 255, cv2.NORM_MINMAX)
+    return Image.fromarray(np.stack([lines.astype(np.uint8)] * 3, axis=-1))
+
+
+def _pseudo_depth(arr: np.ndarray) -> np.ndarray:
+    """Model-free MiDaS stand-in: vertical position prior (lower in frame ~
+    nearer) blended with local sharpness (in-focus ~ nearer). float [0,1],
+    1 = near."""
+    import cv2
+
+    gray = cv2.cvtColor(arr, cv2.COLOR_RGB2GRAY).astype(np.float32) / 255.0
+    h, w = gray.shape
+    position = np.linspace(0.0, 1.0, h)[:, None].repeat(w, axis=1)
+    lap = np.abs(cv2.Laplacian(gray, cv2.CV_32F, ksize=5))
+    sharp = cv2.GaussianBlur(lap, (0, 0), sigmaX=max(h, w) / 32.0)
+    sharp = sharp / max(float(sharp.max()), 1e-6)
+    depth = (0.6 * position + 0.4 * sharp).astype(np.float32)
+    return cv2.GaussianBlur(depth, (0, 0), sigmaX=3.0)
+
+
+@_register("depth")
+def image_to_depth(image: Image.Image) -> Image.Image:
+    depth = _pseudo_depth(np.asarray(image))
+    u8 = (depth * 255.0).clip(0, 255).astype(np.uint8)
+    return Image.fromarray(np.stack([u8] * 3, axis=-1))
+
+
+@_register("normal")
+@_register("normalbae")
+def image_to_normal(image: Image.Image) -> Image.Image:
+    """Surface normals from the pseudo-depth via Sobel gradients, encoded
+    in the usual RGB = (x, y, z) * 0.5 + 0.5 convention."""
+    import cv2
+
+    depth = _pseudo_depth(np.asarray(image))
+    dx = cv2.Sobel(depth, cv2.CV_32F, 1, 0, ksize=5)
+    dy = cv2.Sobel(depth, cv2.CV_32F, 0, 1, ksize=5)
+    z = np.full_like(depth, 0.1)
+    norm = np.sqrt(dx * dx + dy * dy + z * z)
+    n = np.stack([-dx / norm, -dy / norm, z / norm], axis=-1)
+    return Image.fromarray(((n * 0.5 + 0.5) * 255).clip(0, 255)
+                           .astype(np.uint8))
+
+
+# ADE20K-style palette (first 32 of the 150 colors the reference embeds at
+# input_processor.py:118-272 — enough distinct classes for a stand-in).
+_ADE_PALETTE = np.asarray([
+    [120, 120, 120], [180, 120, 120], [6, 230, 230], [80, 50, 50],
+    [4, 200, 3], [120, 120, 80], [140, 140, 140], [204, 5, 255],
+    [230, 230, 230], [4, 250, 7], [224, 5, 255], [235, 255, 7],
+    [150, 5, 61], [120, 120, 70], [8, 255, 51], [255, 6, 82],
+    [143, 255, 140], [204, 255, 4], [255, 51, 7], [204, 70, 3],
+    [0, 102, 200], [61, 230, 250], [255, 6, 51], [11, 102, 255],
+    [255, 7, 71], [255, 9, 224], [9, 7, 230], [220, 220, 220],
+    [255, 9, 92], [112, 9, 255], [8, 255, 214], [7, 255, 224],
+], dtype=np.uint8)
+
+
+@_register("seg")
+def image_to_segments(image: Image.Image) -> Image.Image:
+    """Model-free UperNet stand-in: mean-shift posterization, then each
+    region color snapped to the nearest ADE-palette entry."""
+    import cv2
+
+    arr = cv2.pyrMeanShiftFiltering(
+        cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2BGR), 12, 24)
+    arr = cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+    flat = arr.reshape(-1, 3).astype(np.float32)
+    pal = _ADE_PALETTE.astype(np.float32)
+    # ||a-b||^2 = ||a||^2 - 2 a.b + ||b||^2: peak extra memory is (N, 32)
+    # floats instead of an (N, 32, 3) difference tensor
+    dists = ((flat ** 2).sum(1, keepdims=True)
+             - 2.0 * flat @ pal.T + (pal ** 2).sum(1)[None])
+    return Image.fromarray(
+        _ADE_PALETTE[np.argmin(dists, axis=1)].reshape(arr.shape))
+
+
 def preprocess_image(image: Image.Image, controlnet: dict[str, Any]) -> Image.Image:
-    """Dispatch on controlnet["type"] (input_processor.py:17-60). Types
-    requiring learned estimators raise until those models land."""
+    """Dispatch on controlnet["type"] (input_processor.py:17-60). Every
+    mode has an exact port or a documented model-free stand-in except
+    openpose, which raises (skeletons need weights)."""
     kind = str(controlnet.get("type", "canny")).lower()
     if not controlnet.get("preprocess", True):
         return image

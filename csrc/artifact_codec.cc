@@ -1,0 +1,202 @@
+// Native artifact codec: the host-side hot path of the result envelope.
+//
+// The reference's output processing (swarm/output_processor.py:46-58,
+// 121-136) hashes, base64-encodes and PNG-encodes every generated image in
+// Python/PIL at the GPU->host boundary. On a TPU worker pushing multiple
+// images per second per chip, that Python encode path becomes the
+// serialized host bottleneck — so this framework implements it natively:
+// SHA-256, base64, box-filter thumbnailing and PNG (zlib) encoding in C++,
+// exposed through a C ABI consumed via ctypes
+// (chiaswarm_tpu/native/__init__.py) with a PIL fallback when the shared
+// object is unavailable.
+//
+// Build: g++ -O2 -shared -fPIC artifact_codec.cc -lz -o libartifact.so
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// ----------------------------------------------------------- SHA-256
+
+constexpr uint32_t kSha256K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+inline uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+void Sha256Block(const uint8_t* p, uint32_t h[8]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; ++i) {
+    w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+           (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+  }
+  for (int i = 16; i < 64; ++i) {
+    uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = hh + s1 + ch + kSha256K[i] + w[i];
+    uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+// ------------------------------------------------------------- PNG
+
+void PushU32(std::vector<uint8_t>* out, uint32_t v) {
+  out->push_back(uint8_t(v >> 24));
+  out->push_back(uint8_t(v >> 16));
+  out->push_back(uint8_t(v >> 8));
+  out->push_back(uint8_t(v));
+}
+
+void PushChunk(std::vector<uint8_t>* out, const char type[4],
+               const uint8_t* data, size_t n) {
+  PushU32(out, uint32_t(n));
+  size_t start = out->size();
+  out->insert(out->end(), type, type + 4);
+  out->insert(out->end(), data, data + n);
+  uint32_t crc = crc32(0L, Z_NULL, 0);
+  crc = crc32(crc, out->data() + start, uInt(n + 4));
+  PushU32(out, crc);
+}
+
+}  // namespace
+
+extern "C" {
+
+// 64-hex-char SHA-256 digest + NUL into out[65].
+void sha256_hex(const uint8_t* data, uint64_t n, char* out) {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t i = 0;
+  for (; i + 64 <= n; i += 64) Sha256Block(data + i, h);
+  uint8_t tail[128];
+  uint64_t rem = n - i;
+  std::memcpy(tail, data + i, rem);
+  tail[rem] = 0x80;
+  uint64_t pad = (rem < 56) ? 64 : 128;
+  std::memset(tail + rem + 1, 0, pad - rem - 1 - 8);
+  uint64_t bits = n * 8;
+  for (int b = 0; b < 8; ++b)
+    tail[pad - 1 - b] = uint8_t(bits >> (8 * b));
+  Sha256Block(tail, h);
+  if (pad == 128) Sha256Block(tail + 64, h);
+  static const char* hex = "0123456789abcdef";
+  for (int j = 0; j < 8; ++j) {
+    for (int b = 0; b < 4; ++b) {
+      uint8_t byte = uint8_t(h[j] >> (24 - 8 * b));
+      out[j * 8 + b * 2] = hex[byte >> 4];
+      out[j * 8 + b * 2 + 1] = hex[byte & 15];
+    }
+  }
+  out[64] = '\0';
+}
+
+// base64 encode; out must hold 4*((n+2)/3) bytes. Returns bytes written.
+uint64_t b64_encode(const uint8_t* data, uint64_t n, char* out) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  uint64_t o = 0, i = 0;
+  for (; i + 3 <= n; i += 3) {
+    uint32_t v = (uint32_t(data[i]) << 16) | (uint32_t(data[i + 1]) << 8) |
+                 data[i + 2];
+    out[o++] = tbl[(v >> 18) & 63];
+    out[o++] = tbl[(v >> 12) & 63];
+    out[o++] = tbl[(v >> 6) & 63];
+    out[o++] = tbl[v & 63];
+  }
+  if (i < n) {
+    uint32_t v = uint32_t(data[i]) << 16;
+    if (i + 1 < n) v |= uint32_t(data[i + 1]) << 8;
+    out[o++] = tbl[(v >> 18) & 63];
+    out[o++] = tbl[(v >> 12) & 63];
+    out[o++] = (i + 1 < n) ? tbl[(v >> 6) & 63] : '=';
+    out[o++] = '=';
+  }
+  return o;
+}
+
+// Box-filter downsample RGB8 (h, w) -> (th, tw). out holds tw*th*3.
+void thumbnail_rgb(const uint8_t* rgb, uint32_t w, uint32_t h,
+                   uint32_t tw, uint32_t th, uint8_t* out) {
+  for (uint32_t ty = 0; ty < th; ++ty) {
+    uint32_t y0 = uint64_t(ty) * h / th, y1 = uint64_t(ty + 1) * h / th;
+    if (y1 <= y0) y1 = y0 + 1;
+    for (uint32_t tx = 0; tx < tw; ++tx) {
+      uint32_t x0 = uint64_t(tx) * w / tw, x1 = uint64_t(tx + 1) * w / tw;
+      if (x1 <= x0) x1 = x0 + 1;
+      uint64_t acc[3] = {0, 0, 0};
+      for (uint32_t y = y0; y < y1; ++y)
+        for (uint32_t x = x0; x < x1; ++x)
+          for (int c = 0; c < 3; ++c)
+            acc[c] += rgb[(uint64_t(y) * w + x) * 3 + c];
+      uint64_t cnt = uint64_t(y1 - y0) * (x1 - x0);
+      for (int c = 0; c < 3; ++c)
+        out[(uint64_t(ty) * tw + tx) * 3 + c] = uint8_t(acc[c] / cnt);
+    }
+  }
+}
+
+// PNG-encode RGB8 (h, w). Writes into out (cap bytes); returns bytes
+// written, or 0 if cap is too small. Filter type 0 (None) per scanline +
+// zlib level 6 — artifact PNGs favor encode speed over ratio.
+uint64_t png_encode_rgb(const uint8_t* rgb, uint32_t w, uint32_t h,
+                        uint8_t* out, uint64_t cap) {
+  std::vector<uint8_t> raw;
+  raw.reserve(uint64_t(h) * (uint64_t(w) * 3 + 1));
+  for (uint32_t y = 0; y < h; ++y) {
+    raw.push_back(0);  // filter: None
+    const uint8_t* row = rgb + uint64_t(y) * w * 3;
+    raw.insert(raw.end(), row, row + uint64_t(w) * 3);
+  }
+  uLongf zcap = compressBound(uLong(raw.size()));
+  std::vector<uint8_t> z(zcap);
+  if (compress2(z.data(), &zcap, raw.data(), uLong(raw.size()), 6) != Z_OK)
+    return 0;
+  z.resize(zcap);
+
+  std::vector<uint8_t> png;
+  static const uint8_t sig[8] = {137, 80, 78, 71, 13, 10, 26, 10};
+  png.insert(png.end(), sig, sig + 8);
+  uint8_t ihdr[13];
+  ihdr[0] = uint8_t(w >> 24); ihdr[1] = uint8_t(w >> 16);
+  ihdr[2] = uint8_t(w >> 8);  ihdr[3] = uint8_t(w);
+  ihdr[4] = uint8_t(h >> 24); ihdr[5] = uint8_t(h >> 16);
+  ihdr[6] = uint8_t(h >> 8);  ihdr[7] = uint8_t(h);
+  ihdr[8] = 8;   // bit depth
+  ihdr[9] = 2;   // color type: truecolor RGB
+  ihdr[10] = 0; ihdr[11] = 0; ihdr[12] = 0;
+  PushChunk(&png, "IHDR", ihdr, 13);
+  PushChunk(&png, "IDAT", z.data(), z.size());
+  PushChunk(&png, "IEND", nullptr, 0);
+
+  if (png.size() > cap) return 0;
+  std::memcpy(out, png.data(), png.size());
+  return png.size();
+}
+
+}  // extern "C"

@@ -142,14 +142,16 @@ def test_executor_unavailable_model_is_fatal(pool):
     assert result["fatal_error"] is True
 
 
-def test_executor_stub_workflow_is_fatal(registry, pool):
-    job = {"id": "job-4", "workflow": "txt2audio", "model_name": "cvssp/audioldm",
-           "prompt": "rain", "content_type": "audio/wav"}
+def test_executor_txt2audio_workflow(registry, pool):
+    """txt2audio through the full executor path (formerly a fatal stub —
+    now the jitted AudioLDM-class pipeline, workloads/audio.py)."""
+    job = {"id": "job-4", "workflow": "txt2audio",
+           "model_name": "random/tiny_audio", "prompt": "rain",
+           "num_inference_steps": 2, "audio_length_in_s": 0.05}
     result = synchronous_do_work(job, pool.slots[0], registry)
-    assert result["fatal_error"] is True
-    payload = json.loads(
-        base64.b64decode(result["artifacts"]["primary"]["blob"]))
-    assert "not yet supported" in payload["caption"]
+    assert "fatal_error" not in result
+    assert result["artifacts"]["primary"]["content_type"] == "audio/wav"
+    assert result["pipeline_config"]["mode"] == "txt2audio"
 
 
 # ---------- workloads ----------

@@ -24,6 +24,14 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from chiaswarm_tpu.core.compile_cache import (
+        enable_persistent_compilation_cache,
+    )
+
+    # SDXL-1024 first-compile is minutes on a tunneled chip; cached
+    # recompiles are seconds (shared with the worker runtime)
+    enable_persistent_compilation_cache()
+
     from chiaswarm_tpu.pipelines.components import Components
     from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline, GenerateRequest
 
@@ -38,16 +46,14 @@ def main() -> None:
     batch = int(os.environ.get("CHIASWARM_BENCH_BATCH", "1"))
     iters = int(os.environ.get("CHIASWARM_BENCH_ITERS", "3"))
 
-    c = Components.random(family, seed=0)
     if on_tpu:
-        # store weights in bf16: ~half the HBM, and the UNet/VAE compute in
-        # bf16 anyway (models/configs.py dtype)
-        c.params = jax.tree.map(
-            lambda x: x.astype(jnp.bfloat16)
-            if x.dtype == jnp.float32 else x,
-            c.params,
-        )
+        # host-side param materialization (no init program, no fp32 copy):
+        # on-device fp32 init of SDXL-class weights OOMs a single chip and
+        # the init graph alone takes minutes to compile
+        c = Components.random_host(family, seed=0)
         c.params = jax.device_put(c.params, jax.devices()[0])
+    else:
+        c = Components.random(family, seed=0)
     pipe = DiffusionPipeline(c)
 
     def run(seed: int) -> float:

@@ -1,0 +1,123 @@
+"""Textual inversion: learned concept embeddings merged into the encoder.
+
+Capability parity with swarm/diffusion/diffusion_func.py:48-54 — the
+reference calls ``pipeline.load_textual_inversion(model_name)`` per job and
+treats an incompatible embedding as a FATAL error (``ValueError`` so the
+hive stops retrying). TPU-first redesign: the learned vectors append as new
+rows to the resident text encoder's token-embedding matrix ONCE at load
+time and the placeholder token registers with the tokenizer
+(models/tokenizer.py AddedTokenMixin); jit retraces automatically for the
+one-row-larger embedding shape, and generation runs the standard program.
+
+Supported file formats:
+- diffusers ``learned_embeds`` dicts: ``{"<token>": tensor(D) | (n, D)}``
+- kohya/A1111 ``.pt``: ``{"string_to_param": {"*": tensor}, "name": ...}``
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("chiaswarm.textual_inversion")
+
+
+def _read_raw(path: Path) -> Mapping[str, Any]:
+    """Read one embeddings file WITHOUT the tensor-only assumptions of
+    torch_to_flax.read_torch_weights — A1111 ``.pt`` files carry nested
+    dicts and strings next to the tensors."""
+    if path.suffix == ".safetensors":
+        from safetensors import safe_open
+
+        with safe_open(str(path), framework="np") as fh:
+            return {k: fh.get_tensor(k) for k in fh.keys()}
+    import torch
+
+    return torch.load(str(path), map_location="cpu", weights_only=True)
+
+
+def _to_array(t: Any) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t.astype(np.float32)
+    return np.asarray(t.detach().to("cpu").float().numpy(), np.float32)
+
+
+def load_embeddings(path: str | Path) -> dict[str, np.ndarray]:
+    """Read a textual-inversion file/dir -> {placeholder_token: (n, D)}.
+
+    Malformed files raise ``ValueError`` (fatal — the hive must not retry,
+    swarm/generator.py:34-41)."""
+    path = Path(path)
+    if path.is_dir():
+        files = sorted(list(path.glob("*.safetensors"))
+                       + list(path.glob("*.bin")) + list(path.glob("*.pt")))
+        if not files:
+            raise ValueError(f"no embedding files under {path}")
+        path = files[0]
+    try:
+        state = _read_raw(path)
+    except Exception as exc:
+        raise ValueError(f"unreadable textual inversion {path}: {exc}")
+
+    if "string_to_param" in state:  # A1111 .pt layout
+        token = str(state.get("name", "<concept>"))
+        tensor = _to_array(list(state["string_to_param"].values())[0])
+        return {token: np.atleast_2d(tensor)}
+
+    out: dict[str, np.ndarray] = {}
+    for token, tensor in state.items():
+        if token.startswith("string_to_") or isinstance(tensor, (str, dict,
+                                                                 int, float)):
+            continue
+        arr = _to_array(tensor)
+        if arr.ndim in (1, 2):
+            out[token] = np.atleast_2d(arr)
+    if not out:
+        raise ValueError(f"no embeddings found in {path}")
+    return out
+
+
+def apply_textual_inversion(components, embeddings: dict[str, np.ndarray],
+                            ) -> list[str]:
+    """Append embedding rows + register placeholder tokens. Mutates the
+    Components bundle IN PLACE (callers own a private copy via the
+    registry's per-(model, inversion) cache key).
+
+    Raises ``ValueError`` on hidden-size mismatch — the fatal-error parity
+    with the reference's incompatible-inversion path (diffusion_func.py:
+    48-54, surfaced as fatal at swarm/generator.py:34-41).
+    """
+    import dataclasses
+
+    from chiaswarm_tpu.models.clip import ClipTextEncoder
+
+    added: list[str] = []
+    for token, vectors in embeddings.items():
+        vectors = np.atleast_2d(np.asarray(vectors, np.float32))
+        for i, te in enumerate(components.text_encoders):
+            tree = components.params[f"text_encoder_{i}"]["params"]
+            table = tree["token_embedding"]["embedding"]
+            if vectors.shape[1] != table.shape[1]:
+                raise ValueError(
+                    f"textual inversion {token!r} has dimension "
+                    f"{vectors.shape[1]}, but the text encoder embeds at "
+                    f"{table.shape[1]} — incompatible with this model"
+                )
+            start = table.shape[0]
+            tree["token_embedding"]["embedding"] = jnp.concatenate(
+                [jnp.asarray(table), jnp.asarray(vectors)], axis=0)
+            # the module's static vocab size must match the enlarged table
+            # (flax validates param shapes at apply time)
+            components.text_encoders[i] = ClipTextEncoder(
+                dataclasses.replace(te.config,
+                                    vocab_size=start + vectors.shape[0]))
+            ids = list(range(start, start + vectors.shape[0]))
+            components.tokenizers[i].add_token(token, ids)
+        added.append(token)
+        log.info("textual inversion %r registered (%d vector(s))",
+                 token, vectors.shape[0])
+    return added

@@ -93,6 +93,10 @@ class ModelFamily:
     # pipeline class selector: "sd" (DiffusionPipeline) | "upscaler"
     # (LatentUpscalePipeline, swarm/diffusion/upscale.py parity)
     kind: str = "sd"
+    # instruct-pix2pix-class: UNet input = concat(noise latents, image
+    # latents), dual text+image classifier-free guidance
+    # (timbrooks/instruct-pix2pix routing, swarm/job_arguments.py:128-131)
+    image_conditioned: bool = False
 
 
 _CLIP_L = TextEncoderConfig()  # ViT-L/14 text tower: SD1.x, SDXL enc 1
@@ -149,6 +153,17 @@ SDXL = ModelFamily(
     ),
     default_size=1024,
     needs_time_ids=True,
+)
+
+# instruct-pix2pix: SD1.5 arch with an 8-channel UNet input (noise latents
+# + unscaled image latents) and dual text/image guidance.
+PIX2PIX = ModelFamily(
+    name="pix2pix",
+    unet=UNetConfig(sample_channels=8),
+    vae=VAEConfig(),
+    text_encoders=(_CLIP_L,),
+    default_size=512,
+    image_conditioned=True,
 )
 
 # 2x latent upscaler (sd-x2-latent-upscaler-class): the UNet denoises the
@@ -251,8 +266,33 @@ TINY_UP = ModelFamily(
     kind="upscaler",
 )
 
+# Tiny image-conditioned family for hermetic pix2pix tests.
+TINY_P2P = ModelFamily(
+    name="tiny_p2p",
+    unet=UNetConfig(
+        sample_channels=8,
+        block_out_channels=(32, 64),
+        layers_per_block=1,
+        transformer_depth=(1, 1),
+        attention_head_dim=4,
+        head_dim_is_count=True,
+        cross_attention_dim=32,
+        dtype="float32",
+    ),
+    vae=VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+                  dtype="float32"),
+    text_encoders=(
+        TextEncoderConfig(vocab_size=1000, hidden_size=32,
+                          intermediate_size=64, num_layers=2, num_heads=4,
+                          max_position_embeddings=77, eos_token_id=999),
+    ),
+    default_size=64,
+    image_conditioned=True,
+)
+
 FAMILIES: dict[str, ModelFamily] = {
-    f.name: f for f in (SD15, SD21, SDXL, UPSCALER_X2, TINY, TINY_XL, TINY_UP)
+    f.name: f for f in (SD15, SD21, SDXL, PIX2PIX, UPSCALER_X2, TINY,
+                        TINY_XL, TINY_UP, TINY_P2P)
 }
 
 # hive model-name prefixes -> family (the dispatch the reference does via
@@ -260,6 +300,7 @@ FAMILIES: dict[str, ModelFamily] = {
 _NAME_HINTS = (
     ("latent-upscaler", "upscaler_x2"),
     ("upscale", "upscaler_x2"),
+    ("pix2pix", "pix2pix"),
     ("xl", "sdxl"),
     ("stable-diffusion-2", "sd21"),
     ("sd2", "sd21"),

@@ -32,6 +32,37 @@ class Tokenizer(Protocol):
     def encode_batch(self, texts: Sequence[str]) -> np.ndarray: ...
 
 
+class AddedTokenMixin:
+    """Placeholder-token registry for textual inversion (the reference's
+    ``load_textual_inversion`` path, swarm/diffusion/diffusion_func.py:48-54).
+    A registered token string maps to one or more embedding ids (multi-vector
+    concepts) and is extracted from the prompt before normal tokenization."""
+
+    _added: dict[str, list[int]]
+
+    def add_token(self, token: str, ids: list[int]) -> None:
+        if not hasattr(self, "_added"):
+            self._added = {}
+        self._added[token] = list(ids)
+
+    def _split_added(self, text: str) -> list[str | list[int]]:
+        """Split the prompt into plain-text spans and added-token id runs."""
+        if not getattr(self, "_added", None):
+            return [text]
+        pattern = "|".join(re.escape(t) for t in
+                           sorted(self._added, key=len, reverse=True))
+        parts: list[str | list[int]] = []
+        pos = 0
+        for m in re.finditer(pattern, text):
+            if m.start() > pos:
+                parts.append(text[pos:m.start()])
+            parts.append(self._added[m.group(0)])
+            pos = m.end()
+        if pos < len(text):
+            parts.append(text[pos:])
+        return parts
+
+
 _WORD_RE = re.compile(
     r"'s|'t|'re|'ve|'m|'ll|'d|[a-z]+|[0-9]|[^\sa-z0-9]+", re.IGNORECASE
 )
@@ -42,7 +73,7 @@ def _basic_tokens(text: str) -> list[str]:
     return _WORD_RE.findall(text)
 
 
-class ClipBpeTokenizer:
+class ClipBpeTokenizer(AddedTokenMixin):
     """CLIP BPE over ``vocab.json``/``merges.txt`` (openai/clip format).
 
     ASCII-oriented pre-tokenization (the CLIP regex's unicode classes reduced
@@ -100,13 +131,20 @@ class ClipBpeTokenizer:
 
     def encode(self, text: str) -> list[int]:
         ids = [self.bos_id]
-        for tok in _basic_tokens(text):
-            for piece in self._bpe(tok):
-                pid = self.vocab.get(piece)
-                # drop unknown pieces: mapping them to eos would hijack the
-                # first-EOS pooled readout (models/clip.py argmax pooling)
-                if pid is not None:
-                    ids.append(pid)
+        for span in self._split_added(text):
+            if isinstance(span, list):  # textual-inversion placeholder run
+                ids.extend(span)
+            else:
+                for tok in _basic_tokens(span):
+                    for piece in self._bpe(tok):
+                        pid = self.vocab.get(piece)
+                        # drop unknown pieces: mapping them to eos would
+                        # hijack the first-EOS pooled readout (models/
+                        # clip.py argmax pooling)
+                        if pid is not None:
+                            ids.append(pid)
+                    if len(ids) >= self.max_length - 1:
+                        break
             if len(ids) >= self.max_length - 1:
                 break
         ids = ids[: self.max_length - 1]
@@ -118,7 +156,7 @@ class ClipBpeTokenizer:
         return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
 
 
-class HashTokenizer:
+class HashTokenizer(AddedTokenMixin):
     """Deterministic, vocab-file-free tokenizer for tiny/hermetic models."""
 
     def __init__(self, vocab_size: int = 1000, max_length: int = 77,
@@ -129,14 +167,21 @@ class HashTokenizer:
         self.bos_id = self.eos_id - 1
 
     def encode(self, text: str) -> list[int]:
-        span = max(self.vocab_size - 2, 1)
+        vspan = max(self.vocab_size - 2, 1)
         ids = [self.bos_id]
-        for tok in _basic_tokens(text)[: self.max_length - 2]:
-            # FNV-1a for platform-stable hashing (hash() is salted per process)
-            h = 2166136261
-            for ch in tok.encode("utf-8"):
-                h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
-            ids.append(h % span)
+        for part in self._split_added(text):
+            if isinstance(part, list):  # textual-inversion placeholder run
+                ids.extend(part)
+                continue
+            for tok in _basic_tokens(part):
+                if len(ids) >= self.max_length - 1:
+                    break
+                # FNV-1a: platform-stable hashing (hash() is salted)
+                h = 2166136261
+                for ch in tok.encode("utf-8"):
+                    h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+                ids.append(h % vspan)
+        ids = ids[: self.max_length - 1]
         ids.append(self.eos_id)
         ids += [self.eos_id] * (self.max_length - len(ids))
         return ids[: self.max_length]
@@ -145,7 +190,7 @@ class HashTokenizer:
         return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
 
 
-class HFTokenizer:
+class HFTokenizer(AddedTokenMixin):
     """Wrapper over a serialized HuggingFace ``tokenizer.json`` (the fast-
     tokenizer format T5/DeepFloyd snapshots ship instead of CLIP's
     vocab.json+merges.txt). Pads/truncates to a static length so token ids
@@ -160,7 +205,13 @@ class HFTokenizer:
         self.pad_id = pad_id
 
     def encode(self, text: str) -> list[int]:
-        ids = self._tok.encode(text).ids[: self.max_length]
+        ids: list[int] = []
+        for span in self._split_added(text):
+            if isinstance(span, list):  # textual-inversion placeholder run
+                ids.extend(span)
+            else:
+                ids.extend(self._tok.encode(span).ids)
+        ids = ids[: self.max_length]
         ids += [self.pad_id] * (self.max_length - len(ids))
         return ids
 

@@ -82,15 +82,31 @@ class ModelRegistry:
             f"(no checkpoint at {ckpt}); run `swarm-tpu init` to fetch it"
         )
 
-    def pipeline(self, model_name: str):
+    def pipeline(self, model_name: str,
+                 textual_inversion: str | None = None):
         """Resident pipeline (components + params + compiled executables),
         one LRU entry under the HBM byte budget: evicting the entry drops
         the only strong reference to the param tree. The pipeline class is
         selected by the family's ``kind`` ("sd" -> DiffusionPipeline,
-        "upscaler" -> LatentUpscalePipeline)."""
+        "upscaler" -> LatentUpscalePipeline). A textual inversion keys a
+        SEPARATE entry: the concept rows merge into that entry's private
+        embedding table (convert/textual_inversion.py), never the base's."""
 
         def build():
             components = self._load_components(model_name)
+            if textual_inversion is not None:
+                from chiaswarm_tpu.convert.textual_inversion import (
+                    apply_textual_inversion,
+                    load_embeddings,
+                )
+
+                ti_dir = model_dir(textual_inversion)
+                if not ti_dir.exists():
+                    raise ValueError(
+                        f"textual inversion {textual_inversion!r} is not "
+                        f"available on this node (no file at {ti_dir})"
+                    )
+                apply_textual_inversion(components, load_embeddings(ti_dir))
             if components.family.kind == "upscaler":
                 from chiaswarm_tpu.pipelines.upscale import (
                     LatentUpscalePipeline,
@@ -101,7 +117,7 @@ class ModelRegistry:
             return DiffusionPipeline(components, attn_impl=self.attn_impl)
 
         return GLOBAL_CACHE.cached_params(
-            ("pipeline", model_name), build,
+            ("pipeline", model_name, textual_inversion), build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 

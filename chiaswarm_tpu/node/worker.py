@@ -85,6 +85,12 @@ class Worker:
         # bf16 matmuls on the MXU — the TPU analog of the reference's
         # TF32/cudnn.benchmark startup knobs (swarm/worker.py:179-181)
         jax.config.update("jax_default_matmul_precision", "bfloat16")
+        # amortize XLA compiles across worker restarts
+        from chiaswarm_tpu.core.compile_cache import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
 
     def request_stop(self) -> None:
         self._stop.set()

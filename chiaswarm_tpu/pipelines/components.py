@@ -96,6 +96,77 @@ class Components:
         )
 
     @classmethod
+    def random_host(cls, family: ModelFamily | str, seed: int = 0,
+                    model_name: str | None = None,
+                    dtype: str = "bfloat16") -> "Components":
+        """Random components built WITHOUT running any XLA program: module
+        param shapes come from ``jax.eval_shape`` (abstract tracing) and
+        the values from host numpy. For benchmarks on big families —
+        on-device fp32 init of SDXL-class weights both exhausts a single
+        chip's HBM and takes minutes of init-graph compilation; this path
+        takes seconds and the FLOPs/memory traffic are identical to a
+        converted checkpoint."""
+        import numpy as np
+
+        if isinstance(family, str):
+            family = FAMILIES[family]
+        text_encoders = [ClipTextEncoder(cfg) for cfg in family.text_encoders]
+        tokenizers = [
+            HashTokenizer(cfg.vocab_size, cfg.max_position_embeddings,
+                          cfg.eos_token_id)
+            for cfg in family.text_encoders
+        ]
+        unet = UNet(family.unet)
+        vae = AutoencoderKL(family.vae)
+
+        rng = np.random.default_rng(seed)
+        out_dtype = jnp.dtype(dtype)
+
+        def leaf(s):
+            dt = out_dtype if s.dtype == jnp.float32 else s.dtype
+            if int(np.prod(s.shape)) > 1_000_000:
+                # zeros for the big kernels: sampling billions of host
+                # normals dominates runtime, and value content does not
+                # change TPU op timing (no denormal penalties)
+                return jnp.zeros(s.shape, dt)
+            return jnp.asarray(
+                rng.standard_normal(s.shape).astype(np.float32) * 0.02, dt)
+
+        def materialize(shape_tree):
+            return jax.tree.map(leaf, shape_tree)
+
+        key = jax.random.PRNGKey(0)
+        ids = jnp.zeros((1, family.text_encoders[0].max_position_embeddings),
+                        jnp.int32)
+        params: dict[str, Any] = {}
+        for i, te in enumerate(text_encoders):
+            params[f"text_encoder_{i}"] = materialize(
+                jax.eval_shape(te.init, key, ids))
+        latent = jnp.zeros((1, 8, 8, family.unet.sample_channels))
+        ctx = jnp.zeros((1, ids.shape[1], family.unet.cross_attention_dim))
+        added = None
+        if family.unet.addition_embed_dim is not None:
+            added = {
+                "time_ids": jnp.zeros((1, 6)),
+                "text_embeds": jnp.zeros((1, family.unet.addition_pooled_dim)),
+            }
+        params["unet"] = materialize(
+            jax.eval_shape(unet.init, key, latent, jnp.zeros((1,)), ctx,
+                           added))
+        params["vae"] = materialize(
+            jax.eval_shape(vae.init, key,
+                           jnp.zeros((1, 16, 16, family.vae.in_channels))))
+        return cls(
+            family=family,
+            model_name=model_name or f"random/{family.name}",
+            tokenizers=tokenizers,
+            text_encoders=text_encoders,
+            unet=unet,
+            vae=vae,
+            params=params,
+        )
+
+    @classmethod
     def from_checkpoint(cls, checkpoint_dir: str | Path,
                         model_name: str | None = None,
                         family: ModelFamily | str | None = None) -> "Components":

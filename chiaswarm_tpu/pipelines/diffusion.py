@@ -73,6 +73,8 @@ class GenerateRequest:
     controlnet: Any = None                 # ControlNetBundle
     control_image: np.ndarray | None = None  # (H, W, 3) conditioning image
     control_scale: float = 1.0             # traced; never recompiles
+    # instruct-pix2pix dual guidance (image_conditioned families)
+    image_guidance_scale: float = 1.5      # traced; never recompiles
 
 
 def _to_float_image(img: np.ndarray) -> np.ndarray:
@@ -168,10 +170,19 @@ class DiffusionPipeline:
                 pooled = pool  # SDXL: pooled comes from the last encoder
             return jnp.concatenate(seqs, axis=-1) if len(seqs) > 1 else seqs[0], pooled
 
+        pix2pix = fam.image_conditioned
+
         def fn(params, ids, neg_ids, key, guidance, init_latent, mask,
-               control_params, control_cond, control_scale):
+               control_params, control_cond, control_scale,
+               image_guidance):
             ctx, pooled = encode_text(params, ids)
-            if use_cfg:
+            if pix2pix:
+                # dual CFG rides a tripled batch: [uncond, image-only,
+                # text+image] (timbrooks/instruct-pix2pix semantics; the
+                # reference reaches it via the diffusers pipeline class)
+                nctx, _ = encode_text(params, neg_ids)
+                ctx = jnp.concatenate([nctx, nctx, ctx], axis=0)
+            elif use_cfg:
                 nctx, npooled = encode_text(params, neg_ids)
                 ctx = jnp.concatenate([nctx, ctx], axis=0)
                 if pooled is not None:
@@ -187,10 +198,15 @@ class DiffusionPipeline:
 
             key, nkey = jax.random.split(key)
             noise = jax.random.normal(
-                nkey, (batch, lh, lw, fam.unet.sample_channels), jnp.float32
+                nkey, (batch, lh, lw, fam.vae.latent_channels), jnp.float32
             )
             sigma_start = sched.sigmas[start_step]
-            if has_init:
+            if pix2pix:
+                # image latents condition via channel-concat (UNSCALED, the
+                # pix2pix convention); generation starts from pure noise
+                img_cond = init_latent / fam.vae.scaling_factor
+                x = noise * sched.sigmas[0]
+            elif has_init:
                 x = init_latent + noise * sigma_start
             else:
                 x = noise * sigma_start
@@ -212,7 +228,19 @@ class DiffusionPipeline:
                 x, state, key = carry
                 i = idx + start_step
                 inp = scale_model_input(sched, x, i)
-                if use_cfg:
+                if pix2pix:
+                    inp3 = jnp.concatenate([inp, inp, inp], axis=0)
+                    img3 = jnp.concatenate(
+                        [jnp.zeros_like(img_cond), img_cond, img_cond],
+                        axis=0)
+                    t3 = sched.timesteps[i][None].repeat(3 * batch, axis=0)
+                    out = unet.apply(params["unet"],
+                                     jnp.concatenate([inp3, img3], axis=-1),
+                                     t3, ctx, added)
+                    e_unc, e_img, e_full = jnp.split(out, 3, axis=0)
+                    eps = (e_unc + image_guidance * (e_img - e_unc)
+                           + guidance * (e_full - e_img))
+                elif use_cfg:
                     inp2 = jnp.concatenate([inp, inp], axis=0)
                     t2 = sched.timesteps[i][None].repeat(2 * batch, axis=0)
                     down_res = mid_res = None
@@ -307,14 +335,26 @@ class DiffusionPipeline:
         has_mask = req.mask is not None
         if has_mask and not has_init:
             raise ValueError("inpainting requires an init image with the mask")
+        if fam.image_conditioned:
+            if not has_init:
+                raise ValueError(
+                    "this model edits an input image; start_image_uri is "
+                    "required")
+            if has_mask:
+                raise ValueError(
+                    "instruct-pix2pix models do not take a mask")
+            if req.controlnet is not None:
+                raise ValueError(
+                    "instruct-pix2pix models do not support controlnet")
 
         start_step = 0
         init_latent = jnp.zeros((1,), jnp.float32)  # placeholder
         mask_arr = jnp.zeros((1,), jnp.float32)
         if has_init:
             strength = float(np.clip(req.strength, 0.05, 1.0))
-            if not has_mask:
+            if not has_mask and not fam.image_conditioned:
                 # img2img: skip the first (1-strength) of the ladder
+                # (pix2pix starts from pure noise instead)
                 start_step = min(int(round(steps * (1.0 - strength))),
                                  steps - 1)
             init = np.asarray(req.init_image)
@@ -383,6 +423,7 @@ class DiffusionPipeline:
             control_params,
             control_cond,
             jnp.float32(req.control_scale),
+            jnp.float32(req.image_guidance_scale),
         )
         img = np.asarray(jax.device_get(img))
         img_u8 = ((img + 1.0) * 127.5).round().clip(0, 255).astype(np.uint8)
@@ -410,9 +451,12 @@ class DiffusionPipeline:
             "size": [req.height, req.width],
             "compiled_size": [height, width],
             "batch": batch,
-            "mode": ("inpaint" if has_mask else
+            "mode": ("pix2pix" if fam.image_conditioned else
+                     "inpaint" if has_mask else
                      "img2img" if has_init else "txt2img"),
         }
+        if fam.image_conditioned:
+            config["image_guidance_scale"] = float(req.image_guidance_scale)
         if has_control:
             config["controlnet"] = req.controlnet.model_name
             config["controlnet_scale"] = float(req.control_scale)

@@ -42,9 +42,10 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        controlnet_model_name: str | None = None,
                        controlnet_scale: float = 1.0,
                        save_preprocessed_input: bool = False,
+                       textual_inversion: str | None = None,
                        outputs: tuple[str, ...] = ("primary",),
                        **_ignored: Any):
-    pipe = registry.pipeline(model_name)
+    pipe = registry.pipeline(model_name, textual_inversion=textual_inversion)
     fam = pipe.c.family
     if fam.kind != "sd":
         raise ValueError(
@@ -60,6 +61,11 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
     controlnet = None
     control_image = None
     if controlnet_model_name is not None:
+        if fam.image_conditioned:
+            raise ValueError(
+                "instruct-pix2pix models do not support controlnet; the "
+                "input image already conditions generation"
+            )
         if mask_image is not None:
             raise ValueError(
                 "controlnet jobs cannot also carry a mask_image; the input "
@@ -71,10 +77,10 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         controlnet = registry.controlnet(controlnet_model_name, fam)
         control_image, image = image, None
 
-    if image_guidance_scale is not None:
-        # instruct-pix2pix jobs arrive with image_guidance_scale =
-        # strength*5 (node/job_args.py remap); until the 8-channel pix2pix
-        # UNet lands, honor the user's intent through the img2img strength
+    if image_guidance_scale is not None and not fam.image_conditioned:
+        # image_guidance on a non-pix2pix checkpoint: honor the user's
+        # intent through img2img strength (hive sends strength*5,
+        # node/job_args.py remap)
         strength = min(1.0, max(0.05, float(image_guidance_scale) / 5.0))
 
     mask = None
@@ -101,6 +107,9 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         controlnet=controlnet,
         control_image=control_image,
         control_scale=float(controlnet_scale),
+        image_guidance_scale=float(image_guidance_scale
+                                   if image_guidance_scale is not None
+                                   else 1.5),
     )
     t0 = time.perf_counter()
     images, config = pipe(req)
@@ -122,6 +131,8 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                         key="preprocessed_input")
     artifacts = proc.get_results()
 
+    if textual_inversion is not None:
+        config["textual_inversion"] = textual_inversion
     config.update({
         "nsfw": False,  # safety checker hook (workloads/safety.py) TBD
         "images_per_sec": round(images.shape[0] / max(elapsed, 1e-9), 4),

@@ -18,6 +18,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
 
+# persistent XLA compile cache: the suite is compile-bound on one CPU core;
+# warm reruns skip most of that
+from chiaswarm_tpu.core.compile_cache import (  # noqa: E402
+    enable_persistent_compilation_cache,
+)
+
+enable_persistent_compilation_cache()
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,74 @@
+"""Textual inversion: embedding merge, placeholder tokens, fatal mismatch.
+
+Reference behavior covered: per-job ``load_textual_inversion`` with
+incompatible inversions surfacing as fatal ValueError
+(swarm/diffusion/diffusion_func.py:48-54, swarm/generator.py:34-41).
+"""
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.convert.textual_inversion import apply_textual_inversion
+from chiaswarm_tpu.models.tokenizer import HashTokenizer
+from chiaswarm_tpu.pipelines import Components, DiffusionPipeline, GenerateRequest
+
+
+def test_added_token_splitting():
+    tok = HashTokenizer(vocab_size=100, max_length=16)
+    base = tok.encode("a photo of sks dog")
+    tok.add_token("sks", [200, 201])
+    with_ti = tok.encode("a photo of sks dog")
+    assert 200 in with_ti and 201 in with_ti
+    assert with_ti != base
+    # unrelated prompts are untouched
+    assert tok.encode("a plain cat") == \
+        HashTokenizer(vocab_size=100, max_length=16).encode("a plain cat")
+
+
+def test_apply_textual_inversion_changes_generation():
+    c = Components.random("tiny", seed=0)
+    hidden = c.params["text_encoder_0"]["params"][
+        "token_embedding"]["embedding"].shape[1]
+    pipe = DiffusionPipeline(c)
+    req = GenerateRequest(prompt="a sks landscape", steps=2, height=64,
+                          width=64, seed=3, guidance_scale=5.0)
+    base_img, _ = pipe(req)
+
+    c2 = Components.random("tiny", seed=0)
+    rng = np.random.default_rng(1)
+    added = apply_textual_inversion(
+        c2, {"sks": rng.normal(size=(2, hidden)).astype(np.float32)})
+    assert added == ["sks"]
+    rows = c2.params["text_encoder_0"]["params"][
+        "token_embedding"]["embedding"].shape[0]
+    assert rows == c.params["text_encoder_0"]["params"][
+        "token_embedding"]["embedding"].shape[0] + 2
+
+    ti_img, _ = DiffusionPipeline(c2)(req)
+    assert not np.array_equal(base_img, ti_img)   # concept steers output
+
+    # prompts without the placeholder are unaffected by the merge
+    neutral = GenerateRequest(prompt="plain hills", steps=2, height=64,
+                              width=64, seed=3, guidance_scale=5.0)
+    a, _ = pipe(neutral)
+    b, _ = DiffusionPipeline(c2)(neutral)
+    assert np.array_equal(a, b)
+
+
+def test_incompatible_dimension_is_value_error():
+    c = Components.random("tiny", seed=0)
+    with pytest.raises(ValueError, match="incompatible"):
+        apply_textual_inversion(
+            c, {"sks": np.zeros((1, 9999), np.float32)})
+
+
+def test_workload_missing_inversion_is_value_error():
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.workloads.diffusion import diffusion_callback
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    with pytest.raises(ValueError, match="not.*available"):
+        diffusion_callback(
+            "slot0", "random/tiny", seed=1, registry=registry,
+            prompt="x", num_inference_steps=1, height=64, width=64,
+            textual_inversion="sd-concepts-library/nowhere")

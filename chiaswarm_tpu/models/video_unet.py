@@ -1,0 +1,214 @@
+"""Temporal (video) diffusion UNet — ModelScope-class text-to-video.
+
+The model family behind the reference's txt2vid workload
+(swarm/video/tx2vid.py:17-57 runs ``damo-vilab/text-to-video-ms-1.7b``
+through diffusers). Factorized space-time design, the standard for this
+class: every level runs the 2D blocks of models/unet.py with frames folded
+into the batch axis (pure reuse — same parameter naming, so the 2D
+converter rules extend), interleaved with
+
+- :class:`TemporalAttention`: self-attention along the frame axis at each
+  spatial site (frames become the sequence; spatial sites fold into batch),
+  with a learned frame-position embedding;
+- a temporal 1D conv in each level (local motion mixing).
+
+TPU notes: both foldings are pure reshapes in NHWC — XLA sees large, static
+(B*F, H, W, C) convs for the MXU and (B*H*W, F, C) attention batches; no
+gather/scatter, no dynamic shapes. Frame count is a compile-time static
+(bucketed by the pipeline).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chiaswarm_tpu.models.common import num_groups as _num_groups
+from chiaswarm_tpu.models.configs import UNetConfig
+from chiaswarm_tpu.models.unet import (
+    Downsample,
+    ResnetBlock,
+    SpatialTransformer,
+    Upsample,
+    time_conditioning,
+)
+from chiaswarm_tpu.ops.attention import attention
+
+zeros_init = nn.initializers.zeros
+
+
+class TemporalAttention(nn.Module):
+    """Self-attention over the frame axis. Input (B, F, H, W, C); the
+    output projection is zero-initialized so an untrained temporal layer
+    is identity (frames stay independent), the AnimateDiff-style safe
+    default for weights converted from 2D checkpoints."""
+
+    num_heads: int
+    head_dim: int
+    max_frames: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, f, h, w, c = x.shape
+        residual = x
+        pos = self.param("frame_pos_embed",
+                         nn.initializers.normal(0.02),
+                         (self.max_frames, c))
+        seq = x.transpose(0, 2, 3, 1, 4).reshape(b * h * w, f, c)
+        seq = nn.LayerNorm(dtype=jnp.float32, name="norm")(seq)
+        seq = (seq + pos[None, :f, :]).astype(self.dtype)
+        inner = self.num_heads * self.head_dim
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype,
+                     name="to_q")(seq)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype,
+                     name="to_k")(seq)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype,
+                     name="to_v")(seq)
+        n = b * h * w
+        out = attention(
+            q.reshape(n, f, self.num_heads, self.head_dim),
+            k.reshape(n, f, self.num_heads, self.head_dim),
+            v.reshape(n, f, self.num_heads, self.head_dim),
+            impl="xla",  # tiny sequence (frames) — einsum path
+        ).reshape(n, f, inner)
+        out = nn.Dense(c, kernel_init=zeros_init, dtype=self.dtype,
+                       name="to_out")(out)
+        out = out.reshape(b, h, w, f, c).transpose(0, 3, 1, 2, 4)
+        return residual + out
+
+
+class TemporalConv(nn.Module):
+    """1D conv over frames (local motion), zero-init output -> identity."""
+
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, f, h, w, c = x.shape
+        residual = x
+        seq = x.transpose(0, 2, 3, 1, 4).reshape(b * h * w, f, c)
+        seq = nn.GroupNorm(num_groups=_num_groups(c), epsilon=1e-5,
+                           dtype=jnp.float32, name="norm")(seq)
+        seq = nn.silu(seq).astype(self.dtype)
+        seq = nn.Conv(self.channels, (3,), padding="SAME", dtype=self.dtype,
+                      name="conv1")(seq)
+        seq = nn.silu(seq)
+        seq = nn.Conv(c, (3,), padding="SAME", kernel_init=zeros_init,
+                      dtype=self.dtype, name="conv2")(seq)
+        return residual + seq.reshape(b, h, w, f, c).transpose(0, 3, 1, 2, 4)
+
+
+class VideoUNet(nn.Module):
+    """(B, F, H, W, C) latents -> model prediction, text-conditioned.
+
+    Spatial blocks share models/unet.py modules (frames folded into
+    batch); temporal attention + conv interleave at every level.
+    """
+
+    config: UNetConfig
+    max_frames: int = 64
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(
+        self,
+        sample: jnp.ndarray,                 # (B, F, H, W, C)
+        timesteps: jnp.ndarray,              # (B,)
+        encoder_hidden_states: jnp.ndarray,  # (B, S, cross_dim)
+    ) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.dtype
+        channels = list(cfg.block_out_channels)
+        b, f, hh, ww, _ = sample.shape
+
+        temb = time_conditioning(cfg, dtype, timesteps, None)
+        temb_f = jnp.repeat(temb, f, axis=0)          # (B*F, D) for 2D blocks
+        ctx = encoder_hidden_states.astype(dtype)
+        ctx_f = jnp.repeat(ctx, f, axis=0)            # frames share the text
+
+        def fold(x):   # (B, F, H, W, C) -> (B*F, H, W, C)
+            return x.reshape((-1,) + x.shape[2:])
+
+        def unfold(x):
+            return x.reshape((b, f) + x.shape[1:])
+
+        x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
+                    name="conv_in")(fold(sample.astype(dtype)))
+        x = unfold(x)
+        skips = [x]
+
+        # ---- down path
+        for level, ch in enumerate(channels):
+            depth = cfg.transformer_depth[level]
+            heads, head_dim = cfg.heads_for(ch, level)
+            for j in range(cfg.layers_per_block):
+                x = unfold(ResnetBlock(ch, dtype,
+                                       name=f"down_{level}_resnets_{j}")(
+                    fold(x), temb_f))
+                x = TemporalConv(ch, dtype,
+                                 name=f"down_{level}_tconv_{j}")(x)
+                if depth > 0:
+                    x = unfold(SpatialTransformer(
+                        depth, heads, head_dim, cfg.use_linear_projection,
+                        dtype, cfg.attn_impl,
+                        name=f"down_{level}_attentions_{j}")(fold(x), ctx_f))
+                    x = TemporalAttention(
+                        heads, head_dim, self.max_frames, dtype,
+                        name=f"down_{level}_tattn_{j}")(x)
+                skips.append(x)
+            if level < len(channels) - 1:
+                x = unfold(Downsample(ch, dtype,
+                                      name=f"down_{level}_downsample")(
+                    fold(x)))
+                skips.append(x)
+
+        # ---- mid
+        mid_ch = channels[-1]
+        mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(channels) - 1)
+        mid_depth = max(d for d in cfg.transformer_depth) or 1
+        x = unfold(ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(
+            fold(x), temb_f))
+        x = unfold(SpatialTransformer(
+            mid_depth, mid_heads, mid_head_dim, cfg.use_linear_projection,
+            dtype, cfg.attn_impl, name="mid_attention")(fold(x), ctx_f))
+        x = TemporalAttention(mid_heads, mid_head_dim, self.max_frames,
+                              dtype, name="mid_tattn")(x)
+        x = unfold(ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(
+            fold(x), temb_f))
+
+        # ---- up path
+        for rev, ch in enumerate(reversed(channels)):
+            level = len(channels) - 1 - rev
+            depth = cfg.transformer_depth[level]
+            heads, head_dim = cfg.heads_for(ch, level)
+            for j in range(cfg.layers_per_block + 1):
+                skip = skips.pop()
+                x = jnp.concatenate([x, skip], axis=-1)
+                x = unfold(ResnetBlock(ch, dtype,
+                                       name=f"up_{level}_resnets_{j}")(
+                    fold(x), temb_f))
+                x = TemporalConv(ch, dtype, name=f"up_{level}_tconv_{j}")(x)
+                if depth > 0:
+                    x = unfold(SpatialTransformer(
+                        depth, heads, head_dim, cfg.use_linear_projection,
+                        dtype, cfg.attn_impl,
+                        name=f"up_{level}_attentions_{j}")(fold(x), ctx_f))
+                    x = TemporalAttention(
+                        heads, head_dim, self.max_frames, dtype,
+                        name=f"up_{level}_tattn_{j}")(x)
+            if level > 0:
+                x = unfold(Upsample(ch, dtype,
+                                    name=f"up_{level}_upsample")(fold(x)))
+
+        x = fold(x)
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-5,
+                         dtype=jnp.float32, name="conv_norm_out")(x)
+        x = nn.silu(x).astype(dtype)
+        x = nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
+                    name="conv_out")(x)
+        return unfold(x)

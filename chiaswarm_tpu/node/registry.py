@@ -194,6 +194,32 @@ class ModelRegistry:
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
+    def video_pipeline(self, model_name: str):
+        """Resident ModelScope-class txt2vid pipeline
+        (swarm/video/tx2vid.py:17-57 parity, pipelines/video.py)."""
+        from chiaswarm_tpu.pipelines.video import (
+            VideoComponents,
+            VideoPipeline,
+            get_video_family,
+        )
+
+        def build():
+            family = get_video_family(model_name)
+            if self.allow_random:
+                log.warning("video model %s: using random weights",
+                            model_name)
+                return VideoPipeline(
+                    VideoComponents.random(family, model_name=model_name),
+                    attn_impl=self.attn_impl)
+            raise ValueError(
+                f"video model {model_name!r} is not available on this node"
+            )
+
+        return GLOBAL_CACHE.cached_params(
+            ("video", model_name), build,
+            size_of=lambda pipe: pipe.c.param_bytes(),
+        )
+
     def tts_pipeline(self, model_name: str):
         """Resident bark-class TTS pipeline (swarm/audio/bark.py:11-38
         parity, pipelines/tts.py). No torch checkpoint converter yet —

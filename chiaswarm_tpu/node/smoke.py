@@ -48,8 +48,10 @@ SMOKE_JOBS: dict[str, dict[str, Any]] = {
     "txt2vid": {
         "id": "smoke-txt2vid",
         "workflow": "txt2vid",
-        "model_name": "damo-vilab/text-to-video-ms-1.7b",
+        "model_name": "random/tiny_vid",
         "prompt": "a paper boat drifting",
+        "num_frames": 8,
+        "num_inference_steps": 2,
         "content_type": "video/mp4",
     },
     "img2txt": {
@@ -107,7 +109,7 @@ def main(argv: list[str] | None = None) -> int:
         result = run_smoke(wf, args.random_weights)
         config = result.get("pipeline_config", {})
         status = "error" if "error" in config else "ok"
-        expected_stub = wf in ("txt2vid", "img2txt")
+        expected_stub = wf in ("img2txt",)  # BLIP needs real weights
         line = {
             "workflow": wf, "status": status,
             "fatal": bool(result.get("fatal_error")),

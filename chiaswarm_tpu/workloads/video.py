@@ -112,6 +112,24 @@ def _write_video(frames: list[np.ndarray], fps: float,
         os.unlink(path)
 
 
+def _video_artifacts(frames: list[np.ndarray], fps: float,
+                     content_type: str) -> dict:
+    """Shared video artifact packaging: encoded container + frame-0
+    thumbnail (tx2vid.py:73's thumbnail behavior, both video workflows)."""
+    from PIL import Image
+
+    from chiaswarm_tpu.node.output_processor import encode_image, thumbnail
+
+    blob = _write_video(frames, fps, content_type)
+    frame0 = Image.fromarray(frames[0])
+    thumb_bytes = thumbnail(frame0)
+    return {
+        "primary": make_result(blob, content_type, thumb_bytes),
+        "thumbnail": make_result(encode_image(frame0, "image/jpeg"),
+                                 "image/jpeg", thumb_bytes),
+    }
+
+
 def vid2vid_callback(slot, model_name: str, *, seed: int,
                      registry: ModelRegistry,
                      video_uri: str = "",
@@ -156,18 +174,7 @@ def vid2vid_callback(slot, model_name: str, *, seed: int,
         images, _ = pipe(req)
         out_frames.extend(images)
 
-    blob = _write_video(out_frames, fps, content_type)
-    from PIL import Image
-
-    from chiaswarm_tpu.node.output_processor import encode_image, thumbnail
-
-    frame0 = Image.fromarray(out_frames[0])
-    thumb_bytes = thumbnail(frame0)  # frame-0 thumb, not the video blob
-    artifacts = {
-        "primary": make_result(blob, content_type, thumb_bytes),
-        "thumbnail": make_result(encode_image(frame0, "image/jpeg"),
-                                 "image/jpeg", thumb_bytes),
-    }
+    artifacts = _video_artifacts(out_frames, fps, content_type)
     config = {
         "model_name": model_name,
         "frames": len(out_frames),
@@ -179,12 +186,45 @@ def vid2vid_callback(slot, model_name: str, *, seed: int,
 
 
 def txt2vid_callback(slot, model_name: str, *, seed: int,
-                     registry: ModelRegistry, **kwargs: Any):
-    """Text-to-video (reference: swarm/video/tx2vid.py). The Flax video
-    diffusion model family (ModelScope/SVD-class temporal UNet) is not in
-    the zoo yet; jobs fail fatally (honest capability signal to the hive)
-    rather than burning chip time."""
-    raise ValueError(
-        f"txt2vid is not yet supported by this TPU worker "
-        f"(requested model {model_name!r})"
+                     registry: ModelRegistry,
+                     prompt: str = "",
+                     negative_prompt: str = "",
+                     num_frames: int = 25,
+                     num_inference_steps: int = 25,
+                     guidance_scale: float = 9.0,
+                     height: int | None = None,
+                     width: int | None = None,
+                     fps: float = 8.0,
+                     content_type: str = "video/mp4",
+                     scheduler_type: str | None = None,
+                     **_ignored: Any):
+    """Text-to-video (swarm/video/tx2vid.py:17-88 parity: default 25
+    frames, mp4/h264-or-webm switch, 8 fps, thumbnail from frame 0). The
+    whole denoise runs as ONE jitted program over the (F, lh, lw, C) video
+    latent through the temporal UNet — no per-frame Python loop, no memory
+    heuristics (tx2vid.py:36-53 has no TPU analog)."""
+    import time
+
+    pipe = registry.video_pipeline(model_name)
+    t0 = time.perf_counter()
+    frames, config = pipe(
+        prompt or "",
+        negative_prompt=negative_prompt or "",
+        num_frames=int(num_frames),
+        steps=int(num_inference_steps),
+        guidance_scale=float(guidance_scale),
+        height=height, width=width,
+        seed=seed,
+        scheduler=scheduler_type,
     )
+    elapsed = time.perf_counter() - t0
+
+    artifacts = _video_artifacts(list(frames), float(fps), content_type)
+    config.update({
+        "nsfw": False,
+        "fps": float(fps),
+        "generation_s": round(elapsed, 3),
+        "frames_per_sec": round(frames.shape[0] / max(elapsed, 1e-9), 4),
+        "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
+    })
+    return artifacts, config

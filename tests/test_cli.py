@@ -31,11 +31,12 @@ def test_smoke_txt2audio_and_cascade_ok():
     assert result["pipeline_config"]["mode"] == "cascade_txt2img"
 
 
-def test_smoke_stub_workflows_fail_fatally():
-    # txt2vid stays a stub until the temporal video UNet family lands
+def test_smoke_txt2vid_ok():
     result = run_smoke("txt2vid")
-    assert result.get("fatal_error") is True
-    assert "not yet supported" in result["pipeline_config"]["error"]
+    assert "fatal_error" not in result
+    assert result["pipeline_config"]["mode"] == "txt2vid"
+    assert result["artifacts"]["primary"]["content_type"] == "video/mp4"
+    assert "thumbnail" in result["artifacts"]
 
 
 def test_smoke_covers_every_routed_workflow():

@@ -31,6 +31,9 @@ def main() -> None:
     # SDXL-1024 first-compile is minutes on a tunneled chip; cached
     # recompiles are seconds (shared with the worker runtime)
     enable_persistent_compilation_cache()
+    # the worker's startup knob (node/worker.py startup) — bench must
+    # measure the same numerics the serving path runs
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
 
     from chiaswarm_tpu.pipelines.components import Components
     from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline, GenerateRequest
@@ -45,6 +48,7 @@ def main() -> None:
                                "30" if on_tpu else "4"))
     batch = int(os.environ.get("CHIASWARM_BENCH_BATCH", "1"))
     iters = int(os.environ.get("CHIASWARM_BENCH_ITERS", "3"))
+    attn = os.environ.get("CHIASWARM_BENCH_ATTN", "auto")
 
     if on_tpu:
         # host-side param materialization (no init program, no fp32 copy):
@@ -54,7 +58,7 @@ def main() -> None:
         c.params = jax.device_put(c.params, jax.devices()[0])
     else:
         c = Components.random(family, seed=0)
-    pipe = DiffusionPipeline(c)
+    pipe = DiffusionPipeline(c, attn_impl=attn)
 
     def run(seed: int) -> float:
         req = GenerateRequest(
@@ -80,6 +84,7 @@ def main() -> None:
         "vs_baseline": round(imgs_per_sec / target, 4),
         "p50_latency_s": round(p50, 3),
         "batch": batch,
+        "attn": attn,
         "backend": jax.default_backend(),
     }))
 

@@ -16,7 +16,9 @@ Capability parity with swarm/generator.py:12-95:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
+import os
 from typing import Any
 
 from chiaswarm_tpu import WORKER_VERSION
@@ -68,6 +70,23 @@ def _result(job_id: Any, artifacts: dict, config: dict,
     return result
 
 
+@contextlib.contextmanager
+def _maybe_profile(job_id):
+    """Per-job jax.profiler trace when CHIASWARM_PROFILE_DIR is set — the
+    tracing hook the reference lacks entirely (SURVEY.md §5: its only
+    telemetry is print statements). Traces open in XProf/TensorBoard."""
+    profile_dir = os.environ.get("CHIASWARM_PROFILE_DIR")
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    target = os.path.join(profile_dir, str(job_id or "job"))
+    with jax.profiler.trace(target):
+        yield
+    log.info("job %s profile written to %s", job_id, target)
+
+
 def synchronous_do_work(job: dict[str, Any], slot,
                         registry: ModelRegistry) -> dict[str, Any]:
     job = dict(job)
@@ -83,7 +102,8 @@ def synchronous_do_work(job: dict[str, Any], slot,
         return _result(job_id, artifacts, config, fatal=True)
 
     try:
-        artifacts, config = slot(callback, **kwargs)
+        with _maybe_profile(job_id):
+            artifacts, config = slot(callback, **kwargs)
     except ValueError as exc:  # callback-declared unrecoverable input error
         log.warning("job %s fatal: %s", job_id, exc)
         artifacts, config = _error_payload(exc, content_type)

@@ -54,6 +54,9 @@ class Settings:
     compile_cache_size: int = 4
     max_image_size: int = 1024
     default_steps: int = 30
+    health_port: int = 0  # >0 serves GET /healthz (SURVEY.md §5 gap fix)
+    health_host: str = "127.0.0.1"  # loopback by default (observability)
+    health_bind_ephemeral: bool = False  # tests: bind port 0, read address
 
     @staticmethod
     def _legacy_key_map() -> dict[str, str]:

@@ -97,6 +97,9 @@ class Worker:
 
     async def run(self) -> None:
         self.startup()
+        # bind the health endpoint BEFORE spawning workers: a port clash
+        # must fail fast, not leave unsupervised poll/slot tasks running
+        health_runner = await self._start_health_server()
         tasks = [
             asyncio.create_task(self._slot_worker(slot), name=f"slot{i}")
             for i, slot in enumerate(self.pool)
@@ -110,6 +113,49 @@ class Worker:
             for task in tasks:
                 task.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+            if health_runner is not None:
+                await health_runner.cleanup()
+
+    # ---- health endpoint (observability gap fix, SURVEY.md §5: the
+    # reference's only health signal is the hive's timeout detection) ----
+
+    def health(self) -> dict[str, Any]:
+        from chiaswarm_tpu import WORKER_VERSION
+
+        return {
+            "status": "ok",
+            "worker_version": WORKER_VERSION,
+            "worker_name": self.settings.worker_name,
+            "backend": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "slots": len(self.pool),
+            "jobs_done": self.jobs_done,
+            "queue_depth": self.work_queue.qsize(),
+            "results_pending": self.result_queue.qsize(),
+        }
+
+    async def _start_health_server(self):
+        port = int(self.settings.health_port or 0)
+        if port <= 0 and not self.settings.health_bind_ephemeral:
+            return None
+        from aiohttp import web
+
+        async def healthz(_request):
+            return web.json_response(self.health())
+
+        app = web.Application()
+        app.router.add_get("/healthz", healthz)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        # loopback by default: the endpoint is operator observability,
+        # not a service for arbitrary swarm peers
+        host = self.settings.health_host or "127.0.0.1"
+        site = web.TCPSite(runner, host, max(port, 0))
+        await site.start()
+        bound_port = runner.addresses[0][1] if runner.addresses else port
+        self.health_address = (host, bound_port)
+        log.info("health endpoint on %s:%d/healthz", host, bound_port)
+        return runner
 
     # ---- tasks ----
 

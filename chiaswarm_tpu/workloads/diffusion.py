@@ -133,8 +133,11 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
 
     if textual_inversion is not None:
         config["textual_inversion"] = textual_inversion
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    _, safety_fields = check_images(images, model_name)
+    config.update(safety_fields)
     config.update({
-        "nsfw": False,  # safety checker hook (workloads/safety.py) TBD
         "images_per_sec": round(images.shape[0] / max(elapsed, 1e-9), 4),
         "generation_s": round(elapsed, 3),
         "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),

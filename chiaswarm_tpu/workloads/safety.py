@@ -1,0 +1,128 @@
+"""NSFW safety checker — the result-trust boundary of an open network.
+
+Capability parity with the reference's reliance on diffusers' built-in
+``StableDiffusionSafetyChecker``: any flagged image marks the whole result
+``nsfw: True`` (swarm/diffusion/diffusion_func.py:99-111, OR-propagated at
+swarm/generator.py:37,76 and per-frame at swarm/video/pix2pix.py:68,84).
+
+Design: the checker is the standard CLIP-vision + concept-embedding
+cosine-similarity head. The vision tower runs through transformers' Flax
+CLIP (jit-compiled on the chip); the concept/special-care embeddings and
+thresholds convert from the safety-checker checkpoint
+(``safety_checker/`` subdir of an SD snapshot, or a standalone snapshot
+at ``<root>/models/CompVis__stable-diffusion-safety-checker``).
+
+When no checker checkpoint is present on the node the result carries
+``nsfw: False`` plus ``safety_checker: "unavailable"`` — an explicit
+signal to the hive rather than a silent pass.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger("chiaswarm.safety")
+
+_CACHE: dict[str, Any] = {}
+
+# CLIP preprocessing constants (openai/clip-vit-large-patch14)
+_MEAN = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
+_STD = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def _find_checker_dir(model_name: str | None = None) -> Path | None:
+    from chiaswarm_tpu.node.registry import model_dir
+
+    candidates = []
+    if model_name:
+        candidates.append(model_dir(model_name) / "safety_checker")
+    candidates.append(model_dir("CompVis/stable-diffusion-safety-checker"))
+    for cand in candidates:
+        if cand.is_dir():
+            return cand
+    return None
+
+
+class SafetyChecker:
+    """CLIP-vision + concept-cosine head, jitted once per image size."""
+
+    def __init__(self, checker_dir: Path) -> None:
+        import jax
+        import transformers
+
+        from chiaswarm_tpu.convert.torch_to_flax import read_torch_weights
+
+        self.vision = transformers.FlaxCLIPVisionModelWithProjection \
+            .from_pretrained(str(checker_dir), from_pt=True,
+                             local_files_only=True)
+        state = read_torch_weights(checker_dir)
+        self.concept_embeds = np.asarray(state["concept_embeds"])
+        self.concept_thresholds = np.asarray(
+            state["concept_embeds_weights"])
+        self.special_embeds = np.asarray(state["special_care_embeds"])
+        self.special_thresholds = np.asarray(
+            state["special_care_embeds_weights"])
+        self._jit_embed = jax.jit(
+            lambda pixel_values: self.vision(
+                pixel_values=pixel_values).image_embeds)
+
+    def __call__(self, images: np.ndarray) -> list[bool]:
+        """uint8 (B, H, W, 3) -> per-image nsfw flags."""
+        from PIL import Image
+
+        batch = []
+        for frame in images:
+            img = Image.fromarray(frame).resize((224, 224), Image.BICUBIC)
+            arr = np.asarray(img, np.float32) / 255.0
+            batch.append((arr - _MEAN) / _STD)
+        pixel_values = np.stack(batch).transpose(0, 3, 1, 2)  # NCHW
+
+        embeds = np.asarray(self._jit_embed(pixel_values))
+        embeds = embeds / np.linalg.norm(embeds, axis=-1, keepdims=True)
+
+        def cos(a, b):
+            bn = b / np.linalg.norm(b, axis=-1, keepdims=True)
+            return a @ bn.T
+
+        special = cos(embeds, self.special_embeds)       # (B, n_special)
+        concepts = cos(embeds, self.concept_embeds)      # (B, n_concepts)
+        flags = []
+        for i in range(embeds.shape[0]):
+            # special-care hits lower the concept threshold (the standard
+            # checker's adjustment semantics)
+            adjustment = 0.01 if np.any(
+                special[i] > self.special_thresholds) else 0.0
+            flags.append(bool(np.any(
+                concepts[i] > self.concept_thresholds - adjustment)))
+        return flags
+
+
+def get_checker(model_name: str | None = None) -> SafetyChecker | None:
+    """Resident checker, or None when no checkpoint exists on this node."""
+    checker_dir = _find_checker_dir(model_name)
+    if checker_dir is None:
+        return None
+    key = str(checker_dir)
+    if key not in _CACHE:
+        try:
+            _CACHE[key] = SafetyChecker(checker_dir)
+            log.info("safety checker loaded from %s", checker_dir)
+        except Exception as exc:
+            log.warning("safety checker at %s failed to load: %s",
+                        checker_dir, exc)
+            _CACHE[key] = None
+    return _CACHE[key]
+
+
+def check_images(images: np.ndarray,
+                 model_name: str | None = None) -> tuple[bool, dict]:
+    """OR-reduced nsfw flag + config fields (diffusion_func.py:99-111)."""
+    checker = get_checker(model_name)
+    if checker is None:
+        return False, {"nsfw": False, "safety_checker": "unavailable"}
+    flags = checker(np.asarray(images))
+    return any(flags), {"nsfw": any(flags), "nsfw_flags": flags}

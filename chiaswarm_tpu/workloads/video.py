@@ -175,7 +175,12 @@ def vid2vid_callback(slot, model_name: str, *, seed: int,
         out_frames.extend(images)
 
     artifacts = _video_artifacts(out_frames, fps, content_type)
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    # per-frame OR-ing, the reference's vid2vid semantics (pix2pix.py:68,84)
+    _, safety_fields = check_images(np.stack(out_frames), model_name)
     config = {
+        **safety_fields,
         "model_name": model_name,
         "frames": len(out_frames),
         "fps": fps,
@@ -220,8 +225,11 @@ def txt2vid_callback(slot, model_name: str, *, seed: int,
     elapsed = time.perf_counter() - t0
 
     artifacts = _video_artifacts(list(frames), float(fps), content_type)
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    _, safety_fields = check_images(frames, model_name)
+    config.update(safety_fields)
     config.update({
-        "nsfw": False,
         "fps": float(fps),
         "generation_s": round(elapsed, 3),
         "frames_per_sec": round(frames.shape[0] / max(elapsed, 1e-9), 4),

@@ -217,6 +217,43 @@ def test_worker_end_to_end(registry):
     asyncio.run(scenario())
 
 
+def test_worker_health_endpoint(registry):
+    """GET /healthz (SURVEY.md §5 observability gap fix): live counters
+    while the worker serves against the FakeHive."""
+    async def scenario():
+        import aiohttp
+
+        hive = FakeHive()
+        uri = await hive.start()
+        settings = Settings(hive_uri=uri, hive_token="t",
+                            worker_name="health-test",
+                            health_bind_ephemeral=True)  # port 0, no clash
+        worker = Worker(settings=settings, pool=ChipPool(n_slots=1),
+                        registry=registry)
+        task = asyncio.create_task(worker.run())
+        try:
+            for _ in range(50):
+                if getattr(worker, "health_address", None):
+                    break
+                await asyncio.sleep(0.1)
+            host, port = worker.health_address
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                        f"http://{host}:{port}/healthz") as resp:
+                    assert resp.status == 200
+                    payload = await resp.json()
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=10)
+            await hive.stop()
+        assert payload["status"] == "ok"
+        assert payload["worker_name"] == "health-test"
+        assert payload["slots"] == 1
+        assert "jobs_done" in payload and "queue_depth" in payload
+
+    asyncio.run(scenario())
+
+
 def test_worker_input_image_fetch(registry):
     """img2img through the worker: input image served by the FakeHive."""
 

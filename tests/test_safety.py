@@ -1,0 +1,61 @@
+"""NSFW safety checker: threshold head logic + unavailable-checker signal.
+
+Reference behavior covered: diffusers-checker reliance with whole-result
+OR-propagation (swarm/diffusion/diffusion_func.py:99-111,
+swarm/generator.py:37,76).
+"""
+
+import numpy as np
+
+from chiaswarm_tpu.workloads.safety import SafetyChecker, check_images
+
+
+def _stub_checker(embed_rows: np.ndarray) -> SafetyChecker:
+    """SafetyChecker with a fabricated embedding head (no CLIP weights)."""
+    checker = SafetyChecker.__new__(SafetyChecker)
+    checker.concept_embeds = np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    checker.concept_thresholds = np.asarray([0.9, 0.9], np.float32)
+    checker.special_embeds = np.asarray([[0.7071, 0.7071]], np.float32)
+    checker.special_thresholds = np.asarray([0.94], np.float32)
+    rows = iter(np.atleast_2d(embed_rows).astype(np.float32))
+    checker._jit_embed = lambda pixel_values: np.stack(
+        [next(rows) for _ in range(pixel_values.shape[0])])
+    return checker
+
+
+def _images(n):
+    return np.zeros((n, 8, 8, 3), np.uint8)
+
+
+def test_concept_hit_flags_image():
+    checker = _stub_checker(np.asarray([[10.0, 0.0]]))  # cos vs concept0 = 1
+    assert checker(_images(1)) == [True]
+
+
+def test_orthogonal_embedding_is_clean():
+    checker = _stub_checker(np.asarray([[1.0, -1.0]]))  # cos .707/-0.707 < .9
+    assert checker(_images(1)) == [False]
+
+
+def test_special_care_lowers_threshold():
+    # cos vs concept0 ~0.894 (< 0.9), but special-care cos ~0.949
+    # (> 0.94) lowers thresholds by 0.01 -> 0.89 -> flagged
+    v = np.asarray([[0.9, 0.45]])
+    checker = _stub_checker(v)
+    assert checker(_images(1)) == [True]
+    # same vector without the special-care hit stays clean
+    checker2 = _stub_checker(v)
+    checker2.special_thresholds = np.asarray([2.0], np.float32)  # never hits
+    assert checker2(_images(1)) == [False]
+
+
+def test_batch_flags_are_per_image():
+    checker = _stub_checker(np.asarray([[10.0, 0.0], [1.0, -1.0]]))
+    assert checker(_images(2)) == [True, False]
+
+
+def test_unavailable_checker_is_explicit(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    nsfw, fields = check_images(_images(1), "some/model")
+    assert nsfw is False
+    assert fields["safety_checker"] == "unavailable"

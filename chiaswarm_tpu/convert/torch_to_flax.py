@@ -510,6 +510,60 @@ def load_audio_checkpoint(checkpoint_dir: str | Path, model_name: str,
     )
 
 
+# ------------------------------------------------------- safety checker
+
+def convert_safety_checker(state: Mapping[str, np.ndarray],
+                           ) -> tuple[dict, dict[str, np.ndarray]]:
+    """``StableDiffusionSafetyChecker`` state dict -> (ClipVisionEncoder
+    params, concept buffers). ONE pass over the file: the CLIP vision
+    trunk (nested under ``vision_model.vision_model.``), the visual
+    projection, and the four concept-embedding buffers."""
+    flat: dict[str, np.ndarray] = {}
+    buffers: dict[str, np.ndarray] = {}
+    trunk = "vision_model.vision_model."
+    for key, value in state.items():
+        if key in ("concept_embeds", "concept_embeds_weights",
+                   "special_care_embeds", "special_care_embeds_weights"):
+            buffers[key] = value
+            continue
+        if key == "visual_projection.weight":
+            flat["visual_projection/kernel"] = value.T
+            continue
+        if not key.startswith(trunk):
+            log.debug("safety checker conversion skipped %s", key)
+            continue
+        rest = key[len(trunk):]
+        parts = rest.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        if body[:2] == ["embeddings", "class_embedding"] or \
+                rest == "embeddings.class_embedding":
+            flat["class_embedding"] = value
+        elif body[:2] == ["embeddings", "patch_embedding"]:
+            flat["patch_embedding/kernel"] = value.transpose(2, 3, 1, 0)
+        elif body[:2] == ["embeddings", "position_embedding"]:
+            flat["position_embedding/embedding"] = value
+        elif body[:1] == ["pre_layrnorm"]:
+            _place(flat, "pre_layrnorm", name, value)
+        elif body[:1] == ["post_layernorm"]:
+            _place(flat, "post_layernorm", name, value)
+        elif body[:2] == ["encoder", "layers"]:
+            i, sub = body[2], body[3]
+            if sub == "self_attn":
+                _place(flat, f"layers_{i}/self_attn/{body[4]}", name, value)
+            elif sub in ("layer_norm1", "layer_norm2"):
+                _place(flat, f"layers_{i}/{sub}", name, value)
+            elif sub == "mlp":
+                _place(flat, f"layers_{i}/{body[4]}", name, value)
+    missing = [k for k in ("concept_embeds", "concept_embeds_weights",
+                           "special_care_embeds",
+                           "special_care_embeds_weights")
+               if k not in buffers]
+    if missing:
+        raise ValueError(f"safety checker state is missing {missing}")
+    return _nest(flat), buffers
+
+
 # ------------------------------------------------------------- top level
 
 _SUBDIR_CANDIDATES = {

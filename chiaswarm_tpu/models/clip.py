@@ -13,6 +13,8 @@ negligible next to the denoise loop.
 
 from __future__ import annotations
 
+import dataclasses
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -68,6 +70,61 @@ class ClipLayer(nn.Module):
         x = _act(cfg.hidden_act)(x)
         x = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(x)
         return residual + x
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    """CLIP vision tower (ViT-L/14 defaults — the safety checker's trunk).
+    Field names match TextEncoderConfig so ClipLayer/ClipAttention reuse."""
+
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    hidden_act: str = "quick_gelu"
+    image_size: int = 224
+    patch_size: int = 14
+    projection_dim: int = 768
+
+
+class ClipVisionEncoder(nn.Module):
+    """(B, H, W, 3) preprocessed pixels -> (B, projection_dim) image embeds.
+
+    The image tower of the NSFW safety checker (workloads/safety.py) —
+    patch conv + CLS token + pre-LN ViT + post-LN CLS readout + visual
+    projection, reusing the text encoder's transformer blocks.
+    """
+
+    config: VisionConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        b = pixel_values.shape[0]
+        patches = nn.Conv(
+            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), use_bias=False,
+            dtype=self.dtype, name="patch_embedding",
+        )(pixel_values.astype(self.dtype))
+        patches = patches.reshape(b, -1, cfg.hidden_size)
+        cls = self.param("class_embedding",
+                         nn.initializers.normal(0.02),
+                         (cfg.hidden_size,))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.hidden_size)), patches], axis=1)
+        n_pos = (cfg.image_size // cfg.patch_size) ** 2 + 1
+        pos = nn.Embed(n_pos, cfg.hidden_size, dtype=self.dtype,
+                       name="position_embedding")(jnp.arange(x.shape[1]))
+        x = x + pos[None]
+        x = nn.LayerNorm(dtype=self.dtype, name="pre_layrnorm")(x)
+        mask = jnp.zeros((1, 1, x.shape[1], x.shape[1]), jnp.float32)
+        for i in range(cfg.num_layers):
+            x = ClipLayer(cfg, dtype=self.dtype, name=f"layers_{i}")(x, mask)
+        pooled = nn.LayerNorm(dtype=self.dtype,
+                              name="post_layernorm")(x[:, 0])
+        return nn.Dense(cfg.projection_dim, use_bias=False,
+                        dtype=self.dtype, name="visual_projection")(pooled)
 
 
 class ClipTextEncoder(nn.Module):

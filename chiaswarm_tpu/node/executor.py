@@ -19,6 +19,7 @@ import asyncio
 import contextlib
 import logging
 import os
+import threading
 from typing import Any
 
 from chiaswarm_tpu import WORKER_VERSION
@@ -70,21 +71,35 @@ def _result(job_id: Any, artifacts: dict, config: dict,
     return result
 
 
+_PROFILE_LOCK = threading.Lock()
+
+
 @contextlib.contextmanager
 def _maybe_profile(job_id):
     """Per-job jax.profiler trace when CHIASWARM_PROFILE_DIR is set — the
     tracing hook the reference lacks entirely (SURVEY.md §5: its only
-    telemetry is print statements). Traces open in XProf/TensorBoard."""
+    telemetry is print statements). Traces open in XProf/TensorBoard.
+
+    jax.profiler is a process-global singleton: on multi-slot workers,
+    overlapping jobs skip profiling (the job must not fail because a
+    trace was already running)."""
     profile_dir = os.environ.get("CHIASWARM_PROFILE_DIR")
     if not profile_dir:
         yield
         return
-    import jax
-
-    target = os.path.join(profile_dir, str(job_id or "job"))
-    with jax.profiler.trace(target):
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        log.info("job %s not profiled: another trace is running", job_id)
         yield
-    log.info("job %s profile written to %s", job_id, target)
+        return
+    try:
+        import jax
+
+        target = os.path.join(profile_dir, str(job_id or "job"))
+        with jax.profiler.trace(target):
+            yield
+        log.info("job %s profile written to %s", job_id, target)
+    finally:
+        _PROFILE_LOCK.release()
 
 
 def synchronous_do_work(job: dict[str, Any], slot,

@@ -20,6 +20,12 @@ Head dims of SD UNets (40/80/160/64/128) are zero-padded up to the 128-lane
 tile; padded lanes contribute zero logits and zero values, so results are
 exact. Sequence lengths pad up to the block size with -inf-masked logits.
 
+Default blocks (2048 q x 1024 kv) come from an end-to-end sweep on v5e
+(SDXL 1024px, 30 steps): 256x256 ran 6.98 s/image, XLA's fused attention
+5.07 s, 2048x1024 3.98 s; 2048x2048 and 4096x1024 exceed the 16 MB VMEM
+scoped limit. Large q blocks amortize the running-softmax scratch traffic;
+the kernel clamps blocks to the (padded) sequence length for small inputs.
+
 The same kernel runs in Pallas interpret mode on CPU, which is how the
 hermetic test suite validates it against the einsum reference
 (tests/test_ops.py) without a TPU.
@@ -111,8 +117,8 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     scale: float | None = None,
-    block_q: int = 256,
-    block_kv: int = 256,
+    block_q: int = 2048,
+    block_kv: int = 1024,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Blockwise attention over (B, L, H, D) q and (B, S, H, D) k/v."""

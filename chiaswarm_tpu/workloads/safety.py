@@ -6,9 +6,10 @@ Capability parity with the reference's reliance on diffusers' built-in
 swarm/generator.py:37,76 and per-frame at swarm/video/pix2pix.py:68,84).
 
 Design: the checker is the standard CLIP-vision + concept-embedding
-cosine-similarity head. The vision tower runs through transformers' Flax
-CLIP (jit-compiled on the chip); the concept/special-care embeddings and
-thresholds convert from the safety-checker checkpoint
+cosine-similarity head. The vision tower is this framework's native Flax
+ClipVisionEncoder (models/clip.py, jit-compiled on the chip); the trunk
+weights, concept/special-care embeddings and thresholds all convert from
+the safety-checker checkpoint in one pass
 (``safety_checker/`` subdir of an SD snapshot, or a standalone snapshot
 at ``<root>/models/CompVis__stable-diffusion-safety-checker``).
 
@@ -47,39 +48,53 @@ def _find_checker_dir(model_name: str | None = None) -> Path | None:
     return None
 
 
+def _clip_preprocess(frame: np.ndarray, size: int = 224) -> np.ndarray:
+    """CLIP's shortest-edge resize + center crop (NOT a plain squash —
+    anisotropic resizing shifts cosine scores near the thresholds on
+    non-square video frames)."""
+    from PIL import Image
+
+    img = Image.fromarray(frame)
+    w, h = img.size
+    scale = size / min(w, h)
+    img = img.resize((max(size, round(w * scale)),
+                      max(size, round(h * scale))), Image.BICUBIC)
+    left = (img.width - size) // 2
+    top = (img.height - size) // 2
+    img = img.crop((left, top, left + size, top + size))
+    arr = np.asarray(img, np.float32) / 255.0
+    return (arr - _MEAN) / _STD
+
+
 class SafetyChecker:
-    """CLIP-vision + concept-cosine head, jitted once per image size."""
+    """Native CLIP-vision tower + concept-cosine head (models/clip.py
+    ClipVisionEncoder), converted from the torch checker in ONE file pass.
+    """
 
     def __init__(self, checker_dir: Path) -> None:
         import jax
-        import transformers
 
-        from chiaswarm_tpu.convert.torch_to_flax import read_torch_weights
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_safety_checker,
+            read_torch_weights,
+        )
+        from chiaswarm_tpu.models.clip import ClipVisionEncoder, VisionConfig
 
-        self.vision = transformers.FlaxCLIPVisionModelWithProjection \
-            .from_pretrained(str(checker_dir), from_pt=True,
-                             local_files_only=True)
-        state = read_torch_weights(checker_dir)
-        self.concept_embeds = np.asarray(state["concept_embeds"])
+        params, buffers = convert_safety_checker(
+            read_torch_weights(checker_dir))
+        self.concept_embeds = np.asarray(buffers["concept_embeds"])
         self.concept_thresholds = np.asarray(
-            state["concept_embeds_weights"])
-        self.special_embeds = np.asarray(state["special_care_embeds"])
+            buffers["concept_embeds_weights"])
+        self.special_embeds = np.asarray(buffers["special_care_embeds"])
         self.special_thresholds = np.asarray(
-            state["special_care_embeds_weights"])
+            buffers["special_care_embeds_weights"])
+        vision = ClipVisionEncoder(VisionConfig())
         self._jit_embed = jax.jit(
-            lambda pixel_values: self.vision(
-                pixel_values=pixel_values).image_embeds)
+            lambda pixel_values: vision.apply(params, pixel_values))
 
     def __call__(self, images: np.ndarray) -> list[bool]:
         """uint8 (B, H, W, 3) -> per-image nsfw flags."""
-        from PIL import Image
-
-        batch = []
-        for frame in images:
-            img = Image.fromarray(frame).resize((224, 224), Image.BICUBIC)
-            arr = np.asarray(img, np.float32) / 255.0
-            batch.append((arr - _MEAN) / _STD)
-        pixel_values = np.stack(batch).transpose(0, 3, 1, 2)  # NCHW
+        pixel_values = np.stack([_clip_preprocess(f) for f in images])
 
         embeds = np.asarray(self._jit_embed(pixel_values))
         embeds = embeds / np.linalg.norm(embeds, axis=-1, keepdims=True)

@@ -59,3 +59,99 @@ def test_unavailable_checker_is_explicit(tmp_path, monkeypatch):
     nsfw, fields = check_images(_images(1), "some/model")
     assert nsfw is False
     assert fields["safety_checker"] == "unavailable"
+
+
+def test_clip_preprocess_center_crops():
+    from chiaswarm_tpu.workloads.safety import _MEAN, _STD, _clip_preprocess
+
+    # wide frame: left half black, right half white; the center crop
+    # must cover the middle (mixed), not squash the full width
+    frame = np.zeros((100, 400, 3), np.uint8)
+    frame[:, 200:] = 255
+    out = _clip_preprocess(frame)
+    assert out.shape == (224, 224, 3)
+    restored = out * _STD + _MEAN
+    assert restored[:, :100].mean() < 0.1   # left of crop: black
+    assert restored[:, -100:].mean() > 0.9  # right of crop: white
+
+
+def test_convert_safety_checker_and_real_tower(tmp_path):
+    """End-to-end real-code path: fabricate a tiny torch-layout checker
+    state dict, convert it, run the native vision tower, hit a concept."""
+    import jax
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_safety_checker
+    from chiaswarm_tpu.models.clip import ClipVisionEncoder, VisionConfig
+    from chiaswarm_tpu.workloads.safety import SafetyChecker
+
+    cfg = VisionConfig(hidden_size=16, intermediate_size=32, num_layers=2,
+                       num_heads=2, image_size=28, patch_size=14,
+                       projection_dim=8)
+    vision = ClipVisionEncoder(cfg)
+    params = vision.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 28, 28, 3), np.float32))
+
+    # round-trip: flax tree -> torch-layout flat dict -> converter
+    p = params["params"]
+    rng = np.random.default_rng(0)
+    state = {
+        "vision_model.vision_model.embeddings.class_embedding":
+            np.asarray(p["class_embedding"]),
+        "vision_model.vision_model.embeddings.patch_embedding.weight":
+            np.asarray(p["patch_embedding"]["kernel"]).transpose(3, 2, 0, 1),
+        "vision_model.vision_model.embeddings.position_embedding.weight":
+            np.asarray(p["position_embedding"]["embedding"]),
+        "vision_model.vision_model.pre_layrnorm.weight":
+            np.asarray(p["pre_layrnorm"]["scale"]),
+        "vision_model.vision_model.pre_layrnorm.bias":
+            np.asarray(p["pre_layrnorm"]["bias"]),
+        "vision_model.vision_model.post_layernorm.weight":
+            np.asarray(p["post_layernorm"]["scale"]),
+        "vision_model.vision_model.post_layernorm.bias":
+            np.asarray(p["post_layernorm"]["bias"]),
+        "visual_projection.weight":
+            np.asarray(p["visual_projection"]["kernel"]).T,
+        "concept_embeds": rng.normal(size=(3, 8)).astype(np.float32),
+        "concept_embeds_weights": np.full((3,), 2.0, np.float32),  # never hit
+        "special_care_embeds": rng.normal(size=(1, 8)).astype(np.float32),
+        "special_care_embeds_weights": np.full((1,), 2.0, np.float32),
+    }
+    for i in range(cfg.num_layers):
+        lp = p[f"layers_{i}"]
+        pre = f"vision_model.vision_model.encoder.layers.{i}"
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            state[f"{pre}.self_attn.{proj}.weight"] = \
+                np.asarray(lp["self_attn"][proj]["kernel"]).T
+            state[f"{pre}.self_attn.{proj}.bias"] = \
+                np.asarray(lp["self_attn"][proj]["bias"])
+        for ln in ("layer_norm1", "layer_norm2"):
+            state[f"{pre}.{ln}.weight"] = np.asarray(lp[ln]["scale"])
+            state[f"{pre}.{ln}.bias"] = np.asarray(lp[ln]["bias"])
+        for fc in ("fc1", "fc2"):
+            state[f"{pre}.mlp.{fc}.weight"] = np.asarray(lp[fc]["kernel"]).T
+            state[f"{pre}.mlp.{fc}.bias"] = np.asarray(lp[fc]["bias"])
+
+    converted, buffers = convert_safety_checker(state)
+    pixels = rng.normal(size=(2, 28, 28, 3)).astype(np.float32)
+    want = vision.apply(params, pixels)
+    got = vision.apply(converted, pixels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    # real SafetyChecker flow over the converted artifacts: with impossible
+    # thresholds nothing flags; with the first concept aligned to an actual
+    # embedding, that image flags
+    checker = SafetyChecker.__new__(SafetyChecker)
+    checker.concept_embeds = buffers["concept_embeds"]
+    checker.concept_thresholds = buffers["concept_embeds_weights"]
+    checker.special_embeds = buffers["special_care_embeds"]
+    checker.special_thresholds = buffers["special_care_embeds_weights"]
+    emb = np.asarray(got)
+
+    def fake_vision(pixel_values):
+        return emb[: pixel_values.shape[0]]
+
+    checker._jit_embed = fake_vision
+    assert checker(_images(2)) == [False, False]
+    checker.concept_embeds = emb[:1]
+    checker.concept_thresholds = np.asarray([0.99], np.float32)
+    assert checker(_images(2))[0] is True

@@ -92,17 +92,30 @@ def prefetch_checkpoints(models: list[dict[str, Any]],
 
 
 def warm_compile(models: list[dict[str, Any]]) -> None:
-    """Ahead-of-time compile the default shape bucket per local model."""
+    """Ahead-of-time compile the default shape bucket per local model.
+
+    Warms the SAME cache entries serving will hit: the worker's default
+    slot mesh keys the pipeline entry (node/registry.py), so warming
+    without it would leave a dead unsharded duplicate and pay the full
+    load+compile again on the first real job."""
+    from chiaswarm_tpu.core.chip_pool import ChipPool
     from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.settings import load_settings
     from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
 
+    settings = load_settings()
+    from chiaswarm_tpu.core.mesh import MeshSpec
+
+    spec = (MeshSpec(dict(settings.mesh_shape))
+            if settings.mesh_shape else None)
+    mesh = ChipPool(n_slots=1, mesh_spec=spec).slots[0].mesh
     registry = ModelRegistry(catalog=models, allow_random=False)
     for model in models:
         name = model.get("name") or model.get("model_name")
         if not name or not model_dir(name).exists():
             continue
         try:
-            pipe = registry.pipeline(name)
+            pipe = registry.pipeline(name, mesh=mesh)
             size = pipe.c.family.default_size
             pipe(GenerateRequest(prompt="warmup", steps=2, height=size,
                                  width=size, seed=0))

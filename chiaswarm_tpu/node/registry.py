@@ -35,6 +35,32 @@ def model_dir(model_name: str) -> Path:
     return settings_root() / "models" / model_name.replace("/", "__")
 
 
+def _mesh_cache_key(mesh) -> tuple | None:
+    """Cache-key identity for a slot mesh (None -> default placement)."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), mesh.devices.shape,
+            tuple(d.id for d in mesh.devices.flatten()))
+
+
+def _place_params(params, mesh, model_name: str):
+    """Put a param tree where its slot executes: tensor-parallel shardings
+    for >1-chip meshes, plain placement on the slot's chip otherwise."""
+    if mesh is None:
+        return params
+    import jax
+
+    if mesh.devices.size > 1:
+        from chiaswarm_tpu.parallel import shard_params
+
+        log.info("sharding %s params over mesh %s", model_name,
+                 dict(zip(mesh.axis_names, mesh.devices.shape)))
+        return shard_params(params, mesh)
+    device = mesh.devices.flatten()[0]
+    log.info("placing %s params on %s", model_name, device)
+    return jax.device_put(params, device)
+
+
 class ModelRegistry:
     def __init__(self, catalog: list[dict] | None = None,
                  allow_random: bool = False,
@@ -83,14 +109,26 @@ class ModelRegistry:
         )
 
     def pipeline(self, model_name: str,
-                 textual_inversion: str | None = None):
+                 textual_inversion: str | None = None,
+                 mesh=None):
         """Resident pipeline (components + params + compiled executables),
         one LRU entry under the HBM byte budget: evicting the entry drops
         the only strong reference to the param tree. The pipeline class is
         selected by the family's ``kind`` ("sd" -> DiffusionPipeline,
         "upscaler" -> LatentUpscalePipeline). A textual inversion keys a
         SEPARATE entry: the concept rows merge into that entry's private
-        embedding table (convert/textual_inversion.py), never the base's."""
+        embedding table (convert/textual_inversion.py), never the base's.
+
+        ``mesh`` (a MeshSlot's mesh) places the params: >1 chip shards
+        them — Megatron-style tensor parallel on the ``model`` axis, data
+        parallel batches on ``data`` (parallel/sharding.py; the pipeline
+        seeds batch sharding by placing its token inputs on the ``data``
+        axis) — and a single-chip slot mesh pins them to THAT chip so
+        per-device slots do not all serialize on the default device.
+        """
+        mesh_key = _mesh_cache_key(mesh)
+        if mesh_key is None:
+            mesh = None
 
         def build():
             components = self._load_components(model_name)
@@ -107,6 +145,10 @@ class ModelRegistry:
                         f"available on this node (no file at {ti_dir})"
                     )
                 apply_textual_inversion(components, load_embeddings(ti_dir))
+            # place AFTER the embedding-table merge so the enlarged tree
+            # gets uniform placement
+            components.params = _place_params(components.params, mesh,
+                                              model_name)
             if components.family.kind == "upscaler":
                 from chiaswarm_tpu.pipelines.upscale import (
                     LatentUpscalePipeline,
@@ -117,14 +159,14 @@ class ModelRegistry:
             return DiffusionPipeline(components, attn_impl=self.attn_impl)
 
         return GLOBAL_CACHE.cached_params(
-            ("pipeline", model_name, textual_inversion), build,
+            ("pipeline", model_name, textual_inversion, mesh_key), build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
     def components(self, model_name: str) -> Components:
         return self.pipeline(model_name).c
 
-    def cascade_pipeline(self, model_name: str):
+    def cascade_pipeline(self, model_name: str, mesh=None):
         """Resident IF-class cascade (pipelines/cascade.py) — the
         ``DeepFloyd/`` dispatch target (swarm/job_arguments.py:39-40)."""
         from chiaswarm_tpu.pipelines.cascade import (
@@ -132,6 +174,8 @@ class ModelRegistry:
             CascadePipeline,
             get_cascade_family,
         )
+
+        mesh_key = _mesh_cache_key(mesh)
 
         def build():
             ckpt = model_dir(model_name)
@@ -142,20 +186,24 @@ class ModelRegistry:
                 )
 
                 log.info("loading cascade %s from %s", model_name, ckpt)
-                return CascadePipeline(
-                    load_cascade_checkpoint(ckpt, model_name, family))
-            if self.allow_random:
+                components = load_cascade_checkpoint(ckpt, model_name,
+                                                     family)
+            elif self.allow_random:
                 log.warning("no checkpoint for cascade %s; using random "
                             "weights", model_name)
-                return CascadePipeline(CascadeComponents.random(
-                    family, model_name=model_name))
-            raise ValueError(
-                f"cascade model {model_name!r} is not available on this "
-                f"node (no checkpoint at {ckpt})"
-            )
+                components = CascadeComponents.random(family,
+                                                      model_name=model_name)
+            else:
+                raise ValueError(
+                    f"cascade model {model_name!r} is not available on this "
+                    f"node (no checkpoint at {ckpt})"
+                )
+            components.params = _place_params(components.params, mesh,
+                                              model_name)
+            return CascadePipeline(components)
 
         return GLOBAL_CACHE.cached_params(
-            ("cascade", model_name), build,
+            ("cascade", model_name, mesh_key), build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
@@ -194,7 +242,7 @@ class ModelRegistry:
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
-    def video_pipeline(self, model_name: str):
+    def video_pipeline(self, model_name: str, mesh=None):
         """Resident ModelScope-class txt2vid pipeline
         (swarm/video/tx2vid.py:17-57 parity, pipelines/video.py)."""
         from chiaswarm_tpu.pipelines.video import (
@@ -203,20 +251,24 @@ class ModelRegistry:
             get_video_family,
         )
 
+        mesh_key = _mesh_cache_key(mesh)
+
         def build():
             family = get_video_family(model_name)
             if self.allow_random:
                 log.warning("video model %s: using random weights",
                             model_name)
-                return VideoPipeline(
-                    VideoComponents.random(family, model_name=model_name),
-                    attn_impl=self.attn_impl)
+                components = VideoComponents.random(family,
+                                                    model_name=model_name)
+                components.params = _place_params(components.params, mesh,
+                                                  model_name)
+                return VideoPipeline(components, attn_impl=self.attn_impl)
             raise ValueError(
                 f"video model {model_name!r} is not available on this node"
             )
 
         return GLOBAL_CACHE.cached_params(
-            ("video", model_name), build,
+            ("video", model_name, mesh_key), build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 

@@ -77,6 +77,19 @@ class GenerateRequest:
     image_guidance_scale: float = 1.5      # traced; never recompiles
 
 
+def _params_mesh(params):
+    """The dp x tp mesh the params are sharded over, or None (single-chip
+    or unsharded)."""
+    from jax.sharding import NamedSharding
+
+    for leaf in jax.tree.leaves(params):
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, NamedSharding) and "data" in s.mesh.shape \
+                and s.mesh.devices.size > 1:
+            return s.mesh
+    return None
+
+
 def _to_float_image(img: np.ndarray) -> np.ndarray:
     img = np.asarray(img)
     if img.dtype == np.uint8:
@@ -403,8 +416,27 @@ class DiffusionPipeline:
             control_cond = jnp.asarray(np.clip(cond, 0.0, 1.0))[None]
             control_params = req.controlnet.params
 
-        ids = self._tokenize([req.prompt] * batch)
-        neg = self._tokenize([req.negative_prompt or ""] * batch)
+        ids = [jnp.asarray(i) for i in self._tokenize([req.prompt] * batch)]
+        neg = [jnp.asarray(i) for i in
+               self._tokenize([req.negative_prompt or ""] * batch)]
+
+        # data parallelism: when the params live on a dp x tp mesh, seed
+        # GSPMD's batch-dim propagation by placing the token inputs (and a
+        # batch-shaped init) on the 'data' axis — weight sharding alone
+        # leaves the batch replicated
+        mesh = _params_mesh(self.c.params)
+        if mesh is not None and batch % mesh.shape["data"] == 0:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            row = NamedSharding(mesh, P("data", None))
+            ids = [jax.device_put(i, row) for i in ids]
+            neg = [jax.device_put(i, row) for i in neg]
+            if getattr(init_latent, "ndim", 0) == 4 and \
+                    init_latent.shape[0] == batch:
+                init_latent = jax.device_put(
+                    init_latent,
+                    NamedSharding(mesh, P("data", None, None, None)))
 
         fn = self._get_fn(
             batch=batch, height=height, width=width, steps=steps,
@@ -414,8 +446,8 @@ class DiffusionPipeline:
         )
         img = fn(
             self.c.params,
-            [jnp.asarray(i) for i in ids],
-            [jnp.asarray(i) for i in neg],
+            ids,
+            neg,
             key_for_seed(req.seed),
             jnp.float32(req.guidance_scale),
             init_latent,

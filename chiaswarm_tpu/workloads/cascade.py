@@ -30,7 +30,8 @@ def cascade_callback(slot, model_name: str, *, seed: int,
                      upscaler_model_name: str = (
                          "stabilityai/sd-x2-latent-upscaler"),
                      **_ignored: Any):
-    pipe = registry.cascade_pipeline(model_name)
+    pipe = registry.cascade_pipeline(model_name,
+                                     mesh=getattr(slot, "mesh", None))
 
     t0 = time.perf_counter()
     images, config = pipe(
@@ -46,7 +47,8 @@ def cascade_callback(slot, model_name: str, *, seed: int,
     if upscale:
         # stage 3: two x2 latent-upscale passes (256 -> 512 -> 1024),
         # replacing diffusion_func_if.py:31-40's SD-x4-upscaler stage
-        upscaler = registry.pipeline(upscaler_model_name)
+        upscaler = registry.pipeline(
+            upscaler_model_name, mesh=getattr(slot, "mesh", None))
         for _ in range(2):
             images, up_config = upscaler(images, prompt=prompt or "",
                                          seed=seed)

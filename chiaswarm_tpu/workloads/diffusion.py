@@ -45,7 +45,8 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        textual_inversion: str | None = None,
                        outputs: tuple[str, ...] = ("primary",),
                        **_ignored: Any):
-    pipe = registry.pipeline(model_name, textual_inversion=textual_inversion)
+    pipe = registry.pipeline(model_name, textual_inversion=textual_inversion,
+                             mesh=getattr(slot, "mesh", None))
     fam = pipe.c.family
     if fam.kind != "sd":
         raise ValueError(
@@ -118,7 +119,8 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
     if upscale:
         # x2 latent upscale pass over the generated images, 20 steps at
         # guidance 0 (swarm/diffusion/upscale.py:6-32)
-        upscaler = registry.pipeline(upscaler_model_name)
+        upscaler = registry.pipeline(upscaler_model_name,
+                                     mesh=getattr(slot, "mesh", None))
         images, up_config = upscaler(images, prompt=prompt or "", seed=seed)
         config.update(up_config)
 

@@ -154,7 +154,8 @@ def vid2vid_callback(slot, model_name: str, *, seed: int,
             os.unlink(path)
     fps = float(fps or 8.0)
 
-    pipe = registry.pipeline(model_name)
+    pipe = registry.pipeline(model_name,
+                             mesh=getattr(slot, "mesh", None))
     h, w = frames[0].shape[:2]
     if image_guidance_scale is not None:
         # reference remap arrives as image_guidance_scale = strength*5
@@ -210,7 +211,8 @@ def txt2vid_callback(slot, model_name: str, *, seed: int,
     heuristics (tx2vid.py:36-53 has no TPU analog)."""
     import time
 
-    pipe = registry.video_pipeline(model_name)
+    pipe = registry.video_pipeline(model_name,
+                                   mesh=getattr(slot, "mesh", None))
     t0 = time.perf_counter()
     frames, config = pipe(
         prompt or "",

@@ -1,0 +1,58 @@
+"""Multi-chip serving path: a workload on a >1-chip MeshSlot shards the
+resident params (tp over 'model', dp over 'data') through the registry —
+the production wiring of the dryrun's manual sharding (__graft_entry__).
+Runs on the virtual 8-device CPU mesh (tests/conftest.py).
+"""
+
+import numpy as np
+
+from chiaswarm_tpu.core.chip_pool import ChipPool
+from chiaswarm_tpu.core.mesh import MeshSpec
+from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.workloads.diffusion import diffusion_callback
+
+
+def test_multichip_slot_shards_params_and_generates():
+    import jax
+
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    slot = pool.slots[0]
+    assert slot.mesh.devices.size == 8
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    artifacts, config = diffusion_callback(
+        slot, "random/tiny", seed=5, registry=registry,
+        prompt="a harbor", num_inference_steps=2, height=64, width=64,
+        num_images_per_prompt=4)
+    assert "primary" in artifacts
+    assert config["mode"] == "txt2img"
+
+    # the resident params must actually live on the slot mesh AND some
+    # weight must be tensor-parallel partitioned (not merely replicated)
+    pipe = registry.pipeline("random/tiny", mesh=slot.mesh)
+    leaves = jax.tree.leaves(pipe.c.params)
+    specs = {str(leaf.sharding.spec) for leaf in leaves
+             if hasattr(leaf.sharding, "spec")}
+    assert any("model" in s for s in specs), specs
+
+    # single-chip mesh keys separately and stays unsharded
+    single = registry.pipeline("random/tiny")
+    assert single is not pipe
+
+
+def test_multichip_matches_single_chip_output():
+    """Sharded serving must agree with single-chip up to partitioned-
+    reduction rounding (XLA reorders float reductions across shards, so
+    bit-exactness is not guaranteed — near-equality is)."""
+    from chiaswarm_tpu.pipelines import GenerateRequest
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+
+    req = GenerateRequest(prompt="dunes", steps=2, height=64, width=64,
+                          seed=9, guidance_scale=5.0)
+    single_img, _ = registry.pipeline("random/tiny")(req)
+    multi_img, _ = registry.pipeline("random/tiny",
+                                     mesh=pool.slots[0].mesh)(req)
+    diff = np.abs(single_img.astype(np.int32) - multi_img.astype(np.int32))
+    assert (diff <= 2).mean() > 0.99, diff.max()

@@ -592,3 +592,100 @@ def load_checkpoint(checkpoint_dir: str | Path,
             read_torch_weights(checkpoint_dir / sub)
         )
     return params
+
+
+# ------------------------------------------------------------------ BLIP
+
+def _blip_linear(flat: dict, state: Mapping[str, np.ndarray],
+                 torch_key: str, name: str) -> None:
+    flat[f"{name}/kernel"] = np.ascontiguousarray(state[f"{torch_key}.weight"].T)
+    if f"{torch_key}.bias" in state:
+        flat[f"{name}/bias"] = state[f"{torch_key}.bias"]
+
+
+def _blip_ln(flat: dict, state: Mapping[str, np.ndarray],
+             torch_key: str, name: str) -> None:
+    flat[f"{name}/scale"] = state[f"{torch_key}.weight"]
+    flat[f"{name}/bias"] = state[f"{torch_key}.bias"]
+
+
+def convert_blip_vision(state: Mapping[str, np.ndarray],
+                        prefix: str = "vision_model.") -> dict:
+    """HF ``BlipVisionModel`` state dict -> models/blip.py vision tree."""
+    s = {k[len(prefix):]: v for k, v in state.items() if k.startswith(prefix)}
+    flat: dict[str, np.ndarray] = {}
+    flat["class_embedding"] = s["embeddings.class_embedding"].reshape(-1)
+    flat["position_embedding"] = s["embeddings.position_embedding"].reshape(
+        s["embeddings.position_embedding"].shape[-2:])
+    flat["patch_embedding/kernel"] = s[
+        "embeddings.patch_embedding.weight"].transpose(2, 3, 1, 0)
+    if "embeddings.patch_embedding.bias" in s:
+        flat["patch_embedding/bias"] = s["embeddings.patch_embedding.bias"]
+    n_layers = 1 + max(int(k.split(".")[2]) for k in s
+                       if k.startswith("encoder.layers."))
+    for i in range(n_layers):
+        t = f"encoder.layers.{i}"
+        f = f"layers_{i}"
+        _blip_ln(flat, s, f"{t}.layer_norm1", f"{f}/layer_norm1")
+        _blip_ln(flat, s, f"{t}.layer_norm2", f"{f}/layer_norm2")
+        _blip_linear(flat, s, f"{t}.self_attn.qkv", f"{f}/qkv")
+        _blip_linear(flat, s, f"{t}.self_attn.projection", f"{f}/projection")
+        _blip_linear(flat, s, f"{t}.mlp.fc1", f"{f}/fc1")
+        _blip_linear(flat, s, f"{t}.mlp.fc2", f"{f}/fc2")
+    _blip_ln(flat, s, "post_layernorm", "post_layernorm")
+    return _nest(flat)
+
+
+def convert_blip_text(state: Mapping[str, np.ndarray], prefix: str,
+                      with_lm_head: bool = True) -> dict:
+    """HF ``BlipTextModel``/``BlipTextLMHeadModel`` -> models/blip.py text
+    tree. ``prefix`` is e.g. ``"text_decoder."`` (caption head) or
+    ``"text_encoder."`` (VQA question tower, ``with_lm_head=False``)."""
+    s = {k[len(prefix):]: v for k, v in state.items() if k.startswith(prefix)}
+    # LM-head models nest the trunk under "bert."
+    if any(k.startswith("bert.") for k in s):
+        trunk = {k[len("bert."):]: v for k, v in s.items()
+                 if k.startswith("bert.")}
+    else:
+        trunk = s
+    flat: dict[str, np.ndarray] = {}
+    flat["word_embeddings/embedding"] = trunk["embeddings.word_embeddings.weight"]
+    flat["position_embeddings"] = trunk["embeddings.position_embeddings.weight"]
+    _blip_ln(flat, trunk, "embeddings.LayerNorm", "embed_ln")
+    n_layers = 1 + max(int(k.split(".")[2]) for k in trunk
+                       if k.startswith("encoder.layer."))
+    for i in range(n_layers):
+        t = f"encoder.layer.{i}"
+        f = f"layer_{i}"
+        _blip_linear(flat, trunk, f"{t}.attention.self.query", f"{f}/self_query")
+        _blip_linear(flat, trunk, f"{t}.attention.self.key", f"{f}/self_key")
+        _blip_linear(flat, trunk, f"{t}.attention.self.value", f"{f}/self_value")
+        _blip_linear(flat, trunk, f"{t}.attention.output.dense", f"{f}/self_out")
+        _blip_ln(flat, trunk, f"{t}.attention.output.LayerNorm", f"{f}/self_ln")
+        if f"{t}.crossattention.self.query.weight" in trunk:
+            _blip_linear(flat, trunk, f"{t}.crossattention.self.query",
+                         f"{f}/cross_query")
+            _blip_linear(flat, trunk, f"{t}.crossattention.self.key",
+                         f"{f}/cross_key")
+            _blip_linear(flat, trunk, f"{t}.crossattention.self.value",
+                         f"{f}/cross_value")
+            _blip_linear(flat, trunk, f"{t}.crossattention.output.dense",
+                         f"{f}/cross_out")
+            _blip_ln(flat, trunk, f"{t}.crossattention.output.LayerNorm",
+                     f"{f}/cross_ln")
+        _blip_linear(flat, trunk, f"{t}.intermediate.dense",
+                     f"{f}/intermediate")
+        _blip_linear(flat, trunk, f"{t}.output.dense", f"{f}/output")
+        _blip_ln(flat, trunk, f"{t}.output.LayerNorm", f"{f}/output_ln")
+    if with_lm_head:
+        _blip_linear(flat, s, "cls.predictions.transform.dense",
+                     "head_transform")
+        _blip_ln(flat, s, "cls.predictions.transform.LayerNorm", "head_ln")
+        # decoder weight may be tied to the word embeddings and absent
+        # from the serialized state (tie_word_embeddings)
+        dec_w = s.get("cls.predictions.decoder.weight",
+                      trunk["embeddings.word_embeddings.weight"])
+        flat["decoder/kernel"] = np.ascontiguousarray(dec_w.T)
+        flat["decoder/bias"] = s.get("cls.predictions.decoder.bias",
+                                     s["cls.predictions.bias"])
+    return _nest(flat)

@@ -1,0 +1,407 @@
+"""BLIP-class captioning / VQA model (Flax) — the img2txt workload's trunk.
+
+The reference runs BLIP through torch classes named by the hive
+(swarm/captioning/caption_image.py:12-30). Here the model is native:
+
+- :class:`BlipVisionEncoder` — pre-LN ViT over patch tokens (the image
+  tower; one jitted forward, 577 tokens at 384px).
+- :class:`BlipTextModel` — BERT-style post-LN transformer with per-layer
+  cross-attention onto the vision sequence. One module serves both roles
+  the BLIP family needs: bidirectional *encoder* (VQA question tower) and
+  causal *decoder* with a static-shape KV cache (caption/answer head).
+
+TPU-first decode design (mirrors models/gpt.py): the cross-attention
+K/V over the image are computed ONCE per image (they never change during
+decoding), the self-attention cache is a fixed ring carried through a
+``lax.scan``, and greedy token selection happens on-chip — the whole
+caption is one compiled program, no per-token dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+# --------------------------------------------------------------- configs
+
+@dataclasses.dataclass(frozen=True)
+class BlipVisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    image_size: int = 384
+    patch_size: int = 16
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"
+
+    @property
+    def num_tokens(self) -> int:
+        return (self.image_size // self.patch_size) ** 2 + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BlipTextConfig:
+    vocab_size: int = 30524           # BERT vocab + [DEC]/[ENC]
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    encoder_hidden_size: int = 768    # vision width cross-attended to
+    layer_norm_eps: float = 1e-12
+    bos_token_id: int = 30522         # [DEC]
+    sep_token_id: int = 102           # [SEP] — decode stop token
+    pad_token_id: int = 0
+    dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class BlipConfig:
+    name: str = "blip_base"
+    vision: BlipVisionConfig = BlipVisionConfig()
+    text: BlipTextConfig = BlipTextConfig()
+    # image preprocessing (host side): CLIP-style mean/std
+    pixel_mean: Sequence[float] = (0.48145466, 0.4578275, 0.40821073)
+    pixel_std: Sequence[float] = (0.26862954, 0.26130258, 0.27577711)
+
+
+BLIP_BASE = BlipConfig()
+
+BLIP_TINY = BlipConfig(
+    name="blip_tiny",
+    vision=BlipVisionConfig(hidden_size=32, intermediate_size=64,
+                            num_layers=2, num_heads=4, image_size=32,
+                            patch_size=8),
+    text=BlipTextConfig(vocab_size=1000, hidden_size=32,
+                        intermediate_size=64, num_layers=2, num_heads=4,
+                        max_position_embeddings=64, encoder_hidden_size=32,
+                        bos_token_id=998, sep_token_id=999),
+)
+
+BLIP_CONFIGS = {c.name: c for c in (BLIP_BASE, BLIP_TINY)}
+
+
+# ---------------------------------------------------------------- vision
+
+class BlipVisionLayer(nn.Module):
+    config: BlipVisionConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        b, l, _ = x.shape
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="layer_norm1")(x).astype(self.dtype)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(b, l, cfg.num_heads, head_dim)
+        q, k, v = split(q), split(k), split(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (head_dim ** -0.5)
+        weights = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, l, -1)
+        x = x + nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                         name="projection")(out)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="layer_norm2")(x).astype(self.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class BlipVisionEncoder(nn.Module):
+    """(B, H, W, 3) normalized pixels -> (B, tokens, hidden) patch states."""
+
+    config: BlipVisionConfig
+
+    @nn.compact
+    def __call__(self, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        b = pixel_values.shape[0]
+        patches = nn.Conv(
+            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size), dtype=dtype,
+            name="patch_embedding",
+        )(pixel_values.astype(dtype))
+        patches = patches.reshape(b, -1, cfg.hidden_size)
+        cls = self.param("class_embedding", nn.initializers.normal(0.02),
+                         (cfg.hidden_size,))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(dtype), (b, 1, cfg.hidden_size)),
+             patches], axis=1)
+        pos = self.param("position_embedding",
+                         nn.initializers.normal(0.02),
+                         (cfg.num_tokens, cfg.hidden_size))
+        x = x + pos[None, : x.shape[1]].astype(dtype)
+        for i in range(cfg.num_layers):
+            x = BlipVisionLayer(cfg, dtype, name=f"layers_{i}")(x)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                            name="post_layernorm")(x)
+
+
+# ------------------------------------------------------------------ text
+
+class BlipTextLayer(nn.Module):
+    """BERT-style post-LN block with cross-attention.
+
+    Three entry modes (all sharing one param set):
+    - ``cross_kv``: project encoder states to this layer's cross K/V once.
+    - full forward (``cache is None``): bidirectional or causal self-attn
+      over the whole padded sequence (encoder tower / prefill).
+    - cached step (``cache`` given): self-attn against the KV ring at
+      ``index`` (scan decode).
+    """
+
+    config: BlipTextConfig
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self) -> None:
+        cfg = self.config
+        dense = partial(nn.Dense, dtype=self.dtype)
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps,
+                     dtype=jnp.float32)
+        self.self_query = dense(cfg.hidden_size, name="self_query")
+        self.self_key = dense(cfg.hidden_size, name="self_key")
+        self.self_value = dense(cfg.hidden_size, name="self_value")
+        self.self_out = dense(cfg.hidden_size, name="self_out")
+        self.self_ln = ln(name="self_ln")
+        self.cross_query = dense(cfg.hidden_size, name="cross_query")
+        self.cross_key = dense(cfg.hidden_size, name="cross_key")
+        self.cross_value = dense(cfg.hidden_size, name="cross_value")
+        self.cross_out = dense(cfg.hidden_size, name="cross_out")
+        self.cross_ln = ln(name="cross_ln")
+        self.intermediate = dense(cfg.intermediate_size, name="intermediate")
+        self.output = dense(cfg.hidden_size, name="output")
+        self.output_ln = ln(name="output_ln")
+
+    def _heads(self, t: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        b, l, _ = t.shape
+        return t.reshape(b, l, cfg.num_heads,
+                         cfg.hidden_size // cfg.num_heads)
+
+    def cross_kv(self, enc_states: jnp.ndarray) -> tuple[jnp.ndarray,
+                                                         jnp.ndarray]:
+        return (self._heads(self.cross_key(enc_states)),
+                self._heads(self.cross_value(enc_states)))
+
+    def _attend(self, q, k, v, bias) -> jnp.ndarray:
+        head_dim = q.shape[-1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (head_dim ** -0.5)
+        if bias is not None:
+            scores = scores + bias
+        weights = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+        b, l = out.shape[:2]
+        return out.reshape(b, l, -1)
+
+    def __call__(self, x, *, self_bias, cross_k=None, cross_v=None,
+                 cross_bias=None, cache=None, index=None):
+        q = self._heads(self.self_query(x))
+        k = self._heads(self.self_key(x))
+        v = self._heads(self.self_value(x))
+        if cache is not None:
+            ck, cv = cache
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, index, 0, 0))
+            k, v, cache = ck, cv, (ck, cv)
+        attn = self._attend(q, k, v, self_bias)
+        x = self.self_ln(x + self.self_out(attn)).astype(self.dtype)
+        if cross_k is not None:
+            cq = self._heads(self.cross_query(x))
+            attn = self._attend(cq, cross_k, cross_v, cross_bias)
+            x = self.cross_ln(x + self.cross_out(attn)).astype(self.dtype)
+        h = nn.gelu(self.intermediate(x), approximate=False)
+        x = self.output_ln(x + self.output(h)).astype(self.dtype)
+        return x, cache
+
+
+class BlipTextModel(nn.Module):
+    """Embeddings + N BlipTextLayers + LM head (shared across modes)."""
+
+    config: BlipTextConfig
+    with_lm_head: bool = True
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.word_embeddings = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                                        dtype=self.dtype,
+                                        name="word_embeddings")
+        self.position_embeddings = self.param(
+            "position_embeddings", nn.initializers.normal(0.02),
+            (cfg.max_position_embeddings, cfg.hidden_size))
+        self.embed_ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                     dtype=jnp.float32, name="embed_ln")
+        self.layers = [BlipTextLayer(cfg, self.dtype, name=f"layer_{i}")
+                       for i in range(cfg.num_layers)]
+        if self.with_lm_head:
+            self.head_transform = nn.Dense(cfg.hidden_size,
+                                           dtype=self.dtype,
+                                           name="head_transform")
+            self.head_ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                        dtype=jnp.float32, name="head_ln")
+            self.decoder = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                                    name="decoder")
+
+    def _embed(self, ids: jnp.ndarray, index) -> jnp.ndarray:
+        t = ids.shape[1]
+        tok = self.word_embeddings(ids)
+        pos = jax.lax.dynamic_slice(
+            self.position_embeddings, (index, 0),
+            (t, self.config.hidden_size))
+        return self.embed_ln(tok + pos[None].astype(self.dtype)).astype(
+            self.dtype)
+
+    def cross_kvs(self, enc_states: jnp.ndarray) -> list:
+        return [layer.cross_kv(enc_states) for layer in self.layers]
+
+    def lm_logits(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.gelu(self.head_transform(x), approximate=False)
+        return self.decoder(self.head_ln(h).astype(self.dtype))
+
+    def __call__(self, ids, *, causal: bool, attn_mask=None,
+                 cross_kvs=None, cross_bias=None, caches=None, index=0,
+                 valid_len=None, pos_index=None, ring_bias=None,
+                 logits: bool = True):
+        """Full forward (``caches=None``) or cached step.
+
+        ``attn_mask``: (B, L) 1/0 key-validity (full forward only).
+        ``caches``: per-layer (k, v) rings (B, ring, H, D); ``index`` is
+        the ring position ``ids[:, 0]`` writes to; ``valid_len`` the
+        count of live ring positions after this call. ``pos_index``
+        (traced ok) overrides the *logical* position used for the
+        position embeddings when it differs from the ring slot (padded
+        prefills). ``ring_bias`` (1|B, 1, T, ring) replaces the default
+        ring visibility mask.
+        """
+        cfg = self.config
+        b, t = ids.shape
+        x = self._embed(ids, index if pos_index is None else pos_index)
+
+        if caches is None:
+            bias = jnp.zeros((1, 1, t, t), jnp.float32)
+            if causal:
+                bias = bias + jnp.triu(
+                    jnp.full((t, t), NEG_INF, jnp.float32), k=1)[None, None]
+            if attn_mask is not None:
+                bias = bias + jnp.where(
+                    attn_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+        elif ring_bias is not None:
+            bias = ring_bias
+        else:
+            ring = caches[0][0].shape[1]
+            kpos = jnp.arange(ring)
+            qpos = index + jnp.arange(t)
+            ok = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < valid_len)
+            bias = jnp.where(ok, 0.0, NEG_INF)[None, None]
+
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            ck = cross_kvs[i][0] if cross_kvs is not None else None
+            cv = cross_kvs[i][1] if cross_kvs is not None else None
+            x, cache = layer(
+                x, self_bias=bias, cross_k=ck, cross_v=cv,
+                cross_bias=cross_bias,
+                cache=None if caches is None else caches[i],
+                index=None if caches is None else index)
+            new_caches.append(cache)
+        if logits and self.with_lm_head:
+            return self.lm_logits(x), new_caches
+        return x, new_caches
+
+
+def init_text_caches(cfg: BlipTextConfig, batch: int, ring: int) -> list:
+    head_dim = cfg.hidden_size // cfg.num_heads
+    shape = (batch, ring, cfg.num_heads, head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(cfg.num_layers)]
+
+
+@partial(jax.jit, static_argnames=("model", "max_new", "prompt_len"))
+def generate_text(model: BlipTextModel, params: Any,
+                  prompt_ids: jnp.ndarray, enc_states: jnp.ndarray,
+                  enc_mask: jnp.ndarray | None, *, prompt_len: int,
+                  max_new: int,
+                  actual_len: jnp.ndarray | int | None = None
+                  ) -> jnp.ndarray:
+    """Greedy cross-attending decode: prefill ``prompt_ids`` (B,
+    prompt_len — [DEC] + optional conditioning text), then scan
+    ``max_new`` steps. Returns (B, max_new) int32; positions after SEP
+    repeat SEP (host trims).
+
+    ``prompt_len`` is the STATIC prompt bucket (one compiled program per
+    bucket); ``actual_len`` (traced, defaults to ``prompt_len``) is the
+    number of real tokens — pad ``prompt_ids`` to the bucket with
+    anything. Pad ring slots are masked out of every later query, the
+    first generated token reads the logits at ``actual_len - 1``, and
+    decode steps use *logical* positions (``actual_len + t``) for the
+    position embeddings, so a padded prefill is numerically identical to
+    an unpadded one.
+    """
+    cfg = model.config
+    b = prompt_ids.shape[0]
+    ring = prompt_len + max_new
+    eos = jnp.int32(cfg.sep_token_id)
+    alen = jnp.int32(prompt_len if actual_len is None else actual_len)
+
+    cross_bias = None
+    if enc_mask is not None:
+        cross_bias = jnp.where(enc_mask[:, None, None, :] > 0, 0.0, NEG_INF)
+
+    cross_kvs = model.apply(params, enc_states, method="cross_kvs")
+    caches = init_text_caches(cfg, b, ring)
+    kpos = jnp.arange(ring)
+
+    # prefill: query i sees real prompt keys j <= i only
+    qpos = jnp.arange(prompt_len)
+    ok = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < alen)
+    logits, caches = model.apply(
+        params, prompt_ids, causal=True, cross_kvs=cross_kvs,
+        cross_bias=cross_bias, caches=caches, index=0,
+        ring_bias=jnp.where(ok, 0.0, NEG_INF)[None, None])
+    last = jnp.take_along_axis(
+        logits, jnp.full((b, 1, 1), 1, jnp.int32) * (alen - 1), axis=1
+    )[:, 0]
+    first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+    def body(carry, _):
+        caches, tok, idx, done = carry
+        # idx = ring write slot (prompt_len + t); logical position is
+        # alen + t; pad slots [alen, prompt_len) stay masked forever
+        ok = (kpos < alen) | ((kpos >= prompt_len) & (kpos <= idx))
+        logits, caches = model.apply(
+            params, tok[:, None], causal=True, cross_kvs=cross_kvs,
+            cross_bias=cross_bias, caches=caches, index=idx,
+            pos_index=alen + (idx - prompt_len),
+            ring_bias=jnp.where(ok, 0.0, NEG_INF)[None, None, None])
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, eos, nxt)
+        done = done | (nxt == eos)
+        return (caches, nxt, idx + 1, done), nxt
+
+    (_, _, _, _), toks = jax.lax.scan(
+        body, (caches, first, jnp.int32(prompt_len), first == eos),
+        None, length=max_new - 1)
+    return jnp.concatenate([first[:, None], toks.swapaxes(0, 1)], axis=1)

@@ -244,3 +244,86 @@ def load_tokenizer(checkpoint_dir: str | Path | None, vocab_size: int = 49408,
                 "HashTokenizer — generations will NOT match the reference "
                 "model", path)
     return HashTokenizer(vocab_size, max_length, eos_id)
+
+
+class WordPieceTokenizer:
+    """BERT WordPiece tokenizer over a ``vocab.txt`` (the text side of the
+    BLIP captioner, models/blip.py). Greedy longest-match with ``##``
+    continuation pieces; lowercase basic tokenization. Unlike the prompt
+    tokenizers above it also *decodes* — captions come back off-chip as
+    token ids (swarm/captioning/caption_image.py:29-30 equivalence)."""
+
+    def __init__(self, vocab: dict[str, int], max_length: int = 64) -> None:
+        self.vocab = vocab
+        self.ids_to_tokens = {i: t for t, i in vocab.items()}
+        self.max_length = max_length
+        self.pad_id = vocab.get("[PAD]", 0)
+        self.unk_id = vocab.get("[UNK]", 100)
+        self.cls_id = vocab.get("[CLS]", 101)
+        self.sep_id = vocab.get("[SEP]", 102)
+        # BLIP's [DEC]/[ENC] are *added* tokens beyond the stock BERT
+        # vocab.txt (ids 30522/30523 on a 30522-line file); register them
+        # rather than aliasing a real wordpiece as the decoder-start token
+        if "[DEC]" not in self.vocab:
+            for extra in ("[DEC]", "[ENC]"):
+                idx = len(self.vocab)
+                self.vocab[extra] = idx
+                self.ids_to_tokens[idx] = extra
+        self.bos_id = self.vocab["[DEC]"]
+
+    @classmethod
+    def from_vocab_file(cls, path: str | Path,
+                        max_length: int = 64) -> "WordPieceTokenizer":
+        vocab: dict[str, int] = {}
+        with open(path, encoding="utf-8") as fh:
+            for i, line in enumerate(fh):
+                vocab[line.rstrip("\n")] = i
+        return cls(vocab, max_length)
+
+    def _wordpiece(self, word: str) -> list[int]:
+        ids: list[int] = []
+        start = 0
+        while start < len(word):
+            end = len(word)
+            piece_id = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                if sub in self.vocab:
+                    piece_id = self.vocab[sub]
+                    break
+                end -= 1
+            if piece_id is None:
+                return [self.unk_id]
+            ids.append(piece_id)
+            start = end
+        return ids
+
+    def tokenize(self, text: str) -> list[int]:
+        ids: list[int] = []
+        for word in _basic_tokens(text):
+            ids.extend(self._wordpiece(word))
+        return ids
+
+    def encode(self, text: str, max_length: int | None = None) -> list[int]:
+        """[CLS] tokens [SEP] + [PAD] fill, fixed length."""
+        n = max_length or self.max_length
+        ids = [self.cls_id] + self.tokenize(text)[: n - 2] + [self.sep_id]
+        return ids + [self.pad_id] * (n - len(ids))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        words: list[str] = []
+        stop = {self.pad_id, self.cls_id, self.sep_id, self.bos_id}
+        for i in ids:
+            i = int(i)
+            if i == self.sep_id:
+                break
+            if i in stop:
+                continue
+            tok = self.ids_to_tokens.get(i, "")
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(w for w in words if w)

@@ -168,7 +168,12 @@ class ModelRegistry:
 
     def cascade_pipeline(self, model_name: str, mesh=None):
         """Resident IF-class cascade (pipelines/cascade.py) — the
-        ``DeepFloyd/`` dispatch target (swarm/job_arguments.py:39-40)."""
+        ``DeepFloyd/`` dispatch target (swarm/job_arguments.py:39-40).
+
+        Multi-chip ``mesh`` placement is tensor-parallel ONLY (weights on
+        the ``model`` axis; the batch stays replicated across ``data``) —
+        unlike DiffusionPipeline, the cascade does not seed its inputs on
+        the ``data`` axis."""
         from chiaswarm_tpu.pipelines.cascade import (
             CascadeComponents,
             CascadePipeline,
@@ -244,7 +249,12 @@ class ModelRegistry:
 
     def video_pipeline(self, model_name: str, mesh=None):
         """Resident ModelScope-class txt2vid pipeline
-        (swarm/video/tx2vid.py:17-57 parity, pipelines/video.py)."""
+        (swarm/video/tx2vid.py:17-57 parity, pipelines/video.py).
+
+        Multi-chip ``mesh`` placement is tensor-parallel ONLY: temporal
+        attention couples the frame axis, so frames cannot ride a
+        ``data`` axis here (the frame-batched vid2vid path, which runs
+        per-frame through DiffusionPipeline, does get data parallelism)."""
         from chiaswarm_tpu.pipelines.video import (
             VideoComponents,
             VideoPipeline,
@@ -294,6 +304,50 @@ class ModelRegistry:
 
         return GLOBAL_CACHE.cached_params(
             ("tts", model_name), build,
+            size_of=lambda pipe: pipe.c.param_bytes(),
+        )
+
+    def caption_pipeline(self, model_name: str, mesh=None):
+        """Resident BLIP-class captioner (the per-job torch BLIP load of
+        swarm/captioning/caption_image.py:12-17, made resident + LRU'd;
+        native stack in models/blip.py + pipelines/caption.py)."""
+        from chiaswarm_tpu.pipelines.caption import (
+            CaptionComponents,
+            CaptionPipeline,
+        )
+
+        mesh_key = _mesh_cache_key(mesh)
+
+        def build():
+            ckpt = model_dir(model_name)
+            if ckpt.exists():
+                log.info("loading caption model %s from %s", model_name, ckpt)
+                components = CaptionComponents.from_checkpoint(
+                    ckpt, model_name)
+            elif self.allow_random:
+                log.warning("no checkpoint for caption model %s; using "
+                            "random tiny weights", model_name)
+                components = CaptionComponents.random(
+                    "blip_tiny", model_name=model_name)
+            else:
+                raise ValueError(
+                    f"caption model {model_name!r} is not available on "
+                    f"this node (no checkpoint at {ckpt})"
+                )
+            # a ~450M-param captioner gains nothing from weight sharding:
+            # pin to the slot's lead chip so per-slot jobs do not all
+            # serialize on the default device
+            if mesh is not None:
+                import jax
+
+                device = mesh.devices.flatten()[0]
+                log.info("placing %s params on %s", model_name, device)
+                components.params = jax.device_put(components.params,
+                                                   device)
+            return CaptionPipeline(components)
+
+        return GLOBAL_CACHE.cached_params(
+            ("caption", model_name, mesh_key), build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 

@@ -1,0 +1,243 @@
+"""Captioning / VQA pipeline (img2txt) — native BLIP, one program per stage.
+
+Replaces the torch BLIP classes the reference instantiates per job
+(swarm/captioning/caption_image.py:12-30). Stage structure:
+
+- caption: vision encode (jit) -> greedy cross-attending scan decode
+  (models/blip.py::generate_text, one compiled program).
+- VQA: vision encode -> question tower (bidirectional, cross-attends the
+  image) -> answer decoder cross-attending the question states. The
+  question's pad mask rides into the decoder as a cross-attention bias so
+  padding never leaks into the answer.
+
+Host side only resizes/normalizes the image and decodes WordPiece ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chiaswarm_tpu.models.blip import (
+    BLIP_CONFIGS,
+    BlipConfig,
+    BlipTextModel,
+    BlipVisionEncoder,
+    generate_text,
+)
+from chiaswarm_tpu.models.tokenizer import WordPieceTokenizer
+
+
+def _tiny_vocab() -> dict[str, int]:
+    """Synthetic WordPiece vocab for hermetic tiny-BLIP runs (ids < 1000)."""
+    vocab = {"[PAD]": 0, "[UNK]": 100, "[CLS]": 101, "[SEP]": 999,
+             "[DEC]": 998}
+    i = 1
+    while len(vocab) < 990:
+        if i not in (100, 101, 998, 999):
+            vocab[f"tok{i}"] = i
+        i += 1
+    return vocab
+
+
+@dataclasses.dataclass
+class CaptionComponents:
+    config: BlipConfig
+    model_name: str
+    tokenizer: WordPieceTokenizer
+    vision: BlipVisionEncoder
+    decoder: BlipTextModel
+    encoder: BlipTextModel | None  # VQA question tower (None = caption-only)
+    params: dict[str, Any]         # keys: vision, decoder[, encoder]
+
+    @classmethod
+    def random(cls, config: BlipConfig | str = "blip_tiny", seed: int = 0,
+               model_name: str | None = None,
+               vqa: bool = True) -> "CaptionComponents":
+        if isinstance(config, str):
+            config = BLIP_CONFIGS[config]
+        vision = BlipVisionEncoder(config.vision)
+        decoder = BlipTextModel(config.text)
+        encoder = BlipTextModel(config.text, with_lm_head=False) if vqa \
+            else None
+        key = jax.random.PRNGKey(seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        pixels = jnp.zeros(
+            (1, config.vision.image_size, config.vision.image_size, 3),
+            jnp.float32)
+        ids = jnp.zeros((1, 4), jnp.int32)
+        enc = jnp.zeros((1, config.vision.num_tokens,
+                         config.text.encoder_hidden_size), jnp.float32)
+        head_dim = config.text.hidden_size // config.text.num_heads
+        dummy_kvs = [
+            (jnp.zeros((1, enc.shape[1], config.text.num_heads, head_dim),
+                       jnp.float32),) * 2
+            for _ in range(config.text.num_layers)
+        ]
+        params: dict[str, Any] = {
+            "vision": jax.jit(vision.init)(k1, pixels),
+        }
+        # two init passes share one RNG key: __call__ materializes every
+        # param except the cross K/V projections, which only run inside
+        # method=cross_kvs — merge the trees
+        params["decoder"] = _merge(
+            jax.jit(lambda k: decoder.init(
+                k, ids, causal=True, cross_kvs=dummy_kvs))(k2),
+            jax.jit(lambda k: decoder.init(k, enc,
+                                           method="cross_kvs"))(k2))
+        if encoder is not None:
+            params["encoder"] = _merge(
+                jax.jit(lambda k: encoder.init(
+                    k, ids, causal=False, cross_kvs=dummy_kvs,
+                    logits=False))(k3),
+                jax.jit(lambda k: encoder.init(k, enc,
+                                               method="cross_kvs"))(k3))
+        tokenizer = WordPieceTokenizer(_tiny_vocab()) \
+            if config.text.vocab_size < 30000 else None
+        if tokenizer is None:
+            raise ValueError("random() is for tiny configs; real vocabs "
+                             "need a checkpoint (from_checkpoint)")
+        return cls(config=config,
+                   model_name=model_name or f"random/{config.name}",
+                   tokenizer=tokenizer, vision=vision, decoder=decoder,
+                   encoder=encoder, params=params)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir: str | Path, model_name: str,
+                        config: BlipConfig | str = "blip_base",
+                        ) -> "CaptionComponents":
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_blip_text,
+            convert_blip_vision,
+            read_torch_weights,
+        )
+
+        if isinstance(config, str):
+            config = BLIP_CONFIGS[config]
+        checkpoint_dir = Path(checkpoint_dir)
+        state = read_torch_weights(checkpoint_dir)
+        params: dict[str, Any] = {
+            "vision": convert_blip_vision(state),
+            "decoder": convert_blip_text(state, "text_decoder."),
+        }
+        encoder = None
+        if any(k.startswith("text_encoder.") for k in state):
+            params["encoder"] = convert_blip_text(state, "text_encoder.",
+                                                  with_lm_head=False)
+            encoder = BlipTextModel(config.text, with_lm_head=False)
+        vocab = checkpoint_dir / "vocab.txt"
+        if not vocab.exists():
+            raise FileNotFoundError(f"no vocab.txt under {checkpoint_dir}")
+        return cls(config=config, model_name=model_name,
+                   tokenizer=WordPieceTokenizer.from_vocab_file(vocab),
+                   vision=BlipVisionEncoder(config.vision),
+                   decoder=BlipTextModel(config.text), encoder=encoder,
+                   params=params)
+
+    def param_bytes(self) -> int:
+        return sum(x.nbytes for x in jax.tree.leaves(self.params))
+
+
+def _merge(a: dict, b: dict) -> dict:
+    """Deep-merge two flax param trees (b wins on leaves)."""
+    out = dict(a)
+    for k, v in b.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class CaptionPipeline:
+    """``__call__(image, prompt, vqa=...) -> text``. The hive's model
+    type — not the checkpoint contents — picks the mode (the reference
+    instantiates whichever class the server names,
+    caption_image.py:12-13): ``vqa=True`` answers the prompt through the
+    question tower; otherwise a prompt *conditions* the caption
+    (caption_image.py:21-23 conditional mode)."""
+
+    # conditioning prompts pad to one static bucket ([DEC] + 16 tokens):
+    # exactly two compiled decode programs total (len-1 and len-17)
+    PROMPT_BUCKET = 17
+
+    def __init__(self, components: CaptionComponents,
+                 max_new_tokens: int = 24) -> None:
+        self.c = components
+        self.max_new = max_new_tokens
+        self._encode_image = jax.jit(
+            lambda p, x: self.c.vision.apply(p, x))
+        if self.c.encoder is not None:
+            self._encode_question = jax.jit(self._question_fwd)
+
+    # ---- host-side image prep ----
+    def preprocess(self, image: np.ndarray) -> jnp.ndarray:
+        from PIL import Image
+
+        size = self.c.config.vision.image_size
+        pil = Image.fromarray(image.astype(np.uint8)).convert("RGB")
+        pil = pil.resize((size, size), Image.BICUBIC)
+        arr = np.asarray(pil, np.float32) / 255.0
+        mean = np.asarray(self.c.config.pixel_mean, np.float32)
+        std = np.asarray(self.c.config.pixel_std, np.float32)
+        return jnp.asarray((arr - mean) / std)[None]
+
+    def _question_fwd(self, params, ids, mask, enc_states):
+        cross_kvs = self.c.encoder.apply(params, enc_states,
+                                         method="cross_kvs")
+        states, _ = self.c.encoder.apply(
+            params, ids, causal=False, attn_mask=mask, cross_kvs=cross_kvs,
+            logits=False)
+        return states
+
+    def __call__(self, image: np.ndarray, prompt: str = "",
+                 vqa: bool | None = None) -> str:
+        c = self.c
+        if vqa is None:
+            vqa = False  # default model type is conditional generation
+        if vqa and c.encoder is None:
+            raise ValueError(
+                f"{c.model_name!r} has no question tower (VQA requested)")
+        pixels = self.preprocess(image)
+        enc_states = self._encode_image(c.params["vision"], pixels)
+
+        if vqa and prompt:
+            # VQA: question tower over the image, then answer decode
+            q_len = 32
+            q_ids = jnp.asarray(
+                [c.tokenizer.encode(prompt, q_len)], jnp.int32)
+            q_mask = (q_ids != c.tokenizer.pad_id).astype(jnp.int32)
+            q_states = self._encode_question(c.params["encoder"], q_ids,
+                                             q_mask, enc_states)
+            dec_in = jnp.asarray([[c.config.text.bos_token_id]], jnp.int32)
+            ids = generate_text(c.decoder, c.params["decoder"], dec_in,
+                                q_states, q_mask, prompt_len=1,
+                                max_new=self.max_new)
+            return c.tokenizer.decode(np.asarray(ids)[0])
+
+        # caption; a prompt conditions the decoder (caption_image.py:21-23
+        # conditional mode). Conditioned prefixes pad to PROMPT_BUCKET
+        # with actual_len traced — no recompile per prompt length.
+        prefix = [c.config.text.bos_token_id] + (
+            c.tokenizer.tokenize(prompt)[: self.PROMPT_BUCKET - 1]
+            if prompt else [])
+        actual = len(prefix)
+        if prompt:
+            bucket = self.PROMPT_BUCKET
+            prefix = prefix + [c.tokenizer.pad_id] * (bucket - actual)
+        else:
+            bucket = 1
+        dec_in = jnp.asarray([prefix], jnp.int32)
+        ids = generate_text(c.decoder, c.params["decoder"], dec_in,
+                            enc_states, None, prompt_len=bucket,
+                            max_new=self.max_new,
+                            actual_len=jnp.int32(actual))
+        text = c.tokenizer.decode(np.asarray(ids)[0])
+        if prompt:
+            text = f"{prompt.strip()} {text}".strip()
+        return text

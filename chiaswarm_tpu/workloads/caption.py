@@ -1,73 +1,50 @@
 """Image captioning / VQA workload (img2txt).
 
-Capability parity with swarm/captioning/caption_image.py:6-40: the server
-names a processor + model class (BLIP-style) via job ``parameters``; a
-prompt makes it VQA, no prompt makes it unconditional captioning; output is
-a JSON text artifact. Errors are swallowed into an error artifact exactly
-like the reference (:35-40) — captioning failures should not poison a node.
+Capability parity with swarm/captioning/caption_image.py:6-40: a prompt
+makes it VQA (when the checkpoint carries a question tower) or conditions
+the caption, no prompt means unconditional captioning; output is a JSON
+text artifact. Errors are swallowed into an error artifact exactly like
+the reference (:35-40) — captioning failures should not poison a node.
 
-TPU path: transformers' Flax BLIP classes run under jit on the chip. The
-torch classes the hive may name are mapped to their Flax equivalents.
+TPU path is fully native (no torch at inference): BLIP vision ViT +
+cross-attending BERT decoder (models/blip.py), greedy scan decode as one
+compiled program, served resident through the registry LRU. The hive's
+torch class names (``BlipForConditionalGeneration`` etc.,
+caption_image.py:12-13) select behavior, not implementation: a
+``*QuestionAnswering`` model type forces the VQA route.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
 
 from chiaswarm_tpu.node.output_processor import make_text_result
 
-# hive-sent torch class names -> Flax equivalents
-_FLAX_CLASS = {
-    "BlipForConditionalGeneration": "FlaxBlipForConditionalGeneration",
-    "BlipForQuestionAnswering": "FlaxBlipForQuestionAnswering",
-}
-
 
 def caption_callback(slot, model_name: str, *, seed: int,
                      image: np.ndarray | None = None,
                      prompt: str = "",
                      parameters: dict[str, Any] | None = None,
+                     registry=None,
                      **_ignored: Any):
     config: dict[str, Any] = {"model_name": model_name}
     try:
         if image is None:
             raise ValueError("img2txt requires start_image_uri")
+        if registry is None:
+            raise ValueError("img2txt requires a model registry")
         parameters = parameters or {}
-        import transformers
-
-        processor_name = parameters.get("processor_type", "BlipProcessor")
-        model_cls_name = parameters.get(
-            "model_type", "BlipForConditionalGeneration"
-        )
-        model_cls_name = _FLAX_CLASS.get(model_cls_name, model_cls_name)
-        if not model_cls_name.startswith("Flax"):
-            model_cls_name = "Flax" + model_cls_name
-
-        import os
-
-        offline = not os.environ.get("CHIASWARM_ALLOW_HUB_DOWNLOADS")
-        processor = getattr(transformers, processor_name).from_pretrained(
-            model_name, local_files_only=offline
-        )
-        model = getattr(transformers, model_cls_name).from_pretrained(
-            model_name, from_pt=True, local_files_only=offline
-        )
-
-        from PIL import Image
-
-        pil = Image.fromarray(image) if isinstance(image, np.ndarray) else image
-        if prompt:
-            inputs = processor(pil, prompt, return_tensors="np")
-        else:
-            inputs = processor(pil, return_tensors="np")
-        out = model.generate(**inputs)
-        sequences = getattr(out, "sequences", out)
-        caption = processor.decode(
-            np.asarray(sequences)[0], skip_special_tokens=True
-        )
+        t0 = time.monotonic()
+        pipeline = registry.caption_pipeline(
+            model_name, mesh=getattr(slot, "mesh", None))
+        wants_vqa = "QuestionAnswering" in str(
+            parameters.get("model_type", ""))
+        caption = pipeline(np.asarray(image), prompt or "", vqa=wants_vqa)
         config["caption"] = caption
+        config["elapsed_s"] = round(time.monotonic() - t0, 3)
         return {"primary": make_text_result(caption)}, config
     except Exception as exc:  # error artifact, not a failed job (:35-40)
         config["error"] = str(exc)

@@ -265,17 +265,25 @@ class ModelRegistry:
 
         def build():
             family = get_video_family(model_name)
-            if self.allow_random:
+            ckpt = model_dir(model_name)
+            if ckpt.exists():
+                log.info("loading video model %s from %s (2D inflation)",
+                         model_name, ckpt)
+                components = VideoComponents.from_checkpoint(
+                    ckpt, model_name, family)
+            elif self.allow_random:
                 log.warning("video model %s: using random weights",
                             model_name)
                 components = VideoComponents.random(family,
                                                     model_name=model_name)
-                components.params = _place_params(components.params, mesh,
-                                                  model_name)
-                return VideoPipeline(components, attn_impl=self.attn_impl)
-            raise ValueError(
-                f"video model {model_name!r} is not available on this node"
-            )
+            else:
+                raise ValueError(
+                    f"video model {model_name!r} is not available on this "
+                    f"node (no checkpoint at {ckpt})"
+                )
+            components.params = _place_params(components.params, mesh,
+                                              model_name)
+            return VideoPipeline(components, attn_impl=self.attn_impl)
 
         return GLOBAL_CACHE.cached_params(
             ("video", model_name, mesh_key), build,

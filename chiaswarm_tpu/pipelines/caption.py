@@ -223,9 +223,9 @@ class CaptionPipeline:
         # caption; a prompt conditions the decoder (caption_image.py:21-23
         # conditional mode). Conditioned prefixes pad to PROMPT_BUCKET
         # with actual_len traced — no recompile per prompt length.
-        prefix = [c.config.text.bos_token_id] + (
-            c.tokenizer.tokenize(prompt)[: self.PROMPT_BUCKET - 1]
-            if prompt else [])
+        cond_tokens = c.tokenizer.tokenize(prompt) if prompt else []
+        used = cond_tokens[: self.PROMPT_BUCKET - 1]
+        prefix = [c.config.text.bos_token_id] + used
         actual = len(prefix)
         if prompt:
             bucket = self.PROMPT_BUCKET
@@ -239,5 +239,10 @@ class CaptionPipeline:
                             actual_len=jnp.int32(actual))
         text = c.tokenizer.decode(np.asarray(ids)[0])
         if prompt:
-            text = f"{prompt.strip()} {text}".strip()
+            # prepend only what actually conditioned the decode: when the
+            # prompt exceeds the bucket, echoing the full text would claim
+            # a prefix the model never saw
+            head = (prompt.strip() if len(used) == len(cond_tokens)
+                    else c.tokenizer.decode(used))
+            text = f"{head} {text}".strip()
         return text

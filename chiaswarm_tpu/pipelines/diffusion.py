@@ -299,7 +299,11 @@ class DiffusionPipeline:
             else:
                 img = vae.apply(params["vae"], x,
                                 method=AutoencoderKL.decode)
-            return jnp.clip(img, -1.0, 1.0)
+            # quantize ON DEVICE: the host link (a tunnel on dev pods, PCIe
+            # otherwise) moves 4x fewer bytes as uint8 — at 1024px this is
+            # worth ~0.5s/image end-to-end
+            return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
+                    ).astype(jnp.uint8)
 
         return jax.jit(fn)
 
@@ -457,8 +461,7 @@ class DiffusionPipeline:
             jnp.float32(req.control_scale),
             jnp.float32(req.image_guidance_scale),
         )
-        img = np.asarray(jax.device_get(img))
-        img_u8 = ((img + 1.0) * 127.5).round().clip(0, 255).astype(np.uint8)
+        img_u8 = np.asarray(jax.device_get(img))  # uint8 straight off-chip
         # un-bucket: scale-to-cover + center-crop back to the exact request
         # (plain resize would stretch when the bucket changed aspect ratio)
         if (height, width) != (req.height, req.width):

@@ -141,9 +141,111 @@ class VideoComponents:
                    tokenizer=tokenizer, text_encoder=te, unet=unet, vae=vae,
                    params=params)
 
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir, model_name: str,
+                        family: VideoFamily | str | None = None,
+                        ) -> "VideoComponents":
+        """2D-inflation load: spatial weights from a standard SD-style
+        snapshot (``unet/``, ``vae/``, ``text_encoder/``), temporal layers
+        fresh at their identity init (zero output projections — see
+        models/video_unet.py). The spatial blocks reuse the 2D UNet's
+        parameter naming, so convert_unet's rules apply verbatim; the
+        temporal modules are the only non-converted leaves. An inflated
+        model animates exactly like its 2D parent at frame 1 (tested) and
+        gains motion only from trained temporal weights (a later merge —
+        AnimateDiff-style motion modules — drops into the same slots)."""
+        from pathlib import Path
+
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_text_encoder,
+            convert_unet,
+            convert_vae,
+            read_torch_weights,
+        )
+        from chiaswarm_tpu.models.tokenizer import load_tokenizer
+
+        if isinstance(family, str):
+            family = VIDEO_FAMILIES[family]
+        family = family or MODELSCOPE
+        root = Path(checkpoint_dir)
+
+        te = ClipTextEncoder(family.text_encoder)
+        unet = VideoUNet(family.unet, max_frames=family.max_frames)
+        vae = AutoencoderKL(family.vae)
+
+        spatial = convert_unet(read_torch_weights(root / "unet"),
+                               family.unet)
+        # temporal leaves: shape via abstract tracing (no init program),
+        # values by rule — identity output projections, unit norms
+        sample = jax.ShapeDtypeStruct(
+            (1, 2, 8, 8, family.unet.sample_channels), jnp.float32)
+        tshape = jax.ShapeDtypeStruct((1,), jnp.float32)
+        ctx = jax.ShapeDtypeStruct(
+            (1, family.text_encoder.max_position_embeddings,
+             family.unet.cross_attention_dim), jnp.float32)
+        shapes = jax.eval_shape(unet.init, jax.random.PRNGKey(0), sample,
+                                tshape, ctx)
+        rng = np.random.default_rng(0)
+
+        def fill(path: str, s) -> jnp.ndarray:
+            # only the temporal modules may be synthesized; a spatial leaf
+            # reaching here means the converter missed a checkpoint key —
+            # fail loudly instead of silently shipping random weights
+            if not any(tag in path for tag in ("tconv", "tattn")):
+                raise ValueError(
+                    f"2D inflation: spatial UNet leaf {path!r} missing "
+                    f"from the converted checkpoint (converter/key "
+                    f"mismatch for this architecture variant)")
+            leaf = path.rsplit("/", 1)[-1]
+            if leaf == "scale":
+                return jnp.ones(s.shape, s.dtype)
+            if leaf == "bias" or "to_out" in path or path.endswith(
+                    "conv2/kernel"):
+                return jnp.zeros(s.shape, s.dtype)
+            return jnp.asarray(
+                rng.standard_normal(s.shape).astype(np.float32) * 0.02,
+                s.dtype)
+
+        unet_p = _graft(shapes, spatial, fill)
+        params = {
+            "text_encoder": convert_text_encoder(
+                read_torch_weights(root / "text_encoder")),
+            "unet": unet_p,
+            "vae": convert_vae(read_torch_weights(root / "vae"),
+                               family.vae),
+        }
+        tokenizer = load_tokenizer(
+            root, family.text_encoder.vocab_size,
+            family.text_encoder.eos_token_id,
+            family.text_encoder.max_position_embeddings)
+        return cls(family=family, model_name=model_name,
+                   tokenizer=tokenizer, text_encoder=te, unet=unet,
+                   vae=vae, params=params)
+
     def param_bytes(self) -> int:
         leaves = jax.tree.leaves(self.params)
         return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+
+
+def _graft(shape_tree, converted, fill, prefix: str = ""):
+    """Walk the eval_shape tree; take converted leaves where present
+    (spatial), synthesize the rest by ``fill(path, shape)`` (temporal)."""
+
+    def walk(shapes, conv, prefix):
+        out = {}
+        for key, val in shapes.items():
+            path = f"{prefix}/{key}" if prefix else key
+            sub = conv.get(key) if isinstance(conv, dict) else None
+            if isinstance(val, dict):
+                out[key] = walk(val, sub if isinstance(sub, dict) else {},
+                                path)
+            elif sub is not None:
+                out[key] = jnp.asarray(sub)
+            else:
+                out[key] = fill(path, val)
+        return out
+
+    return walk(shape_tree, converted, prefix)
 
 
 class VideoPipeline:
@@ -205,7 +307,10 @@ class VideoPipeline:
             # decode: frames fold into the VAE batch axis
             img = vae.apply(params["vae"], x[0],
                             method=AutoencoderKL.decode)
-            return jnp.clip(img, -1.0, 1.0)   # (F, H, W, 3)
+            # quantize ON DEVICE: uint8 moves 4x fewer bytes over the
+            # host link (pipelines/diffusion.py rationale)
+            return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
+                    ).astype(jnp.uint8)   # (F, H, W, 3) uint8
 
         return jax.jit(fn)
 
@@ -237,8 +342,7 @@ class VideoPipeline:
                           steps=int(steps), sampler=sampler, use_cfg=use_cfg)
         img = fn(self.c.params, ids, neg, key_for_seed(seed),
                  jnp.float32(guidance_scale))
-        img = np.asarray(jax.device_get(img))
-        img_u8 = ((img + 1.0) * 127.5).round().clip(0, 255).astype(np.uint8)
+        img_u8 = np.asarray(jax.device_get(img))  # uint8 off-chip
         if (height, width) != (req_height, req_width):
             # un-bucket: scale-to-cover + center-crop back to the request
             # (same host-side policy as pipelines/diffusion.py)

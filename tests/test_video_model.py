@@ -80,3 +80,49 @@ def test_txt2vid_workload_emits_video():
 
     blob = base64.b64decode(artifacts["primary"]["blob"])
     assert len(blob) > 100  # a real container, not an empty file
+
+
+def test_video_inflation_matches_2d_parent_at_frame1(tmp_path):
+    """2D-inflation load: spatial weights graft from an SD-style snapshot
+    and the fresh temporal layers are identity, so the video UNet at F=1
+    must reproduce the 2D parent UNet exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from chiaswarm_tpu.pipelines.components import Components
+    from chiaswarm_tpu.pipelines.video import VideoComponents
+    from tests.torch_export import write_checkpoint
+
+    src = Components.random("tiny", seed=11)
+    write_checkpoint(tmp_path, src)
+    vc = VideoComponents.from_checkpoint(tmp_path, "tiny-inflated",
+                                         "tiny_vid")
+
+    rng = np.random.RandomState(4)
+    latent = jnp.asarray(rng.randn(1, 8, 8, 4).astype(np.float32))
+    t = jnp.full((1,), 400.0, jnp.float32)
+    ctx = jnp.asarray(rng.randn(1, 77, 32).astype(np.float32))
+
+    out2d = src.unet.apply(src.params["unet"], latent, t, ctx)
+    out3d = vc.unet.apply(vc.params["unet"], latent[:, None], t, ctx)
+    np.testing.assert_allclose(np.asarray(out3d[:, 0]), np.asarray(out2d),
+                               atol=1e-5, rtol=1e-5)
+    # text encoder and VAE graft byte-exactly
+    a = jax.tree.leaves(src.params["text_encoder_0"])
+    b = jax.tree.leaves(vc.params["text_encoder"])
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def test_video_checkpoint_pipeline_generates(tmp_path):
+    from chiaswarm_tpu.pipelines.components import Components
+    from chiaswarm_tpu.pipelines.video import VideoComponents, VideoPipeline
+    from tests.torch_export import write_checkpoint
+
+    write_checkpoint(tmp_path, Components.random("tiny", seed=2))
+    pipe = VideoPipeline(VideoComponents.from_checkpoint(
+        tmp_path, "tiny-inflated", "tiny_vid"))
+    frames, config = pipe("a drifting cloud", num_frames=4, steps=2,
+                          height=64, width=64, seed=1)
+    assert frames.shape == (4, 64, 64, 3)
+    assert config["mode"] == "txt2vid"

@@ -42,7 +42,8 @@ def read_torch_weights(path: str | Path) -> dict[str, np.ndarray]:
     if path.is_file():
         files = [path]
     else:
-        for pattern in ("*.safetensors", "*.bin", "*.pt", "*.ckpt"):
+        for pattern in ("*.safetensors", "*.bin", "*.pt", "*.pth",
+                        "*.ckpt"):
             files.extend(sorted(path.glob(pattern)))
     if not files:
         raise FileNotFoundError(f"no weight files under {path}")
@@ -688,4 +689,31 @@ def convert_blip_text(state: Mapping[str, np.ndarray], prefix: str,
         flat["decoder/kernel"] = np.ascontiguousarray(dec_w.T)
         flat["decoder/bias"] = s.get("cls.predictions.decoder.bias",
                                      s["cls.predictions.bias"])
+    return _nest(flat)
+
+
+# -------------------------------------------------------------- OpenPose
+
+def convert_openpose(state: Mapping[str, np.ndarray]) -> dict:
+    """CMU ``body_pose_model.pth`` (controlnet_aux layout: ``model0.conv1_1
+    .weight`` / ``model2_1.Mconv1_stage2_L1.weight`` ...) -> the
+    models/openpose.py BodyPoseNet tree. Conv names are globally unique in
+    the CMU graph, so the torch submodule prefix is dropped."""
+    flat: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        parts = key.split(".")
+        if len(parts) < 2 or parts[-1] not in ("weight", "bias"):
+            continue
+        name = parts[-2]
+        if not (name.startswith("conv") or name.startswith("Mconv")):
+            continue
+        if parts[-1] == "weight":
+            flat[f"{name}/kernel"] = value.transpose(2, 3, 1, 0)
+        else:
+            flat[f"{name}/bias"] = value
+    n_convs = len({k.split("/")[0] for k in flat})
+    if n_convs != 92:  # 12 trunk + 2x5 stage-1 + 5x2x7 refinement convs
+        raise ValueError(
+            f"openpose state has {n_convs} convs, expected 92 — not a CMU "
+            f"body_pose_model checkpoint")
     return _nest(flat)

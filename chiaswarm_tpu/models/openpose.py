@@ -1,0 +1,291 @@
+"""OpenPose body-pose detector — the last learned ControlNet preprocessor.
+
+The reference gets skeletons from ``controlnet_aux``'s OpenposeDetector
+(swarm/controlnet/input_processor.py:17-60 dispatch); this is a native
+implementation of the same CMU two-branch network (VGG trunk + 6 stages of
+PAF/heatmap branches) in Flax, with the standard part-affinity-field
+assembly and skeleton rendering on the host.
+
+The network runs under jit (CPU or chip — it is a tiny CNN next to the
+diffusion workloads); peak finding, bipartite limb assembly, and drawing
+are numpy/OpenCV host code, like every other preprocessor in
+workloads/controlnet.py. Weights convert from the public CMU
+``body_pose_model.pth`` layout (convert/torch_to_flax.py::convert_openpose).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# (name, out_channels, kernel, relu) per conv — the fixed CMU body graph
+_TRUNK = [
+    ("conv1_1", 64, 3), ("conv1_2", 64, 3), ("pool", 0, 0),
+    ("conv2_1", 128, 3), ("conv2_2", 128, 3), ("pool", 0, 0),
+    ("conv3_1", 256, 3), ("conv3_2", 256, 3), ("conv3_3", 256, 3),
+    ("conv3_4", 256, 3), ("pool", 0, 0),
+    ("conv4_1", 512, 3), ("conv4_2", 512, 3),
+    ("conv4_3_CPM", 256, 3), ("conv4_4_CPM", 128, 3),
+]
+
+N_PAF, N_HEAT = 38, 19
+
+# COCO-18 limb topology: (joint_a, joint_b) and their PAF channel pairs
+LIMB_SEQ = [(1, 2), (1, 5), (2, 3), (3, 4), (5, 6), (6, 7), (1, 8),
+            (8, 9), (9, 10), (1, 11), (11, 12), (12, 13), (1, 0),
+            (0, 14), (14, 16), (0, 15), (15, 17), (2, 16), (5, 17)]
+MAP_IDX = [(31, 32), (39, 40), (33, 34), (35, 36), (41, 42), (43, 44),
+           (19, 20), (21, 22), (23, 24), (25, 26), (27, 28), (29, 30),
+           (47, 48), (49, 50), (53, 54), (51, 52), (55, 56), (37, 38),
+           (45, 46)]
+
+_COLORS = [
+    (255, 0, 0), (255, 85, 0), (255, 170, 0), (255, 255, 0), (170, 255, 0),
+    (85, 255, 0), (0, 255, 0), (0, 255, 85), (0, 255, 170), (0, 255, 255),
+    (0, 170, 255), (0, 85, 255), (0, 0, 255), (85, 0, 255), (170, 0, 255),
+    (255, 0, 255), (255, 0, 170), (255, 0, 85),
+]
+
+
+class BodyPoseNet(nn.Module):
+    """(B, H, W, 3) in [-0.5, 0.5] -> (paf (B, H/8, W/8, 38),
+    heatmap (B, H/8, W/8, 19)). Six refinement stages, CMU naming."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        conv = lambda ch, k, name: nn.Conv(
+            ch, (k, k), padding=k // 2, dtype=self.dtype, name=name)
+        for name, ch, k in _TRUNK:
+            if name == "pool":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(conv(ch, k, name)(x))
+        feat = x
+
+        def stage1(branch: int, out_ch: int) -> jnp.ndarray:
+            h = feat
+            for i in (1, 2, 3):
+                h = nn.relu(conv(128, 3, f"conv5_{i}_CPM_L{branch}")(h))
+            h = nn.relu(conv(512, 1, f"conv5_4_CPM_L{branch}")(h))
+            return conv(out_ch, 1, f"conv5_5_CPM_L{branch}")(h)
+
+        def stage_t(t: int, branch: int, out_ch: int,
+                    inp: jnp.ndarray) -> jnp.ndarray:
+            h = inp
+            for i in (1, 2, 3, 4, 5):
+                h = nn.relu(conv(128, 7, f"Mconv{i}_stage{t}_L{branch}")(h))
+            h = nn.relu(conv(128, 1, f"Mconv6_stage{t}_L{branch}")(h))
+            return conv(out_ch, 1, f"Mconv7_stage{t}_L{branch}")(h)
+
+        paf, heat = stage1(1, N_PAF), stage1(2, N_HEAT)
+        for t in range(2, 7):
+            inp = jnp.concatenate([paf, heat, feat], axis=-1)
+            paf, heat = stage_t(t, 1, N_PAF, inp), stage_t(t, 2, N_HEAT, inp)
+        return paf, heat
+
+
+# ------------------------------------------------------- host assembly
+
+def find_peaks(heatmap: np.ndarray, thre1: float = 0.1) -> list[list[tuple]]:
+    """Per-joint peak list [(x, y, score, id), ...] from the (H, W, 19)
+    upsampled heatmap (channel 18 is background)."""
+    import cv2
+
+    all_peaks: list[list[tuple]] = []
+    peak_id = 0
+    for part in range(18):
+        m = cv2.GaussianBlur(heatmap[:, :, part], (0, 0), 3)
+        up = np.zeros_like(m); up[1:, :] = m[:-1, :]
+        down = np.zeros_like(m); down[:-1, :] = m[1:, :]
+        left = np.zeros_like(m); left[:, 1:] = m[:, :-1]
+        right = np.zeros_like(m); right[:, :-1] = m[:, 1:]
+        is_peak = (m >= up) & (m >= down) & (m >= left) & (m >= right) & \
+                  (m > thre1)
+        ys, xs = np.nonzero(is_peak)
+        peaks = []
+        for x, y in zip(xs, ys):
+            peaks.append((int(x), int(y), float(heatmap[y, x, part]),
+                          peak_id))
+            peak_id += 1
+        all_peaks.append(peaks)
+    return all_peaks
+
+
+def score_limbs(paf: np.ndarray, all_peaks, thre2: float = 0.05,
+                n_sample: int = 10):
+    """Score candidate limbs by the PAF line integral; greedy-match each
+    limb type. Returns connection_all[k] = [(idA, idB, score, ia, ib)]."""
+    h = paf.shape[0]
+    connection_all = []
+    for k, (ja, jb) in enumerate(LIMB_SEQ):
+        ca, cb = all_peaks[ja], all_peaks[jb]
+        if not ca or not cb:
+            connection_all.append([])
+            continue
+        score_map = paf[:, :, [MAP_IDX[k][0] - 19, MAP_IDX[k][1] - 19]]
+        candidates = []
+        for ia, a in enumerate(ca):
+            for ib, b in enumerate(cb):
+                vec = np.array([b[0] - a[0], b[1] - a[1]], np.float32)
+                norm = max(float(np.linalg.norm(vec)), 1e-6)
+                u = vec / norm
+                xs = np.linspace(a[0], b[0], n_sample).round().astype(int)
+                ys = np.linspace(a[1], b[1], n_sample).round().astype(int)
+                vals = score_map[ys, xs]                  # (n, 2)
+                dots = vals @ u
+                prior = min(0.5 * h / norm - 1.0, 0.0)    # length penalty
+                score = float(dots.mean()) + prior
+                ok = (dots > thre2).sum() > 0.8 * n_sample
+                if ok and score > 0:
+                    candidates.append((ia, ib, score))
+        candidates.sort(key=lambda c: c[2], reverse=True)
+        used_a, used_b, conns = set(), set(), []
+        for ia, ib, s in candidates:
+            if ia in used_a or ib in used_b:
+                continue
+            used_a.add(ia); used_b.add(ib)
+            conns.append((ca[ia][3], cb[ib][3], s, ia, ib))
+        connection_all.append(conns)
+    return connection_all
+
+
+def assemble_people(all_peaks, connection_all, min_parts: int = 4,
+                    min_score: float = 0.4) -> list[np.ndarray]:
+    """Greedy subset assembly (the standard CMU merge): each person is a
+    length-20 row — 18 joint peak-ids (-1 absent), total score, #parts."""
+    flat = [p for peaks in all_peaks for p in peaks]
+    score_of = {p[3]: p[2] for p in flat}
+    subsets: list[np.ndarray] = []
+    for k, (ja, jb) in enumerate(LIMB_SEQ):
+        for ida, idb, s, _, _ in connection_all[k]:
+            found = [i for i, row in enumerate(subsets)
+                     if row[ja] == ida or row[jb] == idb]
+            if len(found) == 1:
+                row = subsets[found[0]]
+                if row[jb] != idb:
+                    row[jb] = idb
+                    row[19] += 1
+                    row[18] += score_of[idb] + s
+                elif row[ja] != ida:
+                    row[ja] = ida
+                    row[19] += 1
+                    row[18] += score_of[ida] + s
+            elif len(found) == 2:
+                r1, r2 = subsets[found[0]], subsets[found[1]]
+                if not np.any((r1[:18] >= 0) & (r2[:18] >= 0)):
+                    r1[:18] = np.where(r2[:18] >= 0, r2[:18], r1[:18])
+                    r1[18] += r2[18] + s
+                    r1[19] += r2[19]
+                    subsets.pop(found[1])
+                else:
+                    r1[jb] = idb
+                    r1[19] += 1
+                    r1[18] += score_of[idb] + s
+            else:
+                row = np.full(20, -1.0)
+                row[ja], row[jb] = ida, idb
+                row[19] = 2
+                row[18] = score_of[ida] + score_of[idb] + s
+                subsets.append(row)
+    return [row for row in subsets
+            if row[19] >= min_parts and row[18] / row[19] >= min_score]
+
+
+def draw_skeletons(shape: tuple[int, int], all_peaks, subsets) -> np.ndarray:
+    """Render the openpose conditioning image: colored limbs + joints on
+    black, (H, W, 3) uint8."""
+    import cv2
+
+    h, w = shape
+    canvas = np.zeros((h, w, 3), np.uint8)
+    pos = {p[3]: (p[0], p[1]) for peaks in all_peaks for p in peaks}
+    for row in subsets:
+        for k, (ja, jb) in enumerate(LIMB_SEQ[:17]):
+            ida, idb = int(row[ja]), int(row[jb])
+            if ida < 0 or idb < 0:
+                continue
+            (xa, ya), (xb, yb) = pos[ida], pos[idb]
+            mx, my = (xa + xb) / 2, (ya + yb) / 2
+            length = float(np.hypot(xa - xb, ya - yb))
+            angle = float(np.degrees(np.arctan2(ya - yb, xa - xb)))
+            poly = cv2.ellipse2Poly((int(mx), int(my)),
+                                    (int(length / 2), 4), int(angle), 0,
+                                    360, 1)
+            cv2.fillConvexPoly(canvas, poly, _COLORS[k % len(_COLORS)])
+        for j in range(18):
+            idx = int(row[j])
+            if idx >= 0:
+                cv2.circle(canvas, pos[idx], 4, _COLORS[j], thickness=-1)
+    return canvas
+
+
+@dataclasses.dataclass
+class OpenposeDetector:
+    """Ties the jitted CNN to the host assembly. ``params`` is the Flax
+    tree (converted body_pose_model weights, or random for shape tests)."""
+
+    params: dict
+    box_size: int = 368
+    stride: int = 8
+
+    def __post_init__(self) -> None:
+        self._net = BodyPoseNet()
+        self._fwd = jax.jit(lambda p, x: self._net.apply(p, x))
+
+    @classmethod
+    def random(cls, seed: int = 0) -> "OpenposeDetector":
+        net = BodyPoseNet()
+        x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        return cls(params=jax.jit(net.init)(jax.random.PRNGKey(seed), x))
+
+    @classmethod
+    def from_checkpoint(cls, path) -> "OpenposeDetector":
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_openpose,
+            read_torch_weights,
+        )
+
+        return cls(params=convert_openpose(read_torch_weights(path)))
+
+    def maps(self, image: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(H, W, 3) uint8 RGB -> upsampled (paf, heatmap) at image res."""
+        import cv2
+
+        h, w = image.shape[:2]
+        scale = self.box_size / max(h, 1)
+        nh = int(round(h * scale)); nw = int(round(w * scale))
+        nh8 = -(-nh // self.stride) * self.stride
+        nw8 = -(-nw // self.stride) * self.stride
+        resized = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_CUBIC)
+        padded = np.full((nh8, nw8, 3), 128, np.uint8)
+        padded[:nh, :nw] = resized
+        # CMU convention: BGR, [-0.5, 0.5]
+        inp = padded[:, :, ::-1].astype(np.float32) / 256.0 - 0.5
+        paf, heat = self._fwd(self.params, jnp.asarray(inp)[None])
+        paf = np.asarray(paf)[0]
+        heat = np.asarray(heat)[0]
+        # upsample to the PADDED extent, crop the stride pad, THEN map to
+        # image coordinates — resizing the padded maps straight to (w, h)
+        # would shrink every joint toward the origin by nh/nh8
+        paf = cv2.resize(paf, (nw8, nh8),
+                         interpolation=cv2.INTER_CUBIC)[:nh, :nw]
+        heat = cv2.resize(heat, (nw8, nh8),
+                          interpolation=cv2.INTER_CUBIC)[:nh, :nw]
+        paf = cv2.resize(paf, (w, h), interpolation=cv2.INTER_CUBIC)
+        heat = cv2.resize(heat, (w, h), interpolation=cv2.INTER_CUBIC)
+        return paf, heat
+
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        """uint8 RGB image -> uint8 RGB skeleton conditioning image."""
+        paf, heat = self.maps(image)
+        peaks = find_peaks(heat)
+        conns = score_limbs(paf, peaks)
+        people = assemble_people(peaks, conns)
+        return draw_skeletons(image.shape[:2], peaks, people)

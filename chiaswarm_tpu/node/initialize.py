@@ -88,7 +88,34 @@ def prefetch_checkpoints(models: list[dict[str, Any]],
             fetched += 1
         except Exception as exc:
             log.warning("prefetch of %s failed: %s", name, exc)
+    fetched += _prefetch_openpose(models, settings)
     return fetched
+
+
+def _prefetch_openpose(models: list[dict[str, Any]],
+                       settings: Settings) -> int:
+    """Fetch the CMU body_pose_model weights (the one learned ControlNet
+    preprocessor, models/openpose.py) when any catalog model advertises an
+    openpose controlnet. Pulled from the public annotator mirror the
+    reference's controlnet_aux uses."""
+    wants = any("openpose" in str(m.get("parameters", {})).lower()
+                or "openpose" in str(m.get("name", "")).lower()
+                for m in models)
+    target = model_dir("openpose")
+    if not wants or target.exists():
+        return 0
+    try:
+        from huggingface_hub import hf_hub_download
+
+        target.mkdir(parents=True, exist_ok=True)
+        hf_hub_download("lllyasviel/Annotators", "body_pose_model.pth",
+                        local_dir=str(target),
+                        token=settings.huggingface_token or None)
+        log.info("fetched openpose body_pose_model weights")
+        return 1
+    except Exception as exc:
+        log.warning("openpose weight fetch failed: %s", exc)
+        return 0
 
 
 def warm_compile(models: list[dict[str, Any]]) -> None:

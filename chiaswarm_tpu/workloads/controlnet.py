@@ -6,16 +6,17 @@ image, dispatched on ``controlnet["type"]``. These are CPU ops (OpenCV /
 PIL) by design — the reference keeps them off-GPU and we keep them off-TPU
 (SURVEY.md §2: "keep on CPU (host) — not TPU work").
 
-Implemented without auxiliary torch models (this image has no
-controlnet_aux). Exact ports: canny (cv2.Canny), tile (64-multiple
-resize), pix2pix (passthrough), shuffle (content shuffle). Model-free
-stand-ins for the learned detectors (documented per function): scribble/
-softedge (Scharr sketch ~ HED/PidiNet), mlsd (probabilistic Hough line
-segments), lineart (dodge-sketch line extraction), depth (defocus +
-position-prior pseudo-depth ~ MiDaS), normalbae (normals from the
-pseudo-depth), seg (mean-shift posterization onto the ADE20K palette the
-reference carries at input_processor.py:118-272). openpose raises — a
-skeleton detector cannot be approximated without weights.
+Implemented without controlnet_aux. Exact ports: canny (cv2.Canny), tile
+(64-multiple resize), pix2pix (passthrough), shuffle (content shuffle).
+openpose runs the NATIVE CMU body-pose network (models/openpose.py,
+converted body_pose_model weights; raises with a fetch hint when the
+weights are absent). Model-free stand-ins for the remaining learned
+detectors (documented per function): scribble/softedge (Scharr sketch ~
+HED/PidiNet), mlsd (probabilistic Hough line segments), lineart
+(dodge-sketch line extraction), depth (defocus + position-prior
+pseudo-depth ~ MiDaS), normalbae (normals from the pseudo-depth), seg
+(mean-shift posterization onto the ADE20K palette the reference carries
+at input_processor.py:118-272).
 """
 
 from __future__ import annotations
@@ -195,10 +196,37 @@ def image_to_segments(image: Image.Image) -> Image.Image:
         _ADE_PALETTE[np.argmin(dists, axis=1)].reshape(arr.shape))
 
 
+_OPENPOSE: list[Any] = []  # resident detector (lazy singleton)
+
+
+@_register("openpose")
+def image_to_openpose(image: Image.Image) -> Image.Image:
+    """Native CMU body-pose skeleton (models/openpose.py) — the one
+    preprocessor that needs learned weights. Loads ``body_pose_model``
+    weights from the node's model dir (fetched by init alongside the
+    diffusion checkpoints); without them this raises, matching the
+    historical behavior but with an actionable message."""
+    if not _OPENPOSE:
+        from chiaswarm_tpu.models.openpose import OpenposeDetector
+        from chiaswarm_tpu.node.registry import model_dir
+
+        ckpt = model_dir("openpose")
+        if not ckpt.exists():
+            raise ValueError(
+                "openpose preprocessing needs the CMU body_pose_model "
+                f"weights at {ckpt}; `swarm-tpu init` fetches them when "
+                "the hive catalog lists an openpose model, or place "
+                "body_pose_model.pth there manually"
+            )
+        _OPENPOSE.append(OpenposeDetector.from_checkpoint(ckpt))
+    skeleton = _OPENPOSE[0](np.asarray(image.convert("RGB")))
+    return Image.fromarray(skeleton)
+
+
 def preprocess_image(image: Image.Image, controlnet: dict[str, Any]) -> Image.Image:
     """Dispatch on controlnet["type"] (input_processor.py:17-60). Every
-    mode has an exact port or a documented model-free stand-in except
-    openpose, which raises (skeletons need weights)."""
+    mode has an exact port, a documented model-free stand-in, or (openpose)
+    a native detector gated on converted weights."""
     kind = str(controlnet.get("type", "canny")).lower()
     if not controlnet.get("preprocess", True):
         return image

@@ -1,0 +1,191 @@
+"""OpenPose preprocessor tests: network fidelity vs a torch reference,
+PAF assembly on synthetic fields, and the end-to-end skeleton render.
+
+The reference gets skeletons from controlnet_aux's OpenposeDetector
+(swarm/controlnet/input_processor.py:17-60); these tests pin the native
+reimplementation (models/openpose.py) to the same CMU graph semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.models.openpose import (
+    LIMB_SEQ,
+    MAP_IDX,
+    N_HEAT,
+    N_PAF,
+    OpenposeDetector,
+    assemble_people,
+    draw_skeletons,
+    find_peaks,
+    score_limbs,
+)
+
+
+def test_network_output_shapes():
+    det = OpenposeDetector.random(seed=0)
+    import jax.numpy as jnp
+
+    paf, heat = det._fwd(det.params, jnp.zeros((1, 64, 48, 3)))
+    assert paf.shape == (1, 8, 6, N_PAF)
+    assert heat.shape == (1, 8, 6, N_HEAT)
+
+
+def _torch_body_net():
+    """Independent torch construction of the CMU graph (controlnet_aux
+    layout) for conversion fidelity."""
+    torch = pytest.importorskip("torch")
+    import collections
+
+    import torch.nn as nn
+
+    def conv(i, o, k):
+        return nn.Conv2d(i, o, k, padding=k // 2)
+
+    def seq(defs):
+        layers = collections.OrderedDict()
+        for name, mod in defs:
+            layers[name] = mod
+        return nn.Sequential(layers)
+
+    class Body(nn.Module):
+        def __init__(self):
+            super().__init__()
+            R = nn.ReLU(inplace=False)
+            P = nn.MaxPool2d(2, 2)
+            self.model0 = seq([
+                ("conv1_1", conv(3, 64, 3)), ("r1", R),
+                ("conv1_2", conv(64, 64, 3)), ("r2", R), ("p1", P),
+                ("conv2_1", conv(64, 128, 3)), ("r3", R),
+                ("conv2_2", conv(128, 128, 3)), ("r4", R), ("p2", P),
+                ("conv3_1", conv(128, 256, 3)), ("r5", R),
+                ("conv3_2", conv(256, 256, 3)), ("r6", R),
+                ("conv3_3", conv(256, 256, 3)), ("r7", R),
+                ("conv3_4", conv(256, 256, 3)), ("r8", R), ("p3", P),
+                ("conv4_1", conv(256, 512, 3)), ("r9", R),
+                ("conv4_2", conv(512, 512, 3)), ("r10", R),
+                ("conv4_3_CPM", conv(512, 256, 3)), ("r11", R),
+                ("conv4_4_CPM", conv(256, 128, 3)), ("r12", R),
+            ])
+
+            def stage1(branch, out):
+                return seq([
+                    (f"conv5_1_CPM_L{branch}", conv(128, 128, 3)), ("a", R),
+                    (f"conv5_2_CPM_L{branch}", conv(128, 128, 3)), ("b", R),
+                    (f"conv5_3_CPM_L{branch}", conv(128, 128, 3)), ("c", R),
+                    (f"conv5_4_CPM_L{branch}", conv(128, 512, 1)), ("d", R),
+                    (f"conv5_5_CPM_L{branch}", conv(512, out, 1)),
+                ])
+
+            def stage_t(t, branch, out):
+                defs = []
+                ch_in = 185
+                for i in (1, 2, 3, 4, 5):
+                    defs += [(f"Mconv{i}_stage{t}_L{branch}",
+                              conv(ch_in, 128, 7)), (f"r{i}", R)]
+                    ch_in = 128
+                defs += [(f"Mconv6_stage{t}_L{branch}", conv(128, 128, 1)),
+                         ("r6", R),
+                         (f"Mconv7_stage{t}_L{branch}", conv(128, out, 1))]
+                return seq(defs)
+
+            self.model1_1 = stage1(1, 38)
+            self.model1_2 = stage1(2, 19)
+            for t in range(2, 7):
+                setattr(self, f"model{t}_1", stage_t(t, 1, 38))
+                setattr(self, f"model{t}_2", stage_t(t, 2, 19))
+
+        def forward(self, x):
+            feat = self.model0(x)
+            paf, heat = self.model1_1(feat), self.model1_2(feat)
+            for t in range(2, 7):
+                inp = torch.cat([paf, heat, feat], dim=1)
+                paf = getattr(self, f"model{t}_1")(inp)
+                heat = getattr(self, f"model{t}_2")(inp)
+            return paf, heat
+
+    torch.manual_seed(0)
+    return torch, Body().eval()
+
+
+def test_conversion_matches_torch_reference():
+    torch, body = _torch_body_net()
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_openpose
+
+    state = {k: v.detach().numpy() for k, v in body.state_dict().items()}
+    det = OpenposeDetector(params=convert_openpose(state))
+
+    x = np.random.RandomState(1).randn(1, 32, 32, 3).astype(np.float32) * 0.3
+    with torch.no_grad():
+        tp, th = body(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    fp, fh = det._fwd(det.params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(fp),
+                               tp.numpy().transpose(0, 2, 3, 1),
+                               atol=2e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(fh),
+                               th.numpy().transpose(0, 2, 3, 1),
+                               atol=2e-4, rtol=2e-3)
+
+
+def test_converter_rejects_wrong_state():
+    from chiaswarm_tpu.convert.torch_to_flax import convert_openpose
+
+    with pytest.raises(ValueError, match="expected 92"):
+        convert_openpose({"model0.conv1_1.weight": np.zeros((64, 3, 3, 3)),
+                          "model0.conv1_1.bias": np.zeros(64)})
+
+
+def _synthetic_fields(h=64, w=64):
+    """Heatmaps/PAF for one person: neck (joint 1) at (20, 32) and right
+    shoulder (joint 2) at (44, 32), with the matching PAF painted along
+    the connecting line."""
+    heat = np.zeros((h, w, N_HEAT), np.float32)
+    paf = np.zeros((h, w, N_PAF), np.float32)
+    a, b = (20, 32), (44, 32)  # (x, y)
+    yy, xx = np.mgrid[0:h, 0:w]
+    for joint, (px, py) in ((1, a), (2, b)):
+        heat[:, :, joint] = np.exp(-((xx - px) ** 2 + (yy - py) ** 2) / 18.0)
+    k = LIMB_SEQ.index((1, 2))
+    cx, cy = MAP_IDX[k][0] - 19, MAP_IDX[k][1] - 19
+    on_line = (np.abs(yy - 32) <= 2) & (xx >= a[0]) & (xx <= b[0])
+    paf[:, :, cx] = on_line * 1.0   # unit vector +x
+    paf[:, :, cy] = 0.0
+    return paf, heat, a, b
+
+
+def test_assembly_connects_synthetic_limb():
+    paf, heat, a, b = _synthetic_fields()
+    peaks = find_peaks(heat)
+    assert len(peaks[1]) == 1 and len(peaks[2]) == 1
+    assert peaks[1][0][:2] == a and peaks[2][0][:2] == b
+    conns = score_limbs(paf, peaks)
+    k = LIMB_SEQ.index((1, 2))
+    assert len(conns[k]) == 1
+    people = assemble_people(peaks, conns, min_parts=2, min_score=0.1)
+    assert len(people) == 1
+    canvas = draw_skeletons((64, 64), peaks, people)
+    # the limb is drawn along y=32 between the two joints
+    assert canvas[30:35, 22:42].sum() > 0
+    assert canvas[:20].sum() == 0
+
+
+def test_end_to_end_random_weights_runs():
+    det = OpenposeDetector.random(seed=1)
+    img = (np.random.RandomState(0).rand(96, 72, 3) * 255).astype(np.uint8)
+    out = det(img)
+    assert out.shape == (96, 72, 3) and out.dtype == np.uint8
+
+
+def test_workload_raises_without_weights(tmp_path, monkeypatch):
+    from PIL import Image
+
+    from chiaswarm_tpu.workloads import controlnet as wl
+
+    monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
+    with pytest.raises(ValueError, match="body_pose_model"):
+        wl.preprocess_image(Image.new("RGB", (64, 64)),
+                            {"type": "openpose", "preprocess": True})

@@ -39,7 +39,14 @@ def test_all_modes_registered():
 
 
 @pytest.mark.parametrize("mode", sorted(_PREPROCESSORS))
-def test_each_mode_produces_rgb(photo, mode):
+def test_each_mode_produces_rgb(photo, mode, monkeypatch):
+    if mode == "openpose":
+        # weight-gated: run it with a random-init detector
+        from chiaswarm_tpu.models.openpose import OpenposeDetector
+        from chiaswarm_tpu.workloads import controlnet as wl
+
+        monkeypatch.setattr(wl, "_OPENPOSE",
+                            [OpenposeDetector.random(seed=0)])
     out = preprocess_image(photo, {"type": mode, "preprocess": True})
     arr = np.asarray(out)
     assert arr.ndim == 3 and arr.shape[2] == 3
@@ -91,6 +98,12 @@ def test_preprocess_false_passthrough(photo):
     assert out is photo
 
 
-def test_unsupported_mode_raises(photo):
-    with pytest.raises(ValueError, match="openpose"):
+def test_openpose_without_weights_raises(photo, tmp_path, monkeypatch):
+    monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
+    with pytest.raises(ValueError, match="body_pose_model"):
         preprocess_image(photo, {"type": "openpose"})
+
+
+def test_unknown_mode_raises(photo):
+    with pytest.raises(ValueError, match="not yet supported"):
+        preprocess_image(photo, {"type": "telekinesis"})

@@ -717,3 +717,149 @@ def convert_openpose(state: Mapping[str, np.ndarray]) -> dict:
             f"openpose state has {n_convs} convs, expected 92 — not a CMU "
             f"body_pose_model checkpoint")
     return _nest(flat)
+
+
+# ------------------------------------------------------------------ Bark
+
+def _fold_parametrizations(state: Mapping[str, np.ndarray]
+                           ) -> dict[str, np.ndarray]:
+    """Newer torch spells weight norm as ``parametrizations.weight
+    .original0`` (g) / ``original1`` (v); fold to plain ``weight``."""
+    out: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        if key.endswith(".parametrizations.weight.original1"):
+            base = key[: -len(".parametrizations.weight.original1")]
+            g = state[base + ".parametrizations.weight.original0"]
+            v = value
+            axes = tuple(range(1, v.ndim))
+            norm = np.sqrt((v * v).sum(axis=axes, keepdims=True))
+            out[base + ".weight"] = g * v / np.maximum(norm, 1e-12)
+        elif key.endswith(".parametrizations.weight.original0"):
+            continue
+        else:
+            out[key] = value
+    return out
+
+
+def _bark_layer_map(flat: dict, s: Mapping[str, np.ndarray]) -> None:
+    """Shared per-layer mapping for bark's causal and fine stages (both
+    use the same block layout). bark builds every linear AND layernorm
+    without bias (config.bias=False); flax LayerNorm always carries one,
+    so absent biases become zeros."""
+    flat["wpe"] = s["position_embeds_layer.weight"]
+    n_layers = 1 + max(int(k.split(".")[1]) for k in s
+                       if k.startswith("layers."))
+    for i in range(n_layers):
+        t = f"layers.{i}"
+        f = f"h_{i}"
+        for ln_t, ln_f in ((f"{t}.layernorm_1", f"{f}/ln_1"),
+                           (f"{t}.layernorm_2", f"{f}/ln_2")):
+            flat[f"{ln_f}/scale"] = s[f"{ln_t}.weight"]
+            flat[f"{ln_f}/bias"] = s.get(
+                f"{ln_t}.bias", np.zeros_like(s[f"{ln_t}.weight"]))
+        # HF names the attention submodule "attn"; some exports use
+        # "attention" (the causal-mask buffer "attn.bias" is skipped)
+        a = f"{t}.attn" if f"{t}.attn.att_proj.weight" in s \
+            else f"{t}.attention"
+        flat[f"{f}/attn_qkv/kernel"] = s[f"{a}.att_proj.weight"].T
+        flat[f"{f}/attn_proj/kernel"] = s[f"{a}.out_proj.weight"].T
+        flat[f"{f}/mlp_fc/kernel"] = s[f"{t}.mlp.in_proj.weight"].T
+        flat[f"{f}/mlp_proj/kernel"] = s[f"{t}.mlp.out_proj.weight"].T
+    flat["ln_f/scale"] = s["layernorm_final.weight"]
+    flat["ln_f/bias"] = s.get("layernorm_final.bias",
+                              np.zeros_like(s["layernorm_final.weight"]))
+
+
+def _convert_bark_gpt(s: Mapping[str, np.ndarray]) -> dict:
+    """One bark causal stage (HF BarkCausalModel keys) -> models/gpt.py
+    GPT tree."""
+    flat: dict[str, np.ndarray] = {}
+    flat["wte/embedding"] = s["input_embeds_layer.weight"]
+    _bark_layer_map(flat, s)
+    flat["lm_head/kernel"] = s["lm_head.weight"].T
+    return _nest(flat)
+
+
+def _convert_bark_fine(s: Mapping[str, np.ndarray], n_codes_total: int,
+                       n_codes_given: int) -> dict:
+    """HF BarkFineModel keys -> models/gpt.py FineGPT tree. Absent (tied)
+    lm_heads fall back to ``input_embeds_layers[k + 1]``."""
+    flat: dict[str, np.ndarray] = {}
+    for k in range(n_codes_total):
+        flat[f"wte_{k}/embedding"] = s[f"input_embeds_layers.{k}.weight"]
+    _bark_layer_map(flat, s)
+    for k in range(n_codes_total - n_codes_given):
+        head = s.get(f"lm_heads.{k}.weight",
+                     s[f"input_embeds_layers.{k + 1}.weight"])
+        flat[f"lm_head_{k}/kernel"] = head.T
+    return _nest(flat)
+
+
+def convert_encodec_decoder(s: Mapping[str, np.ndarray],
+                            codec_config) -> dict:
+    """HF ``EncodecModel`` quantizer + decoder keys (weight norm already
+    folded) -> models/codec.py CodecDecoder tree. Layer indices are
+    positional (ELUs occupy torch ModuleList slots, mirrored flax-side);
+    the transposed-conv slots are derived from the config's layer
+    structure (idx 0 conv, idx 1 lstm, then per upsampling ratio:
+    ELU, ConvTranspose, num_residual_layers resnet units)."""
+    nres = codec_config.num_residual_layers
+    transpose_slots = {2 + r * (2 + nres) + 1
+                       for r in range(len(codec_config.upsampling_ratios))}
+    flat: dict[str, np.ndarray] = {}
+    for key, value in s.items():
+        parts = key.split(".")
+        if parts[0] == "quantizer":
+            # quantizer.layers.{k}.codebook.embed
+            if parts[-1] == "embed":
+                flat[f"codebook_{parts[2]}/embedding"] = value
+            continue
+        if parts[0] != "decoder":
+            continue
+        idx = parts[2]
+        rest = parts[3:]
+        base = f"layers_{idx}"
+        if rest[0] == "lstm":
+            flat[f"{base}/{rest[1]}"] = value
+        elif rest[0] == "conv":
+            if rest[-1] == "weight":
+                if value.ndim != 3:
+                    continue  # buffers (stride etc.)
+                # decoder ConvTranspose weights are (in, out, k); plain
+                # convs are (out, in, k) — both land as (k, in, out)
+                # (ConvTranspose orientation validated by the torch
+                # fidelity test)
+                if int(idx) in transpose_slots:
+                    flat[f"{base}/conv/kernel"] = value.transpose(2, 0, 1)
+                else:
+                    flat[f"{base}/conv/kernel"] = value.transpose(2, 1, 0)
+            elif rest[-1] == "bias":
+                flat[f"{base}/conv/bias"] = value
+        elif rest[0] in ("block", "shortcut"):
+            sub = "shortcut" if rest[0] == "shortcut" else f"block_{rest[1]}"
+            leaf = rest[-1]
+            inner = f"{base}/{sub}/conv"
+            if leaf == "weight" and value.ndim == 3:
+                flat[f"{inner}/kernel"] = value.transpose(2, 1, 0)
+            elif leaf == "bias":
+                flat[f"{inner}/bias"] = value
+    return _nest(flat)
+
+
+def convert_bark(state: Mapping[str, np.ndarray], family) -> dict:
+    """Full HF ``BarkModel`` state dict -> TTSComponents.params
+    (semantic / coarse / fine / codec trees)."""
+    state = _fold_parametrizations(_fold_weight_norm(state))
+
+    def sub(prefix: str) -> dict[str, np.ndarray]:
+        return {k[len(prefix):]: v for k, v in state.items()
+                if k.startswith(prefix)}
+
+    return {
+        "semantic": _convert_bark_gpt(sub("semantic.")),
+        "coarse": _convert_bark_gpt(sub("coarse_acoustics.")),
+        "fine": _convert_bark_fine(sub("fine_acoustics."),
+                                   family.n_fine, 1),
+        "codec": convert_encodec_decoder(sub("codec_model."),
+                                         family.codec),
+    }

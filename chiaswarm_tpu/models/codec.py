@@ -1,12 +1,18 @@
 """Neural codec decoder (Flax): RVQ code stacks -> waveform.
 
-The last stage of bark-class TTS (workloads/audio.py): the fine acoustic
-codes are EnCodec residual-vector-quantizer indices; decoding sums the
-per-codebook embeddings and runs a SEANet-style transposed-conv decoder.
-Mirrors EnCodec's 24 kHz decoder shape (ratios 8·5·4·2 -> hop 320) minus
-its LSTM block — inference here is pure convs, which XLA fuses into a
-handful of MXU-friendly kernels. Conversion from torch folds weight norm
-(convert/torch_to_flax.py:_fold_weight_norm).
+The last stage of bark-class TTS (pipelines/tts.py): fine acoustic codes
+are EnCodec residual-vector-quantizer indices; decoding sums per-codebook
+embeddings and runs the SEANet decoder. This is an EXACT port of the
+EnCodec 24 kHz decoder graph (causal convs with reflect left-padding, a
+2-layer residual LSTM, transposed convs with right-trim, residual units
+with conv shortcuts) so weights convert 1:1 from the torch checkpoint
+(convert/torch_to_flax.py::convert_encodec; weight norm folded).
+
+TPU notes: everything except the LSTM is convs that XLA fuses onto the
+MXU; the LSTM is a ``lax.scan`` over time at the code frame rate (75 Hz —
+hundreds of tiny steps, negligible next to the GPT stages). Codes pad
+right to a static frame bucket; causality makes trimming the decoded
+tail exact.
 """
 
 from __future__ import annotations
@@ -14,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -22,36 +29,144 @@ class CodecConfig:
     n_codebooks: int = 8
     codebook_size: int = 1024
     codebook_dim: int = 128
-    hidden: int = 512
-    upsample_rates: tuple[int, ...] = (8, 5, 4, 2)
-    kernel_mult: int = 2              # transposed-conv kernel = 2 * rate
+    num_filters: int = 32
+    upsampling_ratios: tuple[int, ...] = (8, 5, 4, 2)
+    kernel_size: int = 7
+    last_kernel_size: int = 7
+    residual_kernel_size: int = 3
+    dilation_growth_rate: int = 2
+    num_residual_layers: int = 1
+    compress: int = 2
+    num_lstm_layers: int = 2
+    use_conv_shortcut: bool = True
     sampling_rate: int = 24000
     dtype: str = "float32"
 
     @property
     def hop_length(self) -> int:
         hop = 1
-        for r in self.upsample_rates:
+        for r in self.upsampling_ratios:
             hop *= r
         return hop
 
 
-class DecoderResBlock(nn.Module):
+def _causal_pad(x: jnp.ndarray, pad: int) -> jnp.ndarray:
+    """Left reflect-pad along time (EnCodec's causal convention), with the
+    zero-extension fallback for inputs shorter than the pad."""
+    if pad == 0:
+        return x
+    t = x.shape[1]
+    if t <= pad:  # EnCodec's small-input hack: zero-extend right first
+        extra = pad - t + 1
+        x = jnp.pad(x, ((0, 0), (0, extra), (0, 0)))
+        x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)), mode="reflect")
+        return x[:, : x.shape[1] - extra]
+    return jnp.pad(x, ((0, 0), (pad, 0), (0, 0)), mode="reflect")
+
+
+class CausalConv1d(nn.Module):
     channels: int
+    kernel: int
+    dilation: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        pad = (self.kernel - 1) * self.dilation
+        x = _causal_pad(x, pad)
+        return nn.Conv(self.channels, (self.kernel,), padding="VALID",
+                       kernel_dilation=(self.dilation,), dtype=self.dtype,
+                       name="conv")(x)
+
+
+class CausalConvTranspose1d(nn.Module):
+    """Stride-r transposed conv; EnCodec trims the full (k - stride) pad
+    from the right (causal, trim_right_ratio=1)."""
+
+    channels: int
+    kernel: int
+    stride: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        y = nn.ConvTranspose(self.channels, (self.kernel,),
+                             strides=(self.stride,), padding="VALID",
+                             dtype=self.dtype, name="conv")(x)
+        trim = self.kernel - self.stride
+        return y[:, : y.shape[1] - trim] if trim else y
+
+
+class ResnetUnit(nn.Module):
+    """EnCodec SEANet residual unit: ELU-conv(k,dil)-ELU-conv(1) with a
+    1x1 conv shortcut."""
+
+    channels: int
+    kernel: int
+    dilation: int
+    compress: int
+    use_conv_shortcut: bool
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        hidden = self.channels // self.compress
         h = nn.elu(x)
-        h = nn.Conv(self.channels // 2, (3,), padding="SAME",
-                    dtype=self.dtype, name="conv1")(h)
+        h = CausalConv1d(hidden, self.kernel, self.dilation, self.dtype,
+                         name="block_1")(h)
         h = nn.elu(h)
-        h = nn.Conv(self.channels, (1,), dtype=self.dtype, name="conv2")(h)
+        h = CausalConv1d(self.channels, 1, 1, self.dtype, name="block_3")(h)
+        if self.use_conv_shortcut:
+            x = CausalConv1d(self.channels, 1, 1, self.dtype,
+                             name="shortcut")(x)
         return x + h
 
 
+class ResidualLSTM(nn.Module):
+    """torch-layout LSTM stack with residual add (EncodecLSTM)."""
+
+    hidden: int
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, c = x.shape
+        residual = x
+        h = x.astype(jnp.float32)
+        for layer in range(self.num_layers):
+            w_ih = self.param(f"weight_ih_l{layer}",
+                              nn.initializers.normal(0.02),
+                              (4 * self.hidden, h.shape[-1]))
+            w_hh = self.param(f"weight_hh_l{layer}",
+                              nn.initializers.normal(0.02),
+                              (4 * self.hidden, self.hidden))
+            b_ih = self.param(f"bias_ih_l{layer}", nn.initializers.zeros,
+                              (4 * self.hidden,))
+            b_hh = self.param(f"bias_hh_l{layer}", nn.initializers.zeros,
+                              (4 * self.hidden,))
+            x_proj = h @ w_ih.T + (b_ih + b_hh)  # (B, T, 4H), hoisted
+
+            def step(carry, xt, w_hh=w_hh):
+                hprev, cprev = carry
+                gates = xt + hprev @ w_hh.T
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c = nn.sigmoid(f) * cprev + nn.sigmoid(i) * jnp.tanh(g)
+                hnew = nn.sigmoid(o) * jnp.tanh(c)
+                return (hnew, c), hnew
+
+            zeros = jnp.zeros((b, self.hidden), jnp.float32)
+            (_, _), hs = jax.lax.scan(step, (zeros, zeros),
+                                      x_proj.swapaxes(0, 1))
+            h = hs.swapaxes(0, 1)
+        return residual + h.astype(residual.dtype)
+
+
 class CodecDecoder(nn.Module):
-    """(B, n_codebooks, T) int codes -> (B, T * hop_length) waveform."""
+    """(B, n_codebooks, T) int codes -> (B, T * hop_length) waveform.
+
+    Module names carry the torch ``decoder.layers.{i}`` indices (ELUs
+    occupy slots in the torch ModuleList) so conversion is positional.
+    """
 
     config: CodecConfig
 
@@ -69,17 +184,30 @@ class CodecDecoder(nn.Module):
             quantized = quantized + nn.Embed(
                 cfg.codebook_size, cfg.codebook_dim, dtype=dtype,
                 name=f"codebook_{k}")(codes[:, k])
-        x = nn.Conv(cfg.hidden, (7,), padding="SAME", dtype=dtype,
-                    name="conv_pre")(quantized)
-        ch = cfg.hidden
-        for i, rate in enumerate(cfg.upsample_rates):
-            ch = max(ch // 2, cfg.codebook_dim // 2)
+
+        scaling = 2 ** len(cfg.upsampling_ratios)
+        ch = scaling * cfg.num_filters
+        idx = 0
+        x = CausalConv1d(ch, cfg.kernel_size, 1, dtype,
+                         name=f"layers_{idx}")(quantized)
+        idx += 1
+        x = ResidualLSTM(ch, cfg.num_lstm_layers, name=f"layers_{idx}")(x)
+        for ratio in cfg.upsampling_ratios:
+            idx += 1  # ELU slot
             x = nn.elu(x)
-            x = nn.ConvTranspose(ch, (cfg.kernel_mult * rate,),
-                                 strides=(rate,), padding="SAME",
-                                 dtype=dtype, name=f"upsample_{i}")(x)
-            x = DecoderResBlock(ch, dtype, name=f"resblock_{i}")(x)
+            idx += 1
+            x = CausalConvTranspose1d(ch // 2, 2 * ratio, ratio, dtype,
+                                      name=f"layers_{idx}")(x)
+            ch //= 2
+            for j in range(cfg.num_residual_layers):
+                idx += 1
+                x = ResnetUnit(ch, cfg.residual_kernel_size,
+                               cfg.dilation_growth_rate ** j, cfg.compress,
+                               cfg.use_conv_shortcut, dtype,
+                               name=f"layers_{idx}")(x)
+        idx += 1  # final ELU slot
         x = nn.elu(x)
-        x = nn.Conv(1, (7,), padding="SAME", dtype=dtype,
-                    name="conv_post")(x)
-        return jnp.tanh(x)[..., 0].astype(jnp.float32)
+        idx += 1
+        x = CausalConv1d(1, cfg.last_kernel_size, 1, dtype,
+                         name=f"layers_{idx}")(x)
+        return x[..., 0].astype(jnp.float32)

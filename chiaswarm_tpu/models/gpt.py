@@ -49,9 +49,12 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, cache_k, cache_v, index, valid_len):
+    def __call__(self, x, cache_k, cache_v, index, valid_len,
+                 ring_bias=None):
         """x: (B, T, C) new tokens at positions [index, index+T).
-        cache_k/v: (B, block_size, H, D) rings. Returns (y, k, v)."""
+        cache_k/v: (B, block_size, H, D) rings. ``ring_bias`` (additive,
+        broadcastable to (B, 1, T, block_size)) overrides the default
+        causal ring mask — used by padded prefills. Returns (y, k, v)."""
         cfg = self.config
         head_dim = cfg.n_embd // cfg.n_head
         b, t, _ = x.shape
@@ -67,16 +70,19 @@ class Block(nn.Module):
         cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, index, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, index, 0, 0))
 
-        # causal mask over the ring: key j visible to query i (absolute
-        # position index+i) iff j <= index+i and j < valid_len
-        kpos = jnp.arange(cfg.block_size)
-        qpos = index + jnp.arange(t)
-        mask = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < valid_len)
-
         scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                             cache_k.astype(jnp.float32))
         scores = scores / jnp.sqrt(head_dim).astype(jnp.float32)
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        if ring_bias is not None:
+            scores = scores + ring_bias
+        else:
+            # causal mask over the ring: key j visible to query i (absolute
+            # position index+i) iff j <= index+i and j < valid_len
+            kpos = jnp.arange(cfg.block_size)
+            qpos = index + jnp.arange(t)
+            mask = (kpos[None, :] <= qpos[:, None]) & \
+                   (kpos[None, :] < valid_len)
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
         weights = nn.softmax(scores, axis=-1).astype(self.dtype)
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, cache_v)
         out = out.reshape(b, t, cfg.n_embd)
@@ -86,7 +92,7 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
         h = nn.Dense(4 * cfg.n_embd, use_bias=False, dtype=self.dtype,
                      name="mlp_fc")(h)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # bark uses exact-erf GELU
         x = x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
                          name="mlp_proj")(h)
         return x, cache_k, cache_v
@@ -103,25 +109,38 @@ class GPT(nn.Module):
         return jnp.dtype(self.config.dtype)
 
     @nn.compact
-    def __call__(self, ids, caches, index, valid_len):
-        """ids: (B, T) int32; caches: per-layer (k, v) tuple list;
-        index: scalar position of ids[0]; valid_len: scalar count of
-        valid cache positions after this call."""
+    def __call__(self, ids, caches, index, valid_len, *, embeds=None,
+                 ring_bias=None, pos_index=None):
+        """ids: (B, T) int32 (or ``embeds`` (B, T, C) directly — bark's
+        semantic prefill sums two embedding lookups); caches: per-layer
+        (k, v) tuple list; index: ring position of ids[0]; valid_len:
+        scalar count of valid cache positions after this call.
+        ``pos_index`` overrides the logical position for the position
+        embeddings (padded prefills); ``ring_bias`` overrides the ring
+        mask (see Block)."""
         cfg = self.config
-        b, t = ids.shape
-        tok = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=self.dtype,
-                       name="wte")(ids)
+        if embeds is None:
+            tok = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=self.dtype,
+                           name="wte")(ids)
+        else:
+            # materialize the embedding table even on the embeds path so
+            # both entry modes share one param structure
+            nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=self.dtype,
+                     name="wte")(jnp.zeros((1, 1), jnp.int32))
+            tok = embeds.astype(self.dtype)
+        t = tok.shape[1]
         pos_table = self.param(
             "wpe", nn.initializers.normal(0.02),
             (cfg.block_size, cfg.n_embd))
-        pos = jax.lax.dynamic_slice(pos_table, (index, 0), (t, cfg.n_embd))
+        start = index if pos_index is None else pos_index
+        pos = jax.lax.dynamic_slice(pos_table, (start, 0), (t, cfg.n_embd))
         x = tok + pos[None].astype(self.dtype)
 
         new_caches = []
         for i in range(cfg.n_layer):
             ck, cv = caches[i]
             x, ck, cv = Block(cfg, self.dtype, name=f"h_{i}")(
-                x, ck, cv, index, valid_len)
+                x, ck, cv, index, valid_len, ring_bias)
             new_caches.append((ck, cv))
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
@@ -181,3 +200,84 @@ def generate(gpt: GPT, params: Any, prompt_ids: jnp.ndarray,
         body, (caches, first, jnp.int32(prefill_len), key, done0),
         None, length=max_new - 1)
     return jnp.concatenate([first[:, None], toks.swapaxes(0, 1)], axis=1)
+
+
+class FineBlock(nn.Module):
+    """Non-causal transformer block (bark's fine stage is a masked-LM-style
+    autoencoder over the full 1024-frame window, not autoregressive).
+    Layer names match Block so the bark converter maps both uniformly."""
+
+    config: GPTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        head_dim = cfg.n_embd // cfg.n_head
+        b, t, _ = x.shape
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(self.dtype)
+        qkv = nn.Dense(3 * cfg.n_embd, use_bias=False, dtype=self.dtype,
+                       name="attn_qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, cfg.n_head, head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            q.reshape(shape).astype(jnp.float32),
+                            k.reshape(shape).astype(jnp.float32))
+        scores = scores / jnp.sqrt(head_dim).astype(jnp.float32)
+        weights = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.reshape(shape))
+        x = x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
+                         name="attn_proj")(out.reshape(b, t, cfg.n_embd))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
+        h = nn.Dense(4 * cfg.n_embd, use_bias=False, dtype=self.dtype,
+                     name="mlp_fc")(h)
+        h = nn.gelu(h, approximate=False)
+        return x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
+                            name="mlp_proj")(h)
+
+
+class FineGPT(nn.Module):
+    """Bark fine-acoustics model: ``n_codes_total`` embedding tables whose
+    lookups sum over the codebooks known so far, a full-window non-causal
+    transformer, and one LM head per predicted codebook.
+
+    ``__call__(codes, codebook_idx)``: codes (B, T, n_codes_total) int32,
+    ``codebook_idx`` static — embeds codebooks [0, codebook_idx] and
+    returns logits over the output vocab for codebook ``codebook_idx``.
+    """
+
+    config: GPTConfig
+    n_codes_total: int = 8
+    n_codes_given: int = 1
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(self, codes: jnp.ndarray, codebook_idx: int) -> jnp.ndarray:
+        cfg = self.config
+        b, t, _ = codes.shape
+        # materialize every table (shared param structure across
+        # codebook_idx traces); only [0, codebook_idx] contribute
+        tables = [nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=self.dtype,
+                           name=f"wte_{k}")
+                  for k in range(self.n_codes_total)]
+        x = sum(tables[k](codes[:, :, k])
+                for k in range(codebook_idx + 1))
+        for k in range(codebook_idx + 1, self.n_codes_total):
+            tables[k](jnp.zeros((1, 1), jnp.int32))
+        pos_table = self.param("wpe", nn.initializers.normal(0.02),
+                               (cfg.block_size, cfg.n_embd))
+        x = x + pos_table[None, :t].astype(self.dtype)
+        for i in range(cfg.n_layer):
+            x = FineBlock(cfg, self.dtype, name=f"h_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        heads = [nn.Dense(cfg.out_vocab, use_bias=False, dtype=jnp.float32,
+                          name=f"lm_head_{k}")
+                 for k in range(self.n_codes_total - self.n_codes_given)]
+        logits = heads[codebook_idx - self.n_codes_given](x)
+        for k, head in enumerate(heads):
+            if k != codebook_idx - self.n_codes_given:
+                head(jnp.zeros((1, 1, cfg.n_embd), self.dtype))
+        return logits

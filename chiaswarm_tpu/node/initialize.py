@@ -104,17 +104,22 @@ def _prefetch_openpose(models: list[dict[str, Any]],
     target = model_dir("openpose")
     if not wants or target.exists():
         return 0
+    tmp = target.with_name(target.name + ".fetching")
     try:
         from huggingface_hub import hf_hub_download
 
-        target.mkdir(parents=True, exist_ok=True)
+        tmp.mkdir(parents=True, exist_ok=True)
         hf_hub_download("lllyasviel/Annotators", "body_pose_model.pth",
-                        local_dir=str(target),
+                        local_dir=str(tmp),
                         token=settings.huggingface_token or None)
+        tmp.rename(target)  # only a COMPLETE fetch claims the model dir
         log.info("fetched openpose body_pose_model weights")
         return 1
     except Exception as exc:
         log.warning("openpose weight fetch failed: %s", exc)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
         return 0
 
 

@@ -292,8 +292,8 @@ class ModelRegistry:
 
     def tts_pipeline(self, model_name: str):
         """Resident bark-class TTS pipeline (swarm/audio/bark.py:11-38
-        parity, pipelines/tts.py). No torch checkpoint converter yet —
-        random weights only (gated behind allow_random)."""
+        parity, pipelines/tts.py). Checkpoints load from the torch
+        BarkModel layout via convert_bark."""
         from chiaswarm_tpu.pipelines.tts import (
             TTSComponents,
             TTSPipeline,
@@ -302,12 +302,25 @@ class ModelRegistry:
 
         def build():
             family = get_tts_family(model_name)
+            ckpt = model_dir(model_name)
+            if ckpt.exists():
+                try:
+                    log.info("loading tts model %s from %s", model_name,
+                             ckpt)
+                    return TTSPipeline(TTSComponents.from_checkpoint(
+                        ckpt, model_name, family))
+                except FileNotFoundError as exc:
+                    # empty/partial dir (interrupted download): fall
+                    # through to the configured fallback path
+                    log.warning("tts checkpoint at %s unusable (%s)",
+                                ckpt, exc)
             if self.allow_random:
                 log.warning("tts model %s: using random weights", model_name)
                 return TTSPipeline(TTSComponents.random(
                     family, model_name=model_name))
             raise ValueError(
-                f"tts model {model_name!r} is not available on this node"
+                f"tts model {model_name!r} is not available on this node "
+                f"(no checkpoint at {ckpt})"
             )
 
         return GLOBAL_CACHE.cached_params(

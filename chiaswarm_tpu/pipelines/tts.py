@@ -1,25 +1,33 @@
-"""Bark-class text-to-speech: three GPT stages + neural codec decode.
+"""Bark-class text-to-speech: three transformer stages + EnCodec decode.
 
 Capability parity with swarm/audio/bark.py:11-38 — the reference calls
-``suno-bark``'s ``preload_models`` + ``generate_audio`` and transcodes
-wav -> mp3. Bark's own structure is three autoregressive transformers
-(text -> semantic tokens -> coarse codec codes -> fine codec codes) over an
-EnCodec decoder; this pipeline reproduces that structure TPU-natively:
+``suno-bark``'s ``preload_models`` + ``generate_audio``. This pipeline
+reproduces bark's actual generation protocol natively:
 
-- every stage is the scan-decoding GPT of models/gpt.py — one compiled
-  program per stage generates the full token stream on-chip;
-- the fine stage decodes the remaining codebooks conditioned on coarse
-  codes (kept autoregressive here; bark's fine model is non-causal —
-  a capability deviation, not an API one);
-- codes feed the conv codec decoder (models/codec.py) for the waveform.
+- **semantic**: text ids (+offset, padded to 256) summed with the
+  semantic-history embedding, an infer token, then autoregressive decode
+  of semantic tokens (vocab suppressed to [0, semantic_vocab] + eos).
+- **coarse**: sliding-window decode over [semantic window ; infer token ;
+  coarse history], tokens alternating between two codebook ranges.
+- **fine**: non-causal window model filling codebooks 2..n over 1024-frame
+  buffers (models/gpt.py::FineGPT).
+- **codec**: EnCodec-exact SEANet decoder (models/codec.py).
 
-Voice presets (bark's speaker prompts) plug in as token-prompt prefixes via
-``voice_preset_tokens`` — the server can ship them in job parameters.
+TPU-first mechanics: each stage's decode is ONE compiled scan program
+(static window/prefill buckets with a traced actual-length, the padded
+ring slots masked out — the models/blip.py trick), sampling happens
+on-chip, and only token streams cross the host boundary. Checkpoints
+convert 1:1 from the torch bark layout (convert_bark); random tiny
+weights serve hermetic tests.
+
+Voice presets (bark's speaker history prompts) ride job parameters as
+``history`` arrays {semantic_prompt, coarse_prompt, fine_prompt}.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any
 
 import jax
@@ -28,35 +36,60 @@ import numpy as np
 
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.codec import CodecConfig, CodecDecoder
-from chiaswarm_tpu.models.gpt import GPT, GPTConfig, generate
+from chiaswarm_tpu.models.gpt import (
+    GPT,
+    NEG_INF,
+    FineGPT,
+    GPTConfig,
+    init_caches,
+)
 from chiaswarm_tpu.models.tokenizer import HashTokenizer
 
 
 @dataclasses.dataclass(frozen=True)
 class TTSFamily:
     name: str
-    semantic: GPTConfig       # text tokens -> semantic tokens
-    coarse: GPTConfig         # semantic -> first 2 codec books (interleaved)
-    fine: GPTConfig           # coarse -> remaining books
+    semantic: GPTConfig
+    coarse: GPTConfig
+    fine: GPTConfig
     codec: CodecConfig
-    text_vocab: int = 129595
-    semantic_vocab: int = 10000
-    semantic_rate_hz: float = 49.9    # semantic tokens per second
-    coarse_books: int = 2
-    prefill_len: int = 64             # static prompt bucket
+    # ---- bark protocol constants (HF generation_configuration_bark) ----
+    text_encoding_offset: int = 10_048
+    text_pad_token: int = 129_595
+    semantic_infer_token: int = 129_599
+    semantic_vocab: int = 10_000          # eos == pad == this id
+    max_input_semantic_length: int = 256
+    semantic_rate_hz: float = 49.9
+    max_semantic_tokens: int = 768
+    coarse_rate_hz: float = 75.0
+    n_coarse: int = 2
+    coarse_semantic_pad: int = 12_048
+    coarse_infer_token: int = 12_050
+    max_coarse_input_length: int = 256
+    max_coarse_history: int = 630
+    sliding_window_len: int = 60
+    n_fine: int = 8
+    fine_history_length: int = 512
+    fine_input_length: int = 1024
+    codebook_size: int = 1024
+
+    @property
+    def coarse_prefill(self) -> int:
+        # [semantic window ; infer ; coarse history] padded to one bucket
+        return self.max_coarse_input_length + 1 + self.max_coarse_history
 
 
 BARK = TTSFamily(
     name="bark",
-    semantic=GPTConfig(vocab_size=129600, output_vocab_size=10048,
-                       n_layer=24, n_head=16, n_embd=1024, block_size=1024,
-                       dtype="bfloat16"),
-    coarse=GPTConfig(vocab_size=12096, output_vocab_size=12096,
-                     n_layer=24, n_head=16, n_embd=1024, block_size=1024,
-                     dtype="bfloat16"),
-    fine=GPTConfig(vocab_size=1056, output_vocab_size=1024,
-                   n_layer=24, n_head=16, n_embd=1024, block_size=1024,
-                   dtype="bfloat16"),
+    semantic=GPTConfig(vocab_size=129_600, output_vocab_size=10_048,
+                       n_layer=24, n_head=16, n_embd=1024,
+                       block_size=1024, dtype="bfloat16"),
+    coarse=GPTConfig(vocab_size=12_096, output_vocab_size=12_096,
+                     n_layer=24, n_head=16, n_embd=1024,
+                     block_size=1024, dtype="bfloat16"),
+    fine=GPTConfig(vocab_size=1056, output_vocab_size=1056,
+                   n_layer=24, n_head=16, n_embd=1024,
+                   block_size=1024, dtype="bfloat16"),
     codec=CodecConfig(),
 )
 
@@ -64,16 +97,31 @@ TINY_TTS = TTSFamily(
     name="tiny_tts",
     semantic=GPTConfig(vocab_size=256, output_vocab_size=64, n_layer=2,
                        n_head=2, n_embd=32, block_size=128),
-    coarse=GPTConfig(vocab_size=128, output_vocab_size=128, n_layer=2,
-                     n_head=2, n_embd=32, block_size=128),
-    fine=GPTConfig(vocab_size=32, output_vocab_size=16, n_layer=2,
-                   n_head=2, n_embd=32, block_size=128),
+    coarse=GPTConfig(vocab_size=96, output_vocab_size=96, n_layer=2,
+                     n_head=2, n_embd=32, block_size=96),
+    fine=GPTConfig(vocab_size=24, output_vocab_size=24, n_layer=2,
+                   n_head=2, n_embd=32, block_size=32),
     codec=CodecConfig(n_codebooks=4, codebook_size=16, codebook_dim=8,
-                      hidden=16, upsample_rates=(4, 2), sampling_rate=16000),
-    text_vocab=250,
+                      num_filters=4, upsampling_ratios=(4, 2),
+                      num_lstm_layers=1, sampling_rate=16000),
+    text_encoding_offset=52,
+    text_pad_token=200,
+    semantic_infer_token=255,
     semantic_vocab=50,
+    max_input_semantic_length=16,
     semantic_rate_hz=50.0,
-    prefill_len=16,
+    max_semantic_tokens=32,
+    coarse_rate_hz=50.0,
+    n_coarse=2,
+    coarse_semantic_pad=90,
+    coarse_infer_token=91,
+    max_coarse_input_length=16,
+    max_coarse_history=12,
+    sliding_window_len=8,
+    n_fine=4,
+    fine_history_length=16,
+    fine_input_length=32,
+    codebook_size=16,
 )
 
 TTS_FAMILIES = {f.name: f for f in (BARK, TINY_TTS)}
@@ -89,6 +137,8 @@ def get_tts_family(model_name: str) -> TTSFamily:
     return TTS_FAMILIES["bark"]
 
 
+# ------------------------------------------------------------ components
+
 @dataclasses.dataclass
 class TTSComponents:
     family: TTSFamily
@@ -96,145 +146,374 @@ class TTSComponents:
     tokenizer: Any
     semantic: GPT
     coarse: GPT
-    fine: GPT
+    fine: FineGPT
     codec: CodecDecoder
     params: dict[str, Any]  # keys: semantic, coarse, fine, codec
+
+    @classmethod
+    def _modules(cls, family: TTSFamily):
+        return (GPT(family.semantic), GPT(family.coarse),
+                FineGPT(family.fine, n_codes_total=family.n_fine,
+                        n_codes_given=1),
+                CodecDecoder(family.codec))
 
     @classmethod
     def random(cls, family: TTSFamily | str, seed: int = 0,
                model_name: str | None = None) -> "TTSComponents":
         if isinstance(family, str):
             family = TTS_FAMILIES[family]
-        from chiaswarm_tpu.models.gpt import init_caches
-
         key = jax.random.PRNGKey(seed)
-        mods = {"semantic": GPT(family.semantic),
-                "coarse": GPT(family.coarse),
-                "fine": GPT(family.fine)}
+        semantic, coarse, fine, codec = cls._modules(family)
         params: dict[str, Any] = {}
-        for name, mod in mods.items():
+        for name, mod in (("semantic", semantic), ("coarse", coarse)):
             key, sub = jax.random.split(key)
             caches = init_caches(mod.config, 1)
             params[name] = jax.jit(mod.init)(
                 sub, jnp.zeros((1, 4), jnp.int32), caches, 0, jnp.int32(4))
-        codec = CodecDecoder(family.codec)
+        key, sub = jax.random.split(key)
+        params["fine"] = jax.jit(
+            lambda k: fine.init(
+                k, jnp.zeros((1, 8, family.n_fine), jnp.int32), 1))(sub)
         key, sub = jax.random.split(key)
         params["codec"] = jax.jit(codec.init)(
             sub, jnp.zeros((1, family.codec.n_codebooks, 8), jnp.int32))
-        tokenizer = HashTokenizer(family.text_vocab, family.prefill_len)
+        tokenizer = HashTokenizer(family.text_encoding_offset - 2,
+                                  family.max_input_semantic_length)
         return cls(family=family,
                    model_name=model_name or f"random/{family.name}",
-                   tokenizer=tokenizer, codec=codec, params=params, **mods)
+                   tokenizer=tokenizer, semantic=semantic, coarse=coarse,
+                   fine=fine, codec=codec, params=params)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_dir, model_name: str,
+                        family: TTSFamily | str | None = None,
+                        ) -> "TTSComponents":
+        """Load a torch bark snapshot (HF ``BarkModel`` layout: semantic /
+        coarse_acoustics / fine_acoustics / codec_model in one state
+        dict) via convert_bark."""
+        from pathlib import Path
+
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_bark,
+            read_torch_weights,
+        )
+        from chiaswarm_tpu.models.tokenizer import WordPieceTokenizer
+
+        if isinstance(family, str):
+            family = TTS_FAMILIES[family]
+        family = family or BARK
+        root = Path(checkpoint_dir)
+        params = convert_bark(read_torch_weights(root), family)
+        vocab = root / "vocab.txt"
+        if vocab.exists():
+            tokenizer = WordPieceTokenizer.from_vocab_file(
+                vocab, family.max_input_semantic_length)
+        else:
+            tokenizer = HashTokenizer(family.text_encoding_offset - 2,
+                                      family.max_input_semantic_length)
+        semantic, coarse, fine, codec = cls._modules(family)
+        return cls(family=family, model_name=model_name,
+                   tokenizer=tokenizer, semantic=semantic, coarse=coarse,
+                   fine=fine, codec=codec, params=params)
 
     def param_bytes(self) -> int:
         leaves = jax.tree.leaves(self.params)
         return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
 
 
+# --------------------------------------------------------- stage decode
+
+@partial(jax.jit, static_argnames=("gpt", "prefill_len", "max_new",
+                                   "top_k", "use_embeds"))
+def _stage_decode(gpt: GPT, params, prompt_ids, embeds, actual_len, key,
+                  *, prefill_len: int, max_new: int, top_k: int,
+                  temperature, step_masks, eos_id, pad_id,
+                  use_embeds: bool):
+    """Shared semantic/coarse decoder: padded static prefill (real tokens
+    left-aligned, ``actual_len`` traced), then one scan generating
+    ``max_new`` tokens with per-step additive logit masks.
+
+    ``step_masks``: (2, V) float32 added to the logits; step t uses
+    ``step_masks[t % 2]`` (bark's alternating-codebook processor; pass
+    the same row twice for the semantic stage). ``eos_id`` < 0 disables
+    early stop. ``temperature`` <= ~1e-5 degenerates to argmax."""
+    cfg = gpt.config
+    b = embeds.shape[0] if use_embeds else prompt_ids.shape[0]
+    ring = prefill_len + max_new
+    assert ring <= cfg.block_size, (ring, cfg.block_size)
+    alen = jnp.int32(actual_len)
+    caches = init_caches(cfg, b)
+    kpos = jnp.arange(cfg.block_size)
+
+    qpos = jnp.arange(prefill_len)
+    ok = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < alen)
+    bias = jnp.where(ok, 0.0, NEG_INF)[None, None]
+    logits, caches = gpt.apply(
+        params, None if use_embeds else prompt_ids, caches, 0, alen,
+        embeds=embeds if use_embeds else None, ring_bias=bias)
+    last = jnp.take_along_axis(
+        logits, jnp.full((b, 1, 1), 1, jnp.int32) * (alen - 1), axis=1
+    )[:, 0]
+
+    temp = jnp.maximum(jnp.float32(temperature), 1e-5)
+
+    def pick(key, logits, mask):
+        logits = logits + mask
+        scaled = logits / temp
+        if top_k > 0 and top_k < logits.shape[-1]:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    key, skey = jax.random.split(key)
+    first = pick(skey, last, step_masks[0])
+    done0 = first == eos_id
+
+    def body(carry, t):
+        caches, tok, key, done = carry
+        idx = prefill_len + t  # ring write slot
+        ok = (kpos < alen) | ((kpos >= prefill_len) & (kpos <= idx))
+        bias = jnp.where(ok, 0.0, NEG_INF)[None, None, None]
+        logits, caches = gpt.apply(
+            params, tok[:, None], caches, idx, idx + 1, ring_bias=bias,
+            pos_index=alen + t)
+        key, skey = jax.random.split(key)
+        nxt = pick(skey, logits[:, 0], step_masks[(t + 1) % 2])
+        nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+        done = done | (nxt == eos_id)
+        return (caches, nxt, key, done), nxt
+
+    (_, _, _, _), toks = jax.lax.scan(
+        body, (caches, first, key, done0), jnp.arange(max_new - 1))
+    return jnp.concatenate([first[:, None], toks.swapaxes(0, 1)], axis=1)
+
+
 class TTSPipeline:
-    """Resident three-stage TTS executor (one compiled scan per stage)."""
+    """Resident bark-protocol TTS executor."""
 
     def __init__(self, components: TTSComponents) -> None:
         self.c = components
+        self._fine_fwd = jax.jit(
+            lambda p, buf, ci: self.c.fine.apply(p, buf, ci),
+            static_argnums=2)
+        self._codec = jax.jit(
+            lambda p, codes: self.c.codec.apply(p, codes))
+
+    # ---- stage 1: text -> semantic tokens ----
+    def _semantic(self, text: str, key, temperature: float, top_k: int,
+                  max_new: int, history: np.ndarray | None) -> np.ndarray:
+        fam = self.c.family
+        cfg = fam.semantic
+        L = fam.max_input_semantic_length
+        ids = self.c.tokenizer.encode(text)[:L]
+        ids = np.asarray(ids, np.int64) + fam.text_encoding_offset
+        text_ids = np.full((1, L), fam.text_pad_token, np.int32)
+        text_ids[0, : len(ids)] = np.minimum(ids, cfg.vocab_size - 1)
+
+        hist = np.full((1, L), fam.semantic_vocab, np.int32)  # semantic pad
+        if history is not None:
+            h = np.asarray(history, np.int32).reshape(-1)[-L:]
+            hist[0, : len(h)] = h
+
+        table = self.c.params["semantic"]["params"]["wte"]["embedding"]
+        dtype = jnp.dtype(cfg.dtype)
+        emb = (jnp.asarray(table)[jnp.asarray(text_ids)]
+               + jnp.asarray(table)[jnp.asarray(hist)]).astype(dtype)
+        infer = jnp.asarray(table)[
+            jnp.full((1, 1), fam.semantic_infer_token)].astype(dtype)
+        embeds = jnp.concatenate([emb, infer], axis=1)  # (1, L+1, C)
+
+        # suppress everything outside [0, semantic_vocab] (eos == vocab)
+        mask = np.full(cfg.out_vocab, NEG_INF, np.float32)
+        mask[: fam.semantic_vocab + 1] = 0.0
+        masks = jnp.asarray(np.stack([mask, mask]))
+
+        out = _stage_decode(
+            self.c.semantic, self.c.params["semantic"], None, embeds,
+            L + 1, key, prefill_len=L + 1, max_new=max_new, top_k=top_k,
+            temperature=temperature, step_masks=masks,
+            eos_id=fam.semantic_vocab, pad_id=fam.semantic_vocab,
+            use_embeds=True)
+        sem = np.asarray(out)[0]
+        ends = np.nonzero(sem == fam.semantic_vocab)[0]
+        return sem[: int(ends[0])] if len(ends) else sem
+
+    # ---- stage 2: semantic -> coarse codes (sliding windows) ----
+    def _coarse_history(self, history, ratio: float, max_sem_hist: int,
+                        ) -> tuple[np.ndarray, list[int]]:
+        """Bark's preprocess_histories: offset each coarse codebook row,
+        flatten time-major into the shared vocab, align/trim both
+        histories (modeling_bark.py BarkCoarseModel.preprocess_histories
+        semantics)."""
+        fam = self.c.family
+        if history is None or "coarse_prompt" not in history \
+                or "semantic_prompt" not in history:
+            return np.zeros(0, np.int32), []
+        sem_h = np.asarray(history["semantic_prompt"], np.int32).reshape(-1)
+        coarse_h = np.array(history["coarse_prompt"], np.int64)
+        coarse_h = coarse_h.reshape(-1, coarse_h.shape[-1])[: fam.n_coarse]
+        for n in range(1, coarse_h.shape[0]):
+            coarse_h[n] += fam.codebook_size * n
+        flat = coarse_h.T.reshape(-1) + fam.semantic_vocab
+        n_sem = min(max_sem_hist, len(sem_h) - len(sem_h) % 2,
+                    int(np.floor(len(flat) / ratio)))
+        n_coarse_h = int(round(n_sem * ratio))
+        sem_h = sem_h[len(sem_h) - n_sem:] if n_sem else sem_h[:0]
+        flat = flat[len(flat) - n_coarse_h:][:-2]  # bark's alignment trim
+        return sem_h.astype(np.int32), flat.astype(np.int32).tolist()
+
+    def _coarse(self, semantic: np.ndarray, key, temperature: float,
+                top_k: int, history=None) -> np.ndarray:
+        fam = self.c.family
+        ratio = fam.coarse_rate_hz / fam.semantic_rate_hz * fam.n_coarse
+        max_sem_hist = int(np.floor(fam.max_coarse_history / ratio))
+        n_total = int(round(int(np.floor(
+            len(semantic) * ratio / fam.n_coarse)) * fam.n_coarse))
+        n_total = max(fam.n_coarse, n_total)
+        sw = fam.sliding_window_len
+        P = fam.coarse_prefill
+
+        sem_hist, x_coarse = self._coarse_history(history, ratio,
+                                                  max_sem_hist)
+        len_history = len(x_coarse)
+        base_sem_idx = len(sem_hist)
+        sem = np.concatenate([sem_hist, semantic.astype(np.int32)])
+        masks = np.full((2, fam.coarse.out_vocab), NEG_INF, np.float32)
+        lo = fam.semantic_vocab
+        masks[0, lo: lo + fam.codebook_size] = 0.0
+        masks[1, lo + fam.codebook_size: lo + 2 * fam.codebook_size] = 0.0
+        masks = jnp.asarray(masks)
+
+        n_windows = int(np.ceil(n_total / sw))
+        for _ in range(n_windows):
+            generated = len(x_coarse) - len_history
+            sem_idx = base_sem_idx + int(round(generated / ratio))
+            window = sem[max(0, sem_idx - max_sem_hist):]
+            window = window[: fam.max_coarse_input_length]
+            inp = np.full(fam.max_coarse_input_length,
+                          fam.coarse_semantic_pad, np.int32)
+            inp[: len(window)] = window
+            hist = np.asarray(x_coarse[-fam.max_coarse_history:], np.int32)
+            prompt = np.concatenate(
+                [inp, [fam.coarse_infer_token], hist]).astype(np.int32)
+            actual = len(prompt)
+            prompt = np.pad(prompt, (0, P - actual))[None]
+
+            key, sub = jax.random.split(key)
+            out = _stage_decode(
+                self.c.coarse, self.c.params["coarse"],
+                jnp.asarray(prompt), None, actual, sub, prefill_len=P,
+                max_new=sw, top_k=top_k, temperature=temperature,
+                step_masks=masks, eos_id=-1, pad_id=0, use_embeds=False)
+            take = min(sw, n_total - (len(x_coarse) - len_history))
+            x_coarse.extend(np.asarray(out)[0][:take].tolist())
+        return np.asarray(x_coarse[len_history:], np.int32)
+
+    # ---- stage 3: coarse -> all fine codebooks (window fills) ----
+    def _fine(self, coarse: np.ndarray, key, temperature: float | None,
+              history=None) -> np.ndarray:
+        fam = self.c.family
+        cbs = fam.codebook_size
+        frames = len(coarse) // fam.n_coarse
+        codes = (coarse[: frames * fam.n_coarse].reshape(frames,
+                                                         fam.n_coarse)
+                 - fam.semantic_vocab) % cbs
+        buf = np.full((frames, fam.n_fine), cbs, np.int32)  # pad token
+        buf[:, : fam.n_coarse] = codes
+
+        W, H = fam.fine_input_length, fam.fine_history_length
+        n_history = 0
+        if history is not None and "fine_prompt" in history:
+            fh = np.asarray(history["fine_prompt"],
+                            np.int64).reshape(fam.n_fine, -1).T % cbs
+            fh = fh[-H:].astype(np.int32)
+            n_history = len(fh)
+            buf = np.concatenate([fh, buf], axis=0)
+        n_remove = max(0, W - buf.shape[0])
+        if n_remove:
+            buf = np.pad(buf, ((0, n_remove), (0, 0)),
+                         constant_values=cbs)
+        n_loops = max(0, int(np.ceil((frames - (W - n_history)) / H))) + 1
+        total = buf.shape[0]
+        for n in range(n_loops):
+            start = min(n * H, total - W)
+            fill_start = min(n_history + n * H, total - H)
+            rel = fill_start - start
+            window = jnp.asarray(buf[None, start: start + W])
+            for ci in range(fam.n_coarse, fam.n_fine):
+                logits = self._fine_fwd(self.c.params["fine"], window, ci)
+                rel_logits = logits[0, :, :cbs]
+                if temperature is None or temperature <= 1e-4:
+                    preds = jnp.argmax(rel_logits, axis=-1)
+                else:
+                    key, sub = jax.random.split(key)
+                    preds = jax.random.categorical(
+                        sub, rel_logits / temperature, axis=-1)
+                preds = np.asarray(preds, np.int32)
+                window = np.array(window)  # writable host copy
+                window[0, rel:, ci] = preds[rel:]
+                window = jnp.asarray(window)
+            buf[start: start + W] = np.asarray(window)[0]
+        if n_remove:
+            buf = buf[:-n_remove]
+        return buf[n_history:].T % cbs  # (n_fine, frames)
 
     def __call__(self, text: str, duration_s: float = 4.0, seed: int = 0,
                  temperature: float = 0.7, top_k: int = 50,
                  voice_preset_tokens: list[int] | None = None,
+                 history: dict[str, np.ndarray] | None = None,
                  ) -> tuple[np.ndarray, int, dict]:
         fam = self.c.family
         key = key_for_seed(seed)
         k1, k2, k3 = jax.random.split(key, 3)
 
-        # ---- stage 1: text -> semantic tokens
-        prompt = self.c.tokenizer.encode(text)[: fam.prefill_len]
-        if voice_preset_tokens:
-            keep = fam.prefill_len - len(voice_preset_tokens)
-            prompt = (list(voice_preset_tokens) + prompt[: max(keep, 0)])[
-                : fam.prefill_len]
-        prompt = np.asarray([prompt], np.int32) % fam.semantic.vocab_size
         n_sem = int(min(duration_s * fam.semantic_rate_hz,
-                        fam.semantic.block_size - fam.prefill_len - 1))
-        # bucket to multiples of 32 so duration changes rarely recompile
+                        fam.max_semantic_tokens))
         n_sem = max(8, (n_sem + 31) // 32 * 32)
-        n_sem = min(n_sem, fam.semantic.block_size - fam.prefill_len - 1)
-        semantic = generate(
-            self.c.semantic, self.c.params["semantic"],
-            jnp.asarray(prompt), k1, prefill_len=fam.prefill_len,
-            max_new=n_sem, temperature=temperature, top_k=top_k)
-        semantic = jnp.mod(semantic, fam.semantic_vocab)
-
-        # ---- stage 2: semantic -> coarse codes (books interleaved)
-        c_prefill = min(n_sem, fam.coarse.block_size // 2)
-        coarse_prompt = jnp.mod(semantic[:, :c_prefill],
-                                fam.coarse.vocab_size)
-        n_coarse = min(
-            fam.coarse.block_size - c_prefill - 1,
-            fam.coarse_books * int(round(
-                n_sem / fam.semantic_rate_hz
-                * fam.codec.sampling_rate / fam.codec.hop_length)))
-        n_coarse = max(fam.coarse_books * 4,
-                       n_coarse - n_coarse % fam.coarse_books)
-        # context budget: the coarse ring caps output length; log the
-        # truncation instead of silently under-delivering (sliding-window
-        # coarse generation, as upstream bark does, is future work)
-        frames_possible = n_coarse // fam.coarse_books
-        sec_possible = frames_possible * fam.codec.hop_length \
-            / fam.codec.sampling_rate
-        if sec_possible + 0.25 < duration_s:
+        n_sem = min(n_sem, fam.max_semantic_tokens,
+                    fam.semantic.block_size
+                    - fam.max_input_semantic_length - 2)
+        if history is None and voice_preset_tokens:
+            history = {"semantic_prompt": np.asarray(voice_preset_tokens)}
+        sem_hist = None
+        if history is not None and "semantic_prompt" in history:
+            sem_hist = history["semantic_prompt"]
+        max_possible = fam.max_semantic_tokens / fam.semantic_rate_hz
+        if duration_s > max_possible + 0.25:
             import logging
 
             logging.getLogger("chiaswarm.tts").warning(
-                "tts request for %.1f s truncated to %.2f s by the coarse "
-                "stage context (block_size=%d)", duration_s, sec_possible,
-                fam.coarse.block_size)
-        coarse = generate(
-            self.c.coarse, self.c.params["coarse"], coarse_prompt, k2,
-            prefill_len=c_prefill, max_new=n_coarse,
-            temperature=temperature, top_k=top_k)
-        frames = n_coarse // fam.coarse_books
-        coarse_codes = jnp.mod(
-            coarse[:, : frames * fam.coarse_books].reshape(
-                1, frames, fam.coarse_books).swapaxes(1, 2),
-            fam.codec.codebook_size)                       # (1, 2, frames)
+                "tts request for %.1f s truncated to %.2f s by the "
+                "semantic stage context (max %d tokens @ %.1f Hz)",
+                duration_s, max_possible, fam.max_semantic_tokens,
+                fam.semantic_rate_hz)
+        semantic = self._semantic(text, k1, temperature, top_k, n_sem,
+                                  sem_hist)
+        if len(semantic) == 0:
+            semantic = np.zeros(8, np.int32)
+        coarse = self._coarse(semantic, k2, temperature, top_k,
+                              history=history)
+        fine = self._fine(coarse, k3,
+                          temperature if fam.n_fine > fam.n_coarse
+                          else None, history=history)
 
-        # ---- stage 3: coarse -> fine codes for the remaining books
-        fine_books = fam.codec.n_codebooks - fam.coarse_books
-        f_prefill = min(frames, fam.fine.block_size // 2)
-        fine_prompt = jnp.mod(coarse_codes[:, 0, :f_prefill],
-                              fam.fine.vocab_size)
-        n_fine = min(fine_books * frames,
-                     fam.fine.block_size - f_prefill - 1)
-        n_fine = max(fine_books, n_fine - n_fine % fine_books)
-        fine = generate(
-            self.c.fine, self.c.params["fine"], fine_prompt, k3,
-            prefill_len=f_prefill, max_new=n_fine,
-            temperature=temperature, top_k=top_k)
-        ff = n_fine // fine_books
-        fine_codes = jnp.mod(
-            fine[:, : ff * fine_books].reshape(1, ff, fine_books)
-            .swapaxes(1, 2), fam.codec.codebook_size)
-
-        # pad/trim fine frames to the coarse frame count, stack all books
-        if ff < frames:
-            import logging
-
-            logging.getLogger("chiaswarm.tts").warning(
-                "fine stage delivered %d/%d frames (block_size=%d); the "
-                "tail of the non-coarse codebooks is zero-padded",
-                ff, frames, fam.fine.block_size)
-            fine_codes = jnp.pad(fine_codes, ((0, 0), (0, 0),
-                                              (0, frames - ff)))
-        codes = jnp.concatenate([coarse_codes, fine_codes[:, :, :frames]],
-                                axis=1)                    # (1, books, frames)
-
-        wav = self.c.codec.apply(self.c.params["codec"], codes)
-        wav = np.asarray(jax.device_get(wav))
+        frames = fine.shape[1]
+        books = min(fam.codec.n_codebooks, fine.shape[0])
+        codes = fine[:books]
+        # static frame buckets for the codec program; causal decode makes
+        # right-pad + trim exact
+        bucket = max(64, (frames + 63) // 64 * 64)
+        padded = np.pad(codes, ((0, 0), (0, bucket - frames)))
+        wav = self._codec(self.c.params["codec"],
+                          jnp.asarray(padded[None]))
+        wav = np.asarray(jax.device_get(wav))[:, : frames
+                                              * fam.codec.hop_length]
         sr = fam.codec.sampling_rate
         config = {
             "model_name": self.c.model_name,
             "family": fam.name,
             "mode": "tts",
-            "semantic_tokens": int(n_sem),
+            "semantic_tokens": int(len(semantic)),
             "frames": int(frames),
             "requested_duration_s": float(duration_s),
             "duration_s": round(wav.shape[1] / sr, 3),

@@ -97,6 +97,11 @@ def tts_callback(slot, model_name: str, *, seed: int,
     parameters = parameters or {}
     pipe = registry.tts_pipeline(model_name)
     t0 = time.perf_counter()
+    # full bark voice preset: {semantic_prompt, coarse_prompt,
+    # fine_prompt} arrays in job parameters (JSON lists accepted)
+    history = parameters.get("history") or parameters.get("voice_preset")
+    if history is not None:
+        history = {k: np.asarray(v) for k, v in history.items()}
     wav, sr, config = pipe(
         prompt or "",
         duration_s=float(audio_length_in_s),
@@ -104,5 +109,6 @@ def tts_callback(slot, model_name: str, *, seed: int,
         temperature=float(temperature),
         voice_preset_tokens=(voice_preset_tokens
                              or parameters.get("voice_preset_tokens")),
+        history=history,
     )
     return _finalize_audio(slot, t0, wav, sr, config)

@@ -419,23 +419,29 @@ def load_cascade_checkpoint(checkpoint_dir: str | Path, model_name: str,
 
 # -------------------------------------------------------------- vocoder
 
-def _fold_weight_norm(state: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
-    """Fold torch ``weight_norm`` (weight_g/weight_v pairs) into plain
-    ``weight`` tensors: w = g * v / ||v|| (norm over non-output dims)."""
+def _fold_norm_pairs(state: Mapping[str, np.ndarray], v_suffix: str,
+                     g_suffix: str) -> dict[str, np.ndarray]:
+    """Fold torch weight-norm pairs (g, v) into plain ``weight`` tensors:
+    w = g * v / ||v|| (norm over non-dim-0 axes, torch's default dim=0)."""
     out: dict[str, np.ndarray] = {}
     for key, value in state.items():
-        if key.endswith(".weight_v"):
-            base = key[: -len(".weight_v")]
-            g = state[base + ".weight_g"]
+        if key.endswith(v_suffix):
+            base = key[: -len(v_suffix)]
+            g = state[base + g_suffix]
             v = value
             axes = tuple(range(1, v.ndim))
             norm = np.sqrt((v * v).sum(axis=axes, keepdims=True))
-            out[base + ".weight"] = (g * v / np.maximum(norm, 1e-12))
-        elif key.endswith(".weight_g"):
+            out[base + ".weight"] = g * v / np.maximum(norm, 1e-12)
+        elif key.endswith(g_suffix):
             continue
         else:
             out[key] = value
     return out
+
+
+def _fold_weight_norm(state: Mapping[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Classic ``weight_g``/``weight_v`` spelling."""
+    return _fold_norm_pairs(state, ".weight_v", ".weight_g")
 
 
 def convert_hifigan(state: Mapping[str, np.ndarray],
@@ -724,21 +730,9 @@ def convert_openpose(state: Mapping[str, np.ndarray]) -> dict:
 def _fold_parametrizations(state: Mapping[str, np.ndarray]
                            ) -> dict[str, np.ndarray]:
     """Newer torch spells weight norm as ``parametrizations.weight
-    .original0`` (g) / ``original1`` (v); fold to plain ``weight``."""
-    out: dict[str, np.ndarray] = {}
-    for key, value in state.items():
-        if key.endswith(".parametrizations.weight.original1"):
-            base = key[: -len(".parametrizations.weight.original1")]
-            g = state[base + ".parametrizations.weight.original0"]
-            v = value
-            axes = tuple(range(1, v.ndim))
-            norm = np.sqrt((v * v).sum(axis=axes, keepdims=True))
-            out[base + ".weight"] = g * v / np.maximum(norm, 1e-12)
-        elif key.endswith(".parametrizations.weight.original0"):
-            continue
-        else:
-            out[key] = value
-    return out
+    .original0`` (g) / ``original1`` (v); same fold."""
+    return _fold_norm_pairs(state, ".parametrizations.weight.original1",
+                            ".parametrizations.weight.original0")
 
 
 def _bark_layer_map(flat: dict, s: Mapping[str, np.ndarray]) -> None:

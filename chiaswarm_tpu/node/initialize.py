@@ -147,11 +147,30 @@ def warm_compile(models: list[dict[str, Any]]) -> None:
         if not name or not model_dir(name).exists():
             continue
         try:
-            pipe = registry.pipeline(name, mesh=mesh)
-            size = pipe.c.family.default_size
-            pipe(GenerateRequest(prompt="warmup", steps=2, height=size,
-                                 width=size, seed=0))
-            log.info("warmed %s at %dpx", name, size)
+            workflow = str((model.get("parameters") or {})
+                           .get("workflow", ""))
+            # bark outranks the txt2audio workflow tag: the hive serves
+            # bark UNDER txt2audio (job_args.py routing), so the name
+            # gate must win or bark would warm as AudioLDM and fail
+            if "bark" in name.lower():
+                registry.tts_pipeline(name)("warmup", duration_s=0.5)
+            elif name.startswith("DeepFloyd/"):
+                registry.cascade_pipeline(name, mesh=mesh)(
+                    "warmup", steps=2, sr_steps=2)
+            elif workflow == "txt2audio" or "audioldm" in name.lower():
+                registry.audio_pipeline(name)("warmup", steps=2,
+                                              duration_s=1.0)
+            elif workflow == "img2txt" or "blip" in name.lower():
+                import numpy as np
+
+                registry.caption_pipeline(name, mesh=mesh)(
+                    np.zeros((64, 64, 3), np.uint8))
+            else:
+                pipe = registry.pipeline(name, mesh=mesh)
+                size = pipe.c.family.default_size
+                pipe(GenerateRequest(prompt="warmup", steps=2,
+                                     height=size, width=size, seed=0))
+            log.info("warmed %s", name)
         except Exception as exc:
             log.warning("warm compile of %s failed: %s", name, exc)
 

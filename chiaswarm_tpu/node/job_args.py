@@ -46,7 +46,13 @@ def format_args(job: dict[str, Any], registry: ModelRegistry) -> FormatResult:
             tts_callback, txt2audio_callback,
         )
 
-        if args.get("model_name") == "suno/bark":
+        # "suno/bark" is the reference's exact TTS gate
+        # (swarm/job_arguments.py:22-23); any bark-family name (incl.
+        # the tiny hermetic family) takes the same path here
+        name = str(args.get("model_name", "")).lower()
+        from chiaswarm_tpu.pipelines.tts import TTS_FAMILIES
+
+        if "bark" in name or name.rsplit("/", 1)[-1] in TTS_FAMILIES:
             return tts_callback, args
         return _format_audio_args(args)
 

@@ -309,11 +309,12 @@ class ModelRegistry:
                              ckpt)
                     return TTSPipeline(TTSComponents.from_checkpoint(
                         ckpt, model_name, family))
-                except FileNotFoundError as exc:
-                    # empty/partial dir (interrupted download): fall
-                    # through to the configured fallback path
-                    log.warning("tts checkpoint at %s unusable (%s)",
-                                ckpt, exc)
+                except Exception as exc:
+                    # empty dir, truncated download (UnpicklingError),
+                    # or key mismatch: fall through to the configured
+                    # fallback path instead of poisoning every job
+                    log.warning("tts checkpoint at %s unusable (%s: %s)",
+                                ckpt, type(exc).__name__, exc)
             if self.allow_random:
                 log.warning("tts model %s: using random weights", model_name)
                 return TTSPipeline(TTSComponents.random(

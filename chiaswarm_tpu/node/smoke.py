@@ -61,6 +61,15 @@ SMOKE_JOBS: dict[str, dict[str, Any]] = {
         "content_type": "application/json",
         "_inject_image": True,
     },
+    "tts": {
+        # the reference's bark smoke job (swarm/test.py:45-51)
+        "id": "smoke-tts",
+        "workflow": "txt2audio",
+        "model_name": "random/tiny_tts",
+        "prompt": "hello from the swarm",
+        "audio_length_in_s": 0.3,
+        "content_type": "audio/wav",
+    },
     "cascade": {
         "id": "smoke-cascade",
         "model_name": "DeepFloyd/tiny_cascade",

@@ -100,6 +100,14 @@ def tts_callback(slot, model_name: str, *, seed: int,
     # full bark voice preset: {semantic_prompt, coarse_prompt,
     # fine_prompt} arrays in job parameters (JSON lists accepted)
     history = parameters.get("history") or parameters.get("voice_preset")
+    if isinstance(history, str):
+        # upstream bark names presets ("v2/en_speaker_6") resolved from
+        # bundled npz files this worker does not ship; a ValueError marks
+        # the job fatal/non-retryable (swarm/generator.py:34-41 taxonomy)
+        raise ValueError(
+            f"named voice preset {history!r} is not available on this "
+            "worker; send the preset arrays as parameters.history = "
+            "{semantic_prompt, coarse_prompt, fine_prompt}")
     if history is not None:
         history = {k: np.asarray(v) for k, v in history.items()}
     wav, sr, config = pipe(

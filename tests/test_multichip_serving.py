@@ -56,3 +56,21 @@ def test_multichip_matches_single_chip_output():
                                      mesh=pool.slots[0].mesh)(req)
     diff = np.abs(single_img.astype(np.int32) - multi_img.astype(np.int32))
     assert (diff <= 2).mean() > 0.99, diff.max()
+
+
+def test_caption_params_pin_to_slot_chip():
+    """Per-slot caption serving: params land on the slot's lead chip, not
+    the default device (registry.caption_pipeline mesh placement)."""
+    import jax
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    pool = ChipPool(n_slots=min(2, len(jax.devices())))
+    slot = pool.slots[-1]
+    pipe = registry.caption_pipeline("tinyblip", mesh=slot.mesh)
+    lead = slot.mesh.devices.flatten()[0]
+    devices = {next(iter(leaf.devices()))
+               for leaf in jax.tree.leaves(pipe.c.params)}
+    assert devices == {lead}, (devices, lead)
+    # a different slot keys a separate resident entry
+    other = registry.caption_pipeline("tinyblip", mesh=pool.slots[0].mesh)
+    assert other is not pipe

@@ -857,3 +857,40 @@ def convert_bark(state: Mapping[str, np.ndarray], family) -> dict:
         "codec": convert_encodec_decoder(sub("codec_model."),
                                          family.codec),
     }
+
+
+# ------------------------------------------------------------------- HED
+
+def convert_hed(state: Mapping[str, np.ndarray]) -> dict:
+    """``ControlNetHED.pth`` (controlnet_aux layout: ``norm`` (1,3,1,1),
+    ``block{b}.convs.{i}.weight``, ``block{b}.projection.weight``) ->
+    models/hed.py HEDNetwork tree."""
+    flat: dict[str, np.ndarray] = {}
+    n_blocks = 0
+    for key, value in state.items():
+        parts = key.split(".")
+        if parts[-1] not in ("weight", "bias") and key != "norm":
+            continue
+        if key == "norm":
+            flat["norm"] = value.reshape(-1)
+            continue
+        block = parts[0]
+        if not re.fullmatch(r"block\d+", block) or len(parts) < 3:
+            continue
+        n_blocks = max(n_blocks, int(block[5:]))
+        if parts[1] == "convs":
+            name = f"{block}/convs_{parts[2]}"
+        elif parts[1] == "projection":
+            name = f"{block}/projection"
+        else:
+            continue
+        if parts[-1] == "weight":
+            flat[f"{name}/kernel"] = value.transpose(2, 3, 1, 0)
+        else:
+            flat[f"{name}/bias"] = value
+    if n_blocks != 5 or "norm" not in flat:
+        raise ValueError(
+            f"state has {n_blocks} HED blocks (expected 5)"
+            + ("" if "norm" in flat else " and no 'norm' parameter")
+            + " — not a ControlNetHED checkpoint")
+    return _nest(flat)

@@ -1,0 +1,118 @@
+"""HED edge detector — the learned scribble/softedge preprocessor.
+
+The reference gets soft edges from controlnet_aux's HEDdetector
+(swarm/controlnet/input_processor.py:17-60 dispatch). This is the same
+network natively: a VGG-style trunk of five double/triple-conv blocks
+with a 1x1 side projection per block; the five side maps upsample to the
+input size and fuse by sigmoid-of-mean. Weights convert from the public
+``ControlNetHED.pth`` layout (convert/torch_to_flax.py::convert_hed).
+
+The CNN runs under jit; resize/fusion is host-side like the other
+preprocessors (workloads/controlnet.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# (out_channels, n_convs) per block — the fixed ControlNetHED graph
+_BLOCKS = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+
+class HEDBlock(nn.Module):
+    channels: int
+    n_convs: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        for i in range(self.n_convs):
+            x = nn.relu(nn.Conv(self.channels, (3, 3), padding=1,
+                                dtype=self.dtype, name=f"convs_{i}")(x))
+        side = nn.Conv(1, (1, 1), dtype=self.dtype, name="projection")(x)
+        return x, side
+
+
+class HEDNetwork(nn.Module):
+    """(B, H, W, 3) raw RGB (0-255 floats) -> 5 side maps at strides
+    1/1, 1/2, 1/4, 1/8, 1/16 (pre-sigmoid logits)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
+        norm = self.param("norm", nn.initializers.zeros, (3,))
+        x = x.astype(self.dtype) - norm.astype(self.dtype)
+        sides = []
+        for b, (ch, n) in enumerate(_BLOCKS):
+            if b > 0:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x, side = HEDBlock(ch, n, self.dtype, name=f"block{b + 1}")(x)
+            sides.append(side)
+        return sides
+
+
+@dataclasses.dataclass
+class HEDDetector:
+    """Ties the jitted CNN to the host fuse: sigmoid of the mean of the
+    upsampled side maps (controlnet_aux HEDdetector semantics)."""
+
+    params: dict
+
+    def __post_init__(self) -> None:
+        self._net = HEDNetwork()
+        self._fwd = jax.jit(lambda p, x: self._net.apply(p, x))
+
+    @classmethod
+    def random(cls, seed: int = 0, canvas: int = 512) -> "HEDDetector":
+        net = HEDNetwork()
+        x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        return cls(params=jax.jit(net.init)(jax.random.PRNGKey(seed), x),
+                   canvas=canvas)
+
+    @classmethod
+    def from_checkpoint(cls, path) -> "HEDDetector":
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_hed,
+            read_torch_weights,
+        )
+
+        return cls(params=convert_hed(read_torch_weights(path)))
+
+    # fixed working canvas: ONE compiled shape for every request (the
+    # per-size alternative recompiles the whole VGG on each new 16-px
+    # bucket, a multi-second stall inside the job)
+    canvas: int = 512
+
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        """uint8 RGB (H, W, 3) -> uint8 single-channel edge map."""
+        import cv2
+
+        h, w = image.shape[:2]
+        scale = self.canvas / max(h, w, 1)
+        nh = max(16, min(self.canvas, round(h * scale)))
+        nw = max(16, min(self.canvas, round(w * scale)))
+        resized = cv2.resize(image, (nw, nh),
+                             interpolation=cv2.INTER_AREA)
+        # replicate-pad to the square canvas: a zero apron would read as
+        # a hard dark border after mean subtraction and ring every scale
+        padded = cv2.copyMakeBorder(resized, 0, self.canvas - nh, 0,
+                                    self.canvas - nw, cv2.BORDER_REPLICATE)
+        sides = jax.device_get(self._fwd(
+            self.params, jnp.asarray(padded.astype(np.float32))[None]))
+        maps = []
+        for side in sides:
+            m = np.asarray(side, np.float32)[0, :, :, 0]
+            # crop the pad at map scale, then resize to the image
+            sy = m.shape[0] / self.canvas
+            sx = m.shape[1] / self.canvas
+            m = m[: max(1, round(nh * sy)), : max(1, round(nw * sx))]
+            maps.append(cv2.resize(m, (w, h),
+                                   interpolation=cv2.INTER_LINEAR))
+        fused = 1.0 / (1.0 + np.exp(-np.mean(np.stack(maps), axis=0)))
+        return (fused * 255.0).clip(0, 255).astype(np.uint8)

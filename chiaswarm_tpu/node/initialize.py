@@ -88,39 +88,53 @@ def prefetch_checkpoints(models: list[dict[str, Any]],
             fetched += 1
         except Exception as exc:
             log.warning("prefetch of %s failed: %s", name, exc)
-    fetched += _prefetch_openpose(models, settings)
+    fetched += _prefetch_annotators(models, settings)
     return fetched
 
 
-def _prefetch_openpose(models: list[dict[str, Any]],
-                       settings: Settings) -> int:
-    """Fetch the CMU body_pose_model weights (the one learned ControlNet
-    preprocessor, models/openpose.py) when any catalog model advertises an
-    openpose controlnet. Pulled from the public annotator mirror the
-    reference's controlnet_aux uses."""
-    wants = any("openpose" in str(m.get("parameters", {})).lower()
-                or "openpose" in str(m.get("name", "")).lower()
-                for m in models)
-    target = model_dir("openpose")
-    if not wants or target.exists():
-        return 0
-    tmp = target.with_name(target.name + ".fetching")
-    try:
-        from huggingface_hub import hf_hub_download
+# learned preprocessor weights (models/openpose.py, models/hed.py), pulled
+# from the public annotator mirror the reference's controlnet_aux uses:
+# local model-dir name -> (catalog hint words, weight filename)
+_ANNOTATORS = {
+    "openpose": (("openpose",), "body_pose_model.pth"),
+    "hed": (("hed", "scribble", "softedge"), "ControlNetHED.pth"),
+}
 
-        tmp.mkdir(parents=True, exist_ok=True)
-        hf_hub_download("lllyasviel/Annotators", "body_pose_model.pth",
-                        local_dir=str(tmp),
-                        token=settings.huggingface_token or None)
-        tmp.rename(target)  # only a COMPLETE fetch claims the model dir
-        log.info("fetched openpose body_pose_model weights")
-        return 1
-    except Exception as exc:
-        log.warning("openpose weight fetch failed: %s", exc)
-        import shutil
 
-        shutil.rmtree(tmp, ignore_errors=True)
-        return 0
+def _prefetch_annotators(models: list[dict[str, Any]],
+                         settings: Settings) -> int:
+    """Fetch learned-preprocessor weights when any catalog model
+    advertises a controlnet mode that needs them."""
+    import re
+
+    blob = " ".join(
+        f"{m.get('name', '')} {m.get('parameters') or {}}".lower()
+        for m in models)
+    words = set(re.findall(r"[a-z0-9]+", blob))  # word-boundary matching:
+    # a substring test would fire 'hed' on 'scheduler'/'cached'
+    fetched = 0
+    for local_name, (hints, filename) in _ANNOTATORS.items():
+        target = model_dir(local_name)
+        if target.exists() or not any(h in words for h in hints):
+            continue
+        tmp = target.with_name(target.name + ".fetching")
+        try:
+            from huggingface_hub import hf_hub_download
+
+            tmp.mkdir(parents=True, exist_ok=True)
+            hf_hub_download("lllyasviel/Annotators", filename,
+                            local_dir=str(tmp),
+                            token=settings.huggingface_token or None)
+            tmp.rename(target)  # only a COMPLETE fetch claims the dir
+            log.info("fetched %s annotator weights (%s)", local_name,
+                     filename)
+            fetched += 1
+        except Exception as exc:
+            log.warning("%s weight fetch failed: %s", local_name, exc)
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    return fetched
 
 
 def warm_compile(models: list[dict[str, Any]]) -> None:

@@ -47,12 +47,14 @@ def format_args(job: dict[str, Any], registry: ModelRegistry) -> FormatResult:
         )
 
         # "suno/bark" is the reference's exact TTS gate
-        # (swarm/job_arguments.py:22-23); any bark-family name (incl.
-        # the tiny hermetic family) takes the same path here
+        # (swarm/job_arguments.py:22-23); any bark-family TAIL (incl.
+        # the tiny hermetic family) takes the same path here — a plain
+        # substring test would hijack e.g. "acme/embark-audioldm"
         name = str(args.get("model_name", "")).lower()
+        tail = name.rsplit("/", 1)[-1]
         from chiaswarm_tpu.pipelines.tts import TTS_FAMILIES
 
-        if "bark" in name or name.rsplit("/", 1)[-1] in TTS_FAMILIES:
+        if tail == "bark" or tail in TTS_FAMILIES:
             return tts_callback, args
         return _format_audio_args(args)
 

@@ -10,9 +10,11 @@ Implemented without controlnet_aux. Exact ports: canny (cv2.Canny), tile
 (64-multiple resize), pix2pix (passthrough), shuffle (content shuffle).
 openpose runs the NATIVE CMU body-pose network (models/openpose.py,
 converted body_pose_model weights; raises with a fetch hint when the
-weights are absent). Model-free stand-ins for the remaining learned
-detectors (documented per function): scribble/softedge (Scharr sketch ~
-HED/PidiNet), mlsd (probabilistic Hough line segments), lineart
+weights are absent); scribble/softedge run the NATIVE HED network
+(models/hed.py) when its weights are present, falling back to a
+blurred-Scharr stand-in. Model-free stand-ins for the remaining learned
+detectors (documented per function): mlsd (probabilistic Hough line
+segments), lineart
 (dodge-sketch line extraction), depth (defocus + position-prior
 pseudo-depth ~ MiDaS), normalbae (normals from the pseudo-depth), seg
 (mean-shift posterization onto the ADE20K palette the reference carries
@@ -45,12 +47,36 @@ def image_to_canny(image: Image.Image) -> Image.Image:
     return Image.fromarray(np.stack([edges] * 3, axis=-1))
 
 
+_HED: list[Any] = []  # resident detector (lazy; [None] = no weights)
+
+
 @_register("scribble")
 @_register("softedge")
 def image_to_soft_edges(image: Image.Image) -> Image.Image:
-    """Model-free soft-edge map: blurred Scharr gradient magnitude (stands
-    in for the reference's HED/PidiNet detectors, input_processor.py:17-60)."""
+    """Soft-edge map for the HED/PidiNet modes (input_processor.py:17-60).
+    With converted ``ControlNetHED`` weights in the model dir this runs
+    the native HED network (models/hed.py); without them it falls back to
+    the model-free blurred-Scharr stand-in (logged once)."""
     import cv2
+
+    if not _HED:
+        from chiaswarm_tpu.node.registry import model_dir
+
+        ckpt = model_dir("hed")
+        if ckpt.exists():
+            from chiaswarm_tpu.models.hed import HEDDetector
+
+            _HED.append(HEDDetector.from_checkpoint(ckpt))
+        else:
+            import logging
+
+            logging.getLogger("chiaswarm.preprocess").info(
+                "no HED weights at %s; scribble/softedge use the "
+                "gradient-magnitude stand-in", ckpt)
+            _HED.append(None)
+    if _HED[0] is not None:
+        edge = _HED[0](np.asarray(image.convert("RGB")))
+        return Image.fromarray(np.stack([edge] * 3, axis=-1))
 
     gray = cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2GRAY)
     gray = cv2.GaussianBlur(gray, (5, 5), 0)

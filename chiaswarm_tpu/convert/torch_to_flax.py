@@ -894,3 +894,79 @@ def convert_hed(state: Mapping[str, np.ndarray]) -> dict:
             + ("" if "norm" in flat else " and no 'norm' parameter")
             + " — not a ControlNetHED checkpoint")
     return _nest(flat)
+
+
+# ------------------------------------------------------------------- DPT
+
+def convert_dpt(state: Mapping[str, np.ndarray]) -> dict:
+    """HF ``DPTForDepthEstimation`` (plain-ViT backbone) state dict ->
+    models/dpt.py DPTDepth tree."""
+    flat: dict[str, np.ndarray] = {}
+    s = state
+    flat["cls_token"] = s["dpt.embeddings.cls_token"]
+    flat["position_embeddings"] = s["dpt.embeddings.position_embeddings"][0]
+    _place(flat, "patch_embedding", "weight",
+           s["dpt.embeddings.patch_embeddings.projection.weight"])
+    flat["patch_embedding/bias"] = s[
+        "dpt.embeddings.patch_embeddings.projection.bias"]
+
+    n_layers = 1 + max(
+        int(k.split(".")[3]) for k in s if k.startswith("dpt.encoder.layer."))
+    for i in range(n_layers):
+        t = f"dpt.encoder.layer.{i}"
+        f = f"layer_{i}"
+        for name, torch_name in (
+                ("query", "attention.attention.query"),
+                ("key", "attention.attention.key"),
+                ("value", "attention.attention.value"),
+                ("attn_out", "attention.output.dense"),
+                ("intermediate", "intermediate.dense"),
+                ("output", "output.dense")):
+            _blip_linear(flat, s, f"{t}.{torch_name}", f"{f}/{name}")
+        for ln in ("layernorm_before", "layernorm_after"):
+            _blip_ln(flat, s, f"{t}.{ln}", f"{f}/{ln}")
+
+    n_stages = 1 + max(
+        int(k.split(".")[2]) for k in s if k.startswith("neck.convs."))
+    for i in range(n_stages):
+        _blip_linear(flat, s, f"neck.reassemble_stage.readout_projects.{i}.0",
+                     f"readout_{i}")
+        _place(flat, f"reassemble_proj_{i}", "weight",
+               s[f"neck.reassemble_stage.layers.{i}.projection.weight"])
+        flat[f"reassemble_proj_{i}/bias"] = s[
+            f"neck.reassemble_stage.layers.{i}.projection.bias"]
+        rkey = f"neck.reassemble_stage.layers.{i}.resize.weight"
+        if rkey in s:
+            w = s[rkey]
+            bias = s[f"neck.reassemble_stage.layers.{i}.resize.bias"]
+            if w.shape[-1] == 3:  # 3x3 stride-2 downsample conv (O,I,3,3)
+                flat[f"reassemble_resize_{i}/kernel"] = w.transpose(
+                    2, 3, 1, 0)
+            else:                 # ConvTranspose2d (I,O,k,k)
+                flat[f"reassemble_resize_{i}/kernel"] = w.transpose(
+                    2, 3, 0, 1)
+            flat[f"reassemble_resize_{i}/bias"] = bias
+        _place(flat, f"neck_conv_{i}", "weight",
+               s[f"neck.convs.{i}.weight"])
+
+        t = f"neck.fusion_stage.layers.{i}"
+        _place(flat, f"fusion_{i}_proj", "weight",
+               s[f"{t}.projection.weight"])
+        flat[f"fusion_{i}_proj/bias"] = s[f"{t}.projection.bias"]
+        for res, fres in (("residual_layer1", "res1"),
+                          ("residual_layer2", "res2")):
+            if i == 0 and fres == "res1":
+                continue  # first fusion layer is called without a residual
+            for conv in ("convolution1", "convolution2"):
+                key = f"{t}.{res}.{conv}.weight"
+                name = f"fusion_{i}_{fres}_conv{conv[-1]}"
+                _place(flat, name, "weight", s[key])
+                bkey = f"{t}.{res}.{conv}.bias"
+                if bkey in s:
+                    flat[f"{name}/bias"] = s[bkey]
+
+    for idx, name in ((0, "head_conv1"), (2, "head_conv2"),
+                      (4, "head_conv3")):
+        _place(flat, name, "weight", s[f"head.head.{idx}.weight"])
+        flat[f"{name}/bias"] = s[f"head.head.{idx}.bias"]
+    return _nest(flat)

@@ -1,0 +1,272 @@
+"""DPT monocular depth estimation — the learned depth/normal preprocessor.
+
+The reference's depth ControlNet mode runs the transformers
+depth-estimation pipeline (swarm/controlnet/input_processor.py:87-93,
+Intel/dpt-*); this is the same DPT architecture natively: a plain ViT
+backbone tapped at four layers, the reassemble stage (readout-projected
+tokens -> image-like maps at 4 scales), the feature-fusion decoder
+(pre-activation residual units, align-corners-true x2 upsampling), and
+the 3-conv depth head. Weights convert 1:1 from the HF
+``DPTForDepthEstimation`` state dict (convert/torch_to_flax.py::
+convert_dpt), fidelity-tested against torch.
+
+TPU notes: one fixed square canvas (the checkpoint's ViT grid) keeps a
+single compiled program for every request size; the align-corners
+bilinear x2 upsamples are einsum contractions with constant weight
+matrices (MXU work, no gathers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DPTConfig:
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    image_size: int = 384
+    patch_size: int = 16
+    backbone_out_indices: Sequence[int] = (5, 11, 17, 23)
+    neck_hidden_sizes: Sequence[int] = (256, 512, 1024, 1024)
+    reassemble_factors: Sequence[float] = (4, 2, 1, 0.5)
+    fusion_hidden_size: int = 256
+    qkv_bias: bool = True
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+
+
+# Intel/dpt-large
+DPT_LARGE = DPTConfig()
+
+DPT_TINY = DPTConfig(hidden_size=32, intermediate_size=64, num_layers=4,
+                     num_heads=4, image_size=32, patch_size=8,
+                     backbone_out_indices=(0, 1, 2, 3),
+                     neck_hidden_sizes=(16, 16, 24, 24),
+                     fusion_hidden_size=16)
+
+DPT_CONFIGS = {"dpt_large": DPT_LARGE, "dpt_tiny": DPT_TINY}
+
+
+def _upsample_matrix(n_in: int, n_out: int) -> np.ndarray:
+    """(n_out, n_in) align_corners=True bilinear interpolation weights."""
+    w = np.zeros((n_out, n_in), np.float32)
+    if n_in == 1:
+        w[:, 0] = 1.0
+        return w
+    pos = np.arange(n_out) * (n_in - 1) / max(n_out - 1, 1)
+    lo = np.floor(pos).astype(np.int64).clip(0, n_in - 1)
+    hi = np.minimum(lo + 1, n_in - 1)
+    frac = (pos - lo).astype(np.float32)
+    w[np.arange(n_out), lo] += 1.0 - frac
+    w[np.arange(n_out), hi] += frac
+    return w
+
+
+def _upsample2x(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W, C) -> (B, 2H, 2W, C), bilinear align_corners=True (the
+    torch ``interpolate(scale_factor=2, align_corners=True)`` the DPT
+    decoder uses)."""
+    b, h, w, c = x.shape
+    wh = jnp.asarray(_upsample_matrix(h, 2 * h))
+    ww = jnp.asarray(_upsample_matrix(w, 2 * w))
+    x = jnp.einsum("oh,bhwc->bowc", wh, x)
+    return jnp.einsum("pw,bowc->bopc", ww, x)
+
+
+class DPTViTLayer(nn.Module):
+    config: DPTConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        b, l, _ = x.shape
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="layernorm_before")(x).astype(self.dtype)
+        dense = lambda name: nn.Dense(cfg.hidden_size,
+                                      use_bias=cfg.qkv_bias,
+                                      dtype=self.dtype, name=name)
+        split = lambda t: t.reshape(b, l, cfg.num_heads, head_dim)
+        q = split(dense("query")(h))
+        k = split(dense("key")(h))
+        v = split(dense("value")(h))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (head_dim ** -0.5)
+        weights = nn.softmax(scores, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", weights, v).reshape(b, l, -1)
+        x = x + nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                         name="attn_out")(out)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="layernorm_after")(x).astype(self.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=self.dtype,
+                     name="intermediate")(h)
+        h = nn.gelu(h, approximate=False)
+        return x + nn.Dense(cfg.hidden_size, dtype=self.dtype,
+                            name="output")(h)
+
+
+class DPTDepth(nn.Module):
+    """(B, S, S, 3) normalized pixels (S = config.image_size) ->
+    (B, 16*S/patch, 16*S/patch) relative inverse depth — the fusion
+    decoder upsamples x2 per stage from the ViT grid (S/patch) and the
+    head adds one more, so patch 16 checkpoints return (B, S, S)."""
+
+    config: DPTConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(self, pixel_values: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.dtype
+        b = pixel_values.shape[0]
+        grid = cfg.image_size // cfg.patch_size
+
+        # ---- ViT backbone, tapped at 4 layers
+        patches = nn.Conv(cfg.hidden_size,
+                          (cfg.patch_size, cfg.patch_size),
+                          strides=(cfg.patch_size, cfg.patch_size),
+                          dtype=dtype, name="patch_embedding",
+                          )(pixel_values.astype(dtype))
+        patches = patches.reshape(b, -1, cfg.hidden_size)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.hidden_size))
+        pos = self.param("position_embeddings", nn.initializers.zeros,
+                         (grid * grid + 1, cfg.hidden_size))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(dtype), (b, 1, cfg.hidden_size)),
+             patches], axis=1)
+        x = x + pos[None].astype(dtype)
+
+        taps = []
+        want = set(cfg.backbone_out_indices)
+        for i in range(cfg.num_layers):
+            x = DPTViTLayer(cfg, dtype, name=f"layer_{i}")(x)
+            if i in want:
+                taps.append(x)
+
+        # ---- reassemble: tokens -> 4 image-like maps
+        maps = []
+        for i, state in enumerate(taps):
+            cls_tok, tokens = state[:, :1], state[:, 1:]
+            readout = jnp.concatenate(
+                [tokens, jnp.broadcast_to(cls_tok, tokens.shape)], axis=-1)
+            tokens = nn.gelu(
+                nn.Dense(cfg.hidden_size, dtype=dtype,
+                         name=f"readout_{i}")(readout), approximate=False)
+            m = tokens.reshape(b, grid, grid, cfg.hidden_size)
+            m = nn.Conv(cfg.neck_hidden_sizes[i], (1, 1), dtype=dtype,
+                        name=f"reassemble_proj_{i}")(m)
+            factor = cfg.reassemble_factors[i]
+            if factor > 1:
+                f = int(factor)
+                m = nn.ConvTranspose(cfg.neck_hidden_sizes[i], (f, f),
+                                     strides=(f, f), padding="VALID",
+                                     dtype=dtype,
+                                     name=f"reassemble_resize_{i}")(m)
+            elif factor < 1:
+                m = nn.Conv(cfg.neck_hidden_sizes[i], (3, 3),
+                            strides=(2, 2), padding=1, dtype=dtype,
+                            name=f"reassemble_resize_{i}")(m)
+            m = nn.Conv(cfg.fusion_hidden_size, (3, 3), padding=1,
+                        use_bias=False, dtype=dtype,
+                        name=f"neck_conv_{i}")(m)
+            maps.append(m)
+
+        # ---- fusion decoder (coarsest first)
+        def residual_unit(m, name):
+            h = nn.relu(m)
+            h = nn.Conv(cfg.fusion_hidden_size, (3, 3), padding=1,
+                        dtype=dtype, name=f"{name}_conv1")(h)
+            h = nn.relu(h)
+            h = nn.Conv(cfg.fusion_hidden_size, (3, 3), padding=1,
+                        dtype=dtype, name=f"{name}_conv2")(h)
+            return m + h
+
+        fused = None
+        for j, m in enumerate(reversed(maps)):
+            name = f"fusion_{j}"
+            if fused is None:
+                fused = m
+            else:
+                fused = fused + residual_unit(m, f"{name}_res1")
+            fused = residual_unit(fused, f"{name}_res2")
+            fused = _upsample2x(fused)
+            fused = nn.Conv(cfg.fusion_hidden_size, (1, 1), dtype=dtype,
+                            name=f"{name}_proj")(fused)
+
+        # ---- depth head
+        h = nn.Conv(cfg.fusion_hidden_size // 2, (3, 3), padding=1,
+                    dtype=dtype, name="head_conv1")(fused)
+        h = _upsample2x(h)
+        h = nn.relu(nn.Conv(32, (3, 3), padding=1, dtype=dtype,
+                            name="head_conv2")(h))
+        h = nn.relu(nn.Conv(1, (1, 1), dtype=jnp.float32,
+                            name="head_conv3")(h))
+        return h[..., 0]
+
+
+@dataclasses.dataclass
+class DPTDetector:
+    """Host wrapper: resize/normalize to the fixed canvas, run the jitted
+    model, min-max scale the inverse depth to a uint8 map (the depth
+    conditioning format)."""
+
+    params: dict
+    config: DPTConfig = DPT_LARGE
+
+    def __post_init__(self) -> None:
+        self._net = DPTDepth(self.config)
+        self._fwd = jax.jit(lambda p, x: self._net.apply(p, x))
+
+    @classmethod
+    def random(cls, seed: int = 0,
+               config: DPTConfig = DPT_TINY) -> "DPTDetector":
+        net = DPTDepth(config)
+        x = jnp.zeros((1, config.image_size, config.image_size, 3),
+                      jnp.float32)
+        return cls(params=jax.jit(net.init)(jax.random.PRNGKey(seed), x),
+                   config=config)
+
+    @classmethod
+    def from_checkpoint(cls, path,
+                        config: DPTConfig = DPT_LARGE) -> "DPTDetector":
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_dpt,
+            read_torch_weights,
+        )
+
+        return cls(params=convert_dpt(read_torch_weights(path)),
+                   config=config)
+
+    def depth(self, image: np.ndarray) -> np.ndarray:
+        """uint8 RGB (H, W, 3) -> float32 relative inverse depth (H, W),
+        larger = nearer."""
+        import cv2
+
+        h, w = image.shape[:2]
+        s = self.config.image_size
+        resized = cv2.resize(image, (s, s), interpolation=cv2.INTER_CUBIC)
+        arr = resized.astype(np.float32) / 255.0
+        arr = (arr - 0.5) / 0.5  # DPT image processor: mean .5, std .5
+        out = np.asarray(self._fwd(self.params, jnp.asarray(arr)[None]))[0]
+        return cv2.resize(out, (w, h), interpolation=cv2.INTER_CUBIC)
+
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        """uint8 RGB -> uint8 single-channel depth conditioning map."""
+        d = self.depth(image)
+        lo, hi = float(d.min()), float(d.max())
+        return ((d - lo) / max(hi - lo, 1e-6) * 255.0).astype(np.uint8)

@@ -92,12 +92,17 @@ def prefetch_checkpoints(models: list[dict[str, Any]],
     return fetched
 
 
-# learned preprocessor weights (models/openpose.py, models/hed.py), pulled
-# from the public annotator mirror the reference's controlnet_aux uses:
-# local model-dir name -> (catalog hint words, weight filename)
+# learned preprocessor weights (models/openpose.py, models/hed.py,
+# models/dpt.py): local model-dir name -> (catalog hint words, hub repo,
+# weight filename). openpose/hed come from the public annotator mirror
+# the reference's controlnet_aux uses; depth from the Intel DPT release.
 _ANNOTATORS = {
-    "openpose": (("openpose",), "body_pose_model.pth"),
-    "hed": (("hed", "scribble", "softedge"), "ControlNetHED.pth"),
+    "openpose": (("openpose",), "lllyasviel/Annotators",
+                 "body_pose_model.pth"),
+    "hed": (("hed", "scribble", "softedge"), "lllyasviel/Annotators",
+            "ControlNetHED.pth"),
+    "dpt": (("depth", "normal", "normalbae"), "Intel/dpt-large",
+            "model.safetensors"),
 }
 
 
@@ -113,7 +118,7 @@ def _prefetch_annotators(models: list[dict[str, Any]],
     words = set(re.findall(r"[a-z0-9]+", blob))  # word-boundary matching:
     # a substring test would fire 'hed' on 'scheduler'/'cached'
     fetched = 0
-    for local_name, (hints, filename) in _ANNOTATORS.items():
+    for local_name, (hints, repo, filename) in _ANNOTATORS.items():
         target = model_dir(local_name)
         if target.exists() or not any(h in words for h in hints):
             continue
@@ -122,7 +127,7 @@ def _prefetch_annotators(models: list[dict[str, Any]],
             from huggingface_hub import hf_hub_download
 
             tmp.mkdir(parents=True, exist_ok=True)
-            hf_hub_download("lllyasviel/Annotators", filename,
+            hf_hub_download(repo, filename,
                             local_dir=str(tmp),
                             token=settings.huggingface_token or None)
             tmp.rename(target)  # only a COMPLETE fetch claims the dir

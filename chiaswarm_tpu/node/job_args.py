@@ -48,13 +48,14 @@ def format_args(job: dict[str, Any], registry: ModelRegistry) -> FormatResult:
 
         # "suno/bark" is the reference's exact TTS gate
         # (swarm/job_arguments.py:22-23); any bark-family TAIL (incl.
-        # the tiny hermetic family) takes the same path here — a plain
-        # substring test would hijack e.g. "acme/embark-audioldm"
+        # variants like "bark-small" and the tiny hermetic family) takes
+        # the same path here — matching the tail, not a substring,
+        # keeps e.g. "acme/embark-audioldm" on the AudioLDM path
         name = str(args.get("model_name", "")).lower()
         tail = name.rsplit("/", 1)[-1]
         from chiaswarm_tpu.pipelines.tts import TTS_FAMILIES
 
-        if tail == "bark" or tail in TTS_FAMILIES:
+        if tail.startswith("bark") or tail in TTS_FAMILIES:
             return tts_callback, args
         return _format_audio_args(args)
 

@@ -12,13 +12,14 @@ openpose runs the NATIVE CMU body-pose network (models/openpose.py,
 converted body_pose_model weights; raises with a fetch hint when the
 weights are absent); scribble/softedge run the NATIVE HED network
 (models/hed.py) when its weights are present, falling back to a
-blurred-Scharr stand-in. Model-free stand-ins for the remaining learned
-detectors (documented per function): mlsd (probabilistic Hough line
-segments), lineart
-(dodge-sketch line extraction), depth (defocus + position-prior
-pseudo-depth ~ MiDaS), normalbae (normals from the pseudo-depth), seg
-(mean-shift posterization onto the ADE20K palette the reference carries
-at input_processor.py:118-272).
+blurred-Scharr stand-in; depth/normalbae run the NATIVE DPT network
+(models/dpt.py — the architecture behind the reference's transformers
+depth pipeline) when its weights are present, falling back to a
+position-prior pseudo-depth. Model-free stand-ins for the remaining
+learned detectors (documented per function): mlsd (probabilistic Hough
+line segments), lineart (dodge-sketch line extraction), seg (mean-shift
+posterization onto the ADE20K palette the reference carries at
+input_processor.py:118-272).
 """
 
 from __future__ import annotations
@@ -149,6 +150,9 @@ def image_to_lineart(image: Image.Image) -> Image.Image:
     return Image.fromarray(np.stack([lines.astype(np.uint8)] * 3, axis=-1))
 
 
+_DPT: list[Any] = []  # resident depth model (lazy; [None] = no weights)
+
+
 def _pseudo_depth(arr: np.ndarray) -> np.ndarray:
     """Model-free MiDaS stand-in: vertical position prior (lower in frame ~
     nearer) blended with local sharpness (in-focus ~ nearer). float [0,1],
@@ -165,9 +169,36 @@ def _pseudo_depth(arr: np.ndarray) -> np.ndarray:
     return cv2.GaussianBlur(depth, (0, 0), sigmaX=3.0)
 
 
+def _depth_map(arr: np.ndarray) -> np.ndarray:
+    """float depth in [0, 1] (1 = near): the native DPT network
+    (models/dpt.py — the same architecture behind the reference's
+    transformers depth pipeline, input_processor.py:87-93) when converted
+    weights exist in the model dir, else the model-free stand-in."""
+    if not _DPT:
+        from chiaswarm_tpu.node.registry import model_dir
+
+        ckpt = model_dir("dpt")
+        if ckpt.exists():
+            from chiaswarm_tpu.models.dpt import DPTDetector
+
+            _DPT.append(DPTDetector.from_checkpoint(ckpt))
+        else:
+            import logging
+
+            logging.getLogger("chiaswarm.preprocess").info(
+                "no DPT weights at %s; depth/normal use the "
+                "position-prior stand-in", ckpt)
+            _DPT.append(None)
+    if _DPT[0] is not None:
+        d = _DPT[0].depth(arr)
+        lo, hi = float(d.min()), float(d.max())
+        return ((d - lo) / max(hi - lo, 1e-6)).astype(np.float32)
+    return _pseudo_depth(arr)
+
+
 @_register("depth")
 def image_to_depth(image: Image.Image) -> Image.Image:
-    depth = _pseudo_depth(np.asarray(image))
+    depth = _depth_map(np.asarray(image))
     u8 = (depth * 255.0).clip(0, 255).astype(np.uint8)
     return Image.fromarray(np.stack([u8] * 3, axis=-1))
 
@@ -179,7 +210,7 @@ def image_to_normal(image: Image.Image) -> Image.Image:
     in the usual RGB = (x, y, z) * 0.5 + 0.5 convention."""
     import cv2
 
-    depth = _pseudo_depth(np.asarray(image))
+    depth = _depth_map(np.asarray(image))
     dx = cv2.Sobel(depth, cv2.CV_32F, 1, 0, ksize=5)
     dy = cv2.Sobel(depth, cv2.CV_32F, 0, 1, ksize=5)
     z = np.full_like(depth, 0.1)

@@ -970,3 +970,75 @@ def convert_dpt(state: Mapping[str, np.ndarray]) -> dict:
         _place(flat, name, "weight", s[f"head.head.{idx}.weight"])
         flat[f"{name}/bias"] = s[f"head.head.{idx}.bias"]
     return _nest(flat)
+
+
+# --------------------------------------------------------------- UperNet
+
+def _bnconv(flat: dict, s: Mapping[str, np.ndarray], torch_base: str,
+            name: str) -> None:
+    _place(flat, f"{name}/conv", "weight", s[f"{torch_base}.conv.weight"])
+    flat[f"{name}/bn_scale"] = s[f"{torch_base}.batch_norm.weight"]
+    flat[f"{name}/bn_bias"] = s[f"{torch_base}.batch_norm.bias"]
+    flat[f"{name}/bn_mean"] = s[f"{torch_base}.batch_norm.running_mean"]
+    flat[f"{name}/bn_var"] = s[f"{torch_base}.batch_norm.running_var"]
+
+
+def convert_upernet(state: Mapping[str, np.ndarray]) -> dict:
+    """HF ``UperNetForSemanticSegmentation`` (ConvNeXt backbone) state
+    dict -> models/upernet.py UperNetSeg tree (auxiliary FCN head keys
+    are ignored — inference uses the decode head only)."""
+    s = state
+    flat: dict[str, np.ndarray] = {}
+    _place(flat, "patch_embed", "weight",
+           s["backbone.embeddings.patch_embeddings.weight"])
+    flat["patch_embed/bias"] = s["backbone.embeddings.patch_embeddings.bias"]
+    flat["embed_norm/scale"] = s["backbone.embeddings.layernorm.weight"]
+    flat["embed_norm/bias"] = s["backbone.embeddings.layernorm.bias"]
+
+    n_stages = 1 + max(int(k.split(".")[3]) for k in s
+                       if k.startswith("backbone.encoder.stages."))
+    for st in range(n_stages):
+        t = f"backbone.encoder.stages.{st}"
+        if f"{t}.downsampling_layer.0.weight" in s:
+            flat[f"down_norm_{st}/scale"] = s[
+                f"{t}.downsampling_layer.0.weight"]
+            flat[f"down_norm_{st}/bias"] = s[
+                f"{t}.downsampling_layer.0.bias"]
+            _place(flat, f"down_conv_{st}", "weight",
+                   s[f"{t}.downsampling_layer.1.weight"])
+            flat[f"down_conv_{st}/bias"] = s[
+                f"{t}.downsampling_layer.1.bias"]
+        n_layers = 1 + max(int(k.split(".")[5]) for k in s
+                           if k.startswith(f"{t}.layers."))
+        for i in range(n_layers):
+            lt = f"{t}.layers.{i}"
+            f = f"stage{st}_layer{i}"
+            # torch depthwise conv weight (C, 1, 7, 7) -> flax grouped
+            # conv kernel (7, 7, 1, C)
+            flat[f"{f}/dwconv/kernel"] = s[f"{lt}.dwconv.weight"
+                                           ].transpose(2, 3, 1, 0)
+            flat[f"{f}/dwconv/bias"] = s[f"{lt}.dwconv.bias"]
+            flat[f"{f}/layernorm/scale"] = s[f"{lt}.layernorm.weight"]
+            flat[f"{f}/layernorm/bias"] = s[f"{lt}.layernorm.bias"]
+            _blip_linear(flat, s, f"{lt}.pwconv1", f"{f}/pwconv1")
+            _blip_linear(flat, s, f"{lt}.pwconv2", f"{f}/pwconv2")
+            if f"{lt}.layer_scale_parameter" in s:
+                flat[f"{f}/layer_scale_parameter"] = s[
+                    f"{lt}.layer_scale_parameter"]
+        flat[f"out_norm_{st}/scale"] = s[
+            f"backbone.hidden_states_norms.stage{st + 1}.weight"]
+        flat[f"out_norm_{st}/bias"] = s[
+            f"backbone.hidden_states_norms.stage{st + 1}.bias"]
+
+    n_psp = 1 + max(int(k.split(".")[2]) for k in s
+                    if k.startswith("decode_head.psp_modules."))
+    for k in range(n_psp):
+        _bnconv(flat, s, f"decode_head.psp_modules.{k}.1", f"psp_{k}")
+    _bnconv(flat, s, "decode_head.bottleneck", "bottleneck")
+    for i in range(n_stages - 1):
+        _bnconv(flat, s, f"decode_head.lateral_convs.{i}", f"lateral_{i}")
+        _bnconv(flat, s, f"decode_head.fpn_convs.{i}", f"fpn_{i}")
+    _bnconv(flat, s, "decode_head.fpn_bottleneck", "fpn_bottleneck")
+    _place(flat, "classifier", "weight", s["decode_head.classifier.weight"])
+    flat["classifier/bias"] = s["decode_head.classifier.bias"]
+    return _nest(flat)

@@ -103,6 +103,8 @@ _ANNOTATORS = {
             "ControlNetHED.pth"),
     "dpt": (("depth", "normal", "normalbae"), "Intel/dpt-large",
             "model.safetensors"),
+    "upernet": (("seg", "segmentation"), "openmmlab/upernet-convnext-small",
+                "model.safetensors"),
 }
 
 

@@ -15,11 +15,12 @@ weights are absent); scribble/softedge run the NATIVE HED network
 blurred-Scharr stand-in; depth/normalbae run the NATIVE DPT network
 (models/dpt.py — the architecture behind the reference's transformers
 depth pipeline) when its weights are present, falling back to a
-position-prior pseudo-depth. Model-free stand-ins for the remaining
-learned detectors (documented per function): mlsd (probabilistic Hough
-line segments), lineart (dodge-sketch line extraction), seg (mean-shift
-posterization onto the ADE20K palette the reference carries at
-input_processor.py:118-272).
+position-prior pseudo-depth; seg runs the NATIVE UperNet-ConvNeXt
+segmenter (models/upernet.py — the exact model the reference calls
+through transformers) when its weights are present, falling back to
+mean-shift posterization onto the same full ADE20K palette. Model-free
+stand-ins remain only for mlsd (probabilistic Hough line segments) and
+lineart (dodge-sketch line extraction).
 """
 
 from __future__ import annotations
@@ -48,6 +49,26 @@ def image_to_canny(image: Image.Image) -> Image.Image:
     return Image.fromarray(np.stack([edges] * 3, axis=-1))
 
 
+def _lazy_detector(cache: list, local_name: str, loader,
+                   fallback_msg: str):
+    """Shared weight-gated singleton for the learned preprocessors: load
+    the converted checkpoint from the model dir on first use, else cache
+    ``None`` (-> caller falls back to its model-free stand-in)."""
+    if not cache:
+        from chiaswarm_tpu.node.registry import model_dir
+
+        ckpt = model_dir(local_name)
+        if ckpt.exists():
+            cache.append(loader(ckpt))
+        else:
+            import logging
+
+            logging.getLogger("chiaswarm.preprocess").info(
+                "no %s weights at %s; %s", local_name, ckpt, fallback_msg)
+            cache.append(None)
+    return cache[0]
+
+
 _HED: list[Any] = []  # resident detector (lazy; [None] = no weights)
 
 
@@ -60,23 +81,15 @@ def image_to_soft_edges(image: Image.Image) -> Image.Image:
     the model-free blurred-Scharr stand-in (logged once)."""
     import cv2
 
-    if not _HED:
-        from chiaswarm_tpu.node.registry import model_dir
+    def _load(ckpt):
+        from chiaswarm_tpu.models.hed import HEDDetector
 
-        ckpt = model_dir("hed")
-        if ckpt.exists():
-            from chiaswarm_tpu.models.hed import HEDDetector
+        return HEDDetector.from_checkpoint(ckpt)
 
-            _HED.append(HEDDetector.from_checkpoint(ckpt))
-        else:
-            import logging
-
-            logging.getLogger("chiaswarm.preprocess").info(
-                "no HED weights at %s; scribble/softedge use the "
-                "gradient-magnitude stand-in", ckpt)
-            _HED.append(None)
-    if _HED[0] is not None:
-        edge = _HED[0](np.asarray(image.convert("RGB")))
+    det = _lazy_detector(_HED, "hed", _load,
+                         "scribble/softedge use the gradient stand-in")
+    if det is not None:
+        edge = det(np.asarray(image.convert("RGB")))
         return Image.fromarray(np.stack([edge] * 3, axis=-1))
 
     gray = cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2GRAY)
@@ -174,23 +187,15 @@ def _depth_map(arr: np.ndarray) -> np.ndarray:
     (models/dpt.py — the same architecture behind the reference's
     transformers depth pipeline, input_processor.py:87-93) when converted
     weights exist in the model dir, else the model-free stand-in."""
-    if not _DPT:
-        from chiaswarm_tpu.node.registry import model_dir
+    def _load(ckpt):
+        from chiaswarm_tpu.models.dpt import DPTDetector
 
-        ckpt = model_dir("dpt")
-        if ckpt.exists():
-            from chiaswarm_tpu.models.dpt import DPTDetector
+        return DPTDetector.from_checkpoint(ckpt)
 
-            _DPT.append(DPTDetector.from_checkpoint(ckpt))
-        else:
-            import logging
-
-            logging.getLogger("chiaswarm.preprocess").info(
-                "no DPT weights at %s; depth/normal use the "
-                "position-prior stand-in", ckpt)
-            _DPT.append(None)
-    if _DPT[0] is not None:
-        d = _DPT[0].depth(arr)
+    det = _lazy_detector(_DPT, "dpt", _load,
+                         "depth/normal use the position-prior stand-in")
+    if det is not None:
+        d = det.depth(arr)
         lo, hi = float(d.min()), float(d.max())
         return ((d - lo) / max(hi - lo, 1e-6)).astype(np.float32)
     return _pseudo_depth(arr)
@@ -220,25 +225,34 @@ def image_to_normal(image: Image.Image) -> Image.Image:
                            .astype(np.uint8))
 
 
-# ADE20K-style palette (first 32 of the 150 colors the reference embeds at
-# input_processor.py:118-272 — enough distinct classes for a stand-in).
-_ADE_PALETTE = np.asarray([
-    [120, 120, 120], [180, 120, 120], [6, 230, 230], [80, 50, 50],
-    [4, 200, 3], [120, 120, 80], [140, 140, 140], [204, 5, 255],
-    [230, 230, 230], [4, 250, 7], [224, 5, 255], [235, 255, 7],
-    [150, 5, 61], [120, 120, 70], [8, 255, 51], [255, 6, 82],
-    [143, 255, 140], [204, 255, 4], [255, 51, 7], [204, 70, 3],
-    [0, 102, 200], [61, 230, 250], [255, 6, 51], [11, 102, 255],
-    [255, 7, 71], [255, 9, 224], [9, 7, 230], [220, 220, 220],
-    [255, 9, 92], [112, 9, 255], [8, 255, 214], [7, 255, 224],
-], dtype=np.uint8)
+# full ADE20K palette (the 150-class table + background row the reference
+# embeds at input_processor.py:118-272), shared with models/upernet.py
+from chiaswarm_tpu.workloads.ade_palette import (  # noqa: E402
+    ADE20K_PALETTE as _ADE_PALETTE,
+)
+
+
+_SEG: list[Any] = []  # resident segmenter (lazy; [None] = no weights)
 
 
 @_register("seg")
 def image_to_segments(image: Image.Image) -> Image.Image:
-    """Model-free UperNet stand-in: mean-shift posterization, then each
-    region color snapped to the nearest ADE-palette entry."""
+    """ADE-colored segmentation map. With converted UperNet-ConvNeXt
+    weights in the model dir this runs the native model the reference
+    calls through transformers (models/upernet.py,
+    input_processor.py:96-115); without them: mean-shift posterization
+    with each region color snapped to the nearest ADE-palette entry."""
     import cv2
+
+    def _load(ckpt):
+        from chiaswarm_tpu.models.upernet import UperNetDetector
+
+        return UperNetDetector.from_checkpoint(ckpt)
+
+    det = _lazy_detector(_SEG, "upernet", _load,
+                         "seg uses the posterization stand-in")
+    if det is not None:
+        return Image.fromarray(det(np.asarray(image.convert("RGB"))))
 
     arr = cv2.pyrMeanShiftFiltering(
         cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2BGR), 12, 24)

@@ -16,7 +16,6 @@ Layer map (TPU-first, not a port — see SURVEY.md §7):
 - ``pipelines``  — jitted end-to-end generate functions + workload registry
 - ``parallel``   — sharding rules, data/tensor/sequence parallelism,
                    ring attention, multi-host initialization
-- ``train``      — sharded training step (diffusion loss, LoRA)
 - ``node``       — async worker daemon, hive protocol client, job dispatch,
                    artifact envelope, settings
 - ``convert``    — torch/safetensors checkpoint -> Flax param conversion

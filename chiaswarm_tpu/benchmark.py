@@ -1,16 +1,28 @@
-"""Benchmark: SDXL-class 1024px txt2img throughput (images/sec/chip).
+"""Benchmark: the five BASELINE.json configs, measured end to end.
 
-Measures the BASELINE.json north-star config — SDXL 1024x1024 txt2img,
-30 steps, classifier-free guidance — end to end through the jitted
-pipeline (text encode -> scan denoise -> VAE decode) on the default
-backend. Random weights (identical FLOPs/memory traffic to converted
-checkpoints). On non-TPU hosts it falls back to the tiny hermetic family
-so the script stays runnable anywhere.
+Headline is the north-star config — SDXL 1024px txt2img, 30 steps, CFG —
+through the jitted pipeline (text encode -> scan denoise -> VAE decode) on
+the default backend. The other four configs (SD1.5-512/20-DDIM, SD2.1
+img2img + inpaint, ControlNet+SDXL, txt2vid) run the same way. Random
+host-materialized bf16 weights (identical FLOPs/memory traffic to
+converted checkpoints). On non-TPU hosts the script falls back to the tiny
+hermetic family so it stays runnable anywhere.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: the headline metric fields at the top level
+({"metric", "value", "unit", "vs_baseline", ...}, same schema as round 1)
+plus a "configs" object with one entry per BASELINE.json config.
 `vs_baseline` is vs the driver-set target of 4 images/sec/chip
 (BASELINE.json "north_star"; the reference itself publishes no numbers —
 BASELINE.md).
+
+Throughput is measured steady-state: jobs are submitted back-to-back via
+``DiffusionPipeline.submit`` so job N's device->host uint8 transfer
+overlaps job N+1's denoise (serving does the same; the reference's torch
+pipelines block per call).
+
+Env knobs: CHIASWARM_BENCH_CONFIGS (comma list or "all" / "headline"),
+CHIASWARM_BENCH_ITERS, CHIASWARM_BENCH_ATTN, and for the headline
+CHIASWARM_BENCH_FAMILY/SIZE/STEPS/BATCH.
 """
 
 from __future__ import annotations
@@ -20,9 +32,156 @@ import os
 import time
 
 
+def _percentile50(times: list[float]) -> float:
+    return sorted(times)[len(times) // 2]
+
+
+def _bench_diffusion(pipe, *, size: int, steps: int, batch: int, iters: int,
+                     scheduler: str | None = None, init_image=None,
+                     mask=None, controlnet=None, control_image=None,
+                     pipelined: bool = False) -> dict:
+    """Warm once, then measure. ``pipelined=True`` additionally measures
+    steady-state throughput with submit/wait overlap."""
+    import numpy as np
+
+    from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
+
+    def req(seed: int) -> GenerateRequest:
+        return GenerateRequest(
+            prompt="a photograph of an astronaut riding a horse",
+            negative_prompt="blurry", steps=steps, guidance_scale=7.5,
+            height=size, width=size, batch=batch, seed=seed,
+            scheduler=scheduler, init_image=init_image, strength=0.75,
+            mask=mask, controlnet=controlnet, control_image=control_image,
+        )
+
+    imgs, _ = pipe(req(0))  # compile + warm
+    assert imgs.shape[0] == batch
+
+    times = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        pipe(req(i + 1))
+        times.append(time.perf_counter() - t0)
+    p50 = _percentile50(times)
+    out = {
+        "p50_latency_s": round(p50, 3),
+        "images_per_sec": round(batch / p50, 4),
+    }
+
+    if pipelined:
+        # steady-state: keep one job in flight while fetching the last
+        n = max(4, iters)
+        t0 = time.perf_counter()
+        pending = pipe.submit(req(100))[0]
+        for i in range(1, n):
+            nxt = pipe.submit(req(100 + i))[0]
+            pending.wait()
+            pending = nxt
+        pending.wait()
+        total = time.perf_counter() - t0
+        out["images_per_sec_pipelined"] = round(n * batch / total, 4)
+    return out
+
+
+def run_configs(names: list[str], *, on_tpu: bool, iters: int,
+                attn: str) -> dict:
+    import jax
+    import numpy as np
+
+    from chiaswarm_tpu.pipelines.components import Components, ControlNetBundle
+    from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline
+
+    device = jax.devices()[0]
+
+    def components(family: str) -> Components:
+        c = Components.random_host(family, seed=0)
+        c.params = jax.device_put(c.params, device)
+        return c
+
+    rng = np.random.default_rng(0)
+    results: dict[str, dict] = {}
+
+    if "sd15" in names:
+        # BASELINE.json #1: SD 1.5 txt2img, 512x512, 20 DDIM steps
+        pipe = DiffusionPipeline(components("sd15" if on_tpu else "tiny"),
+                                 attn_impl=attn)
+        size = 512 if on_tpu else 64
+        results["sd15_txt2img_512_ddim20"] = _bench_diffusion(
+            pipe, size=size, steps=20 if on_tpu else 2, batch=1,
+            iters=iters, scheduler="ddim")
+        del pipe
+
+    if "sd21" in names:
+        # BASELINE.json #2: SD 2.1 img2img + inpainting
+        c = components("sd21" if on_tpu else "tiny")
+        pipe = DiffusionPipeline(c, attn_impl=attn)
+        size = 512 if on_tpu else 64
+        steps = 30 if on_tpu else 2
+        init = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        results["sd21_img2img_512"] = _bench_diffusion(
+            pipe, size=size, steps=steps, batch=1, iters=iters,
+            init_image=init)
+        half_mask = np.zeros((size, size), np.float32)
+        half_mask[size // 2:] = 1.0
+        results["sd21_inpaint_512"] = _bench_diffusion(
+            pipe, size=size, steps=steps, batch=1, iters=iters,
+            init_image=init, mask=half_mask)
+        del pipe, c
+
+    if "controlnet" in names:
+        # BASELINE.json #4: ControlNet + SDXL
+        fam = "sdxl" if on_tpu else "tiny"
+        c = components(fam)
+        bundle = ControlNetBundle.random_host(fam, seed=1)
+        bundle.params = jax.device_put(bundle.params, device)
+        pipe = DiffusionPipeline(c, attn_impl=attn)
+        size = 1024 if on_tpu else 64
+        cond = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        results["controlnet_sdxl_1024"] = _bench_diffusion(
+            pipe, size=size, steps=30 if on_tpu else 2, batch=1,
+            iters=iters, controlnet=bundle, control_image=cond)
+        del pipe, c, bundle
+
+    if "txt2vid" in names:
+        # BASELINE.json #5: video diffusion (ModelScope-class temporal UNet)
+        from chiaswarm_tpu.pipelines.video import (
+            VideoComponents,
+            VideoPipeline,
+        )
+
+        fam = "modelscope_t2v" if on_tpu else "tiny_vid"
+        vc = VideoComponents.random_host(fam, seed=0)
+        vc.params = jax.device_put(vc.params, device)
+        vpipe = VideoPipeline(vc, attn_impl=attn)
+        frames = 16 if on_tpu else 8
+        steps = 25 if on_tpu else 2
+        size = 256 if on_tpu else 64
+
+        def vrun(seed: int) -> float:
+            t0 = time.perf_counter()
+            out, _ = vpipe("a paper boat drifting", num_frames=frames,
+                           steps=steps, height=size, width=size, seed=seed)
+            assert out.shape[0] == frames
+            return time.perf_counter() - t0
+
+        vrun(0)
+        times = [vrun(i + 1) for i in range(iters)]
+        p50 = _percentile50(times)
+        results["txt2vid_modelscope"] = {
+            "p50_latency_s": round(p50, 3),
+            "frames": frames,
+            "steps": steps,
+            "size": size,
+            "frames_per_sec": round(frames / p50, 4),
+        }
+        del vpipe, vc
+
+    return results
+
+
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
     from chiaswarm_tpu.core.compile_cache import (
         enable_persistent_compilation_cache,
@@ -36,7 +195,7 @@ def main() -> None:
     jax.config.update("jax_default_matmul_precision", "bfloat16")
 
     from chiaswarm_tpu.pipelines.components import Components
-    from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline, GenerateRequest
+    from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline
 
     on_tpu = jax.default_backend() == "tpu"
     family = os.environ.get(
@@ -49,7 +208,9 @@ def main() -> None:
     batch = int(os.environ.get("CHIASWARM_BENCH_BATCH", "1"))
     iters = int(os.environ.get("CHIASWARM_BENCH_ITERS", "3"))
     attn = os.environ.get("CHIASWARM_BENCH_ATTN", "auto")
+    which = os.environ.get("CHIASWARM_BENCH_CONFIGS", "all")
 
+    # ---- headline: the north-star config ----
     if on_tpu:
         # host-side param materialization (no init program, no fp32 copy):
         # on-device fp32 init of SDXL-class weights OOMs a single chip and
@@ -59,22 +220,20 @@ def main() -> None:
     else:
         c = Components.random(family, seed=0)
     pipe = DiffusionPipeline(c, attn_impl=attn)
+    headline = _bench_diffusion(pipe, size=size, steps=steps, batch=batch,
+                                iters=iters, pipelined=True)
+    del pipe, c
 
-    def run(seed: int) -> float:
-        req = GenerateRequest(
-            prompt="a photograph of an astronaut riding a horse",
-            negative_prompt="blurry", steps=steps, guidance_scale=7.5,
-            height=size, width=size, batch=batch, seed=seed,
-        )
-        t0 = time.perf_counter()
-        imgs, _ = pipe(req)
-        assert imgs.shape[0] == batch
-        return time.perf_counter() - t0
+    # steady-state (transfer-overlapped) throughput is the serving number
+    imgs_per_sec = headline.get("images_per_sec_pipelined",
+                                headline["images_per_sec"])
 
-    run(0)  # compile + warm
-    times = [run(i + 1) for i in range(iters)]
-    p50 = sorted(times)[len(times) // 2]
-    imgs_per_sec = batch / p50
+    configs = {"sdxl_txt2img_1024": headline}
+    if which != "headline":
+        names = (["sd15", "sd21", "controlnet", "txt2vid"]
+                 if which == "all" else which.split(","))
+        configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
+                                   attn=attn))
 
     target = 4.0  # images/sec/chip, BASELINE.json north star
     print(json.dumps({
@@ -82,10 +241,11 @@ def main() -> None:
         "value": round(imgs_per_sec, 4),
         "unit": "images/sec/chip",
         "vs_baseline": round(imgs_per_sec / target, 4),
-        "p50_latency_s": round(p50, 3),
+        "p50_latency_s": headline["p50_latency_s"],
         "batch": batch,
         "attn": attn,
         "backend": jax.default_backend(),
+        "configs": configs,
     }))
 
 

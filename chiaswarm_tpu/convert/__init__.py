@@ -14,13 +14,14 @@ from chiaswarm_tpu.convert.torch_to_flax import (
     load_checkpoint,
     read_torch_weights,
 )
-from chiaswarm_tpu.convert.lora import merge_lora
+from chiaswarm_tpu.convert.lora import load_lora, merge_lora
 
 __all__ = [
     "convert_text_encoder",
     "convert_unet",
     "convert_vae",
     "load_checkpoint",
+    "load_lora",
     "read_torch_weights",
     "merge_lora",
 ]

@@ -21,12 +21,42 @@ from __future__ import annotations
 
 import logging
 import re
+from pathlib import Path
 from typing import Any, Mapping
 
 import jax
 import numpy as np
 
 log = logging.getLogger("chiaswarm.lora")
+
+
+def load_lora(path: str | Path) -> dict[str, np.ndarray]:
+    """Read a LoRA adapter file/dir -> flat {torch_key: array} state.
+
+    Shares textual_inversion's adapter-file resolution (safetensors
+    preferred); an unreadable file raises ``ValueError`` — fatal, so the
+    hive must not retry (swarm/generator.py:34-41; the reference's
+    load_attn_procs failure is likewise re-raised as ValueError,
+    diffusion_func.py:58-68)."""
+    from chiaswarm_tpu.convert.textual_inversion import (
+        _read_raw,
+        _to_array,
+        pick_adapter_file,
+    )
+
+    path = pick_adapter_file(path, "LoRA adapter")
+    try:
+        state = _read_raw(path)
+    except Exception as exc:
+        raise ValueError(f"unreadable LoRA adapter {path}: {exc}")
+    out: dict[str, np.ndarray] = {}
+    for key, tensor in state.items():
+        if isinstance(tensor, (str, dict, int, float)):
+            continue
+        out[str(key)] = _to_array(tensor)
+    if not out:
+        raise ValueError(f"LoRA adapter {path} contains no tensors")
+    return out
 
 _PAIR_RES = (
     # diffusers attn-procs format

@@ -46,18 +46,27 @@ def _to_array(t: Any) -> np.ndarray:
     return np.asarray(t.detach().to("cpu").float().numpy(), np.float32)
 
 
+def pick_adapter_file(path: str | Path, what: str) -> Path:
+    """Resolve a file-or-dir adapter path to ONE weights file: safetensors
+    preferred, then .bin/.pt alphabetically. ValueError when empty (fatal
+    — the hive must not retry, swarm/generator.py:34-41). Shared by the
+    textual-inversion and LoRA loaders."""
+    path = Path(path)
+    if not path.is_dir():
+        return path
+    files = (sorted(path.glob("*.safetensors"))
+             or sorted(list(path.glob("*.bin")) + list(path.glob("*.pt"))))
+    if not files:
+        raise ValueError(f"no {what} files under {path}")
+    return files[0]
+
+
 def load_embeddings(path: str | Path) -> dict[str, np.ndarray]:
     """Read a textual-inversion file/dir -> {placeholder_token: (n, D)}.
 
     Malformed files raise ``ValueError`` (fatal — the hive must not retry,
     swarm/generator.py:34-41)."""
-    path = Path(path)
-    if path.is_dir():
-        files = sorted(list(path.glob("*.safetensors"))
-                       + list(path.glob("*.bin")) + list(path.glob("*.pt")))
-        if not files:
-            raise ValueError(f"no embedding files under {path}")
-        path = files[0]
+    path = pick_adapter_file(path, "embedding")
     try:
         state = _read_raw(path)
     except Exception as exc:

@@ -896,6 +896,137 @@ def convert_hed(state: Mapping[str, np.ndarray]) -> dict:
     return _nest(flat)
 
 
+# ------------------------------------------------------------------ MLSD
+
+def convert_mlsd(state: Mapping[str, np.ndarray]) -> dict:
+    """mlsd_pytorch ``MobileV2_MLSD_Large`` state (``mlsd_large_512_fp32``
+    via controlnet_aux MLSDdetector: ``backbone.features.{i}`` MobileNetV2
+    trunk + ``block15..block23`` decoder) -> models/mlsd.py MLSDNetwork
+    tree."""
+    flat: dict[str, np.ndarray] = {}
+
+    def conv(v: np.ndarray) -> np.ndarray:
+        return v.transpose(2, 3, 1, 0)  # OIHW -> HWIO (dw convs included)
+
+    bn_leaf = {"weight": "scale", "bias": "bias",
+               "running_mean": "mean", "running_var": "var"}
+
+    def put_bn(prefix: str, leaf: str, v: np.ndarray) -> None:
+        if leaf in bn_leaf:
+            flat[f"{prefix}/{bn_leaf[leaf]}"] = v
+
+    n_ir = 0
+    for key, value in state.items():
+        parts = key.split(".")
+        leaf = parts[-1]
+        if leaf == "num_batches_tracked":
+            continue
+        if key.startswith("backbone.features."):
+            i = int(parts[2])
+            if i == 0:  # stem ConvBNReLU
+                if parts[3] == "0":
+                    flat["stem/conv/kernel"] = conv(value)
+                else:
+                    put_bn("stem/bn", leaf, value)
+                continue
+            n_ir = max(n_ir, i)
+            sub = parts[4]  # index inside .conv Sequential
+            # t=1 block (features.1) has no expand stage: [dw, bn] at
+            # conv.0, project at conv.1, bn at conv.2; t=6 blocks add the
+            # expand ConvBNReLU at conv.0 and shift everything down
+            expanded = f"backbone.features.{i}.conv.3.weight" in state \
+                or f"backbone.features.{i}.conv.3.running_mean" in state
+            seq = {"0": ("layer_0", True), "1": ("layer_1", True),
+                   "2": ("project", False), "3": ("project_bn", None)} \
+                if expanded else \
+                  {"0": ("layer_0", True), "1": ("project", False),
+                   "2": ("project_bn", None)}
+            name, is_cbr = seq[sub]
+            if is_cbr:  # ConvBNReLU: .0 conv / .1 bn below it
+                if parts[5] == "0":
+                    flat[f"ir_{i}/{name}/conv/kernel"] = conv(value)
+                else:
+                    put_bn(f"ir_{i}/{name}/bn", leaf, value)
+            elif is_cbr is False:  # plain projection conv
+                flat[f"ir_{i}/{name}/kernel"] = conv(value)
+            else:  # projection BN
+                put_bn(f"ir_{i}/{name}", leaf, value)
+        elif parts[0].startswith("block"):
+            block = parts[0]
+            if parts[1] == "conv3":  # BlockTypeC head conv (with bias)
+                flat[f"{block}/conv3/kernel" if leaf == "weight"
+                     else f"{block}/conv3/bias"] = (
+                    conv(value) if leaf == "weight" else value)
+                continue
+            which, idx = parts[1], parts[2]
+            if idx == "0":  # conv
+                if leaf == "weight":
+                    flat[f"{block}/{which}/conv/kernel"] = conv(value)
+                else:
+                    flat[f"{block}/{which}/conv/bias"] = value
+            else:  # bn
+                put_bn(f"{block}/{which}/bn", leaf, value)
+    if n_ir != 13 or "block23/conv3/kernel" not in flat:
+        raise ValueError(
+            f"state has {n_ir} inverted-residual blocks (expected 13)"
+            + ("" if "block23/conv3/kernel" in flat
+               else " and no block23 head")
+            + " — not a MobileV2_MLSD_Large checkpoint")
+    return _nest(flat)
+
+
+# --------------------------------------------------------------- Lineart
+
+def convert_lineart(state: Mapping[str, np.ndarray]) -> dict:
+    """informative-drawings ``Generator`` state (``sk_model.pth`` via
+    controlnet_aux LineartDetector: ``model0.1`` stem conv, ``model1.{0,3}``
+    downsamples, ``model2.{i}.conv_block.{1,5}`` residual convs,
+    ``model3.{0,3}`` transposed convs, ``model4.1`` head) ->
+    models/lineart.py LineartGenerator tree.
+
+    ConvTranspose2d weights (in, out, kh, kw) are stored pre-flipped as
+    (kh, kw, in, out) so runtime is a plain lhs-dilated conv
+    (models/lineart.py TorchConvTranspose)."""
+    flat: dict[str, np.ndarray] = {}
+    n_res = 0
+
+    def conv(value: np.ndarray) -> np.ndarray:
+        return value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+
+    def convt(value: np.ndarray) -> np.ndarray:
+        return value.transpose(2, 3, 0, 1)[::-1, ::-1].copy()  # + flip
+
+    for key, value in state.items():
+        parts = key.split(".")
+        if parts[-1] not in ("weight", "bias"):
+            continue
+        leaf = "kernel" if parts[-1] == "weight" else "bias"
+        w = value.ndim == 4
+        if key.startswith("model0.1."):
+            flat[f"stem/conv/{leaf}"] = conv(value) if w else value
+        elif key.startswith("model1."):
+            idx = {"0": 0, "3": 1}.get(parts[1])
+            if idx is not None:
+                flat[f"down_{idx}/{leaf}"] = conv(value) if w else value
+        elif key.startswith("model2.") and parts[2] == "conv_block":
+            i = int(parts[1])
+            n_res = max(n_res, i + 1)
+            which = {"1": "conv_a", "5": "conv_b"}.get(parts[3])
+            if which is not None:
+                flat[f"res_{i}/{which}/conv/{leaf}"] = (conv(value)
+                                                        if w else value)
+        elif key.startswith("model3."):
+            idx = {"0": 0, "3": 1}.get(parts[1])
+            if idx is not None:
+                flat[f"up_{idx}/{leaf}"] = convt(value) if w else value
+        elif key.startswith("model4.1."):
+            flat[f"head/conv/{leaf}"] = conv(value) if w else value
+    if n_res == 0 or "stem/conv/kernel" not in flat:
+        raise ValueError("state is not an informative-drawings Generator "
+                         "(no model2 residual blocks / model0 stem)")
+    return _nest(flat)
+
+
 # ------------------------------------------------------------------- DPT
 
 def convert_dpt(state: Mapping[str, np.ndarray]) -> dict:

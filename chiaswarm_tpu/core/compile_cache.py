@@ -29,6 +29,34 @@ from typing import Any, Callable, Hashable
 _POW2 = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
+def xla_compiler_options() -> dict[str, str] | None:
+    """Extra per-executable XLA:TPU compiler options from the
+    ``CHIASWARM_XLA_OPTIONS`` env var ("key=value,key2=value2").
+
+    Passed as ``compiler_options`` to the pipelines' TOP-LEVEL ``jax.jit``
+    calls (nested jits reject them). The main production knob is
+    ``xla_tpu_scoped_vmem_limit_kib`` — the default ~16 MiB scoped VMEM
+    caps the flash-attention block sweep and conv fusion buffer sizes
+    (BASELINE.md block-size table)."""
+    import os
+
+    raw = os.environ.get("CHIASWARM_XLA_OPTIONS", "").strip()
+    if not raw:
+        return None
+    return dict(kv.split("=", 1) for kv in raw.split(",") if "=" in kv)
+
+
+def toplevel_jit(fn, **kwargs):
+    """``jax.jit`` for the pipelines' end-to-end programs, with the
+    env-configured compiler options applied."""
+    import jax
+
+    opts = xla_compiler_options()
+    if opts:
+        kwargs.setdefault("compiler_options", opts)
+    return jax.jit(fn, **kwargs)
+
+
 def enable_persistent_compilation_cache(cache_dir: str | None = None) -> None:
     """Point XLA's persistent compilation cache at a durable directory.
 
@@ -85,13 +113,16 @@ def bucket_batch(n: int) -> int:
 
 
 def bucket_image_size(height: int, width: int, *, multiple: int = 64,
-                      min_size: int = 256, max_size: int = 1024) -> tuple[int, int]:
+                      min_size: int = 64, max_size: int = 1024) -> tuple[int, int]:
     """Snap a requested image size onto the compiled lattice.
 
     Mirrors the reference's size clamp (swarm/job_arguments.py:14,96-102 caps
-    at 1024x1024) but additionally quantizes to ``multiple`` so XLA sees a
-    bounded shape set. Images are generated at the bucketed size and
+    at 1024x1024; small sizes are honored — only a MAX clamp exists there)
+    but additionally quantizes to ``multiple`` so XLA sees a bounded shape
+    set. Images are generated at the bucketed size and
     center-cropped/resized on host to the exact request when they differ.
+    ``multiple=64`` keeps SD latents divisible by 8, so any bucket survives
+    the UNet's downsampling path.
     """
 
     def snap(v: int) -> int:

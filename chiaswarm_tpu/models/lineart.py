@@ -1,0 +1,171 @@
+"""Lineart detector — the learned line-drawing preprocessor.
+
+The reference reaches lineart conditioning through controlnet_aux's
+LineartDetector (swarm/controlnet/input_processor.py:17-60 dispatch),
+which wraps the informative-drawings ``Generator``: a ReflectionPad
+conv stem, two stride-2 downsamples, N InstanceNorm residual blocks at
+256 channels, two transposed-conv upsamples, and a 7x7 sigmoid head
+producing a 1-channel drawing (dark strokes on white). Weights convert
+from the public ``sk_model.pth`` / ``sk_model2.pth`` layout
+(convert/torch_to_flax.py::convert_lineart).
+
+TPU-native notes: InstanceNorm (affine-free, eps 1e-5) is a two-reduce
+fusion XLA handles; the torch ``ConvTranspose2d(k=3, s=2, p=1, op=1)``
+is reproduced exactly as an input-dilated conv with asymmetric (1, 2)
+padding and a pre-flipped kernel (the converter bakes the spatial flip
+and the (in,out) swap into the stored param, so runtime is a plain
+``conv_general_dilated``). The CNN runs under jit; resize logic is
+host-side like the other preprocessors (workloads/controlnet.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def instance_norm(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """torch nn.InstanceNorm2d(affine=False) over NHWC."""
+    mean = x.mean(axis=(1, 2), keepdims=True)
+    var = x.var(axis=(1, 2), keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps)
+
+
+def _reflect_pad(x: jnp.ndarray, p: int) -> jnp.ndarray:
+    return jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+
+
+class ReflectConv(nn.Module):
+    """ReflectionPad2d(p) + Conv2d(k, VALID)."""
+
+    features: int
+    kernel: int
+    pad: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = _reflect_pad(x, self.pad)
+        return nn.Conv(self.features, (self.kernel, self.kernel),
+                       padding="VALID", name="conv")(x)
+
+
+class TorchConvTranspose(nn.Module):
+    """torch ConvTranspose2d(k=3, stride=2, padding=1, output_padding=1)
+    as an lhs-dilated conv. The stored kernel is (kh, kw, in, out) with
+    the spatial flip already baked in (converter responsibility; random
+    init is equivalent under any fixed flip)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (3, 3, in_ch, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        y = jax.lax.conv_general_dilated(
+            x, kernel.astype(x.dtype),
+            window_strides=(1, 1),
+            padding=((1, 2), (1, 2)),   # (k-1-p, k-1-p+output_padding)
+            lhs_dilation=(2, 2),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + bias.astype(y.dtype)
+
+
+class ResidualBlock(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = ReflectConv(self.features, 3, 1, name="conv_a")(x)
+        h = nn.relu(instance_norm(h))
+        h = ReflectConv(self.features, 3, 1, name="conv_b")(h)
+        return x + instance_norm(h)
+
+
+class LineartGenerator(nn.Module):
+    """(B, H, W, 3) in [0, 1] -> (B, H, W, 1) drawing in [0, 1]
+    (informative-drawings Generator(3, 1, n_blocks), sigmoid head)."""
+
+    n_blocks: int = 3
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = ReflectConv(64, 7, 3, name="stem")(x)
+        x = nn.relu(instance_norm(x))
+        for i, ch in enumerate((128, 256)):
+            x = nn.Conv(ch, (3, 3), strides=(2, 2), padding=1,
+                        name=f"down_{i}")(x)
+            x = nn.relu(instance_norm(x))
+        for i in range(self.n_blocks):
+            x = ResidualBlock(256, name=f"res_{i}")(x)
+        for i, ch in enumerate((128, 64)):
+            x = TorchConvTranspose(ch, name=f"up_{i}")(x)
+            x = nn.relu(instance_norm(x))
+        x = ReflectConv(1, 7, 3, name="head")(x)
+        return jax.nn.sigmoid(x)
+
+
+@dataclasses.dataclass
+class LineartDetector:
+    """Host-facing wrapper: uint8 RGB -> uint8 line map (white lines on
+    black, the conditioning format the reference emits after its own
+    255-minus inversion of the generator's dark-on-white drawing)."""
+
+    params: dict
+    n_blocks: int = 3
+    # fixed working canvas: ONE compiled shape for every request (same
+    # rationale as models/hed.py HEDDetector.canvas)
+    canvas: int = 512
+
+    def __post_init__(self) -> None:
+        self._net = LineartGenerator(self.n_blocks)
+        self._fwd = jax.jit(lambda p, x: self._net.apply(p, x))
+
+    @classmethod
+    def random(cls, seed: int = 0, n_blocks: int = 3,
+               canvas: int = 512) -> "LineartDetector":
+        net = LineartGenerator(n_blocks)
+        x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        return cls(params=jax.jit(net.init)(jax.random.PRNGKey(seed), x),
+                   n_blocks=n_blocks, canvas=canvas)
+
+    @classmethod
+    def from_checkpoint(cls, path) -> "LineartDetector":
+        from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_lineart,
+            read_torch_weights,
+        )
+
+        state = read_torch_weights(path)
+        return cls(params=convert_lineart(state),
+                   n_blocks=sum(1 for k in state
+                                if k.endswith("conv_block.1.weight")))
+
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        import cv2
+
+        h, w = image.shape[:2]
+        scale = self.canvas / max(h, w, 1)
+        nh = max(16, min(self.canvas, round(h * scale)))
+        nw = max(16, min(self.canvas, round(w * scale)))
+        resized = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_AREA)
+        padded = cv2.copyMakeBorder(resized, 0, self.canvas - nh, 0,
+                                    self.canvas - nw, cv2.BORDER_REPLICATE)
+        x = jnp.asarray(padded.astype(np.float32) / 255.0)[None]
+        drawing = np.asarray(jax.device_get(
+            self._fwd(self.params, x)))[0, :, :, 0]
+        drawing = cv2.resize(drawing[:nh, :nw], (w, h),
+                             interpolation=cv2.INTER_LINEAR)
+        # generator draws dark strokes on white; conditioning wants
+        # white-on-black (controlnet_aux inverts the same way)
+        lines = 255 - (drawing * 255.0).clip(0, 255).astype(np.uint8)
+        return lines

@@ -166,22 +166,26 @@ class HashTokenizer(AddedTokenMixin):
         self.eos_id = eos_id if eos_id is not None else vocab_size - 1
         self.bos_id = self.eos_id - 1
 
-    def encode(self, text: str) -> list[int]:
+    def tokenize(self, text: str) -> list[int]:
+        """Raw hashed ids — no bos/eos/pad (the bark semantic stage needs
+        specials-free text ids, pipelines/tts.py)."""
         vspan = max(self.vocab_size - 2, 1)
-        ids = [self.bos_id]
+        ids: list[int] = []
         for part in self._split_added(text):
-            if isinstance(part, list):  # textual-inversion placeholder run
+            if isinstance(part, list):
                 ids.extend(part)
                 continue
             for tok in _basic_tokens(part):
-                if len(ids) >= self.max_length - 1:
-                    break
                 # FNV-1a: platform-stable hashing (hash() is salted)
                 h = 2166136261
                 for ch in tok.encode("utf-8"):
                     h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
                 ids.append(h % vspan)
-        ids = ids[: self.max_length - 1]
+        return ids
+
+    def encode(self, text: str) -> list[int]:
+        """bos + tokenize() body (truncated) + eos, padded with eos."""
+        ids = [self.bos_id] + self.tokenize(text)[: self.max_length - 2]
         ids.append(self.eos_id)
         ids += [self.eos_id] * (self.max_length - len(ids))
         return ids[: self.max_length]

@@ -173,7 +173,9 @@ def warm_compile(models: list[dict[str, Any]]) -> None:
             # bark outranks the txt2audio workflow tag: the hive serves
             # bark UNDER txt2audio (job_args.py routing), so the name
             # gate must win or bark would warm as AudioLDM and fail
-            if "bark" in name.lower():
+            from chiaswarm_tpu.pipelines.tts import is_tts_model
+
+            if is_tts_model(name):
                 registry.tts_pipeline(name)("warmup", duration_s=0.5)
             elif name.startswith("DeepFloyd/"):
                 registry.cascade_pipeline(name, mesh=mesh)(

@@ -46,16 +46,9 @@ def format_args(job: dict[str, Any], registry: ModelRegistry) -> FormatResult:
             tts_callback, txt2audio_callback,
         )
 
-        # "suno/bark" is the reference's exact TTS gate
-        # (swarm/job_arguments.py:22-23); any bark-family TAIL (incl.
-        # variants like "bark-small" and the tiny hermetic family) takes
-        # the same path here — matching the tail, not a substring,
-        # keeps e.g. "acme/embark-audioldm" on the AudioLDM path
-        name = str(args.get("model_name", "")).lower()
-        tail = name.rsplit("/", 1)[-1]
-        from chiaswarm_tpu.pipelines.tts import TTS_FAMILIES
+        from chiaswarm_tpu.pipelines.tts import is_tts_model
 
-        if tail.startswith("bark") or tail in TTS_FAMILIES:
+        if is_tts_model(str(args.get("model_name", ""))):
             return tts_callback, args
         return _format_audio_args(args)
 
@@ -107,7 +100,8 @@ def _format_audio_args(args: dict[str, Any]) -> FormatResult:
     from chiaswarm_tpu.workloads.audio import txt2audio_callback
 
     parameters = _pop_parameters(args)
-    args.setdefault("num_inference_steps", 25)
+    # AudioLDM default is 20 steps (swarm/audio/audioldm.py:15-16)
+    args.setdefault("num_inference_steps", 20)
     args["scheduler_type"] = parameters.pop("scheduler_type", None)
     _strip_unsupported(args, parameters)
     return txt2audio_callback, args

@@ -110,6 +110,8 @@ class ModelRegistry:
 
     def pipeline(self, model_name: str,
                  textual_inversion: str | None = None,
+                 lora: str | None = None,
+                 lora_scale: float = 1.0,
                  mesh=None):
         """Resident pipeline (components + params + compiled executables),
         one LRU entry under the HBM byte budget: evicting the entry drops
@@ -118,6 +120,12 @@ class ModelRegistry:
         "upscaler" -> LatentUpscalePipeline). A textual inversion keys a
         SEPARATE entry: the concept rows merge into that entry's private
         embedding table (convert/textual_inversion.py), never the base's.
+        A LoRA adapter likewise keys its own entry under
+        ``(lora, lora_scale)``: the low-rank deltas merge into that
+        entry's private UNet kernels once at load time
+        (convert/lora.py; the runtime side-path + scale kwarg of
+        swarm/diffusion/diffusion_func.py:58-68, done ahead of time so the
+        jitted program and flash attention are unchanged).
 
         ``mesh`` (a MeshSlot's mesh) places the params: >1 chip shards
         them — Megatron-style tensor parallel on the ``model`` axis, data
@@ -145,8 +153,24 @@ class ModelRegistry:
                         f"available on this node (no file at {ti_dir})"
                     )
                 apply_textual_inversion(components, load_embeddings(ti_dir))
-            # place AFTER the embedding-table merge so the enlarged tree
-            # gets uniform placement
+            if lora is not None:
+                from chiaswarm_tpu.convert.lora import load_lora, merge_lora
+
+                lora_dir = model_dir(lora)
+                if not lora_dir.exists():
+                    raise ValueError(
+                        f"LoRA {lora!r} is not available on this node "
+                        f"(no file at {lora_dir})"
+                    )
+                n_levels = len(components.family.unet.block_out_channels)
+                components.params["unet"], n_merged = merge_lora(
+                    components.params["unet"], load_lora(lora_dir),
+                    scale=float(lora_scale), n_levels=n_levels)
+                log.info("merged LoRA %s into %s (%d projections, "
+                         "scale %.3g)", lora, model_name, n_merged,
+                         lora_scale)
+            # place AFTER the embedding-table/LoRA merges so the final
+            # tree gets uniform placement
             components.params = _place_params(components.params, mesh,
                                               model_name)
             if components.family.kind == "upscaler":
@@ -158,8 +182,10 @@ class ModelRegistry:
                                              attn_impl=self.attn_impl)
             return DiffusionPipeline(components, attn_impl=self.attn_impl)
 
+        lora_key = (lora, float(lora_scale)) if lora is not None else None
         return GLOBAL_CACHE.cached_params(
-            ("pipeline", model_name, textual_inversion, mesh_key), build,
+            ("pipeline", model_name, textual_inversion, lora_key, mesh_key),
+            build,
             size_of=lambda pipe: pipe.c.param_bytes(),
         )
 
@@ -266,20 +292,30 @@ class ModelRegistry:
         def build():
             family = get_video_family(model_name)
             ckpt = model_dir(model_name)
+            components = None
             if ckpt.exists():
-                log.info("loading video model %s from %s (2D inflation)",
-                         model_name, ckpt)
-                components = VideoComponents.from_checkpoint(
-                    ckpt, model_name, family)
-            elif self.allow_random:
+                try:
+                    log.info("loading video model %s from %s (2D inflation)",
+                             model_name, ckpt)
+                    components = VideoComponents.from_checkpoint(
+                        ckpt, model_name, family)
+                except Exception as exc:
+                    # truncated/partial download: fall through to the
+                    # configured fallback instead of poisoning every job
+                    # (same policy as tts_pipeline)
+                    log.warning("video checkpoint at %s unusable (%s: %s)",
+                                ckpt, type(exc).__name__, exc)
+            if components is None and self.allow_random:
                 log.warning("video model %s: using random weights",
                             model_name)
                 components = VideoComponents.random(family,
                                                     model_name=model_name)
-            else:
+            if components is None:
+                why = (f"checkpoint at {ckpt} is unusable"
+                       if ckpt.exists() else f"no checkpoint at {ckpt}")
                 raise ValueError(
                     f"video model {model_name!r} is not available on this "
-                    f"node (no checkpoint at {ckpt})"
+                    f"node ({why})"
                 )
             components.params = _place_params(components.params, mesh,
                                               model_name)
@@ -342,19 +378,29 @@ class ModelRegistry:
 
         def build():
             ckpt = model_dir(model_name)
+            components = None
             if ckpt.exists():
-                log.info("loading caption model %s from %s", model_name, ckpt)
-                components = CaptionComponents.from_checkpoint(
-                    ckpt, model_name)
-            elif self.allow_random:
+                try:
+                    log.info("loading caption model %s from %s", model_name,
+                             ckpt)
+                    components = CaptionComponents.from_checkpoint(
+                        ckpt, model_name)
+                except Exception as exc:
+                    # same fallback policy as tts_pipeline: an unusable
+                    # checkpoint dir must not poison every caption job
+                    log.warning("caption checkpoint at %s unusable (%s: %s)",
+                                ckpt, type(exc).__name__, exc)
+            if components is None and self.allow_random:
                 log.warning("no checkpoint for caption model %s; using "
                             "random tiny weights", model_name)
                 components = CaptionComponents.random(
                     "blip_tiny", model_name=model_name)
-            else:
+            if components is None:
+                why = (f"checkpoint at {ckpt} is unusable"
+                       if ckpt.exists() else f"no checkpoint at {ckpt}")
                 raise ValueError(
                     f"caption model {model_name!r} is not available on "
-                    f"this node (no checkpoint at {ckpt})"
+                    f"this node ({why})"
                 )
             # a ~450M-param captioner gains nothing from weight sharding:
             # pin to the slot's lead chip so per-slot jobs do not all

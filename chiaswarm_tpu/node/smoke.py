@@ -79,6 +79,25 @@ SMOKE_JOBS: dict[str, dict[str, Any]] = {
         "upscale": False,
         "content_type": "image/png",
     },
+    "vid2vid": {
+        # the reference's vid2vid smoke job (swarm/test.py:24-33), with
+        # frames injected instead of a video_uri (no network in smoke)
+        "id": "smoke-vid2vid",
+        "workflow": "vid2vid",
+        "model_name": "tiny",
+        "prompt": "make it watercolor",
+        "num_inference_steps": 2,
+        "strength": 0.5,
+        "content_type": "video/mp4",
+        "_inject_frames": True,
+    },
+    "stitch": {
+        "id": "smoke-stitch",
+        "workflow": "stitch",
+        "model_name": "stitch",
+        "content_type": "image/png",
+        "_inject_stitch_images": True,
+    },
 }
 
 
@@ -93,6 +112,16 @@ def run_smoke(workflow: str, random_weights: bool = True) -> dict[str, Any]:
     if job.pop("_inject_image", False):
         rng = np.random.default_rng(0)
         job["image"] = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    if job.pop("_inject_frames", False):
+        job["frames"] = [np.full((64, 64, 3), 30 * i, np.uint8)
+                         for i in range(3)]
+        job["fps"] = 8.0
+    if job.pop("_inject_stitch_images", False):
+        from PIL import Image
+
+        job["jobs"] = [{"resultUri": f"smoke://{i}"} for i in range(3)]
+        job["images"] = [Image.new("RGB", (64, 64), (40 * i, 20, 20))
+                         for i in range(3)]
 
     registry = ModelRegistry(
         catalog=[{"name": "tiny", "family": "tiny"}],

@@ -34,6 +34,7 @@ hermetic test suite validates it against the einsum reference
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +49,14 @@ except Exception:  # pragma: no cover
     _HAS_PLTPU = False
 
 _NEG_INF = -1e30  # finite stand-in: true -inf breaks exp() on fully-masked rows
+
+# block-sweep knobs (read once at import): defaults are the tuned v5e
+# values; CHIASWARM_FLASH_VMEM_MB raises the kernel-scoped VMEM budget so
+# blocks past the default ~16 MB scoped limit (2048x2048, 4096x1024)
+# become compilable for sweeps on other TPU generations
+_DEFAULT_BLOCK_Q = int(os.environ.get("CHIASWARM_FLASH_BLOCK_Q", "2048"))
+_DEFAULT_BLOCK_KV = int(os.environ.get("CHIASWARM_FLASH_BLOCK_KV", "1024"))
+_VMEM_MB = int(os.environ.get("CHIASWARM_FLASH_VMEM_MB", "0"))  # 0 = default
 _LANES = 128
 
 
@@ -117,8 +126,8 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     scale: float | None = None,
-    block_q: int = 2048,
-    block_kv: int = 1024,
+    block_q: int = _DEFAULT_BLOCK_Q,
+    block_kv: int = _DEFAULT_BLOCK_KV,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Blockwise attention over (B, L, H, D) q and (B, S, H, D) k/v."""
@@ -159,8 +168,10 @@ def flash_attention(
     ]
     params = {}
     if _HAS_PLTPU and not interpret:
+        extra = {"vmem_limit_bytes": _VMEM_MB << 20} if _VMEM_MB else {}
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            **extra,
         )
 
     of = pl.pallas_call(

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from chiaswarm_tpu.core.compile_cache import (
+    toplevel_jit,
     GLOBAL_CACHE,
     bucket_batch,
     static_cache_key,
@@ -243,7 +244,7 @@ class AudioPipeline:
             mel = vae.apply(params["vae"], x, method=AutoencoderKL.decode)
             return voc.apply(params["vocoder"], mel[..., 0])
 
-        return jax.jit(fn)
+        return toplevel_jit(fn)
 
     def _get_fn(self, **static):
         return GLOBAL_CACHE.cached_executable(

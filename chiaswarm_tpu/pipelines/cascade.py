@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from chiaswarm_tpu.core.compile_cache import (
+    toplevel_jit,
     GLOBAL_CACHE,
     bucket_batch,
     static_cache_key,
@@ -256,7 +257,7 @@ class CascadePipeline:
             return (jnp.clip((y + 1.0) * 127.5 + 0.5, 0.0, 255.0)
                     ).astype(jnp.uint8)
 
-        return jax.jit(fn)
+        return toplevel_jit(fn)
 
     def _get_fn(self, **static):
         return GLOBAL_CACHE.cached_executable(
@@ -267,7 +268,13 @@ class CascadePipeline:
                  steps: int = 50, sr_steps: int = 30,
                  guidance_scale: float = 7.0, batch: int = 1,
                  seed: int = 0, scheduler: str | None = None,
+                 upscaler=None, final_size: int | None = None,
                  ) -> tuple[np.ndarray, dict]:
+        """Full IF protocol. Stages 1+2 (base -> sr_size) always run; when
+        ``upscaler`` (a LatentUpscalePipeline) is provided the cascade runs
+        its third stage — repeated x2 latent-upscale denoise passes until
+        ``final_size`` (default 4 * sr_size, the reference's x4-upscaler
+        output: 256 -> 1024, diffusion_func_if.py:31-40,63-65)."""
         requested = max(1, batch)
         batch = bucket_batch(requested)
         sampler = resolve(scheduler, prediction_type="epsilon")
@@ -283,6 +290,7 @@ class CascadePipeline:
                  jnp.float32(guidance_scale))
         img_u8 = np.asarray(jax.device_get(img))  # uint8 off-chip
         img_u8 = img_u8[:requested]  # trim the pow2 compile bucket padding
+        stages = 2
         config = {
             "model_name": self.c.model_name,
             "family": self.c.family.name,
@@ -293,4 +301,27 @@ class CascadePipeline:
             "size": [self.c.family.sr_size, self.c.family.sr_size],
             "scheduler": sampler.kind,
         }
+        if upscaler is not None:
+            # ---- stage 3: latent-upscale denoise passes to final_size.
+            # The reference's stage 3 re-conditions on the raw prompt
+            # STRING (diffusion_func_if.py:63-65 — the shared T5 embeds
+            # stop at stage 2; the x4-upscaler is CLIP-conditioned), so
+            # passing ``prompt`` down is the faithful contract here too.
+            target = int(final_size or self.c.family.sr_size * 4)
+            passes = 0
+            prev_size = 0
+            # the upscaler buckets its input at 1024 max, so output caps at
+            # 2048: stop when a pass makes no progress (else a hive job
+            # with an oversized final_size would spin this loop forever)
+            while img_u8.shape[1] < target and img_u8.shape[1] > prev_size:
+                prev_size = img_u8.shape[1]
+                img_u8, up_config = upscaler(img_u8, prompt=prompt or "",
+                                             seed=seed)
+                passes += 1
+                config.update(up_config)
+            if passes:
+                stages += 1
+                config["stage3_passes"] = passes
+            config["size"] = list(img_u8.shape[1:3])
+        config["stages"] = stages
         return img_u8, config

@@ -29,6 +29,26 @@ from chiaswarm_tpu.models.unet import UNet
 from chiaswarm_tpu.models.vae import AutoencoderKL
 
 
+def materialize_host(shape_tree, rng, dtype: str = "bfloat16"):
+    """Materialize an ``eval_shape`` param tree with host-numpy values —
+    no XLA init program (on-device fp32 init of billion-param families
+    exhausts single-chip HBM and compiles for minutes). Big kernels are
+    zeros: sampling billions of host normals dominates runtime, and value
+    content does not change TPU op timing (no denormal penalties)."""
+    import numpy as np
+
+    out_dtype = jnp.dtype(dtype)
+
+    def leaf(s):
+        dt = out_dtype if s.dtype == jnp.float32 else s.dtype
+        if int(np.prod(s.shape)) > 1_000_000:
+            return jnp.zeros(s.shape, dt)
+        return jnp.asarray(
+            rng.standard_normal(s.shape).astype(np.float32) * 0.02, dt)
+
+    return jax.tree.map(leaf, shape_tree)
+
+
 @dataclasses.dataclass
 class Components:
     family: ModelFamily
@@ -120,20 +140,9 @@ class Components:
         vae = AutoencoderKL(family.vae)
 
         rng = np.random.default_rng(seed)
-        out_dtype = jnp.dtype(dtype)
-
-        def leaf(s):
-            dt = out_dtype if s.dtype == jnp.float32 else s.dtype
-            if int(np.prod(s.shape)) > 1_000_000:
-                # zeros for the big kernels: sampling billions of host
-                # normals dominates runtime, and value content does not
-                # change TPU op timing (no denormal penalties)
-                return jnp.zeros(s.shape, dt)
-            return jnp.asarray(
-                rng.standard_normal(s.shape).astype(np.float32) * 0.02, dt)
 
         def materialize(shape_tree):
-            return jax.tree.map(leaf, shape_tree)
+            return materialize_host(shape_tree, rng, dtype)
 
         key = jax.random.PRNGKey(0)
         ids = jnp.zeros((1, family.text_encoders[0].max_position_embeddings),
@@ -251,6 +260,52 @@ class ControlNetBundle:
         params["net"] = jax.jit(net.init)(
             k2, latent, jnp.zeros((1,)), ctx, cond_emb, added
         )
+        return cls(family=family,
+                   model_name=model_name or f"random/controlnet-{family.name}",
+                   params=params)
+
+    @classmethod
+    def random_host(cls, family: ModelFamily | str, seed: int = 0,
+                    model_name: str | None = None,
+                    dtype: str = "bfloat16") -> "ControlNetBundle":
+        """Host-materialized random bundle (see ``materialize_host``) —
+        benchmarks attach SDXL-class control branches without an on-device
+        init program."""
+        import numpy as np
+
+        from chiaswarm_tpu.models.controlnet import (
+            ControlCondEmbedding,
+            ControlNet,
+        )
+
+        if isinstance(family, str):
+            family = FAMILIES[family]
+        cfg = family.unet
+        net = ControlNet(cfg)
+        embed = ControlCondEmbedding(cfg.block_out_channels[0],
+                                     downscale=family.vae.downscale)
+        f = family.vae.downscale
+        lh = lw = 8
+        latent = jnp.zeros((1, lh, lw, cfg.sample_channels), jnp.float32)
+        cond = jnp.zeros((1, lh * f, lw * f, 3), jnp.float32)
+        ctx = jnp.zeros((1, 77, cfg.cross_attention_dim), jnp.float32)
+        added = None
+        if cfg.addition_embed_dim is not None:
+            added = {
+                "time_ids": jnp.zeros((1, 6), jnp.float32),
+                "text_embeds": jnp.zeros(
+                    (1, cfg.addition_pooled_dim), jnp.float32),
+            }
+        rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(0)
+        params = {"embed": materialize_host(
+            jax.eval_shape(embed.init, key, cond), rng, dtype)}
+        cond_emb_shape = jax.eval_shape(
+            lambda p, c: embed.apply(p, c), params["embed"], cond)
+        cond_emb = jnp.zeros(cond_emb_shape.shape, cond_emb_shape.dtype)
+        params["net"] = materialize_host(
+            jax.eval_shape(net.init, key, latent, jnp.zeros((1,)), ctx,
+                           cond_emb, added), rng, dtype)
         return cls(family=family,
                    model_name=model_name or f"random/controlnet-{family.name}",
                    params=params)

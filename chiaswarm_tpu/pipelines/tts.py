@@ -137,6 +137,19 @@ def get_tts_family(model_name: str) -> TTSFamily:
     return TTS_FAMILIES["bark"]
 
 
+def is_tts_model(model_name: str) -> bool:
+    """The ONE bark/TTS routing gate, shared by the job dispatcher
+    (node/job_args.py) and warm-compile (node/initialize.py).
+
+    "suno/bark" is the reference's exact TTS gate
+    (swarm/job_arguments.py:22-23); any bark-family TAIL (incl. variants
+    like "bark-small" and the tiny hermetic family) takes the TTS path —
+    matching the tail, not a substring, keeps e.g. "acme/embark-audioldm"
+    on the AudioLDM path."""
+    tail = (model_name or "").lower().rsplit("/", 1)[-1]
+    return tail.startswith("bark") or tail in TTS_FAMILIES
+
+
 # ------------------------------------------------------------ components
 
 @dataclasses.dataclass
@@ -288,6 +301,24 @@ def _stage_decode(gpt: GPT, params, prompt_ids, embeds, actual_len, key,
     return jnp.concatenate([first[:, None], toks.swapaxes(0, 1)], axis=1)
 
 
+def encode_semantic_text(tokenizer, text: str, fam, vocab_size: int,
+                         ) -> np.ndarray:
+    """Text ids for the semantic stage, bark protocol.
+
+    Bark tokenizes with ``add_special_tokens=False`` and fills the fixed
+    window with ``text_pad_token`` (HF modeling_bark.py:635 masked_fill):
+    use the tokenizer's RAW ids (``tokenize()``), not ``encode()`` —
+    encode() adds [CLS]/[SEP] and pads with [PAD]=0, which after
+    ``text_encoding_offset`` becomes an untrained in-vocab token occupying
+    most of the fully-attended prefill for short prompts."""
+    L = fam.max_input_semantic_length
+    ids = tokenizer.tokenize(text)[:L]
+    ids = np.asarray(ids, np.int64) + fam.text_encoding_offset
+    text_ids = np.full((1, L), fam.text_pad_token, np.int32)
+    text_ids[0, : len(ids)] = np.minimum(ids, vocab_size - 1)
+    return text_ids
+
+
 class TTSPipeline:
     """Resident bark-protocol TTS executor."""
 
@@ -305,10 +336,8 @@ class TTSPipeline:
         fam = self.c.family
         cfg = fam.semantic
         L = fam.max_input_semantic_length
-        ids = self.c.tokenizer.encode(text)[:L]
-        ids = np.asarray(ids, np.int64) + fam.text_encoding_offset
-        text_ids = np.full((1, L), fam.text_pad_token, np.int32)
-        text_ids[0, : len(ids)] = np.minimum(ids, cfg.vocab_size - 1)
+        text_ids = encode_semantic_text(self.c.tokenizer, text, fam,
+                                        cfg.vocab_size)
 
         hist = np.full((1, L), fam.semantic_vocab, np.int32)  # semantic pad
         if history is not None:

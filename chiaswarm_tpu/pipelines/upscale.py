@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from chiaswarm_tpu.core.compile_cache import (
+    toplevel_jit,
     GLOBAL_CACHE,
     bucket_batch,
     bucket_image_size,
@@ -108,7 +109,7 @@ class LatentUpscalePipeline:
             return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
                     ).astype(jnp.uint8)
 
-        return jax.jit(fn)
+        return toplevel_jit(fn)
 
     def _get_fn(self, **static):
         return GLOBAL_CACHE.cached_executable(
@@ -127,8 +128,7 @@ class LatentUpscalePipeline:
         if images.ndim == 3:
             images = images[None]
         in_h, in_w = images.shape[1:3]
-        height, width = bucket_image_size(
-            in_h, in_w, min_size=min(256, fam.default_size))
+        height, width = bucket_image_size(in_h, in_w)
         batch = bucket_batch(images.shape[0])
         sampler = resolve(scheduler, prediction_type=fam.prediction_type)
 

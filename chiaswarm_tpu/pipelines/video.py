@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from chiaswarm_tpu.core.compile_cache import (
+    toplevel_jit,
     GLOBAL_CACHE,
     bucket_image_size,
     static_cache_key,
@@ -135,6 +136,49 @@ class VideoComponents:
                 jnp.zeros((1,)), ctx),
             "vae": jax.jit(vae.init)(
                 k3, jnp.zeros((1, 16, 16, family.vae.in_channels))),
+        }
+        return cls(family=family,
+                   model_name=model_name or f"random/{family.name}",
+                   tokenizer=tokenizer, text_encoder=te, unet=unet, vae=vae,
+                   params=params)
+
+    @classmethod
+    def random_host(cls, family: VideoFamily | str, seed: int = 0,
+                    model_name: str | None = None,
+                    dtype: str = "bfloat16") -> "VideoComponents":
+        """Host-materialized random components (components.py
+        ``materialize_host``): benches load ModelScope-class weights
+        without an on-device init program."""
+        import numpy as np
+
+        from chiaswarm_tpu.pipelines.components import materialize_host
+
+        if isinstance(family, str):
+            family = VIDEO_FAMILIES[family]
+        te = ClipTextEncoder(family.text_encoder)
+        unet = VideoUNet(family.unet, max_frames=family.max_frames)
+        vae = AutoencoderKL(family.vae)
+        tokenizer = HashTokenizer(family.text_encoder.vocab_size,
+                                  family.text_encoder.max_position_embeddings,
+                                  family.text_encoder.eos_token_id)
+        ids = jnp.zeros((1, family.text_encoder.max_position_embeddings),
+                        jnp.int32)
+        ctx = jnp.zeros((1, ids.shape[1], family.unet.cross_attention_dim))
+        rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(0)
+        params = {
+            "text_encoder": materialize_host(
+                jax.eval_shape(te.init, key, ids), rng, dtype),
+            "unet": materialize_host(
+                jax.eval_shape(
+                    unet.init, key,
+                    jnp.zeros((1, 2, 8, 8, family.unet.sample_channels)),
+                    jnp.zeros((1,)), ctx), rng, dtype),
+            "vae": materialize_host(
+                jax.eval_shape(
+                    vae.init, key,
+                    jnp.zeros((1, 16, 16, family.vae.in_channels))),
+                rng, dtype),
         }
         return cls(family=family,
                    model_name=model_name or f"random/{family.name}",
@@ -312,7 +356,7 @@ class VideoPipeline:
             return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
                     ).astype(jnp.uint8)   # (F, H, W, 3) uint8
 
-        return jax.jit(fn)
+        return toplevel_jit(fn)
 
     def _get_fn(self, **static):
         return GLOBAL_CACHE.cached_executable(
@@ -328,8 +372,7 @@ class VideoPipeline:
         fam = self.c.family
         req_height = int(height or fam.default_size)
         req_width = int(width or fam.default_size)
-        height, width = bucket_image_size(
-            req_height, req_width, min_size=min(256, fam.default_size))
+        height, width = bucket_image_size(req_height, req_width)
         requested = max(1, min(int(num_frames), fam.max_frames))
         frames = min((requested + 7) // 8 * 8, fam.max_frames)
         sampler = resolve(scheduler, prediction_type="epsilon")

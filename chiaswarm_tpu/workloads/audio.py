@@ -3,13 +3,16 @@
 Reference capabilities: swarm/audio/audioldm.py:12-36 (AudioLDM pipeline,
 default 20 steps / 10 s of 16 kHz audio) and swarm/audio/bark.py:11-38
 (suno-bark TTS). txt2audio runs the jitted mel-latent diffusion + HiFiGAN
-pipeline (pipelines/audio.py); output is WAV via the stdlib ``wave``
-module (this image has no ffmpeg, so the reference's wav -> mp3 transcode,
-audioldm.py:23-33, is gated off — content negotiation reports audio/wav).
+pipeline (pipelines/audio.py); artifacts are MP3 (``audio/mpeg``) when an
+ffmpeg binary is on PATH — the reference's pydub transcode
+(audioldm.py:23-33) shells out to ffmpeg the same way, and the Dockerfile
+ships it — with an honest WAV (``audio/wav``) fallback via the stdlib
+``wave`` module on hosts without ffmpeg.
 """
 
 from __future__ import annotations
 
+import functools
 import io
 import wave
 from typing import Any
@@ -20,8 +23,7 @@ from chiaswarm_tpu.node.output_processor import make_result
 
 
 def pcm16_wav(samples: np.ndarray, sample_rate: int = 16000) -> bytes:
-    """float [-1,1] mono -> WAV bytes (the host-side encode path for when
-    the audio model family lands; unit-tested now)."""
+    """float [-1,1] mono -> WAV bytes (the ffmpeg-less fallback encode)."""
     pcm = (np.clip(samples, -1.0, 1.0) * 32767.0).astype("<i2")
     buf = io.BytesIO()
     with wave.open(buf, "wb") as wav:
@@ -32,7 +34,40 @@ def pcm16_wav(samples: np.ndarray, sample_rate: int = 16000) -> bytes:
     return buf.getvalue()
 
 
+@functools.lru_cache(maxsize=1)
+def _ffmpeg_path() -> str | None:
+    import shutil
+
+    return shutil.which("ffmpeg")
+
+
+def mp3_bytes(samples: np.ndarray, sample_rate: int = 16000,
+              bitrate: str = "128k") -> bytes | None:
+    """float [-1,1] mono -> MP3 bytes via the ffmpeg CLI, or None when no
+    encoder is available (pydub's export(format="mp3") is the same ffmpeg
+    pipe under the hood, swarm/audio/audioldm.py:23-33)."""
+    exe = _ffmpeg_path()
+    if exe is None:
+        return None
+    import subprocess
+
+    pcm = (np.clip(samples, -1.0, 1.0) * 32767.0).astype("<i2").tobytes()
+    try:
+        proc = subprocess.run(
+            [exe, "-hide_banner", "-loglevel", "error",
+             "-f", "s16le", "-ar", str(sample_rate), "-ac", "1",
+             "-i", "pipe:0", "-f", "mp3", "-b:a", bitrate, "pipe:1"],
+            input=pcm, capture_output=True, timeout=120, check=True,
+        )
+    except Exception:
+        return None
+    return proc.stdout or None
+
+
 def audio_artifact(samples: np.ndarray, sample_rate: int = 16000) -> dict:
+    mp3 = mp3_bytes(samples, sample_rate)
+    if mp3 is not None:
+        return make_result(mp3, "audio/mpeg")
     return make_result(pcm16_wav(samples, sample_rate), "audio/wav")
 
 

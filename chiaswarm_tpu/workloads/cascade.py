@@ -29,9 +29,17 @@ def cascade_callback(slot, model_name: str, *, seed: int,
                      upscale: bool = True,
                      upscaler_model_name: str = (
                          "stabilityai/sd-x2-latent-upscaler"),
+                     final_size: int | None = None,
                      **_ignored: Any):
     pipe = registry.cascade_pipeline(model_name,
                                      mesh=getattr(slot, "mesh", None))
+    upscaler = None
+    if upscale:
+        # stage 3: x2 latent-upscale passes to 4 * sr_size (256 -> 1024),
+        # replacing diffusion_func_if.py:31-40's SD-x4-upscaler stage;
+        # the cascade pipeline owns the pass loop
+        upscaler = registry.pipeline(
+            upscaler_model_name, mesh=getattr(slot, "mesh", None))
 
     t0 = time.perf_counter()
     images, config = pipe(
@@ -43,25 +51,23 @@ def cascade_callback(slot, model_name: str, *, seed: int,
         batch=max(1, int(num_images_per_prompt)),
         seed=seed,
         scheduler=scheduler_type,
+        upscaler=upscaler,
+        final_size=final_size,
     )
-    if upscale:
-        # stage 3: two x2 latent-upscale passes (256 -> 512 -> 1024),
-        # replacing diffusion_func_if.py:31-40's SD-x4-upscaler stage
-        upscaler = registry.pipeline(
-            upscaler_model_name, mesh=getattr(slot, "mesh", None))
-        for _ in range(2):
-            images, up_config = upscaler(images, prompt=prompt or "",
-                                         seed=seed)
-        config.update(up_config)
-        config["upscaled_to"] = list(images.shape[1:3])
     elapsed = time.perf_counter() - t0
 
     proc = OutputProcessor(content_type)
     proc.add_images(images)
     artifacts = proc.get_results()
 
+    # stage-1's safety modules guard the final output in the reference
+    # (diffusion_func_if.py:31-40,70-85); here the shared CLIP-concept
+    # checker covers the cascade like every diffusion workload
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    _, safety_fields = check_images(images, model_name)
+    config.update(safety_fields)
     config.update({
-        "nsfw": False,
         "images_per_sec": round(images.shape[0] / max(elapsed, 1e-9), 4),
         "generation_s": round(elapsed, 3),
         "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),

@@ -131,13 +131,29 @@ def image_shuffle(image: Image.Image) -> Image.Image:
     return Image.fromarray(out)
 
 
+_MLSD: list[Any] = []  # resident detector (lazy; [None] = no weights)
+
+
 @_register("mlsd")
 def image_to_line_segments(image: Image.Image) -> Image.Image:
-    """Model-free M-LSD stand-in: probabilistic Hough segments over Canny
-    edges, drawn white-on-black (the wireframe conditioning format)."""
+    """Wireframe map for the mlsd mode (input_processor.py:17-60). With
+    converted ``MobileV2_MLSD_Large`` weights in the model dir this runs
+    the native M-LSD network (models/mlsd.py); without them it falls back
+    to the model-free Hough stand-in (logged once)."""
     import cv2
 
+    def _load(ckpt):
+        from chiaswarm_tpu.models.mlsd import MLSDDetector
+
+        return MLSDDetector.from_checkpoint(ckpt)
+
+    det = _lazy_detector(_MLSD, "mlsd", _load,
+                         "mlsd uses the Hough-segments stand-in")
     arr = np.asarray(image)
+    if det is not None:
+        wire = det(arr)
+        return Image.fromarray(np.stack([wire] * 3, axis=-1))
+
     gray = cv2.cvtColor(arr, cv2.COLOR_RGB2GRAY)
     edges = cv2.Canny(gray, 50, 150)
     lines = cv2.HoughLinesP(edges, 1, np.pi / 180, threshold=40,
@@ -149,11 +165,27 @@ def image_to_line_segments(image: Image.Image) -> Image.Image:
     return Image.fromarray(out)
 
 
+_LINEART: list[Any] = []  # resident detector (lazy; [None] = no weights)
+
+
 @_register("lineart")
 def image_to_lineart(image: Image.Image) -> Image.Image:
-    """Model-free lineart stand-in: dodge-blend sketch (gray / blurred-gray)
-    inverted to white lines on black, the LineartDetector output format."""
+    """Line drawing for the lineart mode (input_processor.py:17-60). With
+    converted informative-drawings ``Generator`` weights in the model dir
+    this runs the native network (models/lineart.py); without them it
+    falls back to the model-free dodge-blend sketch (logged once)."""
     import cv2
+
+    def _load(ckpt):
+        from chiaswarm_tpu.models.lineart import LineartDetector
+
+        return LineartDetector.from_checkpoint(ckpt)
+
+    det = _lazy_detector(_LINEART, "lineart", _load,
+                         "lineart uses the dodge-sketch stand-in")
+    if det is not None:
+        lines = det(np.asarray(image.convert("RGB")))
+        return Image.fromarray(np.stack([lines] * 3, axis=-1))
 
     gray = cv2.cvtColor(np.asarray(image), cv2.COLOR_RGB2GRAY)
     blur = cv2.GaussianBlur(gray, (21, 21), 0)

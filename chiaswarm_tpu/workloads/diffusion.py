@@ -43,9 +43,15 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        controlnet_scale: float = 1.0,
                        save_preprocessed_input: bool = False,
                        textual_inversion: str | None = None,
+                       lora: str | None = None,
+                       cross_attention_scale: float = 1.0,
                        outputs: tuple[str, ...] = ("primary",),
                        **_ignored: Any):
+    # ``lora`` + ``cross_attention_scale`` are the reference's per-job LoRA
+    # contract (swarm/diffusion/diffusion_func.py:20-22,58-68); here the
+    # scaled deltas merge into a separately-cached param tree at load time
     pipe = registry.pipeline(model_name, textual_inversion=textual_inversion,
+                             lora=lora, lora_scale=cross_attention_scale,
                              mesh=getattr(slot, "mesh", None))
     fam = pipe.c.family
     if fam.kind != "sd":
@@ -135,6 +141,9 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
 
     if textual_inversion is not None:
         config["textual_inversion"] = textual_inversion
+    if lora is not None:
+        config["lora"] = lora
+        config["cross_attention_scale"] = float(cross_attention_scale)
     from chiaswarm_tpu.workloads.safety import check_images
 
     _, safety_fields = check_images(images, model_name)

@@ -1,0 +1,135 @@
+"""Generate tests/fixtures/scheduler_golden.npz from the numpy oracle.
+
+Run from the repo root:  python tests/make_scheduler_fixtures.py
+
+Each fixture is a full per-step trajectory in k-diffusion coordinates
+(x = x0 + sigma * eps), the framework's native space, converted from the
+oracle's VP coordinates where applicable (x_kd = x_vp * sqrt(1 + sigma^2)).
+See scheduler_oracle.py for why these are oracle- rather than
+diffusers-generated.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from scheduler_oracle import (
+    OracleDDIM,
+    OracleDPMpp2M,
+    OracleEuler,
+    OracleEulerAncestral,
+    make_karras_schedule,
+    mock_eps,
+    train_tables,
+)
+
+SHAPE = (1, 4, 4, 4)
+STEPS = (8, 20)
+
+
+def vp_to_kd(x_vp: np.ndarray, sigma_kd: float) -> np.ndarray:
+    return x_vp * np.sqrt(1.0 + sigma_kd ** 2)
+
+
+def kd_to_vp(x_kd: np.ndarray, sigma_kd: float) -> np.ndarray:
+    return x_kd / np.sqrt(1.0 + sigma_kd ** 2)
+
+
+def run_dpmpp(n: int, x_kd0: np.ndarray) -> dict[str, np.ndarray]:
+    o = OracleDPMpp2M(n)
+    traj = []
+    x_vp = kd_to_vp(x_kd0, float(o.sigmas[0]))
+    for i in range(n):
+        s = float(o.sigmas[i])
+        eps = mock_eps(kd_to_vp(vp_to_kd(x_vp, s), s), float(o.timesteps[i]))
+        x_vp = o.step(eps, x_vp)
+        s_next = float(o.sigmas[i + 1])
+        traj.append(vp_to_kd(x_vp, s_next) if s_next > 0 else x_vp)
+    return {"sigmas": o.sigmas, "timesteps": o.timesteps,
+            "traj": np.stack(traj)}
+
+
+def run_ddim(n: int, x_kd0: np.ndarray) -> dict[str, np.ndarray]:
+    o = OracleDDIM(n)
+    abar, kd_sigmas = train_tables()
+    sig0 = float(kd_sigmas[o.timesteps[0]])
+    traj = []
+    x_vp = kd_to_vp(x_kd0, sig0)
+    for i in range(n):
+        t = int(o.timesteps[i])
+        eps = mock_eps(x_vp, float(t))
+        x_vp = o.step(eps, x_vp)
+        prev_t = t - 1000 // n
+        s_next = float(kd_sigmas[prev_t]) if prev_t >= 0 else 0.0
+        traj.append(vp_to_kd(x_vp, s_next) if s_next > 0 else x_vp)
+    return {"timesteps": o.timesteps.astype(np.float64),
+            "sigma0": np.float64(sig0), "traj": np.stack(traj)}
+
+
+def run_euler(n: int, x_kd0: np.ndarray) -> dict[str, np.ndarray]:
+    o = OracleEuler(n)
+    traj = []
+    x = x_kd0.copy()
+    for i in range(n):
+        s = float(o.sigmas[i])
+        eps = mock_eps(x / np.sqrt(s ** 2 + 1.0), float(o.timesteps[i]))
+        x = o.step(eps, x)
+        traj.append(x.copy())
+    return {"sigmas": o.sigmas, "timesteps": o.timesteps,
+            "traj": np.stack(traj)}
+
+
+def run_euler_ancestral(n: int, x_kd0: np.ndarray,
+                        noises: np.ndarray) -> dict[str, np.ndarray]:
+    o = OracleEulerAncestral(n)
+    traj = []
+    x = x_kd0.copy()
+    for i in range(n):
+        s = float(o.sigmas[i])
+        eps = mock_eps(x / np.sqrt(s ** 2 + 1.0), float(o.timesteps[i]))
+        x = o.step(eps, x, noises[i])
+        traj.append(x.copy())
+    return {"sigmas": o.sigmas, "timesteps": o.timesteps,
+            "traj": np.stack(traj)}
+
+
+def main() -> None:
+    rng = np.random.default_rng(42)
+    out: dict[str, np.ndarray] = {}
+    for n in STEPS:
+        sig, _ = make_karras_schedule(n)
+        unit = rng.standard_normal(SHAPE)
+        x0_karras = unit * sig[0]
+        out[f"init_unit_{n}"] = unit
+        noises = rng.standard_normal((n,) + SHAPE)
+        out[f"noises_{n}"] = noises
+
+        for key, res in (
+            (f"dpmpp_2m_{n}", run_dpmpp(n, x0_karras)),
+            (f"euler_{n}", run_euler(n, x0_karras)),
+        ):
+            for field, arr in res.items():
+                out[f"{key}/{field}"] = arr
+
+        # non-karras grids start at their own sigma0
+        o_ea = OracleEulerAncestral(n)
+        x0_ea = unit * o_ea.sigmas[0]
+        for field, arr in run_euler_ancestral(n, x0_ea, noises).items():
+            out[f"euler_ancestral_{n}/{field}"] = arr
+
+        abar, kd_sigmas = train_tables()
+        ddim = OracleDDIM(n)
+        x0_ddim = unit * float(kd_sigmas[ddim.timesteps[0]])
+        for field, arr in run_ddim(n, x0_ddim).items():
+            out[f"ddim_{n}/{field}"] = arr
+
+    dest = Path(__file__).parent / "fixtures" / "scheduler_golden.npz"
+    dest.parent.mkdir(exist_ok=True)
+    np.savez_compressed(dest, **out)
+    print(f"wrote {dest} ({len(out)} arrays)")
+
+
+if __name__ == "__main__":
+    main()

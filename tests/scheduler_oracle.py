@@ -1,0 +1,207 @@
+"""Independent numpy oracle for golden scheduler trajectories.
+
+Purpose (SURVEY hard-part #4): the reference force-swaps every job onto
+DPMSolverMultistep with Karras sigmas (swarm/diffusion/diffusion_func.py:
+71-74), so our jittable sigma-space samplers (schedulers/sampling.py) must
+match the diffusers semantics step for step. diffusers itself is NOT
+installed in this zero-egress image, so the goldens cannot be literal
+diffusers outputs; instead this module re-implements the diffusers
+algorithms INDEPENDENTLY — in VP (variance-preserving) coordinates with
+diffusers' own state bookkeeping (multistep model-output lists,
+lower_order_final, leading/offset timestep spacing), following
+DPMSolverMultistepScheduler / DDIMScheduler / EulerDiscreteScheduler /
+EulerAncestralDiscreteScheduler and the DPM-Solver++ paper (Lu et al.
+2022, Algorithm 2M) — while the framework's samplers work in k-diffusion
+coordinates x_kd = x_vp / sqrt(alpha_bar). Agreement therefore checks the
+algebraic change of variables AND the ladder construction, not shared code
+paths. The fixtures generated from this oracle are committed
+(tests/fixtures/scheduler_golden.npz, see make_scheduler_fixtures.py) so a
+regression in either implementation turns the golden tests red.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+T_TRAIN = 1000
+BETA_START = 0.00085
+BETA_END = 0.012
+
+
+def train_tables() -> tuple[np.ndarray, np.ndarray]:
+    """(alphas_cumprod, kd_sigmas) for SD's scaled_linear schedule."""
+    betas = np.linspace(BETA_START ** 0.5, BETA_END ** 0.5, T_TRAIN,
+                        dtype=np.float64) ** 2
+    abar = np.cumprod(1.0 - betas)
+    sigmas = np.sqrt((1.0 - abar) / abar)
+    return abar, sigmas
+
+
+def leading_timesteps(n: int, steps_offset: int = 1) -> np.ndarray:
+    """diffusers timestep_spacing="leading": descending ints + offset."""
+    step_ratio = T_TRAIN // n
+    ts = (np.arange(n) * step_ratio).round()[::-1].astype(np.int64)
+    return ts + steps_offset
+
+
+def karras_ladder(sigma_min: float, sigma_max: float, n: int,
+                  rho: float = 7.0) -> np.ndarray:
+    ramp = np.linspace(0.0, 1.0, n)
+    return (sigma_max ** (1 / rho)
+            + ramp * (sigma_min ** (1 / rho) - sigma_max ** (1 / rho))) ** rho
+
+
+def sigma_to_t(sigma: np.ndarray, kd_sigmas: np.ndarray) -> np.ndarray:
+    """diffusers' _sigma_to_t: log-sigma interpolation onto train indices."""
+    return np.interp(np.log(np.maximum(sigma, 1e-10)), np.log(kd_sigmas),
+                     np.arange(len(kd_sigmas), dtype=np.float64))
+
+
+def make_karras_schedule(n: int) -> tuple[np.ndarray, np.ndarray]:
+    """(sigmas[n+1] with final 0, fractional timesteps[n]) the way
+    DPMSolverMultistep/EulerDiscrete build them with use_karras_sigmas."""
+    _, kd_sigmas = train_tables()
+    ts = leading_timesteps(n)
+    base = np.interp(ts.astype(np.float64)[::-1],
+                     np.arange(T_TRAIN, dtype=np.float64), kd_sigmas)
+    sig = karras_ladder(float(base[0]), float(base[-1]), n)
+    timesteps = sigma_to_t(sig, kd_sigmas)
+    return np.concatenate([sig, [0.0]]), timesteps
+
+
+def _alpha_sigma_vp(sigma_kd: float) -> tuple[float, float]:
+    """diffusers _sigma_to_alpha_sigma_t: VP-space (alpha_t, sigma_t)."""
+    alpha = 1.0 / np.sqrt(1.0 + sigma_kd ** 2)
+    return alpha, sigma_kd * alpha
+
+
+class OracleDPMpp2M:
+    """DPMSolverMultistepScheduler semantics: algorithm dpmsolver++,
+    solver_order=2, use_karras_sigmas=True, lower_order_final=True,
+    final_sigmas_type="zero", epsilon prediction — in VP coordinates."""
+
+    def __init__(self, n: int):
+        self.sigmas, self.timesteps = make_karras_schedule(n)
+        self.n = n
+        self.model_outputs: list[np.ndarray] = []
+        self.step_index = 0
+
+    def convert_to_x0(self, eps: np.ndarray, x_vp: np.ndarray,
+                      sigma_kd: float) -> np.ndarray:
+        alpha_t, sigma_t = _alpha_sigma_vp(sigma_kd)
+        return (x_vp - sigma_t * eps) / alpha_t
+
+    def step(self, eps: np.ndarray, x_vp: np.ndarray) -> np.ndarray:
+        i = self.step_index
+        s_kd, s_next_kd = self.sigmas[i], self.sigmas[i + 1]
+        x0 = self.convert_to_x0(eps, x_vp, s_kd)
+        self.model_outputs.append(x0)
+        if len(self.model_outputs) > 2:
+            self.model_outputs.pop(0)
+
+        alpha_t, sigma_t = _alpha_sigma_vp(s_next_kd)
+        alpha_s, sigma_s = _alpha_sigma_vp(s_kd)
+        lam_t = np.log(alpha_t) - np.log(max(sigma_t, 1e-20))
+        lam_s = np.log(alpha_s) - np.log(max(sigma_s, 1e-20))
+        h = lam_t - lam_s
+
+        use_first_order = (
+            len(self.model_outputs) < 2
+            or i == self.n - 1            # lower_order_final
+            or s_next_kd == 0.0
+        )
+        if use_first_order:
+            D = self.model_outputs[-1]
+        else:
+            s_prev_kd = self.sigmas[i - 1]
+            alpha_p, sigma_p = _alpha_sigma_vp(s_prev_kd)
+            lam_p = np.log(alpha_p) - np.log(max(sigma_p, 1e-20))
+            h_0 = lam_s - lam_p
+            r0 = h_0 / h
+            m0, m1 = self.model_outputs[-1], self.model_outputs[-2]
+            D = m0 + (0.5 / r0) * (m0 - m1)
+        if s_next_kd == 0.0:
+            x_next = D
+        else:
+            x_next = (sigma_t / sigma_s) * x_vp - alpha_t * np.expm1(-h) * D
+        self.step_index += 1
+        return x_next
+
+
+class OracleDDIM:
+    """DDIMScheduler semantics (eta=0, leading spacing, steps_offset=1,
+    epsilon prediction) in VP coordinates on the discrete timestep grid."""
+
+    def __init__(self, n: int):
+        self.abar, self.kd_sigmas = train_tables()
+        self.timesteps = leading_timesteps(n)  # descending ints
+        self.n = n
+        self.step_index = 0
+
+    def step(self, eps: np.ndarray, x_vp: np.ndarray) -> np.ndarray:
+        t = self.timesteps[self.step_index]
+        prev_t = t - T_TRAIN // self.n
+        a_t = self.abar[t]
+        a_prev = self.abar[prev_t] if prev_t >= 0 else 1.0
+        x0 = (x_vp - np.sqrt(1.0 - a_t) * eps) / np.sqrt(a_t)
+        x_next = np.sqrt(a_prev) * x0 + np.sqrt(1.0 - a_prev) * eps
+        self.step_index += 1
+        return x_next
+
+
+class OracleEuler:
+    """EulerDiscreteScheduler semantics with use_karras_sigmas=True —
+    k-diffusion coordinates (that is how diffusers implements it too; the
+    independence here is the ladder + step recurrence, re-derived)."""
+
+    def __init__(self, n: int):
+        self.sigmas, self.timesteps = make_karras_schedule(n)
+        self.step_index = 0
+
+    def step(self, eps: np.ndarray, x_kd: np.ndarray) -> np.ndarray:
+        i = self.step_index
+        s, s_next = self.sigmas[i], self.sigmas[i + 1]
+        x0 = x_kd - s * eps
+        d = (x_kd - x0) / s
+        x_next = x_kd + (s_next - s) * d
+        self.step_index += 1
+        return x_next
+
+
+class OracleEulerAncestral:
+    """EulerAncestralDiscreteScheduler semantics (no karras support in
+    diffusers for this class): discrete interpolated sigmas, ancestral
+    up/down split, caller-supplied per-step noise."""
+
+    def __init__(self, n: int):
+        _, kd_sigmas = train_tables()
+        ts = leading_timesteps(n)
+        sig = np.interp(ts.astype(np.float64),
+                        np.arange(T_TRAIN, dtype=np.float64), kd_sigmas)
+        self.sigmas = np.concatenate([sig, [0.0]])
+        self.timesteps = ts.astype(np.float64)
+        self.step_index = 0
+
+    def step(self, eps: np.ndarray, x_kd: np.ndarray,
+             noise: np.ndarray) -> np.ndarray:
+        i = self.step_index
+        s, s_next = self.sigmas[i], self.sigmas[i + 1]
+        x0 = x_kd - s * eps
+        if s_next == 0.0:
+            x_next = x0
+        else:
+            var = s_next ** 2 * (s ** 2 - s_next ** 2) / s ** 2
+            sigma_up = np.sqrt(max(var, 0.0))
+            sigma_down = np.sqrt(max(s_next ** 2 - sigma_up ** 2, 0.0))
+            d = (x_kd - x0) / s
+            x_next = x_kd + (sigma_down - s) * d + noise * sigma_up
+        self.step_index += 1
+        return x_next
+
+
+def mock_eps(x_model_input: np.ndarray, t: float) -> np.ndarray:
+    """Deterministic stand-in model. Takes the *scaled* model input (which
+    equals the VP-coordinate sample) and the conditioning timestep — the
+    same two things the real UNet sees — so a timestep-mapping bug between
+    implementations shows up as divergence."""
+    return 0.9 * np.tanh(x_model_input) + 0.02 * np.cos(t / 100.0)

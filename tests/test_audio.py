@@ -86,11 +86,15 @@ def test_convert_hifigan_weight_norm_folding():
     assert tree["resblocks_0_0"]["convs1_0"]["kernel"].shape == (3, 16, 16)
 
 
-def test_workload_txt2audio_wav_artifact():
-    """The txt2audio workflow emits a parseable WAV artifact."""
+def test_workload_txt2audio_wav_artifact(monkeypatch):
+    """The txt2audio workflow emits a parseable WAV artifact (mp3 encode
+    stubbed off so the assertion holds on ffmpeg-carrying hosts too)."""
     from chiaswarm_tpu.node.job_args import format_args
     from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.workloads import audio as audio_wl
 
+    monkeypatch.setattr(audio_wl, "mp3_bytes",
+                        lambda s, sr, bitrate="128k": None)
     registry = ModelRegistry(catalog=[], allow_random=True)
     job = {"workflow": "txt2audio", "model_name": "random/tiny_audio",
            "prompt": "wind chimes", "num_inference_steps": 2,
@@ -108,3 +112,34 @@ def test_workload_txt2audio_wav_artifact():
         assert wav.getnchannels() == 1
         assert wav.getnframes() > 0
     assert artifacts["primary"]["content_type"] == "audio/wav"
+
+
+def test_audio_artifact_prefers_mp3_when_encoder_present(monkeypatch):
+    """With an mp3 encoder available the artifact is audio/mpeg (the
+    reference's pydub/ffmpeg transcode, swarm/audio/audioldm.py:23-33);
+    without one it is an honest audio/wav."""
+    import base64
+
+    from chiaswarm_tpu.workloads import audio as wl
+
+    wav = np.sin(np.linspace(0, 440 * 2 * np.pi, 16000)).astype(np.float32)
+    monkeypatch.setattr(wl, "mp3_bytes",
+                        lambda s, sr, bitrate="128k": b"\xff\xfbFAKEMP3")
+    art = wl.audio_artifact(wav, 16000)
+    assert art["content_type"] == "audio/mpeg"
+    assert base64.b64decode(art["blob"]).startswith(b"\xff\xfb")
+
+    monkeypatch.setattr(wl, "mp3_bytes", lambda s, sr, bitrate="128k": None)
+    art = wl.audio_artifact(wav, 16000)
+    assert art["content_type"] == "audio/wav"
+
+
+def test_mp3_bytes_none_without_ffmpeg(monkeypatch):
+    from chiaswarm_tpu.workloads import audio as wl
+
+    wl._ffmpeg_path.cache_clear()
+    monkeypatch.setenv("PATH", "")
+    try:
+        assert wl.mp3_bytes(np.zeros(100, np.float32), 16000) is None
+    finally:
+        wl._ffmpeg_path.cache_clear()

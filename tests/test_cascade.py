@@ -107,3 +107,44 @@ def test_cascade_workload_dispatch():
     assert "primary" in artifacts
     assert config["family"] == "tiny_cascade"
     assert config["images_per_sec"] > 0
+
+
+def test_cascade_three_stage_emits_4x_sr_size(tiny_cascade):
+    """Full IF protocol: base -> sr -> latent-upscale passes to
+    4 * sr_size (the reference's stage-3 x4-upscaler output,
+    diffusion_func_if.py:31-40,63-65). Three denoise stages run and the
+    final image is 4x the stage-2 size."""
+    from chiaswarm_tpu.pipelines import Components
+    from chiaswarm_tpu.pipelines.upscale import LatentUpscalePipeline
+
+    upscaler = LatentUpscalePipeline(Components.random("tiny_up", seed=0))
+    fam = tiny_cascade.c.family
+    # final_size=2*sr keeps the hermetic run to ONE x2 pass (a 256px CPU
+    # compile takes tens of minutes); the default (no final_size) is
+    # 4 * sr_size = 1024px for the production IF family — the while-loop
+    # target logic is identical either way
+    img, config = tiny_cascade("a castle", steps=2, sr_steps=2, seed=4,
+                               guidance_scale=5.0, upscaler=upscaler,
+                               final_size=fam.sr_size * 2)
+    assert img.shape == (1, fam.sr_size * 2, fam.sr_size * 2, 3)
+    assert img.dtype == np.uint8
+    assert config["stages"] == 3  # base, sr, upscale stage
+    assert config["stage3_passes"] == 1
+    assert config["size"] == [fam.sr_size * 2, fam.sr_size * 2]
+
+
+def test_cascade_workload_three_stage_dispatch():
+    """cascade_callback with upscale=True (the default) runs stage 3
+    through the registry's upscaler and reports the upscaled size."""
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.workloads.cascade import cascade_callback
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    artifacts, config = cascade_callback(
+        "slot0", "random/tiny_cascade", seed=3, registry=registry,
+        prompt="a boat", num_inference_steps=2, sr_steps=2,
+        upscaler_model_name="random/tiny_up", final_size=128)
+    assert "primary" in artifacts
+    assert config["size"][0] == config["size"][1] == 128  # 64 * 2
+    assert config["stages"] == 3
+    assert "nsfw" in config

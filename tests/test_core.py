@@ -80,11 +80,11 @@ def test_bucketing():
     assert bucket_batch(3) == 4
     assert bucket_image_size(512, 512) == (512, 512)
     assert bucket_image_size(500, 700) == (512, 704)
-    # production floor is 256 (SD checkpoints are OOD below it)...
-    assert bucket_image_size(70, 60) == (256, 256)
-    assert bucket_image_size(4000, 100) == (1024, 256)
-    # ...but tiny hermetic families lower it per call site
-    assert bucket_image_size(70, 60, min_size=64) == (128, 64)
+    # small sizes are honored (reference has only a MAX clamp,
+    # job_arguments.py:96-102); quantized up to the 64 lattice
+    assert bucket_image_size(70, 60) == (128, 64)
+    assert bucket_image_size(192, 192) == (192, 192)
+    assert bucket_image_size(4000, 100) == (1024, 128)
 
 
 def test_lru_cache_eviction_and_stats():

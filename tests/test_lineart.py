@@ -1,0 +1,119 @@
+"""Lineart detector tests: torch-reference fidelity + preprocessor wiring.
+
+The reference's lineart mode runs controlnet_aux's LineartDetector — the
+informative-drawings ``Generator`` (swarm/controlnet/input_processor.py:
+17-60 dispatch); these pin the native port (models/lineart.py) to the same
+graph, including the exact ConvTranspose2d(k=3,s=2,p=1,op=1) emulation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.models.lineart import LineartDetector
+
+
+def _torch_generator(n_blocks: int = 3):
+    """Independent torch construction of the informative-drawings
+    Generator(3, 1, n_blocks) with sigmoid head."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    class ResidualBlock(nn.Module):
+        def __init__(self, ch):
+            super().__init__()
+            self.conv_block = nn.Sequential(
+                nn.ReflectionPad2d(1), nn.Conv2d(ch, ch, 3),
+                nn.InstanceNorm2d(ch), nn.ReLU(inplace=True),
+                nn.ReflectionPad2d(1), nn.Conv2d(ch, ch, 3),
+                nn.InstanceNorm2d(ch),
+            )
+
+        def forward(self, x):
+            return x + self.conv_block(x)
+
+    class Generator(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.model0 = nn.Sequential(
+                nn.ReflectionPad2d(3), nn.Conv2d(3, 64, 7),
+                nn.InstanceNorm2d(64), nn.ReLU(inplace=True))
+            self.model1 = nn.Sequential(
+                nn.Conv2d(64, 128, 3, stride=2, padding=1),
+                nn.InstanceNorm2d(128), nn.ReLU(inplace=True),
+                nn.Conv2d(128, 256, 3, stride=2, padding=1),
+                nn.InstanceNorm2d(256), nn.ReLU(inplace=True))
+            self.model2 = nn.Sequential(
+                *[ResidualBlock(256) for _ in range(n_blocks)])
+            self.model3 = nn.Sequential(
+                nn.ConvTranspose2d(256, 128, 3, stride=2, padding=1,
+                                   output_padding=1),
+                nn.InstanceNorm2d(128), nn.ReLU(inplace=True),
+                nn.ConvTranspose2d(128, 64, 3, stride=2, padding=1,
+                                   output_padding=1),
+                nn.InstanceNorm2d(64), nn.ReLU(inplace=True))
+            self.model4 = nn.Sequential(
+                nn.ReflectionPad2d(3), nn.Conv2d(64, 1, 7), nn.Sigmoid())
+
+        def forward(self, x):
+            return self.model4(
+                self.model3(self.model2(self.model1(self.model0(x)))))
+
+    torch.manual_seed(0)
+    return torch, Generator().eval()
+
+
+def test_conversion_matches_torch_reference():
+    torch, net = _torch_generator()
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_lineart
+
+    state = {k: v.detach().numpy() for k, v in net.state_dict().items()}
+    det = LineartDetector(params=convert_lineart(state))
+    x = np.random.RandomState(0).rand(1, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        tout = net(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    fout = np.asarray(det._fwd(det.params, jnp.asarray(x)))
+    np.testing.assert_allclose(fout[..., 0], tout[:, 0], atol=2e-4,
+                               rtol=2e-3)
+
+
+def test_converter_rejects_wrong_state():
+    from chiaswarm_tpu.convert.torch_to_flax import convert_lineart
+
+    with pytest.raises(ValueError, match="Generator"):
+        convert_lineart({"foo.weight": np.zeros((4, 4, 3, 3))})
+
+
+def test_detector_runs_on_odd_sizes():
+    det = LineartDetector.random(seed=0, canvas=64)
+    img = (np.random.RandomState(1).rand(37, 53, 3) * 255).astype(np.uint8)
+    lines = det(img)
+    assert lines.shape == (37, 53) and lines.dtype == np.uint8
+
+
+def test_lineart_uses_model_when_weights_present(monkeypatch):
+    from PIL import Image
+
+    from chiaswarm_tpu.workloads import controlnet as wl
+
+    monkeypatch.setattr(wl, "_LINEART",
+                        [LineartDetector.random(seed=2, canvas=64)])
+    out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
+                              {"type": "lineart"})
+    assert np.asarray(out).shape == (48, 64, 3)
+
+
+def test_lineart_falls_back_without_weights(tmp_path, monkeypatch):
+    from PIL import Image
+
+    from chiaswarm_tpu.workloads import controlnet as wl
+
+    monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
+    monkeypatch.setattr(wl, "_LINEART", [])
+    out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
+                              {"type": "lineart"})
+    assert np.asarray(out).shape == (48, 64, 3)
+    assert wl._LINEART == [None]  # stand-in path cached

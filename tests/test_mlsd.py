@@ -1,0 +1,217 @@
+"""M-LSD detector tests: torch-reference fidelity + preprocessor wiring.
+
+The reference's mlsd mode runs controlnet_aux's MLSDdetector — the
+mlsd_pytorch ``MobileV2_MLSD_Large`` graph (swarm/controlnet/
+input_processor.py:17-60 dispatch); these pin the native port
+(models/mlsd.py) to the same graph: MobileNetV2 trunk (4-ch input, FPN
+taps), TypeA/B/C decoder, align-corners bilinear, TP-map slice, and the
+line decode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.models.mlsd import MLSDDetector, decode_lines
+
+
+def _torch_mlsd():
+    """Independent torch construction of MobileV2_MLSD_Large."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class ConvBNReLU(nn.Sequential):
+        def __init__(self, cin, cout, k=3, stride=1, groups=1):
+            super().__init__(
+                nn.Conv2d(cin, cout, k, stride, (k - 1) // 2, groups=groups,
+                          bias=False),
+                nn.BatchNorm2d(cout), nn.ReLU6(inplace=True))
+
+    class InvertedResidual(nn.Module):
+        def __init__(self, inp, oup, stride, expand_ratio):
+            super().__init__()
+            hidden = inp * expand_ratio
+            self.use_res = stride == 1 and inp == oup
+            layers = []
+            if expand_ratio != 1:
+                layers.append(ConvBNReLU(inp, hidden, k=1))
+            layers.extend([
+                ConvBNReLU(hidden, hidden, stride=stride, groups=hidden),
+                nn.Conv2d(hidden, oup, 1, 1, 0, bias=False),
+                nn.BatchNorm2d(oup)])
+            self.conv = nn.Sequential(*layers)
+
+        def forward(self, x):
+            return x + self.conv(x) if self.use_res else self.conv(x)
+
+    class MobileNetV2(nn.Module):
+        def __init__(self):
+            super().__init__()
+            plan = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+                    (6, 64, 4, 2), (6, 96, 3, 1)]
+            features = [ConvBNReLU(4, 32, stride=2)]
+            cin = 32
+            for t, c, n, s in plan:
+                for j in range(n):
+                    features.append(
+                        InvertedResidual(cin, c, s if j == 0 else 1, t))
+                    cin = c
+            self.features = nn.Sequential(*features)
+            self.fpn_selected = [1, 3, 6, 10, 13]
+
+        def forward(self, x):
+            outs = []
+            for i, f in enumerate(self.features):
+                x = f(x)
+                if i in self.fpn_selected:
+                    outs.append(x)
+            return outs
+
+    class BlockTypeA(nn.Module):
+        def __init__(self, in_c1, in_c2, out_c1, out_c2, upscale=True):
+            super().__init__()
+            self.conv1 = nn.Sequential(
+                nn.Conv2d(in_c2, out_c2, 1), nn.BatchNorm2d(out_c2),
+                nn.ReLU(inplace=True))
+            self.conv2 = nn.Sequential(
+                nn.Conv2d(in_c1, out_c1, 1), nn.BatchNorm2d(out_c1),
+                nn.ReLU(inplace=True))
+            self.upscale = upscale
+
+        def forward(self, a, b):
+            b = self.conv1(b)
+            a = self.conv2(a)
+            if self.upscale:
+                b = F.interpolate(b, scale_factor=2.0, mode="bilinear",
+                                  align_corners=True)
+            return torch.cat((a, b), dim=1)
+
+    class BlockTypeB(nn.Module):
+        def __init__(self, in_c, out_c):
+            super().__init__()
+            self.conv1 = nn.Sequential(
+                nn.Conv2d(in_c, in_c, 3, padding=1), nn.BatchNorm2d(in_c),
+                nn.ReLU())
+            self.conv2 = nn.Sequential(
+                nn.Conv2d(in_c, out_c, 3, padding=1),
+                nn.BatchNorm2d(out_c))
+
+        def forward(self, x):
+            return self.conv2(self.conv1(x) + x)
+
+    class BlockTypeC(nn.Module):
+        def __init__(self, in_c, out_c):
+            super().__init__()
+            self.conv1 = nn.Sequential(
+                nn.Conv2d(in_c, in_c, 3, padding=5, dilation=5),
+                nn.BatchNorm2d(in_c), nn.ReLU())
+            self.conv2 = nn.Sequential(
+                nn.Conv2d(in_c, in_c, 3, padding=1),
+                nn.BatchNorm2d(in_c), nn.ReLU())
+            self.conv3 = nn.Conv2d(in_c, out_c, 1)
+
+        def forward(self, x):
+            return self.conv3(self.conv2(self.conv1(x)))
+
+    class MLSD(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.backbone = MobileNetV2()
+            self.block15 = BlockTypeA(64, 96, 64, 64, upscale=False)
+            self.block16 = BlockTypeB(128, 64)
+            self.block17 = BlockTypeA(32, 64, 64, 64)
+            self.block18 = BlockTypeB(128, 64)
+            self.block19 = BlockTypeA(24, 64, 64, 64)
+            self.block20 = BlockTypeB(128, 64)
+            self.block21 = BlockTypeA(16, 64, 64, 64)
+            self.block22 = BlockTypeB(128, 64)
+            self.block23 = BlockTypeC(64, 16)
+
+        def forward(self, x):
+            c1, c2, c3, c4, c5 = self.backbone(x)
+            x = self.block16(self.block15(c4, c5))
+            x = self.block18(self.block17(c3, x))
+            x = self.block20(self.block19(c2, x))
+            x = self.block22(self.block21(c1, x))
+            return self.block23(x)[:, 7:, :, :]
+
+    torch.manual_seed(0)
+    net = MLSD().eval()
+    # randomize BN running stats so fidelity covers them too
+    with torch.no_grad():
+        for m in net.modules():
+            if isinstance(m, nn.BatchNorm2d):
+                m.running_mean.normal_(0.0, 0.2)
+                m.running_var.uniform_(0.5, 1.5)
+    return torch, net
+
+
+def test_conversion_matches_torch_reference():
+    torch, net = _torch_mlsd()
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_mlsd
+
+    state = {k: v.detach().numpy() for k, v in net.state_dict().items()}
+    det = MLSDDetector(params=convert_mlsd(state))
+    x = np.random.RandomState(0).rand(1, 64, 64, 4).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        tout = net(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    fout = np.asarray(det._fwd(det.params, jnp.asarray(x)))
+    np.testing.assert_allclose(fout.transpose(0, 3, 1, 2), tout,
+                               atol=5e-4, rtol=5e-3)
+
+
+def test_converter_rejects_wrong_state():
+    from chiaswarm_tpu.convert.torch_to_flax import convert_mlsd
+
+    with pytest.raises(ValueError, match="expected 13"):
+        convert_mlsd({"backbone.features.0.0.weight":
+                      np.zeros((32, 4, 3, 3), np.float32)})
+
+
+def test_decode_lines_extracts_planted_segment():
+    """A synthetic TP map with one confident center and a known
+    displacement must decode to exactly that segment (2x coords)."""
+    tp = np.zeros((64, 64, 9), np.float32)
+    tp[:, :, 0] = -10.0       # background logit ~ 0 probability
+    tp[30, 20, 0] = 10.0      # one confident center at (y=30, x=20)
+    tp[30, 20, 1:5] = [-8.0, -6.0, 8.0, 6.0]  # endpoints +-(8, 6)
+    lines = decode_lines(tp, score_thr=0.1, dist_thr=5.0)
+    assert lines.shape == (1, 4)
+    np.testing.assert_allclose(lines[0], [(20 - 8) * 2, (30 - 6) * 2,
+                                          (20 + 8) * 2, (30 + 6) * 2])
+
+
+def test_detector_runs_on_odd_sizes():
+    det = MLSDDetector.random(seed=0, canvas=64)
+    img = (np.random.RandomState(1).rand(37, 53, 3) * 255).astype(np.uint8)
+    out = det(img)
+    assert out.shape == (37, 53) and out.dtype == np.uint8
+
+
+def test_mlsd_uses_model_when_weights_present(monkeypatch):
+    from PIL import Image
+
+    from chiaswarm_tpu.workloads import controlnet as wl
+
+    monkeypatch.setattr(wl, "_MLSD", [MLSDDetector.random(seed=2,
+                                                          canvas=64)])
+    out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
+                              {"type": "mlsd"})
+    assert np.asarray(out).shape == (48, 64, 3)
+
+
+def test_mlsd_falls_back_without_weights(tmp_path, monkeypatch):
+    from PIL import Image
+
+    from chiaswarm_tpu.workloads import controlnet as wl
+
+    monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
+    monkeypatch.setattr(wl, "_MLSD", [])
+    out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
+                              {"type": "mlsd"})
+    assert np.asarray(out).shape == (48, 64, 3)
+    assert wl._MLSD == [None]  # stand-in path cached

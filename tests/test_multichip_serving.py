@@ -74,3 +74,51 @@ def test_caption_params_pin_to_slot_chip():
     # a different slot keys a separate resident entry
     other = registry.caption_pipeline("tinyblip", mesh=pool.slots[0].mesh)
     assert other is not pipe
+
+
+def test_dp_sharding_reduces_per_device_flops():
+    """Scaling-shape sanity (sharding-regression guard): the compiled
+    dp=4-sharded UNet eval must cost each device a fraction of the
+    unsharded program's FLOPs. Catches a silent batch-replication
+    regression — if GSPMD stops partitioning the batch axis, per-device
+    FLOPs jump back to the full count."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.models.configs import FAMILIES
+    from chiaswarm_tpu.models.unet import UNet
+
+    fam = FAMILIES["tiny"]
+    unet = UNet(fam.unet)
+    batch, hw = 4, 8
+    latent = jnp.zeros((batch, hw, hw, fam.unet.sample_channels))
+    t = jnp.zeros((batch,))
+    ctx = jnp.zeros((batch, 8, fam.unet.cross_attention_dim))
+    params = jax.jit(unet.init)(jax.random.PRNGKey(0), latent, t, ctx)
+
+    def flops(compiled) -> float:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+
+    base = jax.jit(unet.apply).lower(params, latent, t, ctx).compile()
+
+    mesh = build_mesh(MeshSpec({"data": 4}),
+                      devices=jax.devices()[:4])
+    row = NamedSharding(mesh, P("data"))
+    sharded_in = (
+        jax.device_put(latent, NamedSharding(mesh, P("data", None, None,
+                                                     None))),
+        jax.device_put(t, row),
+        jax.device_put(ctx, NamedSharding(mesh, P("data", None, None))),
+    )
+    dp = jax.jit(unet.apply).lower(params, *sharded_in).compile()
+
+    f_base, f_dp = flops(base), flops(dp)
+    assert f_base > 0 and f_dp > 0
+    # per-device cost must drop ~4x; allow generous slack for collective
+    # and padding overhead (a replication regression would be ~1.0x)
+    assert f_dp < 0.5 * f_base, (f_dp, f_base)

@@ -168,3 +168,93 @@ def test_resolve_scheduler_names():
     cfg = resolve("DDIMScheduler", prediction_type="v_prediction")
     assert cfg.prediction_type == "v_prediction"
     assert dataclasses.asdict(cfg)  # dataclass, hashable-able config
+
+
+# ---------- golden trajectories vs the independent VP-coordinate oracle ----
+
+class _GoldenHelper:
+    """Run the framework's scan-compatible sampler loop with the oracle's
+    mock model, in k-diffusion coordinates (fixtures are kd-space; see
+    tests/make_scheduler_fixtures.py)."""
+
+    @staticmethod
+    def run(kind: str, n: int, use_karras: bool, x0: np.ndarray,
+            noises: np.ndarray | None = None) -> np.ndarray:
+        from tests.scheduler_oracle import mock_eps
+
+        cfg = SamplerConfig(kind=kind, use_karras_sigmas=use_karras)
+        ns = make_noise_schedule(ScheduleConfig())
+        sched = make_sampling_schedule(ns, n, cfg)
+        x = jnp.asarray(x0, jnp.float32)
+        state = init_sampler_state(x)
+        traj = []
+        for i in range(n):
+            inp = scale_model_input(sched, x, jnp.asarray(i))
+            eps = mock_eps(np.asarray(inp, np.float64),
+                           float(sched.timesteps[i]))
+            nz = (jnp.asarray(noises[i], jnp.float32)
+                  if noises is not None else jnp.zeros_like(x))
+            x, state = sampler_step(cfg, sched, jnp.asarray(i), x,
+                                    jnp.asarray(eps, jnp.float32), state,
+                                    noise=nz, start_index=0)
+            traj.append(np.asarray(x, np.float64))
+        return np.stack(traj)
+
+
+@pytest.fixture(scope="module")
+def golden():
+    import pathlib
+
+    path = pathlib.Path(__file__).parent / "fixtures" / "scheduler_golden.npz"
+    return np.load(path)
+
+
+@pytest.mark.parametrize("n", [8, 20])
+def test_golden_dpmpp_2m_karras(golden, n):
+    """The reference's forced scheduler — DPMSolverMultistep + Karras
+    (swarm/diffusion/diffusion_func.py:71-74). Ladder AND trajectory must
+    match the VP-coordinate oracle step for step."""
+    sig = golden[f"dpmpp_2m_{n}/sigmas"]
+    cfg = SamplerConfig(kind="dpmpp_2m", use_karras_sigmas=True)
+    ns = make_noise_schedule(ScheduleConfig())
+    sched = make_sampling_schedule(ns, n, cfg)
+    np.testing.assert_allclose(np.asarray(sched.sigmas), sig, rtol=2e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sched.timesteps), golden[f"dpmpp_2m_{n}/timesteps"],
+        rtol=0, atol=2e-3)
+
+    x0 = golden[f"init_unit_{n}"] * sig[0]
+    ours = _GoldenHelper.run("dpmpp_2m", n, True, x0)
+    ref = golden[f"dpmpp_2m_{n}/traj"]
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("n", [8, 20])
+def test_golden_euler_karras(golden, n):
+    sig = golden[f"euler_{n}/sigmas"]
+    x0 = golden[f"init_unit_{n}"] * sig[0]
+    ours = _GoldenHelper.run("euler", n, True, x0)
+    np.testing.assert_allclose(ours, golden[f"euler_{n}/traj"],
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("n", [8, 20])
+def test_golden_ddim_discrete_grid(golden, n):
+    """Deterministic DDIM (VP coordinates, diffusers leading spacing) must
+    equal our sigma-space euler/ddim step on the discrete grid — the
+    change-of-variables identity the sampling module claims."""
+    x0 = golden[f"init_unit_{n}"] * float(golden[f"ddim_{n}/sigma0"])
+    ours = _GoldenHelper.run("ddim", n, False, x0)
+    np.testing.assert_allclose(ours, golden[f"ddim_{n}/traj"],
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("n", [8, 20])
+def test_golden_euler_ancestral(golden, n):
+    sig = golden[f"euler_ancestral_{n}/sigmas"]
+    x0 = golden[f"init_unit_{n}"] * sig[0]
+    noises = golden[f"noises_{n}"]
+    ours = _GoldenHelper.run("euler_ancestral", n, False, x0, noises=noises)
+    np.testing.assert_allclose(ours, golden[f"euler_ancestral_{n}/traj"],
+                               rtol=2e-4, atol=2e-4)

@@ -112,10 +112,14 @@ def test_tts_pipeline_end_to_end(tiny_tts):
     assert np.array_equal(wav, wav2)
 
 
-def test_tts_workload_wav_artifact():
+def test_tts_workload_wav_artifact(monkeypatch):
     from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.workloads import audio as audio_wl
     from chiaswarm_tpu.workloads.audio import tts_callback
 
+    # pin the wav fallback so the wave-parse below holds on ffmpeg hosts
+    monkeypatch.setattr(audio_wl, "mp3_bytes",
+                        lambda s, sr, bitrate="128k": None)
     registry = ModelRegistry(catalog=[], allow_random=True)
     artifacts, config = tts_callback(
         "slot0", "random/tiny_tts", seed=2, registry=registry,
@@ -149,3 +153,41 @@ def test_voice_preset_history_changes_output(tiny_tts):
     # histories shift every stage; identical output would mean they were
     # silently dropped
     assert base.shape != cond.shape or not np.array_equal(base, cond)
+
+
+def test_semantic_text_encoding_bark_protocol():
+    """Regression: the semantic-stage text window must be raw wordpiece ids
+    (no [CLS]/[SEP]) with text_pad_token in every unused slot — bark
+    tokenizes with add_special_tokens=False and masked_fills pads with
+    text_pad_token (HF modeling_bark.py:635). encode()'s [PAD]=0 rows
+    would become 0+text_encoding_offset, an untrained in-vocab token."""
+    from chiaswarm_tpu.models.tokenizer import WordPieceTokenizer
+    from chiaswarm_tpu.pipelines.tts import encode_semantic_text
+
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+             "hello": 4, "world": 5}
+    tok = WordPieceTokenizer(vocab, max_length=16)
+    fam = get_tts_family("suno/bark")
+    row = encode_semantic_text(tok, "hello world", fam,
+                               fam.semantic.vocab_size)[0]
+    L = fam.max_input_semantic_length
+    assert row.shape == (L,)
+    off = fam.text_encoding_offset
+    assert row[0] == 4 + off and row[1] == 5 + off
+    # every remaining slot is the real pad token, not [PAD]+offset or
+    # [CLS]/[SEP]+offset
+    assert (row[2:] == fam.text_pad_token).all()
+    assert 0 + off not in row and 2 + off not in row and 3 + off not in row
+
+
+def test_hash_tokenizer_tokenize_matches_encode_body():
+    """HashTokenizer.tokenize() must be the specials-free body of
+    encode() (same hashed ids, no bos/eos/pad)."""
+    from chiaswarm_tpu.models.tokenizer import HashTokenizer
+
+    tok = HashTokenizer(vocab_size=100, max_length=12)
+    raw = tok.tokenize("a few words here")
+    enc = tok.encode("a few words here")
+    assert enc[0] == tok.bos_id
+    assert enc[1:1 + len(raw)] == raw
+    assert all(i < tok.vocab_size - 2 for i in raw)

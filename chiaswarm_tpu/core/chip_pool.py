@@ -37,18 +37,31 @@ from chiaswarm_tpu.core.rng import draw_seed, key_for_seed
 
 class SlotBusy(RuntimeError):
     """Raised when a job is dispatched to a slot that is already executing
-    (parity with the reference's non-blocking mutex, swarm/gpu/device.py:27-29)."""
+    at full pipeline depth (parity with the reference's non-blocking
+    mutex, swarm/gpu/device.py:27-29 — generalized to a bounded counter)."""
 
 
 @dataclasses.dataclass
 class MeshSlot:
-    """One schedulable executor: a device mesh plus per-job RNG state."""
+    """One schedulable executor: a device mesh plus per-job RNG state.
+
+    ``depth`` is the slot's job-pipeline depth: how many jobs may be
+    in flight at once. The reference's torch Device is a hard mutex
+    (depth 1) because its pipelines are stateful modules; these pipelines
+    are pure jitted functions, so a second job can safely tokenize and
+    dispatch its program while the first drains its device->host image
+    transfer — XLA serializes execution on the chip's stream and the
+    overlap removes the chip-idle gap (bench.py measures it at ~+7%
+    steady-state throughput on SDXL-1024). Depth 2 captures the overlap;
+    deeper only grows queue latency.
+    """
 
     index: int
     mesh: Mesh
+    depth: int = 2
 
     def __post_init__(self) -> None:
-        self._lock = threading.Lock()
+        self._slots_free = threading.BoundedSemaphore(max(1, self.depth))
 
     @property
     def identifier(self) -> str:
@@ -71,7 +84,7 @@ class MeshSlot:
         Mirrors Device.__call__ (swarm/gpu/device.py:26-47): non-blocking
         acquire, seed bookkeeping, model_name passed positionally.
         """
-        if not self._lock.acquire(blocking=False):
+        if not self._slots_free.acquire(blocking=False):
             raise SlotBusy(f"{self.identifier} is busy")
         try:
             model_name = kwargs.pop("model_name", None)
@@ -86,7 +99,7 @@ class MeshSlot:
             config["seed"] = seed
             return artifacts, config
         finally:
-            self._lock.release()
+            self._slots_free.release()
 
     def rng(self, seed: int) -> jax.Array:
         return key_for_seed(seed)
@@ -106,6 +119,7 @@ class ChipPool:
         n_slots: int = 1,
         mesh_spec: MeshSpec | None = None,
         devices: Sequence[jax.Device] | None = None,
+        depth: int = 2,
     ) -> None:
         devices = list(devices) if devices is not None else list(jax.devices())
         if n_slots < 1 or len(devices) % n_slots:
@@ -117,6 +131,7 @@ class ChipPool:
             MeshSlot(
                 index=i,
                 mesh=build_mesh(mesh_spec, devices=devices[i * per_slot:(i + 1) * per_slot]),
+                depth=depth,
             )
             for i in range(n_slots)
         ]

@@ -270,20 +270,31 @@ class MLSDDetector:
         import cv2
 
         h, w = image.shape[:2]
-        resized = cv2.resize(image, (self.canvas, self.canvas),
-                             interpolation=cv2.INTER_AREA)
+        # aspect-preserving resize + replicate pad (same scheme as
+        # HEDDetector/LineartDetector): squashing to a square would
+        # distort line geometry relative to the image the UNet sees
+        scale = self.canvas / max(h, w, 1)
+        nh = max(16, min(self.canvas, round(h * scale)))
+        nw = max(16, min(self.canvas, round(w * scale)))
+        resized = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_AREA)
+        padded = cv2.copyMakeBorder(resized, 0, self.canvas - nh, 0,
+                                    self.canvas - nw, cv2.BORDER_REPLICATE)
         # pred_lines input prep: np.ones (value 1.0, NOT 255) concatenates
         # BEFORE the /127.5-1 normalization, so the trained 4th channel is
         # 1/127.5 - 1 ~= -0.992
         x = np.concatenate(
-            [resized.astype(np.float32),
-             np.ones(resized.shape[:2] + (1,), np.float32)],
+            [padded.astype(np.float32),
+             np.ones(padded.shape[:2] + (1,), np.float32)],
             axis=-1) / 127.5 - 1.0
         tp = np.asarray(jax.device_get(
             self._fwd(self.params, jnp.asarray(x)[None])))[0]
         lines = decode_lines(tp, score_thr=score_thr, dist_thr=dist_thr)
+        # draw at full-resolution canvas scale, thick enough to survive
+        # the downscale back to the request size
         out = np.zeros((self.canvas, self.canvas), np.uint8)
+        thickness = max(1, int(round(1.0 / max(scale, 1e-6))))
         for x1, y1, x2, y2 in lines:
             cv2.line(out, (int(round(x1)), int(round(y1))),
-                     (int(round(x2)), int(round(y2))), 255, 1)
+                     (int(round(x2)), int(round(y2))), 255, thickness)
+        out = out[:nh, :nw]
         return cv2.resize(out, (w, h), interpolation=cv2.INTER_NEAREST)

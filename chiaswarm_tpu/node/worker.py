@@ -57,7 +57,13 @@ class Worker:
             self.settings.hive_uri, self.settings.hive_token,
             self.settings.worker_name,
         )
-        self.work_queue: asyncio.Queue = asyncio.Queue(maxsize=len(self.pool))
+        # queue bound = total in-flight capacity (slots x pipeline depth):
+        # the reference sizes its queue to the GPU count (worker.py:186);
+        # depth-2 slots keep one extra job ready so its dispatch overlaps
+        # the previous job's device->host transfer (core/chip_pool.py)
+        depth = max(getattr(slot, "depth", 1) for slot in self.pool)
+        self.work_queue: asyncio.Queue = asyncio.Queue(
+            maxsize=len(self.pool) * depth)
         self.result_queue: asyncio.Queue = asyncio.Queue()
         self._stop = asyncio.Event()
         self.jobs_done = 0
@@ -186,8 +192,16 @@ class Worker:
         return POLL_BUSY_S if jobs else POLL_IDLE_S
 
     async def _slot_worker(self, slot) -> None:
-        while True:
-            job = await self.work_queue.get()
+        """Feed one slot, keeping up to ``slot.depth`` jobs in flight.
+
+        With depth 2, job N+1's host prep + program dispatch overlap job
+        N's device->host image transfer (chip never idles between jobs);
+        the slot's bounded semaphore enforces the cap, this semaphore
+        just avoids pulling queue items nothing can run yet."""
+        inflight = asyncio.Semaphore(max(1, getattr(slot, "depth", 1)))
+        pending: set[asyncio.Task] = set()
+
+        async def run_one(job) -> None:
             try:
                 result = await do_work(job, slot, self.registry)
                 await self.result_queue.put(result)
@@ -195,7 +209,24 @@ class Worker:
             except Exception as exc:  # keep the loop alive, always
                 log.exception("slot worker error: %s", exc)
             finally:
+                inflight.release()
                 self.work_queue.task_done()
+
+        try:
+            while True:
+                await inflight.acquire()
+                job = await self.work_queue.get()
+                task = asyncio.create_task(run_one(job))
+                pending.add(task)
+                task.add_done_callback(pending.discard)
+        finally:
+            # drain in-flight jobs before the loop closes: cancel, then
+            # AWAIT them so their finally blocks (queue bookkeeping) run
+            # and no pending task outlives the event loop
+            for task in list(pending):
+                task.cancel()
+            if pending:
+                await asyncio.gather(*list(pending), return_exceptions=True)
 
     RESULT_RETRIES = 3
     RESULT_RETRY_DELAY_S = 5.0

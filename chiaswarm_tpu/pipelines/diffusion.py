@@ -218,8 +218,8 @@ class DiffusionPipeline:
 
         pix2pix = fam.image_conditioned
 
-        def fn(params, ids, neg_ids, key, guidance, init_latent, mask,
-               control_params, control_cond, control_scale,
+        def fn(params, ids, neg_ids, sample_keys, guidance, init_latent,
+               mask, control_params, control_cond, control_scale,
                image_guidance):
             ctx, pooled = encode_text(params, ids)
             if pix2pix:
@@ -242,10 +242,19 @@ class DiffusionPipeline:
                 added = {"time_ids": time_ids,
                          "text_embeds": pooled[:, : fam.unet.addition_pooled_dim]}
 
-            key, nkey = jax.random.split(key)
-            noise = jax.random.normal(
-                nkey, (batch, lh, lw, fam.vae.latent_channels), jnp.float32
-            )
+            # per-SAMPLE noise streams: row b's noise depends only on its
+            # own key, so image b is identical whether generated at
+            # batch=1 or inside a larger batch (seed reproducibility is
+            # batch-size-invariant — and the precondition for ever
+            # coalescing different jobs into one batched program)
+            def draw(keys):
+                return jax.vmap(lambda k: jax.random.normal(
+                    k, (lh, lw, fam.vae.latent_channels), jnp.float32)
+                )(keys)
+
+            both = jax.vmap(jax.random.split)(sample_keys)  # (B, 2, key)
+            sample_keys, nkeys = both[:, 0], both[:, 1]
+            noise = draw(nkeys)
             sigma_start = sched.sigmas[start_step]
             if pix2pix:
                 # image latents condition via channel-concat (UNSCALED, the
@@ -271,7 +280,7 @@ class DiffusionPipeline:
                     cond_emb = jnp.concatenate([cond_emb, cond_emb], axis=0)
 
             def body(carry, idx):
-                x, state, key = carry
+                x, state, carry_keys = carry
                 i = idx + start_step
                 inp = scale_model_input(sched, x, i)
                 if pix2pix:
@@ -307,22 +316,25 @@ class DiffusionPipeline:
                             added, control_scale)
                     eps = unet.apply(params["unet"], inp, t1, ctx, added,
                                      down_res, mid_res)
-                key, skey = jax.random.split(key)
-                step_noise = jax.random.normal(skey, x.shape, jnp.float32)
+                keys, skeys = jax.vmap(
+                    lambda k: tuple(jax.random.split(k)))(carry_keys)
+                step_noise = draw(skeys)
                 x, state = sampler_step(sampler, sched, i, x, eps, state,
                                         noise=step_noise,
                                         start_index=start_step)
                 if has_mask:
                     # re-project known region onto the next noise level
-                    key, mkey = jax.random.split(key)
-                    renoise = jax.random.normal(mkey, x.shape, jnp.float32)
+                    keys, mkeys = jax.vmap(
+                        lambda k: tuple(jax.random.split(k)))(keys)
+                    renoise = draw(mkeys)
                     known_t = known + renoise * sched.sigmas[i + 1]
                     x = x * mask + known_t * (1.0 - mask)
-                return (x, state, key), None
+                return (x, state, keys), None
 
             n_steps = steps - start_step
             (x, _, _), _ = jax.lax.scan(
-                body, (x, init_sampler_state(x), key), jnp.arange(n_steps)
+                body, (x, init_sampler_state(x), sample_keys),
+                jnp.arange(n_steps)
             )
 
             if tiled:
@@ -377,10 +389,11 @@ class DiffusionPipeline:
         transfer. JAX's async dispatch returns the uint8 result array as a
         future; ``PendingImages.wait()`` fetches it. Submitting job N+1
         before waiting on job N overlaps N's ~0.2 s host transfer with
-        N+1's denoise compute (bench.py measures this steady-state number;
-        the per-job serving executor currently runs ``__call__`` and
-        blocks — wiring the worker's slot loop through submit() is the
-        remaining step. No reference analog — torch blocks per call)."""
+        N+1's denoise compute. bench.py measures this steady-state number
+        directly; the serving loop gets the same overlap from depth-2
+        slots (core/chip_pool.py MeshSlot.depth + node/worker.py
+        _slot_worker), where two blocking jobs interleave across threads.
+        No reference analog — torch blocks per pipeline call."""
         fam = self.c.family
         # small sizes are honored like the reference (only a max clamp,
         # swarm/job_arguments.py:96-102): a 192px request generates AT
@@ -491,11 +504,16 @@ class DiffusionPipeline:
             has_init=has_init, has_mask=has_mask, tiled=req.tiled_decode,
             has_control=has_control,
         )
+        # one independent key per batch row: fold the row index into the
+        # job seed, so row b is reproducible at ANY batch size
+        base_key = key_for_seed(req.seed)
+        sample_keys = jax.vmap(
+            lambda i: jax.random.fold_in(base_key, i))(jnp.arange(batch))
         img = fn(
             self.c.params,
             ids,
             neg,
-            key_for_seed(req.seed),
+            sample_keys,
             jnp.float32(req.guidance_scale),
             init_latent,
             mask_arr,

@@ -52,16 +52,30 @@ def test_chip_pool_slots_and_seed_recording():
     assert config2["seed"] == 123
 
 
-def test_chip_pool_busy_raises():
-    pool = ChipPool(n_slots=1)
-    slot = pool.slots[0]
+def test_chip_pool_busy_raises_past_pipeline_depth():
+    """Depth-1 slot == the reference's hard mutex; the default depth-2
+    slot admits ONE extra in-flight job, then raises."""
+    slot1 = ChipPool(n_slots=1, depth=1).slots[0]
 
     def reentrant(s, model_name, seed=None, **kw):
         with pytest.raises(SlotBusy):
-            slot(lambda *a, **k: ({}, {}))
+            slot1(lambda *a, **k: ({}, {}))
         return {}, {}
 
-    slot(reentrant, model_name=None)
+    slot1(reentrant, model_name=None)
+
+    slot2 = ChipPool(n_slots=1, depth=2).slots[0]
+
+    def two_deep(s, model_name, seed=None, **kw):
+        def inner(s2, model_name2, seed=None, **kw2):
+            with pytest.raises(SlotBusy):  # third concurrent job: full
+                slot2(lambda *a, **k: ({}, {}))
+            return {}, {}
+
+        slot2(inner, model_name=None)  # second concurrent job: admitted
+        return {}, {}
+
+    slot2(two_deep, model_name=None)
 
 
 def test_rng_determinism():
@@ -101,3 +115,28 @@ def test_lru_cache_eviction_and_stats():
     budget.get_or_create("x", lambda: "x", size_bytes=60)
     budget.get_or_create("y", lambda: "y", size_bytes=60)  # evicts x
     assert budget.stats["bytes"] == 60
+
+
+def test_depth2_slot_runs_two_jobs_concurrently():
+    """The serving overlap mechanism: two blocking jobs must be able to
+    execute on ONE slot at the same time (each waits on a barrier only
+    the other can release)."""
+    import threading
+
+    slot = ChipPool(n_slots=1, depth=2).slots[0]
+    barrier = threading.Barrier(2, timeout=30)
+    results = []
+
+    def job(s, model_name, seed=None, **kw):
+        barrier.wait()  # deadlocks unless both jobs are in flight
+        return {}, {"ok": True}
+
+    def run():
+        results.append(slot(job, model_name=None))
+
+    t1 = threading.Thread(target=run)
+    t2 = threading.Thread(target=run)
+    t1.start(); t2.start()
+    t1.join(60); t2.join(60)
+    assert len(results) == 2
+    assert all(cfg["ok"] for _, cfg in results)

@@ -88,16 +88,27 @@ def test_inpaint_keeps_known_region(tiny_pipeline):
     img, config = tiny_pipeline(req)
     assert config["mode"] == "inpaint"
 
-    # an all-keep mask must reproduce the VAE roundtrip of the init image
-    keep_all, _ = tiny_pipeline(GenerateRequest(
+    # the kept region is re-projected from the KNOWN latents every step,
+    # so with an all-keep mask the model's prediction is fully discarded:
+    # the prompt must have NO effect on the output (luck-free property —
+    # the tiny family's random VAE makes pixel-distance checks noise)
+    keep_a, _ = tiny_pipeline(GenerateRequest(
         prompt="x", steps=5, height=64, width=64, seed=9, init_image=init,
         mask=np.zeros((64, 64), np.float32), guidance_scale=1.0))
-    regen_all, _ = tiny_pipeline(GenerateRequest(
+    keep_b, _ = tiny_pipeline(GenerateRequest(
+        prompt="a completely different prompt", steps=5, height=64,
+        width=64, seed=9, init_image=init,
+        mask=np.zeros((64, 64), np.float32), guidance_scale=1.0))
+    assert np.array_equal(keep_a, keep_b)
+    # ...while an all-regenerate mask must respond to the prompt
+    regen_a, _ = tiny_pipeline(GenerateRequest(
         prompt="x", steps=5, height=64, width=64, seed=9, init_image=init,
         mask=np.ones((64, 64), np.float32), guidance_scale=1.0))
-    d_keep = np.abs(keep_all.astype(int) - init.astype(int)).mean()
-    d_regen = np.abs(regen_all.astype(int) - init.astype(int)).mean()
-    assert d_keep < d_regen
+    regen_b, _ = tiny_pipeline(GenerateRequest(
+        prompt="a completely different prompt", steps=5, height=64,
+        width=64, seed=9, init_image=init,
+        mask=np.ones((64, 64), np.float32), guidance_scale=1.0))
+    assert not np.array_equal(regen_a, regen_b)
 
 
 def test_sdxl_family_pipeline(tiny_xl_pipeline):
@@ -120,3 +131,31 @@ def test_scheduler_name_routing(tiny_pipeline):
 
 def test_components_param_bytes(tiny_pipeline):
     assert tiny_pipeline.c.param_bytes() > 10_000
+
+
+def test_sample_rows_are_batch_size_invariant():
+    """Row b of a batched generation must equal the image generated at
+    batch=1 with the same seed (per-sample noise keys fold the row index
+    into the job seed) — the invariant that makes batch bucketing and any
+    future job coalescing transparent to users."""
+    from chiaswarm_tpu.pipelines import (
+        Components,
+        DiffusionPipeline,
+        GenerateRequest,
+    )
+
+    pipe = DiffusionPipeline(Components.random("tiny", seed=0))
+    solo, _ = pipe(GenerateRequest(prompt="a fish", steps=2, height=64,
+                                   width=64, batch=1, seed=21,
+                                   guidance_scale=5.0))
+    batched, _ = pipe(GenerateRequest(prompt="a fish", steps=2, height=64,
+                                      width=64, batch=3, seed=21,
+                                      guidance_scale=5.0))
+    # bitwise equality across DIFFERENT compiled programs is not
+    # guaranteed (XLA reassociates float reductions per batch shape);
+    # the noise streams are identical, so rows agree to quantization
+    diff = np.abs(batched[0].astype(int) - solo[0].astype(int))
+    assert diff.max() <= 3 and (diff <= 1).mean() > 0.99, (
+        diff.max(), (diff <= 1).mean())
+    # rows differ from each other (independent noise streams)
+    assert not np.array_equal(batched[0], batched[1])

@@ -67,6 +67,14 @@ class MeshSlot:
     def identifier(self) -> str:
         return f"tpu-slot:{self.index}"
 
+    @property
+    def data_width(self) -> int:
+        """Size of the mesh's ``data`` axis (1 when absent) — how many
+        batch rows execute in parallel; drives queue sizing and the
+        cross-job coalescing burst size (node/worker.py)."""
+        return int(dict(zip(self.mesh.axis_names,
+                            self.mesh.devices.shape)).get("data", 1))
+
     def descriptor(self) -> dict[str, Any]:
         devices = self.mesh.devices.flatten().tolist()
         dev0 = devices[0]
@@ -98,6 +106,21 @@ class MeshSlot:
             config = dict(config)
             config["seed"] = seed
             return artifacts, config
+        finally:
+            self._slots_free.release()
+
+    def call_multi(self, callback: Callable[..., list], **kwargs) -> list:
+        """``__call__`` variant for coalesced callbacks that return a
+        LIST of per-job (artifacts, config) — per-job seeds ride inside
+        ``kwargs["jobs"]`` and each config already records its own seed
+        (node/executor.py::synchronous_do_work_batch)."""
+        if not self._slots_free.acquire(blocking=False):
+            raise SlotBusy(f"{self.identifier} is busy")
+        try:
+            model_name = kwargs.pop("model_name", None)
+            seed = int(kwargs.pop("seed", 0))
+            outs = callback(self, model_name, seed=seed, **kwargs)
+            return [(artifacts, dict(config)) for artifacts, config in outs]
         finally:
             self._slots_free.release()
 

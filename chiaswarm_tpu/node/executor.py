@@ -42,6 +42,14 @@ async def do_work(job: dict[str, Any], slot, registry: ModelRegistry) -> dict:
     )
 
 
+async def do_work_batch(jobs: list[dict[str, Any]], slot,
+                        registry: ModelRegistry) -> list[dict]:
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, synchronous_do_work_batch, jobs, slot, registry
+    )
+
+
 def _error_payload(exc: Exception, content_type: str) -> tuple[dict, dict]:
     message = exc.args[0] if exc.args else "error generating result"
     message = str(message)
@@ -102,20 +110,21 @@ def _maybe_profile(job_id):
         _PROFILE_LOCK.release()
 
 
-def synchronous_do_work(job: dict[str, Any], slot,
-                        registry: ModelRegistry) -> dict[str, Any]:
+def _format(job: dict[str, Any], registry: ModelRegistry):
+    """-> (job_id, content_type, callback, kwargs) or a fatal result."""
     job = dict(job)
     job_id = job.pop("id", None)
     content_type = job.get("content_type", "image/jpeg")
-    log.info("processing job %s", job_id)
-
     try:
         callback, kwargs = format_args(job, registry)
     except Exception as exc:  # bad inputs: fatal, do not redispatch
         log.warning("job %s failed formatting: %s", job_id, exc)
         artifacts, config = _error_payload(exc, content_type)
-        return _result(job_id, artifacts, config, fatal=True)
+        return None, _result(job_id, artifacts, config, fatal=True)
+    return (job_id, content_type, callback, kwargs), None
 
+
+def _execute(job_id, content_type, callback, kwargs, slot) -> dict:
     try:
         with _maybe_profile(job_id):
             artifacts, config = slot(callback, **kwargs)
@@ -127,5 +136,104 @@ def synchronous_do_work(job: dict[str, Any], slot,
         log.exception("job %s errored", job_id)
         artifacts, config = _error_payload(exc, content_type)
         return _result(job_id, artifacts, config)
-
     return _result(job_id, artifacts, config)
+
+
+def synchronous_do_work(job: dict[str, Any], slot,
+                        registry: ModelRegistry) -> dict[str, Any]:
+    log.info("processing job %s", job.get("id"))
+    formatted, fatal = _format(job, registry)
+    if formatted is None:
+        return fatal
+    return _execute(*formatted, slot)
+
+
+def _coalesce_key(kwargs: dict[str, Any]):
+    from chiaswarm_tpu.workloads.diffusion import COALESCE_KEYS
+
+    return ((kwargs.get("model_name"),)
+            + tuple(repr(kwargs.get(k)) for k in COALESCE_KEYS))
+
+
+def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
+                              registry: ModelRegistry) -> list[dict]:
+    """Run a burst of jobs, coalescing compatible txt2img jobs into ONE
+    batched program (workloads/diffusion.py::diffusion_coalesced_callback)
+    — the dp-mesh efficiency path with no reference analog. Jobs that
+    cannot coalesce (different static params, image inputs, non-diffusion
+    workflows) run through the normal per-job path; a failed coalesced
+    run falls back to per-job execution."""
+    from chiaswarm_tpu.core.rng import draw_seed
+    from chiaswarm_tpu.workloads.diffusion import (
+        coalescable,
+        diffusion_callback,
+        diffusion_coalesced_callback,
+    )
+
+    if len(jobs) == 1:
+        return [synchronous_do_work(jobs[0], slot, registry)]
+
+    results: list[dict | None] = [None] * len(jobs)
+    groups: dict[Any, list[tuple[int, Any, str, dict]]] = {}
+    singles: list[tuple[int, Any, str, Any, dict]] = []
+    for i, job in enumerate(jobs):
+        log.info("processing job %s (burst of %d)", job.get("id"),
+                 len(jobs))
+        formatted, fatal = _format(job, registry)
+        if formatted is None:
+            results[i] = fatal
+            continue
+        job_id, content_type, callback, kwargs = formatted
+        if callback is diffusion_callback and coalescable(kwargs):
+            groups.setdefault(_coalesce_key(kwargs), []).append(
+                (i, job_id, content_type, kwargs))
+        else:
+            singles.append((i, job_id, content_type, callback, kwargs))
+
+    for key, group in groups.items():
+        if len(group) == 1:
+            i, job_id, content_type, kwargs = group[0]
+            singles.append((i, job_id, content_type, diffusion_callback,
+                            kwargs))
+            continue
+        from chiaswarm_tpu.workloads.diffusion import COALESCE_KEYS
+
+        kwargs0 = group[0][3]
+        shared = {k: kwargs0.get(k) for k in COALESCE_KEYS}
+        per_job = []
+        for i, job_id, content_type, kwargs in group:
+            seed = kwargs.get("seed")  # 0 is a valid pinned seed
+            per_job.append({
+                "prompt": kwargs.get("prompt"),
+                "negative_prompt": kwargs.get("negative_prompt"),
+                "num_images_per_prompt":
+                    kwargs.get("num_images_per_prompt", 1),
+                "seed": draw_seed() if seed is None else int(seed),
+                "content_type": content_type,
+            })
+        ids = [job_id for _, job_id, _, _ in group]
+        try:
+            with _maybe_profile(f"coalesced-{ids[0]}"):
+                outs = slot.call_multi(
+                    diffusion_coalesced_callback,
+                    model_name=kwargs0.get("model_name"),
+                    seed=per_job[0]["seed"],
+                    registry=registry, jobs=per_job, **shared)
+            if len(outs) != len(group):  # never silently drop a job
+                raise RuntimeError(
+                    f"coalesced callback returned {len(outs)} results "
+                    f"for {len(group)} jobs")
+            log.info("coalesced %d jobs onto one program: %s",
+                     len(group), ids)
+            for (i, job_id, _, _), (artifacts, config) in zip(group, outs):
+                results[i] = _result(job_id, artifacts, config)
+        except Exception as exc:
+            log.warning("coalesced run %s failed (%s); falling back to "
+                        "per-job execution", ids, exc)
+            for i, job_id, content_type, kwargs in group:
+                singles.append((i, job_id, content_type,
+                                diffusion_callback, kwargs))
+
+    for i, job_id, content_type, callback, kwargs in singles:
+        results[i] = _execute(job_id, content_type, callback, kwargs, slot)
+    return [r for r in results if r is not None]

@@ -22,7 +22,7 @@ import aiohttp
 import jax
 
 from chiaswarm_tpu.core.chip_pool import ChipPool
-from chiaswarm_tpu.node.executor import do_work
+from chiaswarm_tpu.node.executor import do_work, do_work_batch
 from chiaswarm_tpu.node.hive import (
     POLL_BUSY_S,
     POLL_ERROR_S,
@@ -57,13 +57,13 @@ class Worker:
             self.settings.hive_uri, self.settings.hive_token,
             self.settings.worker_name,
         )
-        # queue bound = total in-flight capacity (slots x pipeline depth):
-        # the reference sizes its queue to the GPU count (worker.py:186);
-        # depth-2 slots keep one extra job ready so its dispatch overlaps
-        # the previous job's device->host transfer (core/chip_pool.py)
-        depth = max(getattr(slot, "depth", 1) for slot in self.pool)
+        # queue bound = total in-flight capacity: per slot, the larger of
+        # its pipeline depth (transfer/compute overlap) and its data-axis
+        # width (cross-job coalescing needs that many jobs queued). The
+        # reference sizes its queue to the GPU count (worker.py:186).
         self.work_queue: asyncio.Queue = asyncio.Queue(
-            maxsize=len(self.pool) * depth)
+            maxsize=sum(max(getattr(slot, "depth", 1), slot.data_width)
+                        for slot in self.pool))
         self.result_queue: asyncio.Queue = asyncio.Queue()
         self._stop = asyncio.Event()
         self.jobs_done = 0
@@ -200,23 +200,41 @@ class Worker:
         just avoids pulling queue items nothing can run yet."""
         inflight = asyncio.Semaphore(max(1, getattr(slot, "depth", 1)))
         pending: set[asyncio.Task] = set()
+        # cross-job coalescing: a dp-sharded slot runs up to dp compatible
+        # jobs as ONE batched program (executor groups them; incompatible
+        # jobs in a burst just run serially). Single-data-row slots gain
+        # nothing (batch scaling is linear on one chip) and multi-slot
+        # pools must not greedily drain jobs another idle slot could run,
+        # so the burst drain is limited to single-slot dp pools.
+        max_merge = slot.data_width if len(self.pool) == 1 else 1
 
-        async def run_one(job) -> None:
+        async def run_burst(burst: list[dict]) -> None:
             try:
-                result = await do_work(job, slot, self.registry)
-                await self.result_queue.put(result)
-                self.jobs_done += 1
+                if len(burst) == 1:
+                    results = [await do_work(burst[0], slot, self.registry)]
+                else:
+                    results = await do_work_batch(burst, slot,
+                                                  self.registry)
+                for result in results:
+                    await self.result_queue.put(result)
+                    self.jobs_done += 1
             except Exception as exc:  # keep the loop alive, always
                 log.exception("slot worker error: %s", exc)
             finally:
                 inflight.release()
-                self.work_queue.task_done()
+                for _ in burst:
+                    self.work_queue.task_done()
 
         try:
             while True:
                 await inflight.acquire()
-                job = await self.work_queue.get()
-                task = asyncio.create_task(run_one(job))
+                burst = [await self.work_queue.get()]
+                while len(burst) < max_merge:
+                    try:
+                        burst.append(self.work_queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                task = asyncio.create_task(run_burst(burst))
                 pending.add(task)
                 task.add_done_callback(pending.discard)
         finally:

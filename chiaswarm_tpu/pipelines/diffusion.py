@@ -54,10 +54,19 @@ from chiaswarm_tpu.schedulers.sampling import init_sampler_state
 
 @dataclasses.dataclass(frozen=True)
 class GenerateRequest:
-    """One generation request (pre-normalized by the node dispatcher)."""
+    """One generation request (pre-normalized by the node dispatcher).
 
-    prompt: str
-    negative_prompt: str = ""
+    ``prompt``/``negative_prompt`` may be a tuple of per-ROW prompts
+    (length == ``batch``) — the coalesced-jobs path rides different
+    hive jobs on one batched program (node/executor.py). When
+    ``sample_seed_rows`` is set, row b's noise key is
+    ``fold_in(key_for_seed(seed_b), row_b)`` — exactly what that row
+    would get in its own solo job — instead of deriving every row from
+    ``seed``.
+    """
+
+    prompt: str | tuple[str, ...]
+    negative_prompt: str | tuple[str, ...] = ""
     steps: int = 30
     guidance_scale: float = 7.5
     height: int = 512
@@ -65,6 +74,8 @@ class GenerateRequest:
     batch: int = 1
     seed: int = 0
     scheduler: str | None = None  # diffusers class name from the hive
+    # per-row (seed, row-index) pairs, length == batch (coalesced jobs)
+    sample_seed_rows: tuple[tuple[int, int], ...] | None = None
     # img2img / inpaint
     init_image: np.ndarray | None = None   # (H, W, 3) uint8 or float [-1,1]
     strength: float = 0.8
@@ -476,9 +487,18 @@ class DiffusionPipeline:
             control_cond = jnp.asarray(np.clip(cond, 0.0, 1.0))[None]
             control_params = req.controlnet.params
 
-        ids = [jnp.asarray(i) for i in self._tokenize([req.prompt] * batch)]
+        def rows(value: str | tuple[str, ...]) -> list[str]:
+            vals = (list(value) if isinstance(value, (tuple, list))
+                    else [value or ""] * req.batch)
+            if len(vals) != req.batch:
+                raise ValueError(
+                    f"{len(vals)} per-row prompts for batch {req.batch}")
+            # pad to the compile bucket by repeating the last row
+            return vals + [vals[-1]] * (batch - len(vals))
+
+        ids = [jnp.asarray(i) for i in self._tokenize(rows(req.prompt))]
         neg = [jnp.asarray(i) for i in
-               self._tokenize([req.negative_prompt or ""] * batch)]
+               self._tokenize(rows(req.negative_prompt))]
 
         # data parallelism: when the params live on a dp x tp mesh, seed
         # GSPMD's batch-dim propagation by placing the token inputs (and a
@@ -505,10 +525,17 @@ class DiffusionPipeline:
             has_control=has_control,
         )
         # one independent key per batch row: fold the row index into the
-        # job seed, so row b is reproducible at ANY batch size
-        base_key = key_for_seed(req.seed)
-        sample_keys = jax.vmap(
-            lambda i: jax.random.fold_in(base_key, i))(jnp.arange(batch))
+        # row's seed, so row b is reproducible at ANY batch size (and a
+        # coalesced job's rows match what its solo run would produce)
+        pairs = (list(req.sample_seed_rows) if req.sample_seed_rows
+                 else [(req.seed, i) for i in range(req.batch)])
+        if len(pairs) != req.batch:
+            raise ValueError(
+                f"{len(pairs)} sample_seed_rows for batch {req.batch}")
+        pairs += [pairs[-1]] * (batch - len(pairs))  # bucket padding
+        sample_keys = jnp.stack(
+            [jax.random.fold_in(key_for_seed(int(s)), int(r))
+             for s, r in pairs])
         img = fn(
             self.c.params,
             ids,

@@ -154,3 +154,113 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
     })
     return artifacts, config
+
+
+# ---- cross-job coalescing (no reference analog) -----------------------
+#
+# A dp-sharded mesh slot replicates a batch=1 job on every data row —
+# (dp-1)/dp of the slot does duplicate work. Compatible txt2img jobs
+# (same model/size/steps/guidance/scheduler/adapters, no input images)
+# instead ride ONE batched program: per-row prompts and per-row
+# (seed, row) noise keys keep every job's images identical to its solo
+# run (pipelines/diffusion.py sample_seed_rows). The executor groups
+# queue bursts by COALESCE_KEYS (node/executor.py).
+
+COALESCE_KEYS = ("num_inference_steps", "guidance_scale", "height",
+                 "width", "scheduler_type", "textual_inversion", "lora",
+                 "cross_attention_scale")
+_UNCOALESCABLE = ("image", "mask_image", "controlnet_model_name",
+                  "image_guidance_scale")
+
+
+def coalescable(kwargs: dict[str, Any]) -> bool:
+    # upscale jobs run their x2 pass with the job's OWN prompt/seed —
+    # batching them would condition every job on job 0's; keep them solo
+    return (not kwargs.get("upscale")
+            and all(kwargs.get(k) is None for k in _UNCOALESCABLE))
+
+
+def diffusion_coalesced_callback(slot, model_name: str, *, seed: int,
+                                 registry: ModelRegistry,
+                                 jobs: list[dict[str, Any]],
+                                 **shared: Any):
+    """Run several compatible jobs as one batched program.
+
+    ``jobs`` carries each job's per-row fields ({prompt, negative_prompt,
+    num_images_per_prompt, seed, content_type}); ``shared`` carries the
+    COALESCE_KEYS the executor verified equal. Returns a LIST of
+    per-job (artifacts, config) in input order."""
+    first = jobs[0]
+    prompts: list[str] = []
+    negs: list[str] = []
+    seed_rows: list[tuple[int, int]] = []
+    counts: list[int] = []
+    for job in jobs:
+        n = max(1, int(job.get("num_images_per_prompt", 1)))
+        prompts += [str(job.get("prompt") or "")] * n
+        negs += [str(job.get("negative_prompt") or "")] * n
+        seed_rows += [(int(job["seed"]), r) for r in range(n)]
+        counts.append(n)
+
+    def opt(key: str, default):
+        value = shared.get(key)  # present-but-None means "use default"
+        return default if value is None else value
+
+    pipe = registry.pipeline(
+        model_name,
+        textual_inversion=shared.get("textual_inversion"),
+        lora=shared.get("lora"),
+        lora_scale=opt("cross_attention_scale", 1.0),
+        mesh=getattr(slot, "mesh", None))
+    fam = pipe.c.family
+    height = int(opt("height", fam.default_size))
+    width = int(opt("width", fam.default_size))
+
+    req = GenerateRequest(
+        prompt=tuple(prompts),
+        negative_prompt=tuple(negs),
+        steps=int(opt("num_inference_steps", 30)),
+        guidance_scale=float(opt("guidance_scale", 7.5)),
+        height=height,
+        width=width,
+        batch=len(prompts),
+        seed=int(first["seed"]),
+        sample_seed_rows=tuple(seed_rows),
+        scheduler=shared.get("scheduler_type"),
+        tiled_decode=max(height, width) > 1024,
+    )
+    t0 = time.perf_counter()
+    images, base_config = pipe(req)
+    elapsed = time.perf_counter() - t0
+
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    results = []
+    offset = 0
+    for job, n in zip(jobs, counts):
+        imgs = images[offset:offset + n]
+        offset += n
+        proc = OutputProcessor(job.get("content_type", "image/png"))
+        proc.add_images(imgs)
+        config = dict(base_config)
+        config["seed"] = int(job["seed"])
+        config["batch"] = n
+        # same adapter metadata the solo path records
+        if shared.get("textual_inversion") is not None:
+            config["textual_inversion"] = shared["textual_inversion"]
+        if shared.get("lora") is not None:
+            config["lora"] = shared["lora"]
+            config["cross_attention_scale"] = float(
+                opt("cross_attention_scale", 1.0))
+        _, safety_fields = check_images(imgs, model_name)
+        config.update(safety_fields)
+        config.update({
+            "coalesced": len(jobs),
+            "images_per_sec": round(
+                images.shape[0] / max(elapsed, 1e-9), 4),
+            "generation_s": round(elapsed, 3),
+            "slot": (slot.descriptor() if hasattr(slot, "descriptor")
+                     else str(slot)),
+        })
+        results.append((proc.get_results(), config))
+    return results

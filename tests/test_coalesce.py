@@ -1,0 +1,153 @@
+"""Cross-job coalescing: compatible txt2img jobs ride one batched program.
+
+No reference analog — this is the dp-mesh efficiency path: a data-sharded
+slot replicates a batch=1 job on every data row, so merging compatible
+jobs into one batched program is what makes multi-chip slots earn their
+chips (node/executor.py::synchronous_do_work_batch,
+workloads/diffusion.py::diffusion_coalesced_callback). Per-sample
+(seed, row) noise keys guarantee each job's images match its solo run.
+
+Runs on the virtual 8-device CPU mesh (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.core.chip_pool import ChipPool
+from chiaswarm_tpu.core.mesh import MeshSpec
+from chiaswarm_tpu.node.executor import (
+    synchronous_do_work,
+    synchronous_do_work_batch,
+)
+from chiaswarm_tpu.node.registry import ModelRegistry
+
+
+@pytest.fixture()
+def registry():
+    return ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True,
+    )
+
+
+def _job(i: int, **over):
+    job = {"id": f"j{i}", "model_name": "tiny", "prompt": f"prompt {i}",
+           "seed": 100 + i, "num_inference_steps": 2,
+           "height": 64, "width": 64, "content_type": "image/png"}
+    job.update(over)
+    return job
+
+
+def test_burst_coalesces_and_matches_solo(registry):
+    """Three compatible jobs coalesce onto one program; each job's image
+    agrees with its solo run (same seed) to uint8 quantization."""
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    slot = pool.slots[0]
+    jobs = [_job(0), _job(1), _job(2)]
+    results = synchronous_do_work_batch(jobs, slot, registry)
+    assert [r["id"] for r in results] == ["j0", "j1", "j2"]
+    for r in results:
+        assert "fatal_error" not in r
+        assert r["pipeline_config"]["coalesced"] == 3
+        assert r["pipeline_config"]["seed"] in (100, 101, 102)
+
+    import base64
+    import io
+
+    from PIL import Image
+
+    solo = synchronous_do_work(_job(1), slot, registry)
+    solo_img = np.asarray(Image.open(io.BytesIO(
+        base64.b64decode(solo["artifacts"]["primary"]["blob"]))))
+    co_img = np.asarray(Image.open(io.BytesIO(
+        base64.b64decode(results[1]["artifacts"]["primary"]["blob"]))))
+    diff = np.abs(co_img.astype(int) - solo_img.astype(int))
+    # different compiled batch shapes: agreement to quantization, not bits
+    assert diff.max() <= 3 and (diff <= 1).mean() > 0.99, (
+        diff.max(), (diff <= 1).mean())
+
+
+def test_incompatible_jobs_run_separately(registry):
+    """A burst with mixed static params: the two compatible jobs coalesce,
+    the odd one (different steps) runs alone; all ids come back."""
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    slot = pool.slots[0]
+    jobs = [_job(0), _job(1, num_inference_steps=3), _job(2)]
+    results = synchronous_do_work_batch(jobs, slot, registry)
+    by_id = {r["id"]: r for r in results}
+    assert set(by_id) == {"j0", "j1", "j2"}
+    assert by_id["j0"]["pipeline_config"]["coalesced"] == 2
+    assert by_id["j2"]["pipeline_config"]["coalesced"] == 2
+    assert "coalesced" not in by_id["j1"]["pipeline_config"]
+
+
+def test_image_jobs_are_never_coalesced(registry):
+    """img2img carries an input image — must take the per-job path."""
+    rng = np.random.default_rng(0)
+    init = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    jobs = [_job(0), _job(1, image=init, strength=0.6)]
+    results = synchronous_do_work_batch(jobs, pool.slots[0], registry)
+    by_id = {r["id"]: r for r in results}
+    assert "coalesced" not in by_id["j0"]["pipeline_config"]
+    assert "coalesced" not in by_id["j1"]["pipeline_config"]
+    assert by_id["j1"]["pipeline_config"]["mode"] == "img2img"
+
+
+def test_burst_with_formatting_error_still_returns_all(registry):
+    jobs = [_job(0), _job(1, height=9999, width=9999), _job(2)]
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    results = synchronous_do_work_batch(jobs, pool.slots[0], registry)
+    by_id = {r["id"]: r for r in results}
+    assert set(by_id) == {"j0", "j1", "j2"}
+    assert by_id["j1"]["fatal_error"] is True
+    assert by_id["j0"]["pipeline_config"]["coalesced"] == 2
+
+
+def test_worker_coalesces_queue_burst(registry):
+    """Full worker loop on a dp=4 mesh slot: a burst of four compatible
+    jobs arrives in one poll; the slot merges them into one program
+    (every result reports coalesced=4)."""
+    import asyncio
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+
+    from chiaswarm_tpu.node.settings import Settings
+    from chiaswarm_tpu.node.worker import Worker
+
+    async def main():
+        hive = FakeHive()
+        await hive.start()
+        for i in range(4):
+            hive.jobs.append(_job(i))
+        pool = ChipPool(n_slots=1,
+                        mesh_spec=MeshSpec({"data": 4, "model": 2}))
+        assert pool.slots[0].mesh.devices.size == 8
+        worker = Worker(
+            settings=Settings(hive_uri=hive.uri, hive_token="t",
+                              worker_name="coalesce-test"),
+            registry=registry, pool=pool)
+        assert worker.work_queue.maxsize == 4  # data-axis capacity
+        task = asyncio.create_task(worker.run())
+        await hive.wait_for_results(4, timeout=300)
+        worker.request_stop()
+        try:
+            await asyncio.wait_for(task, timeout=20)
+        except asyncio.TimeoutError:
+            task.cancel()
+        await hive.stop()
+        assert sorted(r["id"] for r in hive.results) == \
+            ["j0", "j1", "j2", "j3"]
+        merged = [r["pipeline_config"].get("coalesced")
+                  for r in hive.results]
+        # the poll delivers all four before the slot picks them up, so at
+        # least some (normally all) coalesce; none may fail
+        assert all(r["pipeline_config"].get("error") is None
+                   for r in hive.results)
+        assert any(m and m >= 2 for m in merged), merged
+
+    asyncio.run(main())

@@ -109,7 +109,7 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         size = 512 if on_tpu else 64
         results["sd15_txt2img_512_ddim20"] = _bench_diffusion(
             pipe, size=size, steps=20 if on_tpu else 2, batch=1,
-            iters=iters, scheduler="ddim")
+            iters=iters, scheduler="ddim", pipelined=True)
         del pipe
 
     if "sd21" in names:
@@ -121,7 +121,7 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         init = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
         results["sd21_img2img_512"] = _bench_diffusion(
             pipe, size=size, steps=steps, batch=1, iters=iters,
-            init_image=init)
+            init_image=init, pipelined=True)
         half_mask = np.zeros((size, size), np.float32)
         half_mask[size // 2:] = 1.0
         results["sd21_inpaint_512"] = _bench_diffusion(

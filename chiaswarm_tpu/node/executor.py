@@ -209,7 +209,10 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                 "num_images_per_prompt":
                     kwargs.get("num_images_per_prompt", 1),
                 "seed": draw_seed() if seed is None else int(seed),
-                "content_type": content_type,
+                # solo-equivalence: an absent content_type must hit the
+                # same default the solo callback uses (image/png), NOT
+                # _format's error-payload jpeg default
+                "content_type": kwargs.get("content_type", "image/png"),
             })
         ids = [job_id for _, job_id, _, _ in group]
         try:

@@ -37,6 +37,31 @@ from chiaswarm_tpu.node.settings import Settings, load_settings
 log = logging.getLogger("chiaswarm.worker")
 
 
+def _burst_key(job: dict) -> tuple | None:
+    """Cheap raw-job coalescability key (None = never coalesce).
+
+    Conservative pre-filter for the slot burst drain: only plain txt2img
+    jobs with identical static fields are drained together — the
+    executor's precise post-formatting grouping
+    (node/executor.py::synchronous_do_work_batch) is the authority; this
+    just keeps non-coalescable traffic on the per-job path so its
+    results upload as soon as each job finishes."""
+    if job.get("workflow") not in (None, "", "txt2img"):
+        return None
+    if job.get("start_image_uri") or job.get("mask_image_uri") \
+            or job.get("image") is not None:
+        return None
+    model = str(job.get("model_name", ""))
+    if model.startswith("DeepFloyd/"):
+        return None
+    params = job.get("parameters") or {}
+    if params.get("controlnet") or params.get("upscale"):
+        return None
+    return (model, job.get("height"), job.get("width"),
+            job.get("num_inference_steps"), job.get("guidance_scale"),
+            repr(sorted(params.items())))
+
+
 class Worker:
     """One node process: N mesh-slot executors + poll/upload tasks.
 
@@ -61,9 +86,15 @@ class Worker:
         # its pipeline depth (transfer/compute overlap) and its data-axis
         # width (cross-job coalescing needs that many jobs queued). The
         # reference sizes its queue to the GPU count (worker.py:186).
+        # coalescing (and therefore data_width-sized bursts) only runs on
+        # single-slot pools (_slot_worker); multi-slot pools must not
+        # over-claim jobs no slot can batch
+        coalescing = len(self.pool) == 1
         self.work_queue: asyncio.Queue = asyncio.Queue(
-            maxsize=sum(max(getattr(slot, "depth", 1), slot.data_width)
-                        for slot in self.pool))
+            maxsize=sum(
+                max(getattr(slot, "depth", 1),
+                    slot.data_width if coalescing else 1)
+                for slot in self.pool))
         self.result_queue: asyncio.Queue = asyncio.Queue()
         self._stop = asyncio.Event()
         self.jobs_done = 0
@@ -229,10 +260,21 @@ class Worker:
             while True:
                 await inflight.acquire()
                 burst = [await self.work_queue.get()]
-                while len(burst) < max_merge:
+                key = _burst_key(burst[0])
+                while key is not None and len(burst) < max_merge:
                     try:
-                        burst.append(self.work_queue.get_nowait())
+                        candidate = self.work_queue.get_nowait()
                     except asyncio.QueueEmpty:
+                        break
+                    if _burst_key(candidate) == key:
+                        burst.append(candidate)
+                    else:
+                        # put the mismatch back (tail position — order
+                        # between independent jobs is not significant)
+                        # and stop: non-coalescable traffic must keep
+                        # the per-job depth-2 path and its prompt upload
+                        self.work_queue.put_nowait(candidate)
+                        self.work_queue.task_done()
                         break
                 task = asyncio.create_task(run_burst(burst))
                 pending.add(task)

@@ -256,7 +256,11 @@ def diffusion_coalesced_callback(slot, model_name: str, *, seed: int,
         config.update(safety_fields)
         config.update({
             "coalesced": len(jobs),
-            "images_per_sec": round(
+            # per-job number keeps solo semantics (this job's images over
+            # this job's wall time); the whole program's throughput is
+            # reported separately so aggregators do not k-fold overcount
+            "images_per_sec": round(n / max(elapsed, 1e-9), 4),
+            "batch_images_per_sec": round(
                 images.shape[0] / max(elapsed, 1e-9), 4),
             "generation_s": round(elapsed, 3),
             "slot": (slot.descriptor() if hasattr(slot, "descriptor")

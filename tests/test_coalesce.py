@@ -151,3 +151,45 @@ def test_worker_coalesces_queue_burst(registry):
         assert any(m and m >= 2 for m in merged), merged
 
     asyncio.run(main())
+
+
+def test_burst_key_prefilter():
+    """The worker's raw-job drain filter: only plain txt2img jobs with
+    identical static fields share a burst key."""
+    from chiaswarm_tpu.node.worker import _burst_key
+
+    a = _job(0)
+    b = _job(1)
+    assert _burst_key(a) is not None
+    assert _burst_key(a) == _burst_key(b)
+    assert _burst_key(_job(2, num_inference_steps=9)) != _burst_key(a)
+    assert _burst_key(_job(3, workflow="txt2vid")) is None
+    assert _burst_key(_job(4, start_image_uri="http://x/i.png")) is None
+    assert _burst_key(_job(5, model_name="DeepFloyd/IF-I-XL-v1.0")) is None
+    assert _burst_key(
+        _job(6, parameters={"controlnet": {"type": "canny"}})) is None
+    assert _burst_key(_job(7, parameters={"upscale": True})) is None
+
+
+def test_coalesced_default_content_type_is_png(registry):
+    """Solo-equivalence of encoding: a job without content_type must come
+    back PNG from the coalesced path (the solo callback's default), not
+    the executor's jpeg error default."""
+    import base64
+
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    jobs = []
+    for i in range(2):
+        job = _job(i)
+        job.pop("content_type")
+        jobs.append(job)
+    results = synchronous_do_work_batch(jobs, pool.slots[0], registry)
+    for r in results:
+        assert r["pipeline_config"]["coalesced"] == 2
+        assert r["artifacts"]["primary"]["content_type"] == "image/png"
+        raw = base64.b64decode(r["artifacts"]["primary"]["blob"])
+        assert raw.startswith(b"\x89PNG")
+        # per-job throughput keeps solo semantics; program total reported
+        # separately
+        cfg = r["pipeline_config"]
+        assert cfg["batch_images_per_sec"] >= cfg["images_per_sec"]

@@ -59,6 +59,8 @@ def _burst_key(job: dict) -> tuple | None:
         return None
     return (model, job.get("height"), job.get("width"),
             job.get("num_inference_steps"), job.get("guidance_scale"),
+            job.get("lora"), job.get("textual_inversion"),
+            job.get("cross_attention_scale"),
             repr(sorted(params.items())))
 
 

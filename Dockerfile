@@ -16,13 +16,10 @@ COPY chiaswarm_tpu ./chiaswarm_tpu
 COPY csrc ./csrc
 COPY bench.py ./
 
-# jax[tpu] resolves libtpu for TPU VMs; on other hosts the CPU backend runs
-RUN pip install --no-cache-dir "jax[tpu]" \
-        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    && pip install --no-cache-dir flax optax orbax-checkpoint einops \
-        pillow opencv-python-headless requests aiohttp safetensors \
-        tokenizers \
-    && pip install --no-cache-dir -e . --no-deps
+# deps come from pyproject.toml; the [tpu] extra resolves libtpu for TPU
+# VMs (on other hosts the base jax wheel's CPU backend runs)
+RUN pip install --no-cache-dir -e ".[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 
 # pre-build the native artifact codec (chiaswarm_tpu/native builds it on
 # first use otherwise)

@@ -36,12 +36,8 @@ python -m venv $venvDir
 & "$venvDir\Scripts\Activate.ps1"
 python -m pip install --upgrade pip | Out-Null
 
-Write-Output "==> installing jax (cpu backend) + dependencies"
-pip install jax flax optax orbax-checkpoint einops pillow `
-    opencv-python-headless requests aiohttp safetensors tokenizers pytest
-
-Write-Output "==> installing swarm-tpu (editable)"
-pip install -e . --no-deps
+Write-Output "==> installing swarm-tpu (cpu backend; deps from pyproject.toml)"
+pip install -e ".[cpu,test]"
 
 Write-Output ""
 Write-Output "Install complete. Next steps:"

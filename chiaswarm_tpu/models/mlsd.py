@@ -201,11 +201,13 @@ class MLSDNetwork(nn.Module):
 
 
 def decode_lines(tp_map: np.ndarray, *, score_thr: float = 0.1,
-                 dist_thr: float = 20.0, top_k: int = 200) -> np.ndarray:
+                 dist_thr: float = 0.1, top_k: int = 200) -> np.ndarray:
     """controlnet_aux ``deccode_output_score_and_ptss`` + ``pred_lines``
     semantics on the (H/2, W/2, 9) TP map: sigmoid center heat, 3x3
     local-max NMS, top-K peaks, endpoints = peak +- displacement, kept if
-    score > thr and length > dist_thr. Returns (N, 4) [x1, y1, x2, y2] in
+    score > thr and map-space length > dist_thr (compared directly, like
+    pred_lines; the default 0.1 is MLSDdetector's thr_d, which keeps
+    nearly every scored segment). Returns (N, 4) [x1, y1, x2, y2] in
     FULL-resolution (2x map) coordinates."""
     center = tp_map[:, :, 0]
     disp = tp_map[:, :, 1:5]
@@ -232,7 +234,7 @@ def decode_lines(tp_map: np.ndarray, *, score_thr: float = 0.1,
         dxs, dys, dxe, dye = disp[y, x]
         x1, y1 = x + dxs, y + dys
         x2, y2 = x + dxe, y + dye
-        if np.hypot(x2 - x1, y2 - y1) > dist_thr / 2.0:
+        if np.hypot(x2 - x1, y2 - y1) > dist_thr:
             lines.append((x1 * 2, y1 * 2, x2 * 2, y2 * 2))
     return np.asarray(lines, np.float32).reshape(-1, 4)
 
@@ -266,7 +268,7 @@ class MLSDDetector:
         return cls(params=convert_mlsd(read_torch_weights(path)))
 
     def __call__(self, image: np.ndarray, *, score_thr: float = 0.1,
-                 dist_thr: float = 20.0) -> np.ndarray:
+                 dist_thr: float = 0.1) -> np.ndarray:
         import cv2
 
         h, w = image.shape[:2]

@@ -155,6 +155,39 @@ def _coalesce_key(kwargs: dict[str, Any]):
             + tuple(repr(kwargs.get(k)) for k in COALESCE_KEYS))
 
 
+def _row_chunks(group: list, data_width: int) -> list[list]:
+    """Split a compatible group so one batched program never exceeds the
+    per-device row footprint of its heaviest member's solo run.
+
+    ``num_images_per_prompt`` multiplies batch rows, so bounding by job
+    count alone would let e.g. 4 jobs x 8 images coalesce into a batch-32
+    program — data_width times the per-device memory of any solo run, a
+    likely OOM recovered only after a wasted large-batch compile. Greedy
+    chunking keeps ceil(total_rows / dp) <= ceil(max_member_rows / dp)."""
+    dw = max(1, int(data_width))
+
+    def cap(rows_max: int) -> int:
+        return dw * -(-rows_max // dw)  # dp * ceil(max/dp)
+
+    chunks: list[list] = []
+    cur: list = []
+    cur_rows = cur_max = 0
+    for item in group:
+        try:
+            rows = max(1, int(item[3].get("num_images_per_prompt") or 1))
+        except (TypeError, ValueError):
+            rows = 1  # bad value surfaces per job downstream, not here
+        if cur and cur_rows + rows > cap(max(cur_max, rows)):
+            chunks.append(cur)
+            cur, cur_rows, cur_max = [], 0, 0
+        cur.append(item)
+        cur_rows += rows
+        cur_max = max(cur_max, rows)
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
 def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                               registry: ModelRegistry) -> list[dict]:
     """Run a burst of jobs, coalescing compatible txt2img jobs into ONE
@@ -190,7 +223,10 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
         else:
             singles.append((i, job_id, content_type, callback, kwargs))
 
-    for key, group in groups.items():
+    data_width = max(1, int(getattr(slot, "data_width", 1)))
+    chunked = [chunk for whole in groups.values()
+               for chunk in _row_chunks(whole, data_width)]
+    for group in chunked:
         if len(group) == 1:
             i, job_id, content_type, kwargs = group[0]
             singles.append((i, job_id, content_type, diffusion_callback,

@@ -64,6 +64,14 @@ def _burst_key(job: dict) -> tuple | None:
             repr(sorted(params.items())))
 
 
+def _job_rows(job: dict) -> int:
+    """Batch rows a raw job contributes to a coalesced program."""
+    try:
+        return max(1, int(job.get("num_images_per_prompt") or 1))
+    except (TypeError, ValueError):
+        return 1
+
+
 class Worker:
     """One node process: N mesh-slot executors + poll/upload tasks.
 
@@ -258,30 +266,55 @@ class Worker:
                 for _ in burst:
                     self.work_queue.task_done()
 
+        held: dict | None = None  # mismatched drain candidate, runs next
         try:
             while True:
                 await inflight.acquire()
-                burst = [await self.work_queue.get()]
+                if held is not None:
+                    burst, held = [held], None
+                else:
+                    burst = [await self.work_queue.get()]
                 key = _burst_key(burst[0])
+                rows = rows_max = _job_rows(burst[0])
                 while key is not None and len(burst) < max_merge:
                     try:
                         candidate = self.work_queue.get_nowait()
                     except asyncio.QueueEmpty:
                         break
-                    if _burst_key(candidate) == key:
+                    cand_rows = _job_rows(candidate)
+                    # num_images_per_prompt multiplies batch rows; never
+                    # drain a burst whose total rows exceed what the
+                    # heaviest member's solo run would put per device
+                    # (the executor's _row_chunks is the authority, this
+                    # avoids claiming jobs it would split anyway)
+                    fits = rows + cand_rows <= max_merge * (
+                        -(-max(rows_max, cand_rows) // max_merge))
+                    if _burst_key(candidate) == key and fits:
                         burst.append(candidate)
+                        rows += cand_rows
+                        rows_max = max(rows_max, cand_rows)
                     else:
-                        # put the mismatch back (tail position — order
-                        # between independent jobs is not significant)
-                        # and stop: non-coalescable traffic must keep
-                        # the per-job depth-2 path and its prompt upload
-                        self.work_queue.put_nowait(candidate)
-                        self.work_queue.task_done()
+                        # hold the mismatch and run it as the NEXT burst:
+                        # re-queueing at the tail would let it repeatedly
+                        # lose its FIFO position to later-arriving
+                        # coalescable jobs (unbounded reordering)
+                        held = candidate
                         break
                 task = asyncio.create_task(run_burst(burst))
                 pending.add(task)
                 task.add_done_callback(pending.discard)
         finally:
+            # a held job was claimed from the queue but never dispatched;
+            # put it back so cancellation cannot silently drop it (and
+            # work_queue.join() accounting stays balanced)
+            if held is not None:
+                try:
+                    self.work_queue.put_nowait(held)
+                except asyncio.QueueFull:
+                    log.error("dropping held job %s at shutdown: queue "
+                              "full (hive recovers it via timeout)",
+                              held.get("id"))
+                self.work_queue.task_done()
             # drain in-flight jobs before the loop closes: cancel, then
             # AWAIT them so their finally blocks (queue bookkeeping) run
             # and no pending task outlives the event loop

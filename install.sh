@@ -24,18 +24,13 @@ echo "==> creating venv at $VENV_DIR"
 source "$VENV_DIR/bin/activate"
 pip install --upgrade pip >/dev/null
 
-echo "==> installing jax ($BACKEND backend)"
+echo "==> installing swarm-tpu ($BACKEND backend; deps from pyproject.toml)"
 if [[ "$BACKEND" == "tpu" ]]; then
-    pip install "jax[tpu]" \
+    pip install -e ".[tpu,test]" \
         -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 else
-    pip install jax
+    pip install -e ".[cpu,test]"
 fi
-
-echo "==> installing swarm-tpu"
-pip install flax optax orbax-checkpoint einops pillow \
-    opencv-python-headless requests aiohttp safetensors tokenizers pytest
-pip install -e . --no-deps
 
 echo "==> building native artifact codec"
 python -c "from chiaswarm_tpu import native; print('native codec:', bool(native.load()))"

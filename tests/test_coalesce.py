@@ -171,6 +171,101 @@ def test_burst_key_prefilter():
     assert _burst_key(_job(7, parameters={"upscale": True})) is None
 
 
+def test_row_chunks_bounds_total_batch_rows():
+    """num_images_per_prompt multiplies rows: 4 jobs x 8 images must NOT
+    merge into one batch-32 program on a dp=4 slot (that is data_width
+    times the per-device memory of any solo run); batch=1 jobs still
+    coalesce up to data_width."""
+    from chiaswarm_tpu.node.executor import _row_chunks
+
+    def item(i, n):
+        return (i, f"j{i}", "image/png", {"num_images_per_prompt": n})
+
+    big = [item(i, 8) for i in range(4)]
+    assert [len(c) for c in _row_chunks(big, 4)] == [1, 1, 1, 1]
+
+    small = [item(i, 1) for i in range(4)]
+    assert [len(c) for c in _row_chunks(small, 4)] == [4]
+
+    # two n=2 jobs fit in one dp=4 program (4 rows); a third would not
+    pairs = [item(i, 2) for i in range(3)]
+    assert [len(c) for c in _row_chunks(pairs, 4)] == [2, 1]
+
+
+def test_oversized_rows_run_per_job_not_batched(registry):
+    """End to end: two 4-image jobs on a dp=4 slot execute per job (the
+    coalesced program would be 8 rows = 2x any solo footprint)."""
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
+    jobs = [_job(0, num_images_per_prompt=4),
+            _job(1, num_images_per_prompt=4)]
+    results = synchronous_do_work_batch(jobs, pool.slots[0], registry)
+    by_id = {r["id"]: r for r in results}
+    assert set(by_id) == {"j0", "j1"}
+    for r in results:
+        assert "coalesced" not in r["pipeline_config"]
+        assert r["pipeline_config"].get("error") is None
+
+
+def test_mismatched_job_keeps_fifo_position(monkeypatch):
+    """The drain holds a non-matching candidate as the NEXT burst instead
+    of re-queueing it at the tail (ADVICE r2): with queue
+    [A, B, A2, A3] the mismatch B must execute before A2/A3 — the old
+    tail re-queue ran [A, A2?]... and pushed B behind later arrivals."""
+    import asyncio
+
+    from chiaswarm_tpu.node import worker as worker_mod
+    from chiaswarm_tpu.node.settings import Settings
+    from chiaswarm_tpu.node.worker import Worker
+
+    class StubSlot:
+        depth = 1          # serialize bursts so order is deterministic
+        data_width = 4
+
+        def descriptor(self):
+            return "stub"
+
+    class StubPool(list):
+        pass
+
+    bursts: list[list[str]] = []
+
+    async def fake_do_work(job, slot, registry):
+        bursts.append([job["id"]])
+        return {"id": job["id"], "artifacts": {}, "pipeline_config": {}}
+
+    async def fake_do_work_batch(jobs, slot, registry):
+        bursts.append([j["id"] for j in jobs])
+        return [{"id": j["id"], "artifacts": {}, "pipeline_config": {}}
+                for j in jobs]
+
+    monkeypatch.setattr(worker_mod, "do_work", fake_do_work)
+    monkeypatch.setattr(worker_mod, "do_work_batch", fake_do_work_batch)
+
+    async def main():
+        pool = StubPool([StubSlot()])
+        worker = Worker(
+            settings=Settings(hive_uri="http://unused", hive_token="t",
+                              worker_name="fifo-test"),
+            registry=object(), pool=pool, hive=object())
+        jobs = [_job(0), _job(1, num_inference_steps=3),
+                _job(2), _job(3)]
+        for job in jobs:
+            worker.work_queue.put_nowait(job)
+        task = asyncio.create_task(worker._slot_worker(pool[0]))
+        await asyncio.wait_for(worker.work_queue.join(), timeout=30)
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(main())
+    flat = [i for burst in bursts for i in burst]
+    # j1 (the mismatch) runs immediately after the burst that found it,
+    # NOT behind j2/j3
+    assert flat == ["j0", "j1", "j2", "j3"], bursts
+    assert bursts[1] == ["j1"], bursts
+    # the compatible tail pair still coalesces after the held job ran
+    assert ["j2", "j3"] in bursts, bursts
+
+
 def test_coalesced_default_content_type_is_png(registry):
     """Solo-equivalence of encoding: a job without content_type must come
     back PNG from the coalesced path (the solo callback's default), not

@@ -185,6 +185,18 @@ def test_decode_lines_extracts_planted_segment():
                                           (20 + 8) * 2, (30 + 6) * 2])
 
 
+def test_decode_lines_threshold_is_map_space_direct():
+    """pred_lines compares map-resolution length directly against dist_thr
+    (no /2): a segment of map length 4 survives dist_thr=3 but not
+    dist_thr=5 — the /2 variant would have kept it at dist_thr=5."""
+    tp = np.zeros((64, 64, 9), np.float32)
+    tp[:, :, 0] = -10.0
+    tp[30, 20, 0] = 10.0
+    tp[30, 20, 1:5] = [-1.6, -1.2, 1.6, 1.2]  # map length = hypot(3.2, 2.4) = 4
+    assert decode_lines(tp, score_thr=0.1, dist_thr=3.0).shape == (1, 4)
+    assert decode_lines(tp, score_thr=0.1, dist_thr=5.0).shape == (0, 4)
+
+
 def test_detector_runs_on_odd_sizes():
     det = MLSDDetector.random(seed=0, canvas=64)
     img = (np.random.RandomState(1).rand(37, 53, 3) * 255).astype(np.uint8)

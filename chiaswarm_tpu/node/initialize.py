@@ -89,13 +89,69 @@ def prefetch_checkpoints(models: list[dict[str, Any]],
         except Exception as exc:
             log.warning("prefetch of %s failed: %s", name, exc)
     fetched += _prefetch_annotators(models, settings)
+    fetched += _prefetch_safety_checker(models, settings)
     return fetched
 
 
+_SAFETY_CHECKER_REPO = "CompVis/stable-diffusion-safety-checker"
+
+
+def _is_sd_generation_model(model: dict[str, Any]) -> bool:
+    """True for models whose outputs go through the NSFW checker —
+    anything the diffusion callback serves (the reference always checks,
+    swarm/diffusion/diffusion_func.py:99-111)."""
+    name = str(model.get("name") or model.get("model_name") or "")
+    if not name:
+        return False
+    from chiaswarm_tpu.pipelines.tts import is_tts_model
+
+    if is_tts_model(name) or "audioldm" in name.lower() \
+            or "blip" in name.lower():
+        return False
+    workflow = str((model.get("parameters") or {}).get("workflow", ""))
+    return workflow not in ("txt2audio", "img2txt", "txt2vid", "vid2vid")
+
+
+def _prefetch_safety_checker(models: list[dict[str, Any]],
+                             settings: Settings) -> int:
+    """Provision the standalone safety checker whenever the catalog lists
+    any image-generating model (workloads/safety.py loads it from
+    ``model_dir("CompVis/stable-diffusion-safety-checker")``; without it a
+    node honestly reports ``safety_checker: "unavailable"`` but an open
+    network should always check)."""
+    if not any(_is_sd_generation_model(m) for m in models):
+        return 0
+    target = model_dir(_SAFETY_CHECKER_REPO)
+    if target.exists():
+        return 0
+    tmp = target.with_name(target.name + ".fetching")
+    try:
+        from huggingface_hub import snapshot_download
+
+        tmp.mkdir(parents=True, exist_ok=True)
+        snapshot_download(
+            _SAFETY_CHECKER_REPO, local_dir=str(tmp),
+            token=settings.huggingface_token or None,
+            allow_patterns=["*.safetensors", "*.bin", "*.json"],
+        )
+        tmp.rename(target)  # only a COMPLETE fetch claims the dir
+        log.info("fetched safety checker weights")
+        return 1
+    except Exception as exc:
+        log.warning("safety checker fetch failed: %s", exc)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        return 0
+
+
 # learned preprocessor weights (models/openpose.py, models/hed.py,
-# models/dpt.py): local model-dir name -> (catalog hint words, hub repo,
-# weight filename). openpose/hed come from the public annotator mirror
-# the reference's controlnet_aux uses; depth from the Intel DPT release.
+# models/dpt.py, models/upernet.py, models/mlsd.py, models/lineart.py):
+# local model-dir name -> (catalog hint words, hub repo, weight filename).
+# openpose/hed/mlsd/lineart come from the public annotator mirror the
+# reference's controlnet_aux uses; depth from the Intel DPT release. ALL
+# six learned modes provision here — a fresh node must never silently
+# serve a stand-in for a mode it could run natively.
 _ANNOTATORS = {
     "openpose": (("openpose",), "lllyasviel/Annotators",
                  "body_pose_model.pth"),
@@ -105,6 +161,9 @@ _ANNOTATORS = {
             "model.safetensors"),
     "upernet": (("seg", "segmentation"), "openmmlab/upernet-convnext-small",
                 "model.safetensors"),
+    "mlsd": (("mlsd",), "lllyasviel/Annotators",
+             "mlsd_large_512_fp32.pth"),
+    "lineart": (("lineart",), "lllyasviel/Annotators", "sk_model.pth"),
 }
 
 

@@ -6,21 +6,17 @@ image, dispatched on ``controlnet["type"]``. These are CPU ops (OpenCV /
 PIL) by design — the reference keeps them off-GPU and we keep them off-TPU
 (SURVEY.md §2: "keep on CPU (host) — not TPU work").
 
-Implemented without controlnet_aux. Exact ports: canny (cv2.Canny), tile
-(64-multiple resize), pix2pix (passthrough), shuffle (content shuffle).
-openpose runs the NATIVE CMU body-pose network (models/openpose.py,
-converted body_pose_model weights; raises with a fetch hint when the
-weights are absent); scribble/softedge run the NATIVE HED network
-(models/hed.py) when its weights are present, falling back to a
-blurred-Scharr stand-in; depth/normalbae run the NATIVE DPT network
-(models/dpt.py — the architecture behind the reference's transformers
-depth pipeline) when its weights are present, falling back to a
-position-prior pseudo-depth; seg runs the NATIVE UperNet-ConvNeXt
-segmenter (models/upernet.py — the exact model the reference calls
-through transformers) when its weights are present, falling back to
-mean-shift posterization onto the same full ADE20K palette. Model-free
-stand-ins remain only for mlsd (probabilistic Hough line segments) and
-lineart (dodge-sketch line extraction).
+Implemented without controlnet_aux. Exact ports: canny (cv2.Canny with
+per-job thresholds), tile (scale min-dim to 1024, round to 64 multiple),
+pix2pix (passthrough), shuffle (content shuffle). Every learned mode runs
+a NATIVE network when its converted weights are in the model dir
+(`swarm-tpu init` provisions all of them): openpose (models/openpose.py,
+raises with a fetch hint when absent); scribble/softedge (models/hed.py);
+depth/normalbae (models/dpt.py); seg (models/upernet.py); mlsd
+(models/mlsd.py); lineart (models/lineart.py). The non-openpose modes
+fall back to documented model-free stand-ins on weightless nodes
+(blurred Scharr, position-prior pseudo-depth, mean-shift posterization
+onto the ADE20K palette, probabilistic Hough segments, dodge-sketch).
 """
 
 from __future__ import annotations
@@ -30,22 +26,40 @@ from typing import Any, Callable
 import numpy as np
 from PIL import Image
 
-_PREPROCESSORS: dict[str, Callable[[Image.Image], Image.Image]] = {}
+_PREPROCESSORS: dict[str, Callable[..., Image.Image]] = {}
+# modes whose function takes the job's controlnet dict as a second
+# positional arg (decided ONCE at registration from the signature, so
+# new parametrized modes need no dispatcher special case)
+_TAKES_PARAMS: set[str] = set()
 
 
 def _register(name: str):
     def wrap(fn):
+        import inspect
+
         _PREPROCESSORS[name] = fn
+        params = [p for p in inspect.signature(fn).parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY,
+                                p.POSITIONAL_OR_KEYWORD)]
+        if len(params) > 1 and params[1].name == "controlnet":
+            _TAKES_PARAMS.add(name)
         return fn
     return wrap
 
 
 @_register("canny")
-def image_to_canny(image: Image.Image) -> Image.Image:
+def image_to_canny(image: Image.Image,
+                   controlnet: dict | None = None) -> Image.Image:
+    """Canny edges honoring the job's thresholds
+    (input_processor.py:74-84: controlnet.get("low_threshold"/
+    "high_threshold") with 100/200 defaults)."""
     import cv2
 
+    controlnet = controlnet or {}
     arr = np.asarray(image)
-    edges = cv2.Canny(arr, 100, 200)
+    edges = cv2.Canny(arr,
+                      int(controlnet.get("low_threshold", 100)),
+                      int(controlnet.get("high_threshold", 200)))
     return Image.fromarray(np.stack([edges] * 3, axis=-1))
 
 
@@ -102,10 +116,14 @@ def image_to_soft_edges(image: Image.Image) -> Image.Image:
 
 
 @_register("tile")
-def image_to_tile(image: Image.Image) -> Image.Image:
-    """Round size down to a 64 multiple (input_processor.py:63-71)."""
+def image_to_tile(image: Image.Image, resolution: int = 1024) -> Image.Image:
+    """Scale so the SHORT side hits ``resolution`` (upscaling small
+    inputs — tile conditioning wants detail at output scale), then round
+    each side to the nearest 64 multiple (input_processor.py:63-71)."""
     w, h = image.size
-    w, h = max(64, w // 64 * 64), max(64, h // 64 * 64)
+    k = float(resolution) / min(h, w)
+    w = max(64, int(round(w * k / 64.0)) * 64)
+    h = max(64, int(round(h * k / 64.0)) * 64)
     return image.resize((w, h), Image.Resampling.LANCZOS)
 
 
@@ -328,10 +346,14 @@ def image_to_openpose(image: Image.Image) -> Image.Image:
 
 def preprocess_image(image: Image.Image, controlnet: dict[str, Any]) -> Image.Image:
     """Dispatch on controlnet["type"] (input_processor.py:17-60). Every
-    mode has an exact port, a documented model-free stand-in, or (openpose)
-    a native detector gated on converted weights."""
+    mode has an exact port or a native detector gated on converted
+    weights (with a documented model-free stand-in).
+
+    Like the reference (input_processor.py:18), preprocessing is OFF by
+    default — the server marks jobs whose input is raw and needs
+    annotation; an already-annotated conditioning image passes through."""
     kind = str(controlnet.get("type", "canny")).lower()
-    if not controlnet.get("preprocess", True):
+    if not controlnet.get("preprocess", False):
         return image
     fn = _PREPROCESSORS.get(kind)
     if fn is None:
@@ -339,4 +361,6 @@ def preprocess_image(image: Image.Image, controlnet: dict[str, Any]) -> Image.Im
             f"controlnet preprocessor {kind!r} is not yet supported on "
             f"this TPU worker (available: {sorted(_PREPROCESSORS)})"
         )
+    if kind in _TAKES_PARAMS:
+        return fn(image, controlnet)
     return fn(image)

@@ -66,10 +66,43 @@ def _clip_preprocess(frame: np.ndarray, size: int = 224) -> np.ndarray:
     return (arr - _MEAN) / _STD
 
 
+def _vision_config(checker_dir: Path):
+    """VisionConfig from the checkpoint's own ``config.json`` (HF
+    safety-checker snapshots carry the CLIPConfig with a vision section);
+    defaults are the production ViT-L/14 shape. Reading the config rather
+    than assuming it lets tiny test fixtures and any future checker
+    variant load through the same path."""
+    from chiaswarm_tpu.models.clip import VisionConfig
+
+    base = VisionConfig()
+    cfg_file = checker_dir / "config.json"
+    if not cfg_file.is_file():
+        return base
+    import json
+
+    try:
+        raw = json.loads(cfg_file.read_text())
+    except (OSError, ValueError):
+        return base
+    vis = raw.get("vision_config") or raw.get("vision_config_dict") or {}
+    return VisionConfig(
+        hidden_size=int(vis.get("hidden_size", base.hidden_size)),
+        intermediate_size=int(vis.get("intermediate_size",
+                                      base.intermediate_size)),
+        num_layers=int(vis.get("num_hidden_layers", base.num_layers)),
+        num_heads=int(vis.get("num_attention_heads", base.num_heads)),
+        image_size=int(vis.get("image_size", base.image_size)),
+        patch_size=int(vis.get("patch_size", base.patch_size)),
+        projection_dim=int(vis.get("projection_dim", base.projection_dim)),
+    )
+
+
 class SafetyChecker:
     """Native CLIP-vision tower + concept-cosine head (models/clip.py
     ClipVisionEncoder), converted from the torch checker in ONE file pass.
     """
+
+    _image_size = 224  # overwritten from the checkpoint config on load
 
     def __init__(self, checker_dir: Path) -> None:
         import jax
@@ -78,7 +111,7 @@ class SafetyChecker:
             convert_safety_checker,
             read_torch_weights,
         )
-        from chiaswarm_tpu.models.clip import ClipVisionEncoder, VisionConfig
+        from chiaswarm_tpu.models.clip import ClipVisionEncoder
 
         params, buffers = convert_safety_checker(
             read_torch_weights(checker_dir))
@@ -88,13 +121,16 @@ class SafetyChecker:
         self.special_embeds = np.asarray(buffers["special_care_embeds"])
         self.special_thresholds = np.asarray(
             buffers["special_care_embeds_weights"])
-        vision = ClipVisionEncoder(VisionConfig())
+        cfg = _vision_config(checker_dir)
+        self._image_size = cfg.image_size
+        vision = ClipVisionEncoder(cfg)
         self._jit_embed = jax.jit(
             lambda pixel_values: vision.apply(params, pixel_values))
 
     def __call__(self, images: np.ndarray) -> list[bool]:
         """uint8 (B, H, W, 3) -> per-image nsfw flags."""
-        pixel_values = np.stack([_clip_preprocess(f) for f in images])
+        pixel_values = np.stack(
+            [_clip_preprocess(f, size=self._image_size) for f in images])
 
         embeds = np.asarray(self._jit_embed(pixel_values))
         embeds = embeds / np.linalg.norm(embeds, axis=-1, keepdims=True)

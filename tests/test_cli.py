@@ -67,3 +67,68 @@ def test_init_fetches_catalog(tmp_path, monkeypatch):
     assert catalog[0]["name"] == "tiny"
     settings = json.loads((tmp_path / "settings.json").read_text())
     assert settings["hive_token"] == "token"
+
+
+def test_annotators_cover_every_learned_mode():
+    """A fresh `swarm-tpu init` must provision weights for ALL six
+    learned preprocessor networks — a mode with a native model but no
+    provisioned weights would silently serve its stand-in forever."""
+    from chiaswarm_tpu.node.initialize import _ANNOTATORS
+
+    assert {"openpose", "hed", "dpt", "upernet", "mlsd",
+            "lineart"} <= set(_ANNOTATORS)
+    hinted = {h for hints, _, _ in _ANNOTATORS.values() for h in hints}
+    assert {"mlsd", "lineart"} <= hinted
+
+
+def test_sd_generation_model_detection():
+    from chiaswarm_tpu.node.initialize import _is_sd_generation_model
+
+    assert _is_sd_generation_model({"name": "runwayml/stable-diffusion-v1-5"})
+    assert _is_sd_generation_model({"name": "DeepFloyd/IF-I-XL-v1.0"})
+    assert not _is_sd_generation_model({"name": "cvssp/audioldm-s-full-v2"})
+    assert not _is_sd_generation_model({"name": "suno/bark"})
+    assert not _is_sd_generation_model(
+        {"name": "Salesforce/blip-image-captioning-large"})
+    assert not _is_sd_generation_model(
+        {"name": "damo/text-to-video",
+         "parameters": {"workflow": "txt2vid"}})
+    assert not _is_sd_generation_model({})
+
+
+def test_init_provisions_safety_checker(tmp_path, monkeypatch):
+    """When the catalog lists an SD model, prefetch provisions the
+    standalone safety checker into the model store (fake hub module —
+    zero-egress hosts skip with a warning instead)."""
+    import sys
+    import types
+
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+
+    def fake_snapshot_download(repo, local_dir=None, **kwargs):
+        from pathlib import Path
+
+        Path(local_dir).mkdir(parents=True, exist_ok=True)
+        (Path(local_dir) / "model.safetensors").write_bytes(b"")
+
+    fake_hub = types.ModuleType("huggingface_hub")
+    fake_hub.snapshot_download = fake_snapshot_download
+    monkeypatch.setitem(sys.modules, "huggingface_hub", fake_hub)
+
+    from chiaswarm_tpu.node.initialize import (
+        _prefetch_safety_checker,
+    )
+    from chiaswarm_tpu.node.registry import model_dir
+    from chiaswarm_tpu.node.settings import Settings
+
+    models = [{"name": "runwayml/stable-diffusion-v1-5",
+               "parameters": {}}]
+    assert _prefetch_safety_checker(models, Settings()) == 1
+    target = model_dir("CompVis/stable-diffusion-safety-checker")
+    assert (target / "model.safetensors").exists()
+    # idempotent: an existing dir is never re-fetched
+    assert _prefetch_safety_checker(models, Settings()) == 0
+    # audio-only catalogs provision nothing (fresh root)
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path / "audio-only"))
+    assert _prefetch_safety_checker(
+        [{"name": "cvssp/audioldm-s-full-v2"}], Settings()) == 0

@@ -75,10 +75,10 @@ def test_depth_preprocessor_uses_dpt_when_present(monkeypatch):
 
     monkeypatch.setattr(wl, "_DPT", [DPTDetector.random(seed=1)])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (10, 200, 80)),
-                              {"type": "depth"})
+                              {"type": "depth", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
     normal = wl.preprocess_image(Image.new("RGB", (64, 48), (10, 200, 80)),
-                                 {"type": "normalbae"})
+                                 {"type": "normalbae", "preprocess": True})
     assert np.asarray(normal).shape == (48, 64, 3)
 
 
@@ -90,6 +90,6 @@ def test_depth_preprocessor_falls_back(tmp_path, monkeypatch):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     monkeypatch.setattr(wl, "_DPT", [])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (10, 200, 80)),
-                              {"type": "depth"})
+                              {"type": "depth", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
     assert wl._DPT == [None]

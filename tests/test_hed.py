@@ -101,7 +101,7 @@ def test_softedge_uses_hed_when_weights_present(monkeypatch):
 
     monkeypatch.setattr(wl, "_HED", [HEDDetector.random(seed=2, canvas=64)])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
-                              {"type": "softedge"})
+                              {"type": "softedge", "preprocess": True})
     arr = np.asarray(out)
     assert arr.shape == (48, 64, 3)
 
@@ -114,6 +114,6 @@ def test_softedge_falls_back_without_weights(tmp_path, monkeypatch):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     monkeypatch.setattr(wl, "_HED", [])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
-                              {"type": "scribble"})
+                              {"type": "scribble", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
     assert wl._HED == [None]  # stand-in path cached

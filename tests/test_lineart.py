@@ -102,7 +102,7 @@ def test_lineart_uses_model_when_weights_present(monkeypatch):
     monkeypatch.setattr(wl, "_LINEART",
                         [LineartDetector.random(seed=2, canvas=64)])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
-                              {"type": "lineart"})
+                              {"type": "lineart", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
 
 
@@ -114,6 +114,6 @@ def test_lineart_falls_back_without_weights(tmp_path, monkeypatch):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     monkeypatch.setattr(wl, "_LINEART", [])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
-                              {"type": "lineart"})
+                              {"type": "lineart", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
     assert wl._LINEART == [None]  # stand-in path cached

@@ -212,7 +212,7 @@ def test_mlsd_uses_model_when_weights_present(monkeypatch):
     monkeypatch.setattr(wl, "_MLSD", [MLSDDetector.random(seed=2,
                                                           canvas=64)])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
-                              {"type": "mlsd"})
+                              {"type": "mlsd", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
 
 
@@ -224,6 +224,6 @@ def test_mlsd_falls_back_without_weights(tmp_path, monkeypatch):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     monkeypatch.setattr(wl, "_MLSD", [])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (90, 120, 40)),
-                              {"type": "mlsd"})
+                              {"type": "mlsd", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
     assert wl._MLSD == [None]  # stand-in path cached

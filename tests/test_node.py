@@ -218,6 +218,53 @@ def test_worker_end_to_end(registry):
     asyncio.run(scenario())
 
 
+def test_worker_e2e_runs_real_safety_checker(registry, tmp_path,
+                                             monkeypatch):
+    """Full worker loop with a PROVISIONED checker: a tiny converted
+    checker fixture on disk (the layout `swarm-tpu init` provisions)
+    must actually screen generated images — result carries real per-image
+    flags, not the ``safety_checker: "unavailable"`` signal
+    (swarm/diffusion/diffusion_func.py:99-111)."""
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    from chiaswarm_tpu.node.registry import model_dir
+    from chiaswarm_tpu.workloads import safety
+
+    from tests.test_safety import write_checker_fixture
+
+    write_checker_fixture(
+        model_dir("CompVis/stable-diffusion-safety-checker"),
+        threshold=-2.0)  # cosine head flags every image
+    monkeypatch.setattr(safety, "_CACHE", {})
+
+    async def scenario():
+        hive = FakeHive()
+        uri = await hive.start()
+        hive.jobs.append({
+            "id": "nsfw-1", "model_name": "tiny", "prompt": "a house",
+            "num_inference_steps": 2, "height": 64, "width": 64,
+            "content_type": "image/png",
+        })
+        settings = Settings(hive_uri=uri, hive_token="t",
+                            worker_name="safety-e2e")
+        worker = Worker(settings=settings, pool=ChipPool(n_slots=1),
+                        registry=registry)
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(1, timeout=120)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=10)
+            await hive.stop()
+
+        result = hive.results[0]
+        assert result["nsfw"] is True
+        cfg = result["pipeline_config"]
+        assert cfg["nsfw_flags"] == [True]
+        assert "safety_checker" not in cfg  # real checker, not unavailable
+
+    asyncio.run(scenario())
+
+
 def test_worker_health_endpoint(registry):
     """GET /healthz (SURVEY.md §5 observability gap fix): live counters
     while the worker serves against the FakeHive."""

@@ -54,25 +54,25 @@ def test_each_mode_produces_rgb(photo, mode, monkeypatch):
 
 
 def test_canny_finds_edges(photo):
-    out = np.asarray(preprocess_image(photo, {"type": "canny"}))
+    out = np.asarray(preprocess_image(photo, {"type": "canny", "preprocess": True}))
     assert out.max() == 255  # box/diagonal edges present
     assert (out > 0).mean() < 0.5  # sparse edge map
 
 
 def test_mlsd_draws_segments(photo):
-    out = np.asarray(preprocess_image(photo, {"type": "mlsd"}))
+    out = np.asarray(preprocess_image(photo, {"type": "mlsd", "preprocess": True}))
     assert out.max() == 255  # straight box edges produce segments
     assert (out == 0).mean() > 0.5  # mostly black wireframe
 
 
 def test_depth_monotone_prior(photo):
-    out = np.asarray(preprocess_image(photo, {"type": "depth"}))[..., 0]
+    out = np.asarray(preprocess_image(photo, {"type": "depth", "preprocess": True}))[..., 0]
     # position prior: bottom rows read nearer (brighter) than top rows
     assert out[-8:].mean() > out[:8].mean()
 
 
 def test_normal_is_unit_encoded(photo):
-    out = np.asarray(preprocess_image(photo, {"type": "normalbae"}))
+    out = np.asarray(preprocess_image(photo, {"type": "normalbae", "preprocess": True}))
     n = out.astype(np.float32) / 255.0 * 2.0 - 1.0
     norms = np.sqrt((n ** 2).sum(-1))
     assert np.isclose(np.median(norms), 1.0, atol=0.15)
@@ -81,16 +81,40 @@ def test_normal_is_unit_encoded(photo):
 def test_seg_uses_palette_colors(photo):
     from chiaswarm_tpu.workloads.controlnet import _ADE_PALETTE
 
-    out = np.asarray(preprocess_image(photo, {"type": "seg"}))
+    out = np.asarray(preprocess_image(photo, {"type": "seg", "preprocess": True}))
     palette = {tuple(c) for c in _ADE_PALETTE}
     colors = {tuple(c) for c in out.reshape(-1, 3)[::37]}
     assert colors <= palette
 
 
-def test_tile_rounds_to_64(photo):
+def test_tile_scales_short_side_to_resolution(photo):
+    """Reference tile semantics (input_processor.py:63-71): scale so the
+    SHORT side hits the target resolution (small inputs upscale), then
+    round each side to the NEAREST 64 multiple."""
     resized = photo.resize((130, 70))
     out = image_to_tile(resized)
-    assert out.size == (128, 64)
+    # k = 1024/70; 130k = 1901.7 -> 1920 (nearest 64), 70k = 1024
+    assert out.size == (1920, 1024)
+    # at the target scale already: nearest-64 rounding only
+    assert image_to_tile(photo.resize((1030, 1100))).size == (1024, 1088)
+    # parameterized resolution keeps test shapes small
+    assert image_to_tile(resized, resolution=128).size == (256, 128)
+
+
+def test_canny_honors_job_thresholds(photo):
+    """Per-job low/high thresholds (input_processor.py:77-81): a
+    permissive threshold pair must mark at least as many edge pixels as
+    a strict pair on the same image."""
+    loose = np.asarray(preprocess_image(
+        photo, {"type": "canny", "preprocess": True,
+                "low_threshold": 10, "high_threshold": 40}))
+    strict = np.asarray(preprocess_image(
+        photo, {"type": "canny", "preprocess": True,
+                "low_threshold": 200, "high_threshold": 250}))
+    default = np.asarray(preprocess_image(
+        photo, {"type": "canny", "preprocess": True}))
+    assert (loose > 0).sum() > (strict > 0).sum()
+    assert (loose > 0).sum() >= (default > 0).sum() >= (strict > 0).sum()
 
 
 def test_preprocess_false_passthrough(photo):
@@ -98,12 +122,21 @@ def test_preprocess_false_passthrough(photo):
     assert out is photo
 
 
+def test_preprocess_defaults_off(photo):
+    """Reference default (input_processor.py:18): no ``preprocess`` key
+    means the input is already a conditioning image — pass through."""
+    out = preprocess_image(photo, {"type": "canny"})
+    assert out is photo
+    # even for weight-gated modes: no preprocessing, no weight demands
+    assert preprocess_image(photo, {"type": "openpose"}) is photo
+
+
 def test_openpose_without_weights_raises(photo, tmp_path, monkeypatch):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     with pytest.raises(ValueError, match="body_pose_model"):
-        preprocess_image(photo, {"type": "openpose"})
+        preprocess_image(photo, {"type": "openpose", "preprocess": True})
 
 
 def test_unknown_mode_raises(photo):
     with pytest.raises(ValueError, match="not yet supported"):
-        preprocess_image(photo, {"type": "telekinesis"})
+        preprocess_image(photo, {"type": "telekinesis", "preprocess": True})

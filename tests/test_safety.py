@@ -75,25 +75,30 @@ def test_clip_preprocess_center_crops():
     assert restored[:, -100:].mean() > 0.9  # right of crop: white
 
 
-def test_convert_safety_checker_and_real_tower(tmp_path):
-    """End-to-end real-code path: fabricate a tiny torch-layout checker
-    state dict, convert it, run the native vision tower, hit a concept."""
+def _tiny_vision_cfg():
+    from chiaswarm_tpu.models.clip import VisionConfig
+
+    return VisionConfig(hidden_size=16, intermediate_size=32, num_layers=2,
+                        num_heads=2, image_size=28, patch_size=14,
+                        projection_dim=8)
+
+
+def _tiny_checker_state(cfg, threshold: float = 2.0):
+    """Torch-layout checker state dict from a tiny flax init -> (state,
+    flax params, vision module). ``threshold`` sets the concept head:
+    2.0 never flags, -2.0 flags everything (cosines live in [-1, 1])."""
     import jax
 
-    from chiaswarm_tpu.convert.torch_to_flax import convert_safety_checker
-    from chiaswarm_tpu.models.clip import ClipVisionEncoder, VisionConfig
-    from chiaswarm_tpu.workloads.safety import SafetyChecker
+    from chiaswarm_tpu.models.clip import ClipVisionEncoder
 
-    cfg = VisionConfig(hidden_size=16, intermediate_size=32, num_layers=2,
-                       num_heads=2, image_size=28, patch_size=14,
-                       projection_dim=8)
     vision = ClipVisionEncoder(cfg)
-    params = vision.init(jax.random.PRNGKey(0),
-                         np.zeros((1, 28, 28, 3), np.float32))
+    params = vision.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1, cfg.image_size, cfg.image_size, 3), np.float32))
 
-    # round-trip: flax tree -> torch-layout flat dict -> converter
     p = params["params"]
     rng = np.random.default_rng(0)
+    d = cfg.projection_dim
     state = {
         "vision_model.vision_model.embeddings.class_embedding":
             np.asarray(p["class_embedding"]),
@@ -111,9 +116,10 @@ def test_convert_safety_checker_and_real_tower(tmp_path):
             np.asarray(p["post_layernorm"]["bias"]),
         "visual_projection.weight":
             np.asarray(p["visual_projection"]["kernel"]).T,
-        "concept_embeds": rng.normal(size=(3, 8)).astype(np.float32),
-        "concept_embeds_weights": np.full((3,), 2.0, np.float32),  # never hit
-        "special_care_embeds": rng.normal(size=(1, 8)).astype(np.float32),
+        "concept_embeds": rng.normal(size=(3, d)).astype(np.float32),
+        "concept_embeds_weights":
+            np.full((3,), threshold, np.float32),
+        "special_care_embeds": rng.normal(size=(1, d)).astype(np.float32),
         "special_care_embeds_weights": np.full((1,), 2.0, np.float32),
     }
     for i in range(cfg.num_layers):
@@ -130,7 +136,40 @@ def test_convert_safety_checker_and_real_tower(tmp_path):
         for fc in ("fc1", "fc2"):
             state[f"{pre}.mlp.{fc}.weight"] = np.asarray(lp[fc]["kernel"]).T
             state[f"{pre}.mlp.{fc}.bias"] = np.asarray(lp[fc]["bias"])
+    return state, params, vision
 
+
+def write_checker_fixture(target_dir, threshold: float = 2.0) -> None:
+    """Materialize a tiny converted-format checker snapshot: safetensors
+    weights + the config.json SafetyChecker reads its VisionConfig from."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    cfg = _tiny_vision_cfg()
+    state, _, _ = _tiny_checker_state(cfg, threshold=threshold)
+    target_dir.mkdir(parents=True, exist_ok=True)
+    save_file(state, str(target_dir / "model.safetensors"))
+    (target_dir / "config.json").write_text(json.dumps({
+        "vision_config": {
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "image_size": cfg.image_size,
+            "patch_size": cfg.patch_size,
+            "projection_dim": cfg.projection_dim,
+        }}))
+
+
+def test_convert_safety_checker_and_real_tower(tmp_path):
+    """End-to-end real-code path: fabricate a tiny torch-layout checker
+    state dict, convert it, run the native vision tower."""
+    from chiaswarm_tpu.convert.torch_to_flax import convert_safety_checker
+
+    cfg = _tiny_vision_cfg()
+    state, params, vision = _tiny_checker_state(cfg)
+    rng = np.random.default_rng(0)
     converted, buffers = convert_safety_checker(state)
     pixels = rng.normal(size=(2, 28, 28, 3)).astype(np.float32)
     want = vision.apply(params, pixels)
@@ -155,3 +194,30 @@ def test_convert_safety_checker_and_real_tower(tmp_path):
     checker.concept_embeds = emb[:1]
     checker.concept_thresholds = np.asarray([0.99], np.float32)
     assert checker(_images(2))[0] is True
+
+
+def test_checker_loads_tiny_fixture_from_disk(tmp_path, monkeypatch):
+    """SafetyChecker reads its VisionConfig from the snapshot's own
+    config.json — a tiny converted fixture loads and flags through the
+    same path the production ViT-L checkpoint uses."""
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    from chiaswarm_tpu.node.registry import model_dir
+    from chiaswarm_tpu.workloads import safety
+
+    checker_dir = model_dir("CompVis/stable-diffusion-safety-checker")
+    write_checker_fixture(checker_dir, threshold=-2.0)  # flags everything
+    monkeypatch.setattr(safety, "_CACHE", {})
+    nsfw, fields = check_images(_images(2), "some/model")
+    assert nsfw is True
+    assert fields["nsfw_flags"] == [True, True]
+
+    # same fixture with never-hit thresholds: clean result, real path
+    import shutil
+
+    shutil.rmtree(checker_dir)
+    write_checker_fixture(checker_dir, threshold=2.0)
+    monkeypatch.setattr(safety, "_CACHE", {})
+    nsfw, fields = check_images(_images(1), "some/model")
+    assert nsfw is False
+    assert fields["nsfw_flags"] == [False]
+    assert "safety_checker" not in fields  # NOT the unavailable signal

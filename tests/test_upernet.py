@@ -95,7 +95,7 @@ def test_seg_preprocessor_uses_upernet_when_present(monkeypatch):
 
     monkeypatch.setattr(wl, "_SEG", [UperNetDetector.random(seed=1)])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (12, 160, 90)),
-                              {"type": "seg"})
+                              {"type": "seg", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
 
 
@@ -107,6 +107,6 @@ def test_seg_preprocessor_falls_back(tmp_path, monkeypatch):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     monkeypatch.setattr(wl, "_SEG", [])
     out = wl.preprocess_image(Image.new("RGB", (64, 48), (12, 160, 90)),
-                              {"type": "seg"})
+                              {"type": "seg", "preprocess": True})
     assert np.asarray(out).shape == (48, 64, 3)
     assert wl._SEG == [None]

@@ -119,6 +119,11 @@ def convert_unet(state: Mapping[str, np.ndarray],
     skipped: list[str] = []
 
     for key, value in state.items():
+        if key == "class_embedding.weight":
+            # nn.Embedding table (x4-upscaler noise level): (N, dim) used
+            # as-is — NOT a linear, so it must bypass _place's transpose
+            flat["class_embedding/embedding"] = value
+            continue
         parts = key.split(".")
         name = parts[-1]
         body = parts[:-1]

@@ -44,6 +44,9 @@ class UNetConfig:
     # SDXL micro-conditioning: concat(sin(time_ids), pooled_text) -> MLP
     addition_embed_dim: int | None = None        # 256 for SDXL
     addition_pooled_dim: int | None = None       # 1280 for SDXL
+    # class-label conditioning table (SD-x4-upscaler noise_level: an
+    # nn.Embed(num_class_embeds, time_embed_dim) added to the time emb)
+    num_class_embeds: int | None = None
     freq_shift: int = 0
     flip_sin_to_cos: bool = True
     dtype: str = "bfloat16"
@@ -166,6 +169,33 @@ PIX2PIX = ModelFamily(
     image_conditioned=True,
 )
 
+# 4x pixel upscaler (stabilityai/stable-diffusion-x4-upscaler-class): the
+# text-conditioned super-resolution stage the reference runs as IF stage 3
+# (swarm/diffusion/diffusion_func_if.py:31-40). The UNet denoises 4-ch
+# latents channel-concatenated with the NOISED low-res RGB image (7 input
+# channels) and conditions on the noise level through a 1000-entry class
+# embedding; the f=4 VAE decodes latents at the LOW-RES grid to 4x pixels.
+UPSCALER_X4 = ModelFamily(
+    name="upscaler_x4",
+    unet=UNetConfig(
+        sample_channels=7,
+        out_channels=4,
+        block_out_channels=(256, 512, 512, 1024),
+        transformer_depth=(0, 1, 1, 1),  # DownBlock2D first level
+        attention_head_dim=8,
+        head_dim_is_count=True,
+        cross_attention_dim=1024,        # OpenCLIP ViT-H text tower
+        use_linear_projection=True,
+        num_class_embeds=1000,
+    ),
+    vae=VAEConfig(block_out_channels=(128, 256, 512),  # f=4 decoder
+                  scaling_factor=0.08333),
+    text_encoders=(_CLIP_H,),
+    prediction_type="v_prediction",
+    default_size=512,
+    kind="upscaler4",
+)
+
 # 2x latent upscaler (sd-x2-latent-upscaler-class): the UNet denoises the
 # 2x latent grid conditioned on the nearest-upsampled low-res latents
 # concatenated on channels (sample_channels = 2 * latent_channels). Run by
@@ -266,6 +296,35 @@ TINY_UP = ModelFamily(
     kind="upscaler",
 )
 
+# Tiny x4-upscaler family for hermetic tests (7ch UNet, noise-level class
+# embedding, f=4 VAE).
+TINY_UP4 = ModelFamily(
+    name="tiny_up4",
+    unet=UNetConfig(
+        sample_channels=7,
+        out_channels=4,
+        block_out_channels=(32, 64),
+        layers_per_block=1,
+        transformer_depth=(0, 1),
+        attention_head_dim=4,
+        head_dim_is_count=True,
+        cross_attention_dim=32,
+        use_linear_projection=True,
+        num_class_embeds=50,
+        dtype="float32",
+    ),
+    vae=VAEConfig(block_out_channels=(16, 32, 32), layers_per_block=1,
+                  scaling_factor=0.08333, dtype="float32"),
+    text_encoders=(
+        TextEncoderConfig(vocab_size=1000, hidden_size=32,
+                          intermediate_size=64, num_layers=2, num_heads=4,
+                          max_position_embeddings=77, eos_token_id=999),
+    ),
+    default_size=64,
+    prediction_type="v_prediction",
+    kind="upscaler4",
+)
+
 # Tiny image-conditioned family for hermetic pix2pix tests.
 TINY_P2P = ModelFamily(
     name="tiny_p2p",
@@ -291,13 +350,17 @@ TINY_P2P = ModelFamily(
 )
 
 FAMILIES: dict[str, ModelFamily] = {
-    f.name: f for f in (SD15, SD21, SDXL, PIX2PIX, UPSCALER_X2, TINY,
-                        TINY_XL, TINY_UP, TINY_P2P)
+    f.name: f for f in (SD15, SD21, SDXL, PIX2PIX, UPSCALER_X2, UPSCALER_X4,
+                        TINY, TINY_XL, TINY_UP, TINY_UP4, TINY_P2P)
 }
 
 # hive model-name prefixes -> family (the dispatch the reference does via
-# server-sent pipeline class names, swarm/job_arguments.py:104-151)
+# server-sent pipeline class names, swarm/job_arguments.py:104-151).
+# ORDER MATTERS: "x4" must outrank the generic "upscale" hint so
+# stabilityai/stable-diffusion-x4-upscaler lands on the 4x family.
 _NAME_HINTS = (
+    ("x4-upscaler", "upscaler_x4"),
+    ("x4", "upscaler_x4"),
     ("latent-upscaler", "upscaler_x2"),
     ("upscale", "upscaler_x2"),
     ("pix2pix", "pix2pix"),

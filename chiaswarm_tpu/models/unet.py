@@ -134,14 +134,14 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
         # spatial self-attention (flash-kernel eligible)
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
         x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
                                self.attn_impl, name="attn1")(h, None)
         # text cross-attention (small KV -> einsum path)
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
         x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
                                "xla", name="attn2")(h, context)
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
         return x + FeedForward(x.shape[-1], self.dtype, name="ff")(h)
 
 
@@ -203,17 +203,28 @@ class Upsample(nn.Module):
 
 def time_conditioning(cfg: UNetConfig, dtype: jnp.dtype,
                       timesteps: jnp.ndarray,
-                      added_cond: dict[str, jnp.ndarray] | None) -> jnp.ndarray:
-    """Timestep (+ SDXL micro-conditioning) embedding. Shared by the UNet
-    and the ControlNet trunk — creates the ``time_embedding`` /
-    ``add_embedding`` submodules in the CALLER's compact scope, so both
-    models keep identical parameter paths for the checkpoint converter."""
+                      added_cond: dict[str, jnp.ndarray] | None,
+                      class_labels: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Timestep (+ SDXL micro-conditioning, + class-label) embedding.
+    Shared by the UNet and the ControlNet trunk — creates the
+    ``time_embedding`` / ``add_embedding`` / ``class_embedding`` submodules
+    in the CALLER's compact scope, so both models keep identical parameter
+    paths for the checkpoint converter."""
     channels = list(cfg.block_out_channels)
     time_embed_dim = channels[0] * 4
     temb = timestep_embedding(timesteps, channels[0],
                               cfg.flip_sin_to_cos, cfg.freq_shift)
     temb = TimestepEmbedding(time_embed_dim, dtype=dtype,
                              name="time_embedding")(temb.astype(dtype))
+    if cfg.num_class_embeds is not None:
+        # noise-level conditioning (SD-x4-upscaler): a learned embedding
+        # row per discrete level, added to the time embedding
+        if class_labels is None:
+            raise ValueError("this family requires class_labels "
+                             "(e.g. the x4-upscaler noise level)")
+        temb = temb + nn.Embed(cfg.num_class_embeds, time_embed_dim,
+                               dtype=dtype, name="class_embedding")(
+            class_labels.astype(jnp.int32))
     if cfg.addition_embed_dim is not None:
         if added_cond is None:
             raise ValueError("this family requires added_cond "
@@ -295,12 +306,14 @@ class UNet(nn.Module):
         added_cond: dict[str, jnp.ndarray] | None = None,  # SDXL micro-cond
         down_residuals: tuple[jnp.ndarray, ...] | None = None,
         mid_residual: jnp.ndarray | None = None,
+        class_labels: jnp.ndarray | None = None,  # (B,) int noise level
     ) -> jnp.ndarray:
         cfg = self.config
         dtype = self.dtype
         channels = list(cfg.block_out_channels)
 
-        temb = time_conditioning(cfg, dtype, timesteps, added_cond)
+        temb = time_conditioning(cfg, dtype, timesteps, added_cond,
+                                 class_labels)
         context = encoder_hidden_states.astype(dtype)
         sample = sample.astype(dtype)
 

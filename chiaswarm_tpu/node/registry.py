@@ -180,6 +180,13 @@ class ModelRegistry:
 
                 return LatentUpscalePipeline(components,
                                              attn_impl=self.attn_impl)
+            if components.family.kind == "upscaler4":
+                from chiaswarm_tpu.pipelines.upscale import (
+                    Upscale4xPipeline,
+                )
+
+                return Upscale4xPipeline(components,
+                                         attn_impl=self.attn_impl)
             return DiffusionPipeline(components, attn_impl=self.attn_impl)
 
         lora_key = (lora, float(lora_scale)) if lora is not None else None

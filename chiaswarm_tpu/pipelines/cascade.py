@@ -14,10 +14,11 @@ TPU-first redesign:
   latent upscaler;
 - the UNets predict epsilon + learned variance (out_channels = 6); the
   sigma-space samplers consume the epsilon half;
-- stage 3 runs the framework's jitted x2 latent upscaler twice
-  (256 -> 512 -> 1024) instead of the reference's SD-x4-upscaler
-  (diffusion_func_if.py:31-40) — same output size, one less model family
-  resident.
+- stage 3 runs the jitted SD-x4-upscaler (pipelines/upscale.py::
+  Upscale4xPipeline) — the SAME text-conditioned x4 SR model class the
+  reference uses (diffusion_func_if.py:31-40), 256 -> 1024 in one pass;
+  the pass loop also accepts an x2-class upscaler (two passes) for nodes
+  without the x4 checkpoint.
 
 The reference's known stage-2 bug (negative_prompt fed from ``prompt``,
 diffusion_func_if.py:44) is intentionally NOT reproduced.
@@ -302,11 +303,12 @@ class CascadePipeline:
             "scheduler": sampler.kind,
         }
         if upscaler is not None:
-            # ---- stage 3: latent-upscale denoise passes to final_size.
-            # The reference's stage 3 re-conditions on the raw prompt
-            # STRING (diffusion_func_if.py:63-65 — the shared T5 embeds
-            # stop at stage 2; the x4-upscaler is CLIP-conditioned), so
-            # passing ``prompt`` down is the faithful contract here too.
+            # ---- stage 3: upscale denoise passes to final_size (one x4
+            # pass for the SD-x4-upscaler; two passes for an x2-class
+            # stand-in). The reference's stage 3 re-conditions on the raw
+            # prompt STRING (diffusion_func_if.py:63-65 — the shared T5
+            # embeds stop at stage 2; the x4-upscaler is CLIP-conditioned),
+            # so passing ``prompt`` down is the faithful contract here too.
             target = int(final_size or self.c.family.sr_size * 4)
             passes = 0
             prev_size = 0

@@ -49,6 +49,61 @@ def materialize_host(shape_tree, rng, dtype: str = "bfloat16"):
     return jax.tree.map(leaf, shape_tree)
 
 
+def abstract_params(family: ModelFamily | str) -> dict[str, Any]:
+    """Param SHAPE trees for every module of a family — pure
+    ``jax.eval_shape`` tracing, no arrays and no compile. Drives
+    random_host materialization and the mesh policy's size estimate."""
+    if isinstance(family, str):
+        family = FAMILIES[family]
+    text_encoders = [ClipTextEncoder(cfg) for cfg in family.text_encoders]
+    unet = UNet(family.unet)
+    vae = AutoencoderKL(family.vae)
+
+    key = jax.random.PRNGKey(0)
+    ids = jnp.zeros((1, family.text_encoders[0].max_position_embeddings),
+                    jnp.int32)
+    shapes: dict[str, Any] = {}
+    for i, te in enumerate(text_encoders):
+        shapes[f"text_encoder_{i}"] = jax.eval_shape(te.init, key, ids)
+    latent = jnp.zeros((1, 8, 8, family.unet.sample_channels))
+    ctx = jnp.zeros((1, ids.shape[1], family.unet.cross_attention_dim))
+    added = None
+    if family.unet.addition_embed_dim is not None:
+        added = {
+            "time_ids": jnp.zeros((1, 6)),
+            "text_embeds": jnp.zeros((1, family.unet.addition_pooled_dim)),
+        }
+    labels = (jnp.zeros((1,), jnp.int32)
+              if family.unet.num_class_embeds is not None else None)
+    shapes["unet"] = jax.eval_shape(
+        lambda k, s, t, c, a, cl: unet.init(k, s, t, c, a, class_labels=cl),
+        key, latent, jnp.zeros((1,)), ctx, added, labels)
+    shapes["vae"] = jax.eval_shape(
+        vae.init, key, jnp.zeros((1, 16, 16, family.vae.in_channels)))
+    return shapes
+
+
+_FAMILY_BYTES_CACHE: dict[tuple[str, int], int] = {}
+
+
+def estimate_family_bytes(family: ModelFamily | str,
+                          bytes_per_param: int = 2) -> int:
+    """Serving-footprint estimate (bf16 by default) for one family's full
+    param set — from abstract shapes, so big families cost a trace, not
+    memory. Used by the worker's default dp x tp policy (core/mesh.py)."""
+    if isinstance(family, str):
+        family = FAMILIES[family]
+    cache_key = (family.name, bytes_per_param)
+    if cache_key not in _FAMILY_BYTES_CACHE:
+        import numpy as np
+
+        shapes = abstract_params(family)
+        total = sum(int(np.prod(leaf.shape))
+                    for leaf in jax.tree.leaves(shapes))
+        _FAMILY_BYTES_CACHE[cache_key] = total * bytes_per_param
+    return _FAMILY_BYTES_CACHE[cache_key]
+
+
 @dataclasses.dataclass
 class Components:
     family: ModelFamily
@@ -97,10 +152,13 @@ class Components:
                     (1, family.unet.addition_pooled_dim), jnp.float32
                 ),
             }
+        labels = (jnp.zeros((1,), jnp.int32)
+                  if family.unet.num_class_embeds is not None else None)
         key, sub = jax.random.split(key)
-        params["unet"] = jax.jit(unet.init)(
-            sub, latent, jnp.zeros((1,)), ctx, added
-        )
+        params["unet"] = jax.jit(
+            lambda k, s, t, c, a, cl: unet.init(k, s, t, c, a,
+                                                class_labels=cl)
+        )(sub, latent, jnp.zeros((1,)), ctx, added, labels)
         key, sub = jax.random.split(key)
         params["vae"] = jax.jit(vae.init)(
             sub, jnp.zeros((1, 16, 16, family.vae.in_channels), jnp.float32)
@@ -140,31 +198,9 @@ class Components:
         vae = AutoencoderKL(family.vae)
 
         rng = np.random.default_rng(seed)
-
-        def materialize(shape_tree):
-            return materialize_host(shape_tree, rng, dtype)
-
-        key = jax.random.PRNGKey(0)
-        ids = jnp.zeros((1, family.text_encoders[0].max_position_embeddings),
-                        jnp.int32)
-        params: dict[str, Any] = {}
-        for i, te in enumerate(text_encoders):
-            params[f"text_encoder_{i}"] = materialize(
-                jax.eval_shape(te.init, key, ids))
-        latent = jnp.zeros((1, 8, 8, family.unet.sample_channels))
-        ctx = jnp.zeros((1, ids.shape[1], family.unet.cross_attention_dim))
-        added = None
-        if family.unet.addition_embed_dim is not None:
-            added = {
-                "time_ids": jnp.zeros((1, 6)),
-                "text_embeds": jnp.zeros((1, family.unet.addition_pooled_dim)),
-            }
-        params["unet"] = materialize(
-            jax.eval_shape(unet.init, key, latent, jnp.zeros((1,)), ctx,
-                           added))
-        params["vae"] = materialize(
-            jax.eval_shape(vae.init, key,
-                           jnp.zeros((1, 16, 16, family.vae.in_channels))))
+        shapes = abstract_params(family)
+        params = {module: materialize_host(tree, rng, dtype)
+                  for module, tree in shapes.items()}
         return cls(
             family=family,
             model_name=model_name or f"random/{family.name}",

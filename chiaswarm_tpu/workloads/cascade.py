@@ -3,9 +3,10 @@
 Capability parity with swarm/diffusion/diffusion_func_if.py:14-92 — the
 ``DeepFloyd/`` model-name prefix routes here (swarm/job_arguments.py:39-40).
 Three stages: 64px T5-conditioned base -> 256px super-res (prompt embeds
-shared, :45-61) -> upscale toward 1024px (:31-40; here two x2 latent-
-upscaler passes instead of the reference's SD-x4-upscaler). The whole
-cascade runs as jitted programs on the chip (pipelines/cascade.py).
+shared, :45-61) -> the SD-x4-upscaler to 1024px (:31-40 — the same
+text-conditioned x4 SR model class the reference runs, pipelines/
+upscale.py::Upscale4xPipeline). The whole cascade runs as jitted programs
+on the chip (pipelines/cascade.py).
 """
 
 from __future__ import annotations
@@ -28,16 +29,16 @@ def cascade_callback(slot, model_name: str, *, seed: int,
                      content_type: str = "image/png",
                      upscale: bool = True,
                      upscaler_model_name: str = (
-                         "stabilityai/sd-x2-latent-upscaler"),
+                         "stabilityai/stable-diffusion-x4-upscaler"),
                      final_size: int | None = None,
                      **_ignored: Any):
     pipe = registry.cascade_pipeline(model_name,
                                      mesh=getattr(slot, "mesh", None))
     upscaler = None
     if upscale:
-        # stage 3: x2 latent-upscale passes to 4 * sr_size (256 -> 1024),
-        # replacing diffusion_func_if.py:31-40's SD-x4-upscaler stage;
-        # the cascade pipeline owns the pass loop
+        # stage 3: the SD-x4-upscaler (diffusion_func_if.py:31-40) takes
+        # 256 -> 1024 in one text-conditioned pass; the cascade pipeline
+        # owns the pass loop (an x2-class name still works, two passes)
         upscaler = registry.pipeline(
             upscaler_model_name, mesh=getattr(slot, "mesh", None))
 

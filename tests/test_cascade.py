@@ -133,6 +133,25 @@ def test_cascade_three_stage_emits_4x_sr_size(tiny_cascade):
     assert config["size"] == [fam.sr_size * 2, fam.sr_size * 2]
 
 
+def test_cascade_stage3_x4_single_pass(tiny_cascade):
+    """Stage 3 through the SD-x4-upscaler model class — the reference's
+    actual stage 3 (diffusion_func_if.py:31-40): ONE pass takes sr_size
+    to 4 * sr_size, conditioned on the prompt string and a noise level."""
+    from chiaswarm_tpu.pipelines import Components
+    from chiaswarm_tpu.pipelines.upscale import Upscale4xPipeline
+
+    upscaler = Upscale4xPipeline(Components.random("tiny_up4", seed=0))
+    fam = tiny_cascade.c.family
+    img, config = tiny_cascade("a castle", steps=2, sr_steps=2, seed=4,
+                               guidance_scale=5.0, upscaler=upscaler,
+                               final_size=fam.sr_size * 4)
+    assert img.shape == (1, fam.sr_size * 4, fam.sr_size * 4, 3)
+    assert config["stages"] == 3
+    assert config["stage3_passes"] == 1  # one x4 pass, not two x2 passes
+    assert config["scale"] == 4
+    assert config["size"] == [fam.sr_size * 4, fam.sr_size * 4]
+
+
 def test_cascade_workload_three_stage_dispatch():
     """cascade_callback with upscale=True (the default) runs stage 3
     through the registry's upscaler and reports the upscaled size."""

@@ -24,7 +24,7 @@ def _tree_paths(tree, prefix=""):
     return out
 
 
-@pytest.mark.parametrize("family", ["tiny", "tiny_xl"])
+@pytest.mark.parametrize("family", ["tiny", "tiny_xl", "tiny_up4"])
 def test_checkpoint_roundtrip(tmp_path, family):
     src = Components.random(family, seed=7)
     write_checkpoint(tmp_path, src)

@@ -86,6 +86,30 @@ def test_unet_forward_tiny_xl_added_cond():
         unet.init(jax.random.PRNGKey(0), x, t, ctx, None)
 
 
+def test_unet_class_label_conditioning():
+    """x4-upscaler-class noise-level conditioning: a class-embedding
+    family forwards with labels, responds to the label value, and
+    refuses to run without one."""
+    from chiaswarm_tpu.models.configs import TINY_UP4
+
+    unet = UNet(TINY_UP4.unet)
+    x = jnp.ones((2, 8, 8, TINY_UP4.unet.sample_channels)) * 0.1
+    t = jnp.array([10.0, 10.0])
+    ctx = jnp.zeros((2, 77, TINY_UP4.unet.cross_attention_dim))
+    labels = jnp.array([0, 0], jnp.int32)
+    params = unet.init(jax.random.PRNGKey(0), x, t, ctx,
+                       class_labels=labels)
+    out = unet.apply(params, x, t, ctx, class_labels=labels)
+    assert out.shape == (2, 8, 8, TINY_UP4.unet.out_channels)
+    # the embedding table participates: different levels, different output
+    out2 = unet.apply(params, x, t, ctx,
+                      class_labels=jnp.array([40, 40], jnp.int32))
+    assert not np.allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+    with pytest.raises(ValueError, match="class_labels"):
+        unet.init(jax.random.PRNGKey(0), x, t, ctx)
+
+
 def test_unet_timestep_sensitivity():
     unet = UNet(TINY.unet)
     x = jnp.ones((1, 8, 8, 4)) * 0.1

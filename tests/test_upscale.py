@@ -1,8 +1,10 @@
-"""Latent 2x upscaler: pipeline, family routing, workload integration.
+"""Upscaler pipelines: latent 2x and SD-x4, routing, workload integration.
 
 Reference behaviors covered: the post-generation sd-x2-latent-upscaler pass
 at 20 steps / guidance 0 (swarm/diffusion/upscale.py:6-32) triggered by the
-server's ``upscale`` model parameter (swarm/job_arguments.py:104-110).
+server's ``upscale`` model parameter (swarm/job_arguments.py:104-110), and
+the IF cascade's SD-x4-upscaler stage 3 model class
+(swarm/diffusion/diffusion_func_if.py:31-40).
 """
 
 import numpy as np
@@ -10,7 +12,10 @@ import pytest
 
 from chiaswarm_tpu.models.configs import get_family
 from chiaswarm_tpu.pipelines import Components
-from chiaswarm_tpu.pipelines.upscale import LatentUpscalePipeline
+from chiaswarm_tpu.pipelines.upscale import (
+    LatentUpscalePipeline,
+    Upscale4xPipeline,
+)
 
 
 @pytest.fixture(scope="module")
@@ -18,10 +23,49 @@ def tiny_upscaler():
     return LatentUpscalePipeline(Components.random("tiny_up", seed=0))
 
 
+@pytest.fixture(scope="module")
+def tiny_upscaler4():
+    return Upscale4xPipeline(Components.random("tiny_up4", seed=0))
+
+
 def test_family_routing():
     assert get_family("stabilityai/sd-x2-latent-upscaler").name == "upscaler_x2"
     assert get_family("stabilityai/sd-x2-latent-upscaler").kind == "upscaler"
     assert get_family("runwayml/stable-diffusion-v1-5").kind == "sd"
+
+
+def test_x4_family_routing():
+    """The reference's stage-3 checkpoint name routes to the x4 family
+    (diffusion_func_if.py:31-40), NOT the generic 'upscale' hint."""
+    fam = get_family("stabilityai/stable-diffusion-x4-upscaler")
+    assert fam.name == "upscaler_x4"
+    assert fam.kind == "upscaler4"
+    assert fam.unet.sample_channels == 7
+    assert fam.unet.num_class_embeds == 1000
+    assert fam.vae.downscale == 4
+    assert fam.prediction_type == "v_prediction"
+
+
+def test_x4_quadruples_size(tiny_upscaler4):
+    """Input at the low-res grid; f=4 VAE decodes straight to 4x pixels.
+    CFG + noise-level conditioning run inside one jitted program."""
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)
+    out, config = tiny_upscaler4(img, prompt="sharp photo", steps=2,
+                                 guidance_scale=5.0, noise_level=7, seed=4)
+    assert out.shape == (1, 256, 256, 3)
+    assert out.dtype == np.uint8
+    assert config["scale"] == 4
+    assert config["upscale_noise_level"] == 7
+    # determinism per seed
+    out2, _ = tiny_upscaler4(img, prompt="sharp photo", steps=2,
+                             guidance_scale=5.0, noise_level=7, seed=4)
+    assert np.array_equal(out, out2)
+    # the noise level feeds the class embedding AND the low-res noising:
+    # a different level must change the result
+    out3, _ = tiny_upscaler4(img, prompt="sharp photo", steps=2,
+                             guidance_scale=5.0, noise_level=30, seed=4)
+    assert not np.array_equal(out, out3)
 
 
 def test_upscale_doubles_size(tiny_upscaler):

@@ -76,6 +76,52 @@ def local_chip_count() -> int:
     return jax.local_device_count()
 
 
+_DEFAULT_HBM_BYTES = 16 * 1024**3  # v5e-class chip; used when stats absent
+# params may take at most this fraction of a chip; the rest is activations,
+# compiled executables, coalesced-batch latents, and the resident-model LRU
+_PARAM_HBM_FRACTION = 0.35
+
+
+def device_hbm_bytes(device: jax.Device | None = None) -> int:
+    """Per-chip memory budget from the runtime, with a v5e default when
+    the platform exposes no stats (CPU test meshes, some plugins)."""
+    device = device or jax.devices()[0]
+    try:
+        stats = device.memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return _DEFAULT_HBM_BYTES
+
+
+def derive_mesh_spec(n_devices: int,
+                     heaviest_param_bytes: int | None = None,
+                     hbm_bytes: int | None = None) -> MeshSpec:
+    """Default dp x tp policy for a serving pool — no hand-written
+    ``mesh_shape`` required.
+
+    Data parallelism is the throughput axis (cross-job coalescing rides
+    it), so everything defaults to ``data``. Tensor parallelism engages
+    ONLY when the heaviest catalog family's bf16 params would not fit
+    comfortably on one chip (> _PARAM_HBM_FRACTION of HBM): tp doubles —
+    over power-of-two divisors of the device count — until the per-chip
+    shard fits. On a v5e-8 with SDXL in the catalog (~7 GB bf16) that
+    lands on dp=4 x tp=2; SD1.5-only catalogs stay dp=8."""
+    if n_devices <= 1:
+        return MeshSpec({DATA_AXIS: 1})
+    if hbm_bytes is None:
+        hbm_bytes = device_hbm_bytes()
+    budget = _PARAM_HBM_FRACTION * hbm_bytes
+    tp = 1
+    if heaviest_param_bytes:
+        while (heaviest_param_bytes / tp > budget
+               and tp * 2 <= n_devices and n_devices % (tp * 2) == 0):
+            tp *= 2
+    return MeshSpec({DATA_AXIS: n_devices // tp, MODEL_AXIS: tp})
+
+
 def build_mesh(
     spec: MeshSpec | None = None,
     devices: Sequence[jax.Device] | None = None,

@@ -26,7 +26,10 @@ def _act(name: str):
     if name == "quick_gelu":
         return lambda x: x * nn.sigmoid(1.702 * x)
     if name == "gelu":
-        return nn.gelu
+        # HF's ACT2FN["gelu"] is the EXACT erf GELU; flax's default is the
+        # tanh approximation — close enough to hide in tiny tests, caught
+        # by the full-config transformers parity suite
+        return lambda x: nn.gelu(x, approximate=False)
     raise ValueError(f"unknown activation {name!r}")
 
 
@@ -61,11 +64,11 @@ class ClipLayer(nn.Module):
     def __call__(self, x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
         residual = x
-        x = nn.LayerNorm(dtype=self.dtype, name="layer_norm1")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="layer_norm1")(x)
         x = ClipAttention(cfg, dtype=self.dtype, name="self_attn")(x, mask)
         x = residual + x
         residual = x
-        x = nn.LayerNorm(dtype=self.dtype, name="layer_norm2")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="layer_norm2")(x)
         x = nn.Dense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(x)
         x = _act(cfg.hidden_act)(x)
         x = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(x)
@@ -117,11 +120,11 @@ class ClipVisionEncoder(nn.Module):
         pos = nn.Embed(n_pos, cfg.hidden_size, dtype=self.dtype,
                        name="position_embedding")(jnp.arange(x.shape[1]))
         x = x + pos[None]
-        x = nn.LayerNorm(dtype=self.dtype, name="pre_layrnorm")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="pre_layrnorm")(x)
         mask = jnp.zeros((1, 1, x.shape[1], x.shape[1]), jnp.float32)
         for i in range(cfg.num_layers):
             x = ClipLayer(cfg, dtype=self.dtype, name=f"layers_{i}")(x, mask)
-        pooled = nn.LayerNorm(dtype=self.dtype,
+        pooled = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
                               name="post_layernorm")(x[:, 0])
         return nn.Dense(cfg.projection_dim, use_bias=False,
                         dtype=self.dtype, name="visual_projection")(pooled)
@@ -163,7 +166,8 @@ class ClipTextEncoder(nn.Module):
         # Single LN module reused on different inputs (shared params): the
         # pooled path always reads the final-LN state even when the sequence
         # readout skips it (OpenCLIP bigG / SDXL penultimate readout).
-        final_ln = nn.LayerNorm(dtype=self.dtype, name="final_layer_norm")
+        final_ln = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                                name="final_layer_norm")
         final = final_ln(x)
 
         readout = x if cfg.output_layer == -1 else hidden_states[cfg.output_layer]

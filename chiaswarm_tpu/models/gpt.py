@@ -59,7 +59,7 @@ class Block(nn.Module):
         head_dim = cfg.n_embd // cfg.n_head
         b, t, _ = x.shape
 
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_1")(x).astype(self.dtype)
         qkv = nn.Dense(3 * cfg.n_embd, use_bias=False, dtype=self.dtype,
                        name="attn_qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -89,7 +89,7 @@ class Block(nn.Module):
         x = x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
                          name="attn_proj")(out)
 
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
         h = nn.Dense(4 * cfg.n_embd, use_bias=False, dtype=self.dtype,
                      name="mlp_fc")(h)
         h = nn.gelu(h, approximate=False)  # bark uses exact-erf GELU
@@ -143,7 +143,7 @@ class GPT(nn.Module):
                 x, ck, cv, index, valid_len, ring_bias)
             new_caches.append((ck, cv))
 
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.out_vocab, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)
         return logits, new_caches
@@ -215,7 +215,7 @@ class FineBlock(nn.Module):
         cfg = self.config
         head_dim = cfg.n_embd // cfg.n_head
         b, t, _ = x.shape
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_1")(x).astype(self.dtype)
         qkv = nn.Dense(3 * cfg.n_embd, use_bias=False, dtype=self.dtype,
                        name="attn_qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -228,7 +228,7 @@ class FineBlock(nn.Module):
         out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.reshape(shape))
         x = x + nn.Dense(cfg.n_embd, use_bias=False, dtype=self.dtype,
                          name="attn_proj")(out.reshape(b, t, cfg.n_embd))
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_2")(x).astype(self.dtype)
         h = nn.Dense(4 * cfg.n_embd, use_bias=False, dtype=self.dtype,
                      name="mlp_fc")(h)
         h = nn.gelu(h, approximate=False)
@@ -272,7 +272,7 @@ class FineGPT(nn.Module):
         x = x + pos_table[None, :t].astype(self.dtype)
         for i in range(cfg.n_layer):
             x = FineBlock(cfg, self.dtype, name=f"h_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="ln_f")(x)
         heads = [nn.Dense(cfg.out_vocab, use_bias=False, dtype=jnp.float32,
                           name=f"lm_head_{k}")
                  for k in range(self.n_codes_total - self.n_codes_given)]

@@ -56,7 +56,7 @@ class TemporalAttention(nn.Module):
                          nn.initializers.normal(0.02),
                          (self.max_frames, c))
         seq = x.transpose(0, 2, 3, 1, 4).reshape(b * h * w, f, c)
-        seq = nn.LayerNorm(dtype=jnp.float32, name="norm")(seq)
+        seq = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm")(seq)
         seq = (seq + pos[None, :f, :]).astype(self.dtype)
         inner = self.num_heads * self.head_dim
         q = nn.Dense(inner, use_bias=False, dtype=self.dtype,

@@ -155,6 +155,24 @@ def _coalesce_key(kwargs: dict[str, Any]):
             + tuple(repr(kwargs.get(k)) for k in COALESCE_KEYS))
 
 
+def job_rows(job_or_kwargs: dict[str, Any]) -> int:
+    """Batch rows one job contributes to a coalesced program
+    (``num_images_per_prompt`` multiplies rows; a bad value surfaces per
+    job downstream, not here). Shared by this module's chunking and the
+    worker's drain (node/worker.py) so the two never drift."""
+    try:
+        return max(1, int(job_or_kwargs.get("num_images_per_prompt") or 1))
+    except (TypeError, ValueError):
+        return 1
+
+
+def rows_cap(rows_max: int, data_width: int) -> int:
+    """Max total rows a coalesced program may carry: dp * ceil(max/dp) —
+    never more per device than the heaviest member's solo run."""
+    dw = max(1, int(data_width))
+    return dw * -(-rows_max // dw)
+
+
 def _row_chunks(group: list, data_width: int) -> list[list]:
     """Split a compatible group so one batched program never exceeds the
     per-device row footprint of its heaviest member's solo run.
@@ -164,20 +182,13 @@ def _row_chunks(group: list, data_width: int) -> list[list]:
     program — data_width times the per-device memory of any solo run, a
     likely OOM recovered only after a wasted large-batch compile. Greedy
     chunking keeps ceil(total_rows / dp) <= ceil(max_member_rows / dp)."""
-    dw = max(1, int(data_width))
-
-    def cap(rows_max: int) -> int:
-        return dw * -(-rows_max // dw)  # dp * ceil(max/dp)
-
     chunks: list[list] = []
     cur: list = []
     cur_rows = cur_max = 0
     for item in group:
-        try:
-            rows = max(1, int(item[3].get("num_images_per_prompt") or 1))
-        except (TypeError, ValueError):
-            rows = 1  # bad value surfaces per job downstream, not here
-        if cur and cur_rows + rows > cap(max(cur_max, rows)):
+        rows = job_rows(item[3])
+        if cur and cur_rows + rows > rows_cap(max(cur_max, rows),
+                                              data_width):
             chunks.append(cur)
             cur, cur_rows, cur_max = [], 0, 0
         cur.append(item)

@@ -22,7 +22,12 @@ import aiohttp
 import jax
 
 from chiaswarm_tpu.core.chip_pool import ChipPool
-from chiaswarm_tpu.node.executor import do_work, do_work_batch
+from chiaswarm_tpu.node.executor import (
+    do_work,
+    do_work_batch,
+    job_rows,
+    rows_cap,
+)
 from chiaswarm_tpu.node.hive import (
     POLL_BUSY_S,
     POLL_ERROR_S,
@@ -64,12 +69,6 @@ def _burst_key(job: dict) -> tuple | None:
             repr(sorted(params.items())))
 
 
-def _job_rows(job: dict) -> int:
-    """Batch rows a raw job contributes to a coalesced program."""
-    try:
-        return max(1, int(job.get("num_images_per_prompt") or 1))
-    except (TypeError, ValueError):
-        return 1
 
 
 class Worker:
@@ -84,10 +83,11 @@ class Worker:
                  registry: ModelRegistry | None = None,
                  hive: HiveClient | None = None) -> None:
         self.settings = settings or load_settings()
-        self.pool = pool if pool is not None else self._default_pool()
+        # registry first: its catalog feeds the default mesh policy
         self.registry = registry or ModelRegistry(
             attn_impl="auto" if self.settings.use_flash_attention else "xla"
         )
+        self.pool = pool if pool is not None else self._default_pool()
         self.hive = hive or HiveClient(
             self.settings.hive_uri, self.settings.hive_token,
             self.settings.worker_name,
@@ -110,11 +110,41 @@ class Worker:
         self.jobs_done = 0
 
     def _default_pool(self) -> ChipPool:
-        from chiaswarm_tpu.core.mesh import MeshSpec
+        """One slot over all chips. An explicit ``mesh_shape`` setting
+        wins; otherwise dp x tp derives from the device count and the
+        heaviest catalog family (core/mesh.py::derive_mesh_spec) — a
+        stock multi-chip node engages tensor parallelism exactly when a
+        served model needs it, with no operator configuration."""
+        from chiaswarm_tpu.core.mesh import MeshSpec, derive_mesh_spec
 
-        spec = (MeshSpec(dict(self.settings.mesh_shape))
-                if self.settings.mesh_shape else None)
+        if self.settings.mesh_shape:
+            spec = MeshSpec(dict(self.settings.mesh_shape))
+        else:
+            spec = derive_mesh_spec(len(jax.devices()),
+                                    self._heaviest_catalog_bytes())
+            log.info("derived default mesh: %s", spec.shape)
         return ChipPool(n_slots=1, mesh_spec=spec)
+
+    def _heaviest_catalog_bytes(self) -> int | None:
+        """bf16 footprint of the largest diffusion family the catalog
+        serves (None = empty catalog). Non-SD names (tts/audio/caption)
+        fall through get_family to sd15 — a small, harmless overestimate
+        that never turns tp on by itself."""
+        try:
+            from chiaswarm_tpu.models.configs import get_family
+            from chiaswarm_tpu.pipelines.components import (
+                estimate_family_bytes,
+            )
+
+            names = self.registry.known_models()
+            if not names:
+                return None
+            families = {get_family(name).name for name in names}
+            return max(estimate_family_bytes(f) for f in families)
+        except Exception as exc:  # policy must never block startup
+            log.warning("mesh policy estimate failed (%s); using dp-only",
+                        exc)
+            return None
 
     # ---- lifecycle ----
 
@@ -275,20 +305,20 @@ class Worker:
                 else:
                     burst = [await self.work_queue.get()]
                 key = _burst_key(burst[0])
-                rows = rows_max = _job_rows(burst[0])
+                rows = rows_max = job_rows(burst[0])
                 while key is not None and len(burst) < max_merge:
                     try:
                         candidate = self.work_queue.get_nowait()
                     except asyncio.QueueEmpty:
                         break
-                    cand_rows = _job_rows(candidate)
+                    cand_rows = job_rows(candidate)
                     # num_images_per_prompt multiplies batch rows; never
                     # drain a burst whose total rows exceed what the
                     # heaviest member's solo run would put per device
                     # (the executor's _row_chunks is the authority, this
                     # avoids claiming jobs it would split anyway)
-                    fits = rows + cand_rows <= max_merge * (
-                        -(-max(rows_max, cand_rows) // max_merge))
+                    fits = rows + cand_rows <= rows_cap(
+                        max(rows_max, cand_rows), max_merge)
                     if _burst_key(candidate) == key and fits:
                         burst.append(candidate)
                         rows += cand_rows

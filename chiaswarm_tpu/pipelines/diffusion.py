@@ -76,6 +76,12 @@ class GenerateRequest:
     scheduler: str | None = None  # diffusers class name from the hive
     # per-row (seed, row-index) pairs, length == batch (coalesced jobs)
     sample_seed_rows: tuple[tuple[int, int], ...] | None = None
+    # explicit standard-normal initial noise (B|1, H/f, W/f, C): replaces
+    # the per-row drawn noise so a fixed-latent render can be compared
+    # image-for-image against an external reference (diffusers golden,
+    # tests/test_real_checkpoint.py). Deterministic samplers (DDIM/DPM)
+    # then walk the exact same trajectory.
+    init_noise: np.ndarray | None = None
     # img2img / inpaint
     init_image: np.ndarray | None = None   # (H, W, 3) uint8 or float [-1,1]
     strength: float = 0.8
@@ -195,7 +201,7 @@ class DiffusionPipeline:
     def _build_fn(self, *, batch: int, height: int, width: int, steps: int,
                   start_step: int, sampler: SamplerConfig, use_cfg: bool,
                   has_init: bool, has_mask: bool, tiled: bool,
-                  has_control: bool = False):
+                  has_control: bool = False, has_noise: bool = False):
         # capture only the static module descriptions — NOT the Components
         # bundle, whose .params would otherwise stay pinned by the
         # executable-cache closure after the param LRU evicts them
@@ -231,7 +237,7 @@ class DiffusionPipeline:
 
         def fn(params, ids, neg_ids, sample_keys, guidance, init_latent,
                mask, control_params, control_cond, control_scale,
-               image_guidance):
+               image_guidance, noise_override):
             ctx, pooled = encode_text(params, ids)
             if pix2pix:
                 # dual CFG rides a tripled batch: [uncond, image-only,
@@ -265,7 +271,7 @@ class DiffusionPipeline:
 
             both = jax.vmap(jax.random.split)(sample_keys)  # (B, 2, key)
             sample_keys, nkeys = both[:, 0], both[:, 1]
-            noise = draw(nkeys)
+            noise = noise_override if has_noise else draw(nkeys)
             sigma_start = sched.sigmas[start_step]
             if pix2pix:
                 # image latents condition via channel-concat (UNSCALED, the
@@ -518,11 +524,35 @@ class DiffusionPipeline:
                     init_latent,
                     NamedSharding(mesh, P("data", None, None, None)))
 
+        has_noise = req.init_noise is not None
+        noise_arr = jnp.zeros((1,), jnp.float32)  # placeholder
+        if has_noise:
+            lh, lw = self._latent_hw(height, width)
+            noise_np = np.asarray(req.init_noise, np.float32)
+            want = (lh, lw, fam.vae.latent_channels)
+            if noise_np.ndim == 3:
+                noise_np = noise_np[None]
+            if noise_np.shape[1:] != want:
+                raise ValueError(
+                    f"init_noise shape {noise_np.shape[1:]} != latent "
+                    f"grid {want}")
+            if noise_np.shape[0] > batch:
+                raise ValueError(
+                    f"init_noise carries {noise_np.shape[0]} rows but the "
+                    f"request buckets to batch {batch}")
+            if noise_np.shape[0] == 1:
+                noise_np = np.repeat(noise_np, batch, axis=0)
+            elif noise_np.shape[0] != batch:
+                pad = np.repeat(noise_np[-1:], batch - noise_np.shape[0],
+                                axis=0)
+                noise_np = np.concatenate([noise_np, pad], axis=0)
+            noise_arr = jnp.asarray(noise_np)
+
         fn = self._get_fn(
             batch=batch, height=height, width=width, steps=steps,
             start_step=start_step, sampler=sampler, use_cfg=use_cfg,
             has_init=has_init, has_mask=has_mask, tiled=req.tiled_decode,
-            has_control=has_control,
+            has_control=has_control, has_noise=has_noise,
         )
         # one independent key per batch row: fold the row index into the
         # row's seed, so row b is reproducible at ANY batch size (and a
@@ -548,6 +578,7 @@ class DiffusionPipeline:
             control_cond,
             jnp.float32(req.control_scale),
             jnp.float32(req.image_guidance_scale),
+            noise_arr,
         )
         config = {
             "model_name": self.c.model_name,

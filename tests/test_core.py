@@ -33,6 +33,68 @@ def test_mesh_bad_shape_raises():
         build_mesh(MeshSpec({"data": -1, "model": -1}))
 
 
+def test_derive_mesh_spec_policy():
+    """Default dp x tp policy: tp engages exactly when the heaviest
+    family's params exceed the per-chip budget; everything else is dp."""
+    from chiaswarm_tpu.core.mesh import derive_mesh_spec
+
+    gib = 1024**3
+    # single chip: trivially dp=1
+    assert derive_mesh_spec(1, 100 * gib).shape == {"data": 1}
+    # small model on 8 chips: dp-only
+    assert derive_mesh_spec(8, 2 * gib, hbm_bytes=16 * gib).shape == \
+        {"data": 8, "model": 1}
+    # SDXL-class (~7 GB bf16) exceeds 0.35 * 16 GiB -> tp=2
+    assert derive_mesh_spec(8, 7 * gib, hbm_bytes=16 * gib).shape == \
+        {"data": 4, "model": 2}
+    # bigger model: tp grows until the shard fits (20/4 = 5 GiB < budget)
+    assert derive_mesh_spec(8, 20 * gib, hbm_bytes=16 * gib).shape == \
+        {"data": 2, "model": 4}
+    # enormous model: tp absorbs every chip before giving up
+    assert derive_mesh_spec(8, 30 * gib, hbm_bytes=16 * gib).shape == \
+        {"data": 1, "model": 8}
+    # unknown catalog: stay dp-only
+    assert derive_mesh_spec(8, None, hbm_bytes=16 * gib).shape == \
+        {"data": 8, "model": 1}
+    # odd device counts cannot split: dp-only even for big models
+    assert derive_mesh_spec(3, 30 * gib, hbm_bytes=16 * gib).shape == \
+        {"data": 3, "model": 1}
+
+
+def test_worker_default_pool_derives_tp_for_big_families(monkeypatch):
+    """A stock 8-device worker with an SDXL-class catalog builds a
+    dp=4 x tp=2 slot WITHOUT any hand-written mesh_shape; a small-model
+    catalog stays dp=8 (VERDICT r2: the Megatron layer must not sit idle
+    behind operator configuration)."""
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.settings import Settings
+    from chiaswarm_tpu.node.worker import Worker
+
+    # estimate_family_bytes traces full SDXL abstractly (seconds); pin the
+    # HBM budget so the test is deterministic across backends
+    from chiaswarm_tpu.core import mesh as mesh_mod
+
+    monkeypatch.setattr(mesh_mod, "device_hbm_bytes",
+                        lambda device=None: 16 * 1024**3)
+
+    sdxl_reg = ModelRegistry(
+        catalog=[{"name": "stabilityai/stable-diffusion-xl-base-1.0",
+                  "family": "sdxl", "parameters": {}}],
+        allow_random=True)
+    worker = Worker(settings=Settings(hive_uri="http://x", hive_token="t"),
+                    registry=sdxl_reg)
+    shape = worker.pool.slots[0].descriptor()["mesh_shape"]
+    assert shape == {"data": 4, "model": 2, "seq": 1}
+
+    tiny_reg = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+    worker2 = Worker(settings=Settings(hive_uri="http://x", hive_token="t"),
+                     registry=tiny_reg)
+    shape2 = worker2.pool.slots[0].descriptor()["mesh_shape"]
+    assert shape2 == {"data": 8, "model": 1, "seq": 1}
+
+
 def test_chip_pool_slots_and_seed_recording():
     pool = ChipPool(n_slots=4)
     assert len(pool) == 4

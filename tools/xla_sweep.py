@@ -1,0 +1,94 @@
+"""XLA compiler-option sweep driver for the headline bench.
+
+Runs `bench.py` (headline config only) once per experiment in a fresh
+subprocess with ``CHIASWARM_XLA_OPTIONS`` set, and prints a results
+table. Per-executable compiler options change XLA's persistent-cache
+key, so experiments never poison each other's cache entries.
+
+Usage:
+    python tools/xla_sweep.py                 # built-in experiment list
+    python tools/xla_sweep.py name=k=v,k2=v2  # ad-hoc experiments
+
+Results belong in BASELINE.md (accepted AND rejected — the reject table
+is what stops the next person from re-running dead ends).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# curated conv-scheduling candidates; an unknown flag fails compilation
+# and records as "invalid" (harmless — that is also an answer)
+DEFAULT_EXPERIMENTS: dict[str, str] = {
+    "baseline": "",
+    "vmem_24m": "xla_tpu_scoped_vmem_limit_kib=24576",
+    "vmem_32m": "xla_tpu_scoped_vmem_limit_kib=32768",
+    "no_rwb_fusion": "xla_tpu_rwb_fusion=false",
+    "async_scale2": "xla_tpu_async_copy_bandwidth_scaling_factor=2",
+    "no_multi_nested": "xla_tpu_enable_multi_level_nested_loop_fusion=false",
+    "flash_q4096": "",  # CHIASWARM_FLASH_BLOCK_Q sweep rides env below
+}
+
+EXTRA_ENV: dict[str, dict[str, str]] = {
+    "flash_q4096": {"CHIASWARM_FLASH_BLOCK_Q": "4096",
+                    "CHIASWARM_FLASH_BLOCK_KV": "1024",
+                    "CHIASWARM_FLASH_VMEM_MB": "64"},
+}
+
+
+def run_one(name: str, options: str, iters: int = 4,
+            timeout_s: int = 3600) -> dict:
+    env = dict(os.environ)
+    env["CHIASWARM_XLA_OPTIONS"] = options
+    env["CHIASWARM_BENCH_CONFIGS"] = "headline"
+    env["CHIASWARM_BENCH_ITERS"] = str(iters)
+    env.update(EXTRA_ENV.get(name, {}))
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout_s)
+    wall = time.time() - t0
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+        return {"name": name, "options": options, "status": "invalid",
+                "wall_s": round(wall, 1), "error": " | ".join(tail)}
+    data = json.loads(line)
+    return {"name": name, "options": options, "status": "ok",
+            "p50_s": data["p50_latency_s"],
+            "images_per_sec": data["value"],
+            "wall_s": round(wall, 1)}
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        experiments = {}
+        for arg in sys.argv[1:]:
+            name, _, opts = arg.partition("=")
+            experiments[name] = opts
+    else:
+        experiments = DEFAULT_EXPERIMENTS
+
+    results = []
+    for name, opts in experiments.items():
+        print(f"== {name}: {opts or '(none)'} ...", flush=True)
+        result = run_one(name, opts)
+        results.append(result)
+        print(f"   {result}", flush=True)
+
+    print("\nname\tstatus\tp50_s\timg/s")
+    for r in results:
+        print(f"{r['name']}\t{r['status']}\t{r.get('p50_s', '-')}\t"
+              f"{r.get('images_per_sec', '-')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -382,7 +382,16 @@ class DiffusionPipeline:
         """Host image(s) -> scaled latents (the img2img/inpaint init).
 
         Accepts (H, W, 3) for one shared init or (B, H, W, 3) for per-item
-        inits (video frames riding the batch axis, workloads/video.py)."""
+        inits (video frames riding the batch axis, workloads/video.py).
+
+        COMPILED: an eager ``vae.apply`` dispatches hundreds of tiny ops
+        per call — on a tunneled chip that alone costs seconds per
+        img2img job (the r2 bench regression). The executable rides the
+        global LRU like every other program (thread-safe, evictable) and
+        the batch is padded to the pow2 compile bucket so per-frame-count
+        vid2vid chunks cannot fan out executables; the module closure
+        carries no params (they pass as an argument, so the param LRU
+        can still evict the tree)."""
         img = _to_float_image(image)
         if img.ndim == 3:
             img = img[None]
@@ -391,10 +400,21 @@ class DiffusionPipeline:
                 f"init image {img.shape[1:3]} != requested {(height, width)}; "
                 "resize on host first (node.job_args does this)"
             )
-        return self.c.vae.apply(
-            self.c.params["vae"], jnp.asarray(img), key_for_seed(seed),
-            method=AutoencoderKL.encode,
-        )
+        n = img.shape[0]
+        bucket = bucket_batch(n)
+        if n < bucket:
+            img = np.concatenate(
+                [img, np.repeat(img[-1:], bucket - n, axis=0)], axis=0)
+        vae = self.c.vae
+        fn = GLOBAL_CACHE.cached_executable(
+            static_cache_key(id(self.c), "encode",
+                             {"batch": bucket, "height": height,
+                              "width": width}),
+            lambda: toplevel_jit(
+                lambda params, x, key: vae.apply(
+                    params, x, key, method=AutoencoderKL.encode)))
+        z = fn(self.c.params["vae"], jnp.asarray(img), key_for_seed(seed))
+        return z[:n]
 
     def __call__(self, req: GenerateRequest) -> tuple[np.ndarray, dict]:
         """Run a request. Returns (images uint8 (B,H,W,3), config dict)."""

@@ -98,8 +98,9 @@ def device_hbm_bytes(device: jax.Device | None = None) -> int:
 
 def derive_mesh_spec(n_devices: int,
                      heaviest_param_bytes: int | None = None,
-                     hbm_bytes: int | None = None) -> MeshSpec:
-    """Default dp x tp policy for a serving pool — no hand-written
+                     hbm_bytes: int | None = None,
+                     latency: bool = False) -> MeshSpec:
+    """Default dp x tp (x sp) policy for a serving pool — no hand-written
     ``mesh_shape`` required.
 
     Data parallelism is the throughput axis (cross-job coalescing rides
@@ -108,7 +109,13 @@ def derive_mesh_spec(n_devices: int,
     comfortably on one chip (> _PARAM_HBM_FRACTION of HBM): tp doubles —
     over power-of-two divisors of the device count — until the per-chip
     shard fits. On a v5e-8 with SDXL in the catalog (~7 GB bf16) that
-    lands on dp=4 x tp=2; SD1.5-only catalogs stay dp=8."""
+    lands on dp=4 x tp=2; SD1.5-only catalogs stay dp=8.
+
+    ``latency=True`` (settings.latency_mode) flips the trade: the leftover
+    devices go to the ``seq`` axis, so every job's large spatial
+    self-attention runs as sequence-parallel ring attention over ICI
+    (ops/attention.py::_try_ring) — shorter per-job latency instead of
+    coalesced throughput."""
     if n_devices <= 1:
         return MeshSpec({DATA_AXIS: 1})
     if hbm_bytes is None:
@@ -119,7 +126,15 @@ def derive_mesh_spec(n_devices: int,
         while (heaviest_param_bytes / tp > budget
                and tp * 2 <= n_devices and n_devices % (tp * 2) == 0):
             tp *= 2
-    return MeshSpec({DATA_AXIS: n_devices // tp, MODEL_AXIS: tp})
+    rest = n_devices // tp
+    # seq must divide the power-of-two spatial token counts (4096/1024/
+    # 256/64) or _try_ring can never engage: cap it to the largest
+    # power-of-two factor and return the remainder to data
+    sp = rest & (-rest) if latency else 1
+    if sp > 1:
+        return MeshSpec({DATA_AXIS: rest // sp, MODEL_AXIS: tp,
+                         SEQ_AXIS: sp})
+    return MeshSpec({DATA_AXIS: rest, MODEL_AXIS: tp})
 
 
 def build_mesh(

@@ -49,6 +49,10 @@ class Settings:
     huggingface_token: str = ""
     # TPU-native additions
     mesh_shape: dict[str, int] | None = None  # e.g. {"data": 8} ; None = auto
+    # auto-mesh policy: True gives leftover chips to the ``seq`` axis
+    # (ring attention shortens each job) instead of ``data`` (coalescing
+    # raises job throughput) — see core/mesh.py::derive_mesh_spec
+    latency_mode: bool = False
     precision: str = "bfloat16"
     use_flash_attention: bool = True
     compile_cache_size: int = 4

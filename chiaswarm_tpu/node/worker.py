@@ -96,18 +96,17 @@ class Worker:
         # its pipeline depth (transfer/compute overlap) and its data-axis
         # width (cross-job coalescing needs that many jobs queued). The
         # reference sizes its queue to the GPU count (worker.py:186).
-        # coalescing (and therefore data_width-sized bursts) only runs on
-        # single-slot pools (_slot_worker); multi-slot pools must not
-        # over-claim jobs no slot can batch
-        coalescing = len(self.pool) == 1
         self.work_queue: asyncio.Queue = asyncio.Queue(
             maxsize=sum(
-                max(getattr(slot, "depth", 1),
-                    slot.data_width if coalescing else 1)
+                max(getattr(slot, "depth", 1), slot.data_width)
                 for slot in self.pool))
         self.result_queue: asyncio.Queue = asyncio.Queue()
         self._stop = asyncio.Event()
         self.jobs_done = 0
+        # slots currently blocked on work_queue.get(): the burst drain
+        # leaves this many jobs in the queue so coalescing on one slot
+        # never starves an idle neighbor (multi-slot fairness reserve)
+        self._hungry_slots = 0
 
     def _default_pool(self) -> ChipPool:
         """One slot over all chips. An explicit ``mesh_shape`` setting
@@ -121,7 +120,8 @@ class Worker:
             spec = MeshSpec(dict(self.settings.mesh_shape))
         else:
             spec = derive_mesh_spec(len(jax.devices()),
-                                    self._heaviest_catalog_bytes())
+                                    self._heaviest_catalog_bytes(),
+                                    latency=self.settings.latency_mode)
             log.info("derived default mesh: %s", spec.shape)
         return ChipPool(n_slots=1, mesh_spec=spec)
 
@@ -274,10 +274,11 @@ class Worker:
         # cross-job coalescing: a dp-sharded slot runs up to dp compatible
         # jobs as ONE batched program (executor groups them; incompatible
         # jobs in a burst just run serially). Single-data-row slots gain
-        # nothing (batch scaling is linear on one chip) and multi-slot
-        # pools must not greedily drain jobs another idle slot could run,
-        # so the burst drain is limited to single-slot dp pools.
-        max_merge = slot.data_width if len(self.pool) == 1 else 1
+        # nothing (batch scaling is linear on one chip). On multi-slot
+        # pools the drain loop below additionally leaves ``_hungry_slots``
+        # jobs in the queue, so a coalescing slot never strips work an
+        # idle neighbor is already waiting for.
+        max_merge = slot.data_width
 
         async def run_burst(burst: list[dict]) -> None:
             try:
@@ -303,10 +304,19 @@ class Worker:
                 if held is not None:
                     burst, held = [held], None
                 else:
-                    burst = [await self.work_queue.get()]
+                    self._hungry_slots += 1
+                    try:
+                        burst = [await self.work_queue.get()]
+                    finally:
+                        self._hungry_slots -= 1
                 key = _burst_key(burst[0])
                 rows = rows_max = job_rows(burst[0])
                 while key is not None and len(burst) < max_merge:
+                    # fairness reserve: jobs other slots are blocked on
+                    # stay in the queue (the drain below has no awaits,
+                    # so this count cannot change mid-drain)
+                    if self.work_queue.qsize() <= self._hungry_slots:
+                        break
                     try:
                         candidate = self.work_queue.get_nowait()
                     except asyncio.QueueEmpty:

@@ -1,7 +1,7 @@
 """Attention dispatch — the TPU replacement for the reference's xformers
 memory-efficient attention (enabled at swarm/diffusion/diffusion_func.py:86-87).
 
-Three implementations behind one function:
+Four implementations behind one function:
 
 - ``"xla"``      — plain einsum softmax attention; XLA fuses it well for the
                    small/medium sequence lengths of image latents. Always
@@ -9,7 +9,13 @@ Three implementations behind one function:
 - ``"flash"``    — Pallas blockwise flash-attention kernel (ops/flash_attention.py),
                    O(L) memory, targets the MXU; used on TPU for large token
                    counts (SDXL 1024px self-attention = 4096 tokens, video).
-- ``"auto"``     — flash on TPU when shapes qualify, else xla.
+- ``"ring"``     — sequence-parallel ring attention (parallel/ring_attention.py):
+                   tokens sharded over the mesh's ``seq`` axis, KV blocks
+                   rotated on ICI. Engaged when the pipeline runs under
+                   parallel.context.sequence_parallel on a seq>1 mesh —
+                   self-attention only (cross-attention KV is 77 tokens).
+- ``"auto"``     — ring when a seq-parallel mesh is active and shapes
+                   qualify, else flash on TPU when shapes qualify, else xla.
 
 All take (B, L, H, D) query / (B, S, H, D) key-value tensors and return
 (B, L, H, D). Head-batched layouts keep the last dim = head_dim (128-lane
@@ -24,7 +30,59 @@ from typing import Literal
 import jax
 import jax.numpy as jnp
 
-AttentionImpl = Literal["auto", "xla", "flash"]
+AttentionImpl = Literal["auto", "xla", "flash", "ring"]
+
+_RING_MIN_TOKENS = 1024  # same bar as the flash kernel; env-overridable
+
+
+def _ring_min_tokens() -> int:
+    import os
+
+    return int(os.environ.get("CHIASWARM_RING_MIN_TOKENS", _RING_MIN_TOKENS))
+
+
+def _try_ring(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float,
+              impl: str) -> jnp.ndarray | None:
+    """Sequence-parallel dispatch: shard tokens over the active mesh's
+    ``seq`` axis and run the ppermute ring. None = not eligible.
+
+    The specs compose with the other parallel axes: batch rides ``data``
+    and heads ride ``model`` (Megatron head sharding) whenever divisible,
+    so a dp x tp x sp mesh needs no resharding beyond the ring itself.
+    Per-shard attention inside the ring is the einsum recurrence — local
+    sequences are L/sp, below the flash kernel's win threshold."""
+    from chiaswarm_tpu.parallel.context import active_seq_mesh
+
+    mesh = active_seq_mesh()
+    if mesh is None:
+        return None
+    b, l, h, _ = q.shape
+    if k.shape[1] != l:
+        return None  # cross-attention: tiny KV, the einsum path wins
+    from chiaswarm_tpu.core.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+    sizes = dict(mesh.shape)
+    sp = sizes.get(SEQ_AXIS, 1)
+    if l % sp or (impl != "ring" and l < _ring_min_tokens()):
+        return None
+    from functools import partial
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.6 jax exposes it under experimental
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from chiaswarm_tpu.parallel.ring_attention import ring_attention
+
+    dp, tp = sizes.get(DATA_AXIS, 1), sizes.get(MODEL_AXIS, 1)
+    spec = P(DATA_AXIS if dp > 1 and b % dp == 0 else None,
+             SEQ_AXIS,
+             MODEL_AXIS if tp > 1 and h % tp == 0 else None,
+             None)
+    fn = shard_map(partial(ring_attention, axis_name=SEQ_AXIS, scale=scale),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
 
 
 def _xla_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -65,6 +123,25 @@ def attention(
         raise ValueError(f"expected (B, L, H, D) tensors, got {q.shape}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+
+    # sequence-parallel dispatch is orthogonal to the LOCAL impl choice:
+    # under an active seq>1 mesh even impl="xla" callers (e.g. a
+    # latency_mode worker with use_flash_attention=false) ring their
+    # large self-attentions — the guards inside _try_ring keep small
+    # sequences on the local paths
+    out = _try_ring(q, k, v, scale, impl)
+    if out is not None:
+        return out
+    if impl == "ring":
+        from chiaswarm_tpu.parallel.context import active_seq_mesh
+
+        if active_seq_mesh() is None:
+            raise ValueError(
+                "impl='ring' requires an active sequence-parallel mesh "
+                "(parallel.context.sequence_parallel)")
+        # mesh active but shape not divisible by the seq axis:
+        # correctness first, fall through to the local paths
+        impl = "auto"
 
     use_flash = False
     if impl == "flash":

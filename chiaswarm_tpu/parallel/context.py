@@ -1,0 +1,109 @@
+"""Trace-time sequence-parallel context.
+
+The reference has no sequence-parallel serving mode (its long-input answer
+is single-GPU attention slicing, swarm/diffusion/diffusion_func.py:85-88).
+Here, a pipeline whose params live on a mesh with a ``seq`` axis > 1 routes
+its large self-attentions through `parallel.ring_attention` automatically:
+the pipeline enters :func:`sequence_parallel` around its jitted program, and
+`ops.attention` reads :func:`active_seq_mesh` at TRACE time to decide the
+dispatch (a static decision — under `jax.jit` the context only needs to be
+live during the first call that traces).
+
+A contextvar (not a global) so hermetic tests can run pipelines on
+different meshes in one process without cross-talk.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+from jax.sharding import Mesh
+
+from chiaswarm_tpu.core.mesh import SEQ_AXIS
+
+_seq_mesh: contextvars.ContextVar[Mesh | None] = contextvars.ContextVar(
+    "chiaswarm_seq_mesh", default=None)
+
+
+def active_seq_mesh() -> Mesh | None:
+    """The mesh whose ``seq`` axis should shard attention, or None.
+
+    Returns None unless the context is entered AND the mesh actually has a
+    ``seq`` axis of size > 1 — callers need no further checks."""
+    mesh = _seq_mesh.get()
+    if mesh is not None and dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+        return mesh
+    return None
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh: Mesh | None):
+    """Route qualifying attention through the ring kernel over ``mesh``.
+
+    Entering with None (or a seq=1 mesh) is a no-op, so pipelines can wrap
+    their programs unconditionally."""
+    token = _seq_mesh.set(mesh)
+    try:
+        yield
+    finally:
+        _seq_mesh.reset(token)
+
+
+def _seq_mesh_of_params(params) -> Mesh | None:
+    """The seq>1 mesh ``params`` are placed on, or None."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    for leaf in jax.tree.leaves(params):
+        s = getattr(leaf, "sharding", None)
+        if isinstance(s, NamedSharding) and s.mesh.devices.size > 1:
+            if dict(s.mesh.shape).get(SEQ_AXIS, 1) > 1:
+                return s.mesh
+            return None  # one placement per param tree; first leaf decides
+    return None
+
+
+@contextlib.contextmanager
+def capture_ring_calls():
+    """Observe ring_attention invocations (dryrun/test instrumentation):
+    yields a list that accumulates each call's q shape.
+
+    The package re-exports the function under its own name, so the real
+    submodule is fetched via importlib (attribute-style ``import a.b as
+    m`` would grab the function) and its attribute is swapped for the
+    duration — ops.attention imports it at call time, so the swap is
+    always observed."""
+    import importlib
+
+    mod = importlib.import_module("chiaswarm_tpu.parallel.ring_attention")
+    calls: list = []
+    real = mod.ring_attention
+
+    def observing(*args, **kwargs):
+        calls.append(args[0].shape)
+        return real(*args, **kwargs)
+
+    mod.ring_attention = observing
+    try:
+        yield calls
+    finally:
+        mod.ring_attention = real
+
+
+def seq_parallel_wrap(jitted, params):
+    """Wrap a jitted pipeline program so it traces (and re-traces, after
+    executable-LRU rebuilds) under :func:`sequence_parallel` whenever
+    ``params`` live on a mesh with a ``seq`` axis > 1 — the single hook
+    every pipeline uses to make ring attention a serving path rather than
+    a demo. No-seq-mesh callers get the jitted fn back untouched (zero
+    overhead on the common path)."""
+    mesh = _seq_mesh_of_params(params)
+    if mesh is None:
+        return jitted
+
+    def wrapped(*args):
+        with sequence_parallel(mesh):
+            return jitted(*args)
+
+    return wrapped

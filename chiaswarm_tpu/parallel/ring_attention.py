@@ -67,16 +67,16 @@ def ring_attention(
     n = jax.lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    b, lq, h, d = q.shape
-    # mark the zero-init carries as device-varying over the ring axis
-    # (shard_map's varying-axis type system requires carry in/out to agree)
-    if hasattr(jax.lax, "pcast"):
-        vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
-    else:  # older jax
-        vary = lambda x: jax.lax.pvary(x, axis_name)
-    o0 = vary(jnp.zeros((b, lq, h, d), jnp.float32))
-    m0 = vary(jnp.full((b, h, lq), _NEG_INF, jnp.float32))
-    l0 = vary(jnp.zeros((b, h, lq), jnp.float32))
+    # The zero-init carries must carry the same varying-axes type as the
+    # loop-updated values (shard_map's vma system rejects a mismatch), and
+    # q/k/v may vary over OTHER mesh axes too (dp x sp x tp serving: batch
+    # on 'data', heads on 'model'). Deriving the zeros from q arithmetic
+    # inherits the full varying set on any jax version; XLA folds the
+    # zero-multiplies away.
+    o0 = (q * 0).astype(jnp.float32)                        # (B, L, H, D)
+    zrow = jnp.sum(o0, axis=-1).transpose(0, 2, 1)          # (B, H, L) zeros
+    m0 = zrow + _NEG_INF
+    l0 = zrow
 
     def body(carry, _):
         k_blk, v_blk, o_acc, m_acc, l_acc = carry

@@ -37,6 +37,7 @@ from chiaswarm_tpu.core.compile_cache import (
     bucket_image_size,
     static_cache_key,
 )
+from chiaswarm_tpu.parallel.context import seq_parallel_wrap
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.vae import AutoencoderKL
 from chiaswarm_tpu.pipelines.components import Components
@@ -367,7 +368,10 @@ class DiffusionPipeline:
             return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
                     ).astype(jnp.uint8)
 
-        return toplevel_jit(fn)
+        # seq>1 param meshes trace under the sequence-parallel context so
+        # ops.attention routes the large spatial self-attentions through
+        # the ppermute ring (parallel/ring_attention.py)
+        return seq_parallel_wrap(toplevel_jit(fn), self.c.params)
 
     def _get_fn(self, **static: Any):
         return GLOBAL_CACHE.cached_executable(

@@ -25,6 +25,7 @@ from chiaswarm_tpu.core.compile_cache import (
     bucket_image_size,
     static_cache_key,
 )
+from chiaswarm_tpu.parallel.context import seq_parallel_wrap
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.clip import ClipTextEncoder
 from chiaswarm_tpu.models.configs import (
@@ -356,7 +357,7 @@ class VideoPipeline:
             return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
                     ).astype(jnp.uint8)   # (F, H, W, 3) uint8
 
-        return toplevel_jit(fn)
+        return seq_parallel_wrap(toplevel_jit(fn), self.c.params)
 
     def _get_fn(self, **static):
         return GLOBAL_CACHE.cached_executable(

@@ -59,6 +59,24 @@ def test_derive_mesh_spec_policy():
     # odd device counts cannot split: dp-only even for big models
     assert derive_mesh_spec(3, 30 * gib, hbm_bytes=16 * gib).shape == \
         {"data": 3, "model": 1}
+    # latency mode: leftover chips ride ``seq`` (ring attention) not dp
+    assert derive_mesh_spec(8, 2 * gib, hbm_bytes=16 * gib,
+                            latency=True).shape == \
+        {"data": 1, "model": 1, "seq": 8}
+    assert derive_mesh_spec(8, 7 * gib, hbm_bytes=16 * gib,
+                            latency=True).shape == \
+        {"data": 1, "model": 2, "seq": 4}
+    # latency mode on one chip degenerates to the single-chip mesh
+    assert derive_mesh_spec(1, 7 * gib, latency=True).shape == {"data": 1}
+    # non-pow2 remainder: seq takes only the pow2 factor (it must divide
+    # the pow2 spatial token counts or ring attention never engages);
+    # the rest returns to data
+    assert derive_mesh_spec(6, 2 * gib, hbm_bytes=16 * gib,
+                            latency=True).shape == \
+        {"data": 3, "model": 1, "seq": 2}
+    assert derive_mesh_spec(3, 2 * gib, hbm_bytes=16 * gib,
+                            latency=True).shape == \
+        {"data": 3, "model": 1}
 
 
 def test_worker_default_pool_derives_tp_for_big_families(monkeypatch):
@@ -93,6 +111,13 @@ def test_worker_default_pool_derives_tp_for_big_families(monkeypatch):
                      registry=tiny_reg)
     shape2 = worker2.pool.slots[0].descriptor()["mesh_shape"]
     assert shape2 == {"data": 8, "model": 1, "seq": 1}
+
+    # latency_mode flips the leftover chips onto the ring-attention axis
+    worker3 = Worker(settings=Settings(hive_uri="http://x", hive_token="t",
+                                       latency_mode=True),
+                     registry=tiny_reg)
+    shape3 = worker3.pool.slots[0].descriptor()["mesh_shape"]
+    assert shape3 == {"data": 1, "model": 1, "seq": 8}
 
 
 def test_chip_pool_slots_and_seed_recording():

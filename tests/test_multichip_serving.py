@@ -58,6 +58,31 @@ def test_multichip_matches_single_chip_output():
     assert (diff <= 2).mean() > 0.99, diff.max()
 
 
+def test_seq_parallel_serving_matches_single_chip(monkeypatch):
+    """latency_mode serving: params on a seq=4 mesh route the UNet's
+    spatial self-attention through ring attention (ops/attention.py
+    _try_ring via parallel/context.py::seq_parallel_wrap) and the
+    pixels match the single-chip run."""
+    from chiaswarm_tpu.parallel.context import capture_ring_calls
+    from chiaswarm_tpu.pipelines import GenerateRequest
+
+    monkeypatch.setenv("CHIASWARM_RING_MIN_TOKENS", "1")
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 2, "seq": 4}))
+
+    req = GenerateRequest(prompt="a lighthouse", steps=2, height=64,
+                          width=64, seed=21, guidance_scale=5.0)
+    with capture_ring_calls() as rings:
+        single_img, _ = registry.pipeline("random/tiny")(req)
+        assert not rings  # single-chip never rings
+        seq_img, _ = registry.pipeline("random/tiny",
+                                       mesh=pool.slots[0].mesh)(req)
+    assert rings, "seq-mesh pipeline never reached ring attention"
+    diff = np.abs(single_img.astype(np.int32) - seq_img.astype(np.int32))
+    assert (diff <= 2).mean() > 0.99, diff.max()
+
+
 def test_caption_params_pin_to_slot_chip():
     """Per-slot caption serving: params land on the slot's lead chip, not
     the default device (registry.caption_pipeline mesh placement)."""

@@ -1,0 +1,81 @@
+"""Static HLO cost model of tools/op_roofline.py: conv/dot/flash FLOPs
+and HBM byte estimates from scheduled-HLO text (operands printed as bare
+%names, shapes resolved through the definition map)."""
+
+import importlib.util
+import os
+
+spec = importlib.util.spec_from_file_location(
+    "op_roofline",
+    os.path.join(os.path.dirname(__file__), "..", "tools",
+                 "op_roofline.py"))
+roofline = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(roofline)
+
+
+_HLO = """\
+HloModule jit_fn, is_scheduled=true
+
+%fused_computation.7 (param_0.1: bf16[2,64,64,320], param_1.2: bf16[3,3,320,640]) -> bf16[2,64,64,640] {
+  %param_0.1 = bf16[2,64,64,320]{3,2,1,0:T(8,128)(2,1)} parameter(0)
+  %param_1.2 = bf16[3,3,320,640]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  ROOT %convolution.9 = bf16[2,64,64,640]{3,2,1,0:T(8,128)(2,1)} convolution(%param_0.1, %param_1.2), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f
+}
+
+%fused_computation.8 (p0: bf16[2,4096,640], p1: bf16[640,640]) -> bf16[2,4096,640] {
+  %p0 = bf16[2,4096,640]{2,1,0:T(8,128)(2,1)} parameter(0)
+  %p1 = bf16[640,640]{1,0:T(8,128)(2,1)} parameter(1)
+  ROOT %dot.3 = bf16[2,4096,640]{2,1,0:T(8,128)(2,1)} dot(%p0, %p1), lhs_batch_dims={}, lhs_contracting_dims={2}, rhs_contracting_dims={0}
+}
+
+ENTRY %main (a: bf16[2,64,64,320], w: bf16[3,3,320,640]) -> bf16[2,64,64,640] {
+  %a = bf16[2,64,64,320]{3,2,1,0:T(8,128)(2,1)} parameter(0)
+  %w = bf16[3,3,320,640]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  %pad.1 = f32[8,4096,128]{2,1,0:T(8,128)} parameter(2)
+  %conv_fusion.1 = bf16[2,64,64,640]{3,2,1,0:T(8,128)(2,1)} fusion(%a, %w), kind=kOutput, calls=%fused_computation.7
+  %x = bf16[2,4096,640]{2,1,0:T(8,128)(2,1)} parameter(3)
+  %m = bf16[640,640]{1,0:T(8,128)(2,1)} parameter(4)
+  %dot_fusion.2 = bf16[2,4096,640]{2,1,0:T(8,128)(2,1)} fusion(%x, %m), kind=kOutput, calls=%fused_computation.8
+  %flash_attention = f32[8,4096,128]{2,1,0:T(8,128)S(1)} custom-call(%pad.1, %pad.1, %pad.1), custom_call_target="tpu_custom_call", operand_layout_constraints={f32[8,4096,128]{2,1,0}, f32[8,4096,128]{2,1,0}, f32[8,4096,128]{2,1,0}}
+  ROOT %out = bf16[2,64,64,640]{3,2,1,0:T(8,128)(2,1)} fusion(%conv_fusion.1), kind=kLoop, calls=%fused_computation.7
+}
+"""
+
+
+def test_conv_fusion_flops_and_bytes():
+    costs = roofline.parse_hlo_text(_HLO)
+    conv = costs["conv_fusion.1"]
+    # 2 * out_elems * window * Cin = 2 * (2*64*64*640) * 9 * 320
+    assert conv["flops"] == 2 * (2 * 64 * 64 * 640) * 9 * 320
+    assert conv["kind"] == "conv"
+    # bytes: result + a + w, bf16
+    expect = 2 * (2 * 64 * 64 * 640 + 2 * 64 * 64 * 320 + 3 * 3 * 320 * 640)
+    assert conv["bytes"] == expect
+
+
+def test_dot_fusion_flops():
+    costs = roofline.parse_hlo_text(_HLO)
+    dot = costs["dot_fusion.2"]
+    # 2 * out_elems * K = 2 * (2*4096*640) * 640
+    assert dot["flops"] == 2 * (2 * 4096 * 640) * 640
+    assert dot["kind"] == "dot"
+
+
+def test_flash_custom_call_flops():
+    costs = roofline.parse_hlo_text(_HLO)
+    fl = costs["flash_attention"]
+    # 4 * BH * L * S * D from the folded (B*H, L_pad, D) operands
+    assert fl["flops"] == 4 * 8 * 4096 * 4096 * 128
+    assert fl["kind"] == "flash"
+    # bytes resolve through the definition map (operands are bare %names):
+    # f32 result + three f32 operands
+    assert fl["bytes"] == 4 * (8 * 4096 * 128) * 4
+
+
+def test_operand_scan_stops_at_list_close():
+    shapes = roofline._operand_shapes(
+        "  %f = bf16[4,4]{1,0:T(8,128)(2,1)} fusion(%a, %b), kind=kLoop, "
+        "calls=%c", "fusion",
+        {"a": ("bf16", [4, 4]), "b": ("f32", [2, 2]),
+         "c": ("f32", [9, 9])})
+    assert shapes == [("bf16", [4, 4]), ("f32", [2, 2])]

@@ -157,6 +157,28 @@ def build_mesh(
     return Mesh(device_array, axis_names)
 
 
+def split_mesh(mesh: Mesh, n: int = 2) -> list[Mesh]:
+    """Partition ``mesh``'s devices into ``n`` contiguous data-axis
+    submeshes — the substrate for stage-level pipeline parallelism
+    (pipelines/cascade.py::generate_stage_parallel): each pipeline stage's
+    params live on its own submesh, so XLA's async dispatch runs stage k
+    of item i concurrently with stage k-1 of item i+1 on disjoint chips.
+
+    Contiguous slices follow ``jax.devices()`` order, so each submesh
+    keeps the tightest ICI locality available. Requires the device count
+    to divide evenly."""
+    devices = mesh.devices.flatten().tolist()
+    if n < 1 or len(devices) % n:
+        raise ValueError(
+            f"cannot split {len(devices)} devices into {n} submeshes")
+    per = len(devices) // n
+    return [
+        build_mesh(MeshSpec({DATA_AXIS: per}),
+                   devices=devices[i * per:(i + 1) * per])
+        for i in range(n)
+    ]
+
+
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     """A 1x1x1 mesh for one chip — lets every pipeline be written against a
     mesh unconditionally (no separate single-chip code path)."""

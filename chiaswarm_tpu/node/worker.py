@@ -304,6 +304,17 @@ class Worker:
                 if held is not None:
                     burst, held = [held], None
                 else:
+                    # a slot that ALREADY has work in flight must not
+                    # synchronously grab a job a hungry neighbor is
+                    # blocked on (acquire+get both return without
+                    # yielding when satisfiable, so at depth>=2 this
+                    # slot would steal the fairness reserve before the
+                    # woken neighbor's coroutine ever runs). Yield until
+                    # the reserved jobs are consumed or surplus arrives.
+                    while (pending and self._hungry_slots
+                           and 0 < self.work_queue.qsize()
+                           <= self._hungry_slots):
+                        await asyncio.sleep(0)
                     self._hungry_slots += 1
                     try:
                         burst = [await self.work_queue.get()]

@@ -197,12 +197,17 @@ class CascadePipeline:
             raise ValueError("sr_size must be a power-of-two multiple of "
                              "base_size")
 
-        def denoise(unet, params, sched, steps, x, ctx, cond, guidance, key):
+        def denoise(unet, params, sched, steps, x, ctx, cond, guidance,
+                    row_keys):
             """Shared scan: ``cond`` (static None or array) is channel-
-            concatenated every step (stage-2 conditioning)."""
+            concatenated every step (stage-2 conditioning). ``row_keys``
+            is one PRNG key PER batch row — row b's ancestral noise
+            depends only on its own key, so an image is identical at any
+            batch size (the diffusion pipeline's per-sample contract,
+            pipelines/diffusion.py)."""
 
             def body(carry, i):
-                x, state, key = carry
+                x, state, row_keys = carry
                 inp = scale_model_input(sched, x, i)
                 if cond is not None:
                     inp = jnp.concatenate([inp, cond], axis=-1)
@@ -217,17 +222,20 @@ class CascadePipeline:
                     t1 = sched.timesteps[i][None].repeat(x.shape[0], axis=0)
                     out = unet.apply(params, inp, t1, ctx)
                     eps = out[..., : x.shape[-1]]
-                key, skey = jax.random.split(key)
-                noise = jax.random.normal(skey, x.shape, jnp.float32)
+                both = jax.vmap(jax.random.split)(row_keys)
+                row_keys, skeys = both[:, 0], both[:, 1]
+                noise = jax.vmap(lambda k: jax.random.normal(
+                    k, x.shape[1:], jnp.float32))(skeys)
                 x, state = sampler_step(sampler, sched, i, x, eps, state,
                                         noise=noise, start_index=0)
-                return (x, state, key), None
+                return (x, state, row_keys), None
 
             (x, _, _), _ = jax.lax.scan(
-                body, (x, init_sampler_state(x), key), jnp.arange(steps))
+                body, (x, init_sampler_state(x), row_keys),
+                jnp.arange(steps))
             return x
 
-        def fn(params, ids, neg_ids, key, guidance):
+        def fn(params, ids, neg_ids, row_keys, guidance):
             ctx = t5.apply(params["t5"], ids)
             if use_cfg:
                 nctx = t5.apply(params["t5"], neg_ids)
@@ -235,12 +243,16 @@ class CascadePipeline:
             else:
                 ctx2 = ctx
 
+            def stage_keys(stage: int):
+                return jax.vmap(
+                    lambda k: jax.random.fold_in(k, stage))(row_keys)
+
             # ---- stage 1: 64px base
-            key, k1, k2, k3 = jax.random.split(key, 4)
-            x = jax.random.normal(k1, (batch, s1, s1, 3), jnp.float32)
+            x = jax.vmap(lambda k: jax.random.normal(
+                k, (s1, s1, 3), jnp.float32))(stage_keys(1))
             x = x * sched1.sigmas[0]
             x = denoise(unet1, params["unet1"], sched1, steps1, x, ctx2,
-                        None, guidance, k2)
+                        None, guidance, stage_keys(2))
             x = jnp.clip(x, -1.0, 1.0)
 
             # ---- stage 2: super-res, conditioned on upsampled stage 1
@@ -249,11 +261,11 @@ class CascadePipeline:
             cond = x
             for _ in range((s2 // s1).bit_length() - 1):
                 cond = upsample2x_nearest(cond)
-            key, k4, k5 = jax.random.split(key, 3)
-            y = jax.random.normal(k4, (batch, s2, s2, 3), jnp.float32)
+            y = jax.vmap(lambda k: jax.random.normal(
+                k, (s2, s2, 3), jnp.float32))(stage_keys(3))
             y = y * sched2.sigmas[0]
             y = denoise(unet2, params["unet2"], sched2, steps2, y, ctx2,
-                        cond, guidance, k5)
+                        cond, guidance, stage_keys(4))
             # quantize ON DEVICE: uint8 moves 4x fewer bytes over the
             # host link (pipelines/diffusion.py rationale)
             return (jnp.clip((y + 1.0) * 127.5 + 0.5, 0.0, 255.0)
@@ -266,17 +278,23 @@ class CascadePipeline:
             static_cache_key(id(self.c), "cascade", static),
             lambda: self._build_fn(**static))
 
-    def __call__(self, prompt: str, negative_prompt: str = "",
-                 steps: int = 50, sr_steps: int = 30,
-                 guidance_scale: float = 7.0, batch: int = 1,
-                 seed: int = 0, scheduler: str | None = None,
-                 upscaler=None, final_size: int | None = None,
-                 ) -> tuple[np.ndarray, dict]:
-        """Full IF protocol. Stages 1+2 (base -> sr_size) always run; when
-        ``upscaler`` (a LatentUpscalePipeline) is provided the cascade runs
-        its third stage — repeated x2 latent-upscale denoise passes until
-        ``final_size`` (default 4 * sr_size, the reference's x4-upscaler
-        output: 256 -> 1024, diffusion_func_if.py:31-40,63-65)."""
+    def submit(self, prompt: str, negative_prompt: str = "",
+               steps: int = 50, sr_steps: int = 30,
+               guidance_scale: float = 7.0, batch: int = 1,
+               seed: int = 0, scheduler: str | None = None,
+               first_row: int = 0):
+        """Dispatch the stage-1+2 program WITHOUT blocking on the result.
+
+        Returns ``(device_img, requested, config)`` — the uint8 output is
+        still materializing on the chip (jax async dispatch), so a caller
+        can queue more work (the next item's stages, another submesh's
+        stage 3) before paying the transfer. The blocking path is
+        ``__call__``.
+
+        Row b's noise key is ``fold_in(key_for_seed(seed), first_row+b)``
+        — the per-sample contract: a (seed, row) pair draws the same
+        image whether it runs inside a batch or as a batch-1 program at
+        ``first_row=row`` (generate_stage_parallel relies on this)."""
         requested = max(1, batch)
         batch = bucket_batch(requested)
         sampler = resolve(scheduler, prediction_type="epsilon")
@@ -288,11 +306,12 @@ class CascadePipeline:
         fn = self._get_fn(batch=batch, steps1=int(steps),
                           steps2=int(sr_steps), sampler=sampler,
                           use_cfg=use_cfg)
-        img = fn(self.c.params, ids, neg, key_for_seed(seed),
+        base_key = key_for_seed(seed)
+        row_keys = jax.vmap(
+            lambda r: jax.random.fold_in(base_key, r)
+        )(jnp.arange(first_row, first_row + batch))
+        img = fn(self.c.params, ids, neg, row_keys,
                  jnp.float32(guidance_scale))
-        img_u8 = np.asarray(jax.device_get(img))  # uint8 off-chip
-        img_u8 = img_u8[:requested]  # trim the pow2 compile bucket padding
-        stages = 2
         config = {
             "model_name": self.c.model_name,
             "family": self.c.family.name,
@@ -303,28 +322,114 @@ class CascadePipeline:
             "size": [self.c.family.sr_size, self.c.family.sr_size],
             "scheduler": sampler.kind,
         }
+        return img, requested, config
+
+    def __call__(self, prompt: str, negative_prompt: str = "",
+                 steps: int = 50, sr_steps: int = 30,
+                 guidance_scale: float = 7.0, batch: int = 1,
+                 seed: int = 0, scheduler: str | None = None,
+                 upscaler=None, final_size: int | None = None,
+                 ) -> tuple[np.ndarray, dict]:
+        """Full IF protocol. Stages 1+2 (base -> sr_size) always run; when
+        ``upscaler`` (a LatentUpscalePipeline) is provided the cascade runs
+        its third stage — repeated x2 latent-upscale denoise passes until
+        ``final_size`` (default 4 * sr_size, the reference's x4-upscaler
+        output: 256 -> 1024, diffusion_func_if.py:31-40,63-65)."""
+        img, requested, config = self.submit(
+            prompt, negative_prompt, steps=steps, sr_steps=sr_steps,
+            guidance_scale=guidance_scale, batch=batch, seed=seed,
+            scheduler=scheduler)
+        img_u8 = np.asarray(jax.device_get(img))  # uint8 off-chip
+        img_u8 = img_u8[:requested]  # trim the pow2 compile bucket padding
+        stages = 2
         if upscaler is not None:
-            # ---- stage 3: upscale denoise passes to final_size (one x4
-            # pass for the SD-x4-upscaler; two passes for an x2-class
-            # stand-in). The reference's stage 3 re-conditions on the raw
-            # prompt STRING (diffusion_func_if.py:63-65 — the shared T5
-            # embeds stop at stage 2; the x4-upscaler is CLIP-conditioned),
-            # so passing ``prompt`` down is the faithful contract here too.
-            target = int(final_size or self.c.family.sr_size * 4)
-            passes = 0
-            prev_size = 0
-            # the upscaler buckets its input at 1024 max, so output caps at
-            # 2048: stop when a pass makes no progress (else a hive job
-            # with an oversized final_size would spin this loop forever)
-            while img_u8.shape[1] < target and img_u8.shape[1] > prev_size:
-                prev_size = img_u8.shape[1]
-                img_u8, up_config = upscaler(img_u8, prompt=prompt or "",
-                                             seed=seed)
-                passes += 1
-                config.update(up_config)
-            if passes:
+            img_u8, stage3 = _run_stage3(img_u8, upscaler, prompt, seed,
+                                         final_size or
+                                         self.c.family.sr_size * 4)
+            config.update(stage3)
+            if "stage3_passes" in stage3:
                 stages += 1
-                config["stage3_passes"] = passes
             config["size"] = list(img_u8.shape[1:3])
         config["stages"] = stages
         return img_u8, config
+
+
+def _run_stage3(img_u8: np.ndarray, upscaler, prompt: str, seed: int,
+                final_size: int, first_row: int = 0,
+                ) -> tuple[np.ndarray, dict]:
+    """Stage 3: upscale denoise passes to ``final_size`` (one x4 pass for
+    the SD-x4-upscaler; two passes for an x2-class stand-in). The
+    reference's stage 3 re-conditions on the raw prompt STRING
+    (diffusion_func_if.py:63-65 — the shared T5 embeds stop at stage 2;
+    the x4-upscaler is CLIP-conditioned), so passing ``prompt`` down is
+    the faithful contract here too."""
+    target = int(final_size)
+    config: dict = {}
+    passes = 0
+    prev_size = 0
+    # the upscaler buckets its input at 1024 max, so output caps at
+    # 2048: stop when a pass makes no progress (else a hive job with an
+    # oversized final_size would spin this loop forever)
+    while img_u8.shape[1] < target and img_u8.shape[1] > prev_size:
+        prev_size = img_u8.shape[1]
+        img_u8, up_config = upscaler(img_u8, prompt=prompt or "",
+                                     seed=seed, first_row=first_row)
+        passes += 1
+        config.update(up_config)
+    if passes:
+        config["stage3_passes"] = passes
+    return img_u8, config
+
+
+def generate_stage_parallel(pipe: CascadePipeline, upscaler, *,
+                            prompt: str, negative_prompt: str = "",
+                            steps: int = 50, sr_steps: int = 30,
+                            guidance_scale: float = 7.0, n_images: int = 1,
+                            seed: int = 0, scheduler: str | None = None,
+                            final_size: int | None = None,
+                            ) -> tuple[np.ndarray, dict]:
+    """Pipeline-parallel cascade: stages 1+2 and stage 3 on DISJOINT
+    submeshes (core/mesh.py::split_mesh), images streamed through.
+
+    ``pipe``'s params live on submesh A and ``upscaler``'s on submesh B
+    (the registry places each per its own mesh). Every image's stage-1+2
+    program is dispatched up front (jax async dispatch queues them on A),
+    then each result is handed to stage 3 on B as it lands — so image
+    i+1's base/SR denoise runs CONCURRENTLY with image i's x4 upscale on
+    different chips. Wall-clock approaches max(sum_A, sum_B) + one stage
+    latency, vs their sum when the stages share chips. The reference runs
+    the three IF stages strictly sequentially on one GPU
+    (diffusion_func_if.py:41-65).
+
+    Image i runs as a batch-1 program at ``first_row=i``, so its noise
+    keys are ``fold_in(key_for_seed(seed), i)`` — EXACTLY what row i of
+    the single-program batched path draws. The same (seed, index) yields
+    the same image on any slot topology (the diffusion pipeline's
+    per-sample noise-key contract)."""
+    n_images = max(1, int(n_images))
+    submitted = []
+    for i in range(n_images):
+        img_dev, _, config = pipe.submit(
+            prompt, negative_prompt, steps=steps, sr_steps=sr_steps,
+            guidance_scale=guidance_scale, batch=1, seed=seed,
+            scheduler=scheduler, first_row=i)
+        submitted.append((img_dev, config))
+
+    outs = []
+    config = dict(submitted[0][1])
+    stages = 2
+    for i, (img_dev, _) in enumerate(submitted):
+        img_u8 = np.asarray(jax.device_get(img_dev))[:1]
+        if upscaler is not None:
+            img_u8, stage3 = _run_stage3(
+                img_u8, upscaler, prompt, seed,
+                final_size or pipe.c.family.sr_size * 4, first_row=i)
+            config.update(stage3)
+            if "stage3_passes" in stage3:
+                stages = 3
+        outs.append(img_u8)
+    images = np.concatenate(outs, axis=0)
+    config["size"] = list(images.shape[1:3])
+    config["stages"] = stages
+    config["pipeline_parallel"] = 2
+    return images, config

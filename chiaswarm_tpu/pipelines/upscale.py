@@ -82,35 +82,48 @@ class LatentUpscalePipeline:
         sched = make_sampling_schedule(self.noise_schedule, steps, sampler)
         latent_ch = fam.vae.latent_channels
 
-        def fn(params, ids, key, image):
+        def fn(params, ids, row_keys, image):
             seqs = []
             for i, te in enumerate(text_encoders):
                 seq, _ = te.apply(params[f"text_encoder_{i}"], ids[i])
                 seqs.append(seq)
             ctx = jnp.concatenate(seqs, axis=-1) if len(seqs) > 1 else seqs[0]
 
-            key, ekey, nkey = jax.random.split(key, 3)
-            z_lo = vae.apply(params["vae"], image, ekey,
-                             method=AutoencoderKL.encode)      # (B,lh,lw,C)
+            # one key PER batch row (fold_in(key_for_seed(seed), row)):
+            # a (seed, row) pair draws the same latents/noise at any
+            # batch size and on any slot topology — the per-sample
+            # contract shared with pipelines/diffusion.py and the
+            # cascade's stage-parallel path
+            def stage_keys(stage: int):
+                return jax.vmap(
+                    lambda k: jax.random.fold_in(k, stage))(row_keys)
+
+            z_lo = jax.vmap(
+                lambda img, k: vae.apply(params["vae"], img[None], k,
+                                         method=AutoencoderKL.encode)[0]
+            )(image, stage_keys(1))                            # (B,lh,lw,C)
             z_cond = upsample2x_nearest(z_lo)                  # (B,2lh,2lw,C)
-            noise = jax.random.normal(
-                nkey, (batch, 2 * lh, 2 * lw, latent_ch), jnp.float32)
+            noise = jax.vmap(lambda k: jax.random.normal(
+                k, (2 * lh, 2 * lw, latent_ch), jnp.float32))(stage_keys(2))
             x = noise * sched.sigmas[0]
 
             def body(carry, i):
-                x, state, key = carry
+                x, state, rkeys = carry
                 inp = scale_model_input(sched, x, i)
                 inp = jnp.concatenate([inp, z_cond], axis=-1)  # 8 channels
                 t = sched.timesteps[i][None].repeat(batch, axis=0)
                 eps = unet.apply(params["unet"], inp, t, ctx)
-                key, skey = jax.random.split(key)
-                step_noise = jax.random.normal(skey, x.shape, jnp.float32)
+                both = jax.vmap(jax.random.split)(rkeys)
+                rkeys, skeys = both[:, 0], both[:, 1]
+                step_noise = jax.vmap(lambda k: jax.random.normal(
+                    k, x.shape[1:], jnp.float32))(skeys)
                 x, state = sampler_step(sampler, sched, i, x, eps, state,
                                         noise=step_noise, start_index=0)
-                return (x, state, key), None
+                return (x, state, rkeys), None
 
             (x, _, _), _ = jax.lax.scan(
-                body, (x, init_sampler_state(x), key), jnp.arange(steps))
+                body, (x, init_sampler_state(x), stage_keys(3)),
+                jnp.arange(steps))
 
             if tiled:
                 img = tiled_decode(vae, params["vae"], x)
@@ -130,11 +143,15 @@ class LatentUpscalePipeline:
 
     def __call__(self, images: np.ndarray, prompt: str = "",
                  steps: int = DEFAULT_UPSCALE_STEPS, seed: int = 0,
-                 scheduler: str | None = None) -> tuple[np.ndarray, dict]:
+                 scheduler: str | None = None,
+                 first_row: int = 0) -> tuple[np.ndarray, dict]:
         """uint8 (B, H, W, 3) -> uint8 (B, 2H, 2W, 3).
 
         Guidance is 0 by construction (no CFG branch), matching the
-        reference's ``guidance_scale=0`` call (upscale.py:22-27)."""
+        reference's ``guidance_scale=0`` call (upscale.py:22-27).
+        ``first_row`` offsets the per-row noise keys so a batch-1 call at
+        row i reproduces row i of a batched call (see submit contract in
+        pipelines/cascade.py)."""
         fam = self.c.family
         images = np.asarray(images)
         if images.ndim == 3:
@@ -158,8 +175,12 @@ class LatentUpscalePipeline:
         fn = self._get_fn(batch=batch, height=height, width=width,
                           steps=int(steps), sampler=sampler,
                           tiled=2 * max(height, width) > 1024)
+        base_key = key_for_seed(seed)
+        row_keys = jax.vmap(
+            lambda r: jax.random.fold_in(base_key, r)
+        )(jnp.arange(first_row, first_row + batch))
         img = fn(self.c.params, [jnp.asarray(i) for i in ids],
-                 key_for_seed(seed), jnp.asarray(fimg))
+                 row_keys, jnp.asarray(fimg))
         img_u8 = np.asarray(jax.device_get(img))  # uint8 off-chip
         # namespaced keys: this config is merged into the generation job's
         # config by the callers — must not clobber its steps/scheduler
@@ -218,28 +239,34 @@ class Upscale4xPipeline:
             return (jnp.concatenate(seqs, axis=-1) if len(seqs) > 1
                     else seqs[0])
 
-        def fn(params, ids, neg_ids, key, image, guidance):
+        def fn(params, ids, neg_ids, row_keys, image, guidance):
             ctx = encode(params, ids)
             if use_cfg:
                 ctx = jnp.concatenate([encode(params, neg_ids), ctx], axis=0)
 
+            # per-row keys: the (seed, row) contract shared with the
+            # other pipelines (see LatentUpscalePipeline above)
+            def stage_keys(stage: int):
+                return jax.vmap(
+                    lambda k: jax.random.fold_in(k, stage))(row_keys)
+
             # DDPM-noise the low-res conditioning image at noise_level —
             # the forward process q(x_t | x_0) on the model's own schedule
             # (StableDiffusionUpscalePipeline's low_res_scheduler step)
-            key, lkey, nkey = jax.random.split(key, 3)
             level = jnp.full((batch,), noise_level, jnp.int32)
             img_noised = add_noise(
                 noise_sched, image,
-                jax.random.normal(lkey, image.shape, jnp.float32), level)
+                jax.vmap(lambda k, shp=image.shape[1:]: jax.random.normal(
+                    k, shp, jnp.float32))(stage_keys(1)), level)
 
-            x = jax.random.normal(
-                nkey, (batch, height, width, latent_ch), jnp.float32)
+            x = jax.vmap(lambda k: jax.random.normal(
+                k, (height, width, latent_ch), jnp.float32))(stage_keys(2))
             x = x * sched.sigmas[0]
             labels = (jnp.concatenate([level, level], axis=0)
                       if use_cfg else level)
 
             def body(carry, i):
-                x, state, key = carry
+                x, state, rkeys = carry
                 inp = scale_model_input(sched, x, i)
                 inp = jnp.concatenate([inp, img_noised], axis=-1)  # 7 ch
                 if use_cfg:
@@ -250,14 +277,17 @@ class Upscale4xPipeline:
                 if use_cfg:
                     out_u, out_c = jnp.split(out, 2, axis=0)
                     out = out_u + guidance * (out_c - out_u)
-                key, skey = jax.random.split(key)
-                step_noise = jax.random.normal(skey, x.shape, jnp.float32)
+                both = jax.vmap(jax.random.split)(rkeys)
+                rkeys, skeys = both[:, 0], both[:, 1]
+                step_noise = jax.vmap(lambda k: jax.random.normal(
+                    k, x.shape[1:], jnp.float32))(skeys)
                 x, state = sampler_step(sampler, sched, i, x, out, state,
                                         noise=step_noise, start_index=0)
-                return (x, state, key), None
+                return (x, state, rkeys), None
 
             (x, _, _), _ = jax.lax.scan(
-                body, (x, init_sampler_state(x), key), jnp.arange(steps))
+                body, (x, init_sampler_state(x), stage_keys(3)),
+                jnp.arange(steps))
 
             if tiled:
                 img = tiled_decode(vae, params["vae"], x)
@@ -280,7 +310,8 @@ class Upscale4xPipeline:
                  guidance_scale: float = DEFAULT_X4_GUIDANCE,
                  noise_level: int = DEFAULT_NOISE_LEVEL,
                  seed: int = 0,
-                 scheduler: str | None = None) -> tuple[np.ndarray, dict]:
+                 scheduler: str | None = None,
+                 first_row: int = 0) -> tuple[np.ndarray, dict]:
         """uint8 (B, H, W, 3) -> uint8 (B, 4H, 4W, 3).
 
         The latent grid runs at the LOW-RES spatial size (the f=4 VAE does
@@ -313,8 +344,12 @@ class Upscale4xPipeline:
                           steps=int(steps), sampler=sampler,
                           use_cfg=use_cfg, noise_level=int(noise_level),
                           tiled=4 * max(height, width) > 1024)
+        base_key = key_for_seed(seed)
+        row_keys = jax.vmap(
+            lambda r: jax.random.fold_in(base_key, r)
+        )(jnp.arange(first_row, first_row + batch))
         img = fn(self.c.params, [jnp.asarray(i) for i in ids],
-                 [jnp.asarray(i) for i in neg], key_for_seed(seed),
+                 [jnp.asarray(i) for i in neg], row_keys,
                  jnp.asarray(fimg), jnp.float32(guidance_scale))
         img_u8 = np.asarray(jax.device_get(img))  # uint8 off-chip
         config = {

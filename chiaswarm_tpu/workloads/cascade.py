@@ -7,6 +7,11 @@ shared, :45-61) -> the SD-x4-upscaler to 1024px (:31-40 — the same
 text-conditioned x4 SR model class the reference runs, pipelines/
 upscale.py::Upscale4xPipeline). The whole cascade runs as jitted programs
 on the chip (pipelines/cascade.py).
+
+Beyond the reference (which runs the stages strictly sequentially on one
+GPU): multi-image jobs on a >=2-chip slot run STAGE-PARALLEL — stages
+1+2 and stage 3 live on disjoint submeshes and overlap across images
+(core/mesh.py::split_mesh + pipelines/cascade.py::generate_stage_parallel).
 """
 
 from __future__ import annotations
@@ -32,29 +37,67 @@ def cascade_callback(slot, model_name: str, *, seed: int,
                          "stabilityai/stable-diffusion-x4-upscaler"),
                      final_size: int | None = None,
                      **_ignored: Any):
-    pipe = registry.cascade_pipeline(model_name,
-                                     mesh=getattr(slot, "mesh", None))
+    mesh = getattr(slot, "mesh", None)
+    n_images = max(1, int(num_images_per_prompt))
+    # stage-level pipeline parallelism: with >=2 chips, >=2 images and a
+    # stage-3 upscaler, stages 1+2 and stage 3 run on DISJOINT submeshes
+    # so image i+1's base/SR denoise overlaps image i's x4 upscale
+    # (pipelines/cascade.py::generate_stage_parallel). Anything smaller
+    # gains nothing from splitting the chips, so it keeps the whole mesh.
+    # Data-only meshes ONLY: split_mesh emits data-axis submeshes, so a
+    # tp (model>1) slot — derived precisely because the weights need
+    # sharding to fit — would silently replicate full weights per chip
+    # (OOM risk), and a seq>1 slot would lose its ring-attention axis.
+    axis_sizes = dict(mesh.shape) if mesh is not None else {}
+    stage_parallel = (upscale and n_images >= 2 and mesh is not None
+                      and mesh.devices.size >= 2
+                      and mesh.devices.size % 2 == 0
+                      and axis_sizes.get("model", 1) == 1
+                      and axis_sizes.get("seq", 1) == 1)
+    if stage_parallel:
+        from chiaswarm_tpu.core.mesh import split_mesh
+
+        base_mesh, up_mesh = split_mesh(mesh, 2)
+    else:
+        base_mesh = up_mesh = mesh
+
+    pipe = registry.cascade_pipeline(model_name, mesh=base_mesh)
     upscaler = None
     if upscale:
         # stage 3: the SD-x4-upscaler (diffusion_func_if.py:31-40) takes
         # 256 -> 1024 in one text-conditioned pass; the cascade pipeline
         # owns the pass loop (an x2-class name still works, two passes)
-        upscaler = registry.pipeline(
-            upscaler_model_name, mesh=getattr(slot, "mesh", None))
+        upscaler = registry.pipeline(upscaler_model_name, mesh=up_mesh)
 
     t0 = time.perf_counter()
-    images, config = pipe(
-        prompt=prompt or "",
-        negative_prompt=negative_prompt or "",
-        steps=int(num_inference_steps),
-        sr_steps=int(sr_steps),
-        guidance_scale=float(guidance_scale),
-        batch=max(1, int(num_images_per_prompt)),
-        seed=seed,
-        scheduler=scheduler_type,
-        upscaler=upscaler,
-        final_size=final_size,
-    )
+    if stage_parallel:
+        from chiaswarm_tpu.pipelines.cascade import generate_stage_parallel
+
+        images, config = generate_stage_parallel(
+            pipe, upscaler,
+            prompt=prompt or "",
+            negative_prompt=negative_prompt or "",
+            steps=int(num_inference_steps),
+            sr_steps=int(sr_steps),
+            guidance_scale=float(guidance_scale),
+            n_images=n_images,
+            seed=seed,
+            scheduler=scheduler_type,
+            final_size=final_size,
+        )
+    else:
+        images, config = pipe(
+            prompt=prompt or "",
+            negative_prompt=negative_prompt or "",
+            steps=int(num_inference_steps),
+            sr_steps=int(sr_steps),
+            guidance_scale=float(guidance_scale),
+            batch=n_images,
+            seed=seed,
+            scheduler=scheduler_type,
+            upscaler=upscaler,
+            final_size=final_size,
+        )
     elapsed = time.perf_counter() - t0
 
     proc = OutputProcessor(content_type)

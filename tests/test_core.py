@@ -79,6 +79,26 @@ def test_derive_mesh_spec_policy():
         {"data": 3, "model": 1}
 
 
+def test_split_mesh_partitions_devices():
+    """split_mesh: contiguous, disjoint, covering data-axis submeshes —
+    the substrate for the cascade's stage-level pipeline parallelism."""
+    import jax
+    import pytest
+
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh, split_mesh
+
+    mesh = build_mesh(MeshSpec({"data": -1}))
+    halves = split_mesh(mesh, 2)
+    assert len(halves) == 2
+    seen = []
+    for sub in halves:
+        assert dict(sub.shape)["data"] == len(jax.devices()) // 2
+        seen += sub.devices.flatten().tolist()
+    assert seen == mesh.devices.flatten().tolist()  # disjoint AND ordered
+    with pytest.raises(ValueError):
+        split_mesh(mesh, 3)  # 8 devices do not split three ways
+
+
 def test_worker_default_pool_derives_tp_for_big_families(monkeypatch):
     """A stock 8-device worker with an SDXL-class catalog builds a
     dp=4 x tp=2 slot WITHOUT any hand-written mesh_shape; a small-model

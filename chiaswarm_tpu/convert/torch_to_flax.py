@@ -120,9 +120,17 @@ def convert_unet(state: Mapping[str, np.ndarray],
 
     for key, value in state.items():
         if key == "class_embedding.weight":
-            # nn.Embedding table (x4-upscaler noise level): (N, dim) used
-            # as-is — NOT a linear, so it must bypass _place's transpose
-            flat["class_embedding/embedding"] = value
+            if config.class_proj_dim is not None:
+                # simple_projection (AudioLDM): an nn.Linear over float
+                # class labels -> normal (O, I) -> (I, O) transpose
+                flat["class_embedding/kernel"] = value.T
+            else:
+                # nn.Embedding table (x4-upscaler noise level): (N, dim)
+                # used as-is — NOT a linear, bypasses _place's transpose
+                flat["class_embedding/embedding"] = value
+            continue
+        if key == "class_embedding.bias":
+            flat["class_embedding/bias"] = value
             continue
         parts = key.split(".")
         name = parts[-1]
@@ -355,6 +363,54 @@ def convert_text_encoder(state: Mapping[str, np.ndarray]) -> dict:
     return _nest(flat)
 
 
+# ------------------------------------------------------------------ CLAP
+
+def convert_clap_text(state: Mapping[str, np.ndarray]) -> dict:
+    """transformers ``ClapTextModelWithProjection`` state dict ->
+    models/clap.py tree (RoBERTa layout; ref swarm/audio/audioldm.py:12-24
+    loads this tower inside AudioLDMPipeline)."""
+    flat: dict[str, np.ndarray] = {}
+    for key, value in state.items():
+        k = key
+        if k.startswith("text_model."):
+            k = k[len("text_model."):]
+        parts = k.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        if body == ["embeddings"]:          # position_ids / token_type_ids
+            continue                        # non-parameter buffers
+        if body[:1] == ["embeddings"]:
+            if body[1] == "LayerNorm":
+                _place(flat, "embed_norm", name, value)
+            else:                           # word/position/token_type
+                flat[f"{body[1]}/embedding"] = value
+        elif body[:2] == ["encoder", "layer"]:
+            i = body[2]
+            sub = body[3]
+            if sub == "attention":
+                if body[4] == "self":       # query/key/value
+                    _place(flat, f"layer_{i}/{body[5]}", name, value)
+                elif body[5] == "dense":    # attention.output.dense
+                    _place(flat, f"layer_{i}/attn_out", name, value)
+                else:                       # attention.output.LayerNorm
+                    _place(flat, f"layer_{i}/attn_norm", name, value)
+            elif sub == "intermediate":
+                _place(flat, f"layer_{i}/intermediate", name, value)
+            elif sub == "output":
+                if body[4] == "dense":
+                    _place(flat, f"layer_{i}/output", name, value)
+                else:                       # output.LayerNorm
+                    _place(flat, f"layer_{i}/out_norm", name, value)
+        elif body == ["pooler", "dense"]:
+            _place(flat, "pooler", name, value)
+        elif body[:1] == ["text_projection"] and len(body) > 1:
+            _place(flat, "proj1" if body[1] == "linear1" else "proj2",
+                   name, value)
+        else:
+            log.debug("clap text conversion skipped %s", key)
+    return _nest(flat)
+
+
 # ------------------------------------------------------------------- T5
 
 def convert_t5(state: Mapping[str, np.ndarray]) -> dict:
@@ -414,7 +470,8 @@ def load_cascade_checkpoint(checkpoint_dir: str | Path, model_name: str,
                               family.stage2),
     }
     tokenizer = load_tokenizer(checkpoint_dir, family.t5.vocab_size,
-                               family.t5.eos_token_id, family.t5.max_length)
+                               family.t5.eos_token_id, family.t5.max_length,
+                               pad_id=family.t5.pad_token_id, add_bos=False)
     return CascadeComponents(
         family=family, model_name=model_name, tokenizer=tokenizer,
         t5=T5Encoder(family.t5), unet1=UNet(family.stage1),
@@ -489,9 +546,9 @@ def convert_hifigan(state: Mapping[str, np.ndarray],
 def load_audio_checkpoint(checkpoint_dir: str | Path, model_name: str,
                           family) -> "Any":
     """AudioLDM-class snapshot -> AudioComponents. Layout: ``text_encoder/``
-    (CLAP text tower — best-effort CLIP-style mapping), ``unet/``, ``vae/``,
-    ``vocoder/`` (SpeechT5HifiGan)."""
-    from chiaswarm_tpu.models.clip import ClipTextEncoder
+    (ClapTextModelWithProjection — RoBERTa tower, convert_clap_text),
+    ``unet/``, ``vae/``, ``vocoder/`` (SpeechT5HifiGan)."""
+    from chiaswarm_tpu.models.clap import ClapTextEncoder
     from chiaswarm_tpu.models.tokenizer import load_tokenizer
     from chiaswarm_tpu.models.unet import UNet
     from chiaswarm_tpu.models.vae import AutoencoderKL
@@ -500,7 +557,7 @@ def load_audio_checkpoint(checkpoint_dir: str | Path, model_name: str,
 
     checkpoint_dir = Path(checkpoint_dir)
     params = {
-        "text_encoder": convert_text_encoder(
+        "text_encoder": convert_clap_text(
             read_torch_weights(checkpoint_dir / "text_encoder")),
         "unet": convert_unet(read_torch_weights(checkpoint_dir / "unet"),
                              family.unet),
@@ -513,16 +570,60 @@ def load_audio_checkpoint(checkpoint_dir: str | Path, model_name: str,
     tokenizer = load_tokenizer(checkpoint_dir,
                                family.text_encoder.vocab_size,
                                family.text_encoder.eos_token_id,
-                               family.text_encoder.max_position_embeddings)
+                               family.text_encoder.max_length,
+                               bos_id=family.text_encoder.bos_token_id,
+                               pad_id=family.text_encoder.pad_token_id)
     return AudioComponents(
         family=family, model_name=model_name, tokenizer=tokenizer,
-        text_encoder=ClipTextEncoder(family.text_encoder),
+        text_encoder=ClapTextEncoder(family.text_encoder),
         unet=UNet(family.unet), vae=AutoencoderKL(family.vae),
         vocoder=HifiGan(family.vocoder), params=params,
     )
 
 
 # ------------------------------------------------------- safety checker
+
+def convert_clip_vision(state: Mapping[str, np.ndarray]) -> dict:
+    """transformers ``CLIPVisionModelWithProjection`` state dict ->
+    ClipVisionEncoder params (models/clip.py). The image-conditioning
+    tower of SVD-class img2vid (the trunk nests under ``vision_model.``;
+    the safety checker's nests one level deeper — convert_safety_checker)."""
+    flat: dict[str, np.ndarray] = {}
+    trunk = "vision_model."
+    for key, value in state.items():
+        if key == "visual_projection.weight":
+            flat["visual_projection/kernel"] = value.T
+            continue
+        if not key.startswith(trunk):
+            log.debug("clip vision conversion skipped %s", key)
+            continue
+        rest = key[len(trunk):]
+        parts = rest.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        if body[:2] == ["embeddings", "class_embedding"] or \
+                rest == "embeddings.class_embedding":
+            flat["class_embedding"] = value
+        elif body[:2] == ["embeddings", "patch_embedding"]:
+            flat["patch_embedding/kernel"] = value.transpose(2, 3, 1, 0)
+        elif body[:2] == ["embeddings", "position_embedding"]:
+            flat["position_embedding/embedding"] = value
+        elif body[:1] == ["pre_layrnorm"]:
+            _place(flat, "pre_layrnorm", name, value)
+        elif body[:1] == ["post_layernorm"]:
+            _place(flat, "post_layernorm", name, value)
+        elif body[:2] == ["encoder", "layers"]:
+            i, sub = body[2], body[3]
+            if sub == "self_attn":
+                _place(flat, f"layers_{i}/self_attn/{body[4]}", name, value)
+            elif sub in ("layer_norm1", "layer_norm2"):
+                _place(flat, f"layers_{i}/{sub}", name, value)
+            elif sub == "mlp":
+                _place(flat, f"layers_{i}/{body[4]}", name, value)
+        else:
+            log.debug("clip vision conversion skipped %s", key)
+    return _nest(flat)
+
 
 def convert_safety_checker(state: Mapping[str, np.ndarray],
                            ) -> tuple[dict, dict[str, np.ndarray]]:

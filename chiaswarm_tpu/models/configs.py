@@ -39,7 +39,9 @@ class UNetConfig:
     transformer_depth: Sequence[int] = (1, 1, 1, 0)
     attention_head_dim: int | Sequence[int] = 8  # SD1.5 stores head *count*
     head_dim_is_count: bool = True               # SD1.5 quirk; False = per-head dim
-    cross_attention_dim: int = 768
+    # None = attention blocks have NO text cross-attention (self-attn +
+    # feed-forward only), the AudioLDM UNet layout
+    cross_attention_dim: int | None = 768
     use_linear_projection: bool = False
     # SDXL micro-conditioning: concat(sin(time_ids), pooled_text) -> MLP
     addition_embed_dim: int | None = None        # 256 for SDXL
@@ -47,6 +49,12 @@ class UNetConfig:
     # class-label conditioning table (SD-x4-upscaler noise_level: an
     # nn.Embed(num_class_embeds, time_embed_dim) added to the time emb)
     num_class_embeds: int | None = None
+    # FiLM conditioning on a continuous vector (AudioLDM text_embeds): a
+    # single Linear(class_proj_dim -> time_embed_dim) over float class
+    # labels ("simple_projection"), concatenated with — not added to — the
+    # time embedding when class_embeddings_concat is set
+    class_proj_dim: int | None = None
+    class_embeddings_concat: bool = False
     freq_shift: int = 0
     flip_sin_to_cos: bool = True
     dtype: str = "bfloat16"

@@ -39,6 +39,7 @@ class T5Config:
     max_length: int = 77
     layer_norm_epsilon: float = 1e-6
     eos_token_id: int = 1
+    pad_token_id: int = 0      # T5 pads with id 0 and prepends no BOS
     dtype: str = "bfloat16"
 
 
@@ -73,8 +74,9 @@ class T5Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
-                 position_bias: jnp.ndarray | None) -> tuple[jnp.ndarray,
-                                                             jnp.ndarray]:
+                 position_bias: jnp.ndarray | None,
+                 mask_bias: jnp.ndarray | None = None,
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
         cfg = self.config
         inner = cfg.num_heads * cfg.d_kv
         b, l, _ = x.shape
@@ -94,8 +96,12 @@ class T5Attention(nn.Module):
                 nn.initializers.normal(1.0),
                 (cfg.relative_attention_num_buckets, cfg.num_heads),
             )
-            # (L, L, H) -> (1, H, L, L)
+            # (L, L, H) -> (1, H, L, L); the padding-mask bias folds in
+            # here once and rides the shared bias through every layer,
+            # exactly as transformers merges its extended attention mask
             position_bias = table[buckets].transpose(2, 0, 1)[None]
+            if mask_bias is not None:
+                position_bias = position_bias + mask_bias
 
         # T5: NO 1/sqrt(d) scaling
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -116,13 +122,14 @@ class T5Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray,
-                 position_bias: jnp.ndarray | None) -> tuple[jnp.ndarray,
-                                                             jnp.ndarray]:
+                 position_bias: jnp.ndarray | None,
+                 mask_bias: jnp.ndarray | None = None,
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
         cfg = self.config
         h = T5LayerNorm(cfg.layer_norm_epsilon, name="attn_norm")(x)
         attn, position_bias = T5Attention(
             cfg, self.has_relative_bias, self.dtype, name="attention"
-        )(h, position_bias)
+        )(h, position_bias, mask_bias)
         x = x + attn
         h = T5LayerNorm(cfg.layer_norm_epsilon, name="ff_norm")(x)
         gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=self.dtype,
@@ -159,16 +166,25 @@ class T5Encoder(nn.Module):
         return jnp.dtype(self.config.dtype)
 
     @nn.compact
-    def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, input_ids: jnp.ndarray,
+                 attention_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+        """``attention_mask`` (B, L) of 1/0 — DeepFloyd's serving path
+        passes the tokenizer padding mask to T5 (the reference's pipeline
+        does the same through transformers); ``None`` attends everywhere."""
         cfg = self.config
         emb = nn.Embed(cfg.vocab_size, cfg.d_model,
                        dtype=self.dtype, name="token_embedding")
         x = emb(input_ids)
+        mask_bias = None
+        if attention_mask is not None:
+            mask_bias = jnp.where(
+                attention_mask[:, None, None, :] > 0, 0.0,
+                jnp.finfo(jnp.float32).min)
         position_bias = None
         for i in range(cfg.num_layers):
             x, position_bias = T5Block(
                 cfg, has_relative_bias=(i == 0), dtype=self.dtype,
                 name=f"block_{i}",
-            )(x, position_bias)
+            )(x, position_bias, mask_bias)
         return T5LayerNorm(cfg.layer_norm_epsilon,
                            name="final_layer_norm")(x).astype(jnp.float32)

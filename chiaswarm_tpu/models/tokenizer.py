@@ -156,20 +156,142 @@ class ClipBpeTokenizer(AddedTokenMixin):
         return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
 
 
+def _bytes_to_unicode() -> dict[int, str]:
+    """GPT-2's reversible byte -> printable-unicode table (the byte-level
+    BPE alphabet RoBERTa-family vocab.json files are written in)."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+_GPT2_WORD_RE = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+| ?[^\sA-Za-z0-9]+"
+    r"|\s+(?!\S)|\s+"
+)
+
+
+class ByteLevelBpeTokenizer(AddedTokenMixin):
+    """GPT-2/RoBERTa byte-level BPE over ``vocab.json``/``merges.txt`` —
+    the tokenizer format real AudioLDM snapshots ship for the CLAP text
+    tower (RobertaTokenizer). Same file names as CLIP's BPE but a disjoint
+    algorithm: case-sensitive, bytes mapped through the GPT-2 unicode
+    table, space carried as a leading ``Ġ`` on the piece (no ``</w>``
+    suffix), RoBERTa ``<s>``/``</s>``/``<pad>`` specials. ASCII-oriented
+    pre-tokenization like :class:`ClipBpeTokenizer` (non-ASCII letters
+    fall through as symbol runs — byte-level, so nothing is dropped)."""
+
+    def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]],
+                 max_length: int = 77) -> None:
+        self.vocab = vocab
+        self.ranks = {pair: i for i, pair in enumerate(merges)}
+        self.max_length = max_length
+        self.byte_map = _bytes_to_unicode()
+        self.bos_id = vocab.get("<s>", vocab.get("<|endoftext|>", 0))
+        self.eos_id = vocab.get("</s>", vocab.get("<|endoftext|>", 2))
+        self.pad_id = vocab.get("<pad>", 1)
+        self.unk_id = vocab.get("<unk>", self.eos_id)
+        self._cache: dict[str, list[str]] = {}
+
+    @classmethod
+    def from_dir(cls, path: str | Path, max_length: int = 77
+                 ) -> "ByteLevelBpeTokenizer":
+        path = Path(path)
+        with open(path / "vocab.json", encoding="utf-8") as fh:
+            vocab = json.load(fh)
+        merges: list[tuple[str, str]] = []
+        with open(path / "merges.txt", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges, max_length)
+
+    def _bpe(self, token: str) -> list[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = list(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, 1 << 30))
+            if best not in self.ranks:
+                break
+            a, b = best
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = merged
+        self._cache[token] = word
+        return word
+
+    def encode(self, text: str) -> list[int]:
+        ids = [self.bos_id]
+        for span in self._split_added(text):
+            if isinstance(span, list):  # textual-inversion placeholder run
+                ids.extend(span)
+                continue
+            for tok in _GPT2_WORD_RE.findall(span):
+                mapped = "".join(self.byte_map[b] for b in
+                                 tok.encode("utf-8"))
+                for piece in self._bpe(mapped):
+                    ids.append(self.vocab.get(piece, self.unk_id))
+                if len(ids) >= self.max_length - 1:
+                    break
+            if len(ids) >= self.max_length - 1:
+                break
+        ids = ids[: self.max_length - 1]
+        ids.append(self.eos_id)
+        ids += [self.pad_id] * (self.max_length - len(ids))
+        return ids
+
+    def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
+
+
 class HashTokenizer(AddedTokenMixin):
     """Deterministic, vocab-file-free tokenizer for tiny/hermetic models."""
 
     def __init__(self, vocab_size: int = 1000, max_length: int = 77,
-                 eos_id: int | None = None) -> None:
+                 eos_id: int | None = None, bos_id: int | None = None,
+                 pad_id: int | None = None, add_bos: bool = True) -> None:
         self.vocab_size = vocab_size
         self.max_length = max_length
         self.eos_id = eos_id if eos_id is not None else vocab_size - 1
-        self.bos_id = self.eos_id - 1
+        self.bos_id = bos_id if bos_id is not None else self.eos_id - 1
+        # CLIP convention pads with EOS; RoBERTa-family towers (CLAP) have
+        # a dedicated pad id their attention mask is derived from; T5 has
+        # no BOS at all (add_bos=False) and pads with id 0
+        self.pad_id = pad_id if pad_id is not None else self.eos_id
+        self.add_bos = add_bos
+        # hashed ids must never collide with the specials: masks and
+        # pooled readouts are derived from exact id equality. Specials sit
+        # either at the bottom (CLAP 0/1/2, T5 0/1) or top (CLIP) of the
+        # vocab — hash into the contiguous id range between them.
+        specials = {self.eos_id, self.bos_id, self.pad_id}
+        self._lo = max((s + 1 for s in specials if s < vocab_size // 2),
+                       default=0)
+        self._hi = min((s for s in specials if s >= vocab_size // 2),
+                       default=vocab_size)
 
     def tokenize(self, text: str) -> list[int]:
         """Raw hashed ids — no bos/eos/pad (the bark semantic stage needs
         specials-free text ids, pipelines/tts.py)."""
-        vspan = max(self.vocab_size - 2, 1)
+        vspan = max(self._hi - self._lo, 1)
         ids: list[int] = []
         for part in self._split_added(text):
             if isinstance(part, list):
@@ -180,14 +302,16 @@ class HashTokenizer(AddedTokenMixin):
                 h = 2166136261
                 for ch in tok.encode("utf-8"):
                     h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
-                ids.append(h % vspan)
+                ids.append(self._lo + h % vspan)
         return ids
 
     def encode(self, text: str) -> list[int]:
-        """bos + tokenize() body (truncated) + eos, padded with eos."""
-        ids = [self.bos_id] + self.tokenize(text)[: self.max_length - 2]
+        """[bos +] tokenize() body (truncated) + eos, padded with pad_id."""
+        head = [self.bos_id] if self.add_bos else []
+        n_special = len(head) + 1
+        ids = head + self.tokenize(text)[: self.max_length - n_special]
         ids.append(self.eos_id)
-        ids += [self.eos_id] * (self.max_length - len(ids))
+        ids += [self.pad_id] * (self.max_length - len(ids))
         return ids[: self.max_length]
 
     def encode_batch(self, texts: Sequence[str]) -> np.ndarray:
@@ -223,17 +347,32 @@ class HFTokenizer(AddedTokenMixin):
         return np.asarray([self.encode(t) for t in texts], dtype=np.int32)
 
 
+def _vocab_is_byte_level(vocab_path: Path) -> bool:
+    """vocab.json + merges.txt is both CLIP's format and GPT-2/RoBERTa's.
+    CLIP vocabs mark word-final pieces with a ``</w>`` suffix; byte-level
+    vocabs carry the space as a leading ``Ġ`` instead."""
+    with open(vocab_path, encoding="utf-8") as fh:
+        keys = json.load(fh).keys()
+    return any(k.startswith("Ġ") for k in keys) and not any(
+        k.endswith("</w>") for k in keys)
+
+
 def load_tokenizer(checkpoint_dir: str | Path | None, vocab_size: int = 49408,
-                   eos_id: int = 49407, max_length: int = 77) -> Tokenizer:
-    """ClipBpeTokenizer when CLIP vocab files exist locally, then a
+                   eos_id: int = 49407, max_length: int = 77,
+                   bos_id: int | None = None, pad_id: int | None = None,
+                   add_bos: bool = True) -> Tokenizer:
+    """ClipBpeTokenizer or ByteLevelBpeTokenizer (RoBERTa/CLAP) when vocab
+    files exist locally — distinguished by vocab content — then a
     serialized ``tokenizer.json`` (T5/sentencepiece-family snapshots), else
     HashTokenizer. Falling back on a REAL checkpoint is loud: hash-bucketed
     ids next to converted weights would silently condition on noise."""
     if checkpoint_dir is not None:
         path = Path(checkpoint_dir)
-        for sub in ("", "tokenizer"):
+        for sub in ("", "tokenizer", "text_encoder"):
             cand = path / sub if sub else path
             if (cand / "vocab.json").exists() and (cand / "merges.txt").exists():
+                if _vocab_is_byte_level(cand / "vocab.json"):
+                    return ByteLevelBpeTokenizer.from_dir(cand, max_length)
                 return ClipBpeTokenizer.from_dir(cand, max_length)
         for sub in ("", "tokenizer"):
             cand = (path / sub if sub else path) / "tokenizer.json"
@@ -247,7 +386,8 @@ def load_tokenizer(checkpoint_dir: str | Path | None, vocab_size: int = 49408,
                 "(vocab.json+merges.txt or tokenizer.json); falling back to "
                 "HashTokenizer — generations will NOT match the reference "
                 "model", path)
-    return HashTokenizer(vocab_size, max_length, eos_id)
+    return HashTokenizer(vocab_size, max_length, eos_id, bos_id=bos_id,
+                         pad_id=pad_id, add_bos=add_bos)
 
 
 class WordPieceTokenizer:

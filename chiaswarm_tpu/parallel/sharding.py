@@ -16,10 +16,22 @@ all-reduce on the residual, not per-matmul gathers):
 - output projection, MLP down-projection: row-parallel — kernel
   P("model", None), bias replicated; GSPMD emits the psum.
 
-Convolutions and norms stay replicated: for UNet resnet convs the win is
-small relative to the halo/collective cost, and batch ("data") parallelism
-covers them. This matches the scaling-book recipe: annotate the big
-matmuls, let the compiler place collectives, profile, iterate.
+Resnet conv pairs follow the same pattern on their CHANNEL dims (no halo
+needed — a 1x1-style channel split, not spatial): ``conv1`` is
+column-parallel on output channels (with ``time_emb_proj`` and ``norm2``
+sharded to match, group stats staying shard-local because tp divides the
+32 GroupNorm groups), ``conv2`` is row-parallel on input channels, and
+GSPMD emits one psum per resnet block on the residual. SD-class UNets are
+~65% conv FLOPs (BASELINE.md op profile), so leaving convs replicated made
+tp pay 44% over ideal (MULTICHIP_r03); with the resnet pairs sharded the
+per-device FLOPs fraction drops to ~1/(dp*tp) + small residue (conv_in/
+out, shortcuts, up/downsamples — measured by dryrun_multichip).
+
+Still replicated: norms on replicated activations, embeddings, time MLPs,
+shortcut/in/out/resize convs, and the SpatialTransformer proj_in/proj_out
+(their producers/consumers need full channels). This matches the
+scaling-book recipe: annotate the big matmuls, let the compiler place
+collectives, profile, iterate.
 """
 
 from __future__ import annotations
@@ -41,6 +53,16 @@ _MLP_GLU_UP = "proj_in"     # GEGLU up-projection inside FeedForward ("ff")
 _MLP_DOWN = "proj_out"
 
 
+def _in_resnet(path: tuple[str, ...]) -> bool:
+    """Inside a UNet/ControlNet ResnetBlock (down_*_resnets_*,
+    mid_resnets_*, up_*_resnets_* — models/unet.py naming). VAE resnets
+    share those block names but nest under encoder/decoder submodules and
+    are excluded: the VAE is a tiny FLOPs fraction and its small channel
+    counts don't divide cleanly across model shards."""
+    return (any("resnets" in part for part in path)
+            and not any(part in ("encoder", "decoder") for part in path))
+
+
 def _spec_for(path: tuple[str, ...], ndim: int) -> P:
     if ndim == 0 or not path:
         return P()
@@ -59,7 +81,26 @@ def _spec_for(path: tuple[str, ...], ndim: int) -> P:
             return P(MODEL_AXIS, None)
     if leaf == "bias" and ndim == 1 and column:
         return P(MODEL_AXIS)
-    return P()  # replicated: convs, norms, embeddings, time MLPs
+
+    # resnet conv pair: channel-wise Megatron (conv1 output channels /
+    # conv2 input channels), with the in-between time projection and
+    # GroupNorm sharded to match
+    if _in_resnet(path):
+        if parent == "conv1":
+            if leaf == "kernel" and ndim == 4:   # HWIO, O sharded
+                return P(None, None, None, MODEL_AXIS)
+            if leaf == "bias" and ndim == 1:
+                return P(MODEL_AXIS)
+        if parent == "conv2" and leaf == "kernel" and ndim == 4:
+            return P(None, None, MODEL_AXIS, None)  # I sharded (row)
+        if parent == "time_emb_proj":
+            if leaf == "kernel" and ndim == 2:
+                return P(None, MODEL_AXIS)
+            if leaf == "bias" and ndim == 1:
+                return P(MODEL_AXIS)
+        if parent == "norm2" and ndim == 1:      # scale/bias over conv1 out
+            return P(MODEL_AXIS)
+    return P()  # replicated: norms, embeddings, time MLPs, resize convs
 
 
 def param_partition_specs(params: Any) -> Any:

@@ -110,8 +110,25 @@ def test_partition_specs_hit_attention_weights():
     assert any("to_q" in p for p in model_sharded)
     assert any("fc1" in p for p in model_sharded)
     assert any("proj_out" in p for p in model_sharded)
-    # norms stay replicated
-    assert not any("norm" in p for p in model_sharded)
+    # resnet conv pair is channel-sharded (conv1 column / conv2 row), with
+    # the in-between norm2 + time projection sharded to match
+    assert any("resnets" in p and "conv1" in p for p in model_sharded)
+    assert any("resnets" in p and "conv2" in p for p in model_sharded)
+    assert any("resnets" in p and "time_emb_proj" in p
+               for p in model_sharded)
+    assert any("resnets" in p and "norm2" in p for p in model_sharded)
+    # norms over replicated activations stay replicated (norm1, attention
+    # LayerNorms, conv_norm_out) — only the resnet-internal norm2 shards
+    assert not any("norm" in p and "norm2" not in p for p in model_sharded)
+    # conv2 bias must stay replicated: it is added AFTER the row-parallel
+    # psum, adding it per-shard would count it tp times
+    assert not any("conv2/bias" in p for p in model_sharded)
+    # the VAE shares resnet block names under encoder/decoder but its
+    # convs must stay replicated (tiny FLOPs share, channel counts don't
+    # divide); only its mid-attention projections shard (deliberate,
+    # covered by the module docstring's Megatron rules)
+    assert not any(p.startswith("vae/") and "resnets" in p
+                   for p in model_sharded)
 
 
 def test_tensor_parallel_pipeline_matches_replicated(mesh8):

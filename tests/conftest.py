@@ -56,6 +56,35 @@ if _os.environ.get("PALLAS_AXON_POOL_IPS"):
         _os._exit(_SESSION_STATUS["code"])
 
 
+# ---- fast / slow tiers (VERDICT r3 weak #4) ---------------------------
+# Default `pytest -q` runs the fast tier; the ~10 compile-heaviest tests
+# are marked `slow` and run with --slow (or CHIASWARM_SLOW=1) — the
+# nightly-CI tier (.github/workflows/test.yml).
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--slow", action="store_true", default=False,
+        help="also run tests marked slow (full tier; nightly CI)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy test, excluded from the default fast tier "
+        "(run with --slow or CHIASWARM_SLOW=1)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--slow") or _os.environ.get("CHIASWARM_SLOW"):
+        return
+    skip = pytest.mark.skip(
+        reason="slow tier: run with --slow or CHIASWARM_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     return jax.devices("cpu")

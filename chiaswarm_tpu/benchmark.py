@@ -126,7 +126,7 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         half_mask[size // 2:] = 1.0
         results["sd21_inpaint_512"] = _bench_diffusion(
             pipe, size=size, steps=steps, batch=1, iters=iters,
-            init_image=init, mask=half_mask)
+            init_image=init, mask=half_mask, pipelined=True)
         del pipe, c
 
     if "controlnet" in names:
@@ -140,7 +140,8 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         cond = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
         results["controlnet_sdxl_1024"] = _bench_diffusion(
             pipe, size=size, steps=30 if on_tpu else 2, batch=1,
-            iters=iters, controlnet=bundle, control_image=cond)
+            iters=iters, controlnet=bundle, control_image=cond,
+            pipelined=True)
         del pipe, c, bundle
 
     if "txt2vid" in names:

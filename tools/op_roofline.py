@@ -287,6 +287,10 @@ def main() -> None:
     parser.add_argument("--size", type=int, default=1024)
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--top", type=int, default=30)
+    parser.add_argument("--controlnet", action="store_true",
+                        help="profile the combined ControlNet+UNet program "
+                             "(BASELINE.json config #4) instead of the base "
+                             "generate program")
     args = parser.parse_args()
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -332,11 +336,23 @@ def main() -> None:
     c = Components.random_host(family, seed=0)
     c.params = jax.device_put(c.params, jax.devices()[0])
     pipe = DiffusionPipeline(c)
+    controlnet = control_image = None
+    if args.controlnet:
+        import numpy as np
+
+        from chiaswarm_tpu.pipelines.components import ControlNetBundle
+
+        controlnet = ControlNetBundle.random_host(family, seed=1)
+        controlnet.params = jax.device_put(controlnet.params,
+                                           jax.devices()[0])
+        control_image = np.random.default_rng(0).integers(
+            0, 255, (size, size, 3), dtype=np.uint8)
     req = GenerateRequest(prompt="roofline probe", steps=steps,
                           height=size, width=size, batch=1, seed=0,
-                          guidance_scale=7.0)
-    print(f"compiling {family} {size}px {steps} steps ...",
-          file=sys.stderr)
+                          guidance_scale=7.0, controlnet=controlnet,
+                          control_image=control_image)
+    print(f"compiling {family}{'+controlnet' if args.controlnet else ''} "
+          f"{size}px {steps} steps ...", file=sys.stderr)
     pipe(req)  # compile + warm
 
     trace_dir = tempfile.mkdtemp(prefix="xplane_")

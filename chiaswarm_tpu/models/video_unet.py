@@ -120,13 +120,14 @@ class VideoUNet(nn.Module):
         sample: jnp.ndarray,                 # (B, F, H, W, C)
         timesteps: jnp.ndarray,              # (B,)
         encoder_hidden_states: jnp.ndarray,  # (B, S, cross_dim)
+        added_cond: dict[str, jnp.ndarray] | None = None,  # SVD micro-cond
     ) -> jnp.ndarray:
         cfg = self.config
         dtype = self.dtype
         channels = list(cfg.block_out_channels)
         b, f, hh, ww, _ = sample.shape
 
-        temb = time_conditioning(cfg, dtype, timesteps, None)
+        temb = time_conditioning(cfg, dtype, timesteps, added_cond)
         temb_f = jnp.repeat(temb, f, axis=0)          # (B*F, D) for 2D blocks
         ctx = encoder_hidden_states.astype(dtype)
         ctx_f = jnp.repeat(ctx, f, axis=0)            # frames share the text

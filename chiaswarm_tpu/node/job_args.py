@@ -71,6 +71,18 @@ def format_args(job: dict[str, Any], registry: ModelRegistry) -> FormatResult:
 
         return vid2vid_callback, args
 
+    if workflow == "img2vid":
+        from chiaswarm_tpu.workloads.video import img2vid_callback
+
+        parameters = _pop_parameters(args)
+        args.pop("prompt", None)        # image-conditioned: no text tower
+        args["scheduler_type"] = parameters.pop("scheduler_type", None)
+        _strip_unsupported(args, parameters)
+        if "start_image_uri" in args:
+            args["image"] = np.asarray(
+                get_image(args.pop("start_image_uri"), None))
+        return img2vid_callback, args
+
     if workflow == "txt2vid":
         from chiaswarm_tpu.workloads.video import txt2vid_callback
 

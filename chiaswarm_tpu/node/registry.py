@@ -289,6 +289,7 @@ class ModelRegistry:
         ``data`` axis here (the frame-batched vid2vid path, which runs
         per-frame through DiffusionPipeline, does get data parallelism)."""
         from chiaswarm_tpu.pipelines.video import (
+            Img2VidPipeline,
             VideoComponents,
             VideoPipeline,
             get_video_family,
@@ -298,6 +299,8 @@ class ModelRegistry:
 
         def build():
             family = get_video_family(model_name)
+            pipeline_cls = (Img2VidPipeline if family.image_conditioned
+                            else VideoPipeline)
             ckpt = model_dir(model_name)
             components = None
             if ckpt.exists():
@@ -326,7 +329,7 @@ class ModelRegistry:
                 )
             components.params = _place_params(components.params, mesh,
                                               model_name)
-            return VideoPipeline(components, attn_impl=self.attn_impl)
+            return pipeline_cls(components, attn_impl=self.attn_impl)
 
         return GLOBAL_CACHE.cached_params(
             ("video", model_name, mesh_key), build,

@@ -79,6 +79,19 @@ SMOKE_JOBS: dict[str, dict[str, Any]] = {
         "upscale": False,
         "content_type": "image/png",
     },
+    "img2vid": {
+        # image-to-video (SVD-class; beyond the reference — BASELINE.json
+        # config #5's model class), frame injected instead of a
+        # start_image_uri (no network in smoke)
+        "id": "smoke-img2vid",
+        "workflow": "img2vid",
+        "model_name": "random/tiny_svd",
+        "num_frames": 8,
+        "num_inference_steps": 2,
+        "height": 64, "width": 64,
+        "content_type": "video/mp4",
+        "_inject_image": True,
+    },
     "vid2vid": {
         # the reference's vid2vid smoke job (swarm/test.py:24-33), with
         # frames injected instead of a video_uri (no network in smoke)

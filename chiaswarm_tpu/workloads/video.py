@@ -211,6 +211,12 @@ def txt2vid_callback(slot, model_name: str, *, seed: int,
     heuristics (tx2vid.py:36-53 has no TPU analog)."""
     import time
 
+    from chiaswarm_tpu.pipelines.video import get_video_family
+
+    if get_video_family(model_name).image_conditioned:
+        raise ValueError(
+            f"model {model_name!r} is image-conditioned (SVD-class) and "
+            f"cannot serve txt2vid; send an img2vid job with a start image")
     pipe = registry.video_pipeline(model_name,
                                    mesh=getattr(slot, "mesh", None))
     t0 = time.perf_counter()
@@ -233,6 +239,65 @@ def txt2vid_callback(slot, model_name: str, *, seed: int,
     config.update(safety_fields)
     config.update({
         "fps": float(fps),
+        "generation_s": round(elapsed, 3),
+        "frames_per_sec": round(frames.shape[0] / max(elapsed, 1e-9), 4),
+        "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
+    })
+    return artifacts, config
+
+
+def img2vid_callback(slot, model_name: str, *, seed: int,
+                     registry: ModelRegistry,
+                     image: np.ndarray,
+                     num_frames: int | None = None,
+                     num_inference_steps: int = 25,
+                     fps: float = 7.0,
+                     motion_bucket_id: int = 127,
+                     noise_aug_strength: float = 0.02,
+                     min_guidance_scale: float = 1.0,
+                     max_guidance_scale: float = 3.0,
+                     height: int | None = None,
+                     width: int | None = None,
+                     content_type: str = "video/mp4",
+                     scheduler_type: str | None = None,
+                     **_ignored: Any):
+    """Image-to-video (SVD-class; BASELINE.json config #5's model class —
+    beyond the reference, which serves only txt2vid/vid2vid). The input
+    frame conditions the whole clip through the CLIP-image embedding and
+    channel-concatenated VAE latents; the denoise runs as ONE jitted
+    program (pipelines/video.py::Img2VidPipeline)."""
+    import time
+
+    from chiaswarm_tpu.pipelines.video import get_video_family
+
+    if not get_video_family(model_name).image_conditioned:
+        raise ValueError(
+            f"model {model_name!r} is a text-to-video family and cannot "
+            f"serve img2vid; name an SVD-class model (svd_img2vid)")
+    pipe = registry.video_pipeline(model_name,
+                                   mesh=getattr(slot, "mesh", None))
+    t0 = time.perf_counter()
+    frames, config = pipe(
+        np.asarray(image),
+        num_frames=num_frames,
+        steps=int(num_inference_steps),
+        fps=int(fps),
+        motion_bucket_id=int(motion_bucket_id),
+        noise_aug_strength=float(noise_aug_strength),
+        min_guidance_scale=float(min_guidance_scale),
+        max_guidance_scale=float(max_guidance_scale),
+        height=height, width=width,
+        seed=seed,
+        scheduler=scheduler_type,
+    )
+    elapsed = time.perf_counter() - t0
+
+    artifacts = _video_artifacts(list(frames), float(fps), content_type)
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    _, safety_fields = check_images(frames, model_name)
+    config.update(safety_fields)
+    config.update({
         "generation_s": round(elapsed, 3),
         "frames_per_sec": round(frames.shape[0] / max(elapsed, 1e-9), 4),
         "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),

@@ -126,3 +126,84 @@ def test_video_checkpoint_pipeline_generates(tmp_path):
                           height=64, width=64, seed=1)
     assert frames.shape == (4, 64, 64, 3)
     assert config["mode"] == "txt2vid"
+
+
+# ---- SVD-class img2vid (BASELINE.json config #5's model class) ---------
+
+
+def test_img2vid_family_routing():
+    from chiaswarm_tpu.pipelines.video import get_video_family
+
+    assert get_video_family(
+        "stabilityai/stable-video-diffusion-img2vid").name == "svd_img2vid"
+    assert get_video_family("random/tiny_svd").name == "tiny_svd"
+    assert get_video_family("damo/text-to-video").name == "modelscope_t2v"
+
+
+def test_img2vid_pipeline_shapes_and_determinism():
+    import numpy as np
+
+    from chiaswarm_tpu.pipelines.video import Img2VidPipeline, VideoComponents
+
+    c = VideoComponents.random("tiny_svd", seed=0)
+    assert c.text_encoder is None and c.image_encoder is not None
+    pipe = Img2VidPipeline(c)
+    rng = np.random.default_rng(3)
+    image = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    frames, config = pipe(image, num_frames=4, steps=2, seed=5,
+                          height=64, width=64)
+    assert frames.shape == (4, 64, 64, 3) and frames.dtype == np.uint8
+    assert config["mode"] == "img2vid"
+    assert config["motion_bucket_id"] == 127
+
+    again, _ = pipe(image, num_frames=4, steps=2, seed=5,
+                    height=64, width=64)
+    np.testing.assert_array_equal(frames, again)
+
+    other, _ = pipe(image, num_frames=4, steps=2, seed=6,
+                    height=64, width=64)
+    assert not np.array_equal(frames, other)
+
+
+def test_img2vid_conditioning_image_matters():
+    """Two different conditioning frames must produce different clips —
+    the image embedding + concat latents actually steer the UNet."""
+    import numpy as np
+
+    from chiaswarm_tpu.pipelines.video import Img2VidPipeline, VideoComponents
+
+    pipe = Img2VidPipeline(VideoComponents.random("tiny_svd", seed=1))
+    rng = np.random.default_rng(0)
+    img_a = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    img_b = 255 - img_a
+    a, _ = pipe(img_a, num_frames=4, steps=2, seed=9, height=64, width=64)
+    b, _ = pipe(img_b, num_frames=4, steps=2, seed=9, height=64, width=64)
+    assert not np.array_equal(a, b)
+
+
+def test_img2vid_workload_emits_video(tmp_path, monkeypatch):
+    import numpy as np
+
+    from chiaswarm_tpu.node.executor import synchronous_do_work
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+
+    monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    pool = ChipPool(n_slots=1)
+    rng = np.random.default_rng(1)
+    job = {
+        "id": "t-img2vid", "workflow": "img2vid",
+        "model_name": "random/tiny_svd",
+        "image": rng.integers(0, 255, (64, 64, 3), dtype=np.uint8),
+        "num_frames": 4, "num_inference_steps": 2,
+        "height": 64, "width": 64, "seed": 2,
+        "content_type": "video/mp4",
+    }
+    result = synchronous_do_work(job, pool.slots[0], registry)
+    cfg = result["pipeline_config"]
+    assert "error" not in cfg, cfg
+    assert cfg["mode"] == "img2vid"
+    art = result["artifacts"]["primary"]
+    assert art["content_type"].startswith("video/")
+    assert art["blob"] and art["thumbnail"]

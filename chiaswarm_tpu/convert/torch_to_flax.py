@@ -1179,9 +1179,14 @@ def convert_dpt(state: Mapping[str, np.ndarray]) -> dict:
             if w.shape[-1] == 3:  # 3x3 stride-2 downsample conv (O,I,3,3)
                 flat[f"reassemble_resize_{i}/kernel"] = w.transpose(
                     2, 3, 1, 0)
-            else:                 # ConvTranspose2d (I,O,k,k)
-                flat[f"reassemble_resize_{i}/kernel"] = w.transpose(
-                    2, 3, 0, 1)
+            else:                 # ConvTranspose2d (I,O,k,k) -> (k,k,I,O)
+                # SPATIALLY FLIPPED: torch conv_transpose is the conv
+                # gradient (flipped kernel); flax ConvTranspose is a plain
+                # fractionally-strided correlation. The tiny harness hid
+                # the orientation error under its 0.05-scale weights —
+                # caught by the published-config DPT-large run.
+                flat[f"reassemble_resize_{i}/kernel"] = np.ascontiguousarray(
+                    w.transpose(2, 3, 0, 1)[::-1, ::-1])
             flat[f"reassemble_resize_{i}/bias"] = bias
         _place(flat, f"neck_conv_{i}", "weight",
                s[f"neck.convs.{i}.weight"])

@@ -203,7 +203,9 @@ class UperNetSeg(nn.Module):
             jnp.concatenate(outs, axis=-1))
         logits = nn.Conv(cfg.num_labels, (1, 1), dtype=jnp.float32,
                          name="classifier")(fused)
-        logits = resize_bilinear(logits, (cfg.image_size, cfg.image_size))
+        # HF upsamples logits to the INPUT size, not a fixed canvas —
+        # caught by the published-config oracle run at a non-canvas input
+        logits = resize_bilinear(logits, pixel_values.shape[1:3])
         return jnp.argmax(logits, axis=-1).astype(jnp.uint8)
 
 

@@ -1,0 +1,298 @@
+"""Published-config-scale oracle runs for Bark / BLIP / DPT / UperNet.
+
+VERDICT r3: the tiny-config torch-fidelity harnesses (test_bark_convert,
+test_caption, test_dpt, test_upernet) prove the conversion rules, but the
+CLIP real-config lesson (eps + GELU bugs invisible at tiny widths) says
+the published configs themselves must go through the same comparisons.
+This file re-runs each harness at the exact published architecture
+against transformers' own classes with random weights — the full offline
+slice of the real-weights proof. Slow tier: full-width forwards are
+compile-heavy on the CPU test platform.
+
+Reference serving sites: Bark swarm/audio/bark.py:11-38, BLIP
+swarm/captioning/caption_image.py, DPT + UperNet preprocessors
+swarm/controlnet/input_processor.py:87-117.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def _randomize(model, seed: int, scale: float = 0.05):
+    """Non-degenerate deterministic weights (HF inits leave zeros that
+    would hide transposition/mapping bugs)."""
+    sd = model.state_dict()
+    gen = torch.Generator().manual_seed(seed)
+    for key, value in sd.items():
+        if not value.dtype.is_floating_point:
+            continue
+        if key.endswith("running_var"):
+            sd[key] = torch.rand(value.shape, generator=gen) + 0.5
+        elif key.endswith("running_mean"):
+            sd[key] = torch.randn(value.shape, generator=gen) * 0.1
+        else:
+            sd[key] = torch.randn(value.shape, generator=gen) * scale
+    model.load_state_dict(sd)
+    return model
+
+
+# ------------------------------------------------------------- DPT-large
+
+def test_dpt_large_published_config_parity():
+    """Intel/dpt-large — the depth preprocessor's published architecture
+    (24x1024 ViT backbone, 4-level reassemble neck, 384px)."""
+    from transformers import DPTConfig as HFDPTConfig
+    from transformers import DPTForDepthEstimation
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_dpt
+    from chiaswarm_tpu.models.dpt import DPT_LARGE, DPTDepth
+
+    cfg = HFDPTConfig(
+        hidden_size=1024, intermediate_size=4096, num_hidden_layers=24,
+        num_attention_heads=16, image_size=384, patch_size=16,
+        backbone_out_indices=[5, 11, 17, 23],
+        neck_hidden_sizes=[256, 512, 1024, 1024], fusion_hidden_size=256,
+        reassemble_factors=[4, 2, 1, 0.5], readout_type="project",
+        is_hybrid=False, qkv_bias=True, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, add_projection=False,
+        use_batch_norm_in_fusion_residual=False,
+    )
+    torch.manual_seed(0)
+    hf = _randomize(DPTForDepthEstimation(cfg).eval(), seed=3)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = convert_dpt(state)
+    x = np.random.RandomState(1).randn(1, 384, 384, 3).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(x.transpose(0, 3, 1, 2))
+                  ).predicted_depth.numpy()
+    got = np.asarray(DPTDepth(DPT_LARGE).apply(params, jnp.asarray(x)))
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=5e-3, rtol=5e-3)
+
+
+# ------------------------------------------------------------- BLIP-base
+
+def test_blip_base_published_config_parity():
+    """Salesforce/blip-image-captioning-base (the exact model name the
+    reference routes img2txt to): 12x768 vision at 384px + 12x768
+    cross-attending BERT decoder over the 30524-row vocab."""
+    from transformers import BlipConfig as HFBlipConfig
+    from transformers import BlipForConditionalGeneration
+
+    from chiaswarm_tpu.convert.torch_to_flax import (
+        convert_blip_text,
+        convert_blip_vision,
+    )
+    from chiaswarm_tpu.models.blip import (
+        BLIP_BASE,
+        BlipTextModel,
+        BlipVisionEncoder,
+    )
+
+    # the published snapshot's text_config, NOT the transformers class
+    # defaults — those say 8 attention heads where the checkpoint ships
+    # 12 (BERT-base), a mismatch this suite exists to catch
+    cfg = HFBlipConfig.from_text_vision_configs(
+        text_config=transformers.BlipTextConfig(
+            vocab_size=30524, hidden_size=768, intermediate_size=3072,
+            num_hidden_layers=12, num_attention_heads=12,
+            max_position_embeddings=512, encoder_hidden_size=768,
+            is_decoder=True, attention_probs_dropout_prob=0.0,
+            hidden_dropout_prob=0.0),
+        vision_config=transformers.BlipVisionConfig(
+            hidden_size=768, intermediate_size=3072, num_hidden_layers=12,
+            num_attention_heads=12, image_size=384, patch_size=16,
+            attention_dropout=0.0),
+    )
+    torch.manual_seed(1)
+    hf = BlipForConditionalGeneration(cfg).eval()
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    vparams = convert_blip_vision(state)
+    tparams = convert_blip_text(state, "text_decoder.")
+
+    pixels = np.random.RandomState(2).randn(1, 384, 384, 3).astype(
+        np.float32)
+    with torch.no_grad():
+        tv = hf.vision_model(
+            torch.from_numpy(pixels.transpose(0, 3, 1, 2))
+        ).last_hidden_state.numpy()
+    fv = np.asarray(BlipVisionEncoder(BLIP_BASE.vision).apply(
+        vparams, jnp.asarray(pixels)))
+    np.testing.assert_allclose(fv, tv, atol=2e-3, rtol=5e-3)
+
+    ids = np.array([[30522, 1037, 3861, 1997]], np.int32)  # [DEC] a picture of
+    with torch.no_grad():
+        tl = hf.text_decoder(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            encoder_hidden_states=torch.from_numpy(tv),
+            is_decoder=True,
+        ).logits.numpy()
+    decoder = BlipTextModel(BLIP_BASE.text)
+    cross_kvs = decoder.apply(tparams, jnp.asarray(tv), method="cross_kvs")
+    fl, _ = decoder.apply(tparams, jnp.asarray(ids), causal=True,
+                          cross_kvs=cross_kvs)
+    np.testing.assert_allclose(np.asarray(fl), tl, atol=2e-3, rtol=5e-3)
+
+
+# ------------------------------------------- UperNet (convnext-small)
+
+def test_upernet_convnext_small_published_config_parity():
+    """openmmlab/upernet-convnext-small — the seg preprocessor's
+    published architecture (depths 3/3/27/3, dims 96..768, 512-ch head,
+    150 ADE labels)."""
+    from transformers import ConvNextConfig, UperNetConfig
+    from transformers import UperNetForSemanticSegmentation
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_upernet
+    from chiaswarm_tpu.models.upernet import (
+        UPERNET_CONVNEXT_SMALL,
+        UperNetSeg,
+    )
+
+    backbone = ConvNextConfig(
+        depths=[3, 3, 27, 3], hidden_sizes=[96, 192, 384, 768],
+        out_features=["stage1", "stage2", "stage3", "stage4"],
+        drop_path_rate=0.0)
+    cfg = UperNetConfig(
+        backbone_config=backbone, hidden_size=512,
+        pool_scales=[1, 2, 3, 6], num_labels=150,
+        use_auxiliary_head=True, auxiliary_in_channels=384)
+    torch.manual_seed(2)
+    hf = _randomize(UperNetForSemanticSegmentation(cfg).eval(), seed=5)
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    params = convert_upernet(state)
+    x = np.random.RandomState(3).randn(1, 256, 256, 3).astype(np.float32)
+    with torch.no_grad():
+        tl = hf(torch.from_numpy(x.transpose(0, 3, 1, 2))).logits
+        tseg = tl.argmax(dim=1).numpy().astype(np.uint8)
+    fseg = np.asarray(UperNetSeg(UPERNET_CONVNEXT_SMALL).apply(
+        params, jnp.asarray(x)))
+    assert fseg.shape == tseg.shape
+    agree = (fseg == tseg).mean()
+    assert agree > 0.99, agree
+
+
+# ------------------------------------------------------------ Bark (big)
+
+@pytest.fixture(scope="module")
+def bark_published():
+    """suno/bark's published stage architectures (24x16x1024, the real
+    129600/10048/12096/1056 vocabs) + the published 24 kHz EnCodec."""
+    from transformers import BarkModel
+    from transformers.models.bark import (
+        BarkCoarseConfig,
+        BarkConfig,
+        BarkFineConfig,
+        BarkSemanticConfig,
+    )
+    from transformers.models.bark import modeling_bark as mb
+    from transformers.models.encodec.configuration_encodec import (
+        EncodecConfig,
+    )
+
+    from chiaswarm_tpu.convert.torch_to_flax import convert_bark
+    from chiaswarm_tpu.pipelines.tts import BARK
+
+    gpt_kw = dict(block_size=1024, num_layers=24, num_heads=16,
+                  hidden_size=1024, dropout=0.0, bias=False)
+    cfg = BarkConfig(
+        semantic_config=BarkSemanticConfig(
+            input_vocab_size=129_600, output_vocab_size=10_048,
+            **gpt_kw).to_dict(),
+        coarse_acoustics_config=BarkCoarseConfig(
+            input_vocab_size=12_096, output_vocab_size=12_096,
+            **gpt_kw).to_dict(),
+        fine_acoustics_config=BarkFineConfig(
+            input_vocab_size=1056, output_vocab_size=1056,
+            n_codes_total=8, n_codes_given=1, **gpt_kw).to_dict(),
+        codec_config=EncodecConfig().to_dict(),  # published 24 kHz model
+    )
+    torch.manual_seed(3)
+    orig = mb.BarkPreTrainedModel._init_weights
+
+    def safe_init(self, module):
+        import torch.nn as nn
+
+        if isinstance(module, nn.LayerNorm) and module.bias is None:
+            module.weight.data.fill_(1.0)
+            return
+        orig(self, module)
+
+    mb.BarkPreTrainedModel._init_weights = safe_init
+    try:
+        hf = BarkModel(cfg).eval()
+    finally:
+        mb.BarkPreTrainedModel._init_weights = orig
+    sd = hf.state_dict()
+    gen = torch.Generator().manual_seed(11)
+    for key, value in sd.items():
+        if value.dtype.is_floating_point and value.ndim >= 2:
+            sd[key] = torch.randn(value.shape, generator=gen) * 0.02
+    hf.load_state_dict(sd)
+
+    fam = dataclasses.replace(
+        BARK,
+        semantic=dataclasses.replace(BARK.semantic, dtype="float32"),
+        coarse=dataclasses.replace(BARK.coarse, dtype="float32"),
+        fine=dataclasses.replace(BARK.fine, dtype="float32"),
+    )
+    state = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    return hf, fam, convert_bark(state, fam)
+
+
+def test_bark_semantic_published_config_parity(bark_published):
+    from chiaswarm_tpu.models.gpt import GPT, init_caches
+
+    hf, fam, params = bark_published
+    ids = np.array([[11, 3000, 77777, 129_000, 42]], np.int64)
+    with torch.no_grad():
+        tl = hf.semantic(input_ids=torch.from_numpy(ids)).logits.numpy()
+    gpt = GPT(fam.semantic)
+    fl, _ = gpt.apply(params["semantic"], jnp.asarray(ids, jnp.int32),
+                      init_caches(fam.semantic, 1), 0, jnp.int32(5))
+    np.testing.assert_allclose(np.asarray(fl), tl, atol=2e-3, rtol=5e-3)
+
+
+def test_bark_fine_published_config_parity(bark_published):
+    from chiaswarm_tpu.models.gpt import FineGPT
+
+    hf, fam, params = bark_published
+    rng = np.random.RandomState(0)
+    codes = rng.randint(0, 1056, size=(1, 16, 8)).astype(np.int64)
+    fine = FineGPT(fam.fine, n_codes_total=8, n_codes_given=1)
+    for ci in (1, 7):
+        with torch.no_grad():
+            tl = hf.fine_acoustics(
+                codebook_idx=ci,
+                input_ids=torch.from_numpy(codes)).logits.numpy()
+        fl = fine.apply(params["fine"], jnp.asarray(codes, jnp.int32), ci)
+        np.testing.assert_allclose(np.asarray(fl), tl, atol=2e-3,
+                                   rtol=5e-3, err_msg=f"codebook {ci}")
+
+
+def test_encodec_published_decoder_parity(bark_published):
+    from chiaswarm_tpu.models.codec import CodecDecoder
+
+    hf, fam, params = bark_published
+    rng = np.random.RandomState(1)
+    codes = rng.randint(0, 1024, size=(1, 8, 9)).astype(np.int64)
+    with torch.no_grad():
+        emb = hf.codec_model.quantizer.decode(
+            torch.from_numpy(codes.transpose(1, 0, 2)))
+        twav = hf.codec_model.decoder(emb).numpy()[:, 0]
+    dec = CodecDecoder(fam.codec)
+    fwav = np.asarray(dec.apply(params["codec"],
+                                jnp.asarray(codes, jnp.int32)))
+    assert fwav.shape == twav.shape
+    np.testing.assert_allclose(fwav, twav, atol=1e-3, rtol=5e-3)

@@ -144,8 +144,45 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
             pipelined=True)
         del pipe, c, bundle
 
+    if "img2vid" in names:
+        # BASELINE.json #5 names "Stable Video Diffusion img2vid": the
+        # image-conditioned SVD-class family (pipelines/video.py::SVD)
+        from chiaswarm_tpu.pipelines.video import (
+            Img2VidPipeline,
+            VideoComponents,
+        )
+
+        fam = "svd_img2vid" if on_tpu else "tiny_svd"
+        vc = VideoComponents.random_host(fam, seed=0)
+        vc.params = jax.device_put(vc.params, device)
+        ipipe = Img2VidPipeline(vc, attn_impl=attn)
+        frames = 14 if on_tpu else 8
+        steps = 25 if on_tpu else 2
+        size = 512 if on_tpu else 64
+        cond = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+
+        def irun(seed: int) -> float:
+            t0 = time.perf_counter()
+            out, _ = ipipe(cond, num_frames=frames, steps=steps,
+                           height=size, width=size, seed=seed)
+            assert out.shape[0] == frames
+            return time.perf_counter() - t0
+
+        irun(0)
+        times = [irun(i + 1) for i in range(iters)]
+        p50 = _percentile50(times)
+        results["img2vid_svd"] = {
+            "p50_latency_s": round(p50, 3),
+            "frames": frames,
+            "steps": steps,
+            "size": size,
+            "frames_per_sec": round(frames / p50, 4),
+        }
+        del ipipe, vc
+
     if "txt2vid" in names:
-        # BASELINE.json #5: video diffusion (ModelScope-class temporal UNet)
+        # the model class the reference actually serves for video
+        # (ModelScope-class temporal UNet, swarm/video/tx2vid.py)
         from chiaswarm_tpu.pipelines.video import (
             VideoComponents,
             VideoPipeline,
@@ -231,7 +268,7 @@ def main() -> None:
 
     configs = {"sdxl_txt2img_1024": headline}
     if which != "headline":
-        names = (["sd15", "sd21", "controlnet", "txt2vid"]
+        names = (["sd15", "sd21", "controlnet", "img2vid", "txt2vid"]
                  if which == "all" else which.split(","))
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))

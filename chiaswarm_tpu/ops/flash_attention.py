@@ -51,12 +51,16 @@ except Exception:  # pragma: no cover
 _NEG_INF = -1e30  # finite stand-in: true -inf breaks exp() on fully-masked rows
 
 # block-sweep knobs (read once at import): defaults are the tuned v5e
-# values; CHIASWARM_FLASH_VMEM_MB raises the kernel-scoped VMEM budget so
-# blocks past the default ~16 MB scoped limit (2048x2048, 4096x1024)
-# become compilable for sweeps on other TPU generations
+# values. CHIASWARM_FLASH_VMEM_MB sets the kernel-scoped VMEM cap — the
+# default 24 MB gives the tuned 2048x1024 blocks headroom over XLA's
+# ~16 MB default cap (the SVD video program's surrounding pads push the
+# same blocks to 16.4 MB scoped); the cap is a compile-time guard, not an
+# allocation, so programs already under 16 MB compile identically. Raise
+# further for sweeps of bigger blocks (2048x2048, 4096x1024) on other
+# TPU generations; 0 = XLA's default cap.
 _DEFAULT_BLOCK_Q = int(os.environ.get("CHIASWARM_FLASH_BLOCK_Q", "2048"))
 _DEFAULT_BLOCK_KV = int(os.environ.get("CHIASWARM_FLASH_BLOCK_KV", "1024"))
-_VMEM_MB = int(os.environ.get("CHIASWARM_FLASH_VMEM_MB", "0"))  # 0 = default
+_VMEM_MB = int(os.environ.get("CHIASWARM_FLASH_VMEM_MB", "24"))
 _LANES = 128
 
 

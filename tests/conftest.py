@@ -25,6 +25,10 @@ from chiaswarm_tpu.core.compile_cache import (  # noqa: E402
 )
 
 enable_persistent_compilation_cache()
+# the suite is dominated by many SMALL compiles (tiny families, one
+# program per test parameterization) — persist nearly all of them, not
+# just the >2s ones the serving default targets
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 import pytest  # noqa: E402
 

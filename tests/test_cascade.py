@@ -109,6 +109,7 @@ def test_cascade_workload_dispatch():
     assert config["images_per_sec"] > 0
 
 
+@pytest.mark.slow
 def test_cascade_three_stage_emits_4x_sr_size(tiny_cascade):
     """Full IF protocol: base -> sr -> latent-upscale passes to
     4 * sr_size (the reference's stage-3 x4-upscaler output,
@@ -133,6 +134,7 @@ def test_cascade_three_stage_emits_4x_sr_size(tiny_cascade):
     assert config["size"] == [fam.sr_size * 2, fam.sr_size * 2]
 
 
+@pytest.mark.slow
 def test_cascade_stage3_x4_single_pass(tiny_cascade):
     """Stage 3 through the SD-x4-upscaler model class — the reference's
     actual stage 3 (diffusion_func_if.py:31-40): ONE pass takes sr_size
@@ -152,6 +154,7 @@ def test_cascade_stage3_x4_single_pass(tiny_cascade):
     assert config["size"] == [fam.sr_size * 4, fam.sr_size * 4]
 
 
+@pytest.mark.slow
 def test_cascade_stage_parallel_dispatch_and_placement():
     """Pipeline parallelism (SURVEY §2b): a multi-image job on a
     multi-chip slot runs stages 1+2 and stage 3 on DISJOINT submeshes
@@ -213,6 +216,7 @@ def test_cascade_stage_parallel_dispatch_and_placement():
     assert (imgs_a == imgs_b).all()
 
 
+@pytest.mark.slow
 def test_cascade_workload_three_stage_dispatch():
     """cascade_callback with upscale=True (the default) runs stage 3
     through the registry's upscaler and reports the upscaled size."""

@@ -4,6 +4,8 @@ import asyncio
 import json
 import os
 
+import pytest
+
 from chiaswarm_tpu.node.smoke import SMOKE_JOBS, run_smoke
 
 from tests.fake_hive import FakeHive
@@ -15,12 +17,14 @@ def test_smoke_txt2img_ok():
     assert "primary" in result["artifacts"]
 
 
+@pytest.mark.slow
 def test_smoke_img2img_ok():
     result = run_smoke("img2img")
     assert "error" not in result["pipeline_config"]
     assert result["pipeline_config"]["mode"] == "img2img"
 
 
+@pytest.mark.slow
 def test_smoke_txt2audio_and_cascade_ok():
     """Formerly fatal stubs — now real jitted pipelines."""
     result = run_smoke("txt2audio")
@@ -32,6 +36,7 @@ def test_smoke_txt2audio_and_cascade_ok():
     assert result["pipeline_config"]["mode"] == "cascade_txt2img"
 
 
+@pytest.mark.slow
 def test_smoke_txt2vid_ok():
     result = run_smoke("txt2vid")
     assert "fatal_error" not in result

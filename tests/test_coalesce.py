@@ -40,6 +40,7 @@ def _job(i: int, **over):
     return job
 
 
+@pytest.mark.slow
 def test_burst_coalesces_and_matches_solo(registry):
     """Three compatible jobs coalesce onto one program; each job's image
     agrees with its solo run (same seed) to uint8 quantization."""
@@ -69,6 +70,7 @@ def test_burst_coalesces_and_matches_solo(registry):
         diff.max(), (diff <= 1).mean())
 
 
+@pytest.mark.slow
 def test_incompatible_jobs_run_separately(registry):
     """A burst with mixed static params: the two compatible jobs coalesce,
     the odd one (different steps) runs alone; all ids come back."""
@@ -83,6 +85,7 @@ def test_incompatible_jobs_run_separately(registry):
     assert "coalesced" not in by_id["j1"]["pipeline_config"]
 
 
+@pytest.mark.slow
 def test_image_jobs_are_never_coalesced(registry):
     """img2img carries an input image — must take the per-job path."""
     rng = np.random.default_rng(0)
@@ -193,11 +196,38 @@ def test_row_chunks_bounds_total_batch_rows():
 
 
 def test_oversized_rows_run_per_job_not_batched(registry):
-    """End to end: two 4-image jobs on a dp=4 slot execute per job (the
-    coalesced program would be 8 rows = 2x any solo footprint)."""
+    """The per-device row budget guards the batch: 1024px-class jobs
+    (single_chip_rows == 1) never merge past one solo footprint per
+    device — pinned at the chunking layer, where the size class is the
+    only input that matters. 512px-class jobs (budget 4/device) DO merge
+    the same row counts (the r4 measured policy), covered end-to-end by
+    test_single_chip_slot_batches_small_jobs."""
+    from chiaswarm_tpu.node.executor import _row_chunks
+
+    def item(i, n, size):
+        return (i, f"j{i}", "image/png",
+                {"num_images_per_prompt": n, "height": size, "width": size})
+
+    big = [item(i, 4, 1024) for i in range(2)]
+    assert [len(c) for c in _row_chunks(big, 4)] == [1, 1]
+    small = [item(i, 4, 512) for i in range(2)]
+    assert [len(c) for c in _row_chunks(small, 4)] == [2]
+    # the budget is max(solo footprint, profitable batch), NOT their
+    # product: a multi-image 512px job never multiplies into 4x its own
+    # solo per-device memory
+    multi = [item(i, 16, 512) for i in range(2)]
+    assert [len(c) for c in _row_chunks(multi, 4)] == [1, 1]
+
+
+@pytest.mark.slow
+def test_oversized_rows_fall_back_per_job_e2e(registry):
+    """End to end through synchronous_do_work_batch: jobs whose combined
+    rows exceed the per-device budget run the per-job path — correct
+    results, no 'coalesced' marker (the non-merging direction of the
+    batching policy, e2e like its merging twin)."""
     pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
-    jobs = [_job(0, num_images_per_prompt=4),
-            _job(1, num_images_per_prompt=4)]
+    jobs = [_job(0, num_images_per_prompt=16),
+            _job(1, num_images_per_prompt=16)]
     results = synchronous_do_work_batch(jobs, pool.slots[0], registry)
     by_id = {r["id"]: r for r in results}
     assert set(by_id) == {"j0", "j1"}
@@ -329,6 +359,7 @@ def test_multislot_pool_coalesces_with_fairness_reserve(monkeypatch):
     assert len({name for name, _ in bursts}) == 2, bursts
 
 
+@pytest.mark.slow
 def test_coalesced_default_content_type_is_png(registry):
     """Solo-equivalence of encoding: a job without content_type must come
     back PNG from the coalesced path (the solo callback's default), not
@@ -351,3 +382,28 @@ def test_coalesced_default_content_type_is_png(registry):
         # separately
         cfg = r["pipeline_config"]
         assert cfg["batch_images_per_sec"] >= cfg["images_per_sec"]
+
+
+def test_single_chip_slot_batches_small_jobs(registry):
+    """A data_width=1 slot merges 512px-class jobs into one batched
+    program — one chip is not saturated by them at batch 1 (+20%
+    images/sec measured at batch 4 on the real chip, BASELINE.md r4).
+    1024px-class jobs stay one row per device (saturated at batch 1)."""
+    from chiaswarm_tpu.node.executor import single_chip_rows
+
+    assert single_chip_rows({"height": 512, "width": 512}) == 4
+    assert single_chip_rows({"height": 64, "width": 64}) == 4
+    assert single_chip_rows({"height": 1024, "width": 1024}) == 1
+    assert single_chip_rows({"height": None, "width": None}) == 1
+
+    import jax
+
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+    assert pool.slots[0].data_width == 1
+    jobs = [_job(i) for i in range(4)]
+    results = synchronous_do_work_batch(jobs, pool.slots[0], registry)
+    assert len(results) == 4
+    assert all(r["pipeline_config"].get("error") is None for r in results)
+    merged = [r["pipeline_config"].get("coalesced") for r in results]
+    assert merged == [4, 4, 4, 4], merged

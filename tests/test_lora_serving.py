@@ -55,6 +55,7 @@ def _write_tiny_lora(name: str, scale_mag: float = 1.0) -> None:
     )
 
 
+@pytest.mark.slow
 def test_job_with_lora_changes_output(tmp_path, monkeypatch, registry, pool):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     _write_tiny_lora("acme/style-lora")
@@ -82,6 +83,7 @@ def test_job_with_lora_changes_output(tmp_path, monkeypatch, registry, pool):
             == base["artifacts"]["primary"]["blob"])
 
 
+@pytest.mark.slow
 def test_lora_entries_are_cache_keyed_by_scale(tmp_path, monkeypatch,
                                                registry):
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))

@@ -302,6 +302,7 @@ def test_worker_health_endpoint(registry):
     asyncio.run(scenario())
 
 
+@pytest.mark.slow
 def test_worker_input_image_fetch(registry):
     """img2img through the worker: input image served by the FakeHive."""
 

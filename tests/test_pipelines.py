@@ -162,6 +162,7 @@ def test_components_param_bytes(tiny_pipeline):
     assert tiny_pipeline.c.param_bytes() > 10_000
 
 
+@pytest.mark.slow
 def test_sample_rows_are_batch_size_invariant():
     """Row b of a batched generation must equal the image generated at
     batch=1 with the same seed (per-sample noise keys fold the row index

@@ -105,6 +105,7 @@ def test_sd15_text_encoder_full_config_parity():
     np.testing.assert_allclose(np.asarray(seq), want, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sd21_text_encoder_full_config_parity():
     """SD2.1's OpenCLIP ViT-H tower: 23 layers, gelu — the family config
     the penultimate-trimmed checkpoint actually ships."""
@@ -132,6 +133,7 @@ def test_sdxl_encoder1_penultimate_readout_parity():
     np.testing.assert_allclose(np.asarray(seq), want, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_sdxl_encoder2_bigg_pooled_projection_parity():
     """SDXL text_encoder 2 (OpenCLIP bigG) at the FULL real config: the
     penultimate sequence readout AND the pooled text-projection output —
@@ -170,6 +172,7 @@ def _t5_ids_and_mask(batch: int = 2, length: int = 77, seed: int = 0):
     return ids, mask
 
 
+@pytest.mark.slow
 def test_t5_encoder_published_config_parity():
     """google/t5-v1_1-small — a real published config of the exact
     architecture family DeepFloyd's XXL encoder uses (gated-GELU, RMSNorm,
@@ -314,6 +317,7 @@ def test_clip_vision_tiny_parity():
     _vision_parity(hf, ours, seed=5, tol=2e-4)
 
 
+@pytest.mark.slow
 def test_clip_vision_vith_real_config_parity():
     """The laion ViT-H/14 image tower at the full published config — the
     image encoder SVD-class img2vid conditions on (and the shape class of
@@ -347,6 +351,7 @@ def _tree_leaves(tree, prefix=""):
 
 @pytest.mark.parametrize("family", [SD15, SDXL, UPSCALER_X4],
                          ids=lambda f: f.name)
+@pytest.mark.slow
 def test_full_config_unet_conversion_roundtrip(family):
     """The converter must map EVERY UNet key at the real per-block
     layouts (SDXL's [0,2,10] transformer depths, the x4-upscaler's
@@ -378,6 +383,7 @@ def test_full_config_unet_conversion_roundtrip(family):
 
 @pytest.mark.parametrize("family", [SD15, UPSCALER_X4],
                          ids=lambda f: f.name)
+@pytest.mark.slow
 def test_full_config_vae_conversion_roundtrip(family):
     """Same for the VAE — including the x4-upscaler's 3-level f=4
     decoder, a layout no tiny family covered before."""

@@ -80,6 +80,7 @@ def test_upscale_doubles_size(tiny_upscaler):
     assert np.array_equal(out, out2)
 
 
+@pytest.mark.slow
 def test_workload_upscale_flag():
     """diffusion_callback with upscale=True emits 2x-size artifacts."""
     from chiaswarm_tpu.node.registry import ModelRegistry

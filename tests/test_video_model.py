@@ -28,6 +28,7 @@ def test_video_family_routing():
     assert VIDEO_FAMILIES["modelscope_t2v"].unet.cross_attention_dim == 1024
 
 
+@pytest.mark.slow
 def test_temporal_unet_zero_init_is_framewise_2d():
     """Zero-initialized temporal layers are identity: identical per-frame
     inputs must produce identical per-frame outputs (the safe default for
@@ -114,6 +115,7 @@ def test_video_inflation_matches_2d_parent_at_frame1(tmp_path):
     assert all(np.array_equal(x, y) for x, y in zip(a, b))
 
 
+@pytest.mark.slow
 def test_video_checkpoint_pipeline_generates(tmp_path):
     from chiaswarm_tpu.pipelines.components import Components
     from chiaswarm_tpu.pipelines.video import VideoComponents, VideoPipeline
@@ -140,6 +142,7 @@ def test_img2vid_family_routing():
     assert get_video_family("damo/text-to-video").name == "modelscope_t2v"
 
 
+@pytest.mark.slow
 def test_img2vid_pipeline_shapes_and_determinism():
     import numpy as np
 
@@ -165,6 +168,7 @@ def test_img2vid_pipeline_shapes_and_determinism():
     assert not np.array_equal(frames, other)
 
 
+@pytest.mark.slow
 def test_img2vid_conditioning_image_matters():
     """Two different conditioning frames must produce different clips —
     the image embedding + concat latents actually steer the UNet."""
@@ -181,6 +185,7 @@ def test_img2vid_conditioning_image_matters():
     assert not np.array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_img2vid_workload_emits_video(tmp_path, monkeypatch):
     import numpy as np
 

@@ -166,11 +166,28 @@ def job_rows(job_or_kwargs: dict[str, Any]) -> int:
         return 1
 
 
-def rows_cap(rows_max: int, data_width: int) -> int:
-    """Max total rows a coalesced program may carry: dp * ceil(max/dp) —
-    never more per device than the heaviest member's solo run."""
+def single_chip_rows(kwargs: dict[str, Any]) -> int:
+    """How many batch rows ONE device profitably carries for this job
+    class. Measured (BASELINE.md r4): 512px-class programs are not
+    MXU-saturated at batch 1 — batch 4 reaches +20% images/sec on one
+    chip and the gain plateaus there; 1024px-class is saturated at
+    batch 1 (r1). Jobs without an explicit size are assumed large."""
+    try:
+        h, w = int(kwargs.get("height") or 0), int(kwargs.get("width") or 0)
+    except (TypeError, ValueError):
+        return 1
+    return 4 if 0 < h * w <= 512 * 512 else 1
+
+
+def rows_cap(rows_max: int, data_width: int, per_device_rows: int = 1) -> int:
+    """Max total rows a coalesced program may carry:
+    dp * max(ceil(rows_max/dp), per_device_rows) — per device, the LARGER
+    of the heaviest member's own solo footprint and the measured
+    profitable batch, never their product (a multi-image 512px job must
+    not multiply into 4x its solo per-device memory; rows past the
+    plateau add no throughput anyway)."""
     dw = max(1, int(data_width))
-    return dw * -(-rows_max // dw)
+    return dw * max(-(-rows_max // dw), max(1, int(per_device_rows)))
 
 
 def _row_chunks(group: list, data_width: int) -> list[list]:
@@ -185,10 +202,13 @@ def _row_chunks(group: list, data_width: int) -> list[list]:
     chunks: list[list] = []
     cur: list = []
     cur_rows = cur_max = 0
+    # group members share COALESCE_KEYS (incl. height/width), so the
+    # per-device row budget is uniform across the group
+    per_device = single_chip_rows(group[0][3]) if group else 1
     for item in group:
         rows = job_rows(item[3])
         if cur and cur_rows + rows > rows_cap(max(cur_max, rows),
-                                              data_width):
+                                              data_width, per_device):
             chunks.append(cur)
             cur, cur_rows, cur_max = [], 0, 0
         cur.append(item)

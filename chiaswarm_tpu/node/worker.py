@@ -27,6 +27,7 @@ from chiaswarm_tpu.node.executor import (
     do_work_batch,
     job_rows,
     rows_cap,
+    single_chip_rows,
 )
 from chiaswarm_tpu.node.hive import (
     POLL_BUSY_S,
@@ -273,12 +274,15 @@ class Worker:
         pending: set[asyncio.Task] = set()
         # cross-job coalescing: a dp-sharded slot runs up to dp compatible
         # jobs as ONE batched program (executor groups them; incompatible
-        # jobs in a burst just run serially). Single-data-row slots gain
-        # nothing (batch scaling is linear on one chip). On multi-slot
-        # pools the drain loop below additionally leaves ``_hungry_slots``
-        # jobs in the queue, so a coalescing slot never strips work an
-        # idle neighbor is already waiting for.
-        max_merge = slot.data_width
+        # jobs in a burst just run serially). 512px-class jobs
+        # additionally batch up to single_chip_rows() per device — one
+        # chip is NOT saturated by them at batch 1 (+20% measured,
+        # BASELINE.md r4); 1024px-class stays at one row per device
+        # (saturated, r1). On multi-slot pools the drain loop below
+        # additionally leaves ``_hungry_slots`` jobs in the queue, so a
+        # coalescing slot never strips work an idle neighbor is already
+        # waiting for.
+        base_merge = slot.data_width
 
         async def run_burst(burst: list[dict]) -> None:
             try:
@@ -322,6 +326,8 @@ class Worker:
                         self._hungry_slots -= 1
                 key = _burst_key(burst[0])
                 rows = rows_max = job_rows(burst[0])
+                per_device = single_chip_rows(burst[0])
+                max_merge = base_merge * per_device
                 while key is not None and len(burst) < max_merge:
                     # fairness reserve: jobs other slots are blocked on
                     # stay in the queue (the drain below has no awaits,
@@ -339,7 +345,7 @@ class Worker:
                     # (the executor's _row_chunks is the authority, this
                     # avoids claiming jobs it would split anyway)
                     fits = rows + cand_rows <= rows_cap(
-                        max(rows_max, cand_rows), max_merge)
+                        max(rows_max, cand_rows), base_merge, per_device)
                     if _burst_key(candidate) == key and fits:
                         burst.append(candidate)
                         rows += cand_rows

@@ -50,9 +50,6 @@ from chiaswarm_tpu.schedulers import (
 from chiaswarm_tpu.schedulers.common import ScheduleConfig
 from chiaswarm_tpu.schedulers.sampling import init_sampler_state
 
-DEFAULT_FRAMES = 25  # swarm/video/tx2vid.py:20
-
-
 @dataclasses.dataclass(frozen=True)
 class VideoFamily:
     name: str
@@ -66,7 +63,9 @@ class VideoFamily:
     image_conditioned: bool = False
     vision: VisionConfig | None = None
     prediction_type: str = "epsilon"
-    default_frames: int = 25  # swarm/video/tx2vid.py:20
+    # default clip length (25 = the reference's txt2vid default,
+    # swarm/video/tx2vid.py:20; SVD checkpoints publish their own)
+    default_frames: int = 25
 
 
 # text-to-video-ms-1.7b shaped (CLIP-H text tower, 4-level UNet)
@@ -99,6 +98,7 @@ TINY_VID = VideoFamily(
                   dtype="float32"),
     default_size=64,
     max_frames=16,
+    default_frames=8,
 )
 
 # stable-video-diffusion-img2vid shaped: image-conditioned spatio-temporal
@@ -494,7 +494,7 @@ class VideoPipeline:
             lambda: self._build_fn(**static))
 
     def __call__(self, prompt: str, negative_prompt: str = "",
-                 num_frames: int = DEFAULT_FRAMES, steps: int = 25,
+                 num_frames: int | None = None, steps: int = 25,
                  guidance_scale: float = 9.0, height: int | None = None,
                  width: int | None = None, seed: int = 0,
                  scheduler: str | None = None) -> tuple[np.ndarray, dict]:
@@ -503,7 +503,8 @@ class VideoPipeline:
         req_height = int(height or fam.default_size)
         req_width = int(width or fam.default_size)
         height, width = bucket_image_size(req_height, req_width)
-        requested = max(1, min(int(num_frames), fam.max_frames))
+        requested = max(1, min(int(num_frames or fam.default_frames),
+                               fam.max_frames))
         frames = min((requested + 7) // 8 * 8, fam.max_frames)
         sampler = resolve(scheduler, prediction_type="epsilon")
         use_cfg = guidance_scale > 1.0

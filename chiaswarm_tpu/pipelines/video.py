@@ -63,6 +63,10 @@ class VideoFamily:
     image_conditioned: bool = False
     vision: VisionConfig | None = None
     prediction_type: str = "epsilon"
+    # EDM continuous-sigma schedule (SVD): karras ladder over this range
+    # with 0.25*log(sigma) timestep conditioning, replacing the
+    # beta-derived discrete schedule. None = discrete (ModelScope class).
+    edm_sigma_range: tuple[float, float] | None = None
     # default clip length (25 = the reference's txt2vid default,
     # swarm/video/tx2vid.py:20; SVD checkpoints publish their own)
     default_frames: int = 25
@@ -107,9 +111,9 @@ TINY_VID = VideoFamily(
 # noise-aug) micro-conditioning through the 256-dim added embedding.
 # BASELINE.json config #5 names this class; the reference itself serves
 # only ModelScope-style txt2vid (swarm/video/tx2vid.py) — this family goes
-# beyond reference parity to match the driver's config sheet. The EDM
-# sigma schedule of the published checkpoint is approximated with the
-# v-prediction Karras-sigma Euler sampler (schedulers/sampling.py).
+# beyond reference parity to match the driver's config sheet. The denoise
+# runs the published EDM schedule: karras sigmas over (0.002, 700) with
+# 0.25*log(sigma) conditioning and v-prediction (edm_sigma_range below).
 SVD = VideoFamily(
     name="svd_img2vid",
     text_encoder=None,
@@ -131,6 +135,7 @@ SVD = VideoFamily(
                         patch_size=14, projection_dim=1024,
                         hidden_act="gelu"),
     prediction_type="v_prediction",
+    edm_sigma_range=(0.002, 700.0),   # the published SVD EulerDiscrete
     default_frames=14,
 )
 
@@ -152,6 +157,7 @@ TINY_SVD = VideoFamily(
                         num_heads=2, image_size=28, patch_size=14,
                         projection_dim=16),
     prediction_type="v_prediction",
+    edm_sigma_range=(0.002, 700.0),
     default_frames=8,
 )
 
@@ -558,6 +564,9 @@ class Img2VidPipeline:
         if not components.family.image_conditioned:
             raise ValueError("Img2VidPipeline requires an image-conditioned "
                              "family (svd_img2vid/tiny_svd)")
+        if components.family.edm_sigma_range is None:
+            raise ValueError("image-conditioned families denoise on the "
+                             "EDM schedule; set edm_sigma_range")
         self.c = components
         fam = components.family
         if attn_impl not in ("auto", fam.unet.attn_impl):
@@ -573,7 +582,13 @@ class Img2VidPipeline:
                   sampler, use_cfg: bool):
         fam = self.c.family
         vision, unet, vae = (self.c.image_encoder, self.c.unet, self.c.vae)
-        sched = make_sampling_schedule(self.noise_schedule, steps, sampler)
+        # the published SVD schedule (see make_edm_schedule); the
+        # v-prediction preconditioning and 1/sqrt(sigma^2+1) input
+        # scaling are the framework's existing sigma-space math
+        from chiaswarm_tpu.schedulers.sampling import make_edm_schedule
+
+        smin, smax = fam.edm_sigma_range
+        sched = make_edm_schedule(smin, smax, steps)
         f = fam.vae.downscale
         lh, lw = height // f, width // f
         latent_ch = fam.vae.latent_channels

@@ -89,6 +89,20 @@ def make_sampling_schedule(
                             timesteps=timesteps.astype(jnp.float32))
 
 
+def make_edm_schedule(sigma_min: float, sigma_max: float,
+                      num_steps: int) -> SamplingSchedule:
+    """EDM continuous-sigma schedule (SVD-class): karras ladder over
+    (sigma_min, sigma_max) with ``0.25 * log(sigma)`` conditioning —
+    diffusers EulerDiscrete with ``timestep_type="continuous"``. The
+    trailing zero sigma and the framework's v-prediction/input-scaling
+    sigma-space math apply unchanged."""
+    sig = karras_sigmas(jnp.float32(sigma_min), jnp.float32(sigma_max),
+                        num_steps)
+    return SamplingSchedule(
+        sigmas=jnp.concatenate([sig, jnp.zeros((1,))]).astype(jnp.float32),
+        timesteps=(0.25 * jnp.log(sig)).astype(jnp.float32))
+
+
 def init_noise_scale(sched: SamplingSchedule) -> jnp.ndarray:
     """Initial latents = N(0,1) * sigma_max (k-diffusion convention)."""
     return sched.sigmas[0]

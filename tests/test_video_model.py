@@ -212,3 +212,39 @@ def test_img2vid_workload_emits_video(tmp_path, monkeypatch):
     art = result["artifacts"]["primary"]
     assert art["content_type"].startswith("video/")
     assert art["blob"] and art["thumbnail"]
+
+
+def test_svd_edm_schedule_tables(monkeypatch):
+    """The img2vid denoise must run the published SVD schedule: karras
+    sigmas spanning (0.002, 700), a trailing zero, and 0.25*log(sigma)
+    conditioning (diffusers EulerDiscrete timestep_type="continuous") —
+    asserted on make_edm_schedule's own output AND on the pipeline
+    actually requesting it with the family's range."""
+    import numpy as np
+
+    import chiaswarm_tpu.schedulers.sampling as sampling
+    from chiaswarm_tpu.pipelines.video import Img2VidPipeline, VideoComponents
+
+    sched = sampling.make_edm_schedule(0.002, 700.0, 10)
+    sig = np.asarray(sched.sigmas)
+    assert sig.shape == (11,) and sig[-1] == 0.0
+    assert np.isclose(sig[0], 700.0, rtol=1e-4)
+    assert np.isclose(sig[-2], 0.002, rtol=1e-3)
+    assert (np.diff(sig) < 0).all()
+    np.testing.assert_allclose(np.asarray(sched.timesteps),
+                               0.25 * np.log(sig[:-1]), rtol=1e-5)
+
+    pipe = Img2VidPipeline(VideoComponents.random("tiny_svd", seed=0))
+    calls = []
+    orig = sampling.make_edm_schedule
+
+    def spy(smin, smax, n):
+        calls.append((smin, smax, n))
+        return orig(smin, smax, n)
+
+    monkeypatch.setattr(sampling, "make_edm_schedule", spy)
+    rng = np.random.default_rng(1)
+    frames, cfg = pipe(rng.integers(0, 255, (64, 64, 3), dtype=np.uint8),
+                       num_frames=4, steps=2, height=64, width=64, seed=1)
+    assert frames.shape == (4, 64, 64, 3)
+    assert calls == [pipe.c.family.edm_sigma_range + (2,)]

@@ -48,7 +48,10 @@ from chiaswarm_tpu.schedulers import (
     scale_model_input,
 )
 from chiaswarm_tpu.schedulers.common import ScheduleConfig
-from chiaswarm_tpu.schedulers.sampling import init_sampler_state
+from chiaswarm_tpu.schedulers.sampling import (
+    init_sampler_state,
+    make_edm_schedule,
+)
 
 @dataclasses.dataclass(frozen=True)
 class VideoFamily:
@@ -573,10 +576,6 @@ class Img2VidPipeline:
             components.unet = VideoUNet(
                 dataclasses.replace(fam.unet, attn_impl=attn_impl),
                 max_frames=fam.max_frames)
-        self.schedule_config = ScheduleConfig(
-            beta_schedule="scaled_linear",
-            prediction_type=fam.prediction_type)
-        self.noise_schedule = make_noise_schedule(self.schedule_config)
 
     def _build_fn(self, *, frames: int, height: int, width: int, steps: int,
                   sampler, use_cfg: bool):
@@ -585,8 +584,6 @@ class Img2VidPipeline:
         # the published SVD schedule (see make_edm_schedule); the
         # v-prediction preconditioning and 1/sqrt(sigma^2+1) input
         # scaling are the framework's existing sigma-space math
-        from chiaswarm_tpu.schedulers.sampling import make_edm_schedule
-
         smin, smax = fam.edm_sigma_range
         sched = make_edm_schedule(smin, smax, steps)
         f = fam.vae.downscale

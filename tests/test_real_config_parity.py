@@ -381,6 +381,77 @@ def test_full_config_unet_conversion_roundtrip(family):
             np.asarray(want[path], np.float32), err_msg=path)
 
 
+@pytest.mark.parametrize("family", [SD15, SDXL], ids=lambda f: f.name)
+@pytest.mark.slow
+def test_full_config_controlnet_conversion_roundtrip(family):
+    """The ControlNet converter must map every key at the real trunk
+    layouts (SD1.5's 4-level and SDXL's [0,2,10]-depth 3-level down
+    path + the zero convs + the hint embedder) — the control branch of
+    BASELINE config #4 (ref swarm/diffusion/diffusion_func.py:29-39)."""
+    from chiaswarm_tpu.convert.torch_to_flax import convert_controlnet
+    from chiaswarm_tpu.pipelines.components import ControlNetBundle
+
+    from tests.torch_export import export_controlnet
+
+    src = ControlNetBundle.random_host(family.name, seed=2)
+    exported = export_controlnet(src.params,
+                                 len(family.unet.block_out_channels))
+    converted = convert_controlnet(exported, family.unet)
+
+    want = _tree_leaves(src.params)
+    got = _tree_leaves(converted)
+    assert set(got) == set(want), (
+        sorted(set(want) - set(got))[:5], sorted(set(got) - set(want))[:5])
+    rng = np.random.default_rng(2)
+    paths = sorted(want)
+    for path in [paths[i] for i in
+                 rng.choice(len(paths), size=24, replace=False)]:
+        assert got[path].shape == want[path].shape, path
+        np.testing.assert_array_equal(
+            np.asarray(got[path], np.float32),
+            np.asarray(want[path], np.float32), err_msg=path)
+
+
+@pytest.mark.slow
+def test_full_config_audioldm_unet_conversion_roundtrip():
+    """The AudioLDM UNet at its real layout: cross-attention-free
+    transformer blocks + the simple-projection class embedding (a Linear,
+    not an Embed — the converter must transpose it) over the published
+    (128, 256, 384, 640) mel-latent trunk (ref swarm/audio/
+    audioldm.py:12-24)."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.models.unet import UNet
+    from chiaswarm_tpu.pipelines.audio import AUDIOLDM
+    from chiaswarm_tpu.pipelines.components import materialize_host
+
+    from tests.torch_export import export_unet
+
+    unet = UNet(AUDIOLDM.unet)
+    shapes = jax.eval_shape(
+        unet.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, 8, 8, AUDIOLDM.unet.sample_channels)),
+        jnp.zeros((1,)), None,
+        class_labels=jnp.zeros((1, AUDIOLDM.unet.class_proj_dim)))
+    src = materialize_host(shapes, np.random.default_rng(4), "bfloat16")
+    exported = export_unet(src, len(AUDIOLDM.unet.block_out_channels))
+    converted = convert_unet(exported, AUDIOLDM.unet)
+
+    want = _tree_leaves(src["params"])
+    got = _tree_leaves(converted["params"])
+    assert set(got) == set(want), (
+        sorted(set(want) - set(got))[:5], sorted(set(got) - set(want))[:5])
+    for path in sorted(want):
+        assert got[path].shape == want[path].shape, path
+        # VALUES too: the square (512, 512) class-embedding Linear makes
+        # a missing transpose shape-invisible — only equality catches it
+        np.testing.assert_array_equal(
+            np.asarray(got[path], np.float32),
+            np.asarray(want[path], np.float32), err_msg=path)
+
+
 @pytest.mark.parametrize("family", [SD15, UPSCALER_X4],
                          ids=lambda f: f.name)
 @pytest.mark.slow

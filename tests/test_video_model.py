@@ -234,6 +234,8 @@ def test_svd_edm_schedule_tables(monkeypatch):
     np.testing.assert_allclose(np.asarray(sched.timesteps),
                                0.25 * np.log(sig[:-1]), rtol=1e-5)
 
+    import chiaswarm_tpu.pipelines.video as video_mod
+
     pipe = Img2VidPipeline(VideoComponents.random("tiny_svd", seed=0))
     calls = []
     orig = sampling.make_edm_schedule
@@ -242,7 +244,7 @@ def test_svd_edm_schedule_tables(monkeypatch):
         calls.append((smin, smax, n))
         return orig(smin, smax, n)
 
-    monkeypatch.setattr(sampling, "make_edm_schedule", spy)
+    monkeypatch.setattr(video_mod, "make_edm_schedule", spy)
     rng = np.random.default_rng(1)
     frames, cfg = pipe(rng.integers(0, 255, (64, 64, 3), dtype=np.uint8),
                        num_frames=4, steps=2, height=64, width=64, seed=1)

@@ -83,6 +83,35 @@ def export_unet(flax_params: dict, n_levels: int) -> dict[str, np.ndarray]:
     return out
 
 
+def export_controlnet(bundle_params: dict,
+                      n_levels: int) -> dict[str, np.ndarray]:
+    """ControlNetBundle.params ({"net", "embed"}) -> diffusers
+    ``ControlNetModel`` state-dict naming. The trunk reuses export_unet's
+    reverse map (same module names as the UNet down+mid path); the
+    controlnet-specific heads are the zero convs and the hint embedder."""
+    out: dict[str, np.ndarray] = {}
+    trunk: dict = {}
+    for key, sub in bundle_params["net"]["params"].items():
+        m = re.fullmatch(r"controlnet_down_blocks_(\d+)", key)
+        if m:
+            for leaf, value in sub.items():
+                _leaf(f"controlnet_down_blocks.{m.group(1)}", leaf, value,
+                      out)
+        elif key == "controlnet_mid_block":
+            for leaf, value in sub.items():
+                _leaf("controlnet_mid_block", leaf, value, out)
+        else:
+            trunk[key] = sub
+    out.update(export_unet({"params": trunk}, n_levels))
+    for key, sub in bundle_params["embed"]["params"].items():
+        m = re.fullmatch(r"blocks_(\d+)", key)
+        base = (f"controlnet_cond_embedding.blocks.{m.group(1)}" if m
+                else f"controlnet_cond_embedding.{key}")
+        for leaf, value in sub.items():
+            _leaf(base, leaf, value, out)
+    return out
+
+
 def export_vae(flax_params: dict, n_levels: int) -> dict[str, np.ndarray]:
     out: dict[str, np.ndarray] = {}
     for path, value in _flatten(flax_params["params"]):

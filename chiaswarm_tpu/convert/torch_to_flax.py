@@ -98,7 +98,10 @@ def _place(flat: dict[str, np.ndarray], flax_path: str, name: str,
            value: np.ndarray) -> None:
     """Append one torch leaf under ``flax_path`` with layout transform."""
     if name == "weight":
-        if value.ndim == 4:    # conv OIHW -> HWIO
+        if value.ndim == 5:    # Conv3d (O,I,kT,kH,kW) -> (kT,kH,kW,I,O)
+            # (the video UNets' frame-axis (3,1,1) convs)
+            flat[f"{flax_path}/kernel"] = value.transpose(2, 3, 4, 1, 0)
+        elif value.ndim == 4:  # conv OIHW -> HWIO
             flat[f"{flax_path}/kernel"] = value.transpose(2, 3, 1, 0)
         elif value.ndim == 2:  # linear (O,I) -> (I,O)
             flat[f"{flax_path}/kernel"] = value.T
@@ -218,6 +221,183 @@ def _unet_path(body: list[str], n_levels: int) -> str | None:
     return None
 
 
+# ------------------------------------------------------------ video UNets
+
+def _temp_conv_inner(rest: list[str]) -> str | None:
+    """Names inside diffusers' ``TemporalConvLayer``: each of conv1..conv4
+    is an nn.Sequential whose index 0 is the GroupNorm and whose last
+    entry is the Conv3d (index 2, or 3 behind a Dropout)."""
+    if len(rest) < 2:
+        return None
+    m = re.fullmatch(r"conv([1-4])", rest[0])
+    if not m:
+        return None
+    return f"norm{m.group(1)}" if rest[1] == "0" else f"conv{m.group(1)}"
+
+
+def _unet3d_path(body: list[str], n_levels: int) -> str | None:
+    """ModelScope ``UNet3DConditionModel`` keys -> models/video_unet.py
+    UNet3D paths. Spatial modules reuse the 2D rules (_unet_path); the
+    temporal additions are ``transformer_in``, per-block ``temp_convs``
+    and ``temp_attentions`` (both TransformerTemporalModel layouts map
+    through _attention_inner — same proj/block naming)."""
+    if body[0] == "transformer_in":
+        inner = _attention_inner(body[1:])
+        return f"transformer_in/{inner}" if inner else None
+    if body[0] in ("down_blocks", "up_blocks") and len(body) > 4:
+        level = int(body[1])
+        side = "down" if body[0] == "down_blocks" else "up"
+        if side == "up":
+            level = n_levels - 1 - level
+        if body[2] == "temp_convs":
+            inner = _temp_conv_inner(body[4:])
+            return (f"{side}_{level}_tconvs_{body[3]}/{inner}"
+                    if inner else None)
+        if body[2] == "temp_attentions":
+            inner = _attention_inner(body[4:])
+            return (f"{side}_{level}_tattns_{body[3]}/{inner}"
+                    if inner else None)
+    if body[0] == "mid_block" and len(body) > 2:
+        if body[1] == "temp_convs":
+            inner = _temp_conv_inner(body[3:])
+            return f"mid_tconvs_{body[2]}/{inner}" if inner else None
+        if body[1] == "temp_attentions" and body[2] == "0":
+            inner = _attention_inner(body[3:])
+            return f"mid_tattn/{inner}" if inner else None
+    return _unet_path(body, n_levels)
+
+
+def convert_unet3d(state: Mapping[str, np.ndarray],
+                   config: UNetConfig) -> dict:
+    """diffusers ``UNet3DConditionModel`` state dict (the layout of
+    text-to-video-ms-1.7b, the snapshot the reference serves —
+    swarm/video/tx2vid.py:24-27) -> UNet3D params."""
+    n_levels = len(config.block_out_channels)
+    flat: dict[str, np.ndarray] = {}
+    skipped: list[str] = []
+    for key, value in state.items():
+        parts = key.split(".")
+        path = _unet3d_path(parts[:-1], n_levels)
+        if path is None:
+            skipped.append(key)
+            continue
+        _place(flat, path, parts[-1], value)
+    if skipped:
+        log.info("unet3d conversion skipped %d keys (e.g. %s)",
+                 len(skipped), skipped[0])
+    return _nest(flat)
+
+
+def _temporal_block_inner(rest: list[str]) -> str | None:
+    """Names inside diffusers' ``TemporalBasicTransformerBlock``."""
+    if not rest:
+        return None
+    head = rest[0]
+    if head in ("norm_in", "norm1", "norm2", "norm3"):
+        return head
+    if head in ("ff_in", "ff") and len(rest) >= 3 and rest[1] == "net":
+        if rest[2] == "0" and len(rest) > 3 and rest[3] == "proj":
+            return f"{head}/proj_in"
+        if rest[2] == "2":
+            return f"{head}/proj_out"
+        return None
+    if head in ("attn1", "attn2") and len(rest) > 1:
+        proj = rest[1]
+        if proj == "to_out":       # to_out.0 (ModuleList with dropout)
+            return f"{head}/to_out"
+        if proj in ("to_q", "to_k", "to_v"):
+            return f"{head}/{proj}"
+    return None
+
+
+def _st_attention_inner(rest: list[str]) -> str | None:
+    """Names inside diffusers' ``TransformerSpatioTemporalModel``: the
+    spatial transformer_blocks reuse _attention_inner; the temporal side
+    adds temporal_transformer_blocks, time_pos_embed and the time_mixer's
+    scalar blend weight."""
+    if not rest:
+        return None
+    head = rest[0]
+    if head == "temporal_transformer_blocks" and len(rest) > 2:
+        inner = _temporal_block_inner(rest[2:])
+        return f"temporal_blocks_{rest[1]}/{inner}" if inner else None
+    if head == "time_pos_embed" and len(rest) > 1 and \
+            rest[1] in ("linear_1", "linear_2"):
+        return f"time_pos_embed/{rest[1]}"
+    if head == "time_mixer":
+        return ""                  # mix_factor sits at the module root
+    return _attention_inner(rest)
+
+
+def _unet_st_path(body: list[str], n_levels: int) -> str | None:
+    """SVD ``UNetSpatioTemporalConditionModel`` keys ->
+    models/video_unet.py UNetSpatioTemporal paths."""
+    if body[0] in ("down_blocks", "up_blocks") and len(body) > 4:
+        level = int(body[1])
+        side = "down" if body[0] == "down_blocks" else "up"
+        if side == "up":
+            level = n_levels - 1 - level
+        kind, j = body[2], body[3]
+        if kind == "resnets":
+            root = f"{side}_{level}_resnets_{j}"
+            sub = body[4]
+            if sub == "spatial_res_block" and body[5] in _RESNET_LEAVES:
+                return f"{root}/spatial/{body[5]}"
+            if sub == "temporal_res_block" and body[5] in _RESNET_LEAVES:
+                return f"{root}/temporal/{body[5]}"
+            if sub == "time_mixer":
+                return root        # leaf name is mix_factor
+            return None
+        if kind == "attentions":
+            inner = _st_attention_inner(body[4:])
+            if inner is None:
+                return None
+            root = f"{side}_{level}_attentions_{j}"
+            return f"{root}/{inner}" if inner else root
+        if kind == "downsamplers" and body[4] == "conv":
+            return f"down_{level}_downsample/conv"
+        if kind == "upsamplers" and body[4] == "conv":
+            return f"up_{level}_upsample/conv"
+        return None
+    if body[0] == "mid_block" and len(body) > 3:
+        if body[1] == "resnets":
+            root = f"mid_resnets_{body[2]}"
+            sub = body[3]
+            if sub == "spatial_res_block" and body[4] in _RESNET_LEAVES:
+                return f"{root}/spatial/{body[4]}"
+            if sub == "temporal_res_block" and body[4] in _RESNET_LEAVES:
+                return f"{root}/temporal/{body[4]}"
+            if sub == "time_mixer":
+                return root
+            return None
+        if body[1] == "attentions" and body[2] == "0":
+            inner = _st_attention_inner(body[3:])
+            if inner is None:
+                return None
+            return f"mid_attention/{inner}" if inner else "mid_attention"
+    return _unet_path(body, n_levels)
+
+
+def convert_unet_spatio_temporal(state: Mapping[str, np.ndarray],
+                                 config: UNetConfig) -> dict:
+    """diffusers ``UNetSpatioTemporalConditionModel`` state dict (the
+    published SVD img2vid layout) -> UNetSpatioTemporal params."""
+    n_levels = len(config.block_out_channels)
+    flat: dict[str, np.ndarray] = {}
+    skipped: list[str] = []
+    for key, value in state.items():
+        parts = key.split(".")
+        path = _unet_st_path(parts[:-1], n_levels)
+        if path is None:
+            skipped.append(key)
+            continue
+        _place(flat, path, parts[-1], value)
+    if skipped:
+        log.info("spatio-temporal unet conversion skipped %d keys "
+                 "(e.g. %s)", len(skipped), skipped[0])
+    return _nest(flat)
+
+
 # ------------------------------------------------------------- ControlNet
 
 def convert_controlnet(state: Mapping[str, np.ndarray],
@@ -323,6 +503,75 @@ def _vae_path(body: list[str], n_levels: int) -> str | None:
             if leaf in ("to_q", "to_k", "to_v", "to_out", "group_norm"):
                 return f"{side}/mid/attentions_0/{leaf}"
     return None
+
+
+# ----------------------------------------------------- temporal VAE (SVD)
+
+def _temporal_vae_decoder_path(rest: list[str],
+                               n_levels: int) -> str | None:
+    """``TemporalDecoder`` keys (under ``decoder.``) ->
+    models/vae.py TemporalVaeDecoder paths."""
+    joined = ".".join(rest)
+    if joined in ("conv_in", "conv_norm_out", "conv_out", "time_conv_out"):
+        return f"decoder/{rest[0]}"
+    if rest[0] == "mid_block":
+        if rest[1] == "resnets":
+            root = f"decoder/mid_resnets_{rest[2]}"
+            if rest[3] == "spatial_res_block" and rest[4] in _RESNET_LEAVES:
+                return f"{root}/spatial/{rest[4]}"
+            if rest[3] == "temporal_res_block" and \
+                    rest[4] in _RESNET_LEAVES:
+                return f"{root}/temporal/{rest[4]}"
+            if rest[3] == "time_mixer":
+                return root               # leaf mix_factor
+            return None
+        if rest[1] == "attentions" and rest[2] == "0":
+            leaf = _VAE_ATTN_ALIASES.get(rest[3], rest[3])
+            if leaf in ("to_q", "to_k", "to_v", "to_out", "group_norm"):
+                return f"decoder/mid_attention/{leaf}"
+            return None
+    if rest[0] == "up_blocks":
+        level = n_levels - 1 - int(rest[1])
+        if rest[2] == "resnets":
+            root = f"decoder/up_{level}_resnets_{rest[3]}"
+            if rest[4] == "spatial_res_block" and rest[5] in _RESNET_LEAVES:
+                return f"{root}/spatial/{rest[5]}"
+            if rest[4] == "temporal_res_block" and \
+                    rest[5] in _RESNET_LEAVES:
+                return f"{root}/temporal/{rest[5]}"
+            if rest[4] == "time_mixer":
+                return root
+            return None
+        if rest[2] == "upsamplers" and rest[4] == "conv":
+            return f"decoder/up_{level}_upsample"
+    return None
+
+
+def convert_temporal_vae(state: Mapping[str, np.ndarray],
+                         config: VAEConfig) -> dict:
+    """``AutoencoderKLTemporalDecoder`` state dict (the VAE real SVD
+    snapshots ship) -> AutoencoderKLTemporalDecoder params: standard
+    encoder (+ quant_conv) through the 2D VAE rules, the TemporalDecoder
+    through its own. There is no post_quant_conv in this layout."""
+    n_levels = len(config.block_out_channels)
+    flat: dict[str, np.ndarray] = {}
+    skipped: list[str] = []
+    for key, value in state.items():
+        parts = key.split(".")
+        name = parts[-1]
+        body = parts[:-1]
+        if body and body[0] == "decoder":
+            path = _temporal_vae_decoder_path(body[1:], n_levels)
+        else:
+            path = _vae_path(body, n_levels)
+        if path is None:
+            skipped.append(key)
+            continue
+        _place(flat, path, name, value)
+    if skipped:
+        log.info("temporal vae conversion skipped %d keys (e.g. %s)",
+                 len(skipped), skipped[0])
+    return _nest(flat)
 
 
 # ---------------------------------------------------------- text encoder

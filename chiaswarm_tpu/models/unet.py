@@ -51,14 +51,19 @@ def timestep_embedding(timesteps: jnp.ndarray, dim: int,
 
 
 class TimestepEmbedding(nn.Module):
-    """Two-layer MLP lifting the sinusoidal embedding to the block width."""
+    """Two-layer MLP lifting the sinusoidal embedding to the block width.
+    ``hidden_dim`` covers diffusers' ``out_dim`` variant (SVD's
+    ``time_pos_embed``: C -> 4C -> C); None keeps both layers at
+    ``out_dim``."""
 
     out_dim: int
     dtype: jnp.dtype = jnp.float32
+    hidden_dim: int | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Dense(self.out_dim, dtype=self.dtype, name="linear_1")(x)
+        x = nn.Dense(self.hidden_dim or self.out_dim, dtype=self.dtype,
+                     name="linear_1")(x)
         x = nn.silu(x)
         return nn.Dense(self.out_dim, dtype=self.dtype, name="linear_2")(x)
 
@@ -66,10 +71,11 @@ class TimestepEmbedding(nn.Module):
 class ResnetBlock(nn.Module):
     out_channels: int
     dtype: jnp.dtype = jnp.float32
+    eps: float = 1e-5  # SVD's attention-level blocks ship 1e-6
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, temb: jnp.ndarray) -> jnp.ndarray:
-        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-5, dtype=jnp.float32,
+        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=self.eps, dtype=jnp.float32,
                          name="norm1")(x)
         h = nn.silu(h).astype(self.dtype)
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
@@ -77,7 +83,7 @@ class ResnetBlock(nn.Module):
         t = nn.Dense(self.out_channels, dtype=self.dtype,
                      name="time_emb_proj")(nn.silu(temb))
         h = h + t[:, None, None, :]
-        h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]), epsilon=1e-5, dtype=jnp.float32,
+        h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]), epsilon=self.eps, dtype=jnp.float32,
                          name="norm2")(h)
         h = nn.silu(h).astype(self.dtype)
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype,
@@ -99,7 +105,9 @@ class FeedForward(nn.Module):
         inner = self.dim * 4
         x = nn.Dense(inner * 2, dtype=self.dtype, name="proj_in")(x)
         x, gate = jnp.split(x, 2, axis=-1)
-        x = x * nn.gelu(gate)
+        # exact (erf) gelu — diffusers' GEGLU calls F.gelu without the
+        # tanh approximation; matters for number-level checkpoint parity
+        x = x * nn.gelu(gate, approximate=False)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj_out")(x)
 
 

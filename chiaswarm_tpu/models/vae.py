@@ -131,6 +131,122 @@ class Decoder(nn.Module):
                        name="conv_out")(x)
 
 
+class VaeSpatioTemporalResBlock(nn.Module):
+    """The temb-free ``SpatioTemporalResBlock`` of diffusers'
+    ``TemporalDecoder`` (the SVD snapshot's VAE decoder): spatial resnet
+    (eps 1e-6) -> temporal resnet (eps 1e-5) -> SWITCHED learned blend
+    out = (1-a)*spatial + a*temporal, a = sigmoid(mix_factor) — the
+    ``merge_strategy="learned"``/``switch_spatial_to_temporal_mix`` combo
+    this decoder ships (the UNet blocks use the non-switched direction)."""
+
+    out_channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (B, F, H, W, C)
+        from chiaswarm_tpu.models.video_unet import TemporalResnetBlock
+
+        b, f = x.shape[:2]
+        s = VaeResnetBlock(self.out_channels, self.dtype,
+                           name="spatial")(x.reshape((-1,) + x.shape[2:]))
+        s = s.reshape((b, f) + s.shape[1:])
+        t = TemporalResnetBlock(self.out_channels, 1e-5, self.dtype,
+                                name="temporal")(s)
+        a = nn.sigmoid(self.param("mix_factor",
+                                  nn.initializers.constant(0.0), (1,)))
+        a = a.astype(s.dtype)
+        return (1.0 - a) * s + a * t
+
+
+class TemporalVaeDecoder(nn.Module):
+    """diffusers ``TemporalDecoder``: the published SVD VAE decoder.
+    Every resnet slot is a temb-free spatio-temporal pair; one spatial
+    mid attention; a final frame-axis (3,1,1) conv (``time_conv_out``)
+    after conv_out. No post_quant_conv — the latents feed conv_in
+    directly (the published ``AutoencoderKLTemporalDecoder`` layout)."""
+
+    config: VAEConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jnp.ndarray) -> jnp.ndarray:  # (B, F, lh, lw, C)
+        cfg = self.config
+        chans = list(cfg.block_out_channels)
+        if cfg.layers_per_block != 2:
+            # the mid block below hardcodes the published 2-resnet +
+            # 1-attention shape (MidBlockTemporalDecoder at
+            # num_layers=2, the only configuration SVD ships)
+            raise ValueError("TemporalVaeDecoder requires "
+                             "layers_per_block=2 (the published layout)")
+        b, f = z.shape[:2]
+
+        def fold(v):
+            return v.reshape((-1,) + v.shape[2:])
+
+        def unfold(v):
+            return v.reshape((b, f) + v.shape[1:])
+
+        x = nn.Conv(chans[-1], (3, 3), padding=1, dtype=self.dtype,
+                    name="conv_in")(fold(z.astype(self.dtype)))
+        # mid: resnets[0] -> attention -> resnets[1] (num_layers =
+        # layers_per_block; per-frame spatial attention, VAE-style)
+        x = VaeSpatioTemporalResBlock(chans[-1], self.dtype,
+                                      name="mid_resnets_0")(unfold(x))
+        x = VaeAttention(self.dtype, name="mid_attention")(fold(x))
+        x = VaeSpatioTemporalResBlock(chans[-1], self.dtype,
+                                      name="mid_resnets_1")(unfold(x))
+        for rev, ch in enumerate(reversed(chans)):
+            level = len(chans) - 1 - rev
+            for j in range(cfg.layers_per_block + 1):
+                x = VaeSpatioTemporalResBlock(
+                    ch, self.dtype, name=f"up_{level}_resnets_{j}")(x)
+            if level > 0:
+                h = upsample2x_nearest(fold(x))
+                h = nn.Conv(ch, (3, 3), padding=1, dtype=self.dtype,
+                            name=f"up_{level}_upsample")(h)
+                x = unfold(h)
+        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-6,
+                         dtype=jnp.float32, name="conv_norm_out")(fold(x))
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(cfg.in_channels, (3, 3), padding=1, dtype=jnp.float32,
+                    name="conv_out")(h)
+        # frame-axis smoothing conv on the decoded RGB
+        return nn.Conv(cfg.in_channels, (3, 1, 1),
+                       padding=((1, 1), (0, 0), (0, 0)), dtype=jnp.float32,
+                       name="time_conv_out")(unfold(h))
+
+
+class AutoencoderKLTemporalDecoder(nn.Module):
+    """SVD's VAE: the standard spatial encoder + the temporal decoder.
+    encode_moments matches AutoencoderKL's (the img2vid pipeline encodes
+    the conditioning frame with it); decode takes (B, F, lh, lw, C)
+    scaled latents and returns (B, F, H, W, 3)."""
+
+    config: VAEConfig
+
+    def setup(self) -> None:
+        dtype = jnp.dtype(self.config.dtype)
+        self.encoder = Encoder(self.config, dtype, name="encoder")
+        self.decoder = TemporalVaeDecoder(self.config, dtype,
+                                          name="decoder")
+
+    def encode_moments(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        moments = self.encoder(x)
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def decode(self, z: jnp.ndarray) -> jnp.ndarray:
+        return self.decoder(z / self.config.scaling_factor)
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # frame-folded round trip (init/tests): x (B, F, H, W, 3)
+        b, f = x.shape[:2]
+        mean, _ = self.encode_moments(x.reshape((-1,) + x.shape[2:]))
+        z = (mean * self.config.scaling_factor).reshape(
+            (b, f) + mean.shape[1:])
+        return self.decode(z)
+
+
 class AutoencoderKL(nn.Module):
     """encode: image (B,H,W,3) in [-1,1] -> scaled latents.
     decode: scaled latents -> image in [-1,1]."""

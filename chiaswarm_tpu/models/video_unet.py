@@ -1,21 +1,43 @@
-"""Temporal (video) diffusion UNet — ModelScope-class text-to-video.
+"""Temporal (video) diffusion UNets — faithful to the two published layouts.
 
-The model family behind the reference's txt2vid workload
-(swarm/video/tx2vid.py:17-57 runs ``damo-vilab/text-to-video-ms-1.7b``
-through diffusers). Factorized space-time design, the standard for this
-class: every level runs the 2D blocks of models/unet.py with frames folded
-into the batch axis (pure reuse — same parameter naming, so the 2D
-converter rules extend), interleaved with
+The reference's txt2vid workload runs ``damo-vilab/text-to-video-ms-1.7b``
+through diffusers' ``UNet3DConditionModel`` (swarm/video/tx2vid.py:24-27);
+BASELINE config #5 names the SVD class, diffusers'
+``UNetSpatioTemporalConditionModel``. Earlier rounds served a generic
+factorized space-time UNet; VERDICT r4 #1: real snapshots' trained temporal
+weights could not be converted onto it. This module now mirrors the two
+published module graphs exactly — every torch parameter has a
+corresponding leaf here (convert/torch_to_flax.py maps them 1:1, and
+pipelines/video.py refuses to synthesize leaves for these families).
 
-- :class:`TemporalAttention`: self-attention along the frame axis at each
-  spatial site (frames become the sequence; spatial sites fold into batch),
-  with a learned frame-position embedding;
-- a temporal 1D conv in each level (local motion mixing).
+:class:`UNet3D` (ModelScope text-to-video layout):
+- ``conv_in`` -> ``transformer_in`` (a temporal transformer at full res,
+  8 heads) -> down blocks of [resnet, temp_conv, spatial attn, temp attn]
+  -> mid -> up -> ``conv_out``.
+- ``TemporalConvLayer``: four GroupNorm+SiLU+Conv(3,1,1) stages with a
+  residual add; the published init zeroes the fourth conv.
+- ``TemporalTransformer``: GroupNorm -> linear proj -> ONE basic block
+  whose attn1 AND attn2 are both frame-axis self-attention (diffusers'
+  ``double_self_attention=True``) -> linear proj + residual. No frame
+  positional embedding — the published layout has none.
 
-TPU notes: both foldings are pure reshapes in NHWC — XLA sees large, static
-(B*F, H, W, C) convs for the MXU and (B*H*W, F, C) attention batches; no
-gather/scatter, no dynamic shapes. Frame count is a compile-time static
-(bucketed by the pipeline).
+:class:`UNetSpatioTemporal` (SVD image-to-video layout):
+- every resnet slot is a :class:`SpatioTemporalResBlock` — a spatial
+  ResnetBlock, a :class:`TemporalResnetBlock` (frame-axis convs, per-frame
+  time embedding), and a learned sigmoid blend (``mix_factor``, the
+  AlphaBlender with ``switch_spatial_to_temporal_mix``);
+- every attention slot is a :class:`TransformerSpatioTemporal` — a spatial
+  transformer block, a sinusoidal frame-position embedding
+  (``time_pos_embed``), a :class:`TemporalBasicBlock` (ff_in -> frame
+  self-attn -> cross-attn to the conditioning token -> ff) and a second
+  learned blend, inside one linear proj_in/proj_out pair.
+
+TPU notes: frame folding is pure reshape in NHWC — XLA sees large static
+(B*F, H, W, C) convs for the MXU and (B*H*W, F, C) attention batches; the
+frame-axis convs are (3, 1, 1) kernels on the 5-D tensor (one conv op, no
+gather). Frame count is a compile-time static (bucketed by the pipeline).
+Serving always runs with diffusers' ``image_only_indicator`` at zero, so
+the blend weights reduce to ``sigmoid(mix_factor)`` — constants under jit.
 """
 
 from __future__ import annotations
@@ -26,89 +48,105 @@ import jax.numpy as jnp
 from chiaswarm_tpu.models.common import num_groups as _num_groups
 from chiaswarm_tpu.models.configs import UNetConfig
 from chiaswarm_tpu.models.unet import (
+    CrossAttention,
     Downsample,
+    FeedForward,
     ResnetBlock,
     SpatialTransformer,
+    TimestepEmbedding,
+    TransformerBlock,
     Upsample,
     time_conditioning,
+    timestep_embedding,
 )
-from chiaswarm_tpu.ops.attention import attention
 
 zeros_init = nn.initializers.zeros
 
 
-class TemporalAttention(nn.Module):
-    """Self-attention over the frame axis. Input (B, F, H, W, C); the
-    output projection is zero-initialized so an untrained temporal layer
-    is identity (frames stay independent), the AnimateDiff-style safe
-    default for weights converted from 2D checkpoints."""
-
-    num_heads: int
-    head_dim: int
-    max_frames: int = 64
-    dtype: jnp.dtype = jnp.float32
-
-    @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        b, f, h, w, c = x.shape
-        residual = x
-        pos = self.param("frame_pos_embed",
-                         nn.initializers.normal(0.02),
-                         (self.max_frames, c))
-        seq = x.transpose(0, 2, 3, 1, 4).reshape(b * h * w, f, c)
-        seq = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm")(seq)
-        seq = (seq + pos[None, :f, :]).astype(self.dtype)
-        inner = self.num_heads * self.head_dim
-        q = nn.Dense(inner, use_bias=False, dtype=self.dtype,
-                     name="to_q")(seq)
-        k = nn.Dense(inner, use_bias=False, dtype=self.dtype,
-                     name="to_k")(seq)
-        v = nn.Dense(inner, use_bias=False, dtype=self.dtype,
-                     name="to_v")(seq)
-        n = b * h * w
-        out = attention(
-            q.reshape(n, f, self.num_heads, self.head_dim),
-            k.reshape(n, f, self.num_heads, self.head_dim),
-            v.reshape(n, f, self.num_heads, self.head_dim),
-            impl="xla",  # tiny sequence (frames) — einsum path
-        ).reshape(n, f, inner)
-        out = nn.Dense(c, kernel_init=zeros_init, dtype=self.dtype,
-                       name="to_out")(out)
-        out = out.reshape(b, h, w, f, c).transpose(0, 3, 1, 2, 4)
-        return residual + out
+def _fold(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, F, H, W, C) -> (B*F, H, W, C) for the shared 2D spatial blocks."""
+    return x.reshape((-1,) + x.shape[2:])
 
 
-class TemporalConv(nn.Module):
-    """1D conv over frames (local motion), zero-init output -> identity."""
+def _unfold(x: jnp.ndarray, b: int, f: int) -> jnp.ndarray:
+    return x.reshape((b, f) + x.shape[1:])
+
+
+# --------------------------------------------------- ModelScope modules
+
+
+class TemporalConvLayer(nn.Module):
+    """diffusers ``TemporalConvLayer``: four (GroupNorm, SiLU, Conv3d
+    (3,1,1)) stages with a residual add; the published init zeroes conv4
+    so an untrained layer is identity. GroupNorm statistics run over
+    (F, H, W) per channel group — the torch layout applies it to the
+    (B, C, F, H, W) tensor — which the 5-D NHWC GroupNorm matches."""
 
     channels: int
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        b, f, h, w, c = x.shape
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (B, F, H, W, C)
+        identity = x
+        h = x
+        for k in (1, 2, 3, 4):
+            h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]),
+                             epsilon=1e-5, dtype=jnp.float32,
+                             name=f"norm{k}")(h)
+            h = nn.silu(h).astype(self.dtype)
+            h = nn.Conv(self.channels, (3, 1, 1),
+                        padding=((1, 1), (0, 0), (0, 0)),
+                        kernel_init=zeros_init if k == 4
+                        else nn.initializers.lecun_normal(),
+                        dtype=self.dtype, name=f"conv{k}")(h)
+        return identity + h
+
+
+class TemporalTransformer(nn.Module):
+    """diffusers ``TransformerTemporalModel`` with its default
+    ``double_self_attention=True``: frames are the sequence axis, spatial
+    sites fold into batch; attn1 and attn2 are BOTH self-attention (the
+    constructor's cross_attention_dim is discarded in this mode). No
+    positional embedding — the published layout relies on the temporal
+    convs for order information."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:  # (B, F, H, W, C)
+        b, f, hh, ww, c = x.shape
         residual = x
-        seq = x.transpose(0, 2, 3, 1, 4).reshape(b * h * w, f, c)
-        seq = nn.GroupNorm(num_groups=_num_groups(c), epsilon=1e-5,
-                           dtype=jnp.float32, name="norm")(seq)
-        seq = nn.silu(seq).astype(self.dtype)
-        seq = nn.Conv(self.channels, (3,), padding="SAME", dtype=self.dtype,
-                      name="conv1")(seq)
-        seq = nn.silu(seq)
-        seq = nn.Conv(c, (3,), padding="SAME", kernel_init=zeros_init,
-                      dtype=self.dtype, name="conv2")(seq)
-        return residual + seq.reshape(b, h, w, f, c).transpose(0, 3, 1, 2, 4)
+        h = nn.GroupNorm(num_groups=_num_groups(c), epsilon=1e-6,
+                         dtype=jnp.float32, name="norm")(x)
+        h = h.transpose(0, 2, 3, 1, 4).reshape(b * hh * ww, f, c)
+        h = h.astype(self.dtype)
+        inner = self.num_heads * self.head_dim
+        h = nn.Dense(inner, dtype=self.dtype, name="proj_in")(h)
+        # ONE basic block (num_layers=1 in both the transformer_in and the
+        # per-level temp_attentions of the published config); attn2 runs
+        # self-attention because context=None falls back to h
+        h = TransformerBlock(self.num_heads, self.head_dim, self.dtype,
+                             "xla", has_cross_attn=True,
+                             name="transformer_blocks_0")(h, None)
+        h = nn.Dense(c, dtype=self.dtype, name="proj_out")(h)
+        h = h.reshape(b, hh, ww, f, c).transpose(0, 3, 1, 2, 4)
+        return residual + h
 
 
-class VideoUNet(nn.Module):
-    """(B, F, H, W, C) latents -> model prediction, text-conditioned.
+class UNet3D(nn.Module):
+    """ModelScope-class text-to-video UNet (diffusers
+    ``UNet3DConditionModel``): (B, F, H, W, C) latents -> model prediction.
 
-    Spatial blocks share models/unet.py modules (frames folded into
-    batch); temporal attention + conv interleave at every level.
-    """
+    Block order per down layer: resnet -> temp_conv -> spatial attention
+    -> temporal attention (CrossAttnDownBlock3D); the attention-free last
+    level runs resnet -> temp_conv only (DownBlock3D). ``transformer_in``
+    (8 heads at the stem width) runs right after conv_in. The spatial
+    modules are models/unet.py's own (same parameter names, so the 2D
+    converter rules apply to them verbatim)."""
 
     config: UNetConfig
-    max_frames: int = 64
 
     @property
     def dtype(self) -> jnp.dtype:
@@ -120,7 +158,7 @@ class VideoUNet(nn.Module):
         sample: jnp.ndarray,                 # (B, F, H, W, C)
         timesteps: jnp.ndarray,              # (B,)
         encoder_hidden_states: jnp.ndarray,  # (B, S, cross_dim)
-        added_cond: dict[str, jnp.ndarray] | None = None,  # SVD micro-cond
+        added_cond: dict[str, jnp.ndarray] | None = None,
     ) -> jnp.ndarray:
         cfg = self.config
         dtype = self.dtype
@@ -128,19 +166,17 @@ class VideoUNet(nn.Module):
         b, f, hh, ww, _ = sample.shape
 
         temb = time_conditioning(cfg, dtype, timesteps, added_cond)
-        temb_f = jnp.repeat(temb, f, axis=0)          # (B*F, D) for 2D blocks
-        ctx = encoder_hidden_states.astype(dtype)
-        ctx_f = jnp.repeat(ctx, f, axis=0)            # frames share the text
-
-        def fold(x):   # (B, F, H, W, C) -> (B*F, H, W, C)
-            return x.reshape((-1,) + x.shape[2:])
-
-        def unfold(x):
-            return x.reshape((b, f) + x.shape[1:])
+        temb_f = jnp.repeat(temb, f, axis=0)          # (B*F, D)
+        ctx_f = jnp.repeat(encoder_hidden_states.astype(dtype), f, axis=0)
 
         x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
-                    name="conv_in")(fold(sample.astype(dtype)))
-        x = unfold(x)
+                    name="conv_in")(_fold(sample.astype(dtype)))
+        x = _unfold(x, b, f)
+        # full-resolution temporal transformer at the stem width: the
+        # published layout fixes 8 heads here (not channels/head_dim)
+        head_dim0 = cfg.heads_for(channels[0], 0)[1]
+        x = TemporalTransformer(8, head_dim0, dtype,
+                                name="transformer_in")(x)
         skips = [x]
 
         # ---- down path
@@ -148,39 +184,44 @@ class VideoUNet(nn.Module):
             depth = cfg.transformer_depth[level]
             heads, head_dim = cfg.heads_for(ch, level)
             for j in range(cfg.layers_per_block):
-                x = unfold(ResnetBlock(ch, dtype,
-                                       name=f"down_{level}_resnets_{j}")(
-                    fold(x), temb_f))
-                x = TemporalConv(ch, dtype,
-                                 name=f"down_{level}_tconv_{j}")(x)
+                x = _unfold(ResnetBlock(ch, dtype,
+                                        name=f"down_{level}_resnets_{j}")(
+                    _fold(x), temb_f), b, f)
+                x = TemporalConvLayer(ch, dtype,
+                                      name=f"down_{level}_tconvs_{j}")(x)
                 if depth > 0:
-                    x = unfold(SpatialTransformer(
+                    x = _unfold(SpatialTransformer(
                         depth, heads, head_dim, cfg.use_linear_projection,
                         dtype, cfg.attn_impl,
-                        name=f"down_{level}_attentions_{j}")(fold(x), ctx_f))
-                    x = TemporalAttention(
-                        heads, head_dim, self.max_frames, dtype,
-                        name=f"down_{level}_tattn_{j}")(x)
+                        name=f"down_{level}_attentions_{j}")(
+                        _fold(x), ctx_f), b, f)
+                    x = TemporalTransformer(
+                        heads, head_dim, dtype,
+                        name=f"down_{level}_tattns_{j}")(x)
                 skips.append(x)
             if level < len(channels) - 1:
-                x = unfold(Downsample(ch, dtype,
-                                      name=f"down_{level}_downsample")(
-                    fold(x)))
+                x = _unfold(Downsample(ch, dtype,
+                                       name=f"down_{level}_downsample")(
+                    _fold(x)), b, f)
                 skips.append(x)
 
-        # ---- mid
+        # ---- mid (UNetMidBlock3DCrossAttn, num_layers=1):
+        # resnet, temp_conv, attn, temp_attn, resnet, temp_conv
         mid_ch = channels[-1]
         mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(channels) - 1)
         mid_depth = max(d for d in cfg.transformer_depth) or 1
-        x = unfold(ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(
-            fold(x), temb_f))
-        x = unfold(SpatialTransformer(
+        x = _unfold(ResnetBlock(mid_ch, dtype, name="mid_resnets_0")(
+            _fold(x), temb_f), b, f)
+        x = TemporalConvLayer(mid_ch, dtype, name="mid_tconvs_0")(x)
+        x = _unfold(SpatialTransformer(
             mid_depth, mid_heads, mid_head_dim, cfg.use_linear_projection,
-            dtype, cfg.attn_impl, name="mid_attention")(fold(x), ctx_f))
-        x = TemporalAttention(mid_heads, mid_head_dim, self.max_frames,
-                              dtype, name="mid_tattn")(x)
-        x = unfold(ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(
-            fold(x), temb_f))
+            dtype, cfg.attn_impl, name="mid_attention")(
+            _fold(x), ctx_f), b, f)
+        x = TemporalTransformer(mid_heads, mid_head_dim, dtype,
+                                name="mid_tattn")(x)
+        x = _unfold(ResnetBlock(mid_ch, dtype, name="mid_resnets_1")(
+            _fold(x), temb_f), b, f)
+        x = TemporalConvLayer(mid_ch, dtype, name="mid_tconvs_1")(x)
 
         # ---- up path
         for rev, ch in enumerate(reversed(channels)):
@@ -190,26 +231,284 @@ class VideoUNet(nn.Module):
             for j in range(cfg.layers_per_block + 1):
                 skip = skips.pop()
                 x = jnp.concatenate([x, skip], axis=-1)
-                x = unfold(ResnetBlock(ch, dtype,
-                                       name=f"up_{level}_resnets_{j}")(
-                    fold(x), temb_f))
-                x = TemporalConv(ch, dtype, name=f"up_{level}_tconv_{j}")(x)
+                x = _unfold(ResnetBlock(ch, dtype,
+                                        name=f"up_{level}_resnets_{j}")(
+                    _fold(x), temb_f), b, f)
+                x = TemporalConvLayer(ch, dtype,
+                                      name=f"up_{level}_tconvs_{j}")(x)
                 if depth > 0:
-                    x = unfold(SpatialTransformer(
+                    x = _unfold(SpatialTransformer(
                         depth, heads, head_dim, cfg.use_linear_projection,
                         dtype, cfg.attn_impl,
-                        name=f"up_{level}_attentions_{j}")(fold(x), ctx_f))
-                    x = TemporalAttention(
-                        heads, head_dim, self.max_frames, dtype,
-                        name=f"up_{level}_tattn_{j}")(x)
+                        name=f"up_{level}_attentions_{j}")(
+                        _fold(x), ctx_f), b, f)
+                    x = TemporalTransformer(
+                        heads, head_dim, dtype,
+                        name=f"up_{level}_tattns_{j}")(x)
             if level > 0:
-                x = unfold(Upsample(ch, dtype,
-                                    name=f"up_{level}_upsample")(fold(x)))
+                x = _unfold(Upsample(ch, dtype,
+                                     name=f"up_{level}_upsample")(
+                    _fold(x)), b, f)
 
-        x = fold(x)
+        x = _fold(x)
         x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-5,
                          dtype=jnp.float32, name="conv_norm_out")(x)
         x = nn.silu(x).astype(dtype)
         x = nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
                     name="conv_out")(x)
-        return unfold(x)
+        return _unfold(x, b, f)
+
+
+# ---------------------------------------------------------- SVD modules
+
+
+class TemporalResnetBlock(nn.Module):
+    """diffusers ``TemporalResnetBlock``: the frame-axis twin of a spatial
+    resnet — (3,1,1) convs, a per-frame time-embedding projection
+    (``temb_bf=None`` skips it — the temporal VAE decoder's temb-free
+    variant). The SVD layouts always keep in_channels == out_channels
+    here (no shortcut)."""
+
+    out_channels: int
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray,
+                 temb_bf: jnp.ndarray | None = None) -> jnp.ndarray:
+        # x (B, F, H, W, C); temb_bf (B, F, D)
+        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]),
+                         epsilon=self.eps, dtype=jnp.float32,
+                         name="norm1")(x)
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(self.out_channels, (3, 1, 1),
+                    padding=((1, 1), (0, 0), (0, 0)), dtype=self.dtype,
+                    name="conv1")(h)
+        if temb_bf is not None:
+            t = nn.Dense(self.out_channels, dtype=self.dtype,
+                         name="time_emb_proj")(nn.silu(temb_bf))
+            h = h + t[:, :, None, None, :].astype(h.dtype)
+        h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]),
+                         epsilon=self.eps, dtype=jnp.float32,
+                         name="norm2")(h)
+        h = nn.silu(h).astype(self.dtype)
+        h = nn.Conv(self.out_channels, (3, 1, 1),
+                    padding=((1, 1), (0, 0), (0, 0)), dtype=self.dtype,
+                    name="conv2")(h)
+        return x + h
+
+
+class SpatioTemporalResBlock(nn.Module):
+    """diffusers ``SpatioTemporalResBlock``: spatial resnet -> temporal
+    resnet -> learned blend. Serving runs diffusers'
+    ``image_only_indicator`` at zero, so the AlphaBlender reduces to
+    out = a*spatial + (1-a)*temporal with a = sigmoid(mix_factor) — the
+    non-switched direction the SVD UNet blocks use
+    (``switch_spatial_to_temporal_mix`` is enabled only in the temporal
+    VAE decoder, where ``switch_mix`` flips the blend)."""
+
+    out_channels: int
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    switch_mix: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, temb_f: jnp.ndarray,
+                 temb_bf: jnp.ndarray) -> jnp.ndarray:
+        b, f = x.shape[:2]
+        s = ResnetBlock(self.out_channels, self.dtype, eps=self.eps,
+                        name="spatial")(_fold(x), temb_f)
+        s = _unfold(s, b, f)
+        t = TemporalResnetBlock(self.out_channels, self.eps, self.dtype,
+                                name="temporal")(s, temb_bf)
+        a = nn.sigmoid(self.param("mix_factor",
+                                  nn.initializers.constant(0.5), (1,)))
+        a = a.astype(s.dtype)
+        if self.switch_mix:
+            a = 1.0 - a
+        return a * s + (1.0 - a) * t
+
+
+class TemporalBasicBlock(nn.Module):
+    """diffusers ``TemporalBasicTransformerBlock``: norm_in+ff_in (with
+    residual), frame-axis self-attention, cross-attention to the
+    first-frame conditioning token, feed-forward. Operates on the
+    (B*S, F, C) frame-major layout."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, time_ctx: jnp.ndarray,
+                 b: int, f: int) -> jnp.ndarray:
+        # x (B*F, S, C); time_ctx (B, S_ctx, ctx_dim)
+        bf, s, c = x.shape
+        h = x.reshape(b, f, s, c).transpose(0, 2, 1, 3).reshape(b * s, f, c)
+        residual = h
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="norm_in")(h).astype(self.dtype)
+        h = FeedForward(c, self.dtype, name="ff_in")(h) + residual
+        a = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="norm1")(h).astype(self.dtype)
+        h = CrossAttention(self.num_heads, self.head_dim, self.dtype,
+                           "xla", name="attn1")(a, None) + h
+        # every spatial site cross-attends to the (first-frame) context
+        ctx = jnp.broadcast_to(time_ctx[:, None],
+                               (b, s) + time_ctx.shape[1:])
+        ctx = ctx.reshape((b * s,) + time_ctx.shape[1:]).astype(self.dtype)
+        a = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="norm2")(h).astype(self.dtype)
+        h = CrossAttention(self.num_heads, self.head_dim, self.dtype,
+                           "xla", name="attn2")(a, ctx) + h
+        a = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                         name="norm3")(h).astype(self.dtype)
+        h = FeedForward(c, self.dtype, name="ff")(a) + h
+        return h.reshape(b, s, f, c).transpose(0, 2, 1, 3).reshape(bf, s, c)
+
+
+class TransformerSpatioTemporal(nn.Module):
+    """diffusers ``TransformerSpatioTemporalModel``: per depth step, a
+    spatial transformer block and a temporal one run on the same tokens
+    (the temporal one seeded with a sinusoidal frame-position embedding
+    through ``time_pos_embed``), blended by a learned sigmoid factor —
+    all inside one GroupNorm + linear proj_in/proj_out pair. GroupNorm
+    statistics are per frame (the torch layout normalizes the folded
+    (B*F, C, H, W) tensor)."""
+
+    depth: int
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, ctx: jnp.ndarray) -> jnp.ndarray:
+        # x (B, F, H, W, C); ctx (B, S, cross_dim)
+        b, f, hh, ww, c = x.shape
+        residual = x
+        h = nn.GroupNorm(num_groups=_num_groups(c), epsilon=1e-6,
+                         dtype=jnp.float32, name="norm")(_fold(x))
+        seq = h.reshape(b * f, hh * ww, c).astype(self.dtype)
+        inner = self.num_heads * self.head_dim
+        seq = nn.Dense(inner, dtype=self.dtype, name="proj_in")(seq)
+
+        ctx_f = jnp.repeat(ctx.astype(self.dtype), f, axis=0)
+        # sinusoidal frame ids -> MLP (in C, hidden 4C, out C)
+        femb = timestep_embedding(jnp.arange(f, dtype=jnp.float32), c)
+        femb = TimestepEmbedding(c, self.dtype, hidden_dim=c * 4,
+                                 name="time_pos_embed")(
+            femb.astype(self.dtype))
+        femb = jnp.tile(femb, (b, 1))[:, None, :]     # (B*F, 1, C)
+
+        mix = nn.sigmoid(self.param("mix_factor",
+                                    nn.initializers.constant(0.5), (1,)))
+        mix = mix.astype(self.dtype)
+        for i in range(self.depth):
+            s = TransformerBlock(self.num_heads, self.head_dim, self.dtype,
+                                 self.attn_impl, has_cross_attn=True,
+                                 name=f"transformer_blocks_{i}")(seq, ctx_f)
+            t = TemporalBasicBlock(self.num_heads, self.head_dim,
+                                   self.dtype,
+                                   name=f"temporal_blocks_{i}")(
+                s + femb, ctx, b, f)
+            seq = mix * s + (1.0 - mix) * t
+        seq = nn.Dense(c, dtype=self.dtype, name="proj_out")(seq)
+        return residual + seq.reshape(b, f, hh, ww, c)
+
+
+class UNetSpatioTemporal(nn.Module):
+    """SVD-class image-to-video UNet (diffusers
+    ``UNetSpatioTemporalConditionModel``): (B, F, H, W, 8) noise++cond
+    latents -> prediction, conditioned on a single CLIP-image token and
+    the (fps, motion bucket, noise-aug) micro-conditioning ids through
+    ``add_embedding``. Published quirk kept for checkpoint fidelity: the
+    resnets of attention-bearing levels use GroupNorm eps 1e-6, the
+    attention-free level and the mid block 1e-5."""
+
+    config: UNetConfig
+
+    @property
+    def dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.config.dtype)
+
+    @nn.compact
+    def __call__(
+        self,
+        sample: jnp.ndarray,                 # (B, F, H, W, C)
+        timesteps: jnp.ndarray,              # (B,)
+        encoder_hidden_states: jnp.ndarray,  # (B, S, cross_dim)
+        added_cond: dict[str, jnp.ndarray] | None = None,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        dtype = self.dtype
+        channels = list(cfg.block_out_channels)
+        b, f, hh, ww, _ = sample.shape
+
+        temb = time_conditioning(cfg, dtype, timesteps, added_cond)
+        temb_f = jnp.repeat(temb, f, axis=0)             # (B*F, D)
+        temb_bf = jnp.repeat(temb[:, None], f, axis=1)   # (B, F, D)
+        ctx = encoder_hidden_states.astype(dtype)
+
+        x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
+                    name="conv_in")(_fold(sample.astype(dtype)))
+        x = _unfold(x, b, f)
+        skips = [x]
+
+        for level, ch in enumerate(channels):
+            depth = cfg.transformer_depth[level]
+            heads, head_dim = cfg.heads_for(ch, level)
+            eps = 1e-6 if depth > 0 else 1e-5
+            for j in range(cfg.layers_per_block):
+                x = SpatioTemporalResBlock(
+                    ch, eps, dtype,
+                    name=f"down_{level}_resnets_{j}")(x, temb_f, temb_bf)
+                if depth > 0:
+                    x = TransformerSpatioTemporal(
+                        depth, heads, head_dim, dtype, cfg.attn_impl,
+                        name=f"down_{level}_attentions_{j}")(x, ctx)
+                skips.append(x)
+            if level < len(channels) - 1:
+                x = _unfold(Downsample(ch, dtype,
+                                       name=f"down_{level}_downsample")(
+                    _fold(x)), b, f)
+                skips.append(x)
+
+        mid_ch = channels[-1]
+        mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(channels) - 1)
+        mid_depth = max(d for d in cfg.transformer_depth) or 1
+        x = SpatioTemporalResBlock(mid_ch, 1e-5, dtype,
+                                   name="mid_resnets_0")(x, temb_f, temb_bf)
+        x = TransformerSpatioTemporal(
+            mid_depth, mid_heads, mid_head_dim, dtype, cfg.attn_impl,
+            name="mid_attention")(x, ctx)
+        x = SpatioTemporalResBlock(mid_ch, 1e-5, dtype,
+                                   name="mid_resnets_1")(x, temb_f, temb_bf)
+
+        for rev, ch in enumerate(reversed(channels)):
+            level = len(channels) - 1 - rev
+            depth = cfg.transformer_depth[level]
+            heads, head_dim = cfg.heads_for(ch, level)
+            eps = 1e-6 if depth > 0 else 1e-5
+            for j in range(cfg.layers_per_block + 1):
+                skip = skips.pop()
+                x = jnp.concatenate([x, skip], axis=-1)
+                x = SpatioTemporalResBlock(
+                    ch, eps, dtype,
+                    name=f"up_{level}_resnets_{j}")(x, temb_f, temb_bf)
+                if depth > 0:
+                    x = TransformerSpatioTemporal(
+                        depth, heads, head_dim, dtype, cfg.attn_impl,
+                        name=f"up_{level}_attentions_{j}")(x, ctx)
+            if level > 0:
+                x = _unfold(Upsample(ch, dtype,
+                                     name=f"up_{level}_upsample")(
+                    _fold(x)), b, f)
+
+        x = _fold(x)
+        x = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]), epsilon=1e-5,
+                         dtype=jnp.float32, name="conv_norm_out")(x)
+        x = nn.silu(x).astype(dtype)
+        x = nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
+                    name="conv_out")(x)
+        return _unfold(x, b, f)

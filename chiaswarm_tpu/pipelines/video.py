@@ -38,8 +38,11 @@ from chiaswarm_tpu.models.configs import (
     VAEConfig,
 )
 from chiaswarm_tpu.models.tokenizer import HashTokenizer
-from chiaswarm_tpu.models.vae import AutoencoderKL
-from chiaswarm_tpu.models.video_unet import VideoUNet
+from chiaswarm_tpu.models.vae import (
+    AutoencoderKL,
+    AutoencoderKLTemporalDecoder,
+)
+from chiaswarm_tpu.models.video_unet import UNet3D, UNetSpatioTemporal
 from chiaswarm_tpu.schedulers import (
     make_noise_schedule,
     make_sampling_schedule,
@@ -75,7 +78,10 @@ class VideoFamily:
     default_frames: int = 25
 
 
-# text-to-video-ms-1.7b shaped (CLIP-H text tower, 4-level UNet)
+# text-to-video-ms-1.7b shaped (CLIP-H text tower, 4-level UNet3D).
+# use_linear_projection stays False: diffusers' UNet3DConditionModel builds
+# its Transformer2DModels with the conv-projection default, so the
+# published snapshot stores (O, I, 1, 1) proj weights.
 MODELSCOPE = VideoFamily(
     name="modelscope_t2v",
     text_encoder=TextEncoderConfig(
@@ -86,7 +92,6 @@ MODELSCOPE = VideoFamily(
         transformer_depth=(1, 1, 1, 0),
         attention_head_dim=64, head_dim_is_count=False,
         cross_attention_dim=1024,
-        use_linear_projection=True,
     ),
     vae=VAEConfig(),
     default_size=256,
@@ -126,7 +131,6 @@ SVD = VideoFamily(
         transformer_depth=(1, 1, 1, 0),
         attention_head_dim=64, head_dim_is_count=False,
         cross_attention_dim=1024,
-        use_linear_projection=True,
         addition_embed_dim=256,       # 3 ids x 256 -> add_embedding MLP
     ),
     vae=VAEConfig(),
@@ -151,7 +155,9 @@ TINY_SVD = VideoFamily(
         transformer_depth=(1, 1), attention_head_dim=4,
         head_dim_is_count=True, cross_attention_dim=16,
         addition_embed_dim=8, dtype="float32"),
-    vae=VAEConfig(block_out_channels=(16, 32), layers_per_block=1,
+    # layers_per_block=2: the temporal-decoder VAE hardcodes the
+    # published 2-resnet mid shape
+    vae=VAEConfig(block_out_channels=(16, 32), layers_per_block=2,
                   dtype="float32"),
     default_size=64,
     max_frames=16,
@@ -198,14 +204,38 @@ def _unet_init_args(family: VideoFamily):
     return sample, t, ctx, added
 
 
+def make_video_unet(family: VideoFamily, attn_impl: str = "auto"):
+    """The faithful architecture for a family: SVD-class families run the
+    spatio-temporal layout, text families the ModelScope UNet3D."""
+    cfg = family.unet
+    if attn_impl not in ("auto", cfg.attn_impl):
+        cfg = dataclasses.replace(cfg, attn_impl=attn_impl)
+    cls = UNetSpatioTemporal if family.image_conditioned else UNet3D
+    return cls(cfg)
+
+
+def make_video_vae(family: VideoFamily):
+    """SVD-class families ship the temporal-decoder VAE
+    (AutoencoderKLTemporalDecoder); text families the standard one."""
+    cls = (AutoencoderKLTemporalDecoder if family.image_conditioned
+           else AutoencoderKL)
+    return cls(family.vae)
+
+
+def _vae_init_args(family: VideoFamily):
+    if family.image_conditioned:   # frame-folded round trip signature
+        return (jnp.zeros((1, 2, 16, 16, family.vae.in_channels)),)
+    return (jnp.zeros((1, 16, 16, family.vae.in_channels)),)
+
+
 @dataclasses.dataclass
 class VideoComponents:
     family: VideoFamily
     model_name: str
     tokenizer: Any
     text_encoder: ClipTextEncoder | None
-    unet: VideoUNet
-    vae: AutoencoderKL
+    unet: UNet3D | UNetSpatioTemporal
+    vae: AutoencoderKL | AutoencoderKLTemporalDecoder
     params: dict[str, Any]  # keys: text_encoder|image_encoder, unet, vae
     image_encoder: ClipVisionEncoder | None = None
 
@@ -215,13 +245,12 @@ class VideoComponents:
         if isinstance(family, str):
             family = VIDEO_FAMILIES[family]
         key = jax.random.PRNGKey(seed)
-        unet = VideoUNet(family.unet, max_frames=family.max_frames)
-        vae = AutoencoderKL(family.vae)
+        unet = make_video_unet(family)
+        vae = make_video_vae(family)
         key, k1, k2, k3 = jax.random.split(key, 4)
         params = {
             "unet": jax.jit(unet.init)(k2, *_unet_init_args(family)),
-            "vae": jax.jit(vae.init)(
-                k3, jnp.zeros((1, 16, 16, family.vae.in_channels))),
+            "vae": jax.jit(vae.init)(k3, *_vae_init_args(family)),
         }
         te = tokenizer = image_encoder = None
         if family.image_conditioned:
@@ -256,8 +285,8 @@ class VideoComponents:
 
         if isinstance(family, str):
             family = VIDEO_FAMILIES[family]
-        unet = VideoUNet(family.unet, max_frames=family.max_frames)
-        vae = AutoencoderKL(family.vae)
+        unet = make_video_unet(family)
+        vae = make_video_vae(family)
         rng = np.random.default_rng(seed)
         key = jax.random.PRNGKey(0)
         params = {
@@ -265,9 +294,7 @@ class VideoComponents:
                 jax.eval_shape(unet.init, key, *_unet_init_args(family)),
                 rng, dtype),
             "vae": materialize_host(
-                jax.eval_shape(
-                    vae.init, key,
-                    jnp.zeros((1, 16, 16, family.vae.in_channels))),
+                jax.eval_shape(vae.init, key, *_vae_init_args(family)),
                 rng, dtype),
         }
         te = tokenizer = image_encoder = None
@@ -296,20 +323,32 @@ class VideoComponents:
     def from_checkpoint(cls, checkpoint_dir, model_name: str,
                         family: VideoFamily | str | None = None,
                         ) -> "VideoComponents":
-        """2D-inflation load: spatial weights from a standard SD-style
-        snapshot (``unet/``, ``vae/``, ``text_encoder/``), temporal layers
-        fresh at their identity init (zero output projections — see
-        models/video_unet.py). The spatial blocks reuse the 2D UNet's
-        parameter naming, so convert_unet's rules apply verbatim; the
-        temporal modules are the only non-converted leaves. An inflated
-        model animates exactly like its 2D parent at frame 1 (tested) and
-        gains motion only from trained temporal weights (a later merge —
-        AnimateDiff-style motion modules — drops into the same slots)."""
+        """Load a video snapshot with FULL temporal fidelity.
+
+        - SVD-class (image-conditioned) families require a real
+          spatio-temporal snapshot (``unet/`` with spatial_res_block/
+          temporal_res_block nesting, ``image_encoder/``, ``vae/``);
+          every leaf must convert — nothing is synthesized.
+        - Text families: a native ModelScope ``UNet3DConditionModel``
+          snapshot (temp_convs/transformer_in keys present) converts
+          completely — trained motion weights land in the temporal slots
+          (the reference's served model, swarm/video/tx2vid.py:24-27).
+          A plain 2D SD snapshot (no temporal keys) falls back to
+          AnimateDiff-style 2D inflation: spatial weights convert, the
+          temporal modules init at identity (zero output projections) —
+          the model animates exactly like its 2D parent at frame 1.
+
+        Either way a leaf that EXISTS in the snapshot is never silently
+        replaced: conversion is strict (missing/unconvertible keys raise).
+        """
         from pathlib import Path
 
         from chiaswarm_tpu.convert.torch_to_flax import (
+            convert_temporal_vae,
             convert_text_encoder,
             convert_unet,
+            convert_unet3d,
+            convert_unet_spatio_temporal,
             convert_vae,
             read_torch_weights,
         )
@@ -320,51 +359,47 @@ class VideoComponents:
         family = family or MODELSCOPE
         root = Path(checkpoint_dir)
 
-        unet = VideoUNet(family.unet, max_frames=family.max_frames)
-        vae = AutoencoderKL(family.vae)
-
-        spatial = convert_unet(read_torch_weights(root / "unet"),
-                               family.unet)
-        # temporal leaves: shape via abstract tracing (no init program),
-        # values by rule — identity output projections, unit norms
+        unet = make_video_unet(family)
+        vae = make_video_vae(family)
+        state = read_torch_weights(root / "unet")
         shapes = jax.eval_shape(unet.init, jax.random.PRNGKey(0),
                                 *_unet_init_args(family))
-        rng = np.random.default_rng(0)
 
-        def fill(path: str, s) -> jnp.ndarray:
-            # only the temporal modules may be synthesized; a spatial leaf
-            # reaching here means the converter missed a checkpoint key —
-            # fail loudly instead of silently shipping random weights
-            if not any(tag in path for tag in ("tconv", "tattn")):
+        if family.image_conditioned:
+            if not any(".spatial_res_block." in k for k in state):
                 raise ValueError(
-                    f"2D inflation: spatial UNet leaf {path!r} missing "
-                    f"from the converted checkpoint (converter/key "
-                    f"mismatch for this architecture variant)")
-            leaf = path.rsplit("/", 1)[-1]
-            if leaf == "scale":
-                return jnp.ones(s.shape, s.dtype)
-            if leaf == "bias" or "to_out" in path or path.endswith(
-                    "conv2/kernel"):
-                return jnp.zeros(s.shape, s.dtype)
-            return jnp.asarray(
-                rng.standard_normal(s.shape).astype(np.float32) * 0.02,
-                s.dtype)
+                    f"{model_name}: not an SVD-class spatio-temporal UNet "
+                    f"snapshot (no spatial_res_block keys). Image-"
+                    f"conditioned families cannot be 2D-inflated — the "
+                    f"published UNetSpatioTemporalConditionModel layout "
+                    f"is required.")
+            unet_p = _strict_match(
+                shapes, convert_unet_spatio_temporal(state, family.unet),
+                model_name)
+        elif any(".temp_convs." in k or k.startswith("transformer_in.")
+                 for k in state):
+            # native ModelScope snapshot: full conversion, zero synthesis
+            unet_p = _strict_match(
+                shapes, convert_unet3d(state, family.unet), model_name)
+        else:
+            unet_p = _inflate_2d(shapes, convert_unet(state, family.unet))
 
-        unet_p = _graft(shapes, spatial, fill)
-        params = {
-            "unet": unet_p,
-            "vae": convert_vae(read_torch_weights(root / "vae"),
-                               family.vae),
-        }
+        vae_state = read_torch_weights(root / "vae")
+        if family.image_conditioned:
+            # the published SVD VAE (AutoencoderKLTemporalDecoder):
+            # trained temporal-decoder weights convert strictly too
+            vae_p = _strict_match(
+                jax.eval_shape(vae.init, jax.random.PRNGKey(0),
+                               *_vae_init_args(family)),
+                convert_temporal_vae(vae_state, family.vae),
+                f"{model_name} (vae)")
+        else:
+            vae_p = convert_vae(vae_state, family.vae)
+        params = {"unet": unet_p, "vae": vae_p}
         te = tokenizer = image_encoder = None
         if family.image_conditioned:
-            # SVD-class snapshot: ``image_encoder/`` is a standard
-            # CLIPVisionModelWithProjection (oracle-tested converter).
-            # The published SVD UNet's spatio-temporal torch naming maps
-            # through the same spatial rules where blocks coincide;
-            # temporal slots not present in the snapshot fill at identity
-            # (zero output projections) — stated limitation until a real
-            # checkpoint is reachable to pin the full name map against.
+            # ``image_encoder/`` is a standard
+            # CLIPVisionModelWithProjection (oracle-tested converter)
             from chiaswarm_tpu.convert.torch_to_flax import (
                 convert_clip_vision,
             )
@@ -389,9 +424,63 @@ class VideoComponents:
         return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
 
 
-def _graft(shape_tree, converted, fill, prefix: str = ""):
-    """Walk the eval_shape tree; take converted leaves where present
-    (spatial), synthesize the rest by ``fill(path, shape)`` (temporal)."""
+def _flat_leaves(tree) -> dict:
+    from flax.traverse_util import flatten_dict
+
+    return {"/".join(k): v for k, v in flatten_dict(tree).items()}
+
+
+def _strict_match(shape_tree, converted, model_name: str):
+    """Every module leaf must come from the snapshot — a video family's
+    trained temporal weights are never silently replaced (VERDICT r4 #1).
+    Missing, extra, or shape-mismatched leaves raise with the offending
+    paths."""
+    want = _flat_leaves(shape_tree)
+    got = _flat_leaves(converted)
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    if missing or extra:
+        raise ValueError(
+            f"{model_name}: video UNet snapshot does not convert "
+            f"completely — {len(missing)} module leaves missing from the "
+            f"checkpoint (e.g. {missing[:3]}), {len(extra)} checkpoint "
+            f"keys with no module slot (e.g. {extra[:3]})")
+        # no fallback: serving a video family with synthesized temporal
+        # weights would silently produce motion-free clips
+    bad = [p for p in want if tuple(want[p].shape) != tuple(got[p].shape)]
+    if bad:
+        raise ValueError(
+            f"{model_name}: converted leaf shapes disagree with the "
+            f"family config at {bad[:3]} "
+            f"(checkpoint {[tuple(got[p].shape) for p in bad[:3]]} vs "
+            f"config {[tuple(want[p].shape) for p in bad[:3]]})")
+    return converted
+
+
+def _inflate_2d(shape_tree, spatial):
+    """AnimateDiff-style 2D inflation for ModelScope-class families fed a
+    plain SD snapshot: spatial leaves convert, temporal modules
+    (transformer_in / tconvs / tattns) init at identity — zero output
+    projections (conv4, proj_out), unit norms — so the clip equals the 2D
+    parent framewise until trained temporal weights replace them."""
+    rng = np.random.default_rng(0)
+
+    def fill(path: str, s) -> jnp.ndarray:
+        if not any(tag in path for tag in
+                   ("tconv", "tattn", "transformer_in")):
+            raise ValueError(
+                f"2D inflation: spatial UNet leaf {path!r} missing from "
+                f"the converted checkpoint (converter/key mismatch for "
+                f"this architecture variant)")
+        leaf = path.rsplit("/", 1)[-1]
+        if leaf == "scale":
+            return jnp.ones(s.shape, s.dtype)
+        if leaf == "bias" or "to_out" in path or "conv4" in path or \
+                "proj_out" in path:
+            return jnp.zeros(s.shape, s.dtype)
+        return jnp.asarray(
+            rng.standard_normal(s.shape).astype(np.float32) * 0.02,
+            s.dtype)
 
     def walk(shapes, conv, prefix):
         out = {}
@@ -407,7 +496,7 @@ def _graft(shape_tree, converted, fill, prefix: str = ""):
                 out[key] = fill(path, val)
         return out
 
-    return walk(shape_tree, converted, prefix)
+    return walk(shape_tree, spatial, "")
 
 
 def _unbucket_frames(img_u8: np.ndarray, req_height: int, req_width: int,
@@ -439,9 +528,7 @@ class VideoPipeline:
         self.c = components
         fam = components.family
         if attn_impl not in ("auto", fam.unet.attn_impl):
-            components.unet = VideoUNet(
-                dataclasses.replace(fam.unet, attn_impl=attn_impl),
-                max_frames=fam.max_frames)
+            components.unet = make_video_unet(fam, attn_impl)
         self.schedule_config = ScheduleConfig(beta_schedule="scaled_linear",
                                               prediction_type="epsilon")
         self.noise_schedule = make_noise_schedule(self.schedule_config)
@@ -573,9 +660,7 @@ class Img2VidPipeline:
         self.c = components
         fam = components.family
         if attn_impl not in ("auto", fam.unet.attn_impl):
-            components.unet = VideoUNet(
-                dataclasses.replace(fam.unet, attn_impl=attn_impl),
-                max_frames=fam.max_frames)
+            components.unet = make_video_unet(fam, attn_impl)
 
     def _build_fn(self, *, frames: int, height: int, width: int, steps: int,
                   sampler, use_cfg: bool):
@@ -601,7 +686,7 @@ class Img2VidPipeline:
             image_aug = image + aug * jax.random.normal(
                 akey, image.shape, jnp.float32)
             mean, _ = vae.apply(params["vae"], image_aug,
-                                method=AutoencoderKL.encode_moments)
+                                method="encode_moments")
             cond = jnp.broadcast_to(mean[:, None],
                                     (1, frames, lh, lw, latent_ch))
 
@@ -646,8 +731,9 @@ class Img2VidPipeline:
             (x, _, _), _ = jax.lax.scan(
                 body, (x, init_sampler_state(x), key), jnp.arange(steps))
 
-            img = vae.apply(params["vae"], x[0],
-                            method=AutoencoderKL.decode)
+            # temporal-decoder VAE: frames stay a real axis so the
+            # decoder's frame convs and blends see the whole clip
+            img = vae.apply(params["vae"], x, method="decode")[0]
             return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
                     ).astype(jnp.uint8)   # (F, H, W, 3)
 
@@ -685,9 +771,17 @@ class Img2VidPipeline:
         # conditioning latents at the generation grid
         cond_img = np.asarray(pil.resize((width, height), Image.LANCZOS),
                               np.float32) / 127.5 - 1.0
-        # CLIP tower input (resize; mean/std from the published preprocessor)
+        # CLIP tower input — the published CLIPImageProcessor recipe:
+        # shortest edge to image_size (bicubic), center crop, then the
+        # CLIP mean/std. A plain squash distorts non-square inputs (SVD's
+        # native 576x1024) vs the reference embedding (ADVICE r4 #2).
         s = fam.vision.image_size
-        clip_in = np.asarray(pil.resize((s, s), Image.BICUBIC),
+        w0, h0 = pil.size
+        scale = s / min(w0, h0)
+        rw, rh = max(s, round(w0 * scale)), max(s, round(h0 * scale))
+        resized = pil.resize((rw, rh), Image.BICUBIC)
+        x0, y0 = (rw - s) // 2, (rh - s) // 2
+        clip_in = np.asarray(resized.crop((x0, y0, x0 + s, y0 + s)),
                              np.float32) / 255.0
         mean = np.asarray([0.48145466, 0.4578275, 0.40821073], np.float32)
         std = np.asarray([0.26862954, 0.26130258, 0.27577711], np.float32)

@@ -56,7 +56,7 @@ STEPS = 20
 GUIDANCE = 7.5
 SIZE = 512
 
-pytestmark = pytest.mark.skipif(
+_needs_sd_snapshot = pytest.mark.skipif(
     not SNAPSHOT,
     reason="set CHIASWARM_REAL_CHECKPOINT=/path/to/sd-snapshot to run "
            "the real-weights integration proof (zero-egress CI skips)",
@@ -70,6 +70,7 @@ def _psnr(a: np.ndarray, b: np.ndarray) -> float:
     return 10.0 * np.log10(255.0 ** 2 / mse)
 
 
+@_needs_sd_snapshot
 def test_real_checkpoint_txt2img_end_to_end():
     from chiaswarm_tpu.pipelines.components import Components
     from chiaswarm_tpu.pipelines.diffusion import (
@@ -115,3 +116,55 @@ def test_real_checkpoint_txt2img_end_to_end():
     assert psnr >= 30.0, (
         f"converted checkpoint diverges from diffusers: PSNR {psnr:.1f} dB"
     )
+
+
+# ---- video snapshots (VERDICT r4 #7) ----------------------------------
+
+VIDEO_SNAPSHOT = os.environ.get("CHIASWARM_REAL_VIDEO_CHECKPOINT")
+
+
+@pytest.mark.skipif(
+    not VIDEO_SNAPSHOT,
+    reason="set CHIASWARM_REAL_VIDEO_CHECKPOINT=/path/to/"
+           "text-to-video-ms-1.7b (or an SVD img2vid snapshot) to run "
+           "the real-video-weights proof")
+def test_real_video_checkpoint_end_to_end():
+    """The first host with a real video snapshot proves MOTION in one
+    command: strict conversion (zero synthesized leaves — trained
+    temporal weights load, pipelines/video.py::_strict_match) and a clip
+    whose frames actually differ (a 2D-inflated or identity-filled model
+    would render a near-static clip)."""
+    from chiaswarm_tpu.pipelines.video import (
+        Img2VidPipeline,
+        VideoComponents,
+        VideoPipeline,
+        get_video_family,
+    )
+
+    snap = Path(VIDEO_SNAPSHOT)
+    assert (snap / "unet").is_dir(), f"not a video snapshot: {snap}"
+    family = get_video_family(snap.name)
+    vc = VideoComponents.from_checkpoint(snap, snap.name, family)
+
+    if family.image_conditioned:
+        rng = np.random.default_rng(SEED)
+        cond = rng.integers(0, 255, (576, 1024, 3), dtype=np.uint8)
+        frames, config = Img2VidPipeline(vc)(
+            cond, num_frames=14, steps=25, height=576, width=1024,
+            seed=SEED)
+    else:
+        frames, config = VideoPipeline(vc)(
+            PROMPT, num_frames=16, steps=25, height=256, width=256,
+            seed=SEED)
+
+    assert frames.dtype == np.uint8 and frames.ndim == 4
+    assert np.isfinite(frames.astype(np.float64)).all()
+    assert config.get("error") is None
+    spread = int(frames.max()) - int(frames.min())
+    assert spread > 64, f"degenerate clip (spread {spread})"
+    # trained temporal weights must produce real motion: mean abs
+    # frame-to-frame delta well above codec noise
+    deltas = np.abs(np.diff(frames.astype(np.float64), axis=0))
+    assert float(deltas.mean()) > 1.0, (
+        f"near-static clip (mean frame delta {deltas.mean():.3f}) — "
+        f"temporal weights did not load correctly")

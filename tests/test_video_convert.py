@@ -1,0 +1,323 @@
+"""Video-UNet checkpoint fidelity (VERDICT r4 #1).
+
+The reference serves REAL ModelScope snapshots (swarm/video/tx2vid.py:
+24-27); BASELINE config #5 names the SVD class. These tests prove, without
+weights or diffusers:
+
+- forward parity: a torch model in the EXACT published layout/state-dict
+  naming (tests/torch_video_ref.py), randomized, converted, must
+  reproduce the torch forward number-for-number through the Flax modules;
+- conversion completeness at the FULL published configs: every leaf of
+  the 1.4B-param layouts converts — nothing is synthesized (the silent
+  motion-loss failure VERDICT r4 flagged);
+- the end-to-end load path: a full-layout snapshot on disk -> strict
+  from_checkpoint -> clip, for both families; a 2D snapshot into an
+  SVD-class family must raise, not silently inflate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from chiaswarm_tpu.convert.torch_to_flax import (  # noqa: E402
+    convert_unet3d,
+    convert_unet_spatio_temporal,
+)
+from chiaswarm_tpu.pipelines.video import (  # noqa: E402
+    MODELSCOPE,
+    SVD,
+    VIDEO_FAMILIES,
+    _strict_match,
+    _unet_init_args,
+    make_video_unet,
+)
+
+from tests.torch_video_ref import (  # noqa: E402
+    UNet3DRef,
+    UNetSpatioTemporalRef,
+    randomize_,
+)
+
+
+def _np_state(model) -> dict[str, np.ndarray]:
+    return {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+
+def test_unet3d_forward_parity():
+    """ModelScope layout at the tiny config: converted weights reproduce
+    the torch forward — covers the temporal conv stack, the double-self
+    temporal attention, transformer_in, and the interleaving order."""
+    fam = VIDEO_FAMILIES["tiny_vid"]
+    tm = UNet3DRef(fam.unet).eval()
+    randomize_(tm, seed=0)
+    params = convert_unet3d(_np_state(tm), fam.unet)
+
+    rng = np.random.default_rng(1)
+    b, f, s = 2, 3, 7
+    sample = rng.normal(size=(b, f, 16, 16, 4)).astype(np.float32)
+    t = np.asarray([3.0, 250.0], np.float32)
+    ctx = rng.normal(size=(b, s, fam.unet.cross_attention_dim)
+                     ).astype(np.float32)
+
+    with torch.no_grad():
+        want = tm(torch.from_numpy(sample.transpose(0, 4, 1, 2, 3)),
+                  torch.from_numpy(t), torch.from_numpy(ctx)).numpy()
+    unet = make_video_unet(fam)
+    got = unet.apply(params, jnp.asarray(sample), jnp.asarray(t),
+                     jnp.asarray(ctx))
+    np.testing.assert_allclose(np.asarray(got),
+                               want.transpose(0, 2, 3, 4, 1),
+                               atol=3e-4, rtol=3e-4)
+
+
+def test_unet_spatio_temporal_forward_parity():
+    """SVD layout at the tiny config: spatio-temporal res blocks (learned
+    blends), temporal transformer blocks (ff_in residual, first-frame
+    cross-attention), frame-position embedding, micro-conditioning."""
+    fam = VIDEO_FAMILIES["tiny_svd"]
+    tm = UNetSpatioTemporalRef(fam.unet).eval()
+    randomize_(tm, seed=2)
+    params = convert_unet_spatio_temporal(_np_state(tm), fam.unet)
+
+    rng = np.random.default_rng(3)
+    b, f = 2, 3
+    sample = rng.normal(size=(b, f, 16, 16, fam.unet.sample_channels)
+                        ).astype(np.float32)
+    t = np.asarray([0.7, 1.4], np.float32)
+    ctx = rng.normal(size=(b, 1, fam.unet.cross_attention_dim)
+                     ).astype(np.float32)
+    ids = np.asarray([[6.0, 127.0, 0.02], [7.0, 60.0, 0.1]], np.float32)
+
+    with torch.no_grad():
+        want = tm(torch.from_numpy(sample.transpose(0, 1, 4, 2, 3)),
+                  torch.from_numpy(t), torch.from_numpy(ctx),
+                  torch.from_numpy(ids)).numpy()
+    unet = make_video_unet(fam)
+    got = unet.apply(params, jnp.asarray(sample), jnp.asarray(t),
+                     jnp.asarray(ctx), {"time_ids": jnp.asarray(ids)})
+    np.testing.assert_allclose(np.asarray(got),
+                               want.transpose(0, 1, 3, 4, 2),
+                               atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family,ref_cls,converter", [
+    (MODELSCOPE, UNet3DRef, convert_unet3d),
+    (SVD, UNetSpatioTemporalRef, convert_unet_spatio_temporal),
+], ids=["modelscope", "svd"])
+def test_full_published_config_conversion_complete(family, ref_cls,
+                                                   converter):
+    """At the FULL published configs (4 levels, 2 layers/block, head-dim
+    64, ~1.4B params) every checkpoint key must land on exactly one module
+    leaf with the right shape — the completeness guarantee
+    from_checkpoint's strict mode enforces for real snapshots."""
+    tm = ref_cls(family.unet)
+    converted = converter(_np_state(tm), family.unet)
+    del tm
+    unet = make_video_unet(family)
+    shapes = jax.eval_shape(unet.init, jax.random.PRNGKey(0),
+                            *_unet_init_args(family))
+    _strict_match(shapes, converted, family.name)  # raises on any gap
+
+
+def test_temporal_vae_decoder_forward_parity():
+    """The SVD VAE's TemporalDecoder at a tiny config: converted weights
+    reproduce the torch forward — covers the switched learned blends,
+    the temb-free temporal resnets, the mid attention and time_conv_out."""
+    from chiaswarm_tpu.convert.torch_to_flax import convert_temporal_vae
+    from chiaswarm_tpu.models.vae import TemporalVaeDecoder
+
+    from tests.torch_video_ref import TemporalDecoderRef
+
+    fam = VIDEO_FAMILIES["tiny_svd"]
+    tm = TemporalDecoderRef(fam.vae).eval()
+    randomize_(tm, seed=6)
+    state = {f"decoder.{k}": v for k, v in _np_state(tm).items()}
+    tree = convert_temporal_vae(state, fam.vae)
+    params = {"params": tree["params"]["decoder"]}
+
+    rng = np.random.default_rng(7)
+    z = rng.normal(size=(2, 3, 4, 4, fam.vae.latent_channels)
+                   ).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(z.transpose(0, 1, 4, 2, 3)), 3).numpy()
+    got = TemporalVaeDecoder(fam.vae).apply(params, jnp.asarray(z))
+    np.testing.assert_allclose(np.asarray(got),
+                               want.transpose(0, 1, 3, 4, 2),
+                               atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.slow
+def test_full_published_svd_vae_conversion_complete():
+    """The published SVD VAE (AutoencoderKLTemporalDecoder at the
+    (128,256,512,512)x2 layout): every key converts, nothing synthesized
+    — including the temporal decoder and the absence of
+    post_quant_conv."""
+    from chiaswarm_tpu.convert.torch_to_flax import convert_temporal_vae
+    from chiaswarm_tpu.models.configs import VAEConfig
+    from chiaswarm_tpu.models.vae import (
+        AutoencoderKL,
+        AutoencoderKLTemporalDecoder,
+    )
+    from chiaswarm_tpu.pipelines.components import materialize_host
+
+    from tests.torch_export import export_vae
+    from tests.torch_video_ref import TemporalDecoderRef
+
+    cfg = VAEConfig()
+    # encoder keys via the standard flax export, decoder via the torch ref
+    enc = materialize_host(
+        jax.eval_shape(AutoencoderKL(cfg).init, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 16, 16, cfg.in_channels))),
+        np.random.default_rng(9), "bfloat16")
+    state = {k: v for k, v in export_vae(enc, 4).items()
+             if not k.startswith("decoder.") and not k.startswith("post_quant_conv")}
+    state.update({f"decoder.{k}": v
+                  for k, v in _np_state(TemporalDecoderRef(cfg)).items()})
+    converted = convert_temporal_vae(state, cfg)
+    shapes = jax.eval_shape(
+        AutoencoderKLTemporalDecoder(cfg).init, jax.random.PRNGKey(0),
+        jnp.zeros((1, 2, 16, 16, cfg.in_channels)))
+    _strict_match(shapes, converted, "svd-vae")
+
+
+def _write_safetensors(dirpath, state: dict[str, np.ndarray]) -> None:
+    from pathlib import Path
+
+    from safetensors.numpy import save_file
+
+    d = Path(dirpath)
+    d.mkdir(parents=True, exist_ok=True)
+    save_file({k: np.ascontiguousarray(v) for k, v in state.items()},
+              str(d / "model.safetensors"))
+
+
+def _write_tiny_vae_and_text(root) -> None:
+    from chiaswarm_tpu.pipelines.components import Components
+    from tests.torch_export import export_text_encoder, export_vae
+
+    src = Components.random("tiny", seed=7)
+    _write_safetensors(root / "vae", export_vae(src.params["vae"], 2))
+    _write_safetensors(root / "text_encoder",
+                       export_text_encoder(src.params["text_encoder_0"]))
+
+
+def test_modelscope_snapshot_loads_trained_temporal_weights(tmp_path):
+    """A native UNet3DConditionModel snapshot on disk converts completely
+    — the trained temporal weights land (spot-checked against the torch
+    state), no identity fill — and the pipeline renders from it."""
+    from chiaswarm_tpu.pipelines.video import VideoComponents, VideoPipeline
+
+    fam = VIDEO_FAMILIES["tiny_vid"]
+    tm = UNet3DRef(fam.unet)
+    randomize_(tm, seed=11)
+    state = _np_state(tm)
+    _write_safetensors(tmp_path / "unet", state)
+    _write_tiny_vae_and_text(tmp_path)
+
+    vc = VideoComponents.from_checkpoint(tmp_path, "tiny-ms-native",
+                                         "tiny_vid")
+    # trained temporal weights, not identity: conv4 of a temp conv equals
+    # the checkpoint value (transposed), and is NOT zero
+    want = state["down_blocks.0.temp_convs.0.conv4.3.weight"]
+    got = np.asarray(
+        vc.params["unet"]["params"]["down_0_tconvs_0"]["conv4"]["kernel"])
+    np.testing.assert_array_equal(got, want.transpose(2, 3, 4, 1, 0))
+    assert np.abs(got).max() > 0
+
+    frames, config = VideoPipeline(vc)("a test", num_frames=4, steps=2,
+                                       height=64, width=64, seed=1)
+    assert frames.shape == (4, 64, 64, 3)
+    assert config["mode"] == "txt2vid"
+
+
+def test_svd_snapshot_end_to_end_load_path(tmp_path):
+    """A full spatio-temporal snapshot (unet + image_encoder + vae)
+    loads strictly and renders an img2vid clip."""
+    transformers = pytest.importorskip("transformers")
+
+    from chiaswarm_tpu.pipelines.video import (
+        Img2VidPipeline,
+        VideoComponents,
+    )
+    from tests.torch_export import export_vae
+
+    from chiaswarm_tpu.models.vae import AutoencoderKL
+
+    from tests.torch_video_ref import TemporalDecoderRef
+
+    fam = VIDEO_FAMILIES["tiny_svd"]
+    tm = UNetSpatioTemporalRef(fam.unet)
+    randomize_(tm, seed=12)
+    state = _np_state(tm)
+    _write_safetensors(tmp_path / "unet", state)
+    # the published temporal-decoder VAE layout: standard encoder keys +
+    # "decoder."-prefixed TemporalDecoder keys, no post_quant_conv
+    enc = jax.jit(AutoencoderKL(fam.vae).init)(
+        jax.random.PRNGKey(8), jnp.zeros((1, 16, 16, 3)))
+    vae_state = {k: v for k, v in export_vae(enc, 2).items()
+                 if not k.startswith("decoder.") and not k.startswith("post_quant_conv")}
+    tdec = TemporalDecoderRef(fam.vae)
+    randomize_(tdec, seed=13)
+    vae_state.update({f"decoder.{k}": v
+                      for k, v in _np_state(tdec).items()})
+    _write_safetensors(tmp_path / "vae", vae_state)
+    v = fam.vision
+    torch.manual_seed(5)
+    vision = transformers.CLIPVisionModelWithProjection(
+        transformers.CLIPVisionConfig(
+            hidden_size=v.hidden_size, intermediate_size=v.intermediate_size,
+            num_hidden_layers=v.num_layers, num_attention_heads=v.num_heads,
+            image_size=v.image_size, patch_size=v.patch_size,
+            projection_dim=v.projection_dim))
+    _write_safetensors(tmp_path / "image_encoder", _np_state(vision))
+
+    vc = VideoComponents.from_checkpoint(tmp_path, "tiny-svd-native",
+                                         "tiny_svd")
+    # the learned blend factors came from the snapshot
+    want = state["mid_block.resnets.0.time_mixer.mix_factor"]
+    got = np.asarray(
+        vc.params["unet"]["params"]["mid_resnets_0"]["mix_factor"])
+    np.testing.assert_array_equal(got, want)
+
+    rng = np.random.default_rng(4)
+    image = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+    frames, config = Img2VidPipeline(vc)(image, num_frames=4, steps=2,
+                                         height=64, width=64, seed=3)
+    assert frames.shape == (4, 64, 64, 3)
+    assert config["mode"] == "img2vid"
+
+
+def test_svd_family_rejects_2d_snapshot(tmp_path):
+    """Feeding a plain SD-style 2D snapshot to an image-conditioned
+    family must raise the dedicated error (ADVICE r4 #5) — never
+    silently inflate."""
+    from chiaswarm_tpu.pipelines.components import Components
+    from chiaswarm_tpu.pipelines.video import VideoComponents
+    from tests.torch_export import write_checkpoint
+
+    write_checkpoint(tmp_path, Components.random("tiny", seed=3))
+    with pytest.raises(ValueError, match="spatio-temporal"):
+        VideoComponents.from_checkpoint(tmp_path, "bad-svd", "tiny_svd")
+
+
+def test_modelscope_strict_mode_rejects_truncated_snapshot(tmp_path):
+    """A native snapshot with a temporal key REMOVED must fail loudly —
+    the strict matcher guards against partial conversions replacing
+    trained weights."""
+    from chiaswarm_tpu.pipelines.video import VideoComponents
+
+    fam = VIDEO_FAMILIES["tiny_vid"]
+    tm = UNet3DRef(fam.unet)
+    state = _np_state(tm)
+    state.pop("mid_block.temp_attentions.0.proj_out.weight")
+    _write_safetensors(tmp_path / "unet", state)
+    _write_tiny_vae_and_text(tmp_path)
+    with pytest.raises(ValueError, match="missing"):
+        VideoComponents.from_checkpoint(tmp_path, "truncated", "tiny_vid")

@@ -29,23 +29,26 @@ def test_video_family_routing():
 
 
 @pytest.mark.slow
-def test_temporal_unet_zero_init_is_framewise_2d():
-    """Zero-initialized temporal layers are identity: identical per-frame
-    inputs must produce identical per-frame outputs (the safe default for
-    weights converted from 2D checkpoints)."""
+def test_inflated_temporal_layers_are_framewise_identity(tmp_path):
+    """2D inflation inits the temporal modules at identity (zero conv4 /
+    proj_out): identical per-frame inputs must produce identical
+    per-frame outputs — the safe default for weights grafted from 2D
+    checkpoints."""
     import jax
     import jax.numpy as jnp
 
-    from chiaswarm_tpu.models.video_unet import VideoUNet
+    from chiaswarm_tpu.pipelines.components import Components
+    from chiaswarm_tpu.pipelines.video import VideoComponents
+    from tests.torch_export import write_checkpoint
 
-    fam = VIDEO_FAMILIES["tiny_vid"]
-    unet = VideoUNet(fam.unet, max_frames=fam.max_frames)
+    write_checkpoint(tmp_path, Components.random("tiny", seed=5))
+    vc = VideoComponents.from_checkpoint(tmp_path, "tiny-inflated",
+                                         "tiny_vid")
     frame = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 8, 8, 4))
     video = jnp.repeat(frame, 4, axis=1)   # 4 identical frames
     ctx = jax.random.normal(jax.random.PRNGKey(2),
-                            (1, 77, fam.unet.cross_attention_dim))
-    params = unet.init(jax.random.PRNGKey(0), video, jnp.zeros((1,)), ctx)
-    out = unet.apply(params, video, jnp.full((1,), 3.0), ctx)
+                            (1, 77, vc.family.unet.cross_attention_dim))
+    out = vc.unet.apply(vc.params["unet"], video, jnp.full((1,), 3.0), ctx)
     assert out.shape == video.shape
     for i in range(1, 4):
         np.testing.assert_allclose(np.asarray(out[:, 0]),

@@ -1,0 +1,807 @@
+"""Test-side torch references for the two published video-UNet layouts.
+
+Independent torch implementations of diffusers' ``UNet3DConditionModel``
+(ModelScope text-to-video — the snapshot the reference serves,
+swarm/video/tx2vid.py:24-27) and ``UNetSpatioTemporalConditionModel``
+(SVD img2vid), with the EXACT published state-dict naming. diffusers is
+not installed in this environment, so these stand in for it on two fronts:
+
+- numeric forward parity vs models/video_unet.py (converted weights must
+  reproduce the torch forward number-for-number);
+- full-published-config conversion coverage (state_dict() -> converter ->
+  every Flax leaf present, nothing synthesized).
+
+Written against the published module graphs, NOT against the Flax code —
+a naming/semantics bug in the converter or the Flax modules cannot cancel
+out here (same policy as tests/torch_export.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def _groups(channels: int) -> int:
+    g = min(32, channels)
+    while channels % g:
+        g -= 1
+    return g
+
+
+def sinusoidal(t: torch.Tensor, dim: int) -> torch.Tensor:
+    """diffusers get_timestep_embedding with flip_sin_to_cos=True,
+    downscale_freq_shift=0: [cos | sin]."""
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0) * torch.arange(half).float() / half)
+    args = t.float()[:, None] * freqs[None]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+class TimestepEmbedding(nn.Module):
+    def __init__(self, in_dim: int, hidden: int, out_dim: int | None = None):
+        super().__init__()
+        self.linear_1 = nn.Linear(in_dim, hidden)
+        self.linear_2 = nn.Linear(hidden, out_dim or hidden)
+
+    def forward(self, x):
+        return self.linear_2(F.silu(self.linear_1(x)))
+
+
+class Attention(nn.Module):
+    """diffusers Attention: biasless qkv, to_out = ModuleList([Linear,
+    Dropout])."""
+
+    def __init__(self, dim, heads, head_dim, cross_dim=None):
+        super().__init__()
+        inner = heads * head_dim
+        self.heads, self.head_dim = heads, head_dim
+        self.to_q = nn.Linear(dim, inner, bias=False)
+        self.to_k = nn.Linear(cross_dim or dim, inner, bias=False)
+        self.to_v = nn.Linear(cross_dim or dim, inner, bias=False)
+        self.to_out = nn.ModuleList([nn.Linear(inner, dim), nn.Dropout(0.0)])
+
+    def forward(self, x, context=None):
+        context = x if context is None else context
+        b, l, _ = x.shape
+        s = context.shape[1]
+        q = self.to_q(x).reshape(b, l, self.heads, self.head_dim)
+        k = self.to_k(context).reshape(b, s, self.heads, self.head_dim)
+        v = self.to_v(context).reshape(b, s, self.heads, self.head_dim)
+        attn = torch.einsum("blhd,bshd->bhls", q, k) / math.sqrt(
+            self.head_dim)
+        attn = attn.softmax(dim=-1)
+        out = torch.einsum("bhls,bshd->blhd", attn, v).reshape(b, l, -1)
+        return self.to_out[1](self.to_out[0](out))
+
+
+class GEGLU(nn.Module):
+    def __init__(self, dim, inner):
+        super().__init__()
+        self.proj = nn.Linear(dim, inner * 2)
+
+    def forward(self, x):
+        x, gate = self.proj(x).chunk(2, dim=-1)
+        return x * F.gelu(gate)
+
+
+class FeedForward(nn.Module):
+    def __init__(self, dim, out_dim=None):
+        super().__init__()
+        inner = dim * 4
+        self.net = nn.ModuleList([GEGLU(dim, inner), nn.Dropout(0.0),
+                                  nn.Linear(inner, out_dim or dim)])
+
+    def forward(self, x):
+        for layer in self.net:
+            x = layer(x)
+        return x
+
+
+class BasicTransformerBlock(nn.Module):
+    def __init__(self, dim, heads, head_dim, cross_dim=None,
+                 double_self_attention=False):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = Attention(dim, heads, head_dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = Attention(
+            dim, heads, head_dim,
+            None if double_self_attention else cross_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = FeedForward(dim)
+        self.double_self_attention = double_self_attention
+
+    def forward(self, x, context=None):
+        x = self.attn1(self.norm1(x)) + x
+        ctx = None if self.double_self_attention else context
+        x = self.attn2(self.norm2(x), ctx) + x
+        return self.ff(self.norm3(x)) + x
+
+
+class ResnetBlock2D(nn.Module):
+    def __init__(self, in_ch, out_ch, temb_dim, eps=1e-5):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(_groups(in_ch), in_ch, eps=eps)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = nn.Linear(temb_dim, out_ch)
+        self.norm2 = nn.GroupNorm(_groups(out_ch), out_ch, eps=eps)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        self.conv_shortcut = (nn.Conv2d(in_ch, out_ch, 1)
+                              if in_ch != out_ch else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class Downsample2D(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2D(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class Transformer2DModel(nn.Module):
+    """Spatial transformer with the conv-projection default the 3D UNet
+    uses (use_linear_projection=False)."""
+
+    def __init__(self, heads, head_dim, in_ch, cross_dim,
+                 use_linear_projection=False, depth=1):
+        super().__init__()
+        inner = heads * head_dim
+        self.use_linear_projection = use_linear_projection
+        self.norm = nn.GroupNorm(_groups(in_ch), in_ch, eps=1e-6)
+        if use_linear_projection:
+            self.proj_in = nn.Linear(in_ch, inner)
+            self.proj_out = nn.Linear(inner, in_ch)
+        else:
+            self.proj_in = nn.Conv2d(in_ch, inner, 1)
+            self.proj_out = nn.Conv2d(inner, in_ch, 1)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(inner, heads, head_dim, cross_dim)
+             for _ in range(depth)])
+
+    def forward(self, x, context):
+        b, c, hh, ww = x.shape
+        residual = x
+        h = self.norm(x)
+        if self.use_linear_projection:
+            h = h.permute(0, 2, 3, 1).reshape(b, hh * ww, c)
+            h = self.proj_in(h)
+        else:
+            h = self.proj_in(h)
+            h = h.permute(0, 2, 3, 1).reshape(b, hh * ww, -1)
+        for block in self.transformer_blocks:
+            h = block(h, context)
+        if self.use_linear_projection:
+            h = self.proj_out(h)
+            h = h.reshape(b, hh, ww, c).permute(0, 3, 1, 2)
+        else:
+            h = h.reshape(b, hh, ww, -1).permute(0, 3, 1, 2)
+            h = self.proj_out(h)
+        return h + residual
+
+
+# ------------------------------------------------- ModelScope (UNet3D)
+
+
+class TemporalConvLayer(nn.Module):
+    """Four (GroupNorm, SiLU[, Dropout], Conv3d (3,1,1)) stages; conv4
+    zero-initialized; residual add. Keys: conv1.{0,2}, conv2..4.{0,3}."""
+
+    def __init__(self, dim):
+        super().__init__()
+        self.conv1 = nn.Sequential(
+            nn.GroupNorm(_groups(dim), dim), nn.SiLU(),
+            nn.Conv3d(dim, dim, (3, 1, 1), padding=(1, 0, 0)))
+        for name in ("conv2", "conv3", "conv4"):
+            setattr(self, name, nn.Sequential(
+                nn.GroupNorm(_groups(dim), dim), nn.SiLU(), nn.Dropout(0.0),
+                nn.Conv3d(dim, dim, (3, 1, 1), padding=(1, 0, 0))))
+        nn.init.zeros_(self.conv4[-1].weight)
+        nn.init.zeros_(self.conv4[-1].bias)
+
+    def forward(self, x, num_frames):
+        # x (B*F, C, H, W) -> (B, C, F, H, W)
+        x = x.reshape(-1, num_frames, *x.shape[1:]).permute(0, 2, 1, 3, 4)
+        identity = x
+        x = self.conv4(self.conv3(self.conv2(self.conv1(x))))
+        x = identity + x
+        x = x.permute(0, 2, 1, 3, 4)                  # (B, F, C, H, W)
+        return x.reshape(-1, *x.shape[2:])
+
+
+class TransformerTemporalModel(nn.Module):
+    """Frame-axis transformer, double self-attention (the diffusers
+    default for this class)."""
+
+    def __init__(self, heads, head_dim, in_ch):
+        super().__init__()
+        inner = heads * head_dim
+        self.norm = nn.GroupNorm(_groups(in_ch), in_ch, eps=1e-6)
+        self.proj_in = nn.Linear(in_ch, inner)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(inner, heads, head_dim,
+                                   double_self_attention=True)])
+        self.proj_out = nn.Linear(inner, in_ch)
+
+    def forward(self, x, num_frames):
+        bf, c, hh, ww = x.shape
+        b = bf // num_frames
+        residual = x
+        h = x.reshape(b, num_frames, c, hh, ww).permute(0, 2, 1, 3, 4)
+        h = self.norm(h)
+        h = h.permute(0, 3, 4, 2, 1).reshape(b * hh * ww, num_frames, c)
+        h = self.proj_in(h)
+        for block in self.transformer_blocks:
+            h = block(h)
+        h = self.proj_out(h)
+        h = h.reshape(b, hh, ww, num_frames, c).permute(0, 4, 3, 1, 2)
+        h = h.permute(0, 2, 1, 3, 4).reshape(bf, c, hh, ww)
+        return h + residual
+
+
+class _Block3D(nn.Module):
+    """One down/up level of UNet3DConditionModel: resnets + temp_convs
+    (+ attentions + temp_attentions when the level has attention)."""
+
+    def __init__(self, chans, temb_dim, heads, head_dim, cross_dim,
+                 depth, sampler=None):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [ResnetBlock2D(i, o, temb_dim) for i, o in chans])
+        self.temp_convs = nn.ModuleList(
+            [TemporalConvLayer(o) for _, o in chans])
+        if depth > 0:
+            self.attentions = nn.ModuleList(
+                [Transformer2DModel(heads, head_dim, o, cross_dim,
+                                    depth=depth) for _, o in chans])
+            self.temp_attentions = nn.ModuleList(
+                [TransformerTemporalModel(heads, head_dim, o)
+                 for _, o in chans])
+        else:
+            self.attentions = self.temp_attentions = None
+        if sampler == "down":
+            self.downsamplers = nn.ModuleList([Downsample2D(chans[-1][1])])
+        elif sampler == "up":
+            self.upsamplers = nn.ModuleList([Upsample2D(chans[-1][1])])
+
+
+class UNet3DRef(nn.Module):
+    """diffusers UNet3DConditionModel at a chiaswarm UNetConfig."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        chans = list(cfg.block_out_channels)
+        temb_dim = chans[0] * 4
+        self.conv_in = nn.Conv2d(cfg.sample_channels, chans[0], 3,
+                                 padding=1)
+        self.time_embedding = TimestepEmbedding(chans[0], temb_dim)
+        head_dim0 = cfg.heads_for(chans[0], 0)[1]
+        self.transformer_in = TransformerTemporalModel(8, head_dim0,
+                                                       chans[0])
+        down, in_ch = [], chans[0]
+        for level, ch in enumerate(chans):
+            heads, head_dim = cfg.heads_for(ch, level)
+            pairs = []
+            for _ in range(cfg.layers_per_block):
+                pairs.append((in_ch, ch))
+                in_ch = ch
+            down.append(_Block3D(
+                pairs, temb_dim, heads, head_dim, cfg.cross_attention_dim,
+                cfg.transformer_depth[level],
+                "down" if level < len(chans) - 1 else None))
+        self.down_blocks = nn.ModuleList(down)
+
+        mid_ch = chans[-1]
+        mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(chans) - 1)
+        mid_depth = max(cfg.transformer_depth) or 1
+
+        class _Mid(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.resnets = nn.ModuleList(
+                    [ResnetBlock2D(mid_ch, mid_ch, temb_dim),
+                     ResnetBlock2D(mid_ch, mid_ch, temb_dim)])
+                self.temp_convs = nn.ModuleList(
+                    [TemporalConvLayer(mid_ch), TemporalConvLayer(mid_ch)])
+                self.attentions = nn.ModuleList(
+                    [Transformer2DModel(mid_heads, mid_head_dim, mid_ch,
+                                        cfg.cross_attention_dim,
+                                        depth=mid_depth)])
+                self.temp_attentions = nn.ModuleList(
+                    [TransformerTemporalModel(mid_heads, mid_head_dim,
+                                              mid_ch)])
+
+        self.mid_block = _Mid()
+
+        up = []
+        skip_chs = []  # per-skip channel counts, mirroring the down path
+        in_ch = chans[0]
+        skip_chs.append(chans[0])
+        for level, ch in enumerate(chans):
+            for _ in range(cfg.layers_per_block):
+                skip_chs.append(ch)
+            if level < len(chans) - 1:
+                skip_chs.append(ch)
+        x_ch = chans[-1]
+        for rev, ch in enumerate(reversed(chans)):
+            level = len(chans) - 1 - rev
+            heads, head_dim = cfg.heads_for(ch, level)
+            pairs = []
+            for _ in range(cfg.layers_per_block + 1):
+                pairs.append((x_ch + skip_chs.pop(), ch))
+                x_ch = ch
+            up.append(_Block3D(
+                pairs, temb_dim, heads, head_dim, cfg.cross_attention_dim,
+                cfg.transformer_depth[level],
+                "up" if level > 0 else None))
+        self.up_blocks = nn.ModuleList(up)
+
+        self.conv_norm_out = nn.GroupNorm(_groups(chans[0]), chans[0],
+                                          eps=1e-5)
+        self.conv_out = nn.Conv2d(chans[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, context):
+        # sample (B, C, F, H, W); context (B, S, D)
+        b, _, f, _, _ = sample.shape
+        temb = self.time_embedding(
+            sinusoidal(timesteps, self.cfg.block_out_channels[0]))
+        temb_f = temb.repeat_interleave(f, dim=0)
+        ctx_f = context.repeat_interleave(f, dim=0)
+
+        x = sample.permute(0, 2, 1, 3, 4).reshape(
+            b * f, *sample.shape[1:2], *sample.shape[3:])
+        x = self.conv_in(x)
+        x = self.transformer_in(x, f)
+        skips = [x]
+        for block in self.down_blocks:
+            for j, (resnet, tconv) in enumerate(
+                    zip(block.resnets, block.temp_convs)):
+                x = tconv(resnet(x, temb_f), f)
+                if block.attentions is not None:
+                    x = block.attentions[j](x, ctx_f)
+                    x = block.temp_attentions[j](x, f)
+                skips.append(x)
+            if hasattr(block, "downsamplers"):
+                x = block.downsamplers[0](x)
+                skips.append(x)
+
+        m = self.mid_block
+        x = m.temp_convs[0](m.resnets[0](x, temb_f), f)
+        x = m.attentions[0](x, ctx_f)
+        x = m.temp_attentions[0](x, f)
+        x = m.temp_convs[1](m.resnets[1](x, temb_f), f)
+
+        for block in self.up_blocks:
+            for j, (resnet, tconv) in enumerate(
+                    zip(block.resnets, block.temp_convs)):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = tconv(resnet(x, temb_f), f)
+                if block.attentions is not None:
+                    x = block.attentions[j](x, ctx_f)
+                    x = block.temp_attentions[j](x, f)
+            if hasattr(block, "upsamplers"):
+                x = block.upsamplers[0](x)
+
+        x = self.conv_out(F.silu(self.conv_norm_out(x)))
+        return x.reshape(b, f, *x.shape[1:]).permute(0, 2, 1, 3, 4)
+
+
+# ------------------------------------------------------ SVD (spatio-temporal)
+
+
+class _AlphaBlender(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.mix_factor = nn.Parameter(torch.tensor([0.5]))
+
+
+class TemporalResnetBlock(nn.Module):
+    def __init__(self, dim, temb_dim, eps):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(_groups(dim), dim, eps=eps)
+        self.conv1 = nn.Conv3d(dim, dim, (3, 1, 1), padding=(1, 0, 0))
+        if temb_dim is not None:
+            self.time_emb_proj = nn.Linear(temb_dim, dim)
+        self.norm2 = nn.GroupNorm(_groups(dim), dim, eps=eps)
+        self.conv2 = nn.Conv3d(dim, dim, (3, 1, 1), padding=(1, 0, 0))
+
+    def forward(self, x, temb_bf=None):
+        # x (B, C, F, H, W); temb_bf (B, F, D)
+        h = self.conv1(F.silu(self.norm1(x)))
+        if temb_bf is not None:
+            t = self.time_emb_proj(F.silu(temb_bf))      # (B, F, C)
+            h = h + t.permute(0, 2, 1)[:, :, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        return x + h
+
+
+class SpatioTemporalResBlock(nn.Module):
+    def __init__(self, in_ch, out_ch, temb_dim, eps):
+        super().__init__()
+        self.spatial_res_block = ResnetBlock2D(in_ch, out_ch, temb_dim, eps)
+        self.temporal_res_block = TemporalResnetBlock(out_ch, temb_dim, eps)
+        self.time_mixer = _AlphaBlender()
+
+    def forward(self, x, temb_f, num_frames):
+        s = self.spatial_res_block(x, temb_f)
+        bf, c, hh, ww = s.shape
+        b = bf // num_frames
+        s5 = s.reshape(b, num_frames, c, hh, ww).permute(0, 2, 1, 3, 4)
+        temb_bf = temb_f.reshape(b, num_frames, -1)
+        t5 = self.temporal_res_block(s5, temb_bf)
+        # non-switched AlphaBlender — the SVD UNet direction
+        # (switch_spatial_to_temporal_mix is a temporal-VAE-decoder-only
+        # option in diffusers)
+        a = torch.sigmoid(self.time_mixer.mix_factor)
+        out = a * s5 + (1.0 - a) * t5
+        return out.permute(0, 2, 1, 3, 4).reshape(bf, c, hh, ww)
+
+
+class TemporalBasicTransformerBlock(nn.Module):
+    def __init__(self, dim, heads, head_dim, cross_dim):
+        super().__init__()
+        self.norm_in = nn.LayerNorm(dim)
+        self.ff_in = FeedForward(dim)
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = Attention(dim, heads, head_dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = Attention(dim, heads, head_dim, cross_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = FeedForward(dim)
+
+    def forward(self, x, num_frames, context):
+        # x (B*F, S, C); context (B*S, S_ctx, D)
+        bf, s, c = x.shape
+        b = bf // num_frames
+        h = x.reshape(b, num_frames, s, c).permute(0, 2, 1, 3)
+        h = h.reshape(b * s, num_frames, c)
+        residual = h
+        h = self.ff_in(self.norm_in(h)) + residual
+        h = self.attn1(self.norm1(h)) + h
+        h = self.attn2(self.norm2(h), context) + h
+        h = self.ff(self.norm3(h)) + h
+        h = h.reshape(b, s, num_frames, c).permute(0, 2, 1, 3)
+        return h.reshape(bf, s, c)
+
+
+class TransformerSpatioTemporalModel(nn.Module):
+    def __init__(self, heads, head_dim, in_ch, cross_dim, depth=1):
+        super().__init__()
+        inner = heads * head_dim
+        self.in_ch = in_ch
+        self.norm = nn.GroupNorm(_groups(in_ch), in_ch, eps=1e-6)
+        self.proj_in = nn.Linear(in_ch, inner)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(inner, heads, head_dim, cross_dim)
+             for _ in range(depth)])
+        self.temporal_transformer_blocks = nn.ModuleList(
+            [TemporalBasicTransformerBlock(inner, heads, head_dim,
+                                           cross_dim)
+             for _ in range(depth)])
+        self.time_pos_embed = TimestepEmbedding(in_ch, in_ch * 4, in_ch)
+        self.time_mixer = _AlphaBlender()
+        self.proj_out = nn.Linear(inner, in_ch)
+
+    def forward(self, x, context, num_frames):
+        # x (B*F, C, H, W); context (B*F, S_ctx, D)
+        bf, c, hh, ww = x.shape
+        b = bf // num_frames
+        time_context = context.reshape(
+            b, num_frames, -1, context.shape[-1])[:, 0]
+        time_context = time_context[:, None].expand(
+            b, hh * ww, -1, context.shape[-1])
+        time_context = time_context.reshape(
+            b * hh * ww, -1, context.shape[-1])
+
+        residual = x
+        h = self.norm(x).permute(0, 2, 3, 1).reshape(bf, hh * ww, c)
+        h = self.proj_in(h)
+
+        frame_ids = torch.arange(num_frames).repeat(b)
+        femb = self.time_pos_embed(sinusoidal(frame_ids, self.in_ch))
+        femb = femb[:, None]
+
+        a = torch.sigmoid(self.time_mixer.mix_factor)
+        for block, tblock in zip(self.transformer_blocks,
+                                 self.temporal_transformer_blocks):
+            s = block(h, context)
+            t = tblock(s + femb, num_frames, time_context)
+            h = a * s + (1.0 - a) * t
+        h = self.proj_out(h)
+        h = h.reshape(bf, hh, ww, c).permute(0, 3, 1, 2)
+        return h + residual
+
+
+class _BlockST(nn.Module):
+    def __init__(self, chans, temb_dim, heads, head_dim, cross_dim,
+                 depth, sampler=None):
+        super().__init__()
+        eps = 1e-6 if depth > 0 else 1e-5
+        self.resnets = nn.ModuleList(
+            [SpatioTemporalResBlock(i, o, temb_dim, eps) for i, o in chans])
+        if depth > 0:
+            self.attentions = nn.ModuleList(
+                [TransformerSpatioTemporalModel(heads, head_dim, o,
+                                                cross_dim, depth)
+                 for _, o in chans])
+        else:
+            self.attentions = None
+        if sampler == "down":
+            self.downsamplers = nn.ModuleList([Downsample2D(chans[-1][1])])
+        elif sampler == "up":
+            self.upsamplers = nn.ModuleList([Upsample2D(chans[-1][1])])
+
+
+class UNetSpatioTemporalRef(nn.Module):
+    """diffusers UNetSpatioTemporalConditionModel at a UNetConfig."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        chans = list(cfg.block_out_channels)
+        temb_dim = chans[0] * 4
+        self.conv_in = nn.Conv2d(cfg.sample_channels, chans[0], 3,
+                                 padding=1)
+        self.time_embedding = TimestepEmbedding(chans[0], temb_dim)
+        self.add_embedding = TimestepEmbedding(
+            3 * cfg.addition_embed_dim, temb_dim)
+
+        down, in_ch = [], chans[0]
+        for level, ch in enumerate(chans):
+            heads, head_dim = cfg.heads_for(ch, level)
+            pairs = []
+            for _ in range(cfg.layers_per_block):
+                pairs.append((in_ch, ch))
+                in_ch = ch
+            down.append(_BlockST(
+                pairs, temb_dim, heads, head_dim, cfg.cross_attention_dim,
+                cfg.transformer_depth[level],
+                "down" if level < len(chans) - 1 else None))
+        self.down_blocks = nn.ModuleList(down)
+
+        mid_ch = chans[-1]
+        mid_heads, mid_head_dim = cfg.heads_for(mid_ch, len(chans) - 1)
+        mid_depth = max(cfg.transformer_depth) or 1
+
+        class _Mid(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.resnets = nn.ModuleList(
+                    [SpatioTemporalResBlock(mid_ch, mid_ch, temb_dim, 1e-5),
+                     SpatioTemporalResBlock(mid_ch, mid_ch, temb_dim,
+                                            1e-5)])
+                self.attentions = nn.ModuleList(
+                    [TransformerSpatioTemporalModel(
+                        mid_heads, mid_head_dim, mid_ch,
+                        cfg.cross_attention_dim, mid_depth)])
+
+        self.mid_block = _Mid()
+
+        up = []
+        skip_chs = [chans[0]]
+        for level, ch in enumerate(chans):
+            for _ in range(cfg.layers_per_block):
+                skip_chs.append(ch)
+            if level < len(chans) - 1:
+                skip_chs.append(ch)
+        x_ch = chans[-1]
+        for rev, ch in enumerate(reversed(chans)):
+            level = len(chans) - 1 - rev
+            heads, head_dim = cfg.heads_for(ch, level)
+            pairs = []
+            for _ in range(cfg.layers_per_block + 1):
+                pairs.append((x_ch + skip_chs.pop(), ch))
+                x_ch = ch
+            up.append(_BlockST(
+                pairs, temb_dim, heads, head_dim, cfg.cross_attention_dim,
+                cfg.transformer_depth[level],
+                "up" if level > 0 else None))
+        self.up_blocks = nn.ModuleList(up)
+
+        self.conv_norm_out = nn.GroupNorm(_groups(chans[0]), chans[0],
+                                          eps=1e-5)
+        self.conv_out = nn.Conv2d(chans[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, context, added_ids):
+        # sample (B, F, C, H, W); context (B, S, D); added_ids (B, 3)
+        b, f = sample.shape[:2]
+        temb = self.time_embedding(
+            sinusoidal(timesteps, self.cfg.block_out_channels[0]))
+        ids_emb = sinusoidal(added_ids.flatten(),
+                             self.cfg.addition_embed_dim).reshape(b, -1)
+        temb = temb + self.add_embedding(ids_emb)
+        temb_f = temb.repeat_interleave(f, dim=0)
+        ctx_f = context.repeat_interleave(f, dim=0)
+
+        x = sample.reshape(b * f, *sample.shape[2:])
+        x = self.conv_in(x)
+        skips = [x]
+        for block in self.down_blocks:
+            for j, resnet in enumerate(block.resnets):
+                x = resnet(x, temb_f, f)
+                if block.attentions is not None:
+                    x = block.attentions[j](x, ctx_f, f)
+                skips.append(x)
+            if hasattr(block, "downsamplers"):
+                x = block.downsamplers[0](x)
+                skips.append(x)
+
+        m = self.mid_block
+        x = m.resnets[0](x, temb_f, f)
+        x = m.attentions[0](x, ctx_f, f)
+        x = m.resnets[1](x, temb_f, f)
+
+        for block in self.up_blocks:
+            for j, resnet in enumerate(block.resnets):
+                x = torch.cat([x, skips.pop()], dim=1)
+                x = resnet(x, temb_f, f)
+                if block.attentions is not None:
+                    x = block.attentions[j](x, ctx_f, f)
+            if hasattr(block, "upsamplers"):
+                x = block.upsamplers[0](x)
+
+        x = self.conv_out(F.silu(self.conv_norm_out(x)))
+        return x.reshape(b, f, *x.shape[1:])
+
+
+# --------------------------------------- SVD temporal VAE decoder
+
+
+class VaeResnetRef(nn.Module):
+    """temb-free ResnetBlock2D (eps 1e-6), the VAE spatial resnet."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(_groups(in_ch), in_ch, eps=1e-6)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = nn.GroupNorm(_groups(out_ch), out_ch, eps=1e-6)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        self.conv_shortcut = (nn.Conv2d(in_ch, out_ch, 1)
+                              if in_ch != out_ch else None)
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class VaeSTBlockRef(nn.Module):
+    """TemporalDecoder's SpatioTemporalResBlock: temb-free, spatial eps
+    1e-6 / temporal 1e-5, merge_strategy='learned' WITH
+    switch_spatial_to_temporal_mix -> out = (1-a)*spatial + a*temporal,
+    mix_factor initialized at 0."""
+
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.spatial_res_block = VaeResnetRef(in_ch, out_ch)
+        self.temporal_res_block = TemporalResnetBlock(out_ch, None, 1e-5)
+        self.time_mixer = _AlphaBlender()
+
+    def forward(self, x, num_frames):
+        s = self.spatial_res_block(x)
+        bf, c, hh, ww = s.shape
+        b = bf // num_frames
+        s5 = s.reshape(b, num_frames, c, hh, ww).permute(0, 2, 1, 3, 4)
+        t5 = self.temporal_res_block(s5)
+        a = torch.sigmoid(self.time_mixer.mix_factor)
+        out = (1.0 - a) * s5 + a * t5
+        return out.permute(0, 2, 1, 3, 4).reshape(bf, c, hh, ww)
+
+
+class VaeMidAttentionRef(nn.Module):
+    """diffusers Attention as the VAE mid uses it: group_norm, biased
+    qkv, residual, one head at the full channel width."""
+
+    def __init__(self, dim):
+        super().__init__()
+        self.group_norm = nn.GroupNorm(_groups(dim), dim, eps=1e-6)
+        self.to_q = nn.Linear(dim, dim)
+        self.to_k = nn.Linear(dim, dim)
+        self.to_v = nn.Linear(dim, dim)
+        self.to_out = nn.ModuleList([nn.Linear(dim, dim), nn.Dropout(0.0)])
+
+    def forward(self, x):
+        b, c, hh, ww = x.shape
+        residual = x
+        h = self.group_norm(x).permute(0, 2, 3, 1).reshape(b, hh * ww, c)
+        q, k, v = self.to_q(h), self.to_k(h), self.to_v(h)
+        attn = (q @ k.transpose(1, 2)) / math.sqrt(c)
+        h = attn.softmax(dim=-1) @ v
+        h = self.to_out[1](self.to_out[0](h))
+        return h.reshape(b, hh, ww, c).permute(0, 3, 1, 2) + residual
+
+
+class TemporalDecoderRef(nn.Module):
+    """diffusers TemporalDecoder (the SVD snapshot's VAE decoder)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        chans = list(cfg.block_out_channels)
+        self.conv_in = nn.Conv2d(cfg.latent_channels, chans[-1], 3,
+                                 padding=1)
+
+        class _Mid(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.resnets = nn.ModuleList(
+                    [VaeSTBlockRef(chans[-1], chans[-1])
+                     for _ in range(cfg.layers_per_block)])
+                self.attentions = nn.ModuleList(
+                    [VaeMidAttentionRef(chans[-1])])
+
+        self.mid_block = _Mid()
+        up = []
+        x_ch = chans[-1]
+        for i, ch in enumerate(reversed(chans)):
+            resnets = nn.ModuleList(
+                [VaeSTBlockRef(x_ch if j == 0 else ch, ch)
+                 for j in range(cfg.layers_per_block + 1)])
+
+            class _Block(nn.Module):
+                pass
+
+            block = _Block()
+            block.resnets = resnets
+            if i < len(chans) - 1:          # add_upsample on all but last
+                block.upsamplers = nn.ModuleList([Upsample2D(ch)])
+            up.append(block)
+            x_ch = ch
+        self.up_blocks = nn.ModuleList(up)
+        self.conv_norm_out = nn.GroupNorm(_groups(chans[0]), chans[0],
+                                          eps=1e-6)
+        self.conv_out = nn.Conv2d(chans[0], cfg.in_channels, 3, padding=1)
+        self.time_conv_out = nn.Conv3d(cfg.in_channels, cfg.in_channels,
+                                       (3, 1, 1), padding=(1, 0, 0))
+
+    def forward(self, z, num_frames):
+        # z (B, F, C, H, W) unscaled latents -> (B, F, 3, H*8, W*8)
+        b, f = z.shape[:2]
+        x = self.conv_in(z.reshape(b * f, *z.shape[2:]))
+        m = self.mid_block
+        x = m.resnets[0](x, f)
+        x = m.attentions[0](x)
+        x = m.resnets[1](x, f)
+        for block in self.up_blocks:
+            for resnet in block.resnets:
+                x = resnet(x, f)
+            if hasattr(block, "upsamplers"):
+                x = block.upsamplers[0](x)
+        x = self.conv_out(F.silu(self.conv_norm_out(x)))
+        c, hh, ww = x.shape[1:]
+        x5 = x.reshape(b, f, c, hh, ww).permute(0, 2, 1, 3, 4)
+        x5 = self.time_conv_out(x5)
+        return x5.permute(0, 2, 1, 3, 4)
+
+
+def randomize_(model: nn.Module, seed: int, scale: float = 0.15) -> None:
+    """Replace every parameter (including the published zero inits and
+    norm affines) with seeded random values so conversion parity is
+    meaningful for all leaves."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in model.parameters():
+            p.copy_(torch.randn(p.shape, generator=gen) * scale)

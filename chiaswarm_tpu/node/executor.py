@@ -22,6 +22,8 @@ import os
 import threading
 from typing import Any
 
+import numpy as np
+
 from chiaswarm_tpu import WORKER_VERSION
 from chiaswarm_tpu.node.job_args import format_args
 from chiaswarm_tpu.node.output_processor import (
@@ -151,7 +153,16 @@ def synchronous_do_work(job: dict[str, Any], slot,
 def _coalesce_key(kwargs: dict[str, Any]):
     from chiaswarm_tpu.workloads.diffusion import COALESCE_KEYS
 
-    return ((kwargs.get("model_name"),)
+    # img2img/inpaint coalesce only with matching modes AND pixel grids:
+    # the height/width kwargs may be absent for image jobs (the callback
+    # takes the image's own size), so key on the fetched image AND mask
+    # shapes (mask sizes are free-form solo — the pipeline resizes — so
+    # presence alone would group unstackable masks)
+    image = kwargs.get("image")
+    mask = kwargs.get("mask_image")
+    return ((kwargs.get("model_name"),
+             None if image is None else tuple(np.asarray(image).shape),
+             None if mask is None else tuple(np.asarray(mask).shape))
             + tuple(repr(kwargs.get(k)) for k in COALESCE_KEYS))
 
 
@@ -168,14 +179,22 @@ def job_rows(job_or_kwargs: dict[str, Any]) -> int:
 
 def single_chip_rows(kwargs: dict[str, Any]) -> int:
     """How many batch rows ONE device profitably carries for this job
-    class. Measured (BASELINE.md r4): 512px-class programs are not
-    MXU-saturated at batch 1 — batch 4 reaches +20% images/sec on one
-    chip and the gain plateaus there; 1024px-class is saturated at
-    batch 1 (r1). Jobs without an explicit size are assumed large."""
+    class. Measured (BASELINE.md r4) on the DIFFUSION workflows — the
+    only job class reaching this via _burst_key/coalescable, so the rule
+    cannot leak onto unbenched classes (ADVICE r4 #4): 512px-class
+    programs are not MXU-saturated at batch 1 — batch 4 reaches +20%
+    images/sec on one chip and the gain plateaus there; 1024px-class is
+    saturated at batch 1 (r1). Size comes from the explicit kwargs or,
+    for img2img/inpaint jobs that take the image's own grid, the fetched
+    image shape; otherwise assumed large."""
     try:
         h, w = int(kwargs.get("height") or 0), int(kwargs.get("width") or 0)
     except (TypeError, ValueError):
         return 1
+    if not (h and w):
+        image = kwargs.get("image")
+        if image is not None and getattr(image, "ndim", 0) >= 2:
+            h, w = int(image.shape[0]), int(image.shape[1])
     return 4 if 0 < h * w <= 512 * 512 else 1
 
 
@@ -276,6 +295,10 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                 "num_images_per_prompt":
                     kwargs.get("num_images_per_prompt", 1),
                 "seed": draw_seed() if seed is None else int(seed),
+                # per-job init/mask images (img2img/inpaint coalescing;
+                # shapes/presence are uniform across the group by key)
+                "image": kwargs.get("image"),
+                "mask_image": kwargs.get("mask_image"),
                 # solo-equivalence: an absent content_type must hit the
                 # same default the solo callback uses (image/png), NOT
                 # _format's error-payload jpeg default

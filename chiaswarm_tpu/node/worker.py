@@ -46,27 +46,36 @@ log = logging.getLogger("chiaswarm.worker")
 def _burst_key(job: dict) -> tuple | None:
     """Cheap raw-job coalescability key (None = never coalesce).
 
-    Conservative pre-filter for the slot burst drain: only plain txt2img
-    jobs with identical static fields are drained together — the
-    executor's precise post-formatting grouping
-    (node/executor.py::synchronous_do_work_batch) is the authority; this
-    just keeps non-coalescable traffic on the per-job path so its
-    results upload as soon as each job finishes."""
-    if job.get("workflow") not in (None, "", "txt2img"):
-        return None
-    if job.get("start_image_uri") or job.get("mask_image_uri") \
-            or job.get("image") is not None:
+    Conservative pre-filter for the slot burst drain: plain txt2img,
+    img2img and inpaint jobs with identical static fields are drained
+    together (images themselves differ per job by design — per-job init
+    stacks + encode seeds keep solo equality) — the executor's precise
+    post-formatting grouping (node/executor.py::
+    synchronous_do_work_batch) is the authority (it also sees the FETCHED
+    image shapes, which this pre-filter cannot); this just keeps
+    non-coalescable traffic on the per-job path so its results upload as
+    soon as each job finishes."""
+    if job.get("workflow") not in (None, "", "txt2img", "img2img",
+                                   "inpaint"):
         return None
     model = str(job.get("model_name", ""))
-    if model.startswith("DeepFloyd/"):
+    if model.startswith("DeepFloyd/") or "pix2pix" in model:
         return None
     params = job.get("parameters") or {}
     if params.get("controlnet") or params.get("upscale"):
         return None
+    image = job.get("image")
     return (model, job.get("height"), job.get("width"),
             job.get("num_inference_steps"), job.get("guidance_scale"),
             job.get("lora"), job.get("textual_inversion"),
             job.get("cross_attention_scale"),
+            # mode split: generation vs img2img vs inpaint (+ inline
+            # image grids; URI-fetched sizes are the executor's job)
+            bool(job.get("start_image_uri") or image is not None),
+            bool(job.get("mask_image_uri")
+                 or job.get("mask_image") is not None),
+            job.get("strength"),
+            None if image is None else tuple(getattr(image, "shape", ())),
             repr(sorted(params.items())))
 
 

@@ -87,6 +87,12 @@ class GenerateRequest:
     init_image: np.ndarray | None = None   # (H, W, 3) uint8 or float [-1,1]
     strength: float = 0.8
     mask: np.ndarray | None = None         # (H, W) float, 1 = regenerate
+    # coalesced img2img/inpaint: ``init_image`` is a per-JOB (J, H, W, 3)
+    # stack (``mask`` a per-JOB (J, H, W) stack) and init_groups[j] =
+    # (encode_seed, n_rows) — job j's image is VAE-encoded with ITS OWN
+    # seed through the same batch-1 executable its solo run uses (bitwise
+    # solo equality by construction), then repeated over its rows
+    init_groups: tuple[tuple[int, int], ...] | None = None
     tiled_decode: bool = False
     # ControlNet (swarm/diffusion/diffusion_func.py:29-39)
     controlnet: Any = None                 # ControlNetBundle
@@ -475,7 +481,17 @@ class DiffusionPipeline:
             if init.ndim == 4 and init.shape[1:3] != (height, width) or \
                init.ndim == 3 and init.shape[:2] != (height, width):
                 init = _resize_batch(init, height, width)
-            z = self.encode_init_image(init, height, width, req.seed)
+            if req.init_groups is not None:
+                # coalesced jobs: encode each job's image with ITS seed
+                # through the batch-1 executable its solo run uses, then
+                # repeat over that job's rows — bitwise solo equality
+                z = jnp.concatenate([
+                    jnp.repeat(self.encode_init_image(
+                        init[j], height, width, enc_seed), n_rows, axis=0)
+                    for j, (enc_seed, n_rows)
+                    in enumerate(req.init_groups)], axis=0)
+            else:
+                z = self.encode_init_image(init, height, width, req.seed)
             if z.shape[0] == 1:
                 init_latent = jnp.repeat(z, batch, axis=0)
             elif z.shape[0] == batch:
@@ -485,20 +501,36 @@ class DiffusionPipeline:
                 init_latent = jnp.concatenate([z, pad], axis=0)
         if has_mask:
             lh, lw = self._latent_hw(height, width)
-            m = np.asarray(req.mask, dtype=np.float32)
-            if m.shape != (lh, lw):
-                f = fam.vae.downscale
-                if m.shape != (lh * f, lw * f):
-                    # bring arbitrary mask sizes onto the bucketed pixel grid
-                    from PIL import Image
 
-                    m = np.asarray(Image.fromarray(
-                        (m * 255).clip(0, 255).astype(np.uint8)
-                    ).resize((lw * f, lh * f), Image.NEAREST),
-                        dtype=np.float32) / 255.0
-                # downsample to the latent grid by box-averaging
-                m = m.reshape(lh, f, lw, f).mean((1, 3))
-            mask_arr = jnp.asarray((m > 0.5).astype(np.float32))[None, :, :, None]
+            def latent_mask(m: np.ndarray) -> np.ndarray:
+                if m.shape != (lh, lw):
+                    f = fam.vae.downscale
+                    if m.shape != (lh * f, lw * f):
+                        # bring arbitrary mask sizes onto the bucketed
+                        # pixel grid
+                        from PIL import Image
+
+                        m = np.asarray(Image.fromarray(
+                            (m * 255).clip(0, 255).astype(np.uint8)
+                        ).resize((lw * f, lh * f), Image.NEAREST),
+                            dtype=np.float32) / 255.0
+                    # downsample to the latent grid by box-averaging
+                    m = m.reshape(lh, f, lw, f).mean((1, 3))
+                return (m > 0.5).astype(np.float32)
+
+            m = np.asarray(req.mask, dtype=np.float32)
+            if req.init_groups is not None:
+                # per-JOB masks -> per-row stack, padded to the bucket
+                rows_m = np.concatenate([
+                    np.repeat(latent_mask(m[j])[None], n_rows, axis=0)
+                    for j, (_, n_rows) in enumerate(req.init_groups)])
+                if rows_m.shape[0] < batch:
+                    rows_m = np.concatenate(
+                        [rows_m, np.repeat(rows_m[-1:],
+                                           batch - rows_m.shape[0], 0)])
+                mask_arr = jnp.asarray(rows_m)[:, :, :, None]
+            else:
+                mask_arr = jnp.asarray(latent_mask(m))[None, :, :, None]
 
         has_control = req.controlnet is not None
         control_params = {"zero": jnp.zeros((1,), jnp.float32)}

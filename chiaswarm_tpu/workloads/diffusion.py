@@ -168,9 +168,13 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
 
 COALESCE_KEYS = ("num_inference_steps", "guidance_scale", "height",
                  "width", "scheduler_type", "textual_inversion", "lora",
-                 "cross_attention_scale")
-_UNCOALESCABLE = ("image", "mask_image", "controlnet_model_name",
-                  "image_guidance_scale")
+                 "cross_attention_scale", "strength")
+# ControlNet conditions on the image (different program); pix2pix jobs
+# carry image_guidance_scale (dual-CFG family, kept solo). Plain img2img
+# and inpaint DO coalesce since r5: per-job init stacks + per-job
+# VAE-encode seeds keep every job's images equal to its solo run
+# (pipelines/diffusion.py GenerateRequest.init_groups).
+_UNCOALESCABLE = ("controlnet_model_name", "image_guidance_scale")
 
 
 def coalescable(kwargs: dict[str, Any]) -> bool:
@@ -213,21 +217,50 @@ def diffusion_coalesced_callback(slot, model_name: str, *, seed: int,
         lora_scale=opt("cross_attention_scale", 1.0),
         mesh=getattr(slot, "mesh", None))
     fam = pipe.c.family
-    height = int(opt("height", fam.default_size))
-    width = int(opt("width", fam.default_size))
+
+    # img2img/inpaint: per-JOB init/mask stacks + per-job encode seeds
+    # (the executor's coalesce key guarantees uniform image shapes and
+    # mask presence across the group)
+    has_img = first.get("image") is not None
+    init_stack = mask_stack = init_groups = None
+    if has_img:
+        if fam.image_conditioned:
+            # pix2pix-family jobs are excluded upstream; a miss here must
+            # fall back to the per-job path, not mis-serve dual CFG
+            raise ValueError("image-conditioned (pix2pix) jobs do not "
+                             "coalesce")
+        init_stack = np.stack([np.asarray(j["image"]) for j in jobs])
+        init_groups = tuple((int(j["seed"]), n)
+                            for j, n in zip(jobs, counts))
+        if first.get("mask_image") is not None:
+            masks = []
+            for job in jobs:
+                m = np.asarray(job["mask_image"], dtype=np.float32)
+                if m.ndim == 3:
+                    m = m.mean(axis=-1)
+                masks.append(m / 255.0 if m.max() > 1.0 else m)
+            mask_stack = np.stack(masks)
+        height, width = init_stack.shape[1:3]
+    else:
+        height = int(opt("height", fam.default_size))
+        width = int(opt("width", fam.default_size))
 
     req = GenerateRequest(
         prompt=tuple(prompts),
         negative_prompt=tuple(negs),
         steps=int(opt("num_inference_steps", 30)),
         guidance_scale=float(opt("guidance_scale", 7.5)),
-        height=height,
-        width=width,
+        height=int(height),
+        width=int(width),
         batch=len(prompts),
         seed=int(first["seed"]),
         sample_seed_rows=tuple(seed_rows),
         scheduler=shared.get("scheduler_type"),
-        tiled_decode=max(height, width) > 1024,
+        init_image=init_stack,
+        init_groups=init_groups,
+        strength=float(opt("strength", 0.75)),
+        mask=mask_stack,
+        tiled_decode=max(int(height), int(width)) > 1024,
     )
     t0 = time.perf_counter()
     images, base_config = pipe(req)

@@ -158,26 +158,32 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         ipipe = Img2VidPipeline(vc, attn_impl=attn)
         frames = 14 if on_tpu else 8
         steps = 25 if on_tpu else 2
-        size = 512 if on_tpu else 64
-        cond = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        # recorded shape = the PUBLISHED SVD serving portrait (576x1024,
+        # 14 frames, 25 steps — VERDICT r4 #6); the square 512 bucket
+        # stays as a secondary entry for cross-round continuity
+        shapes = ([("img2vid_svd", 576, 1024),
+                   ("img2vid_svd_512", 512, 512)] if on_tpu
+                  else [("img2vid_svd", 64, 64)])
+        for name, bh, bw in shapes:
+            cond = rng.integers(0, 255, (bh, bw, 3), dtype=np.uint8)
 
-        def irun(seed: int) -> float:
-            t0 = time.perf_counter()
-            out, _ = ipipe(cond, num_frames=frames, steps=steps,
-                           height=size, width=size, seed=seed)
-            assert out.shape[0] == frames
-            return time.perf_counter() - t0
+            def irun(seed: int) -> float:
+                t0 = time.perf_counter()
+                out, _ = ipipe(cond, num_frames=frames, steps=steps,
+                               height=bh, width=bw, seed=seed)
+                assert out.shape[0] == frames
+                return time.perf_counter() - t0
 
-        irun(0)
-        times = [irun(i + 1) for i in range(iters)]
-        p50 = _percentile50(times)
-        results["img2vid_svd"] = {
-            "p50_latency_s": round(p50, 3),
-            "frames": frames,
-            "steps": steps,
-            "size": size,
-            "frames_per_sec": round(frames / p50, 4),
-        }
+            irun(0)
+            times = [irun(i + 1) for i in range(iters)]
+            p50 = _percentile50(times)
+            results[name] = {
+                "p50_latency_s": round(p50, 3),
+                "frames": frames,
+                "steps": steps,
+                "size": [bh, bw],
+                "frames_per_sec": round(frames / p50, 4),
+            }
         del ipipe, vc
 
     if "txt2vid" in names:

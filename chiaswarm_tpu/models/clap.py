@@ -40,7 +40,12 @@ class ClapTextConfig:
     bos_token_id: int = 0
     eos_token_id: int = 2
     projection_dim: int = 512
-    max_length: int = 77          # static prompt length served by the node
+    # static prompt length served by the node — the reference tokenizes at
+    # RobertaTokenizer's model_max_length (512); padding is masked, so
+    # short prompts embed identically and long prompts are no longer
+    # truncated at ~75 tokens (ADVICE r4 #3). One compile bucket either
+    # way, and the 512-token text encode is trivial next to the UNet scan.
+    max_length: int = 512
     dtype: str = "float32"
 
 

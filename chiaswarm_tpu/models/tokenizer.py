@@ -172,10 +172,27 @@ def _bytes_to_unicode() -> dict[int, str]:
     return {b: chr(c) for b, c in zip(bs, cs)}
 
 
-_GPT2_WORD_RE = re.compile(
-    r"'s|'t|'re|'ve|'m|'ll|'d| ?[A-Za-z]+| ?[0-9]+| ?[^\sA-Za-z0-9]+"
-    r"|\s+(?!\S)|\s+"
-)
+# the EXACT GPT-2/RoBERTa pre-tokenization pattern (transformers'
+# RobertaTokenizer): unicode letter/number classes, so accented and CJK
+# prompts split into the same spans (ADVICE r4 #1 — the earlier
+# ASCII-only classes silently produced different token ids for them).
+# The `regex` module provides \p{L}/\p{N}; plain `re` classes are the
+# fallback ([^\W\d_] is re's unicode-letter idiom).
+try:
+    import regex as _regex
+
+    _GPT2_WORD_RE = _regex.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+"
+        r"|\s+(?!\S)|\s+"
+    )
+except ImportError:  # pragma: no cover
+    # best-effort re-only approximation: underscores ride the symbol
+    # class (as in the real pattern); non-decimal \p{N} numerics (e.g.
+    # superscripts) still split as symbols here — exactness needs `regex`
+    _GPT2_WORD_RE = re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+"
+        r"|\s+(?!\S)|\s+"
+    )
 
 
 class ByteLevelBpeTokenizer(AddedTokenMixin):
@@ -184,9 +201,10 @@ class ByteLevelBpeTokenizer(AddedTokenMixin):
     tower (RobertaTokenizer). Same file names as CLIP's BPE but a disjoint
     algorithm: case-sensitive, bytes mapped through the GPT-2 unicode
     table, space carried as a leading ``Ġ`` on the piece (no ``</w>``
-    suffix), RoBERTa ``<s>``/``</s>``/``<pad>`` specials. ASCII-oriented
-    pre-tokenization like :class:`ClipBpeTokenizer` (non-ASCII letters
-    fall through as symbol runs — byte-level, so nothing is dropped)."""
+    suffix), RoBERTa ``<s>``/``</s>``/``<pad>`` specials. Pre-tokenizes
+    with the exact GPT-2 unicode pattern (``\\p{L}``/``\\p{N}``), so
+    accented/CJK prompts form the same spans — and thus the same token
+    ids — as transformers' RobertaTokenizer."""
 
     def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]],
                  max_length: int = 77) -> None:

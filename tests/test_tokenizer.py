@@ -32,6 +32,11 @@ def _write_byte_level_vocab(path):
         ("h", "e"), ("l", "l"), ("he", "ll"), ("hell", "o"),
         ("Ġ", "w"), ("o", "r"), ("Ġw", "or"), ("Ġwor", "ld"),
         ("l", "o"), ("Ġ", "lo"),
+        # accented-word merges that CROSS the ASCII letter/symbol
+        # boundary ("café" -> "caf" + "é" under an ASCII-only
+        # pre-tokenizer): only the unicode \p{L} pattern keeps the word
+        # one span so these can apply (ADVICE r4 #1)
+        ("c", "a"), ("ca", "f"), ("caf", "Ã"), ("cafÃ", "©"),
     ]
     tokens = ["<s>", "<pad>", "</s>", "<unk>"] + alphabet + [
         a + b for a, b in merges]
@@ -46,6 +51,7 @@ def _write_byte_level_vocab(path):
 
 @pytest.mark.parametrize("text", [
     "hello world", "Hello, world!!", "lo lo hello", "world  hello ", "",
+    "café hello", "naïve café!", "東京 hello 123", "hello…café",
 ])
 def test_byte_level_bpe_matches_roberta_tokenizer(tmp_path, text):
     transformers = pytest.importorskip("transformers")

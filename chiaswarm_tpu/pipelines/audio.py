@@ -88,10 +88,12 @@ AUDIOLDM = AudioFamily(
 
 TINY_AUDIO = AudioFamily(
     name="tiny_audio",
+    # max_length must fit the tiny 130-row position table (the class
+    # default is the published 512, which would silently clamp gathers)
     text_encoder=ClapTextConfig(
         vocab_size=1000, hidden_size=32, intermediate_size=64,
         num_layers=2, num_heads=4, projection_dim=32,
-        max_position_embeddings=130),
+        max_position_embeddings=130, max_length=77),
     unet=UNetConfig(
         sample_channels=8, out_channels=8,
         block_out_channels=(32, 64), layers_per_block=1,

@@ -362,17 +362,19 @@ class VideoComponents:
         unet = make_video_unet(family)
         vae = make_video_vae(family)
         state = read_torch_weights(root / "unet")
+        if family.image_conditioned and \
+                not any(".spatial_res_block." in k for k in state):
+            # fail BEFORE the (multi-second) abstract init trace
+            raise ValueError(
+                f"{model_name}: not an SVD-class spatio-temporal UNet "
+                f"snapshot (no spatial_res_block keys). Image-"
+                f"conditioned families cannot be 2D-inflated — the "
+                f"published UNetSpatioTemporalConditionModel layout "
+                f"is required.")
         shapes = jax.eval_shape(unet.init, jax.random.PRNGKey(0),
                                 *_unet_init_args(family))
 
         if family.image_conditioned:
-            if not any(".spatial_res_block." in k for k in state):
-                raise ValueError(
-                    f"{model_name}: not an SVD-class spatio-temporal UNet "
-                    f"snapshot (no spatial_res_block keys). Image-"
-                    f"conditioned families cannot be 2D-inflated — the "
-                    f"published UNetSpatioTemporalConditionModel layout "
-                    f"is required.")
             unet_p = _strict_match(
                 shapes, convert_unet_spatio_temporal(state, family.unet),
                 model_name)

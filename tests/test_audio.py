@@ -49,6 +49,7 @@ def test_vocoder_shapes():
     assert np.abs(np.asarray(wav)).max() <= 1.0
 
 
+@pytest.mark.slow
 def test_txt2audio_pipeline(tiny_audio):
     wav, sr, config = tiny_audio("rain on a tin roof", steps=2,
                                  duration_s=0.05, seed=3)

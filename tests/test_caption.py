@@ -163,6 +163,7 @@ def test_blip_conversion_matches_torch():
     np.testing.assert_allclose(np.asarray(fl), tl, atol=5e-4, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_blip_cached_decode_matches_full_forward():
     """The scan-decode KV ring must produce the same logits as a full
     causal forward at every position (prefill+step == one-shot)."""

@@ -89,6 +89,7 @@ def test_cascade_two_stage_generation(tiny_cascade):
     assert not np.array_equal(img, img3)
 
 
+@pytest.mark.slow
 def test_cascade_workload_dispatch():
     """format_args routes DeepFloyd/ names to the cascade callback, which
     produces artifacts (upscale off to keep it tiny-model only)."""

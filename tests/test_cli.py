@@ -11,6 +11,7 @@ from chiaswarm_tpu.node.smoke import SMOKE_JOBS, run_smoke
 from tests.fake_hive import FakeHive
 
 
+@pytest.mark.slow
 def test_smoke_txt2img_ok():
     result = run_smoke("txt2img")
     assert "error" not in result["pipeline_config"]

@@ -168,6 +168,7 @@ def test_inpaint_jobs_coalesce_with_distinct_masks(registry):
         diff.max(), (diff <= 1).mean())
 
 
+@pytest.mark.slow
 def test_burst_with_formatting_error_still_returns_all(registry):
     jobs = [_job(0), _job(1, height=9999, width=9999), _job(2)]
     pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 4, "model": 2}))
@@ -178,6 +179,7 @@ def test_burst_with_formatting_error_still_returns_all(registry):
     assert by_id["j0"]["pipeline_config"]["coalesced"] == 2
 
 
+@pytest.mark.slow
 def test_worker_coalesces_queue_burst(registry):
     """Full worker loop on a dp=4 mesh slot: a burst of four compatible
     jobs arrives in one poll; the slot merges them into one program
@@ -468,6 +470,7 @@ def test_coalesced_default_content_type_is_png(registry):
         assert cfg["batch_images_per_sec"] >= cfg["images_per_sec"]
 
 
+@pytest.mark.slow
 def test_single_chip_slot_batches_small_jobs(registry):
     """A data_width=1 slot merges 512px-class jobs into one batched
     program — one chip is not saturated by them at batch 1 (+20%

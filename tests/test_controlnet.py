@@ -125,6 +125,7 @@ def test_convert_controlnet_naming():
     assert net["mid_resnets_0"]["conv1"]["kernel"].shape == (3, 3, 64, 64)
 
 
+@pytest.mark.slow
 def test_controlnet_residual_count_matches_unet_skips(tiny_controlnet):
     """The control branch must emit exactly one residual per UNet skip."""
     import jax
@@ -154,6 +155,7 @@ def test_controlnet_residual_count_matches_unet_skips(tiny_controlnet):
     assert mid.shape[-1] == cfg.block_out_channels[-1]
 
 
+@pytest.mark.slow
 def test_workload_controlnet_echo_artifact():
     """diffusion_callback with controlnet_model_name: conditioning steers a
     txt2img pass and the preprocessed input echoes back as an artifact."""

@@ -45,6 +45,7 @@ def test_checkpoint_roundtrip(tmp_path, family):
             )
 
 
+@pytest.mark.slow
 def test_converted_checkpoint_generates(tmp_path):
     src = Components.random("tiny", seed=3)
     write_checkpoint(tmp_path, src)

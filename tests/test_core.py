@@ -99,6 +99,7 @@ def test_split_mesh_partitions_devices():
         split_mesh(mesh, 3)  # 8 devices do not split three ways
 
 
+@pytest.mark.slow
 def test_worker_default_pool_derives_tp_for_big_families(monkeypatch):
     """A stock 8-device worker with an SDXL-class catalog builds a
     dp=4 x tp=2 slot WITHOUT any hand-written mesh_shape; a small-model

@@ -63,8 +63,8 @@ def test_unet_forward_tiny():
     x = jnp.zeros((2, 8, 8, 4))
     t = jnp.array([10.0, 500.0])
     ctx = jnp.zeros((2, 77, TINY.unet.cross_attention_dim))
-    params = unet.init(jax.random.PRNGKey(0), x, t, ctx)
-    out = unet.apply(params, x, t, ctx)
+    params = jax.jit(unet.init)(jax.random.PRNGKey(0), x, t, ctx)
+    out = jax.jit(unet.apply)(params, x, t, ctx)
     assert out.shape == (2, 8, 8, 4)
     assert np.isfinite(np.asarray(out)).all()
 

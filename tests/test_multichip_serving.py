@@ -5,6 +5,7 @@ Runs on the virtual 8-device CPU mesh (tests/conftest.py).
 """
 
 import numpy as np
+import pytest
 
 from chiaswarm_tpu.core.chip_pool import ChipPool
 from chiaswarm_tpu.core.mesh import MeshSpec
@@ -12,6 +13,7 @@ from chiaswarm_tpu.node.registry import ModelRegistry
 from chiaswarm_tpu.workloads.diffusion import diffusion_callback
 
 
+@pytest.mark.slow
 def test_multichip_slot_shards_params_and_generates():
     import jax
 
@@ -40,6 +42,7 @@ def test_multichip_slot_shards_params_and_generates():
     assert single is not pipe
 
 
+@pytest.mark.slow
 def test_multichip_matches_single_chip_output():
     """Sharded serving must agree with single-chip up to partitioned-
     reduction rounding (XLA reorders float reductions across shards, so
@@ -149,6 +152,7 @@ def test_dp_sharding_reduces_per_device_flops():
     assert f_dp < 0.5 * f_base, (f_dp, f_base)
 
 
+@pytest.mark.slow
 def test_img2vid_tensor_parallel_matches_single_chip():
     """SVD-class img2vid under Megatron tp sharding (the video UNet's
     spatial blocks share the 2D UNet's module names, so the conv/attention

@@ -218,6 +218,7 @@ def test_worker_end_to_end(registry):
     asyncio.run(scenario())
 
 
+@pytest.mark.slow
 def test_worker_e2e_runs_real_safety_checker(registry, tmp_path,
                                              monkeypatch):
     """Full worker loop with a PROVISIONED checker: a tiny converted

@@ -24,6 +24,7 @@ from chiaswarm_tpu.models.openpose import (
 )
 
 
+@pytest.mark.slow
 def test_network_output_shapes():
     det = OpenposeDetector.random(seed=0)
     import jax.numpy as jnp
@@ -173,6 +174,7 @@ def test_assembly_connects_synthetic_limb():
     assert canvas[:20].sum() == 0
 
 
+@pytest.mark.slow
 def test_end_to_end_random_weights_runs():
     det = OpenposeDetector.random(seed=1)
     img = (np.random.RandomState(0).rand(96, 72, 3) * 255).astype(np.uint8)

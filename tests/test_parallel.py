@@ -6,6 +6,7 @@ strategy from SURVEY.md §4."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -131,6 +132,7 @@ def test_partition_specs_hit_attention_weights():
                    for p in model_sharded)
 
 
+@pytest.mark.slow
 def test_tensor_parallel_pipeline_matches_replicated(mesh8):
     """Same request, params replicated vs sharded dp=4 x tp=2 — same pixels."""
     c = Components.random("tiny", seed=3)

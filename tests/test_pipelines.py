@@ -43,6 +43,7 @@ def test_txt2img_guidance_no_recompile(tiny_pipeline):
     assert after - before <= 1  # same executable for both guidance values
 
 
+@pytest.mark.slow
 def test_txt2img_batch_and_odd_size(tiny_pipeline):
     req = GenerateRequest(prompt="x", steps=2, height=70, width=60, batch=3,
                           seed=5)
@@ -52,6 +53,7 @@ def test_txt2img_batch_and_odd_size(tiny_pipeline):
     assert config["compiled_size"] == [128, 64]  # snapped to lattice
 
 
+@pytest.mark.slow
 def test_init_noise_override_controls_trajectory(tiny_pipeline):
     """GenerateRequest.init_noise (the golden-parity hook,
     tests/test_real_checkpoint.py): a pinned standard-normal initial
@@ -148,6 +150,7 @@ def test_sdxl_family_pipeline(tiny_xl_pipeline):
     assert config["family"] == "tiny_xl"
 
 
+@pytest.mark.slow
 def test_scheduler_name_routing(tiny_pipeline):
     for name, kind in [("EulerDiscreteScheduler", "euler"),
                        ("DDIMScheduler", "ddim"),

@@ -56,6 +56,7 @@ def test_pix2pix_requires_image(tiny_p2p):
         tiny_p2p(GenerateRequest(prompt="x", steps=2, height=64, width=64))
 
 
+@pytest.mark.slow
 def test_workload_pix2pix_no_strength_remap():
     """With an image_conditioned family, image_guidance_scale drives dual
     CFG directly instead of being folded into img2img strength."""

@@ -25,6 +25,7 @@ def test_added_token_splitting():
         HashTokenizer(vocab_size=100, max_length=16).encode("a plain cat")
 
 
+@pytest.mark.slow
 def test_apply_textual_inversion_changes_generation():
     c = Components.random("tiny", seed=0)
     hidden = c.params["text_encoder_0"]["params"][

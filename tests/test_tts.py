@@ -24,6 +24,7 @@ def tiny_tts():
     return TTSPipeline(TTSComponents.random("tiny_tts", seed=0))
 
 
+@pytest.mark.slow
 def test_gpt_cached_decode_matches_full_forward():
     """Incremental KV-cache decode must produce the same logits as a full
     forward over the whole sequence (the cache-correctness invariant)."""
@@ -54,6 +55,7 @@ def test_gpt_cached_decode_matches_full_forward():
                                    np.asarray(full_logits[:, t]), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_gpt_generate_deterministic():
     import jax
     import jax.numpy as jnp
@@ -78,6 +80,7 @@ def test_gpt_generate_deterministic():
     assert not np.array_equal(np.asarray(out1), np.asarray(out3))
 
 
+@pytest.mark.slow
 def test_codec_decoder_shapes():
     import jax
     import jax.numpy as jnp

@@ -46,6 +46,7 @@ def _hf_tiny():
     return torch, model
 
 
+@pytest.mark.slow
 def test_upernet_conversion_matches_torch():
     torch, hf = _hf_tiny()
     import jax.numpy as jnp

@@ -68,6 +68,7 @@ def test_x4_quadruples_size(tiny_upscaler4):
     assert not np.array_equal(out, out3)
 
 
+@pytest.mark.slow
 def test_upscale_doubles_size(tiny_upscaler):
     rng = np.random.default_rng(3)
     img = rng.integers(0, 255, (1, 64, 64, 3), dtype=np.uint8)

@@ -69,8 +69,8 @@ def test_unet3d_forward_parity():
         want = tm(torch.from_numpy(sample.transpose(0, 4, 1, 2, 3)),
                   torch.from_numpy(t), torch.from_numpy(ctx)).numpy()
     unet = make_video_unet(fam)
-    got = unet.apply(params, jnp.asarray(sample), jnp.asarray(t),
-                     jnp.asarray(ctx))
+    got = jax.jit(unet.apply)(params, jnp.asarray(sample), jnp.asarray(t),
+                              jnp.asarray(ctx))
     np.testing.assert_allclose(np.asarray(got),
                                want.transpose(0, 2, 3, 4, 1),
                                atol=3e-4, rtol=3e-4)
@@ -99,8 +99,9 @@ def test_unet_spatio_temporal_forward_parity():
                   torch.from_numpy(t), torch.from_numpy(ctx),
                   torch.from_numpy(ids)).numpy()
     unet = make_video_unet(fam)
-    got = unet.apply(params, jnp.asarray(sample), jnp.asarray(t),
-                     jnp.asarray(ctx), {"time_ids": jnp.asarray(ids)})
+    got = jax.jit(unet.apply)(params, jnp.asarray(sample), jnp.asarray(t),
+                              jnp.asarray(ctx),
+                              {"time_ids": jnp.asarray(ids)})
     np.testing.assert_allclose(np.asarray(got),
                                want.transpose(0, 1, 3, 4, 2),
                                atol=3e-4, rtol=3e-4)
@@ -147,7 +148,8 @@ def test_temporal_vae_decoder_forward_parity():
                    ).astype(np.float32)
     with torch.no_grad():
         want = tm(torch.from_numpy(z.transpose(0, 1, 4, 2, 3)), 3).numpy()
-    got = TemporalVaeDecoder(fam.vae).apply(params, jnp.asarray(z))
+    got = jax.jit(TemporalVaeDecoder(fam.vae).apply)(params,
+                                                      jnp.asarray(z))
     np.testing.assert_allclose(np.asarray(got),
                                want.transpose(0, 1, 3, 4, 2),
                                atol=3e-4, rtol=3e-4)
@@ -237,6 +239,7 @@ def test_modelscope_snapshot_loads_trained_temporal_weights(tmp_path):
     assert config["mode"] == "txt2vid"
 
 
+@pytest.mark.slow
 def test_svd_snapshot_end_to_end_load_path(tmp_path):
     """A full spatio-temporal snapshot (unet + image_encoder + vae)
     loads strictly and renders an img2vid clip."""

@@ -55,6 +55,7 @@ def test_inflated_temporal_layers_are_framewise_identity(tmp_path):
                                    np.asarray(out[:, i]), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_txt2vid_pipeline(tiny_vid):
     frames, config = tiny_vid("a drifting boat", num_frames=6, steps=2,
                               seed=4, height=64, width=64)
@@ -66,6 +67,7 @@ def test_txt2vid_pipeline(tiny_vid):
     assert np.array_equal(frames, frames2)
 
 
+@pytest.mark.slow
 def test_txt2vid_workload_emits_video():
     from chiaswarm_tpu.node.job_args import format_args
     from chiaswarm_tpu.node.registry import ModelRegistry
@@ -86,6 +88,7 @@ def test_txt2vid_workload_emits_video():
     assert len(blob) > 100  # a real container, not an empty file
 
 
+@pytest.mark.slow
 def test_video_inflation_matches_2d_parent_at_frame1(tmp_path):
     """2D-inflation load: spatial weights graft from an SD-style snapshot
     and the fresh temporal layers are identity, so the video UNet at F=1
@@ -217,16 +220,15 @@ def test_img2vid_workload_emits_video(tmp_path, monkeypatch):
     assert art["blob"] and art["thumbnail"]
 
 
-def test_svd_edm_schedule_tables(monkeypatch):
-    """The img2vid denoise must run the published SVD schedule: karras
-    sigmas spanning (0.002, 700), a trailing zero, and 0.25*log(sigma)
-    conditioning (diffusers EulerDiscrete timestep_type="continuous") —
-    asserted on make_edm_schedule's own output AND on the pipeline
-    actually requesting it with the family's range."""
+def test_svd_edm_schedule_tables():
+    """The published SVD schedule: karras sigmas spanning (0.002, 700),
+    a trailing zero, and 0.25*log(sigma) conditioning (diffusers
+    EulerDiscrete timestep_type="continuous") on make_edm_schedule's own
+    output (pure table math — the pipeline wiring is the slow-tier
+    test below)."""
     import numpy as np
 
     import chiaswarm_tpu.schedulers.sampling as sampling
-    from chiaswarm_tpu.pipelines.video import Img2VidPipeline, VideoComponents
 
     sched = sampling.make_edm_schedule(0.002, 700.0, 10)
     sig = np.asarray(sched.sigmas)
@@ -236,6 +238,16 @@ def test_svd_edm_schedule_tables(monkeypatch):
     assert (np.diff(sig) < 0).all()
     np.testing.assert_allclose(np.asarray(sched.timesteps),
                                0.25 * np.log(sig[:-1]), rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_svd_pipeline_requests_edm_schedule(monkeypatch):
+    """The img2vid pipeline actually builds its denoise on the family's
+    EDM range."""
+    import numpy as np
+
+    import chiaswarm_tpu.schedulers.sampling as sampling
+    from chiaswarm_tpu.pipelines.video import Img2VidPipeline, VideoComponents
 
     import chiaswarm_tpu.pipelines.video as video_mod
 

@@ -291,6 +291,13 @@ def main() -> None:
                         help="profile the combined ControlNet+UNet program "
                              "(BASELINE.json config #4) instead of the base "
                              "generate program")
+    parser.add_argument("--img2vid", action="store_true",
+                        help="profile the SVD img2vid program (config #5: "
+                             "spatio-temporal UNet + temporal-decoder VAE) "
+                             "at --size x --size; use --width for the "
+                             "published 576x1024 portrait")
+    parser.add_argument("--width", type=int, default=None)
+    parser.add_argument("--frames", type=int, default=14)
     args = parser.parse_args()
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -329,9 +336,40 @@ def main() -> None:
     diffusion_mod.toplevel_jit = capturing_toplevel_jit
 
     on_tpu = jax.default_backend() == "tpu"
-    family = args.family if on_tpu else "tiny"
     size = args.size if on_tpu else 64
     steps = args.steps if on_tpu else 2
+
+    if args.img2vid:
+        import numpy as np
+
+        import chiaswarm_tpu.pipelines.video as video_mod
+        from chiaswarm_tpu.pipelines.video import (
+            Img2VidPipeline,
+            VideoComponents,
+        )
+
+        video_mod.toplevel_jit = capturing_toplevel_jit
+        fam = "svd_img2vid" if on_tpu else "tiny_svd"
+        vc = VideoComponents.random_host(fam, seed=0)
+        vc.params = jax.device_put(vc.params, jax.devices()[0])
+        ipipe = Img2VidPipeline(vc)
+        height = size
+        width = args.width or size
+        frames = args.frames if on_tpu else 4
+        cond = np.random.default_rng(0).integers(
+            0, 255, (height, width, 3), dtype=np.uint8)
+        print(f"compiling img2vid {height}x{width} {frames}f {steps} "
+              f"steps ...", file=sys.stderr)
+        ipipe(cond, num_frames=frames, steps=steps, height=height,
+              width=width, seed=0)  # compile + warm
+        trace_dir = tempfile.mkdtemp(prefix="xplane_")
+        with jax.profiler.trace(trace_dir):
+            ipipe(cond, num_frames=frames, steps=steps, height=height,
+                  width=width, seed=0)
+        _report(trace_dir, executables, args, peak_tflops, peak_gbps)
+        return
+
+    family = args.family if on_tpu else "tiny"
 
     c = Components.random_host(family, seed=0)
     c.params = jax.device_put(c.params, jax.devices()[0])
@@ -358,6 +396,10 @@ def main() -> None:
     trace_dir = tempfile.mkdtemp(prefix="xplane_")
     with jax.profiler.trace(trace_dir):
         pipe(req)
+    _report(trace_dir, executables, args, peak_tflops, peak_gbps)
+
+
+def _report(trace_dir, executables, args, peak_tflops, peak_gbps) -> None:
     xplane = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
     if not xplane:
         raise FileNotFoundError("profiler produced no xplane.pb")
@@ -404,13 +446,24 @@ def main() -> None:
 
     conv_rows = [r for r in rows if r["kind"] in ("conv", "mixed")]
     conv_ms = sum(r["ms"] for r in conv_rows)
-    weighted_roof = (sum(r["roof_pct"] * r["ms"] for r in conv_rows)
-                     / max(conv_ms, 1e-9))
+    # a fusion whose static cost model exceeds its measured time by >1.2x
+    # is MIS-COSTED (e.g. a multi-conv fusion double-counted, or a
+    # rematerialized op the profiler books elsewhere) — folding it into
+    # the attainment average would report >100% nonsense; report it
+    # separately instead
+    sane = [r for r in conv_rows if r["roof_pct"] <= 120.0]
+    sane_ms = sum(r["ms"] for r in sane)
+    weighted_roof = (sum(r["roof_pct"] * r["ms"] for r in sane)
+                     / max(sane_ms, 1e-9))
+    n_miscosted = len(conv_rows) - len(sane)
 
     print(f"\ndevice op time total (containers excluded): "
           f"{total_ps * 1e-9:.1f} ms; conv fusions: {conv_ms:.1f} ms "
           f"({100 * conv_ms / max(total_ps * 1e-9, 1e-9):.0f}%), "
-          f"time-weighted conv roofline attainment: {weighted_roof:.0f}%")
+          f"time-weighted conv roofline attainment: {weighted_roof:.0f}% "
+          f"over {sane_ms:.1f} ms"
+          + (f" ({n_miscosted} fusions excluded as mis-costed, "
+             f"{conv_ms - sane_ms:.1f} ms)" if n_miscosted else ""))
     print(f"peaks: {peak_tflops:.0f} TFLOP/s, {peak_gbps:.0f} GB/s "
           f"(CHIASWARM_PEAK_TFLOPS/GBPS to override)\n")
     header = (f"{'op':<40} {'kind':>5} {'n':>4} {'ms':>8} {'GFLOP':>9} "

@@ -305,8 +305,9 @@ class ModelRegistry:
             components = None
             if ckpt.exists():
                 try:
-                    log.info("loading video model %s from %s (2D inflation)",
-                             model_name, ckpt)
+                    log.info("loading video model %s from %s (strict "
+                             "temporal conversion; 2D snapshots inflate "
+                             "for text families only)", model_name, ckpt)
                     components = VideoComponents.from_checkpoint(
                         ckpt, model_name, family)
                 except Exception as exc:

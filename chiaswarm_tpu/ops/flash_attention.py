@@ -58,8 +58,12 @@ _NEG_INF = -1e30  # finite stand-in: true -inf breaks exp() on fully-masked rows
 # allocation, so programs already under 16 MB compile identically. Raise
 # further for sweeps of bigger blocks (2048x2048, 4096x1024) on other
 # TPU generations; 0 = XLA's default cap.
-_DEFAULT_BLOCK_Q = int(os.environ.get("CHIASWARM_FLASH_BLOCK_Q", "2048"))
-_DEFAULT_BLOCK_KV = int(os.environ.get("CHIASWARM_FLASH_BLOCK_KV", "1024"))
+# an env-pinned block is an EXPLICIT sweep request: it bypasses the
+# divisibility auto-pick (the datapoint labeled 2048 must measure 2048)
+_ENV_BLOCK_Q = os.environ.get("CHIASWARM_FLASH_BLOCK_Q")
+_ENV_BLOCK_KV = os.environ.get("CHIASWARM_FLASH_BLOCK_KV")
+_DEFAULT_BLOCK_Q = int(_ENV_BLOCK_Q) if _ENV_BLOCK_Q else 2048
+_DEFAULT_BLOCK_KV = int(_ENV_BLOCK_KV) if _ENV_BLOCK_KV else 1024
 _VMEM_MB = int(os.environ.get("CHIASWARM_FLASH_VMEM_MB", "24"))
 _LANES = 128
 
@@ -110,6 +114,41 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0] = (acc_scr[:] / l_scr[:, :1]).astype(o_ref.dtype)
 
 
+def _clamp_block(length: int, block: int) -> int:
+    """Shrink a block to the 8-padded sequence length (small inputs)."""
+    return min(block, max(8, ((length + 7) // 8) * 8))
+
+
+def _pick_block(length: int, default: int) -> int:
+    """Auto block size for one attention axis: minimize the PADDED
+    length — masked block padding still runs on the MXU, so a
+    non-divisible tuned block wastes real time (the SVD portrait's
+    9216-token level padded to 10240 with 2048-blocks; its 2304-token
+    level to 4096/3072). Two guards keep the r2 sweep's findings intact:
+    candidates stop at 768 (the sweep measured small blocks ~75% slower
+    than large ones regardless of padding — a 256-divisible length must
+    not fall off that cliff), and a smaller block is taken only when it
+    saves >=5% of the default's padded length. Power-of-two SD/SDXL
+    shapes keep the tuned blocks bit-for-bit. Applied ONLY when neither
+    the caller nor the CHIASWARM_FLASH_BLOCK_* env knobs pin a block —
+    explicit sweep values are honored as requested."""
+    length8 = max(8, ((length + 7) // 8) * 8)
+    if length8 <= default:
+        return length8
+    pad_default = -(-length8 // default) * default
+    best_key, best = (pad_default, -default), default
+    for cand in (1536, 1280, 1024, 768):
+        if cand >= default:
+            continue
+        padded = -(-length8 // cand) * cand
+        if pad_default - padded < 0.05 * pad_default:
+            continue  # not worth leaving the tuned block
+        key = (padded, -cand)
+        if key < best_key:
+            best_key, best = key, cand
+    return best
+
+
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
     size = x.shape[axis]
     target = ((size + multiple - 1) // multiple) * multiple
@@ -130,8 +169,8 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     scale: float | None = None,
-    block_q: int = _DEFAULT_BLOCK_Q,
-    block_kv: int = _DEFAULT_BLOCK_KV,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Blockwise attention over (B, L, H, D) q and (B, S, H, D) k/v."""
@@ -150,8 +189,19 @@ def flash_attention(
 
     qf, kf, vf = fold(q), fold(k), fold(v)
 
-    block_q = min(block_q, max(8, ((l + 7) // 8) * 8))
-    block_kv = min(block_kv, max(8, ((s + 7) // 8) * 8))
+    # None = auto (divisibility-aware pick, unless an env sweep pins the
+    # block); an explicit caller/env value is honored, clamped only to
+    # the padded sequence length
+    if block_q is None:
+        block_q = (_clamp_block(l, _DEFAULT_BLOCK_Q) if _ENV_BLOCK_Q
+                   else _pick_block(l, _DEFAULT_BLOCK_Q))
+    else:
+        block_q = _clamp_block(l, block_q)
+    if block_kv is None:
+        block_kv = (_clamp_block(s, _DEFAULT_BLOCK_KV) if _ENV_BLOCK_KV
+                    else _pick_block(s, _DEFAULT_BLOCK_KV))
+    else:
+        block_kv = _clamp_block(s, block_kv)
     qf = _pad_to(qf, 1, block_q)
     kf = _pad_to(kf, 1, block_kv)
     vf = _pad_to(vf, 1, block_kv)

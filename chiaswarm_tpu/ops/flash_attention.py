@@ -58,10 +58,13 @@ _NEG_INF = -1e30  # finite stand-in: true -inf breaks exp() on fully-masked rows
 # allocation, so programs already under 16 MB compile identically. Raise
 # further for sweeps of bigger blocks (2048x2048, 4096x1024) on other
 # TPU generations; 0 = XLA's default cap.
-# an env-pinned block is an EXPLICIT sweep request: it bypasses the
-# divisibility auto-pick (the datapoint labeled 2048 must measure 2048)
+# an env-pinned block is an EXPLICIT sweep request: EITHER knob disables
+# the divisibility auto-pick on BOTH axes, so a datapoint labeled
+# "4096x1024" measures exactly 4096x1024 (pinning one axis must not let
+# the other silently auto-pick)
 _ENV_BLOCK_Q = os.environ.get("CHIASWARM_FLASH_BLOCK_Q")
 _ENV_BLOCK_KV = os.environ.get("CHIASWARM_FLASH_BLOCK_KV")
+_ENV_PINNED = bool(_ENV_BLOCK_Q or _ENV_BLOCK_KV)
 _DEFAULT_BLOCK_Q = int(_ENV_BLOCK_Q) if _ENV_BLOCK_Q else 2048
 _DEFAULT_BLOCK_KV = int(_ENV_BLOCK_KV) if _ENV_BLOCK_KV else 1024
 _VMEM_MB = int(os.environ.get("CHIASWARM_FLASH_VMEM_MB", "24"))
@@ -124,11 +127,14 @@ def _pick_block(length: int, default: int) -> int:
     length — masked block padding still runs on the MXU, so a
     non-divisible tuned block wastes real time (the SVD portrait's
     9216-token level padded to 10240 with 2048-blocks; its 2304-token
-    level to 4096/3072). Two guards keep the r2 sweep's findings intact:
-    candidates stop at 768 (the sweep measured small blocks ~75% slower
-    than large ones regardless of padding — a 256-divisible length must
-    not fall off that cliff), and a smaller block is taken only when it
-    saves >=5% of the default's padded length. Power-of-two SD/SDXL
+    level to 4096/3072). The rule minimizes padded length over the
+    FIXED candidate list (1536, 1280, 1024, 768) below the tuned
+    default — large blocks only, not divisors of it. Two guards keep
+    the r2 sweep's findings intact: candidates stop at 768 (the sweep
+    measured small blocks ~75% slower than large ones regardless of
+    padding — a 256-divisible length must not fall off that cliff),
+    and a smaller block is taken only when it saves >=5% of the
+    default's padded length. Power-of-two SD/SDXL
     shapes keep the tuned blocks bit-for-bit. Applied ONLY when neither
     the caller nor the CHIASWARM_FLASH_BLOCK_* env knobs pin a block —
     explicit sweep values are honored as requested."""
@@ -193,12 +199,12 @@ def flash_attention(
     # block); an explicit caller/env value is honored, clamped only to
     # the padded sequence length
     if block_q is None:
-        block_q = (_clamp_block(l, _DEFAULT_BLOCK_Q) if _ENV_BLOCK_Q
+        block_q = (_clamp_block(l, _DEFAULT_BLOCK_Q) if _ENV_PINNED
                    else _pick_block(l, _DEFAULT_BLOCK_Q))
     else:
         block_q = _clamp_block(l, block_q)
     if block_kv is None:
-        block_kv = (_clamp_block(s, _DEFAULT_BLOCK_KV) if _ENV_BLOCK_KV
+        block_kv = (_clamp_block(s, _DEFAULT_BLOCK_KV) if _ENV_PINNED
                     else _pick_block(s, _DEFAULT_BLOCK_KV))
     else:
         block_kv = _clamp_block(s, block_kv)

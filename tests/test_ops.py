@@ -52,3 +52,30 @@ def test_attention_dispatch_explicit_flash():
     out_xla = attention(q, q, q, impl="xla")
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_xla),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_block_autopick_divisibility():
+    """The auto block picker (ops/flash_attention.py::_pick_block):
+    non-divisible lengths switch to the largest tuned-subdivision block
+    that removes the masked padding (the SVD portrait's +4.2%); every
+    power-of-two SD/SDXL shape keeps the tuned 2048/1024 blocks
+    bit-for-bit; the r2 small-block cliff (256/512) is never selected;
+    sub-threshold savings stay on the tuned block."""
+    from chiaswarm_tpu.ops.flash_attention import _pick_block
+
+    # tuned shapes unchanged (SDXL 1024px levels, SD 512px levels)
+    assert _pick_block(16384, 2048) == 2048
+    assert _pick_block(4096, 2048) == 2048
+    assert _pick_block(4096, 1024) == 1024
+    # SVD portrait levels tile exactly
+    assert _pick_block(9216, 2048) == 1536
+    assert _pick_block(9216, 1024) == 1024
+    assert _pick_block(2304, 2048) == 768
+    assert _pick_block(2304, 1024) == 768
+    # 256-divisible lengths must NOT fall to the small-block cliff
+    assert _pick_block(12544, 2048) == 1280
+    # below-threshold saving keeps the tuned block (6% vs 4% padding)
+    assert _pick_block(12544, 1024) == 1024
+    # short sequences clamp to the 8-padded length as before
+    assert _pick_block(77, 2048) == 80
+    assert _pick_block(256, 2048) == 256

@@ -494,3 +494,32 @@ def test_single_chip_slot_batches_small_jobs(registry):
     assert all(r["pipeline_config"].get("error") is None for r in results)
     merged = [r["pipeline_config"].get("coalesced") for r in results]
     assert merged == [4, 4, 4, 4], merged
+
+
+def test_coalesce_key_splits_mismatched_image_and_mask_grids():
+    """The executor's grouping key must carry the fetched image AND mask
+    shapes: free-form mask sizes are valid solo (the pipeline resizes),
+    so keying on presence alone would group unstackable per-job masks
+    and silently demote the burst to per-job execution."""
+    from chiaswarm_tpu.node.executor import _coalesce_key
+
+    img64 = np.zeros((64, 64, 3), np.uint8)
+    img96 = np.zeros((96, 64, 3), np.uint8)
+    m64 = np.zeros((64, 64), np.float32)
+    m32 = np.zeros((32, 32), np.float32)
+    base = {"model_name": "tiny", "num_inference_steps": 2,
+            "strength": 0.6}
+    k_a = _coalesce_key({**base, "image": img64, "mask_image": m64})
+    k_b = _coalesce_key({**base, "image": img64, "mask_image": m64})
+    assert k_a == k_b
+    # different mask grid -> different group
+    assert k_a != _coalesce_key({**base, "image": img64,
+                                 "mask_image": m32})
+    # different image grid -> different group
+    assert k_a != _coalesce_key({**base, "image": img96,
+                                 "mask_image": m64})
+    # img2img vs inpaint -> different group
+    assert k_a != _coalesce_key({**base, "image": img64})
+    # strength is a static (schedule start index) -> different group
+    assert _coalesce_key({**base, "image": img64}) != _coalesce_key(
+        {**base, "image": img64, "strength": 0.9})

@@ -112,6 +112,18 @@ class FeedForward(nn.Module):
 
 
 class CrossAttention(nn.Module):
+    """Scaled dot-product attention with to_q/to_k/to_v/to_out heads.
+
+    Context-batch contract: ``context.shape[0]`` must equal the query
+    batch OR divide it, and in the divisible case query rows must be
+    ordered context-major (row ``i`` attends to ``context[i // m]`` for
+    ``m = b // bc`` — what ``(B, F, ...) -> (B*F, ...)`` folds and
+    ``(b, s, f, c) -> (b*s, f, c)`` reshapes produce). CFG callers must
+    still concatenate their negative/positive contexts to the full query
+    batch themselves: a batch-1 context against a CFG pair would be
+    silently broadcast to both halves, making guidance a no-op.
+    """
+
     num_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.float32
@@ -122,10 +134,32 @@ class CrossAttention(nn.Module):
         context = x if context is None else context
         inner = self.num_heads * self.head_dim
         b, l, _ = x.shape
-        s = context.shape[1]
+        bc, s = context.shape[:2]
         q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
         k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(context)
         v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(context)
+        if s == 1:
+            # Softmax over a single key is identically 1, so the attended
+            # value is the value row itself — independent of the queries.
+            # out == to_out(v) broadcast over every query position (exact,
+            # not an approximation; SVD's one-token CLIP-image context hits
+            # this in every spatial and temporal cross-attention). q/k above
+            # are kept so the param tree matches checkpoints; XLA removes
+            # the dead computation. The context batch may be a divisor of
+            # the query batch (an unbroadcast per-sample token): the result
+            # broadcast replaces materializing the per-site context.
+            out = nn.Dense(inner, dtype=self.dtype, name="to_out")(v)
+            out = jnp.broadcast_to(out.reshape(bc, 1, 1, inner),
+                                   (bc, b // bc, l, inner))
+            return out.reshape(b, l, inner)
+        if bc != b:
+            # un-broadcast per-sample context on the general path too, so
+            # callers never depend on which path runs: expand k/v after
+            # projection (cheaper than materializing a per-site context)
+            k = jnp.broadcast_to(k[:, None], (bc, b // bc, s, inner))
+            v = jnp.broadcast_to(v[:, None], (bc, b // bc, s, inner))
+            k = k.reshape(b, s, inner)
+            v = v.reshape(b, s, inner)
         q = q.reshape(b, l, self.num_heads, self.head_dim)
         k = k.reshape(b, s, self.num_heads, self.head_dim)
         v = v.reshape(b, s, self.num_heads, self.head_dim)

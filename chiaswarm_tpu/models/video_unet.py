@@ -167,7 +167,10 @@ class UNet3D(nn.Module):
 
         temb = time_conditioning(cfg, dtype, timesteps, added_cond)
         temb_f = jnp.repeat(temb, f, axis=0)          # (B*F, D)
-        ctx_f = jnp.repeat(encoder_hidden_states.astype(dtype), f, axis=0)
+        # spatial-attention queries are (B*F, S, C) b-major: the text
+        # context rides CrossAttention's divisible-batch expansion
+        # un-broadcast (k/v projected once per sample, not per frame)
+        ctx_f = encoder_hidden_states.astype(dtype)
 
         x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
                     name="conv_in")(_fold(sample.astype(dtype)))
@@ -353,10 +356,12 @@ class TemporalBasicBlock(nn.Module):
                          name="norm1")(h).astype(self.dtype)
         h = CrossAttention(self.num_heads, self.head_dim, self.dtype,
                            "xla", name="attn1")(a, None) + h
-        # every spatial site cross-attends to the (first-frame) context
-        ctx = jnp.broadcast_to(time_ctx[:, None],
-                               (b, s) + time_ctx.shape[1:])
-        ctx = ctx.reshape((b * s,) + time_ctx.shape[1:]).astype(self.dtype)
+        # every spatial site cross-attends to the (first-frame) context,
+        # passed un-broadcast: CrossAttention's divisible-batch support
+        # expands k/v after projection, so the per-site context copy
+        # (the largest tensor in the block — b*s ~ 9k sites at SVD's
+        # portrait shape) is never materialized
+        ctx = time_ctx.astype(self.dtype)
         a = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
                          name="norm2")(h).astype(self.dtype)
         h = CrossAttention(self.num_heads, self.head_dim, self.dtype,
@@ -393,7 +398,10 @@ class TransformerSpatioTemporal(nn.Module):
         inner = self.num_heads * self.head_dim
         seq = nn.Dense(inner, dtype=self.dtype, name="proj_in")(seq)
 
-        ctx_f = jnp.repeat(ctx.astype(self.dtype), f, axis=0)
+        # the spatial blocks' queries are (B*F, S, C) in b-major order, so
+        # the context rides CrossAttention's divisible-batch expansion
+        # un-broadcast (no f-fold copy, k/v projected once per sample)
+        ctx_f = ctx.astype(self.dtype)
         # sinusoidal frame ids -> MLP (in C, hidden 4C, out C)
         femb = timestep_embedding(jnp.arange(f, dtype=jnp.float32), c)
         femb = TimestepEmbedding(c, self.dtype, hidden_dim=c * 4,

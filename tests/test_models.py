@@ -161,3 +161,41 @@ def test_tiled_decode_matches_single_tile():
     assert np.isfinite(tiled).all()
     assert abs(tiled[0, 0]).max() > 0  # no black border line
     assert abs(tiled[0, :, 0]).max() > 0
+
+
+def test_cross_attention_single_key_fast_path_exact():
+    """A one-token context makes softmax degenerate (one key -> weight 1),
+    so CrossAttention's fast path must equal the full attention math:
+    out = to_out(to_v(ctx)) at every query position, queries irrelevant."""
+    from chiaswarm_tpu.models.unet import CrossAttention
+
+    attn = CrossAttention(num_heads=2, head_dim=8)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 5, 16)), jnp.float32)
+    ctx1 = jnp.asarray(rng.normal(size=(2, 1, 12)), jnp.float32)
+    params = attn.init(jax.random.PRNGKey(0), x, ctx1)
+    out = np.asarray(attn.apply(params, x, ctx1))
+
+    # reference: the general math with an explicit softmax over the 1 key
+    p = params["params"]
+    v = ctx1 @ p["to_v"]["kernel"]                       # (2, 1, 16)
+    ref = v @ p["to_out"]["kernel"] + p["to_out"]["bias"]
+    ref = np.broadcast_to(np.asarray(ref), out.shape)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    # every query position sees the same attended value
+    assert np.allclose(out[:, 0], out[:, 1])
+
+    # divisible-batch form: an unbroadcast (B, 1, D) context against
+    # (B*m, L, D) queries must equal broadcasting the context by hand
+    xb = jnp.asarray(rng.normal(size=(6, 5, 16)), jnp.float32)  # m = 3
+    manual = np.asarray(attn.apply(
+        params, xb,
+        jnp.repeat(ctx1, 3, axis=0)))  # b-major repeat: [c0,c0,c0,c1,...]
+    fast = np.asarray(attn.apply(params, xb, ctx1))
+    np.testing.assert_allclose(fast, manual, atol=1e-6)
+
+    # the general path (s > 1) accepts the same un-broadcast form
+    ctx2 = jnp.asarray(rng.normal(size=(2, 4, 12)), jnp.float32)
+    manual2 = np.asarray(attn.apply(params, xb, jnp.repeat(ctx2, 3, axis=0)))
+    general = np.asarray(attn.apply(params, xb, ctx2))
+    np.testing.assert_allclose(general, manual2, atol=1e-6)

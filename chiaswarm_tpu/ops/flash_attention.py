@@ -197,7 +197,12 @@ def flash_attention(
 
     # None = auto (divisibility-aware pick, unless an env sweep pins the
     # block); an explicit caller/env value is honored, clamped only to
-    # the padded sequence length
+    # the padded sequence length. (A per-shape measured-pair override
+    # was tried and REJECTED: tools/flash_sweep.py's isolated chain
+    # showed 1152x2304 beating 768x768 by ~21% at 2304 tokens, but the
+    # end-to-end portrait program measured ~equal-or-worse — in-program
+    # these ops already run at 97 TFLOP/s with XLA overlapping them,
+    # and the isolated ~40 TFLOP/s chain mispredicts that regime.)
     if block_q is None:
         block_q = (_clamp_block(l, _DEFAULT_BLOCK_Q) if _ENV_PINNED
                    else _pick_block(l, _DEFAULT_BLOCK_Q))

@@ -127,6 +127,13 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         results["sd21_inpaint_512"] = _bench_diffusion(
             pipe, size=size, steps=steps, batch=1, iters=iters,
             init_image=init, mask=half_mask, pipelined=True)
+        if on_tpu:
+            # SD 2.1's PUBLISHED serving shape: the 768-v checkpoint is
+            # native 768px (the reference serves it there; its 9216-token
+            # attention level tiles exactly with the 1536 flash block)
+            results["sd21_txt2img_768"] = _bench_diffusion(
+                pipe, size=768, steps=steps, batch=1, iters=iters,
+                pipelined=True)
         del pipe, c
 
     if "controlnet" in names:

@@ -1,0 +1,75 @@
+"""swarmlint — AST-based static analysis for the repo's TPU invariants.
+
+TPU throughput lives or dies on invariants the CUDA reference never
+needed: no recompilation in the job loop, no host<->device sync inside
+jitted code, stateless PRNG discipline, and survival across JAX's API
+churn on the pinned version (``core/compat.py``). The runtime modules
+document these in prose; this package enforces them at zero runtime cost.
+
+Stdlib-only (``ast`` + ``json``): the linter must run in CI images and
+pre-commit hooks that have no jax installed.
+
+Entry points:
+
+- ``python -m chiaswarm_tpu.analysis [paths...]`` — CLI (see __main__.py)
+- :func:`run` — programmatic entry used by ``tests/test_lint.py``
+- :func:`analyze_source` — lint one source string (rule fixture tests)
+
+Rules (registered in ``chiaswarm_tpu.analysis.rules``):
+
+====  ======================  ===============================================
+code  name                    invariant
+====  ======================  ===============================================
+R1    host-sync-in-jit        no .item()/device_get/np.asarray/... reachable
+                              from jitted or traced code
+R2    prng-key-reuse          a PRNG key feeds at most one jax.random draw
+                              before a split/fold_in rebinds it
+R3    compat-import           jax API churn goes through core/compat.py,
+                              never direct imports of shimmed symbols
+R4    import-time-device-init no jax.devices()/device_count() at module
+                              scope (breaks JAX_PLATFORMS selection & makes
+                              imports backend-dependent)
+R5    jit-hygiene             serving-path jits use compile_cache.toplevel_jit
+                              (CHIASWARM_XLA_OPTIONS) and never donate the
+                              cache-resident param tree
+R6    recompile-hazard        raw request shapes reach compiled code only
+                              through the shape-bucketing helpers
+====  ======================  ===============================================
+
+Baseline workflow: first adoption of a rule grandfathers existing findings
+into ``.swarmlint-baseline.json`` (``--write-baseline``). New findings fail;
+fixing a baselined finding makes its entry stale, which fails under
+``--strict`` until the entry is deleted — the baseline can only shrink.
+"""
+
+from chiaswarm_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    all_rules,
+    analyze_paths,
+    analyze_source,
+    get_rule,
+)
+from chiaswarm_tpu.analysis.baseline import (
+    Baseline,
+    DEFAULT_BASELINE_NAME,
+    load_baseline,
+    write_baseline,
+)
+from chiaswarm_tpu.analysis.runner import run
+
+__all__ = [
+    "Baseline",
+    "DEFAULT_BASELINE_NAME",
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "all_rules",
+    "analyze_paths",
+    "analyze_source",
+    "get_rule",
+    "load_baseline",
+    "run",
+    "write_baseline",
+]

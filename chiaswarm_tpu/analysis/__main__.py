@@ -1,0 +1,80 @@
+"""CLI: ``python -m chiaswarm_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean · 1 new findings (or stale baseline under --strict)
+· 2 unparseable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from chiaswarm_tpu.analysis.core import all_rules
+from chiaswarm_tpu.analysis.runner import DEFAULT_LINT_PATHS, repo_root, run
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m chiaswarm_tpu.analysis",
+        description="swarmlint — enforce the repo's TPU compilation/RNG/"
+                    "compat invariants (stdlib-only AST pass)")
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_LINT_PATHS),
+                   help="files/directories to lint (default: the package, "
+                        "tests, tools and repo-root entry scripts, "
+                        "relative to the repo root)")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="baseline JSON (default: .swarmlint-baseline.json "
+                        "at the repo root; relative paths resolve against "
+                        "the repo root, like the lint paths)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather all current findings into the "
+                        "baseline and exit 0 (adoption / post-fix shrink)")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries (CI mode — "
+                        "the baseline may only shrink)")
+    p.add_argument("--select", metavar="RULES", default=None,
+                   help="comma-separated rule names or codes to run "
+                        "(e.g. R2,compat-import)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as a JSON array instead of text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.code}  {r.name:24s} {r.description}")
+        return 0
+
+    import dataclasses
+    import os
+
+    root = repo_root()
+    # relative paths resolve against the REPO ROOT, matching how findings
+    # and baseline entries are keyed — a cwd with its own tests/ subdir
+    # must not silently swap the linted tree
+    paths = [a if os.path.isabs(a) else os.path.join(root, a)
+             for a in args.paths]
+    baseline = (args.baseline if args.baseline is None
+                or os.path.isabs(args.baseline)
+                else os.path.join(root, args.baseline))
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    result = run(paths, baseline_path=baseline, strict=args.strict,
+                 select=select, write_baseline=args.write_baseline,
+                 root=root)
+    if args.as_json:
+        print(json.dumps(
+            [dataclasses.asdict(f) for f in result.new], indent=2))
+        if result.stale:
+            print(json.dumps({"stale": result.stale}), file=sys.stderr)
+        for e in result.errors:
+            print(f"error: {e}", file=sys.stderr)
+    else:
+        print(result.report)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,109 @@
+"""Baseline file: grandfathered findings that may only ever shrink.
+
+The baseline is a JSON list of finding identities (rule, path, symbol,
+message — deliberately line-number-free so it survives unrelated edits)
+plus a ``count`` for identical findings repeated in one function.
+
+Lifecycle:
+
+- adopt a rule: run with ``--write-baseline`` to grandfather what exists
+- new code: any finding whose identity is not baselined FAILS the run
+- fix a baselined finding: its entry goes *stale*; stale entries FAIL
+  under ``--strict`` (the CI mode) until the entry is deleted — so the
+  file ratchets monotonically toward empty and can never hide new debt.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+from typing import Callable, Iterable
+
+from chiaswarm_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE_NAME = ".swarmlint-baseline.json"
+_SCHEMA = 1
+
+
+@dataclasses.dataclass
+class Baseline:
+    """Suppression set with multiplicity-aware matching."""
+
+    entries: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def split(self, findings: Iterable[Finding],
+              in_scope: Callable[[str], bool] | None = None,
+              ) -> tuple[list[Finding], list[Finding], list[str]]:
+        """Partition into (new, suppressed, stale_keys).
+
+        A key suppresses at most ``count`` identical findings; the excess
+        surface as new. A key matching FEWER findings than its count is
+        stale — including a partial fix of a multi-count entry, otherwise
+        the leftover headroom would silently suppress a reintroduced
+        violation later. Staleness is only reported when ``in_scope``
+        says this run actually looked for the entry (a --select or
+        path-subset run must not misreport entries it never re-checked).
+        """
+        counts: collections.Counter[str] = collections.Counter()
+        new: list[Finding] = []
+        suppressed: list[Finding] = []
+        for f in findings:
+            key = f.baseline_key
+            counts[key] += 1
+            if counts[key] <= self.entries.get(key, 0):
+                suppressed.append(f)
+            else:
+                new.append(f)
+        stale = [k for k, n in self.entries.items()
+                 if counts[k] < n and (in_scope is None or in_scope(k))]
+        return new, suppressed, sorted(stale)
+
+
+def _key_fields(key: str) -> dict[str, str]:
+    rule, path, symbol, message = key.split("::", 3)
+    return {"rule": rule, "path": path, "symbol": symbol, "message": message}
+
+
+def load_baseline(path: str) -> Baseline:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return Baseline()
+    if not isinstance(doc, dict) or doc.get("schema") != _SCHEMA:
+        raise ValueError(f"{path}: not a swarmlint baseline (schema "
+                         f"{_SCHEMA} expected)")
+    entries: dict[str, int] = {}
+    for e in doc.get("findings", []):
+        key = "::".join((e["rule"], e["path"], e["symbol"], e["message"]))
+        entries[key] = int(e.get("count", 1))
+    return Baseline(entries)
+
+
+def write_baseline(path: str, findings: Iterable[Finding],
+                   keep: dict[str, int] | None = None) -> int:
+    """Serialize current findings as the new baseline; returns entry count.
+
+    ``keep`` carries existing entries that this run did NOT re-check
+    (out-of-scope paths on a partial run) — they are preserved verbatim
+    so a path-subset ``--write-baseline`` cannot erase them."""
+    counts: collections.Counter[str] = collections.Counter(
+        f.baseline_key for f in findings)
+    for key, n in (keep or {}).items():
+        counts.setdefault(key, n)
+    doc = {
+        "schema": _SCHEMA,
+        "comment": "grandfathered swarmlint findings — may only shrink; "
+                   "regenerate with python -m chiaswarm_tpu.analysis "
+                   "--write-baseline after FIXING findings, never to "
+                   "suppress new ones",
+        "findings": [
+            {**_key_fields(key), "count": n}
+            for key, n in sorted(counts.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return len(counts)

@@ -1,0 +1,330 @@
+"""Visitor framework for swarmlint: findings, rule registry, module context.
+
+Everything here is pure stdlib ``ast``. A :class:`ModuleContext` is built
+once per file and shared by all rules; it pre-computes the things every
+TPU-invariant rule needs — import-alias resolution (so ``jnp.zeros`` and
+``jax.numpy.zeros`` look the same to a rule), a parent map, the function
+table with qualnames, and try/except-guard detection for imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Callable, Iterable, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``symbol`` is the enclosing function's qualname (or ``<module>``): the
+    baseline matches on (rule, path, symbol, message) — NOT on line
+    numbers — so grandfathered findings survive unrelated edits to the
+    same file.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = "<module>"
+
+    @property
+    def baseline_key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.symbol}::{self.message}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message} (in {self.symbol})")
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: usable in sets
+class FunctionInfo:
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef / Lambda
+    qualname: str
+    parent: "FunctionInfo | None"
+
+
+class ModuleContext:
+    """Per-file facts shared by every rule."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.imports = self._collect_imports(tree)
+        self.functions = self._collect_functions(tree)
+        self._func_by_node = {f.node: f for f in self.functions}
+
+    # ---- imports ---------------------------------------------------------
+    @staticmethod
+    def _collect_imports(tree: ast.Module) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        top = a.name.split(".", 1)[0]
+                        aliases[top] = top
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    # ---- functions -------------------------------------------------------
+    def _collect_functions(self, tree: ast.Module) -> list[FunctionInfo]:
+        out: list[FunctionInfo] = []
+        # lambdas are numbered by order of appearance within their scope,
+        # NOT by line number: baseline keys embed the qualname and must
+        # survive unrelated edits that shift lines
+        counters: dict[str, int] = {}
+
+        def visit(node: ast.AST, prefix: str, parent: FunctionInfo | None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    qn = f"{prefix}{child.name}"
+                    info = FunctionInfo(child, qn, parent)
+                    out.append(info)
+                    visit(child, qn + ".", info)
+                elif isinstance(child, ast.Lambda):
+                    counters[prefix] = counters.get(prefix, 0) + 1
+                    qn = f"{prefix}<lambda#{counters[prefix]}>"
+                    info = FunctionInfo(child, qn, parent)
+                    out.append(info)
+                    visit(child, qn + ".", info)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", parent)
+                else:
+                    visit(child, prefix, parent)
+
+        visit(tree, "", None)
+        return out
+
+    def enclosing_function(self, node: ast.AST) -> FunctionInfo | None:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES + (ast.Lambda,)):
+                return self._func_by_node.get(cur)
+            cur = self.parents.get(cur)
+        return None
+
+    def symbol_for(self, node: ast.AST) -> str:
+        info = self.enclosing_function(node)
+        return info.qualname if info else "<module>"
+
+    # ---- name resolution -------------------------------------------------
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted path of a Name/Attribute chain, import aliases applied.
+
+        ``jnp.zeros`` -> ``jax.numpy.zeros`` when the module did
+        ``import jax.numpy as jnp``; plain locals resolve to their bare
+        name (``float(...)`` -> ``float``).
+        """
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.imports.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def resolve_call(self, call: ast.Call) -> str | None:
+        return self.resolve(call.func)
+
+    def callable_target(self, node: ast.AST) -> str | None:
+        """Resolve a node used as a callable, unwrapping functools.partial:
+        ``partial(jax.jit, static_argnums=1)`` resolves to ``jax.jit``."""
+        if isinstance(node, ast.Call):
+            fn = self.resolve(node.func)
+            if fn in ("functools.partial", "partial") and node.args:
+                return self.callable_target(node.args[0])
+            return fn
+        return self.resolve(node)
+
+    def in_import_guard(self, node: ast.AST) -> bool:
+        """True when ``node`` sits in the body of a ``try`` that catches
+        ImportError/ModuleNotFoundError/Exception — the sanctioned pattern
+        for feature-probing an API that may be absent on some jax."""
+        cur = self.parents.get(node)
+        prev = node
+        while cur is not None:
+            if isinstance(cur, ast.Try) and prev in cur.body:
+                for handler in cur.handlers:
+                    names = _handler_names(handler)
+                    if names & {"ImportError", "ModuleNotFoundError",
+                                "Exception", "AttributeError"}:
+                        return True
+            prev, cur = cur, self.parents.get(cur)
+        return False
+
+    def line(self, node: ast.AST) -> int:
+        return getattr(node, "lineno", 0)
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set[str]:
+    t = handler.type
+    if t is None:  # bare `except:`
+        return {"Exception"}
+    nodes = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = set()
+    for n in nodes:
+        if isinstance(n, ast.Attribute):  # builtins.ImportError
+            out.add(n.attr)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+class Rule:
+    """Base class: subclasses set ``code``/``name``/``description`` and
+    implement :meth:`check`, yielding findings for one module."""
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleContext, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=ctx.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            symbol=ctx.symbol_for(node),
+        )
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    assert inst.name and inst.code, cls
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def _ensure_rules_loaded() -> None:
+    from chiaswarm_tpu.analysis import rules  # noqa: F401  (registers all)
+
+
+def all_rules() -> list[Rule]:
+    _ensure_rules_loaded()
+    return [_REGISTRY[k] for k in sorted(_REGISTRY, key=lambda n: _REGISTRY[n].code)]
+
+
+def get_rule(name: str) -> Rule:
+    _ensure_rules_loaded()
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    by_code = {r.code: r for r in _REGISTRY.values()}
+    if name in by_code:
+        return by_code[name]
+    raise KeyError(f"unknown rule {name!r}; have "
+                   f"{sorted(_REGISTRY)} / {sorted(by_code)}")
+
+
+# ---- drivers -------------------------------------------------------------
+
+def analyze_source(source: str, relpath: str = "<string>.py",
+                   rules: Iterable[Rule] | None = None) -> list[Finding]:
+    tree = ast.parse(source, filename=relpath)
+    ctx = ModuleContext(relpath, source, tree)
+    findings: list[Finding] = []
+    for rule in (rules if rules is not None else all_rules()):
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: Iterable[str],
+                      root: str | None = None) -> Iterator[tuple[str, str]]:
+    """Yield (abspath, root-relative posix path) for every .py under paths."""
+    root = os.path.abspath(root or os.getcwd())
+    seen: set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files = [p]
+        else:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                # prune caches, dot-dirs (.venv/.git/...) and vendor
+                # trees: foreign code is neither ours to lint nor safe
+                # to parse
+                dirnames[:] = [d for d in dirnames
+                               if not d.startswith(".")
+                               and d not in ("__pycache__", "node_modules",
+                                             "venv", "site-packages")]
+                files.extend(os.path.join(dirpath, fn)
+                             for fn in filenames if fn.endswith(".py"))
+            files.sort()
+        for f in files:
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            yield f, rel
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Iterable[Rule] | None = None,
+                  root: str | None = None,
+                  on_error: Callable[[str, Exception], None] | None = None,
+                  ) -> list[Finding]:
+    rules = list(rules if rules is not None else all_rules())
+    findings: list[Finding] = []
+    rootdir = os.path.abspath(root or os.getcwd())
+
+    def err(rel: str, exc: Exception) -> None:
+        if on_error is not None:
+            on_error(rel, exc)
+        else:
+            raise exc
+
+    seen: set[str] = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        rel0 = os.path.relpath(ap, rootdir).replace(os.sep, "/")
+        if not os.path.exists(ap):
+            # a typo'd path must FAIL the run, not lint nothing and pass
+            err(rel0, FileNotFoundError("path does not exist"))
+            continue
+        count = 0
+        for abspath, rel in iter_python_files([ap], root=rootdir):
+            # count BEFORE dedup: a path fully covered by an earlier
+            # overlapping argument is not an empty path
+            count += 1
+            if abspath in seen:
+                continue
+            seen.add(abspath)
+            try:
+                with open(abspath, "r", encoding="utf-8") as fh:
+                    source = fh.read()
+                findings.extend(analyze_source(source, rel, rules))
+            except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+                err(rel, exc)
+        if count == 0:
+            err(rel0, ValueError("no Python files found under path"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

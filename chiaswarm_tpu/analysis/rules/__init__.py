@@ -1,0 +1,82 @@
+"""Rule registry: importing this package registers every rule.
+
+Shared vocabulary for "things that trace/compile" lives here so R1/R5/R6
+agree on what counts as entering XLA.
+"""
+
+from __future__ import annotations
+
+#: Callables that produce a compiled/traced callable from a function.
+JIT_WRAPPERS = (
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "compile_cache.toplevel_jit",
+    "toplevel_jit",
+)
+
+#: Callables whose function arguments are traced (host syncs inside them
+#: fail at trace time even without an explicit jit).
+TRACED_WRAPPERS = (
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.named_call",
+)
+
+#: Host-callback escapes: code inside these legitimately runs on host.
+CALLBACK_WRAPPERS = (
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    "jax.debug.callback",
+    "jax.debug.print",
+)
+
+
+import ast
+
+#: nodes that open a new function scope
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def resolves_to(path: str | None, *targets: str) -> bool:
+    """Suffix-tolerant dotted-path match, so relative imports
+    (``from .compile_cache import toplevel_jit``) still resolve."""
+    if not path:
+        return False
+    return any(path == t or path.endswith("." + t) for t in targets)
+
+
+def own_nodes(func_node: ast.AST):
+    """Walk a function's own subtree, stopping at nested functions —
+    they are separate scopes (and separate call-graph entries)."""
+    todo = (list(func_node.body)
+            if isinstance(func_node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else [func_node.body])  # Lambda body is one expression
+    while todo:
+        node = todo.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNC_NODES):
+                continue
+            todo.append(child)
+
+
+from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
+    compat_imports,
+    device_init,
+    host_sync,
+    jit_hygiene,
+    prng,
+    recompile,
+)

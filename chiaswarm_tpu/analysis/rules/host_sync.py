@@ -1,0 +1,196 @@
+"""R1 host-sync-in-jit: no host<->device synchronization reachable from
+jitted or traced code.
+
+``.item()``, ``float(jnp_value)``, ``np.asarray``, ``jax.device_get`` and
+``block_until_ready`` each force a device->host transfer. Outside jit they
+merely serialize the async dispatch queue (bad enough in the denoise
+loop); *inside* jit/scan/vmap they fail at trace time or, worse, silently
+fall back to recompile-per-value patterns. The reference never cared —
+CUDA sync is cheap relative to its Python overhead; on TPU a single sync
+in the per-step path stalls the ICI pipeline.
+
+Reachability is intra-module: a function is "jit-reachable" when it is
+decorated with / passed to a jit or tracing wrapper, or is called (by
+simple name or ``self.method``) from a reachable function in the same
+file. Cross-module reachability is out of scope — module boundaries in
+this repo coincide with the host/device split (pipelines postprocess on
+host), so per-file analysis matches the architecture.
+
+Host-callback escapes (``jax.pure_callback``/``io_callback``/
+``jax.debug.*``) are exempt: their bodies run on host by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import (
+    Finding, FunctionInfo, ModuleContext, Rule, register,
+)
+from chiaswarm_tpu.analysis.rules import (
+    CALLBACK_WRAPPERS, JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes,
+    resolves_to,
+)
+
+_SYNC_CALLS = ("jax.device_get", "jax.block_until_ready",
+               "numpy.asarray", "numpy.array", "numpy.copy")
+_SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+
+@register
+class HostSyncInJit(Rule):
+    code = "R1"
+    name = "host-sync-in-jit"
+    description = ("no .item()/float()/np.asarray/device_get/"
+                   "block_until_ready reachable from jitted/traced code")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        roots = _jit_roots(ctx)
+        if not roots:
+            return
+        reachable = _reachable(ctx, roots)
+        seen: set[tuple[int, int]] = set()
+        for info in reachable:
+            for node, what in _sync_sites(ctx, info):
+                loc = (node.lineno, node.col_offset)
+                if loc in seen:
+                    continue
+                seen.add(loc)
+                yield self.finding(
+                    ctx, node,
+                    f"host sync {what} is reachable from jitted/traced "
+                    f"code; hoist it outside the compiled region (or use "
+                    f"jax.pure_callback if the host round-trip is "
+                    f"intentional)")
+
+
+def _jit_roots(ctx: ModuleContext) -> set[FunctionInfo]:
+    """Functions directly entering trace: decorated with, or passed to,
+    a jit/tracing wrapper."""
+    wrappers = JIT_WRAPPERS + TRACED_WRAPPERS
+    roots: set[FunctionInfo] = set()
+    by_name: dict[str, list[FunctionInfo]] = {}
+    for info in ctx.functions:
+        if isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(info.node.name, []).append(info)
+            for dec in info.node.decorator_list:
+                if resolves_to(ctx.callable_target(dec), *wrappers):
+                    roots.add(info)
+    by_node = {info.node: info for info in ctx.functions}
+
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if not resolves_to(ctx.resolve_call(call), *wrappers):
+            continue
+        args = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in args:
+            if isinstance(arg, ast.Lambda) and arg in by_node:
+                roots.add(by_node[arg])
+            elif isinstance(arg, ast.Name):
+                roots.update(by_name.get(arg.id, []))
+            elif isinstance(arg, ast.Attribute):  # self._step, cls.body
+                roots.update(by_name.get(arg.attr, []))
+    return roots
+
+
+def _callees(info: FunctionInfo) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name) and node.func.value.id in (
+                    "self", "cls"):
+                out.add(node.func.attr)
+    return out
+
+
+def _reachable(ctx: ModuleContext,
+               roots: set[FunctionInfo]) -> set[FunctionInfo]:
+    by_name: dict[str, list[FunctionInfo]] = {}
+    for info in ctx.functions:
+        if isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(info.node.name, []).append(info)
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        info = frontier.pop()
+        for name in _callees(info):
+            for callee in by_name.get(name, []):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+    return seen
+
+
+def _in_callback(ctx: ModuleContext, node: ast.AST) -> bool:
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) and resolves_to(
+                ctx.resolve_call(cur), *CALLBACK_WRAPPERS):
+            return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+_ARRAY_REDUCERS = frozenset({"sum", "mean", "max", "min", "all", "any",
+                             "prod", "std", "var", "argmax", "argmin"})
+
+
+def _is_array_expr(ctx: ModuleContext, node: ast.AST,
+                   array_names: set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in array_names
+    if isinstance(node, ast.Call):
+        inner = ctx.resolve_call(node)
+        if inner and (inner.startswith("jax.numpy.")
+                      or inner.startswith("jax.lax.")):
+            return True
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ARRAY_REDUCERS)
+    return False
+
+
+def _local_array_names(ctx: ModuleContext, info: FunctionInfo) -> set[str]:
+    """Names assigned from an obviously-array expression in this function
+    (one dataflow hop: enough for the `loss = x.sum(); float(loss)`
+    pattern)."""
+    names: set[str] = set()
+    for _ in range(2):  # second pass resolves name-to-name chains
+        for node in own_nodes(info.node):
+            if isinstance(node, ast.Assign) and _is_array_expr(
+                    ctx, node.value, names):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names
+
+
+def _sync_sites(ctx: ModuleContext, info: FunctionInfo):
+    array_names = _local_array_names(ctx, info)
+    for node in own_nodes(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _in_callback(ctx, node):
+            continue
+        resolved = ctx.resolve_call(node)
+        # exact match: suffix matching would catch device-side
+        # jax.numpy.asarray with the host numpy.asarray pattern
+        if resolved in _SYNC_CALLS:
+            yield node, f"'{resolved}'"
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+                and not node.args and not node.keywords):
+            yield node, f"'.{node.func.attr}()'"
+            continue
+        # float(jnp.sum(x)) / int(x.mean()) / float(loss) where loss was
+        # assigned from an array expression — definite array-to-scalar
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and _is_array_expr(ctx, node.args[0], array_names)):
+            yield node, f"'{node.func.id}()' on an array expression"

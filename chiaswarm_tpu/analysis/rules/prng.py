@@ -1,0 +1,274 @@
+"""R2 prng-key-reuse: a PRNG key feeds at most one jax.random consumer.
+
+Stateless PRNG discipline (core/rng.py): every ``jax.random.*`` draw —
+and ``split`` itself — consumes its key; reusing the same key variable
+for a second draw yields CORRELATED samples silently (two "independent"
+noise tensors that are bit-identical). The classic bug::
+
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    noise = jax.random.normal(key, shape)   # <- key already spent by split
+
+``fold_in(key, i)`` is the sanctioned non-consuming derivation (it maps
+the parent key to a fresh stream without invalidating it for further
+fold_ins — the per-row pattern in pipelines/cascade.py), so it neither
+consumes nor trips the rule.
+
+Analysis is per-function and flow-sensitive over straight-line code:
+branches are analyzed independently then merged (consumed-anywhere wins);
+loop bodies are analyzed twice so a draw from a loop-invariant key is
+caught as cross-iteration reuse. Names are tracked when assigned from a
+key-producing call or when a parameter looks like a key (``key``,
+``rng``, ``*key``). Interprocedural flows are not tracked: passing a key
+to a helper does not consume it here — the helper's own body is analyzed
+on its own.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, register,
+)
+from chiaswarm_tpu.analysis.rules import FUNC_NODES as _FUNC_NODES
+from chiaswarm_tpu.analysis.rules import resolves_to
+
+_FRESH = "fresh"
+_CONSUMED = "consumed"
+
+#: calls whose result is a key (or batch of keys)
+_PRODUCERS = ("jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+              "jax.random.fold_in", "jax.random.wrap_key_data",
+              "rng.key_for_seed", "key_for_seed", "rng.per_sample_keys",
+              "per_sample_keys")
+#: jax.random calls that do NOT consume their key argument
+_NON_CONSUMING = ("jax.random.fold_in", "jax.random.key_data",
+                  "jax.random.key_impl")
+
+
+def _keyish_param(name: str) -> bool:
+    return name in ("rng", "prng") or name.endswith("key")
+
+
+@register
+class PrngKeyReuse(Rule):
+    code = "R2"
+    name = "prng-key-reuse"
+    description = ("the same PRNG key must not feed two jax.random calls "
+                   "without an intervening split/fold_in")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        findings: dict[tuple[int, int, str], Finding] = {}
+
+        def emit(node: ast.AST, name: str) -> None:
+            loc = (node.lineno, node.col_offset, name)
+            if loc not in findings:
+                findings[loc] = self.finding(
+                    ctx, node,
+                    f"PRNG key '{name}' is consumed by a second "
+                    f"jax.random call without an intervening "
+                    f"split/fold_in rebind — draws will be correlated")
+
+        for scope, body in _scopes(ctx):
+            state: dict[str, str] = {}
+            if isinstance(scope, _FUNC_NODES):
+                args = scope.args
+                for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                    if _keyish_param(a.arg):
+                        state[a.arg] = _FRESH
+            _scan_block(ctx, body, state, emit)
+        yield from findings.values()
+
+
+def _scopes(ctx: ModuleContext):
+    """(scope_node, stmt_list) for the module and every function."""
+    yield ctx.tree, ctx.tree.body
+    for info in ctx.functions:
+        node = info.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, node.body
+        elif isinstance(node, ast.Lambda):
+            yield node, [ast.Expr(value=node.body)]
+
+
+def _scan_block(ctx, stmts, state, emit) -> None:
+    for stmt in stmts:
+        _scan_stmt(ctx, stmt, state, emit)
+
+
+def _scan_stmt(ctx, stmt, state, emit) -> None:
+    if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+        return  # nested scopes analyzed separately
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        value = stmt.value
+        if value is not None:
+            _scan_expr(ctx, value, state, emit)
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        produced = (isinstance(value, ast.Call)
+                    and resolves_to(ctx.resolve_call(value), *_PRODUCERS))
+        for t in targets:
+            for name in _target_names(t):
+                if produced:
+                    state[name] = _FRESH
+                else:
+                    state.pop(name, None)  # rebound to something untracked
+        return
+    if isinstance(stmt, (ast.If,)):
+        _scan_expr(ctx, stmt.test, state, emit)
+        s1, s2 = dict(state), dict(state)
+        _scan_block(ctx, stmt.body, s1, emit)
+        _scan_block(ctx, stmt.orelse, s2, emit)
+        _merge(state, s1, s2)
+        return
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _scan_expr(ctx, stmt.iter, state, emit)
+        # iterating a key-producing call (`for k in split(key, n)`) binds
+        # a FRESH per-iteration key each pass; anything else untracks
+        produced = (isinstance(stmt.iter, ast.Call)
+                    and resolves_to(ctx.resolve_call(stmt.iter),
+                                    *_PRODUCERS))
+        targets = _target_names(stmt.target)
+        # two passes: the second models re-entering the loop, catching
+        # draws from a key that is never rebound inside the body
+        for _ in range(2):
+            for name in targets:
+                if produced:
+                    state[name] = _FRESH
+                else:
+                    state.pop(name, None)
+            _scan_block(ctx, stmt.body, state, emit)
+        _scan_block(ctx, stmt.orelse, state, emit)
+        return
+    if isinstance(stmt, ast.While):
+        _scan_expr(ctx, stmt.test, state, emit)
+        _scan_block(ctx, stmt.body, state, emit)
+        _scan_block(ctx, stmt.body, state, emit)
+        _scan_block(ctx, stmt.orelse, state, emit)
+        return
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            _scan_expr(ctx, item.context_expr, state, emit)
+        _scan_block(ctx, stmt.body, state, emit)
+        return
+    if isinstance(stmt, ast.Try):
+        _scan_block(ctx, stmt.body, state, emit)
+        for handler in stmt.handlers:
+            _scan_block(ctx, handler.body, dict(state), emit)
+        _scan_block(ctx, stmt.orelse, state, emit)
+        _scan_block(ctx, stmt.finalbody, state, emit)
+        return
+    if isinstance(stmt, ast.Match):
+        _scan_expr(ctx, stmt.subject, state, emit)
+        branches: list[dict] = []
+        for case in stmt.cases:
+            s = dict(state)
+            if case.guard is not None:
+                _scan_expr(ctx, case.guard, s, emit)
+            _scan_block(ctx, case.body, s, emit)
+            branches.append(s)
+        # merge like If/else: consumed in any arm wins. The implicit
+        # no-match path keeps the incoming state — unless a wildcard arm
+        # (`case _:` / bare capture) makes no-match impossible
+        exhaustive = any(
+            isinstance(c.pattern, ast.MatchAs) and c.pattern.pattern is None
+            for c in stmt.cases)
+        incoming = [] if exhaustive else [dict(state)]
+        _merge_many(state, branches + incoming)
+        return
+    # Return / Expr / Assert / Raise / ...
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.expr):
+            _scan_expr(ctx, child, state, emit)
+
+
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+
+
+def _scan_expr(ctx, expr, state, emit,
+               skip: frozenset[str] = frozenset()) -> None:
+    """Find key-consuming draws in an expression.
+
+    Comprehensions get special treatment: their ``for`` targets are
+    per-iteration bindings (a target shadowing an outer key name must
+    not consume it), and their bodies run repeatedly — modeled as two
+    passes so a loop-invariant key drawn per element is caught as reuse.
+    """
+    todo = [expr]
+    while todo:
+        node = todo.pop()
+        if isinstance(node, _COMP_NODES):
+            bound = frozenset(
+                name for gen in node.generators
+                for name in _target_names(gen.target)) | skip
+            for gen in node.generators:
+                # iter evaluates in the enclosing scope (once)
+                _scan_expr(ctx, gen.iter, state, emit, skip)
+            parts = ([node.key, node.value]
+                     if isinstance(node, ast.DictComp) else [node.elt])
+            parts += [i for gen in node.generators for i in gen.ifs]
+            for _ in range(2):  # model iteration
+                for part in parts:
+                    _scan_expr(ctx, part, state, emit, bound)
+            continue
+        if isinstance(node, ast.Call):
+            _check_draw(ctx, node, state, emit, skip=skip)
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _check_draw(ctx, node: ast.Call, state, emit,
+                skip: frozenset[str] = frozenset()) -> None:
+    resolved = ctx.resolve_call(node)
+    if not (resolved and (resolved.startswith("jax.random.")
+                          or resolves_to(resolved, "random.split",
+                                         "random.fold_in"))):
+        return
+    if resolves_to(resolved, *_NON_CONSUMING):
+        return
+    if resolves_to(resolved, "jax.random.PRNGKey", "jax.random.key",
+                   "jax.random.wrap_key_data"):
+        return  # constructors take ints, not keys
+    key_arg = None
+    if node.args:
+        key_arg = node.args[0]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+    if isinstance(key_arg, ast.Name) and key_arg.id in state \
+            and key_arg.id not in skip:
+        if state[key_arg.id] == _CONSUMED:
+            emit(node, key_arg.id)
+        else:
+            state[key_arg.id] = _CONSUMED
+
+
+def _target_names(target) -> list[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(
+                elt.value if isinstance(elt, ast.Starred) else elt))
+        return out
+    return []
+
+
+def _merge(state, s1, s2) -> None:
+    _merge_many(state, [s1, s2])
+
+
+def _merge_many(state, branches: list[dict]) -> None:
+    """Join branch states: consumed anywhere wins, fresh anywhere next,
+    and a name absent from EVERY branch (rebound to something untracked
+    on all paths) is untracked — including names still in ``state``."""
+    for name in set(state) | {n for s in branches for n in s}:
+        vals = [s.get(name) for s in branches]
+        if _CONSUMED in vals:
+            state[name] = _CONSUMED
+        elif _FRESH in vals:
+            state[name] = _FRESH
+        else:
+            state.pop(name, None)

@@ -1,0 +1,133 @@
+"""R6 recompile-hazard: raw request shapes must be bucketed before they
+reach compiled code.
+
+Every distinct (height, width, batch) that flows into a jitted program is
+a fresh XLA compilation — minutes on TPU for an SDXL-class UNet. The
+whole point of ``compile_cache.bucket_image_size``/``bucket_batch`` is
+that arbitrary requested sizes snap onto a small compiled lattice; a
+pipeline that feeds ``req.height`` straight into its executable reopens
+the compile-per-job failure mode the cache exists to close.
+
+Heuristic (program layer only — ``pipelines/``, ``workloads/``): a
+function is flagged when it
+
+1. executes compiled code — it calls ``<jit wrapper>(fn)(...)``
+   immediately, calls a local name previously bound from a jit wrapper,
+   or goes through ``cached_executable``/``get_or_create``; and
+2. reads a raw shape attribute (``.height``/``.width``/``.batch``/
+   ``.num_frames``) from a request-like object; and
+3. never calls a bucketing helper (``bucket_image_size``,
+   ``bucket_batch``, or a local ``_bucket*``/``snap*`` helper).
+
+The finding sits on the first raw shape read. Intra-function only: a
+function that merely forwards the request object is fine — the function
+that unpacks shapes next to the executable is the one that must bucket.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import (
+    Finding, FunctionInfo, ModuleContext, Rule, register,
+)
+from chiaswarm_tpu.analysis.rules import JIT_WRAPPERS, own_nodes, resolves_to
+
+_TOPLEVEL_PACKAGES = ("chiaswarm_tpu/pipelines/", "chiaswarm_tpu/workloads/")
+_SHAPE_ATTRS = frozenset({"height", "width", "batch", "num_frames"})
+_BUCKET_HELPERS = ("bucket_image_size", "bucket_batch",
+                   "compile_cache.bucket_image_size",
+                   "compile_cache.bucket_batch")
+_EXEC_ATTRS = frozenset({"cached_executable", "get_or_create"})
+
+
+@register
+class RecompileHazard(Rule):
+    code = "R6"
+    name = "recompile-hazard"
+    description = ("raw request shapes (.height/.width/.batch) must pass "
+                   "through the shape-bucketing helpers before reaching "
+                   "compiled code")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not any(p in ctx.relpath for p in _TOPLEVEL_PACKAGES):
+            return
+        # the repo's dominant pattern binds executables to SELF in
+        # __init__ (self._fwd = toplevel_jit(...)) and calls them from
+        # other methods — collect those attr names module-wide
+        self_jit_attrs: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and resolves_to(
+                    ctx.callable_target(node.value), *JIT_WRAPPERS):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        self_jit_attrs.add(t.attr)
+        for info in ctx.functions:
+            if not isinstance(info.node,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_function(ctx, info, self_jit_attrs)
+
+    def _check_function(self, ctx: ModuleContext, info: FunctionInfo,
+                        self_jit_attrs: set[str]) -> Iterator[Finding]:
+        executes = False
+        buckets = False
+        jit_bound: set[str] = set()
+        shape_reads: list[ast.Attribute] = []
+        nodes = list(own_nodes(info.node))
+
+        # pass 1: names bound from jit wrappers (AST walk order is not
+        # source order, so bindings must be known before the use pass)
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and resolves_to(
+                    ctx.callable_target(node.value), *JIT_WRAPPERS):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jit_bound.add(t.id)
+
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                resolved = ctx.resolve_call(node)
+                if resolves_to(resolved, *_BUCKET_HELPERS) or (
+                        resolved and _is_bucket_name(
+                            resolved.rsplit(".", 1)[-1])):
+                    buckets = True
+                if isinstance(node.func, ast.Call) and resolves_to(
+                        ctx.resolve_call(node.func), *JIT_WRAPPERS):
+                    executes = True  # jax.jit(fn)(args)
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in jit_bound:
+                    executes = True  # fn = toplevel_jit(...); fn(args)
+                elif isinstance(node.func, ast.Attribute) and (
+                        node.func.attr in _EXEC_ATTRS
+                        or (node.func.attr in self_jit_attrs
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self")):
+                    executes = True  # self._fwd(...) bound in __init__
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _SHAPE_ATTRS \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Name):
+                shape_reads.append(node)
+
+        if executes and shape_reads and not buckets:
+            first = min(shape_reads, key=lambda n: (n.lineno, n.col_offset))
+            attrs = sorted({n.attr for n in shape_reads})
+            yield self.finding(
+                ctx, first,
+                f"raw request shape attribute(s) {', '.join(attrs)} reach "
+                f"compiled code without shape bucketing — every distinct "
+                f"value is a fresh XLA compile; snap through "
+                f"compile_cache.bucket_image_size/bucket_batch first")
+
+
+def _is_bucket_name(name: str) -> bool:
+    """Local bucketing helpers by naming convention. Deliberately
+    narrow: a word-boundary is required so e.g. ``store.snapshot()``
+    does not silence the rule for the whole function."""
+    return (name in ("snap", "bucket")
+            or name.startswith(("bucket_", "_bucket", "snap_")))

@@ -1,0 +1,157 @@
+"""Shared driver behind the CLI and the tier-1 ``tests/test_lint.py`` gate."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+from chiaswarm_tpu.analysis import baseline as baseline_mod
+from chiaswarm_tpu.analysis.core import Finding, all_rules, analyze_paths, get_rule
+
+
+#: the repo surfaces the lint gate covers — single source of truth for
+#: the CLI default paths, tests/test_lint.py, and the CI job
+DEFAULT_LINT_PATHS = ("chiaswarm_tpu", "tests", "tools",
+                      "bench.py", "__graft_entry__.py")
+
+
+@dataclasses.dataclass
+class RunResult:
+    exit_code: int
+    new: list[Finding]
+    suppressed: list[Finding]
+    stale: list[str]
+    errors: list[str]
+    report: str
+
+
+def repo_root() -> str:
+    """The directory findings are reported relative to (and where the
+    default baseline lives): the repo checkout containing this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _scope_checker(paths: list[str], root: str,
+                   rules) -> Callable[[str], bool]:
+    """Predicate: did THIS run (its paths + selected rules) re-check the
+    file/rule a baseline key refers to? Out-of-scope entries are neither
+    stale nor erasable."""
+    rule_names = {r.name for r in rules}
+    prefixes: list[str] = []
+    exact: set[str] = set()
+    for p in paths:
+        rel = os.path.relpath(os.path.abspath(p), root).replace(os.sep, "/")
+        if rel == ".":
+            prefixes.append("")  # whole repo
+        elif os.path.isdir(p):
+            prefixes.append(rel.rstrip("/") + "/")
+        else:
+            exact.add(rel)
+
+    def in_scope(key: str) -> bool:
+        rule, path, _, _ = key.split("::", 3)
+        return rule in rule_names and (
+            path in exact or any(path.startswith(px) for px in prefixes))
+
+    return in_scope
+
+
+def run(paths: list[str],
+        *,
+        baseline_path: str | None = None,
+        strict: bool = False,
+        select: list[str] | None = None,
+        write_baseline: bool = False,
+        root: str | None = None) -> RunResult:
+    """Lint ``paths``; returns exit code 0 when clean.
+
+    - new (non-baselined) findings -> exit 1
+    - stale baseline entries -> exit 1 under ``strict``, warning otherwise
+    - unparseable files -> exit 2
+    """
+    root = root or repo_root()
+    if baseline_path is None:
+        baseline_path = os.path.join(
+            root, baseline_mod.DEFAULT_BASELINE_NAME)
+    try:
+        rules = [get_rule(s) for s in select] if select else all_rules()
+    except KeyError as exc:
+        # typo'd --select is bad input (exit 2), not lint findings
+        return RunResult(2, [], [], [], [str(exc)],
+                         f"swarmlint: {exc.args[0]}")
+
+    errors: list[str] = []
+    error_paths: set[str] = set()
+
+    def record_error(rel: str, exc: Exception) -> None:
+        errors.append(f"{rel}: {exc}")
+        error_paths.add(rel)
+
+    findings = analyze_paths(paths, rules, root=root, on_error=record_error)
+    scope = _scope_checker(paths, root, rules)
+
+    def in_scope(key: str) -> bool:
+        # a file that failed to parse was NOT re-checked: its baseline
+        # entries are neither stale nor safe to drop on a rewrite
+        return scope(key) and key.split("::", 3)[1] not in error_paths
+
+    if write_baseline:
+        if select:
+            return RunResult(
+                2, [], [], [], ["--write-baseline with --select would "
+                                "erase other rules' entries"],
+                "swarmlint: refusing --write-baseline with --select — a "
+                "partial rule run cannot regenerate the full baseline")
+        if errors:
+            # refuse to write a silently incomplete baseline
+            report = "\n".join(
+                [f"error: {e}" for e in errors]
+                + ["swarmlint: baseline NOT written — fix unparseable "
+                   "files first"])
+            return RunResult(2, [], [], [], errors, report)
+        # preserve entries this run never re-checked (out-of-scope paths)
+        try:
+            existing = baseline_mod.load_baseline(baseline_path).entries
+        except Exception as exc:
+            return RunResult(
+                2, [], [], [], [f"{baseline_path}: {exc}"],
+                f"swarmlint: cannot read existing baseline "
+                f"{baseline_path}: {exc}")
+        keep = {k: n for k, n in existing.items() if not in_scope(k)}
+        n = baseline_mod.write_baseline(baseline_path, findings, keep)
+        report = (f"wrote {n} baseline entr{'y' if n == 1 else 'ies'} "
+                  f"({len(findings)} findings, {len(keep)} out-of-scope "
+                  f"kept) to {baseline_path}")
+        return RunResult(0, [], findings, [], errors, report)
+
+    try:
+        bl = baseline_mod.load_baseline(baseline_path)
+    except Exception as exc:
+        # truncated / merge-conflicted / wrong-schema baseline: bad
+        # input (exit 2), not a lint failure
+        return RunResult(
+            2, [], [], [], [f"{baseline_path}: {exc}"],
+            f"swarmlint: unreadable baseline {baseline_path}: {exc}")
+    new, suppressed, stale = bl.split(findings, in_scope=in_scope)
+
+    lines: list[str] = [f.render() for f in new]
+    for key in stale:
+        lines.append(
+            f"stale baseline entry (finding no longer present — delete it "
+            f"from {os.path.basename(baseline_path)}): {key}")
+    for e in errors:
+        lines.append(f"error: {e}")
+    lines.append(
+        f"swarmlint: {len(new)} finding{'s' if len(new) != 1 else ''}, "
+        f"{len(suppressed)} baselined, {len(stale)} stale baseline "
+        f"entr{'y' if len(stale) == 1 else 'ies'}")
+
+    exit_code = 0
+    if errors:
+        exit_code = 2
+    elif new or (strict and stale):
+        exit_code = 1
+    return RunResult(exit_code, new, suppressed, stale, errors,
+                     "\n".join(lines))

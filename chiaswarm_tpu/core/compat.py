@@ -1,0 +1,123 @@
+"""Pinned-version JAX compat layer — one place that knows which APIs moved.
+
+The repo pins jax 0.4.37 (pyproject.toml). JAX churns public surface
+between minors: ``shard_map`` graduated from ``jax.experimental.shard_map``
+to a top-level ``jax.shard_map`` export, Pallas modules move, and
+``jax.experimental.*`` carries no stability promise at all. The seed repo
+already paid for this twice — ``tests/test_parallel.py`` imported
+``from jax import shard_map`` (absent on 0.4.37, poisoning the whole tier-1
+collection) and ``ops/attention.py`` hand-rolled its own try/except
+fallback for the same symbol.
+
+This module is the single sanctioned crossing point:
+
+- ``COMPAT_TABLE`` is pure data (no jax import needed to read it) and
+  drives the ``compat-import`` lint rule in ``chiaswarm_tpu.analysis`` —
+  any module outside this file that imports a shimmed symbol directly is
+  a finding.
+- The shims themselves resolve lazily via module ``__getattr__`` so that
+  importing this module (e.g. from the linter, or from a host-only tool)
+  never drags in the jax runtime.
+
+Usage::
+
+    from chiaswarm_tpu.core.compat import shard_map
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: The jax version this repo is pinned to (pyproject.toml). The compat
+#: table below documents API surface relative to THIS version; bump them
+#: together.
+PINNED_JAX = "0.4.37"
+
+
+@dataclasses.dataclass(frozen=True)
+class CompatEntry:
+    """One symbol whose import path differs across pinned/modern jax."""
+
+    symbol: str           # name exported by this module
+    modern: str           # import path on current jax (>= 0.6)
+    pinned: str           # import path on the pinned version
+    note: str = ""
+
+
+#: Symbols that MUST be imported from this module rather than from jax
+#: directly. Keys are ``"<module>:<name>"`` import forms that the
+#: ``compat-import`` rule rejects anywhere outside this file.
+COMPAT_TABLE: dict[str, CompatEntry] = {
+    "jax:shard_map": CompatEntry(
+        symbol="shard_map",
+        modern="jax.shard_map",
+        pinned="jax.experimental.shard_map.shard_map",
+        note="top-level export only exists on jax >= 0.6; 0.4.x raises "
+             "ImportError at collection time",
+    ),
+    "jax.experimental.shard_map:shard_map": CompatEntry(
+        symbol="shard_map",
+        modern="jax.shard_map",
+        pinned="jax.experimental.shard_map.shard_map",
+        note="experimental path is removed once the symbol graduates; "
+             "route through compat so the repo survives an upgrade",
+    ),
+    "jax.lax:axis_size": CompatEntry(
+        symbol="axis_size",
+        modern="jax.lax.axis_size",
+        pinned="jax.core.axis_frame",
+        note="lax.axis_size does not exist on 0.4.x; axis_frame(name) "
+             "returns the static size there (ring_attention relied on the "
+             "modern name and broke every seq-parallel test on the pin)",
+    ),
+}
+
+#: ``jax.experimental`` submodules that modules may import at module scope
+#: without a try/except guard. Everything else under ``jax.experimental``
+#: must be guarded or shimmed here — the ``compat-import`` rule enforces
+#: it. Pallas is allowed because ``ops.attention`` already feature-probes
+#: the whole kernel module before use (``_flash_available``).
+ALLOWED_EXPERIMENTAL: frozenset[str] = frozenset({
+    "jax.experimental.pallas",
+})
+
+
+def _resolve_shard_map():
+    try:  # jax >= 0.6 top-level export
+        from jax import shard_map as sm
+    except ImportError:  # pinned 0.4.x: experimental module
+        from jax.experimental.shard_map import shard_map as sm
+    if not callable(sm):  # some versions expose the MODULE at jax.shard_map
+        sm = sm.shard_map
+    return sm
+
+
+def _resolve_axis_size():
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size
+
+    def axis_size(axis_name):
+        """Static size of a named mesh axis inside shard_map/pmap."""
+        size = jax.core.axis_frame(axis_name)
+        # modern jax returns a frame object; 0.4.x returns the int itself
+        return getattr(size, "size", size)
+
+    return axis_size
+
+
+_LAZY = {"shard_map": _resolve_shard_map, "axis_size": _resolve_axis_size}
+_cache: dict[str, object] = {}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        if name not in _cache:
+            _cache[name] = _LAZY[name]()
+        return _cache[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

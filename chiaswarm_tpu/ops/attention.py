@@ -67,11 +67,9 @@ def _try_ring(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float,
         return None
     from functools import partial
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pre-0.6 jax exposes it under experimental
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from chiaswarm_tpu.core.compat import shard_map
 
     from chiaswarm_tpu.parallel.ring_attention import ring_attention
 

@@ -27,6 +27,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from chiaswarm_tpu.core.compat import axis_size
+
 _NEG_INF = -1e30
 
 
@@ -64,7 +66,7 @@ def ring_attention(
     """
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # The zero-init carries must carry the same varying-axes type as the

@@ -30,6 +30,7 @@ from chiaswarm_tpu.models.blip import (
     BlipVisionEncoder,
     generate_text,
 )
+from chiaswarm_tpu.core.compile_cache import toplevel_jit
 from chiaswarm_tpu.models.tokenizer import WordPieceTokenizer
 
 
@@ -170,10 +171,10 @@ class CaptionPipeline:
                  max_new_tokens: int = 24) -> None:
         self.c = components
         self.max_new = max_new_tokens
-        self._encode_image = jax.jit(
+        self._encode_image = toplevel_jit(
             lambda p, x: self.c.vision.apply(p, x))
         if self.c.encoder is not None:
-            self._encode_question = jax.jit(self._question_fwd)
+            self._encode_question = toplevel_jit(self._question_fwd)
 
     # ---- host-side image prep ----
     def preprocess(self, image: np.ndarray) -> jnp.ndarray:

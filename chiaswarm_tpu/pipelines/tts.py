@@ -27,13 +27,14 @@ Voice presets (bark's speaker history prompts) ride job parameters as
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
+import functools
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from chiaswarm_tpu.core.compile_cache import toplevel_jit
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.codec import CodecConfig, CodecDecoder
 from chiaswarm_tpu.models.gpt import (
@@ -236,9 +237,22 @@ class TTSComponents:
 
 # --------------------------------------------------------- stage decode
 
-@partial(jax.jit, static_argnames=("gpt", "prefill_len", "max_new",
-                                   "top_k", "use_embeds"))
-def _stage_decode(gpt: GPT, params, prompt_ids, embeds, actual_len, key,
+@functools.lru_cache(maxsize=1)
+def _stage_decode_jit():
+    """Jitted stage decoder, built on FIRST USE — not at import — so
+    CHIASWARM_XLA_OPTIONS set after module import still applies, matching
+    the __init__-bound executables of the other pipeline stages."""
+    return toplevel_jit(
+        _stage_decode_impl,
+        static_argnames=("gpt", "prefill_len", "max_new",
+                         "top_k", "use_embeds"))
+
+
+def _stage_decode(*args, **kwargs):
+    return _stage_decode_jit()(*args, **kwargs)
+
+
+def _stage_decode_impl(gpt: GPT, params, prompt_ids, embeds, actual_len, key,
                   *, prefill_len: int, max_new: int, top_k: int,
                   temperature, step_masks, eos_id, pad_id,
                   use_embeds: bool):
@@ -324,10 +338,10 @@ class TTSPipeline:
 
     def __init__(self, components: TTSComponents) -> None:
         self.c = components
-        self._fine_fwd = jax.jit(
+        self._fine_fwd = toplevel_jit(
             lambda p, buf, ci: self.c.fine.apply(p, buf, ci),
             static_argnums=2)
-        self._codec = jax.jit(
+        self._codec = toplevel_jit(
             lambda p, codes: self.c.codec.apply(p, codes))
 
     # ---- stage 1: text -> semantic tokens ----

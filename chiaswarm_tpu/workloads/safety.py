@@ -105,8 +105,7 @@ class SafetyChecker:
     _image_size = 224  # overwritten from the checkpoint config on load
 
     def __init__(self, checker_dir: Path) -> None:
-        import jax
-
+        from chiaswarm_tpu.core.compile_cache import toplevel_jit
         from chiaswarm_tpu.convert.torch_to_flax import (
             convert_safety_checker,
             read_torch_weights,
@@ -124,7 +123,7 @@ class SafetyChecker:
         cfg = _vision_config(checker_dir)
         self._image_size = cfg.image_size
         vision = ClipVisionEncoder(cfg)
-        self._jit_embed = jax.jit(
+        self._jit_embed = toplevel_jit(
             lambda pixel_values: vision.apply(params, pixel_values))
 
     def __call__(self, images: np.ndarray) -> list[bool]:

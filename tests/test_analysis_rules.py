@@ -1,0 +1,646 @@
+"""Per-rule fixture tests for swarmlint (chiaswarm_tpu/analysis).
+
+One positive (must flag) and one negative (must stay silent) snippet per
+rule, plus the baseline lifecycle: finding -> grandfathered -> fixed ->
+stale entry errors under --strict.
+
+Snippets are linted under a pipelines/ pseudo-path because R5/R6 scope
+themselves to the top-level program layer.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from chiaswarm_tpu.analysis import analyze_source, get_rule
+from chiaswarm_tpu.analysis.runner import run
+
+PIPE = "chiaswarm_tpu/pipelines/fixture.py"
+
+
+def lint(src: str, path: str = PIPE, rule: str | None = None):
+    rules = [get_rule(rule)] if rule else None
+    return analyze_source(textwrap.dedent(src), path, rules)
+
+
+def rules_hit(src: str, path: str = PIPE):
+    return sorted({f.rule for f in lint(src, path)})
+
+
+# ---------------------------------------------------------------- R1
+
+def test_r1_flags_host_sync_inside_jitted_function():
+    fs = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.asarray(x) + 1
+        """, rule="R1")
+    assert [f.rule for f in fs] == ["host-sync-in-jit"]
+    assert fs[0].symbol == "step"
+
+
+def test_r1_flags_sync_reachable_through_local_call_graph():
+    fs = lint("""
+        import jax
+
+        def _inner(c):
+            return float(c.mean())
+
+        def _body(c, _):
+            return _inner(c), None
+
+        def scan_all(xs):
+            return jax.lax.scan(_body, xs, None, length=4)
+        """, rule="R1")
+    assert [f.symbol for f in fs] == ["_inner"]
+
+
+def test_r1_tracks_float_of_locally_assigned_array():
+    fs = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            loss = x.sum()
+            return float(loss)
+        """, rule="R1")
+    assert len(fs) == 1 and "float" in fs[0].message
+    # float() of a plain scalar parameter stays silent
+    fs = lint("""
+        import jax
+
+        @jax.jit
+        def step(x, scale):
+            return x * float(scale)
+        """, rule="R1")
+    assert fs == []
+
+
+def test_r1_ignores_host_sync_outside_jit_and_callbacks():
+    fs = lint("""
+        import jax
+        import numpy as np
+
+        def postprocess(x):
+            # host side of the pipeline: syncs are the POINT here
+            return np.asarray(jax.device_get(x)).item()
+
+        @jax.jit
+        def step(x):
+            jax.debug.print("mean={m}", m=x.mean().item())
+            return x
+        """, rule="R1")
+    assert fs == []
+
+
+# ---------------------------------------------------------------- R2
+
+def test_r2_flags_key_reused_after_split():
+    fs = lint("""
+        import jax
+
+        def sample(seed):
+            key = jax.random.PRNGKey(seed)
+            k1, k2 = jax.random.split(key)
+            return jax.random.normal(key, (3,))   # key already spent
+        """, rule="R2")
+    assert [f.rule for f in fs] == ["prng-key-reuse"]
+    assert "'key'" in fs[0].message
+
+
+def test_r2_flags_loop_invariant_key():
+    fs = lint("""
+        import jax
+
+        def sample(key, n):
+            out = []
+            for _ in range(n):
+                out.append(jax.random.uniform(key, (2,)))
+            return out
+        """, rule="R2")
+    assert len(fs) == 1
+
+
+def test_r2_flags_key_reuse_inside_comprehensions():
+    fs = lint("""
+        import jax
+
+        def sample(key, n):
+            return [jax.random.normal(key, (2,)) for _ in range(n)]
+        """, rule="R2")
+    assert len(fs) == 1
+    # per-iteration keys from the comprehension's own target are fine
+    fs = lint("""
+        import jax
+
+        def sample(keys):
+            return [jax.random.normal(k, (2,)) for k in keys]
+        """, rule="R2")
+    assert fs == []
+    # a comprehension target SHADOWING an outer key must neither consume
+    # it nor flag the later legitimate draw
+    fs = lint("""
+        import jax
+
+        def sample(key, n):
+            rows = jax.random.split(jax.random.fold_in(key, 0), n)
+            xs = [jax.random.normal(key, (2,)) for key in rows]
+            return xs, jax.random.normal(key, (3,))
+        """, rule="R2")
+    assert fs == []
+
+
+def test_r2_tracks_per_iteration_keys_from_split_loops():
+    # two draws from the SAME per-iteration key: correlated — flag
+    fs = lint("""
+        import jax
+
+        def sample(key, n):
+            for k in jax.random.split(key, n):
+                a = jax.random.normal(k, (2,))
+                b = jax.random.normal(k, (2,))
+        """, rule="R2")
+    assert len(fs) == 1
+    # one draw per iteration key is the canonical correct pattern
+    fs = lint("""
+        import jax
+
+        def sample(key, n):
+            return [jax.random.normal(k, (2,))
+                    for k in jax.random.split(key, n)]
+        """, rule="R2")
+    assert fs == []
+
+
+def test_r2_sees_match_statement_bodies():
+    # rebinds across EXHAUSTIVE match arms must be honored: the second
+    # draw below is fine on every path (no false positive)
+    fs = lint("""
+        import jax
+
+        def sample(key, mode):
+            key, xk = jax.random.split(key)
+            x = jax.random.normal(xk, (2,))
+            match mode:
+                case "refresh":
+                    key = jax.random.fold_in(key, 7)
+                case _:
+                    key, extra = jax.random.split(key)
+            return jax.random.normal(key, (2,))
+        """, rule="R2")
+    assert fs == []
+    # without a wildcard arm the no-match path still carries the spent
+    # key, so the same draw IS potential reuse (consistent with if/else)
+    fs = lint("""
+        import jax
+
+        def sample(key, mode):
+            x = jax.random.normal(key, (2,))
+            match mode:
+                case "refresh":
+                    key = jax.random.fold_in(key, 7)
+            return jax.random.normal(key, (2,))
+        """, rule="R2")
+    assert len(fs) == 1
+    # reuse INSIDE a match arm must be caught
+    fs = lint("""
+        import jax
+
+        def sample(key, mode):
+            match mode:
+                case "double":
+                    a = jax.random.normal(key, (2,))
+                    b = jax.random.normal(key, (2,))
+        """, rule="R2")
+    assert len(fs) == 1
+
+
+def test_r2_branch_rebinds_to_untracked_values_clear_consumption():
+    # both arms rebind the name to something the rule cannot track: the
+    # later draw must not be flagged off the stale pre-branch state
+    fs = lint("""
+        import jax
+
+        def sample(seed, cond, make_key):
+            key = jax.random.PRNGKey(seed)
+            a = jax.random.normal(key, (2,))
+            if cond:
+                key = make_key(1)
+            else:
+                key = make_key(2)
+            return a, jax.random.normal(key, (2,))
+        """, rule="R2")
+    assert fs == []
+
+
+def test_r2_allows_split_rebind_and_fold_in():
+    fs = lint("""
+        import jax
+
+        def sample(key, n):
+            for i in range(n):
+                key, sub = jax.random.split(key)
+                x = jax.random.normal(sub, (3,))
+            rows = [jax.random.fold_in(key, r) for r in range(4)]
+            y = jax.random.normal(jax.random.fold_in(key, 99), (3,))
+            return x, y, rows
+        """, rule="R2")
+    assert fs == []
+
+
+# ---------------------------------------------------------------- R3
+
+def test_r3_flags_direct_shard_map_import_even_guarded():
+    fs = lint("""
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        """, rule="R3")
+    assert len(fs) == 2  # both arms must route through core.compat
+    assert all("core.compat" in f.message for f in fs)
+
+
+def test_r3_flags_unguarded_experimental_and_pinned_attr_call():
+    fs = lint("""
+        import jax
+        from jax.experimental import multihost_utils
+
+        def n(axis):
+            return jax.lax.axis_size(axis)
+        """, rule="R3")
+    assert sorted(f.line for f in fs) == [3, 6]
+
+
+def test_r3_allows_guarded_experimental_allowlisted_pallas_and_compat_itself():
+    fs = lint("""
+        from jax.experimental import pallas as pl
+        try:
+            from jax.experimental import multihost_utils
+        except ImportError:
+            multihost_utils = None
+        from chiaswarm_tpu.core.compat import shard_map, axis_size
+        """, rule="R3")
+    assert fs == []
+    # compat.py itself may do whatever it needs
+    fs = lint("from jax.experimental.shard_map import shard_map",
+              path="chiaswarm_tpu/core/compat.py", rule="R3")
+    assert fs == []
+
+
+# ---------------------------------------------------------------- R4
+
+def test_r4_flags_module_scope_and_default_arg_device_init():
+    fs = lint("""
+        import jax
+
+        N_CHIPS = len(jax.devices())
+
+        def run(n=jax.device_count()):
+            return n
+        """, rule="R4")
+    assert sorted(f.line for f in fs) == [4, 6]
+
+
+def test_r4_flags_module_scope_lambda_defaults():
+    fs = lint("""
+        import jax
+
+        handler = lambda devs=jax.devices(): devs
+        body_is_fine = lambda: jax.devices()
+        """, rule="R4")
+    assert [f.line for f in fs] == [4]
+    # a lambda BODY inside a decorator/default expression runs at call
+    # time, not import time — must not be flagged
+    fs = lint("""
+        import jax
+
+        def f(make=lambda: jax.devices()):
+            return make()
+        """, rule="R4")
+    assert fs == []
+
+
+def test_r4_allows_device_queries_inside_functions():
+    fs = lint("""
+        import jax
+
+        def chip_count():
+            return len(jax.devices())
+
+        class Pool:
+            def __init__(self):
+                self.devices = jax.local_devices()
+        """, rule="R4")
+    assert fs == []
+
+
+# ---------------------------------------------------------------- R5
+
+def test_r5_flags_raw_jit_in_program_layer_and_donated_params():
+    fs = lint("""
+        import jax
+        from functools import partial
+
+        class Pipeline:
+            def __init__(self, c):
+                self._fwd = jax.jit(lambda p, x: c.apply(p, x))
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def denoise(params, latents):
+            return latents
+        """)
+    r5 = [f for f in fs if f.rule == "jit-hygiene"]
+    assert len(r5) == 3  # raw jit, raw decorator jit, donated params
+    assert any("donates 'params'" in f.message for f in r5)
+
+
+def test_r5_allows_toplevel_jit_and_init_jits_and_non_program_layer():
+    fs = lint("""
+        import jax
+        from chiaswarm_tpu.core.compile_cache import toplevel_jit
+
+        def build(c, k, x):
+            params = jax.jit(c.unet.init)(k, x)          # one-shot init
+            params2 = jax.jit(lambda kk: c.vae.init(kk, x))(k)
+            fwd = toplevel_jit(lambda p, x: c.apply(p, x))
+            return params, params2, fwd
+        """, rule="R5")
+    assert fs == []
+    # outside pipelines/workloads raw jax.jit is fine (models, tests, ...)
+    fs = lint("import jax\nf = jax.jit(lambda x: x)\n",
+              path="chiaswarm_tpu/models/unet.py", rule="R5")
+    assert fs == []
+
+
+# ---------------------------------------------------------------- R6
+
+def test_r6_flags_raw_request_shapes_reaching_compiled_code():
+    fs = lint("""
+        from chiaswarm_tpu.core.compile_cache import toplevel_jit
+
+        def serve(req, params):
+            fn = toplevel_jit(lambda p, h, w: p)
+            return fn(params, req.height, req.width)
+        """, rule="R6")
+    assert [f.rule for f in fs] == ["recompile-hazard"]
+    assert "height" in fs[0].message and "width" in fs[0].message
+
+
+def test_r5_flags_curried_partial_jit_calls():
+    fs = lint("""
+        import jax
+        from functools import partial
+
+        class Pipeline:
+            def __init__(self, c):
+                self._f = partial(jax.jit, static_argnums=2)(c.apply)
+        """, rule="R5")
+    assert len(fs) == 1
+
+
+def test_r6_sees_executables_bound_to_self_attributes():
+    """The repo's dominant pattern: bind in __init__, call elsewhere."""
+    fs = lint("""
+        from chiaswarm_tpu.core.compile_cache import toplevel_jit
+
+        class Pipeline:
+            def __init__(self, c):
+                self._run = toplevel_jit(lambda p, h, w: p)
+
+            def generate(self, req, params):
+                return self._run(params, req.height, req.width)
+        """, rule="R6")
+    assert [f.rule for f in fs] == ["recompile-hazard"]
+    assert "generate" in fs[0].symbol
+
+
+def test_r6_is_not_silenced_by_lookalike_method_names():
+    fs = lint("""
+        from chiaswarm_tpu.core.compile_cache import toplevel_jit
+
+        def serve(req, params, store):
+            store.snapshot()   # NOT a bucketing helper
+            fn = toplevel_jit(lambda p, h: p)
+            return fn(params, req.height)
+        """, rule="R6")
+    assert [f.rule for f in fs] == ["recompile-hazard"]
+
+
+def test_r6_allows_bucketed_shapes_and_forwarding_functions():
+    fs = lint("""
+        from chiaswarm_tpu.core.compile_cache import (
+            bucket_batch, bucket_image_size, toplevel_jit,
+        )
+
+        def serve(req, params):
+            h, w = bucket_image_size(req.height, req.width)
+            b = bucket_batch(req.batch)
+            fn = toplevel_jit(lambda p, h, w, b: p)
+            return fn(params, h, w, b)
+
+        def enqueue(req, queue):
+            # no compiled call here: forwarding the request is fine
+            queue.put((req.height, req.width))
+        """, rule="R6")
+    assert fs == []
+
+
+# ---------------------------------------------------------------- baseline
+
+BAD = """import jax
+
+N = len(jax.devices())
+"""
+
+
+def _write(tmp_path, rel, content):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(content)
+    return p
+
+
+def test_baseline_lifecycle_add_suppress_fix_stale(tmp_path):
+    mod = _write(tmp_path, "pkg/mod.py", BAD)
+    bl = tmp_path / "baseline.json"
+
+    # 1. new finding fails
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path))
+    assert r.exit_code == 1 and len(r.new) == 1 and not r.stale
+
+    # 2. grandfather it, rerun: suppressed, clean
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 0
+    doc = json.loads(bl.read_text())
+    assert doc["schema"] == 1 and len(doc["findings"]) == 1
+    assert doc["findings"][0]["rule"] == "import-time-device-init"
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 0 and len(r.suppressed) == 1
+
+    # 3. a SECOND identical-identity finding is NOT covered (count=1)
+    mod.write_text(BAD + "M = len(jax.devices())\n")
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path))
+    assert r.exit_code == 1 and len(r.new) == 1 and len(r.suppressed) == 1
+
+    # 4. fix the violation: the baseline entry is now stale —
+    #    strict (CI) errors until it is deleted; non-strict only warns
+    mod.write_text("import jax\n\ndef n():\n    return jax.devices()\n")
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path))
+    assert r.exit_code == 0 and r.stale
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 1 and r.stale and "stale" in r.report
+
+    # 5. shrink the baseline (the only sanctioned regeneration): clean
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert json.loads(bl.read_text())["findings"] == []
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 0
+
+
+def test_unparseable_file_is_reported_not_crashed(tmp_path):
+    _write(tmp_path, "pkg/broken.py", "def f(:\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path))
+    assert r.exit_code == 2 and r.errors
+    # --write-baseline must refuse rather than write an incomplete file
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), write_baseline=True)
+    assert r.exit_code == 2 and "NOT written" in r.report
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_baseline_entries_of_unparseable_files_are_not_stale(tmp_path):
+    """A transient syntax error must not tell the user to delete still-
+    valid baseline entries for that file."""
+    mod = _write(tmp_path, "pkg/mod.py", BAD)
+    bl = tmp_path / "baseline.json"
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 0
+
+    good = mod.read_text()
+    mod.write_text("def f(:\n")  # mid-refactor breakage
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 2 and not r.stale, r.report
+
+    mod.write_text(good)  # restored: entry still suppresses
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 0 and len(r.suppressed) == 1
+
+
+def test_findings_are_deterministic_and_line_independent_keys():
+    src = """
+    import jax
+
+    def sample(key):
+        jax.random.normal(key, (2,))
+        return jax.random.normal(key, (2,))
+    """
+    a = lint(src)
+    b = lint("\n\n" + textwrap.dedent(src))  # shifted two lines down
+    assert [f.baseline_key for f in a] == [f.baseline_key for f in b]
+    assert a[0].line != b[0].line
+
+
+def test_lambda_finding_keys_survive_line_shifts():
+    src = """
+    import jax
+    f = jax.jit(lambda x: x.item())
+    """
+    a = lint(src, rule="R1")
+    b = lint("\n# shifted\n" + textwrap.dedent(src), rule="R1")
+    assert len(a) == 1
+    assert [f.baseline_key for f in a] == [f.baseline_key for f in b]
+    assert "<lambda#1>" in a[0].symbol
+
+
+def test_overlapping_paths_and_bad_select_are_handled(tmp_path):
+    _write(tmp_path, "pkg/mod.py", "x = 1\n")
+    # a path fully covered by an earlier argument is not "empty"
+    r = run([str(tmp_path), str(tmp_path / "pkg")],
+            baseline_path=str(tmp_path / "b.json"), root=str(tmp_path))
+    assert r.exit_code == 0, r.report
+    # a typo'd rule selection is bad input (exit 2), not lint findings
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R9"])
+    assert r.exit_code == 2 and "unknown rule" in r.report
+
+
+def test_nonexistent_path_fails_instead_of_linting_nothing(tmp_path):
+    r = run([str(tmp_path / "no_such_dir")],
+            baseline_path=str(tmp_path / "b.json"), root=str(tmp_path))
+    assert r.exit_code == 2 and "does not exist" in r.report
+    # a dir with no python files is equally suspicious
+    (tmp_path / "empty").mkdir()
+    r = run([str(tmp_path / "empty")],
+            baseline_path=str(tmp_path / "b.json"), root=str(tmp_path))
+    assert r.exit_code == 2 and "no Python files" in r.report
+
+
+def test_multicount_entry_partial_fix_goes_stale(tmp_path):
+    """count=2 entries must SHRINK when one of the two findings is fixed;
+    leftover headroom would silently suppress a reintroduced violation."""
+    mod = _write(tmp_path, "pkg/mod.py", BAD + "M = len(jax.devices())\n")
+    bl = tmp_path / "baseline.json"
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert json.loads(bl.read_text())["findings"][0]["count"] == 2
+
+    mod.write_text(BAD)  # fix ONE of the two identical findings
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 1 and r.stale
+
+
+def test_corrupt_baseline_is_bad_input_not_a_crash(tmp_path):
+    _write(tmp_path, "pkg/mod.py", "x = 1\n")
+    bl = tmp_path / "baseline.json"
+    bl.write_text('{"schema": 99}')
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path))
+    assert r.exit_code == 2 and "baseline" in r.report
+    bl.write_text("{truncated")
+    r = run([str(tmp_path)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 2
+
+
+def test_partial_runs_do_not_corrupt_baseline(tmp_path):
+    _write(tmp_path, "pkg/dev.py", BAD)  # R4 finding
+    bl = tmp_path / "baseline.json"
+    r = run([str(tmp_path / "pkg")], baseline_path=str(bl),
+            root=str(tmp_path), write_baseline=True)
+    assert r.exit_code == 0
+
+    # --select of a DIFFERENT rule: the R4 entry is out of scope — not
+    # stale, and a strict run stays green
+    r = run([str(tmp_path / "pkg")], baseline_path=str(bl),
+            root=str(tmp_path), select=["R2"], strict=True)
+    assert r.exit_code == 0 and not r.stale
+
+    # --write-baseline with --select is refused outright
+    r = run([str(tmp_path / "pkg")], baseline_path=str(bl),
+            root=str(tmp_path), select=["R2"], write_baseline=True)
+    assert r.exit_code == 2 and "refusing" in r.report
+
+    # path-subset write preserves entries for unvisited paths
+    _write(tmp_path, "other/mod.py", BAD)
+    r = run([str(tmp_path / "other")], baseline_path=str(bl),
+            root=str(tmp_path), write_baseline=True)
+    assert r.exit_code == 0
+    doc = json.loads(bl.read_text())
+    assert sorted(e["path"] for e in doc["findings"]) == [
+        "other/mod.py", "pkg/dev.py"]

@@ -60,7 +60,7 @@ _CHILD = textwrap.dedent("""
     assert s == (1.0 + 2.0) * 4, s
 
     # explicit psum through shard_map over the global mesh
-    from jax import shard_map
+    from chiaswarm_tpu.core.compat import shard_map
     ps = shard_map(
         lambda v: jax.lax.psum(v, "data"), mesh=mesh,
         in_specs=P("data", None), out_specs=P(None, None),

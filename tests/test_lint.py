@@ -1,0 +1,56 @@
+"""Tier-1 lint gate: the repo stays clean under its own static analysis.
+
+``swarmlint`` (chiaswarm_tpu/analysis) enforces the TPU invariants the
+runtime modules document in prose — no host sync reachable from jit, no
+PRNG key reuse, compat-shimmed jax imports, no import-time device init,
+toplevel_jit hygiene, shape bucketing before compiled code. This gate
+fails the suite the moment a non-baselined finding lands, and fails under
+strict mode when a baseline entry goes stale (fixed findings must be
+deleted from the baseline — it only shrinks).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from chiaswarm_tpu.analysis import run
+from chiaswarm_tpu.analysis.runner import DEFAULT_LINT_PATHS, repo_root
+
+ROOT = repo_root()
+
+
+def test_package_and_tests_are_lint_clean():
+    result = run([os.path.join(ROOT, p) for p in DEFAULT_LINT_PATHS],
+                 strict=True)
+    assert result.exit_code == 0, "\n" + result.report
+    assert not result.errors, result.errors
+
+
+def test_cli_entrypoint_is_clean_and_exits_zero():
+    """The exact command the docs/CI advertise (default paths)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "chiaswarm_tpu.analysis", "--strict"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout, proc.stdout
+
+
+def test_linter_is_stdlib_only(tmp_path):
+    """The pass must run where jax is NOT installed (CI lint job, hooks).
+    Block jax imports with a poisoned stub and rerun the gate."""
+    (tmp_path / "jax.py").write_text(
+        'raise ImportError("jax unavailable in the lint environment")\n')
+    env = dict(os.environ, PYTHONPATH=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, "-m", "chiaswarm_tpu.analysis", "--strict"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_all_six_rules_are_registered():
+    from chiaswarm_tpu.analysis import all_rules
+
+    codes = [r.code for r in all_rules()]
+    assert codes == ["R1", "R2", "R3", "R4", "R5", "R6"], codes

@@ -7,8 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chiaswarm_tpu.core.compat import shard_map
 
 from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
 from chiaswarm_tpu.ops.attention import _xla_attention

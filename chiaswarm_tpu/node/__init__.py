@@ -1,1 +1,2 @@
-"""Node runtime: worker daemon, hive protocol, dispatch, artifacts, settings."""
+"""Node runtime: worker daemon, hive protocol, dispatch, artifacts,
+settings, fault tolerance (resilience) and the chaos harness."""

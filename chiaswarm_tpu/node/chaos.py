@@ -1,0 +1,272 @@
+"""Deterministic chaos harness: a fault-injecting hive + executor.
+
+Fault tolerance proven by hope is not fault tolerance. This module drives
+a REAL :class:`~chiaswarm_tpu.node.worker.Worker` — its actual poll loop,
+burst drain, degradation ladder, upload retries, and shutdown path —
+against scripted faults, entirely in-process and entirely deterministic
+(explicit scripts, or schedules expanded from a seed):
+
+- :class:`ChaoticHive` is an aiohttp hive whose ``/api/work`` and
+  ``/api/results`` endpoints misbehave on a script: dropped connections,
+  injected latency, HTTP 500s, non-JSON HTTP 400s (the misbehaving-worker
+  signal), and malformed job payloads.
+- :class:`ChaoticExecutor` replaces the node executor (the ``executor``
+  seam on ``Worker``): each job's ``chaos`` field scripts its outcome per
+  attempt — ``ok`` / ``slow`` / ``hang`` (exceeds the deadline) /
+  ``crash`` (raises out of the executor) / ``oom`` / ``fetch`` (transient)
+  / ``fatal`` — so retry ladders and burst splits are exercised on demand
+  without compiling a single pipeline.
+
+``tests/test_chaos.py`` asserts the invariant the whole fault-tolerance
+layer exists for: under any scripted schedule, every injected job ends as
+exactly one uploaded success-or-error envelope or one dead-letter file —
+no silent drops — and the worker exits cleanly.
+
+The harness is product code (not test code) so operators can smoke a
+build the same way: ``python -m chiaswarm_tpu.node.smoke`` covers the
+happy path, this covers the unhappy ones.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from typing import Any, Iterable
+
+from chiaswarm_tpu.node.executor import error_result
+from chiaswarm_tpu.node.output_processor import make_text_result
+
+log = logging.getLogger("chiaswarm.chaos")
+
+#: fault modes a ChaoticHive poll endpoint understands
+POLL_MODES = ("ok", "drop", "delay", "http_500", "bad_worker", "malformed")
+#: fault modes a ChaoticHive result endpoint understands (per job id)
+RESULT_MODES = ("ok", "drop", "http_500")
+#: fault modes a ChaoticExecutor understands (per job attempt)
+EXECUTOR_MODES = ("ok", "slow", "hang", "crash", "oom", "fetch", "fatal")
+
+
+class ChaosSchedule:
+    """A consumable script of fault modes; exhausted scripts yield the
+    default. ``from_seed`` expands a deterministic pseudo-random schedule
+    (same seed -> same faults, forever) for soak-style runs; tests mostly
+    pass explicit scripts."""
+
+    def __init__(self, script: Iterable[str] | None = None,
+                 default: str = "ok") -> None:
+        self._script = list(script or [])
+        self.default = default
+        self.consumed: list[str] = []
+
+    @classmethod
+    def from_seed(cls, seed: Any, modes: tuple[str, ...], length: int,
+                  default: str = "ok") -> "ChaosSchedule":
+        rng = random.Random(seed)
+        return cls([rng.choice(modes) for _ in range(length)],
+                   default=default)
+
+    def next(self) -> str:
+        mode = self._script.pop(0) if self._script else self.default
+        self.consumed.append(mode)
+        return mode
+
+
+def _malformed_job(n: int) -> dict[str, Any]:
+    """Syntactically valid JSON, semantically garbage: carries an id (so
+    the zero-loss accounting can track it) but fails argument formatting
+    — the worker must upload a fatal error envelope, not choke."""
+    return {"id": f"malformed-{n}", "model_name": None,
+            "height": "not-a-number", "width": 64, "prompt": 3}
+
+
+class ChaoticHive:
+    """In-process hive with scripted fault injection on both endpoints.
+
+    ``poll_faults`` scripts GET /api/work (one mode per request);
+    ``result_faults`` maps job id -> per-attempt mode script for
+    POST /api/results, so a specific result's uploads can be failed
+    deterministically no matter what order uploads arrive in.
+    """
+
+    def __init__(self, poll_faults: Iterable[str] | None = None,
+                 result_faults: dict[str, Iterable[str]] | None = None,
+                 delay_s: float = 0.05) -> None:
+        from aiohttp import web
+
+        self.pending_jobs: list[dict[str, Any]] = []
+        self.issued_ids: list[str] = []
+        self.results: list[dict[str, Any]] = []
+        self.result_event = asyncio.Event()
+        self.poll_faults = ChaosSchedule(poll_faults)
+        self.result_faults = {
+            job_id: ChaosSchedule(script)
+            for job_id, script in (result_faults or {}).items()
+        }
+        self.delay_s = float(delay_s)
+        self.poll_count = 0
+        self._malformed = 0
+        self._app = web.Application(client_max_size=256 * 1024 * 1024)
+        self._app.router.add_get("/api/work", self._work)
+        self._app.router.add_post("/api/results", self._results)
+        self._app.router.add_get("/api/models", self._models)
+        self._runner = None
+        self.uri = ""
+
+    # ---- job injection ----
+
+    def submit(self, job: dict[str, Any]) -> None:
+        self.pending_jobs.append(job)
+        self.issued_ids.append(str(job.get("id")))
+
+    # ---- endpoints ----
+
+    async def _work(self, request):
+        from aiohttp import web
+
+        self.poll_count += 1
+        mode = self.poll_faults.next()
+        if mode == "drop":
+            # connection dies mid-request: the client sees a disconnect,
+            # queued jobs stay queued for the next (backed-off) poll
+            request.transport.close()
+            raise ConnectionResetError("chaos: dropped poll connection")
+        if mode == "delay":
+            await asyncio.sleep(self.delay_s)
+        if mode == "http_500":
+            return web.Response(status=500, text="chaos: hive on fire")
+        if mode == "bad_worker":
+            # the misbehaving-worker signal with a NON-JSON body — the
+            # client must still raise BadWorkerError (hive.py get_work)
+            return web.Response(status=400,
+                                text="<html>chaos: bad worker</html>")
+        if mode == "malformed":
+            self._malformed += 1
+            self.submit(_malformed_job(self._malformed))
+        jobs, self.pending_jobs = self.pending_jobs, []
+        return web.json_response({"jobs": jobs})
+
+    async def _results(self, request):
+        from aiohttp import web
+
+        # peek the id WITHOUT recording, so a faulted upload attempt is
+        # not double-counted when the worker retries it
+        try:
+            result = await request.json()
+        except Exception:
+            return web.Response(status=400, text="unparseable result")
+        job_id = str(result.get("id"))
+        schedule = self.result_faults.get(job_id)
+        mode = schedule.next() if schedule else "ok"
+        if mode == "drop":
+            request.transport.close()
+            raise ConnectionResetError("chaos: dropped result connection")
+        if mode == "http_500":
+            return web.Response(status=500, text="chaos: results on fire")
+        self.results.append(result)
+        self.result_event.set()
+        return web.json_response({"status": "ok"})
+
+    async def _models(self, request):
+        from aiohttp import web
+
+        return web.json_response({"models": []})
+
+    # ---- lifecycle ----
+
+    async def start(self) -> str:
+        from aiohttp import web
+
+        self._runner = web.AppRunner(self._app,
+                                     access_log=None)  # quiet chaos noise
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.uri = f"http://127.0.0.1:{port}"
+        return self.uri
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def wait_for_results(self, n: int, timeout: float = 60.0) -> None:
+        async def _wait():
+            while len(self.results) < n:
+                self.result_event.clear()
+                await self.result_event.wait()
+
+        await asyncio.wait_for(_wait(), timeout)
+
+    def uploaded_ids(self) -> list[str]:
+        return [str(result.get("id")) for result in self.results]
+
+
+class ChaoticExecutor:
+    """Executor stand-in with per-job, per-attempt scripted outcomes.
+
+    A job's ``chaos`` field is a list of modes consumed one per execution
+    attempt (the last entry repeats once exhausted; no ``chaos`` field
+    means always ``ok``), so e.g. ``["oom", "ok"]`` fails the coalesced
+    attempt and succeeds the ladder's solo re-run. ``events`` records
+    ``("batch"|"solo", [job ids...])`` per attempt for assertions on HOW
+    the ladder executed, not just the outcomes.
+    """
+
+    def __init__(self, hang_s: float = 5.0, slow_s: float = 0.3) -> None:
+        self.hang_s = float(hang_s)
+        self.slow_s = float(slow_s)
+        self.attempts: dict[str, int] = {}
+        self.events: list[tuple[str, list[str]]] = []
+        self.started = asyncio.Event()  # first job reached the executor
+
+    def _mode(self, job: dict[str, Any]) -> str:
+        script = job.get("chaos") or []
+        job_id = str(job.get("id"))
+        n = self.attempts.get(job_id, 0)
+        self.attempts[job_id] = n + 1
+        if not script:
+            return "ok"
+        return str(script[min(n, len(script) - 1)])
+
+    async def _run_one(self, job: dict[str, Any]) -> dict[str, Any]:
+        mode = self._mode(job)
+        if mode == "slow":
+            await asyncio.sleep(self.slow_s)
+            mode = "ok"
+        if mode == "hang":
+            await asyncio.sleep(self.hang_s)
+            mode = "ok"  # too late: the deadline already envelope'd it
+        if mode == "crash":
+            raise RuntimeError(f"chaos: executor crash on {job.get('id')}")
+        if mode == "oom":
+            return error_result(
+                job, "chaos: RESOURCE_EXHAUSTED: out of memory allocating "
+                     "device buffer", kind="oom")
+        if mode == "fetch":
+            return error_result(
+                job, "chaos: ConnectionError fetching input image",
+                kind="transient")
+        if mode == "fatal":
+            return error_result(job, "chaos: unusable job inputs",
+                                kind="fatal", fatal=True)
+        return {
+            "id": job.get("id"),
+            "artifacts": {"primary": make_text_result(
+                f"chaos ok: {job.get('id')}")},
+            "nsfw": False,
+            "worker_version": "chaos",
+            "pipeline_config": {"chaos": True,
+                                "attempt": self.attempts[str(job.get("id"))]},
+        }
+
+    async def do_work(self, job: dict[str, Any], slot, registry) -> dict:
+        self.started.set()
+        self.events.append(("solo", [str(job.get("id"))]))
+        return await self._run_one(job)
+
+    async def do_work_batch(self, jobs: list[dict[str, Any]], slot,
+                            registry) -> list[dict]:
+        self.started.set()
+        self.events.append(("batch", [str(job.get("id")) for job in jobs]))
+        return [await self._run_one(job) for job in jobs]

@@ -33,6 +33,7 @@ from chiaswarm_tpu.node.output_processor import (
     make_text_result,
 )
 from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.node.resilience import classify_exception
 
 log = logging.getLogger("chiaswarm.executor")
 
@@ -52,10 +53,19 @@ async def do_work_batch(jobs: list[dict[str, Any]], slot,
     )
 
 
-def _error_payload(exc: Exception, content_type: str) -> tuple[dict, dict]:
+def _error_payload(exc: Exception, content_type: str,
+                   kind: str | None = None) -> tuple[dict, dict]:
     message = exc.args[0] if exc.args else "error generating result"
     message = str(message)
-    config = {"error": message}
+    # structured envelope: the failure kind + exception class ride in the
+    # config so the hive (and the worker's own degradation ladder,
+    # node/worker.py) learn of failures explicitly instead of via the
+    # hive's timeout detector (swarm/worker.py:92-97)
+    config = {
+        "error": message,
+        "error_kind": kind or classify_exception(exc),
+        "error_class": type(exc).__name__,
+    }
     if content_type.startswith("image/"):
         img = image_from_text(message)
         artifacts = {
@@ -79,6 +89,23 @@ def _result(job_id: Any, artifacts: dict, config: dict,
     if fatal:
         result["fatal_error"] = True
     return result
+
+
+def error_result(job: dict[str, Any], exc_or_message: Any, *,
+                 kind: str | None = None, fatal: bool = False) -> dict:
+    """Structured error envelope for a job that never produced a result
+    through the normal executor path — deadline expiry, a crashed slot
+    task, a circuit-breaker refusal (node/worker.py), or a chaos-injected
+    executor fault (node/chaos.py). Same wire shape as executor-internal
+    failures, so the hive's result handler needs no new cases."""
+    if isinstance(exc_or_message, BaseException):
+        exc: Exception = exc_or_message if isinstance(
+            exc_or_message, Exception) else RuntimeError(str(exc_or_message))
+    else:
+        exc = RuntimeError(str(exc_or_message))
+    content_type = str(job.get("content_type") or "image/jpeg")
+    artifacts, config = _error_payload(exc, content_type, kind=kind)
+    return _result(job.get("id"), artifacts, config, fatal=fatal)
 
 
 _PROFILE_LOCK = threading.Lock()
@@ -119,10 +146,16 @@ def _format(job: dict[str, Any], registry: ModelRegistry):
     content_type = job.get("content_type", "image/jpeg")
     try:
         callback, kwargs = format_args(job, registry)
-    except Exception as exc:  # bad inputs: fatal, do not redispatch
-        log.warning("job %s failed formatting: %s", job_id, exc)
-        artifacts, config = _error_payload(exc, content_type)
-        return None, _result(job_id, artifacts, config, fatal=True)
+    except Exception as exc:
+        # bad inputs are fatal (do not redispatch) — but formatting also
+        # FETCHES input images, and a network blip is not the user's
+        # fault: transient kinds upload without the fatal flag so the
+        # worker's ladder (and failing that, the hive) may retry
+        kind = classify_exception(exc)
+        fatal = kind not in ("transient", "oom")
+        log.warning("job %s failed formatting (%s): %s", job_id, kind, exc)
+        artifacts, config = _error_payload(exc, content_type, kind=kind)
+        return None, _result(job_id, artifacts, config, fatal=fatal)
     return (job_id, content_type, callback, kwargs), None
 
 
@@ -134,8 +167,8 @@ def _execute(job_id, content_type, callback, kwargs, slot) -> dict:
         log.warning("job %s fatal: %s", job_id, exc)
         artifacts, config = _error_payload(exc, content_type)
         return _result(job_id, artifacts, config, fatal=True)
-    except Exception as exc:  # transient: error artifact, hive may retry
-        log.exception("job %s errored", job_id)
+    except Exception as exc:  # error artifact without the fatal flag: the
+        log.exception("job %s errored", job_id)  # hive may retry elsewhere
         artifacts, config = _error_payload(exc, content_type)
         return _result(job_id, artifacts, config)
     return _result(job_id, artifacts, config)

@@ -24,9 +24,18 @@ from chiaswarm_tpu import WORKER_VERSION
 
 log = logging.getLogger("chiaswarm.hive")
 
-POLL_BUSY_S = 1     # work found: the hive has more, come right back
-POLL_IDLE_S = 11    # nothing queued
-POLL_ERROR_S = 121  # network/hive error backoff
+# the adaptive poll cadence constants are protocol-level but live in the
+# pure-config settings module (so config never imports aiohttp);
+# re-exported here because this file documents the wire protocol. The
+# reference polls a flat POLL_ERROR_S=121 s after any error; the worker
+# now backs off exponentially (base node/settings.py:
+# poll_backoff_base_s) with jitter up to that cap, resetting on the
+# first successful poll (node/resilience.py::Backoff).
+from chiaswarm_tpu.node.settings import (  # noqa: F401
+    POLL_BUSY_S,
+    POLL_ERROR_S,
+    POLL_IDLE_S,
+)
 
 
 class BadWorkerError(RuntimeError):
@@ -64,8 +73,23 @@ class HiveClient:
                 payload = await response.json()
                 return list(payload.get("jobs", []))
             if response.status == 400:
-                payload = await response.json()
-                raise BadWorkerError(payload.get("message", "bad worker"))
+                # parse defensively: a misbehaving-worker signal must stay
+                # a BadWorkerError even when the hive (or an intermediary
+                # proxy) sends a non-JSON 400 body — letting json() raise
+                # here would demote it to a generic poll failure
+                message = "bad worker"
+                try:
+                    payload = await response.json(content_type=None)
+                    if isinstance(payload, dict):
+                        message = str(payload.get("message", message))
+                except Exception:
+                    try:
+                        body = (await response.text()).strip()
+                        if body:
+                            message = body[:200]
+                    except Exception:
+                        pass
+                raise BadWorkerError(message)
             response.raise_for_status()
             return []
 

@@ -71,6 +71,36 @@ class ModelRegistry:
                          for m in catalog}
         self.allow_random = allow_random
         self.attn_impl = attn_impl
+        self._quarantined: dict[str, str] = {}
+
+    # ---- quarantine (circuit breaker, node/resilience.py) ----
+
+    def quarantine(self, model_name: str, reason: str = "") -> None:
+        """Refuse to serve ``model_name`` until :meth:`unquarantine` — the
+        worker's per-model circuit breaker trips this after K consecutive
+        permanent failures so one broken checkpoint cannot poison the
+        whole node (it would otherwise burn a load + compile per job)."""
+        log.error("quarantining model %s%s", model_name,
+                  f": {reason}" if reason else "")
+        self._quarantined[model_name] = reason or "circuit breaker open"
+
+    def unquarantine(self, model_name: str) -> None:
+        if self._quarantined.pop(model_name, None) is not None:
+            log.warning("model %s released from quarantine", model_name)
+
+    def is_quarantined(self, model_name: str) -> bool:
+        return model_name in self._quarantined
+
+    def quarantined_models(self) -> list[str]:
+        return sorted(self._quarantined)
+
+    def _check_quarantine(self, model_name: str) -> None:
+        reason = self._quarantined.get(model_name)
+        if reason is not None:
+            raise ValueError(
+                f"model {model_name!r} is quarantined on this node "
+                f"({reason})"
+            )
 
     # ---- catalog (server-driven config, job_arguments.py:104-151) ----
 
@@ -134,6 +164,7 @@ class ModelRegistry:
         axis) — and a single-chip slot mesh pins them to THAT chip so
         per-device slots do not all serialize on the default device.
         """
+        self._check_quarantine(model_name)
         mesh_key = _mesh_cache_key(mesh)
         if mesh_key is None:
             mesh = None
@@ -213,6 +244,7 @@ class ModelRegistry:
             get_cascade_family,
         )
 
+        self._check_quarantine(model_name)
         mesh_key = _mesh_cache_key(mesh)
 
         def build():
@@ -253,6 +285,8 @@ class ModelRegistry:
             AudioPipeline,
             get_audio_family,
         )
+
+        self._check_quarantine(model_name)
 
         def build():
             ckpt = model_dir(model_name)
@@ -295,6 +329,7 @@ class ModelRegistry:
             get_video_family,
         )
 
+        self._check_quarantine(model_name)
         mesh_key = _mesh_cache_key(mesh)
 
         def build():
@@ -347,6 +382,8 @@ class ModelRegistry:
             get_tts_family,
         )
 
+        self._check_quarantine(model_name)
+
         def build():
             family = get_tts_family(model_name)
             ckpt = model_dir(model_name)
@@ -385,6 +422,7 @@ class ModelRegistry:
             CaptionPipeline,
         )
 
+        self._check_quarantine(model_name)
         mesh_key = _mesh_cache_key(mesh)
 
         def build():

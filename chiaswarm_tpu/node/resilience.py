@@ -1,0 +1,435 @@
+"""Failure classification, backoff, circuit breakers, and result durability.
+
+The reference worker has exactly one failure story: a job that crashes on
+the node is silently eaten, the hive waits out its deadline and flags the
+whole worker with HTTP 400 (swarm/worker.py:92-97). This module is the
+node-side opposite — failures contained at the JOB level and reported
+explicitly:
+
+- :func:`classify_exception` / :func:`classify_result` sort failures into
+  kinds that drive the worker's degradation ladder (node/worker.py):
+  ``transient`` faults (image-fetch blips, 5xx) and ``oom`` retry locally
+  with capped backoff; ``oom``'d coalesced bursts additionally split and
+  re-run serially; ``fatal`` input errors upload immediately and are never
+  retried anywhere; ``model``/``timeout``/``error`` feed the breaker.
+- :class:`BreakerBoard` keeps one :class:`CircuitBreaker` per model:
+  ``BREAKER_KINDS`` failures in a row quarantine the model (mirrored into
+  ``ModelRegistry``) so one broken checkpoint cannot poison the node;
+  after a cooldown one half-open probe may close it again. Deliberately
+  NOT counted: ``fatal`` (bad *user* inputs — K bad requests in a row must
+  not quarantine a healthy model) and ``transient`` (network, not the
+  model).
+- :class:`Backoff` / :func:`backoff_delay` give capped exponential backoff
+  with deterministic seeded jitter (equal-jitter: half fixed, half drawn),
+  shared by the poll loop, the retry ladder, and upload retries.
+- :class:`DeadLetterSpool` persists result envelopes that exhausted their
+  upload retries to disk; the worker replays them on the next startup, so
+  paid chip time survives even a hive outage spanning a node restart.
+
+Everything here is stdlib-only and synchronous — deliberately importable
+without jax, aiohttp, or an event loop, so the chaos suite and the linter
+job can load it anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import random
+import re
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+log = logging.getLogger("chiaswarm.resilience")
+
+# ---------------------------------------------------------------------------
+# failure classification
+# ---------------------------------------------------------------------------
+
+#: kinds the worker's ladder retries locally (with backoff; oom also splits
+#: coalesced bursts into serial solo re-runs first)
+RETRYABLE_KINDS = frozenset({"transient", "oom"})
+
+#: kinds that count as a model-level failure toward its circuit breaker
+BREAKER_KINDS = frozenset({"model", "timeout", "error", "oom"})
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "OOM",
+    "Allocation failure",
+)
+
+# exception type names (checked across the MRO so requests/urllib3/aiohttp
+# subclasses match without importing any of them) that mean "the outside
+# world hiccuped": worth a local retry, never the model's fault
+_TRANSIENT_TYPE_NAMES = frozenset({
+    "ConnectionError",
+    "ConnectionResetError",
+    "ConnectionAbortedError",
+    "ConnectionRefusedError",
+    "BrokenPipeError",
+    "ConnectTimeout",
+    "ReadTimeout",
+    "Timeout",
+    "TimeoutError",
+    "ChunkedEncodingError",
+    "ContentDecodingError",
+    "SSLError",
+    "ProxyError",
+    "ServerDisconnectedError",
+    "ClientConnectorError",
+    "ClientOSError",
+})
+
+_MODEL_UNAVAILABLE_MARKERS = (
+    "is not available on this node",   # node/registry.py load errors
+    "quarantined",                     # breaker refusal re-entering a load
+)
+
+
+def classify_exception(exc: BaseException) -> str:
+    """Sort an exception into a failure kind for the degradation ladder.
+
+    Returns one of ``oom`` / ``model`` / ``transient`` / ``fatal`` /
+    ``error``:
+
+    - ``oom``: device memory exhaustion (XLA RESOURCE_EXHAUSTED et al).
+    - ``model``: this node cannot load the model (missing/broken
+      checkpoint, quarantine) — breaker fodder.
+    - ``transient``: network-shaped (input-image fetch, 5xx upstream) —
+      retried locally.
+    - ``fatal``: the job's inputs are bad; no node can succeed, do not
+      redispatch (reference taxonomy, swarm/generator.py:34-41).
+    - ``error``: everything else — uploaded without the fatal flag so the
+      hive may retry elsewhere; counts toward the model's breaker.
+    """
+    text = f"{type(exc).__name__}: {exc}"
+    if any(marker in text for marker in _OOM_MARKERS):
+        return "oom"
+    if any(marker in str(exc) for marker in _MODEL_UNAVAILABLE_MARKERS):
+        return "model"
+    names = {cls.__name__ for cls in type(exc).__mro__}
+    if "HTTPError" in names:
+        # requests.HTTPError subclasses OSError via RequestException, so
+        # decide by status class BEFORE the blanket OSError check: 5xx is
+        # the server's bad day (retry), 4xx means our request is wrong.
+        # Prefer the attached response object; fall back to the LEADING
+        # status code of raise_for_status()'s message — never a free
+        # regex over the whole text, which would match 5xx-looking
+        # digits inside the URL ("…/500x500/a.png")
+        status = getattr(getattr(exc, "response", None),
+                         "status_code", None)
+        if status is None:
+            match = re.match(r"\s*(\d{3})\b", str(exc))
+            status = int(match.group(1)) if match else None
+        if status is None:
+            return "error"
+        return "transient" if 500 <= status <= 599 else "fatal"
+    if names & _TRANSIENT_TYPE_NAMES:
+        return "transient"
+    if isinstance(exc, (TimeoutError, OSError)):
+        return "transient"
+    if isinstance(exc, ValueError):
+        return "fatal"
+    return "error"
+
+
+def classify_result(result: dict[str, Any] | None) -> str:
+    """Kind of a finished result envelope: ``ok`` or a failure kind.
+
+    The executor stamps ``pipeline_config["error_kind"]`` on every error
+    envelope it builds (node/executor.py); envelopes from older nodes or
+    test stubs that lack the stamp fall back to the fatal flag.
+    """
+    if not isinstance(result, dict):
+        return "error"
+    config = result.get("pipeline_config") or {}
+    if not isinstance(config, dict) or "error" not in config:
+        return "ok"
+    kind = config.get("error_kind")
+    if kind:
+        return str(kind)
+    return "fatal" if result.get("fatal_error") else "error"
+
+
+# ---------------------------------------------------------------------------
+# backoff
+# ---------------------------------------------------------------------------
+
+
+def backoff_delay(attempt: int, base: float, cap: float,
+                  rng: random.Random | None = None) -> float:
+    """Capped exponential backoff with equal jitter for ``attempt`` >= 1.
+
+    ``min(cap, base * 2**(attempt-1))``, half fixed + half uniformly
+    jittered, so synchronized failures across a fleet decorrelate but the
+    delay never collapses to ~0 (which would hammer a struggling hive).
+    """
+    span = min(float(cap), float(base) * (2.0 ** max(0, int(attempt) - 1)))
+    if rng is None:
+        return span
+    return span / 2.0 + rng.uniform(0.0, span / 2.0)
+
+
+class Backoff:
+    """Stateful capped-exponential backoff with deterministic jitter.
+
+    ``next()`` grows the delay; ``reset()`` (called on the first success)
+    snaps back to the base. Seeding by worker name keeps a node's schedule
+    reproducible (chaos tests) while decorrelating nodes from each other.
+    """
+
+    def __init__(self, base: float, cap: float, seed: Any = None) -> None:
+        self.base = float(base)
+        self.cap = float(cap)
+        self._rng = random.Random(seed)
+        self._failures = 0
+
+    def next(self) -> float:
+        self._failures += 1
+        return backoff_delay(self._failures, self.base, self.cap, self._rng)
+
+    def reset(self) -> None:
+        self._failures = 0
+
+    @property
+    def failures(self) -> int:
+        return self._failures
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """closed -> open after ``threshold`` consecutive failures; after
+    ``cooldown_s`` exactly ONE half-open probe is admitted at a time —
+    its success closes the breaker, its failure re-opens (and re-arms the
+    cooldown), and an inconclusive outcome (the probe died of something
+    that says nothing about the model, e.g. bad user inputs) releases the
+    probe slot so the next job probes again."""
+
+    def __init__(self, threshold: int, cooldown_s: float,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self.state = "closed"
+        self.failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def allow(self) -> bool:
+        """May a job for this model run now? Transitions open->half_open
+        when the cooldown has elapsed (the caller should un-quarantine the
+        model before dispatching the probe). In half_open only one probe
+        is in flight at a time — a queued backlog must not stampede a
+        likely-broken checkpoint the moment the cooldown expires."""
+        if self.state == "open":
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                self.state = "half_open"
+                self._probing = True
+                return True
+            return False
+        if self.state == "half_open":
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+        return True
+
+    def record(self, ok: bool) -> str | None:
+        """Record an outcome; returns ``"opened"``/``"closed"`` on a state
+        transition the caller must mirror (registry quarantine), else
+        None."""
+        self._probing = False
+        if ok:
+            was = self.state
+            self.failures = 0
+            self.state = "closed"
+            return "closed" if was != "closed" else None
+        self.failures += 1
+        if self.state == "half_open" or self.failures >= self.threshold:
+            self.state = "open"
+            self._opened_at = self._clock()
+            return "opened"
+        return None
+
+    def release_probe(self) -> None:
+        """The in-flight half-open probe ended without a verdict on the
+        model; free the slot so the next job may probe."""
+        self._probing = False
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"state": self.state,
+                "consecutive_failures": self.failures}
+
+
+class BreakerBoard:
+    """Per-model circuit breakers with registry-mirroring callbacks.
+
+    ``on_open(model)`` fires when a breaker opens (quarantine the model),
+    ``on_close(model)`` when it closes after a successful probe, and
+    ``on_probe(model)`` when a half-open probe is about to dispatch (the
+    registry must accept the load again or the probe can never succeed).
+    Callbacks may be None (test stubs without a real registry).
+    """
+
+    def __init__(self, threshold: int, cooldown_s: float,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_open: Callable[[str], Any] | None = None,
+                 on_close: Callable[[str], Any] | None = None,
+                 on_probe: Callable[[str], Any] | None = None) -> None:
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._on_open = on_open
+        self._on_close = on_close
+        self._on_probe = on_probe
+
+    @staticmethod
+    def _notify(callback: Callable[[str], Any] | None, model: str) -> None:
+        if callback is None:
+            return
+        try:
+            callback(model)
+        except Exception:  # a mirror must never break dispatch
+            log.exception("breaker callback failed for %s", model)
+
+    def allow(self, model: str) -> bool:
+        breaker = self._breakers.get(model)
+        if breaker is None:
+            return True
+        was_open = breaker.state == "open"
+        allowed = breaker.allow()
+        if allowed and was_open:  # open -> half_open: let the probe load
+            log.warning("breaker for %s half-open: dispatching one probe",
+                        model)
+            self._notify(self._on_probe, model)
+        return allowed
+
+    def record(self, model: str, ok: bool) -> None:
+        breaker = self._breakers.get(model)
+        if breaker is None:
+            if ok:
+                return  # never-failed models stay untracked
+            breaker = self._breakers[model] = CircuitBreaker(
+                self.threshold, self.cooldown_s, self._clock)
+        transition = breaker.record(ok)
+        if transition == "opened":
+            log.error("breaker OPEN for %s after %d consecutive failures; "
+                      "quarantining for %.0fs", model, breaker.failures,
+                      self.cooldown_s)
+            self._notify(self._on_open, model)
+        elif transition == "closed":
+            log.info("breaker closed for %s (probe succeeded)", model)
+            self._notify(self._on_close, model)
+
+    def record_inconclusive(self, model: str) -> None:
+        """The job's failure says nothing about the model (bad user
+        inputs, network blip): don't move the breaker, but release the
+        half-open probe slot so another job may probe — otherwise an
+        inconclusive probe would leave the breaker stuck half-open."""
+        breaker = self._breakers.get(model)
+        if breaker is not None:
+            breaker.release_probe()
+
+    def states(self) -> dict[str, dict[str, Any]]:
+        return {model: breaker.snapshot()
+                for model, breaker in self._breakers.items()}
+
+    def open_models(self) -> list[str]:
+        return [m for m, b in self._breakers.items() if b.state == "open"]
+
+
+# ---------------------------------------------------------------------------
+# dead-letter spool
+# ---------------------------------------------------------------------------
+
+
+class DeadLetterSpool:
+    """Disk spool for result envelopes whose uploads exhausted retries.
+
+    One JSON file per envelope, named ``<job id>-<content hash>.json`` so
+    re-spooling the same envelope is idempotent; the tmp-then-rename write
+    keeps a crash mid-spool from leaving a half file that replay would
+    then misparse. ``replay()`` yields everything spooled so the worker
+    can re-queue it at startup (result durability across restarts)."""
+
+    def __init__(self, directory: Path | str) -> None:
+        self.directory = Path(directory)
+
+    def _path_for(self, result: dict[str, Any], payload: str) -> Path:
+        digest = hashlib.sha256(payload.encode("utf-8")).hexdigest()[:12]
+        job_id = re.sub(r"[^A-Za-z0-9._-]+", "_",
+                        str(result.get("id") or "result"))[:80]
+        return self.directory / f"{job_id}-{digest}.json"
+
+    def spool(self, result: dict[str, Any]) -> Path:
+        payload = json.dumps(result, sort_keys=True)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        path = self._path_for(result, payload)
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(payload, encoding="utf-8")
+        tmp.replace(path)
+        log.error("result %s spooled to dead-letter: %s",
+                  result.get("id"), path)
+        return path
+
+    def replay(self) -> list[tuple[Path, dict[str, Any]]]:
+        if not self.directory.is_dir():
+            return []
+        entries: list[tuple[Path, dict[str, Any]]] = []
+        for path in sorted(self.directory.glob("*.json")):
+            try:
+                entries.append((path, json.loads(
+                    path.read_text(encoding="utf-8"))))
+            except (OSError, json.JSONDecodeError) as exc:
+                log.error("unreadable dead-letter file %s (%s); parking as "
+                          ".bad", path, exc)
+                try:
+                    path.replace(path.with_suffix(".json.bad"))
+                except OSError:
+                    pass
+        return entries
+
+    def discard(self, path: Path | str) -> None:
+        try:
+            Path(path).unlink()
+        except FileNotFoundError:
+            pass
+
+    def depth(self) -> int:
+        if not self.directory.is_dir():
+            return 0
+        return sum(1 for _ in self.directory.glob("*.json"))
+
+
+# ---------------------------------------------------------------------------
+# counters
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ResilienceStats:
+    """Worker-level failure counters surfaced on /healthz
+    (node/worker.py::Worker.health) so the degradation ladder is
+    observable from outside the process."""
+
+    jobs_failed: int = 0
+    jobs_timed_out: int = 0
+    jobs_retried: int = 0
+    jobs_quarantined: int = 0
+    upload_retries: int = 0
+    results_dead_lettered: int = 0
+    results_replayed: int = 0
+
+    def snapshot(self) -> dict[str, int]:
+        return dataclasses.asdict(self)

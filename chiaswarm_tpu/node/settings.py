@@ -17,6 +17,16 @@ import os
 from pathlib import Path
 from typing import Any
 
+# the hive protocol's adaptive poll cadence (the reference's constants,
+# swarm/worker.py). They live HERE — the pure-config module — so hive.py
+# (which needs aiohttp) can re-export them without config depending on an
+# HTTP client: 1 s after work, 11 s idle; 121 s is the reference's flat
+# error delay, kept as the CAP of the worker's exponential error backoff
+# (node/resilience.py::Backoff).
+POLL_BUSY_S = 1
+POLL_IDLE_S = 11
+POLL_ERROR_S = 121
+
 _ENV_OVERRIDES = {
     # reference env vars (swarm/settings.py:36-38) kept for drop-in parity
     "SDAAS_URI": "hive_uri",
@@ -61,6 +71,42 @@ class Settings:
     health_port: int = 0  # >0 serves GET /healthz (SURVEY.md §5 gap fix)
     health_host: str = "127.0.0.1"  # loopback by default (observability)
     health_bind_ephemeral: bool = False  # tests: bind port 0, read address
+    # adaptive poll cadence (protocol congestion control; defaults are
+    # THE protocol constants from node/hive.py — overridable so hermetic
+    # chaos runs can poll fast)
+    poll_busy_s: float = float(POLL_BUSY_S)
+    poll_idle_s: float = float(POLL_IDLE_S)
+    # ---- fault tolerance (node/resilience.py, node/worker.py) ----
+    # per-job execution budget; a timed-out job uploads a structured error
+    # envelope instead of silently eating the hive's patience
+    job_deadline_s: float = 600.0
+    # per-workflow overrides, e.g. {"txt2vid": 1800, "img2vid": 1800};
+    # the "default" key (if present) replaces job_deadline_s
+    workflow_deadline_s: dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    transient_retries: int = 2          # local re-runs for transient/oom
+    retry_backoff_s: float = 0.5        # ladder backoff base
+    retry_backoff_cap_s: float = 30.0   # ladder backoff cap
+    breaker_threshold: int = 3          # consecutive failures -> quarantine
+    breaker_cooldown_s: float = 300.0   # open -> half-open probe window
+    poll_backoff_base_s: float = 2.0    # poll-error backoff base
+    # backoff cap = the reference's flat error delay (hive.POLL_ERROR_S)
+    poll_backoff_cap_s: float = float(POLL_ERROR_S)
+    upload_retries: int = 3             # result upload attempts
+    upload_retry_delay_s: float = 5.0   # upload backoff base
+    drain_timeout_s: float = 30.0       # shutdown: in-flight job drain
+    result_drain_timeout_s: float = 20.0  # shutdown: upload-queue drain
+    dead_letter_dir: str = ""           # default <settings root>/dead_letter
+    install_signal_handlers: bool = True  # SIGTERM/SIGINT -> graceful stop
+
+    def deadline_for(self, workflow: str | None) -> float:
+        """Execution budget (seconds) for one job of ``workflow`` (None /
+        "" = the plain stable-diffusion path)."""
+        table = self.workflow_deadline_s or {}
+        default = float(table.get("default", self.job_deadline_s))
+        if not workflow:
+            return default
+        return float(table.get(str(workflow), default))
 
     @staticmethod
     def _legacy_key_map() -> dict[str, str]:

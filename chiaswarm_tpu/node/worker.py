@@ -7,6 +7,25 @@ the two on single-GPU nodes (SURVEY.md §3.1). Here backpressure is the
 bounded ``work_queue`` alone — the poll loop simply waits for queue space,
 and each slot task owns its own execution; no shared semaphore.
 
+Fault containment (node/resilience.py) — the reference's only failure
+story is the hive's timeout detector (swarm/worker.py:92-97); here
+failures are contained at the JOB level and reported explicitly:
+
+- every burst runs under a per-workflow **deadline** (settings.py:
+  ``deadline_for``); a timed-out or crashed job uploads a structured
+  error envelope through the normal result path, so the hive learns of
+  failures in seconds;
+- the **degradation ladder**: transient faults (input-image fetch blips,
+  device OOM on a coalesced burst) re-run locally with capped backoff +
+  jitter — OOM'd bursts split and re-run serially — and a per-model
+  circuit breaker quarantines a model in the registry after K consecutive
+  permanent failures;
+- **graceful shutdown**: SIGTERM/SIGINT stop polling first, in-flight
+  slots and the result queue drain (bounded by the drain timeouts), and
+  results that exhaust upload retries spool to a disk dead-letter
+  directory that replays on the next startup — paid chip time is never
+  silently discarded.
+
 Startup gates mirror the reference's (worker.py:166-181): an accelerator
 must be present (TPU/virtual-CPU mesh instead of CUDA), logging configured,
 and matmul precision pinned (bf16 — the TPU analog of TF32 knobs).
@@ -16,6 +35,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
+import re
+import signal
+from pathlib import Path
 from typing import Any
 
 import aiohttp
@@ -25,19 +48,25 @@ from chiaswarm_tpu.core.chip_pool import ChipPool
 from chiaswarm_tpu.node.executor import (
     do_work,
     do_work_batch,
+    error_result,
     job_rows,
     rows_cap,
     single_chip_rows,
 )
-from chiaswarm_tpu.node.hive import (
-    POLL_BUSY_S,
-    POLL_ERROR_S,
-    POLL_IDLE_S,
-    BadWorkerError,
-    HiveClient,
-)
+from chiaswarm_tpu.node.hive import BadWorkerError, HiveClient
 from chiaswarm_tpu.node.logging_setup import setup_logging
 from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.node.resilience import (
+    BREAKER_KINDS,
+    RETRYABLE_KINDS,
+    Backoff,
+    BreakerBoard,
+    DeadLetterSpool,
+    ResilienceStats,
+    backoff_delay,
+    classify_exception,
+    classify_result,
+)
 from chiaswarm_tpu.node.settings import Settings, load_settings
 
 log = logging.getLogger("chiaswarm.worker")
@@ -86,12 +115,18 @@ class Worker:
 
     Designed as a class (vs the reference's module globals) so tests can run
     multiple hermetic workers against a FakeHive in one process.
+
+    ``executor`` (an object with async ``do_work(job, slot, registry)`` and
+    ``do_work_batch(jobs, slot, registry)``) overrides the real executor —
+    the seam the chaos harness (node/chaos.py) uses to inject scripted
+    faults under a real worker.
     """
 
     def __init__(self, settings: Settings | None = None,
                  pool: ChipPool | None = None,
                  registry: ModelRegistry | None = None,
-                 hive: HiveClient | None = None) -> None:
+                 hive: HiveClient | None = None,
+                 executor: Any | None = None) -> None:
         self.settings = settings or load_settings()
         # registry first: its catalog feeds the default mesh policy
         self.registry = registry or ModelRegistry(
@@ -102,6 +137,7 @@ class Worker:
             self.settings.hive_uri, self.settings.hive_token,
             self.settings.worker_name,
         )
+        self._executor = executor
         # queue bound = total in-flight capacity: per slot, the larger of
         # its pipeline depth (transfer/compute overlap) and its data-axis
         # width (cross-job coalescing needs that many jobs queued). The
@@ -112,11 +148,43 @@ class Worker:
                 for slot in self.pool))
         self.result_queue: asyncio.Queue = asyncio.Queue()
         self._stop = asyncio.Event()
+        self._draining = asyncio.Event()
         self.jobs_done = 0
         # slots currently blocked on work_queue.get(): the burst drain
         # leaves this many jobs in the queue so coalescing on one slot
         # never starves an idle neighbor (multi-slot fairness reserve)
         self._hungry_slots = 0
+        # ---- fault-tolerance state (node/resilience.py) ----
+        self.stats = ResilienceStats()
+        # deterministic per-worker jitter: chaos runs reproduce exactly,
+        # while distinct workers still decorrelate from each other
+        self._poll_backoff = Backoff(
+            base=self.settings.poll_backoff_base_s,
+            cap=self.settings.poll_backoff_cap_s,
+            seed=f"poll:{self.settings.worker_name}")
+        self._retry_rng = random.Random(
+            f"retry:{self.settings.worker_name}")
+        # the registry mirror tolerates stub registries without
+        # quarantine support (several worker tests pass object())
+        self.breakers = BreakerBoard(
+            threshold=self.settings.breaker_threshold,
+            cooldown_s=self.settings.breaker_cooldown_s,
+            on_open=getattr(self.registry, "quarantine", None),
+            on_close=getattr(self.registry, "unquarantine", None),
+            on_probe=getattr(self.registry, "unquarantine", None))
+        self.dead_letters = DeadLetterSpool(self._dead_letter_dir())
+
+    def _dead_letter_dir(self) -> Path:
+        if self.settings.dead_letter_dir:
+            return Path(self.settings.dead_letter_dir).expanduser()
+        from chiaswarm_tpu.node.settings import settings_root
+
+        # namespaced by worker name: hermetic test workers (and multiple
+        # workers sharing one settings root) must never replay — and then
+        # DELETE — each other's spooled results
+        name = re.sub(r"[^A-Za-z0-9._-]+", "_",
+                      self.settings.worker_name or "worker")
+        return settings_root() / "dead_letter" / name
 
     def _default_pool(self) -> ChipPool:
         """One slot over all chips. An explicit ``mesh_shape`` setting
@@ -182,26 +250,115 @@ class Worker:
     def request_stop(self) -> None:
         self._stop.set()
 
+    def _install_signal_handlers(self, loop) -> list:
+        """SIGTERM/SIGINT trigger the graceful-drain path instead of
+        killing in-flight paid chip time (settings gate for embedders)."""
+        if not self.settings.install_signal_handlers:
+            return []
+        installed = []
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, self.request_stop)
+                installed.append(sig)
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass  # non-main thread / non-unix loop
+        return installed
+
+    @staticmethod
+    def _remove_signal_handlers(loop, installed) -> None:
+        for sig in installed:
+            try:
+                loop.remove_signal_handler(sig)
+            except (NotImplementedError, RuntimeError, ValueError):
+                pass
+
+    def _replay_dead_letters(self) -> None:
+        """Re-queue results spooled by a previous run: upload durability
+        across restarts. The file is only discarded after ITS upload
+        succeeds (node/worker.py::_deliver)."""
+        for path, result in self.dead_letters.replay():
+            result["_dead_letter_path"] = str(path)
+            self.result_queue.put_nowait(result)
+            self.stats.results_replayed += 1
+        if self.stats.results_replayed:
+            log.warning("replaying %d dead-letter result(s) from %s",
+                        self.stats.results_replayed,
+                        self.dead_letters.directory)
+
     async def run(self) -> None:
         self.startup()
+        self._replay_dead_letters()
         # bind the health endpoint BEFORE spawning workers: a port clash
         # must fail fast, not leave unsupervised poll/slot tasks running
         health_runner = await self._start_health_server()
-        tasks = [
+        loop = asyncio.get_running_loop()
+        signals = self._install_signal_handlers(loop)
+        slot_tasks = [
             asyncio.create_task(self._slot_worker(slot), name=f"slot{i}")
             for i, slot in enumerate(self.pool)
         ]
-        tasks.append(asyncio.create_task(self._result_worker(),
-                                         name="results"))
-        tasks.append(asyncio.create_task(self._poll_loop(), name="poll"))
+        result_task = asyncio.create_task(self._result_worker(),
+                                          name="results")
+        poll_task = asyncio.create_task(self._poll_loop(), name="poll")
+        tasks = slot_tasks + [result_task, poll_task]
         try:
             await self._stop.wait()
+            await self._shutdown(poll_task, slot_tasks, result_task)
         finally:
             for task in tasks:
                 task.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+            # anything still queued embodies paid chip time: spool it
+            self._spool_unsent_results()
             if health_runner is not None:
                 await health_runner.cleanup()
+            self._remove_signal_handlers(loop, signals)
+
+    async def _shutdown(self, poll_task, slot_tasks, result_task) -> None:
+        """Graceful drain: polling halts first, in-flight slots finish,
+        queued results upload — each phase bounded by its timeout so a
+        wedged dependency cannot hold the process hostage."""
+        log.info("stopping: polling halts; %d queued job(s) + in-flight "
+                 "work drain, then %d pending result(s) upload",
+                 self.work_queue.qsize(), self.result_queue.qsize())
+        poll_task.cancel()
+        await asyncio.gather(poll_task, return_exceptions=True)
+        self._draining.set()
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*slot_tasks, return_exceptions=True),
+                timeout=self.settings.drain_timeout_s)
+        except asyncio.TimeoutError:
+            log.error("slot drain exceeded %.0fs; cancelling in-flight "
+                      "jobs (the hive recovers them via its timeout "
+                      "detector)", self.settings.drain_timeout_s)
+            for task in slot_tasks:
+                task.cancel()
+            await asyncio.gather(*slot_tasks, return_exceptions=True)
+        try:
+            await asyncio.wait_for(
+                self.result_queue.join(),
+                timeout=self.settings.result_drain_timeout_s)
+        except asyncio.TimeoutError:
+            log.error("result drain exceeded %.0fs; unsent results spool "
+                      "to the dead-letter directory",
+                      self.settings.result_drain_timeout_s)
+        result_task.cancel()
+        await asyncio.gather(result_task, return_exceptions=True)
+
+    def _spool_unsent_results(self) -> None:
+        """Shutdown durability: whatever the result worker never got to
+        goes to disk, not to /dev/null."""
+        while True:
+            try:
+                result = self.result_queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            spooled = result.pop("_dead_letter_path", None)
+            if spooled is None:  # replayed results already have a file
+                self.dead_letters.spool(result)
+                self.stats.results_dead_lettered += 1
+            self.result_queue.task_done()
 
     # ---- health endpoint (observability gap fix, SURVEY.md §5: the
     # reference's only health signal is the hive's timeout detection) ----
@@ -209,7 +366,7 @@ class Worker:
     def health(self) -> dict[str, Any]:
         from chiaswarm_tpu import WORKER_VERSION
 
-        return {
+        data = {
             "status": "ok",
             "worker_version": WORKER_VERSION,
             "worker_name": self.settings.worker_name,
@@ -219,7 +376,13 @@ class Worker:
             "jobs_done": self.jobs_done,
             "queue_depth": self.work_queue.qsize(),
             "results_pending": self.result_queue.qsize(),
+            # degradation-ladder observability (node/resilience.py)
+            "breakers": self.breakers.states(),
+            "dead_letter_depth": self.dead_letters.depth(),
+            "poll_consecutive_errors": self._poll_backoff.failures,
         }
+        data.update(self.stats.snapshot())
+        return data
 
     async def _start_health_server(self):
         port = int(self.settings.health_port or 0)
@@ -249,9 +412,16 @@ class Worker:
     async def _poll_loop(self) -> None:
         async with aiohttp.ClientSession() as session:
             while not self._stop.is_set():
-                # natural backpressure: wait for queue space, not a semaphore
-                while self.work_queue.full():
-                    await asyncio.sleep(1)
+                # natural backpressure: wait for queue space — but keep
+                # watching _stop, so a full queue can never stall shutdown
+                while self.work_queue.full() and not self._stop.is_set():
+                    try:
+                        await asyncio.wait_for(self._stop.wait(),
+                                               timeout=1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                if self._stop.is_set():
+                    return
                 delay = await self._ask_for_work(session)
                 try:
                     await asyncio.wait_for(self._stop.wait(), timeout=delay)
@@ -259,18 +429,53 @@ class Worker:
                     pass
 
     async def _ask_for_work(self, session: aiohttp.ClientSession) -> float:
+        """One poll; returns the next delay. Errors back off exponentially
+        with jitter (capped at hive.POLL_ERROR_S by default) and the
+        schedule resets on the first successful poll."""
         try:
             jobs = await self.hive.get_work(session)
         except BadWorkerError as exc:
             log.error("hive flagged this worker: %s", exc)
-            return POLL_ERROR_S
+            return self._poll_backoff.next()
         except Exception as exc:
             log.warning("poll failed: %s", exc)
-            return POLL_ERROR_S
+            return self._poll_backoff.next()
+        self._poll_backoff.reset()
         for job in jobs:
             log.info("got job %s", job.get("id"))
             await self.work_queue.put(job)
-        return POLL_BUSY_S if jobs else POLL_IDLE_S
+        if jobs:
+            return float(self.settings.poll_busy_s)
+        return float(self.settings.poll_idle_s)
+
+    async def _next_job(self) -> dict | None:
+        """Block for the next queued job; returns None once the worker is
+        draining AND the queue is empty (graceful-shutdown exit)."""
+        if self._draining.is_set() and self.work_queue.empty():
+            return None
+        get_task = asyncio.ensure_future(self.work_queue.get())
+        drain_task = asyncio.ensure_future(self._draining.wait())
+        try:
+            await asyncio.wait({get_task, drain_task},
+                               return_when=asyncio.FIRST_COMPLETED)
+            while not get_task.done():
+                # draining with jobs still queued: claim them — but a
+                # sibling slot may win the race for the last one, after
+                # which this get can never be satisfied again (polling
+                # already stopped), so re-check emptiness instead of
+                # blocking the whole drain on it
+                if self.work_queue.empty():
+                    return None
+                await asyncio.wait({get_task}, timeout=0.05)
+            return get_task.result()
+        finally:
+            # no awaits between the queue checks above and these cancels,
+            # and asyncio.Queue re-wakes the next getter when a woken one
+            # is cancelled — a queued job can never be lost here
+            get_task.cancel()
+            drain_task.cancel()
+            await asyncio.gather(get_task, drain_task,
+                                 return_exceptions=True)
 
     async def _slot_worker(self, slot) -> None:
         """Feed one slot, keeping up to ``slot.depth`` jobs in flight.
@@ -295,16 +500,26 @@ class Worker:
 
         async def run_burst(burst: list[dict]) -> None:
             try:
-                if len(burst) == 1:
-                    results = [await do_work(burst[0], slot, self.registry)]
-                else:
-                    results = await do_work_batch(burst, slot,
-                                                  self.registry)
+                results = await self._execute_burst(burst, slot)
                 for result in results:
                     await self.result_queue.put(result)
                     self.jobs_done += 1
-            except Exception as exc:  # keep the loop alive, always
+            except Exception as exc:
+                # fault containment: a crash in the execution path must
+                # never silently eat the burst (the reference's behavior —
+                # the hive would wait out its deadline then flag the whole
+                # worker); every job reports an explicit error envelope
                 log.exception("slot worker error: %s", exc)
+                kind = classify_exception(exc)
+                outcomes: dict[str, set[str]] = {}
+                for job in burst:
+                    self.stats.jobs_failed += 1
+                    outcomes.setdefault(
+                        str(job.get("model_name") or ""), set()).add(kind)
+                    await self.result_queue.put(
+                        error_result(job, exc, kind=kind))
+                    self.jobs_done += 1
+                self._record_outcomes(outcomes)
             finally:
                 inflight.release()
                 for _ in burst:
@@ -330,9 +545,13 @@ class Worker:
                         await asyncio.sleep(0)
                     self._hungry_slots += 1
                     try:
-                        burst = [await self.work_queue.get()]
+                        job = await self._next_job()
                     finally:
                         self._hungry_slots -= 1
+                    if job is None:  # draining and the queue is dry
+                        inflight.release()
+                        break
+                    burst = [job]
                 key = _burst_key(burst[0])
                 rows = rows_max = job_rows(burst[0])
                 per_device = single_chip_rows(burst[0])
@@ -369,6 +588,10 @@ class Worker:
                 task = asyncio.create_task(run_burst(burst))
                 pending.add(task)
                 task.add_done_callback(pending.discard)
+            # graceful drain: in-flight bursts COMPLETE (and their results
+            # reach the result queue) before this slot's task returns
+            if pending:
+                await asyncio.gather(*list(pending), return_exceptions=True)
         finally:
             # a held job was claimed from the queue but never dispatched;
             # put it back so cancellation cannot silently drop it (and
@@ -381,42 +604,187 @@ class Worker:
                               "full (hive recovers it via timeout)",
                               held.get("id"))
                 self.work_queue.task_done()
-            # drain in-flight jobs before the loop closes: cancel, then
-            # AWAIT them so their finally blocks (queue bookkeeping) run
-            # and no pending task outlives the event loop
+            # forced-cancel path: cancel in-flight jobs, then AWAIT them
+            # so their finally blocks (queue bookkeeping) run and no
+            # pending task outlives the event loop
             for task in list(pending):
                 task.cancel()
             if pending:
                 await asyncio.gather(*list(pending), return_exceptions=True)
 
-    RESULT_RETRIES = 3
-    RESULT_RETRY_DELAY_S = 5.0
+    # ---- execution with deadlines + the degradation ladder ----
+
+    async def _attempt(self, jobs: list[dict], slot) -> list[dict]:
+        """One executor call under the per-workflow deadline. A timed-out
+        attempt yields explicit timeout envelopes — the hive hears about
+        it NOW, not when its own worker-level detector fires. (The
+        abandoned executor thread finishes in the background and its
+        result is discarded; run_in_executor work is not interruptible.)
+        """
+        budget = max(self.settings.deadline_for(job.get("workflow"))
+                     for job in jobs)
+        executor = self._executor
+        if len(jobs) == 1:
+            dw = executor.do_work if executor is not None else do_work
+            call = dw(jobs[0], slot, self.registry)
+        else:
+            dwb = (executor.do_work_batch if executor is not None
+                   else do_work_batch)
+            call = dwb(jobs, slot, self.registry)
+        try:
+            out = await asyncio.wait_for(call, timeout=budget)
+        except asyncio.TimeoutError:
+            self.stats.jobs_timed_out += len(jobs)
+            log.error("burst %s exceeded its %.0fs deadline",
+                      [job.get("id") for job in jobs], budget)
+            return [error_result(
+                job, f"job exceeded the node's {budget:.0f}s execution "
+                     f"deadline", kind="timeout") for job in jobs]
+        except Exception as exc:
+            # the real executor renders its own failures as envelopes, so
+            # anything raising THROUGH it is a genuine crash — contain it
+            # at the job level with explicit envelopes (the reference
+            # silently eats such jobs; the hive then times out the whole
+            # worker, swarm/worker.py:92-97)
+            log.exception("executor crashed on burst %s",
+                          [job.get("id") for job in jobs])
+            kind = classify_exception(exc)
+            return [error_result(job, exc, kind=kind) for job in jobs]
+        results = [out] if len(jobs) == 1 else list(out)
+        # never let a miscounting executor silently drop a job
+        while len(results) < len(jobs):
+            results.append(error_result(
+                jobs[len(results)], "executor returned no result for this "
+                "job", kind="error"))
+        return results
+
+    async def _execute_burst(self, burst: list[dict], slot) -> list[dict]:
+        """Run a burst through the degradation ladder:
+
+        1. circuit-breaker gate — jobs for quarantined models get an
+           immediate (non-fatal) refusal envelope, no chip time burned;
+        2. one batched attempt under the deadline;
+        3. jobs that failed transiently (image-fetch blip, device OOM)
+           re-run SOLO with capped backoff + jitter — an OOM'd coalesced
+           burst thereby splits and re-runs serially;
+        4. final outcomes feed the per-model breakers.
+        """
+        results: list[dict | None] = [None] * len(burst)
+        ready: list[int] = []
+        for i, job in enumerate(burst):
+            model = str(job.get("model_name") or "")
+            if model and not self.breakers.allow(model):
+                self.stats.jobs_failed += 1
+                self.stats.jobs_quarantined += 1
+                # NOT fatal: this node refuses, another node may serve it
+                results[i] = error_result(
+                    job, f"model {model!r} is quarantined on this node "
+                         f"(circuit breaker open)", kind="quarantined")
+            else:
+                ready.append(i)
+        if ready:
+            attempt = await self._attempt([burst[i] for i in ready], slot)
+            for i, result in zip(ready, attempt):
+                results[i] = result
+        max_retries = max(0, int(self.settings.transient_retries))
+        outcomes: dict[str, set[str]] = {}
+        for i in ready:
+            kind = classify_result(results[i])
+            for retry in range(1, max_retries + 1):
+                if kind not in RETRYABLE_KINDS:
+                    break
+                delay = backoff_delay(retry, self.settings.retry_backoff_s,
+                                      self.settings.retry_backoff_cap_s,
+                                      self._retry_rng)
+                log.warning("job %s hit a %s fault; solo re-run %d/%d "
+                            "in %.2fs", burst[i].get("id"), kind, retry,
+                            max_retries, delay)
+                self.stats.jobs_retried += 1
+                await asyncio.sleep(delay)
+                results[i] = (await self._attempt([burst[i]], slot))[0]
+                kind = classify_result(results[i])
+            if kind != "ok":
+                self.stats.jobs_failed += 1
+            outcomes.setdefault(
+                str(burst[i].get("model_name") or ""), set()).add(kind)
+        self._record_outcomes(outcomes)
+        return [result for result in results if result is not None]
+
+    def _record_outcomes(self, outcomes: dict[str, set[str]]) -> None:
+        """Feed the per-model circuit breakers, ONE record per model per
+        burst: a single burst-level incident (e.g. a deadline expiry on
+        an N-job coalesced burst) must count as one "consecutive"
+        failure, not N — or one cold compile could quarantine a healthy
+        model. Which kinds count is resilience.BREAKER_KINDS policy:
+        model-load failures, timeouts, OOM that survived the ladder, and
+        unclassified execution errors — NOT fatal user-input errors (K
+        bad requests in a row must not quarantine a healthy model) and
+        NOT transient network faults. A success for the model anywhere in
+        the burst proves it serves and wins over same-burst failures."""
+        for model, kinds in outcomes.items():
+            if not model:
+                continue
+            if "ok" in kinds:
+                self.breakers.record(model, ok=True)
+            elif kinds & BREAKER_KINDS:
+                self.breakers.record(model, ok=False)
+            else:
+                # says nothing about the model — but if this burst held
+                # the half-open probe, free the slot for the next one
+                self.breakers.record_inconclusive(model)
+
+    # ---- result upload with durability ----
 
     async def _result_worker(self) -> None:
         async with aiohttp.ClientSession() as session:
             while True:
                 result = await self.result_queue.get()
                 try:
-                    await self._upload_with_retry(session, result)
+                    await self._deliver(session, result)
                 finally:
                     self.result_queue.task_done()
 
-    async def _upload_with_retry(self, session, result) -> None:
+    async def _deliver(self, session, result: dict) -> None:
         """A completed job's result embodies real chip time; a transient
         upload blip must not discard it (and a dropped result gets this
-        worker flagged by the hive's timeout-based failure detection)."""
-        for attempt in range(1, self.RESULT_RETRIES + 1):
+        worker flagged by the hive's timeout-based failure detection).
+        Exhausted retries spool the envelope to the dead-letter directory
+        for replay on the next startup."""
+        spooled = result.pop("_dead_letter_path", None)
+        try:
+            uploaded = await self._upload_with_retry(session, result)
+        except asyncio.CancelledError:
+            # shutdown cancelled us mid-upload: persist before dying
+            if spooled is None:
+                self.dead_letters.spool(result)
+                self.stats.results_dead_lettered += 1
+            raise
+        if uploaded:
+            if spooled is not None:
+                self.dead_letters.discard(spooled)
+        elif spooled is None:
+            self.dead_letters.spool(result)
+            self.stats.results_dead_lettered += 1
+        # a replayed result that failed again keeps its existing file
+
+    async def _upload_with_retry(self, session, result) -> bool:
+        retries = max(1, int(self.settings.upload_retries))
+        for attempt in range(1, retries + 1):
             try:
                 response = await self.hive.post_result(session, result)
-                log.info("uploaded result %s: %s", result.get("id"), response)
-                return
+                log.info("uploaded result %s: %s", result.get("id"),
+                         response)
+                return True
             except Exception as exc:
+                self.stats.upload_retries += 1
                 log.warning("result upload attempt %d/%d failed: %s",
-                            attempt, self.RESULT_RETRIES, exc)
-                if attempt < self.RESULT_RETRIES:
-                    await asyncio.sleep(self.RESULT_RETRY_DELAY_S * attempt)
-        log.error("dropping result %s after %d failed uploads",
-                  result.get("id"), self.RESULT_RETRIES)
+                            attempt, retries, exc)
+                if attempt < retries:
+                    await asyncio.sleep(backoff_delay(
+                        attempt, self.settings.upload_retry_delay_s,
+                        self.settings.poll_backoff_cap_s,
+                        self._retry_rng))
+        return False
 
 
 async def run_worker(settings: Settings | None = None) -> None:

@@ -79,4 +79,5 @@ from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
     jit_hygiene,
     prng,
     recompile,
+    scan_carry,
 )

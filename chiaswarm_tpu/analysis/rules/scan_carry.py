@@ -1,0 +1,202 @@
+"""R7 scan-carry-dtype: mixed-precision loop bodies must pin the carry
+dtype before returning it.
+
+``lax.scan``/``while_loop``/``fori_loop`` require the carry's dtype to be
+invariant across iterations; a body that upcasts to a compute dtype
+(``x.astype(jnp.float32)``) and returns the result un-pinned either fails
+at trace time (scan) or — in a HOST-driven step loop like the continuous
+batcher's per-row carry (serving/stepper.py) — silently recompiles every
+iteration and corrupts multistep state that straddles the promotion. The
+repo's sampler pins its carry explicitly
+(``x_next.astype(sample.dtype)``, schedulers/sampling.py) — this rule
+enforces that discipline.
+
+Heuristic: for every function syntactically passed as the body of
+``jax.lax.scan`` (arg 0), ``jax.lax.while_loop`` (arg 1) or
+``jax.lax.fori_loop`` (arg 2) — or bound via ``f=``/``body_fun=`` — if
+the body contains at least one explicit dtype cast (``.astype(...)`` or a
+``jnp.float32/bfloat16/float16(...)`` constructor), then the returned
+carry (the first element of a scan body's return tuple; the whole return
+value otherwise) must be dtype-pinned: an ``.astype(...)`` call, a name
+whose last assignment was one, or a parameter returned untouched. Bodies
+without casts are single-precision and stay silent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+)
+from chiaswarm_tpu.analysis.rules import FUNC_NODES, own_nodes, resolves_to
+
+#: loop primitive -> positional index of the body callable
+_LOOP_BODY_ARG = {
+    "jax.lax.scan": 0,
+    "lax.scan": 0,
+    "jax.lax.while_loop": 1,
+    "lax.while_loop": 1,
+    "jax.lax.fori_loop": 2,
+    "lax.fori_loop": 2,
+}
+_BODY_KEYWORDS = ("f", "body_fun", "body")
+
+_CAST_CONSTRUCTORS = ("jax.numpy.float32", "jax.numpy.bfloat16",
+                      "jax.numpy.float16", "jax.numpy.float64")
+
+
+@register
+class ScanCarryDtype(Rule):
+    code = "R7"
+    name = "scan-carry-dtype"
+    description = ("mixed-precision scan/loop bodies must pin the carry "
+                   "dtype (.astype) before returning it — a promoted "
+                   "carry breaks lax.scan and silently recompiles "
+                   "host-driven step loops")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        bodies: dict[ast.AST, str] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.resolve_call(node)
+            idx = None
+            for name, pos in _LOOP_BODY_ARG.items():
+                if resolves_to(target, name):
+                    idx = pos
+                    kind = name.rsplit(".", 1)[-1]
+                    break
+            if idx is None:
+                continue
+            body_expr = None
+            if len(node.args) > idx:
+                body_expr = node.args[idx]
+            else:
+                for kw in node.keywords:
+                    if kw.arg in _BODY_KEYWORDS:
+                        body_expr = kw.value
+                        break
+            fn = self._resolve_body(ctx, body_expr)
+            if fn is not None:
+                bodies[fn] = kind
+        for fn, kind in bodies.items():
+            yield from self._check_body(ctx, fn, kind)
+
+    @staticmethod
+    def _resolve_body(ctx: ModuleContext, expr) -> ast.AST | None:
+        if isinstance(expr, FUNC_NODES):
+            return expr
+        if isinstance(expr, ast.Name):
+            for info in ctx.functions:
+                node = info.node
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.name == expr.id:
+                    return node
+        return None
+
+    def _check_body(self, ctx: ModuleContext, fn: ast.AST,
+                    kind: str) -> Iterator[Finding]:
+        nodes = list(own_nodes(fn))
+        has_cast = False
+        pinned_names: set[str] = set()
+        reassigned: set[str] = set()
+        for node in nodes:
+            if self._is_cast(ctx, node):
+                has_cast = True
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        reassigned.add(t.id)
+                        # an astype pins a name — unless it is itself a
+                        # float promotion (x.astype(jnp.float32))
+                        if self._is_astype(node.value) and \
+                                not self._is_cast(ctx, node.value):
+                            pinned_names.add(t.id)
+                        else:
+                            pinned_names.discard(t.id)
+        if not has_cast:
+            return
+        params: set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        elif isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args}
+        untouched = params - reassigned
+
+        for node in nodes:
+            carry = self._carry_expr(node, fn, kind)
+            if carry is None:
+                continue
+            if not self._pinned(ctx, carry, pinned_names, untouched):
+                yield self.finding(
+                    ctx, carry,
+                    f"{kind} body mixes dtypes (explicit cast present) "
+                    f"but returns its carry un-pinned — a promoted carry "
+                    f"dtype breaks the loop or recompiles per step; "
+                    f"return carry.astype(<carry-in dtype>) instead")
+                return  # one finding per body
+
+    @staticmethod
+    def _carry_expr(node, fn, kind):
+        if isinstance(fn, ast.Lambda):
+            value = fn.body if node is fn.body else None
+        elif isinstance(node, ast.Return):
+            value = node.value
+        else:
+            return None
+        if value is None:
+            return None
+        if kind == "scan" and isinstance(value, ast.Tuple) and value.elts:
+            return value.elts[0]  # scan returns (carry, per-step output)
+        return value
+
+    @classmethod
+    def _pinned(cls, ctx: ModuleContext, expr, pinned_names: set[str],
+                untouched_params: set[str]) -> bool:
+        if isinstance(expr, ast.Tuple):
+            return all(cls._pinned(ctx, e, pinned_names, untouched_params)
+                       for e in expr.elts)
+        if cls._is_cast(ctx, expr):
+            # returning an explicit FLOAT promotion (``jnp.float32(y)``,
+            # ``y.astype(jnp.bfloat16)``) IS the hazard, not a pin
+            return False
+        if cls._is_astype(expr):
+            return True  # .astype(x.dtype)-style pin
+        if isinstance(expr, ast.Name):
+            return (expr.id in pinned_names
+                    or expr.id in untouched_params)
+        if isinstance(expr, ast.Call):
+            # opaque helper calls (``sampler_step(...)``-shaped carries)
+            # get the benefit of the doubt — pinning may happen inside
+            return True
+        return False
+
+    @staticmethod
+    def _is_astype(expr) -> bool:
+        return (isinstance(expr, ast.Call)
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr == "astype")
+
+    @classmethod
+    def _is_cast(cls, ctx: ModuleContext, node) -> bool:
+        """Only FLOAT dtype casts count as mixed precision: integer/bool
+        casts (token ids, loop counters) cannot silently promote a bf16
+        carry, and ``.astype(x.dtype)`` is the PIN, not a hazard."""
+        if not isinstance(node, ast.Call):
+            return False
+        if cls._is_astype(node):
+            if not node.args:
+                return False
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return "float" in arg.value
+            target = ctx.resolve(arg)
+            return bool(target) and any(
+                resolves_to(target, c) for c in _CAST_CONSTRUCTORS)
+        target = ctx.resolve_call(node)
+        return resolves_to(target, *_CAST_CONSTRUCTORS)

@@ -84,6 +84,140 @@ def _bench_diffusion(pipe, *, size: int, steps: int, batch: int, iters: int,
     return out
 
 
+def _bench_mixed_arrival(*, on_tpu: bool, attn: str) -> dict:
+    """Continuous step-level admission (serving/stepper.py) vs burst-only
+    coalescing under STAGGERED mixed-steps arrivals — the traffic shape
+    the burst path cannot batch at all: jobs arrive in different polls
+    and with different step counts, so `synchronous_do_work_batch` runs
+    every one as a solo program while the step scheduler splices each
+    into the resident lane at the next step boundary.
+
+    Runs on a dp-sharded mesh slot when enough devices exist (the virtual
+    8-device CPU mesh in CI): a solo batch-1 program replicates over the
+    data axis, wasting (dp-1)/dp of the slot — exactly what lane
+    occupancy recovers. `sharded_rows` rides the opt-in
+    CHIASWARM_STEPPER_SHARD_ROWS knob; on the pinned jax build the
+    sharded step program has a known numerics divergence (ROADMAP), so
+    this config measures THROUGHPUT mechanics, and serving keeps the
+    knob off until that is debugged."""
+    import os
+    import time
+
+    import jax
+
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
+    from chiaswarm_tpu.serving.stepper import StepScheduler
+
+    fam = "sd15" if on_tpu else "tiny"
+    size = 512 if on_tpu else 64
+    steps_mix = [20, 25, 30] if on_tpu else [6, 8, 10]
+    n_dev = len(jax.devices())
+    if n_dev >= 8:
+        mesh = build_mesh(MeshSpec({"data": 4, "model": 2}))
+    elif n_dev >= 2:
+        mesh = build_mesh(MeshSpec({"data": n_dev}))
+    else:
+        mesh = None
+    dp = 1 if mesh is None else dict(
+        zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+
+    saved = {k: os.environ.get(k) for k in
+             ("CHIASWARM_STEPPER_LANE_WIDTH", "CHIASWARM_STEPPER_SHARD_ROWS")}
+    os.environ["CHIASWARM_STEPPER_LANE_WIDTH"] = str(max(2, dp))
+    os.environ["CHIASWARM_STEPPER_SHARD_ROWS"] = "1" if dp > 1 else "0"
+    try:
+        registry = ModelRegistry(
+            catalog=[{"name": fam, "family": fam, "parameters": {}}],
+            allow_random=True, attn_impl=attn)
+        pipe = registry.pipeline(fam, mesh=mesh)
+        jobs = [(f"job {i}", steps_mix[i % len(steps_mix)], 300 + i)
+                for i in range(8)]
+
+        def req(prompt, steps, seed):
+            return GenerateRequest(prompt=prompt, steps=steps,
+                                   guidance_scale=7.5, height=size,
+                                   width=size, seed=seed)
+
+        # warm every solo program + the lane executables
+        for steps in sorted(set(s for _, s, _ in jobs)):
+            pipe(req("warm", steps, 0))
+        sched = StepScheduler()
+        sched.submit_request(pipe, prompt="warm", steps=max(steps_mix),
+                             guidance_scale=7.5, height=size, width=size,
+                             rows=1, seed=0).result(timeout=600)[0].wait()
+        s0 = dict(sched.stats())
+        t0 = time.perf_counter()
+        sched.submit_request(pipe, prompt="warm2", steps=max(steps_mix),
+                             guidance_scale=7.5, height=size, width=size,
+                             rows=1, seed=1).result(timeout=600)[0].wait()
+        step_t = (time.perf_counter() - t0) / max(
+            1, sched.stats()["steps_executed"] - s0["steps_executed"])
+        # arrivals one lane-step apart: several polls' worth of traffic
+        # lands while any one job is still denoising — the regime burst
+        # coalescing serves as N solo programs
+        stagger = step_t
+
+        def arrivals(run_one):
+            t_start = time.perf_counter()
+            handles = []
+            for i, job in enumerate(jobs):
+                target = t_start + i * stagger
+                now = time.perf_counter()
+                if now < target:
+                    time.sleep(target - now)
+                handles.append(run_one(job))
+            return t_start, handles
+
+        # burst-only reality for this arrival stream: one solo program
+        # per job (mixed steps never share a _coalesce_key), submit/wait
+        # pipelined like the serving slots
+        t_start, handles = arrivals(
+            lambda job: pipe.submit(req(*job))[0])
+        for pending in handles:
+            pending.wait()
+        burst_total = time.perf_counter() - t_start
+
+        before = dict(sched.stats())
+        t_start, handles = arrivals(
+            lambda job: sched.submit_request(
+                pipe, prompt=job[0], steps=job[1], guidance_scale=7.5,
+                height=size, width=size, rows=1, seed=job[2]))
+        for fut in handles:
+            fut.result(timeout=600)[0].wait()
+        cont_total = time.perf_counter() - t_start
+        after = dict(sched.stats())
+        sched.shutdown()
+
+        active = after["row_steps_active"] - before["row_steps_active"]
+        padded = (after.get("row_steps_padded", 0)
+                  - before.get("row_steps_padded", 0))
+        denom = max(1, active + padded)
+        return {
+            "jobs": len(jobs),
+            "steps_mix": steps_mix,
+            "stagger_s": round(stagger, 4),
+            "images_per_sec_continuous": round(len(jobs) / cont_total, 4),
+            "images_per_sec_burst_only": round(len(jobs) / burst_total, 4),
+            "speedup": round(burst_total / cont_total, 4),
+            "lane_occupancy": round(active / denom, 4),
+            "padding_waste": round(padded / denom, 4),
+            "rows_admitted_midflight": (
+                after.get("rows_admitted_midflight", 0)
+                - before.get("rows_admitted_midflight", 0)),
+            "lane_width": max(2, dp),
+            "mesh_data_axis": dp,
+            "sharded_rows": dp > 1,
+        }
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
 def run_configs(names: list[str], *, on_tpu: bool, iters: int,
                 attn: str) -> dict:
     import jax
@@ -193,6 +327,12 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
             }
         del ipipe, vc
 
+    if "stepper" in names:
+        # ISSUE 3: steady-state throughput under staggered mixed-steps
+        # arrivals — continuous step-level admission vs the burst path
+        results["stepper_mixed_arrival"] = _bench_mixed_arrival(
+            on_tpu=on_tpu, attn=attn)
+
     if "txt2vid" in names:
         # the model class the reference actually serves for video
         # (ModelScope-class temporal UNet, swarm/video/tx2vid.py)
@@ -281,7 +421,8 @@ def main() -> None:
 
     configs = {"sdxl_txt2img_1024": headline}
     if which != "headline":
-        names = (["sd15", "sd21", "controlnet", "img2vid", "txt2vid"]
+        names = (["sd15", "sd21", "controlnet", "img2vid", "stepper",
+                  "txt2vid"]
                  if which == "all" else which.split(","))
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))

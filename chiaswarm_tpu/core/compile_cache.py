@@ -112,6 +112,27 @@ def bucket_batch(n: int) -> int:
     raise ValueError(f"batch {n} exceeds supported maximum {_POW2[-1]}")
 
 
+_STEP_BUCKETS = (16, 32, 64, 128)
+
+
+def bucket_steps(n: int) -> int:
+    """Round a denoise step count up to the lane capacity lattice.
+
+    The step scheduler (serving/stepper.py) compiles ONE resident step
+    program per lane whose per-row sigma/timestep tables are sized to
+    this capacity; bucketing keeps the lane-program count bounded while
+    letting jobs with different step counts share a lane. The step
+    program executes one step per call, so capacity padding costs table
+    memory only — never compute."""
+    if n < 1:
+        raise ValueError("steps must be >= 1")
+    for cap in _STEP_BUCKETS:
+        if n <= cap:
+            return cap
+    raise ValueError(
+        f"steps {n} exceeds the lane capacity maximum {_STEP_BUCKETS[-1]}")
+
+
 def bucket_image_size(height: int, width: int, *, multiple: int = 64,
                       min_size: int = 64, max_size: int = 1024) -> tuple[int, int]:
     """Snap a requested image size onto the compiled lattice.

@@ -174,12 +174,72 @@ def _execute(job_id, content_type, callback, kwargs, slot) -> dict:
     return _result(job_id, artifacts, config)
 
 
+def _stepper_submit(job_id, content_type, callback, kwargs, slot,
+                    registry):
+    """Submit an eligible txt2img job to the slot's continuous step
+    scheduler (serving/stepper.py). Returns a ticket or None (run the
+    job through the ordinary burst/solo path instead). Submission
+    failures are never terminal for the job — it just falls back."""
+    from chiaswarm_tpu.workloads.diffusion import (
+        diffusion_callback,
+        stepper_eligible,
+        stepper_submit,
+    )
+
+    if callback is not diffusion_callback or not stepper_eligible(kwargs):
+        return None
+    from chiaswarm_tpu.core.rng import draw_seed
+    from chiaswarm_tpu.serving.stepper import LaneReject
+
+    seed = kwargs.get("seed")
+    seed = draw_seed() if seed is None else int(seed)
+    try:
+        return stepper_submit(slot, registry, kwargs, seed, job_id=job_id)
+    except LaneReject as exc:
+        log.debug("job %s not lane-eligible (%s)", job_id, exc)
+        return None
+    except Exception as exc:
+        log.warning("job %s lane submit failed (%s); per-job path",
+                    job_id, exc)
+        return None
+
+
+def _stepper_collect(job_id, content_type, slot, ticket) -> dict | None:
+    """Wait out a lane ticket. Returns the finished result, a timeout
+    envelope (in-lane deadline expiry), or None — meaning the job must
+    re-run through the per-job path (lane fault; zero-loss fallback)."""
+    from chiaswarm_tpu.serving.stepper import LaneDeadline
+    from chiaswarm_tpu.workloads.diffusion import stepper_finish
+
+    try:
+        artifacts, config = stepper_finish(ticket)
+    except LaneDeadline as exc:
+        return error_result({"id": job_id, "content_type": content_type},
+                            exc, kind="timeout")
+    except Exception as exc:
+        kind = classify_exception(exc)
+        if kind == "oom":
+            from chiaswarm_tpu.serving.stepper import get_stepper
+
+            get_stepper(slot).note_oom()  # rebuild lanes narrower
+        log.warning("job %s lane run failed (%s: %s); per-job path",
+                    job_id, kind, exc)
+        return None
+    return _result(job_id, artifacts, config)
+
+
 def synchronous_do_work(job: dict[str, Any], slot,
                         registry: ModelRegistry) -> dict[str, Any]:
     log.info("processing job %s", job.get("id"))
     formatted, fatal = _format(job, registry)
     if formatted is None:
         return fatal
+    job_id, content_type, _, _ = formatted
+    ticket = _stepper_submit(*formatted, slot, registry)
+    if ticket is not None:
+        result = _stepper_collect(job_id, content_type, slot, ticket)
+        if result is not None:
+            return result
     return _execute(*formatted, slot)
 
 
@@ -292,6 +352,9 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
     results: list[dict | None] = [None] * len(jobs)
     groups: dict[Any, list[tuple[int, Any, str, dict]]] = {}
     singles: list[tuple[int, Any, str, Any, dict]] = []
+    # lane tickets: eligible jobs are submitted FIRST so their rows
+    # splice into running lanes while the rest of the burst executes
+    tickets: list[tuple[int, Any, str, dict, Any]] = []
     for i, job in enumerate(jobs):
         log.info("processing job %s (burst of %d)", job.get("id"),
                  len(jobs))
@@ -301,6 +364,11 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
             continue
         job_id, content_type, callback, kwargs = formatted
         if callback is diffusion_callback and coalescable(kwargs):
+            ticket = _stepper_submit(job_id, content_type, callback,
+                                     kwargs, slot, registry)
+            if ticket is not None:
+                tickets.append((i, job_id, content_type, kwargs, ticket))
+                continue
             groups.setdefault(_coalesce_key(kwargs), []).append(
                 (i, job_id, content_type, kwargs))
         else:
@@ -359,6 +427,16 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
             for i, job_id, content_type, kwargs in group:
                 singles.append((i, job_id, content_type,
                                 diffusion_callback, kwargs))
+
+    # collect lane tickets after the burst groups dispatched: a failed
+    # lane row falls back to the per-job path below (zero-loss)
+    for i, job_id, content_type, kwargs, ticket in tickets:
+        result = _stepper_collect(job_id, content_type, slot, ticket)
+        if result is not None:
+            results[i] = result
+        else:
+            singles.append((i, job_id, content_type, diffusion_callback,
+                            kwargs))
 
     for i, job_id, content_type, callback, kwargs in singles:
         results[i] = _execute(job_id, content_type, callback, kwargs, slot)

@@ -94,8 +94,20 @@ def _burst_key(job: dict) -> tuple | None:
     if params.get("controlnet") or params.get("upscale"):
         return None
     image = job.get("image")
+    steps = job.get("num_inference_steps")
+    guidance = job.get("guidance_scale")
+    if not (job.get("start_image_uri") or image is not None
+            or job.get("mask_image_uri")
+            or job.get("mask_image") is not None):
+        from chiaswarm_tpu.serving.stepper import stepper_enabled
+
+        if stepper_enabled():
+            # lanes carry steps + guidance PER ROW (serving/stepper.py):
+            # plain txt2img jobs differing only in those two fields drain
+            # as one burst and splice into one lane
+            steps = guidance = None
     return (model, job.get("height"), job.get("width"),
-            job.get("num_inference_steps"), job.get("guidance_scale"),
+            steps, guidance,
             job.get("lora"), job.get("textual_inversion"),
             job.get("cross_attention_scale"),
             # mode split: generation vs img2img vs inpaint (+ inline
@@ -335,6 +347,14 @@ class Worker:
             for task in slot_tasks:
                 task.cancel()
             await asyncio.gather(*slot_tasks, return_exceptions=True)
+        # retire step-scheduler lanes: drained bursts already collected
+        # their rows; anything still resident (abandoned executor threads
+        # after a timed-out drain) fails over to the per-job path or an
+        # envelope — rows are never silently dropped
+        for slot in self.pool:
+            stepper = getattr(slot, "_stepper", None)
+            if stepper is not None:
+                stepper.shutdown()
         try:
             await asyncio.wait_for(
                 self.result_queue.join(),
@@ -382,6 +402,23 @@ class Worker:
             "poll_consecutive_errors": self._poll_backoff.failures,
         }
         data.update(self.stats.snapshot())
+        data["stepper"] = self._stepper_health()
+        return data
+
+    def _stepper_health(self) -> dict[str, Any]:
+        """Step-scheduler counters next to the resilience stats: lane
+        occupancy vs padding waste, rows spliced mid-flight, steps
+        executed — the signals an operator tunes lane width by."""
+        from chiaswarm_tpu.serving.stepper import (
+            aggregate_stats,
+            stepper_enabled,
+        )
+
+        steppers = [st for st in
+                    (getattr(slot, "_stepper", None) for slot in self.pool)
+                    if st is not None]
+        data = {"enabled": stepper_enabled()}
+        data.update(aggregate_stats(steppers))
         return data
 
     async def _start_health_server(self):

@@ -43,14 +43,17 @@ from chiaswarm_tpu.models.vae import AutoencoderKL
 from chiaswarm_tpu.pipelines.components import Components
 from chiaswarm_tpu.schedulers import (
     SamplerConfig,
+    SamplingSchedule,
     make_noise_schedule,
     make_sampling_schedule,
     resolve,
     sampler_step,
+    sampler_step_rows,
     scale_model_input,
+    scale_model_input_rows,
 )
 from chiaswarm_tpu.schedulers.common import ScheduleConfig
-from chiaswarm_tpu.schedulers.sampling import init_sampler_state
+from chiaswarm_tpu.schedulers.sampling import SamplerState, init_sampler_state
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,6 +103,22 @@ class GenerateRequest:
     control_scale: float = 1.0             # traced; never recompiles
     # instruct-pix2pix dual guidance (image_conditioned families)
     image_guidance_scale: float = 1.5      # traced; never recompiles
+
+
+def _make_text_encode(text_encoders):
+    """Trace-time text-encode over a tuple of encoder modules — shared by
+    the solo generate program and the step scheduler's context-encode
+    executable so both produce identical embeddings for a row."""
+    def encode_text(params, ids_list):
+        seqs, pooled = [], None
+        for i, te in enumerate(text_encoders):
+            seq, pool = te.apply(params[f"text_encoder_{i}"], ids_list[i])
+            seqs.append(seq)
+            pooled = pool  # SDXL: pooled comes from the last encoder
+        return (jnp.concatenate(seqs, axis=-1)
+                if len(seqs) > 1 else seqs[0]), pooled
+
+    return encode_text
 
 
 def _params_mesh(params):
@@ -232,13 +251,7 @@ class DiffusionPipeline:
                 fam.unet.block_out_channels[0],
                 downscale=fam.vae.downscale)
 
-        def encode_text(params, ids_list):
-            seqs, pooled = [], None
-            for i, te in enumerate(text_encoders):
-                seq, pool = te.apply(params[f"text_encoder_{i}"], ids_list[i])
-                seqs.append(seq)
-                pooled = pool  # SDXL: pooled comes from the last encoder
-            return jnp.concatenate(seqs, axis=-1) if len(seqs) > 1 else seqs[0], pooled
+        encode_text = _make_text_encode(text_encoders)
 
         pix2pix = fam.image_conditioned
 
@@ -425,6 +438,145 @@ class DiffusionPipeline:
                     params, x, key, method=AutoencoderKL.encode)))
         z = fn(self.c.params["vae"], jnp.asarray(img), key_for_seed(seed))
         return z[:n]
+
+    # ---------- step-scheduler executables (serving/stepper.py) ----------
+    #
+    # Continuous step-level batching decomposes the solo generate program
+    # into four resident executables per lane bucket: context encode, row
+    # init (initial noise draw), ONE denoise step over the whole lane
+    # (per-row timesteps/sigmas — rows at different progress coexist),
+    # and VAE decode for retiring rows. All four ride the global
+    # executable LRU, so admitting a row never compiles anything: the
+    # lane-program count is bounded by the (batch, size, steps-capacity,
+    # sampler) buckets alone.
+
+    def stepper_encode_fn(self, *, batch: int):
+        """(params, ids, neg_ids) -> (ctx_u, ctx_c, pooled_u, pooled_c)
+        for ``batch`` rows — the admission-time text encode. Same
+        per-row math as the solo program's in-trace encode."""
+        text_encoders = tuple(self.c.text_encoders)
+
+        def build():
+            encode_text = _make_text_encode(text_encoders)
+
+            def fn(params, ids, neg_ids):
+                ctx_c, pooled_c = encode_text(params, ids)
+                ctx_u, pooled_u = encode_text(params, neg_ids)
+                return ctx_u, ctx_c, pooled_u, pooled_c
+
+            return seq_parallel_wrap(toplevel_jit(fn), self.c.params)
+
+        return GLOBAL_CACHE.cached_executable(
+            static_cache_key(id(self.c), "stepper_encode",
+                             {"batch": batch}), build)
+
+    def stepper_row_init_fn(self, *, batch: int, height: int, width: int):
+        """(sample_keys, sigma0) -> (carry_keys, x0): the initial split +
+        noise draw for freshly admitted rows. Identical to the solo
+        program's prologue (split, draw, scale by sigma[start]), so a
+        spliced row starts on exactly its solo trajectory."""
+        fam = self.c.family
+        lh, lw = self._latent_hw(height, width)
+
+        def build():
+            def fn(sample_keys, sigma0):
+                both = jax.vmap(jax.random.split)(sample_keys)
+                carry, nkeys = both[:, 0], both[:, 1]
+                noise = jax.vmap(lambda k: jax.random.normal(
+                    k, (lh, lw, fam.vae.latent_channels), jnp.float32)
+                )(nkeys)
+                return carry, noise * sigma0.reshape(-1, 1, 1, 1)
+
+            return toplevel_jit(fn)
+
+        return GLOBAL_CACHE.cached_executable(
+            static_cache_key(id(self.c), "stepper_init",
+                             {"batch": batch, "height": height,
+                              "width": width}), build)
+
+    def stepper_step_fn(self, *, batch: int, height: int, width: int,
+                        steps_cap: int, sampler: SamplerConfig):
+        """ONE denoise step over a full lane of ``batch`` rows.
+
+        Per-row traced state: latents, carry keys, step index, start
+        index, sigma/timestep tables (each row owns its ladder, padded to
+        ``steps_cap``), guidance scale, multistep history, active mask.
+        Inactive (padding / retired) rows compute and are discarded by
+        the mask — their carries freeze, so a row admitted into their
+        slot later starts clean. Classifier-free guidance is always
+        compiled in; per-row guidance rides as a traced vector.
+        """
+        fam = self.c.family
+        unet = self.c.unet
+        lh, lw = self._latent_hw(height, width)
+        needs_xl = fam.unet.addition_embed_dim is not None
+
+        def build():
+            def fn(params, ctx_u, ctx_c, pooled_u, pooled_c, x, carry_keys,
+                   idx, start_idx, sigmas_tab, ts_tab, guidance,
+                   old_denoised, active):
+                sched_rows = SamplingSchedule(sigmas=sigmas_tab,
+                                              timesteps=ts_tab)
+                inp = scale_model_input_rows(sched_rows, x, idx)
+                t = jax.vmap(lambda ts, i: ts[i])(ts_tab, idx)
+                ctx = jnp.concatenate([ctx_u, ctx_c], axis=0)
+                inp2 = jnp.concatenate([inp, inp], axis=0)
+                t2 = jnp.concatenate([t, t], axis=0)
+                added = None
+                if needs_xl:
+                    time_ids = jnp.asarray(
+                        [height, width, 0, 0, height, width], jnp.float32
+                    )[None, :].repeat(2 * batch, axis=0)
+                    pooled = jnp.concatenate([pooled_u, pooled_c], axis=0)
+                    added = {"time_ids": time_ids,
+                             "text_embeds":
+                                 pooled[:, : fam.unet.addition_pooled_dim]}
+                out = unet.apply(params["unet"], inp2, t2, ctx, added)
+                eps_u, eps_c = jnp.split(out, 2, axis=0)
+                eps = eps_u + guidance.reshape(-1, 1, 1, 1) * (eps_c - eps_u)
+                both = jax.vmap(jax.random.split)(carry_keys)
+                keys, skeys = both[:, 0], both[:, 1]
+                step_noise = jax.vmap(lambda k: jax.random.normal(
+                    k, (lh, lw, fam.vae.latent_channels), jnp.float32)
+                )(skeys)
+                x_next, state = sampler_step_rows(
+                    sampler, sched_rows, idx, x, eps,
+                    SamplerState(old_denoised=old_denoised),
+                    step_noise, start_idx)
+                act = active.reshape(-1, 1, 1, 1)
+                x_next = jnp.where(act, x_next, x)
+                new_old = jnp.where(act, state.old_denoised, old_denoised)
+                keys = jnp.where(active.reshape(-1, 1), keys, carry_keys)
+                idx_next = idx + active.astype(idx.dtype)
+                return x_next, keys, idx_next, new_old
+
+            return seq_parallel_wrap(toplevel_jit(fn), self.c.params)
+
+        return GLOBAL_CACHE.cached_executable(
+            static_cache_key(id(self.c), "stepper_step",
+                             {"batch": batch, "height": height,
+                              "width": width, "steps_cap": steps_cap,
+                              "sampler": sampler}), build)
+
+    def stepper_decode_fn(self, *, batch: int, height: int, width: int):
+        """Latents -> uint8 images for retiring rows — dispatched
+        asynchronously so the transfer/decode of finished rows overlaps
+        the lane's ongoing UNet steps."""
+        vae = self.c.vae
+
+        def build():
+            def fn(params, x):
+                img = vae.apply(params["vae"], x,
+                                method=AutoencoderKL.decode)
+                return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
+                        ).astype(jnp.uint8)
+
+            return seq_parallel_wrap(toplevel_jit(fn), self.c.params)
+
+        return GLOBAL_CACHE.cached_executable(
+            static_cache_key(id(self.c), "stepper_decode",
+                             {"batch": batch, "height": height,
+                              "width": width}), build)
 
     def __call__(self, req: GenerateRequest) -> tuple[np.ndarray, dict]:
         """Run a request. Returns (images uint8 (B,H,W,3), config dict)."""
@@ -641,6 +793,9 @@ class DiffusionPipeline:
             "family": fam.name,
             "scheduler": sampler.kind,
             "steps": steps,
+            # ladder position actually executed (img2img strength maps to
+            # a start index; the quantization is an observable contract)
+            "denoise_steps": steps - start_step,
             "guidance_scale": float(req.guidance_scale),
             "size": [req.height, req.width],
             "compiled_size": [height, width],

@@ -22,7 +22,9 @@ from chiaswarm_tpu.schedulers.sampling import (
     SamplingSchedule,
     make_sampling_schedule,
     scale_model_input,
+    scale_model_input_rows,
     sampler_step,
+    sampler_step_rows,
     init_noise_scale,
     SAMPLERS,
     resolve,
@@ -37,7 +39,9 @@ __all__ = [
     "SamplingSchedule",
     "make_sampling_schedule",
     "scale_model_input",
+    "scale_model_input_rows",
     "sampler_step",
+    "sampler_step_rows",
     "init_noise_scale",
     "SAMPLERS",
     "resolve",

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from chiaswarm_tpu.schedulers.common import (
@@ -182,6 +183,41 @@ def sampler_step(
 
     x_next = jnp.where(sigma_next == 0.0, denoised, x_next)
     return x_next.astype(sample.dtype), SamplerState(old_denoised=denoised.astype(sample.dtype))
+
+
+def scale_model_input_rows(sched: SamplingSchedule, sample: jnp.ndarray,
+                           i: jnp.ndarray) -> jnp.ndarray:
+    """Per-row :func:`scale_model_input`: every array in ``sched`` carries
+    a leading batch dim (each row owns its own sigma ladder) and ``i`` is
+    a (B,) vector of per-row step indices — rows at different ladder
+    positions coexist in one batched program (serving/stepper.py)."""
+    return jax.vmap(scale_model_input)(sched, sample, i)
+
+
+def sampler_step_rows(
+    config: SamplerConfig,
+    sched: SamplingSchedule,
+    i: jnp.ndarray,
+    sample: jnp.ndarray,
+    model_output: jnp.ndarray,
+    state: SamplerState,
+    noise: jnp.ndarray,
+    start_index: jnp.ndarray,
+) -> tuple[jnp.ndarray, SamplerState]:
+    """Per-row :func:`sampler_step` — the continuous-batching quantum.
+
+    ``sched.sigmas`` is (B, S+1) and ``sched.timesteps`` (B, S): each row
+    carries its OWN ladder (different jobs may run different step counts),
+    ``i``/``start_index`` are (B,) per-row positions. Implemented as a
+    ``vmap`` of the scalar step so the math — and therefore every row's
+    trajectory — is identical to the solo scan path by construction.
+    """
+    def one(sched_b, i_b, x_b, eps_b, state_b, noise_b, start_b):
+        return sampler_step(config, sched_b, i_b, x_b, eps_b, state_b,
+                            noise=noise_b, start_index=start_b)
+
+    return jax.vmap(one)(sched, i, sample, model_output, state, noise,
+                         start_index)
 
 
 # diffusers class name (as sent by the hive) -> sampler kind

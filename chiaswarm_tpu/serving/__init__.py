@@ -1,0 +1,6 @@
+"""Serving-side schedulers (no reference analog).
+
+``stepper`` implements continuous step-level batching: jobs join and
+leave a resident batched denoise loop at step boundaries instead of
+queueing behind whole solo programs.
+"""

@@ -1,0 +1,801 @@
+"""Continuous step-level batching: the UNet step is the scheduling quantum.
+
+Burst coalescing (node/executor.py::synchronous_do_work_batch) only merges
+jobs that arrive in the SAME poll with identical static params — a job that
+arrives one poll later waits behind a full solo program. This module applies
+iteration-level admission (Orca-style continuous batching, popularized for
+LLM serving by vLLM) to diffusion: one resident batched denoise program per
+(model, bucketed-shape, steps-capacity, sampler) **lane** executes ONE step
+per call over a fixed lane width of rows; incoming jobs splice into free
+row slots at the next step boundary, finished rows retire early and their
+VAE decode + host transfer overlap the ongoing UNet steps.
+
+Per-row traced state (latents, carry keys, step index, sigma/timestep
+tables, guidance, multistep history, active mask) makes rows at different
+progress — and with different step counts — coexist in one program; the
+per-row math is a ``vmap`` of the solo sampler step, so every row walks
+exactly its solo trajectory (the numerical-equivalence gate,
+tests/test_stepper.py). Admission never compiles: the four lane
+executables (encode / row-init / step / decode,
+pipelines/diffusion.py ``stepper_*_fn``) are keyed by buckets alone.
+
+Fault containment composes with the PR-2 machinery: a failed lane fails
+every resident row's future — the executor falls back to the per-job path
+(where the OOM ladder splits and retries), so the chaos zero-loss
+invariant (every job -> exactly one envelope or dead-letter) holds; rows
+carry their own in-lane deadline; an OOM'd lane additionally halves the
+lane width it will rebuild with. ``drain``/``shutdown`` retire lanes
+cleanly on worker stop.
+
+Knobs (operator guide: README "Continuous batching"):
+
+- ``CHIASWARM_STEPPER=1``  enable lane routing (default off)
+- ``CHIASWARM_STEPPER_LANE_WIDTH``  rows per lane (default: the slot's
+  data width x the measured per-chip profitable batch, pow2-bucketed)
+- ``CHIASWARM_STEPPER_ROW_DEADLINE_S``  per-row in-lane deadline (600)
+- ``CHIASWARM_STEPPER_IDLE_S``  idle grace before a lane retires (15)
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any
+
+import numpy as np
+
+log = logging.getLogger("chiaswarm.stepper")
+
+ENV_ENABLE = "CHIASWARM_STEPPER"
+ENV_LANE_WIDTH = "CHIASWARM_STEPPER_LANE_WIDTH"
+ENV_ROW_DEADLINE = "CHIASWARM_STEPPER_ROW_DEADLINE_S"
+ENV_IDLE_S = "CHIASWARM_STEPPER_IDLE_S"
+ENV_SHARD_ROWS = "CHIASWARM_STEPPER_SHARD_ROWS"
+
+
+def stepper_enabled() -> bool:
+    """Continuous batching is opt-in: the burst-coalescing path stays the
+    default until lanes are enabled (worker env / operator config)."""
+    return os.environ.get(ENV_ENABLE, "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+class LaneReject(RuntimeError):
+    """The job cannot ride a lane (too many rows, steps beyond the
+    capacity lattice, ...) — run it through the ordinary path."""
+
+
+class LaneDeadline(TimeoutError):
+    """A row exceeded its in-lane deadline and was retired unfinished."""
+
+
+class LaneRetired(RuntimeError):
+    """The lane shut down (drain/stop/fault) before the row completed."""
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: membership checks
+class _RowJob:                    # must never compare device/numpy fields
+    """One job's rows plus everything admission needs. Prepared in the
+    SUBMITTING thread (tokenize/encode/init dispatch happen there) so the
+    driver stays a pure step pump."""
+
+    job_id: Any
+    n_rows: int
+    steps: int
+    guidance: float
+    sigmas: np.ndarray          # (steps+1,) this job's ladder
+    timesteps: np.ndarray       # (steps,)
+    ctx_u: Any                  # (n, L, D) device
+    ctx_c: Any
+    pooled_u: Any               # (n, P) device or None (non-XL)
+    pooled_c: Any
+    keys0: Any                  # (n, ...) carry keys after the init split
+    x0: Any                     # (n, lh, lw, C) initial latents
+    deadline: float             # absolute time.monotonic() cutoff
+    future: Future = dataclasses.field(default_factory=Future)
+    admitted_at_step: int = -1
+    slots: list[int] = dataclasses.field(default_factory=list)
+
+
+class Lane:
+    """One resident batched denoise loop: a fixed-width row file through
+    one compiled step program, driven by a dedicated thread."""
+
+    _ids = iter(range(1, 1 << 62))
+
+    def __init__(self, sched: "StepScheduler", key: tuple, pipe,
+                 *, width: int, height: int, width_px: int,
+                 steps_cap: int, sampler) -> None:
+        self._sched = sched
+        self.key = key
+        self.pipe = pipe
+        self.width = int(width)
+        self.height = int(height)
+        self.width_px = int(width_px)
+        self.steps_cap = int(steps_cap)
+        self.sampler = sampler
+        self.lane_id = next(Lane._ids)
+        self._cond = threading.Condition()
+        self._pending: collections.deque[_RowJob] = collections.deque()
+        self._rows: list[_RowJob | None] = [None] * self.width
+        self._stop = False
+        self._retired = False
+        self.steps_executed = 0
+        # host mirrors of the slow-changing per-row inputs (rebuilt on
+        # device only when admission/retirement changes them)
+        self._h_start = np.zeros(self.width, np.int32)
+        self._h_idx = np.zeros(self.width, np.int32)
+        self._h_sig = np.ones((self.width, self.steps_cap + 1), np.float32)
+        self._h_ts = np.zeros((self.width, self.steps_cap), np.float32)
+        self._h_guid = np.ones(self.width, np.float32)
+        self._h_active = np.zeros(self.width, bool)
+        self._dev = None  # device state dict, allocated at first admission
+        self._mesh = None
+        self._deferred_counts: list[dict] = []
+        self._window: collections.deque = collections.deque()
+        # retired rows whose async decode is still in flight: the future
+        # resolves only once the images are RESIDENT (same cross-thread
+        # hazard as admission — the consumer must never read an array
+        # another thread is still computing)
+        self._handoff: collections.deque = collections.deque()
+        self._thread = threading.Thread(
+            target=self._drive, name=f"stepper-lane-{self.lane_id}",
+            daemon=True)
+        self._thread.start()
+
+    # ---- submission side ----
+
+    def try_enqueue(self, job: _RowJob) -> bool:
+        with self._cond:
+            if self._stop or self._retired:
+                return False
+            self._pending.append(job)
+            self._cond.notify_all()
+            return True
+
+    def busy(self) -> bool:
+        with self._cond:
+            return (bool(self._pending) or bool(self._handoff)
+                    or any(r is not None for r in self._rows))
+
+    def occupancy(self) -> tuple[int, int]:
+        with self._cond:
+            return sum(r is not None for r in self._rows), self.width
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+
+    # ---- driver ----
+
+    def _drive(self) -> None:
+        idle_s = float(os.environ.get(ENV_IDLE_S, "15") or 15)
+        idle_since: float | None = None
+        try:
+            while True:
+                with self._cond:
+                    while True:
+                        if self._stop:
+                            raise LaneRetired("lane stopped")
+                        self._admit_locked()
+                        if self._h_active.any():
+                            idle_since = None
+                            break
+                        now = time.monotonic()
+                        if idle_since is None:
+                            idle_since = now
+                        elif now - idle_since >= idle_s:
+                            if self._pending:
+                                # a job the lane can never admit (e.g.
+                                # wider than a width-limited lane) must
+                                # bounce, not leak an unresolved future
+                                raise LaneRetired(
+                                    "lane retired with unadmittable "
+                                    "pending rows")
+                            self._retired = True
+                            return
+                        # woken by try_enqueue/stop notify; the timeout
+                        # only bounds the idle grace itself
+                        self._cond.wait(
+                            timeout=max(0.05, idle_s - (now - idle_since)))
+                self._flush_counts()
+                self._sched._maybe_fault(self)
+                self._dispatch_step()
+                self._retire_rows()
+                self._flush_handoff(block=not self._h_active.any())
+        except BaseException as exc:  # noqa: BLE001 — containment seam
+            self._fail_all(exc)
+        finally:
+            with self._cond:
+                self._retired = True
+            self._flush_counts()
+            self._sched._lane_done(self)
+
+    def _flush_counts(self) -> None:
+        while self._deferred_counts:
+            self._sched._count(**self._deferred_counts.pop(0))
+
+    def _alloc_dev(self, job: _RowJob) -> None:
+        import jax.numpy as jnp
+
+        from chiaswarm_tpu.pipelines.diffusion import _params_mesh
+
+        # data parallelism: when the params live on a dp x tp mesh, lane
+        # rows ride the 'data' axis — same GSPMD seeding the solo path
+        # uses for its token inputs (pipelines/diffusion.py submit). A
+        # solo job on a dp slot wastes (dp-1)/dp of the chips; a full
+        # lane keeps every data row busy. OPT-IN for now: on the pinned
+        # jax build the row-sharded step program diverges numerically
+        # from its unsharded twin (same failure smell as the seq-parallel
+        # divergence in ROADMAP) — enable once that is debugged.
+        self._mesh = None
+        if os.environ.get(ENV_SHARD_ROWS, "").strip().lower() in (
+                "1", "true", "on", "yes"):
+            mesh = _params_mesh(self.pipe.c.params)
+            if mesh is not None and self.width % mesh.shape["data"] == 0:
+                self._mesh = mesh
+        lh, lw = self.pipe._latent_hw(self.height, self.width_px)
+        ch = self.pipe.c.family.vae.latent_channels
+        zero_row = jnp.zeros((self.width, lh, lw, ch), jnp.float32)
+        keys = jnp.stack([job.keys0[0]] * self.width)
+        placeholder = jnp.zeros((1,), jnp.float32)
+        self._dev = {
+            "x": zero_row,
+            "keys": keys,
+            "idx": jnp.zeros(self.width, jnp.int32),
+            "old": zero_row,
+            "ctx_u": jnp.zeros((self.width,) + job.ctx_u.shape[1:],
+                               job.ctx_u.dtype),
+            "ctx_c": jnp.zeros((self.width,) + job.ctx_c.shape[1:],
+                               job.ctx_c.dtype),
+            "pooled_u": (placeholder if job.pooled_u is None else
+                         jnp.zeros((self.width,) + job.pooled_u.shape[1:],
+                                   job.pooled_u.dtype)),
+            "pooled_c": (placeholder if job.pooled_c is None else
+                         jnp.zeros((self.width,) + job.pooled_c.shape[1:],
+                                   job.pooled_c.dtype)),
+        }
+        self._sync_tables()
+
+    def _place_rows(self) -> None:
+        """Pin every lane-width array onto the mesh's data axis (no-op on
+        single-chip slots). Re-applied after admission scatters, whose
+        outputs may lose the row sharding."""
+        if self._mesh is None:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        for key, arr in self._dev.items():
+            if getattr(arr, "ndim", 0) < 1 or arr.shape[0] != self.width:
+                continue  # non-XL pooled placeholders
+            spec = P(*(("data",) + (None,) * (arr.ndim - 1)))
+            self._dev[key] = jax.device_put(
+                arr, NamedSharding(self._mesh, spec))
+
+    def _sync_tables(self) -> None:
+        """Rebuild the device copies of the host-mirrored per-row inputs.
+
+        MUST transfer COPIES: jax dispatch is async, and handing it a
+        live numpy buffer that admission/retirement later mutates in
+        place lets the device read the FUTURE value — the step then e.g.
+        sees a row as inactive and silently skips it (observed: a
+        one-step job decoding its un-stepped init latents)."""
+        import jax.numpy as jnp
+
+        dev = self._dev
+        dev["start"] = jnp.asarray(self._h_start.copy())
+        dev["sig"] = jnp.asarray(self._h_sig.copy())
+        dev["ts"] = jnp.asarray(self._h_ts.copy())
+        dev["guid"] = jnp.asarray(self._h_guid.copy())
+        dev["active"] = jnp.asarray(self._h_active.copy())
+
+    def _admit_locked(self) -> None:
+        """Splice pending jobs into free row slots — the step boundary is
+        wherever the driver is between dispatches."""
+        import jax.numpy as jnp
+
+        free = [s for s in range(self.width) if self._rows[s] is None]
+        while self._pending and self._pending[0].n_rows <= len(free):
+            job = self._pending.popleft()
+            if job.future.cancelled():
+                continue
+            # cross-thread handoff sync: the job's arrays were dispatched
+            # from the SUBMITTING thread (encode/init overlap earlier lane
+            # steps); admit the row only once they are resident. Usually a
+            # no-op by now — and this container's jax build corrupts
+            # results when a program consumes another thread's still-
+            # compiling outputs, so the barrier is correctness, not style.
+            for arr in (job.x0, job.keys0, job.ctx_u, job.ctx_c,
+                        job.pooled_u, job.pooled_c):
+                if arr is not None:
+                    arr.block_until_ready()
+            slots, free = free[:job.n_rows], free[job.n_rows:]
+            if self._dev is None:
+                self._alloc_dev(job)
+            mid_flight = bool(self._h_active.any())
+            sel = np.asarray(slots)
+            dev = self._dev
+            dev["x"] = dev["x"].at[sel].set(job.x0)
+            dev["keys"] = dev["keys"].at[sel].set(job.keys0)
+            dev["old"] = dev["old"].at[sel].set(
+                jnp.zeros_like(job.x0))
+            dev["idx"] = dev["idx"].at[sel].set(0)
+            dev["ctx_u"] = dev["ctx_u"].at[sel].set(job.ctx_u)
+            dev["ctx_c"] = dev["ctx_c"].at[sel].set(job.ctx_c)
+            if job.pooled_u is not None:
+                dev["pooled_u"] = dev["pooled_u"].at[sel].set(job.pooled_u)
+                dev["pooled_c"] = dev["pooled_c"].at[sel].set(job.pooled_c)
+            self._h_idx[sel] = 0
+            self._h_start[sel] = 0
+            self._h_sig[sel, :] = 0.0
+            self._h_sig[sel, : job.steps + 1] = job.sigmas
+            self._h_ts[sel, :] = 0.0
+            self._h_ts[sel, : job.steps] = job.timesteps
+            self._h_guid[sel] = job.guidance
+            self._h_active[sel] = True
+            self._sync_tables()
+            self._place_rows()
+            for s in slots:
+                self._rows[s] = job
+            job.slots = slots
+            job.admitted_at_step = self.steps_executed
+            # deferred: _admit_locked runs under self._cond while
+            # submitters hold sched._lock and wait on self._cond —
+            # taking sched._lock (inside _count) HERE would deadlock
+            self._deferred_counts.append(dict(
+                rows_admitted=job.n_rows,
+                rows_admitted_midflight=(job.n_rows if mid_flight
+                                         else 0)))
+
+    def _dispatch_step(self) -> None:
+        dev = self._dev
+        fn = self.pipe.stepper_step_fn(
+            batch=self.width, height=self.height, width=self.width_px,
+            steps_cap=self.steps_cap, sampler=self.sampler)
+        dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
+            self.pipe.c.params,
+            dev["ctx_u"], dev["ctx_c"], dev["pooled_u"], dev["pooled_c"],
+            dev["x"], dev["keys"], dev["idx"],
+            dev["start"], dev["sig"], dev["ts"], dev["guid"],
+            dev["old"], dev["active"],
+        )
+        active = int(self._h_active.sum())
+        self._h_idx[self._h_active] += 1
+        self.steps_executed += 1
+        self._sched._count(steps_executed=1, row_steps_active=active,
+                           row_steps_padded=self.width - active)
+        # throttle: keep at most two dispatched steps in flight (the
+        # depth-2 philosophy of core/chip_pool.py) so the async queue
+        # cannot run away from the device — and execution errors surface
+        # here, inside the containment try of the driver loop
+        self._window.append(dev["x"])
+        if len(self._window) > 2:
+            self._window.popleft().block_until_ready()
+
+    def _retire_rows(self) -> None:
+        """Retire finished rows (decode dispatched async — it overlaps the
+        next steps) and expire rows past their deadline."""
+        from chiaswarm_tpu.core.compile_cache import bucket_batch
+        from chiaswarm_tpu.pipelines.diffusion import PendingImages
+
+        import jax.numpy as jnp
+
+        now = time.monotonic()
+        done: list[_RowJob] = []
+        expired: list[_RowJob] = []
+        for s, job in enumerate(self._rows):
+            if job is None or not self._h_active[s]:
+                continue
+            if self._h_idx[s] >= job.steps and job not in done:
+                done.append(job)
+            elif now > job.deadline and job not in expired \
+                    and self._h_idx[s] < job.steps:
+                expired.append(job)
+        changed = False
+        for job in done:
+            sel = np.asarray(job.slots)
+            rows_x = jnp.take(self._dev["x"], jnp.asarray(sel), axis=0)
+            bucket = bucket_batch(job.n_rows)
+            if job.n_rows < bucket:
+                rows_x = jnp.concatenate(
+                    [rows_x, jnp.repeat(rows_x[-1:],
+                                        bucket - job.n_rows, axis=0)])
+            decode = self.pipe.stepper_decode_fn(
+                batch=bucket, height=self.height, width=self.width_px)
+            images = decode(self.pipe.c.params, rows_x)
+            pending = PendingImages(
+                device_images=images,
+                compiled_hw=(self.height, self.width_px),
+                requested_hw=(self.height, self.width_px),
+                requested_batch=job.n_rows)
+            self._release_rows(job)
+            changed = True
+            self._sched._count(rows_completed=job.n_rows)
+            self._handoff.append((job, pending, {
+                "lane": self.lane_id,
+                "lane_width": self.width,
+                "admitted_at_step": job.admitted_at_step,
+                "steps_executed": self.steps_executed,
+            }))
+        for job in expired:
+            self._release_rows(job)
+            changed = True
+            self._sched._count(rows_expired=job.n_rows)
+            if not job.future.done():
+                job.future.set_exception(LaneDeadline(
+                    f"row(s) of job {job.job_id} exceeded the in-lane "
+                    f"deadline"))
+        if changed:
+            with self._cond:
+                self._cond.notify_all()
+
+    def _flush_handoff(self, block: bool) -> None:
+        """Resolve retired rows whose decoded images are resident. With
+        ``block=False`` (rows still stepping) in-flight decodes stay
+        queued — the overlap — and resolve at a later boundary; with
+        ``block=True`` (lane idle) the driver waits them out."""
+        while self._handoff:
+            job, pending, info = self._handoff[0]
+            images = pending.device_images
+            ready = True
+            if not block:
+                is_ready = getattr(images, "is_ready", None)
+                ready = bool(is_ready()) if callable(is_ready) else False
+            if not ready:
+                return
+            images.block_until_ready()
+            self._handoff.popleft()
+            if not job.future.cancelled():
+                job.future.set_result((pending, info))
+
+    def _release_rows(self, job: _RowJob) -> None:
+        for s in job.slots:
+            self._rows[s] = None
+            self._h_active[s] = False
+        if self._dev is not None:
+            self._sync_tables()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        err = exc if isinstance(exc, Exception) else LaneRetired(str(exc))
+        # retired rows with in-flight decodes: their chip time is already
+        # spent — deliver if the decode survives, fail otherwise
+        while self._handoff:
+            job, pending, info = self._handoff.popleft()
+            try:
+                pending.device_images.block_until_ready()
+                if not job.future.done():
+                    job.future.set_result((pending, info))
+            except Exception:
+                if not job.future.done():
+                    job.future.set_exception(err)
+        with self._cond:
+            # retire BEFORE draining: a submit racing this failure must
+            # see a dead lane (and open a fresh one), not append a job
+            # whose future nobody will ever resolve
+            self._retired = True
+            jobs = {id(j): j for j in self._rows if j is not None}
+            jobs.update({id(j): j for j in self._pending})
+            self._pending.clear()
+            for s in range(self.width):
+                self._rows[s] = None
+            self._h_active[:] = False
+        failed_rows = 0
+        for job in jobs.values():
+            failed_rows += job.n_rows
+            if not job.future.done():
+                job.future.set_exception(err)
+        if jobs:
+            # remember (key, width) BEFORE collectors wake: note_oom may
+            # run after _lane_done has already deregistered this lane
+            self._sched._note_lane_failure(self.key, self.width)
+            self._sched._count(rows_failed=failed_rows, lanes_failed=1)
+            log.warning("lane %d failed (%s): %d row(s) bounced to the "
+                        "per-job path", self.lane_id, err, failed_rows)
+        self._dev = None
+        self._window.clear()
+
+
+class StepScheduler:
+    """Owns the lanes of one slot; thread-safe submit/stats/drain."""
+
+    def __init__(self, slot: Any = None) -> None:
+        self.slot = slot
+        self._lock = threading.Lock()
+        self._lanes: dict[tuple, Lane] = {}
+        self._width_limits: dict[tuple, int] = {}
+        self._stats = collections.Counter()
+        self._fault: list[tuple[int, BaseException]] = []
+        self._total_steps = 0
+        self._last_oom_incident = -1
+        # (key -> width) of recently failed lanes: note_oom must still
+        # find the lane that just died even after _lane_done removed it
+        self._failed_lane_hints: dict[tuple, int] = {}
+        _register_for_exit(self)
+
+    # ---- policy ----
+
+    def lane_width(self, height: int, width: int) -> int:
+        env = os.environ.get(ENV_LANE_WIDTH, "").strip()
+        if env:
+            width_rows = int(env)
+        else:
+            from chiaswarm_tpu.core.compile_cache import bucket_batch
+            from chiaswarm_tpu.node.executor import single_chip_rows
+
+            data_width = max(1, int(getattr(self.slot, "data_width", 1)))
+            per_device = single_chip_rows({"height": height, "width": width})
+            width_rows = bucket_batch(max(2, data_width * per_device))
+        return max(1, width_rows)
+
+    def row_deadline_s(self) -> float:
+        return float(os.environ.get(ENV_ROW_DEADLINE, "600") or 600)
+
+    # ---- submission ----
+
+    def submit_request(self, pipe, *, prompt: str, negative_prompt: str = "",
+                       steps: int = 30, guidance_scale: float = 7.5,
+                       height: int | None = None, width: int | None = None,
+                       rows: int = 1, seed: int = 0,
+                       scheduler: str | None = None,
+                       deadline_s: float | None = None,
+                       job_id: Any = None) -> Future:
+        """Prepare a job's rows (tokenize, encode, ladder, initial noise)
+        and hand them to the matching lane. Returns a Future resolving to
+        ``(PendingImages, lane_info)``; raises :class:`LaneReject` when
+        the job cannot ride a lane."""
+        import jax
+        import jax.numpy as jnp
+
+        from chiaswarm_tpu.core.compile_cache import (
+            bucket_batch,
+            bucket_image_size,
+            bucket_steps,
+        )
+        from chiaswarm_tpu.core.rng import key_for_seed
+        from chiaswarm_tpu.schedulers import make_sampling_schedule, resolve
+
+        fam = pipe.c.family
+        if fam.kind != "sd" or fam.image_conditioned:
+            raise LaneReject(f"family {fam.name!r} does not ride lanes")
+        if float(guidance_scale) <= 1.0:
+            raise LaneReject("guidance <= 1 runs the solo (no-CFG) program")
+        height, width = bucket_image_size(int(height or fam.default_size),
+                                          int(width or fam.default_size))
+        steps = max(1, int(steps))
+        try:
+            cap = bucket_steps(steps)
+        except ValueError as exc:
+            raise LaneReject(str(exc)) from exc
+        rows = max(1, int(rows))
+        lane_rows = self.lane_width(height, width)
+        if rows > lane_rows:
+            raise LaneReject(
+                f"{rows} rows exceed the lane width {lane_rows}")
+        sampler = resolve(scheduler, prediction_type=fam.prediction_type)
+        key = (id(pipe.c), height, width, cap, sampler)
+        limit = self._width_limits.get(key)
+        if limit is not None and limit < lane_rows:
+            lane_rows = max(rows, limit)
+
+        sched = make_sampling_schedule(pipe.noise_schedule, steps, sampler)
+        sig = np.asarray(sched.sigmas, np.float32)
+        ts = np.asarray(sched.timesteps, np.float32)
+
+        eb = bucket_batch(rows)
+        ids = [jnp.asarray(i) for i in pipe._tokenize([prompt or ""] * eb)]
+        neg = [jnp.asarray(i) for i in
+               pipe._tokenize([negative_prompt or ""] * eb)]
+        ctx_u, ctx_c, pooled_u, pooled_c = pipe.stepper_encode_fn(
+            batch=eb)(pipe.c.params, ids, neg)
+        # per-row noise keys: fold the row index into the job's seed —
+        # exactly the solo program's key derivation, so every row matches
+        # its solo run bit-for-bit in key space
+        keys = jnp.stack([jax.random.fold_in(key_for_seed(int(seed)), r)
+                          for r in range(rows)] +
+                         [key_for_seed(int(seed))] * (eb - rows))
+        carry, x0 = pipe.stepper_row_init_fn(
+            batch=eb, height=height, width=width)(keys, jnp.float32(sig[0]))
+        job = _RowJob(
+            job_id=job_id, n_rows=rows, steps=steps,
+            guidance=float(guidance_scale), sigmas=sig, timesteps=ts,
+            ctx_u=ctx_u[:rows], ctx_c=ctx_c[:rows],
+            pooled_u=None if pooled_u is None else pooled_u[:rows],
+            pooled_c=None if pooled_c is None else pooled_c[:rows],
+            keys0=carry[:rows], x0=x0[:rows],
+            deadline=time.monotonic() + (deadline_s if deadline_s is not None
+                                         else self.row_deadline_s()))
+        self._enqueue(key, pipe, job, lane_rows, height, width, cap, sampler)
+        return job.future
+
+    def _enqueue(self, key, pipe, job, lane_rows, height, width, cap,
+                 sampler) -> None:
+        created = False
+        with self._lock:
+            lane = self._lanes.get(key)
+            # a lane narrower than the job (width-limited after an OOM)
+            # could never admit it: open a fresh, wide-enough lane — the
+            # old one drains its residents and idles out
+            if lane is not None and lane.width < job.n_rows:
+                lane = None
+            if lane is None or not lane.try_enqueue(job):
+                lane = Lane(self, key, pipe, width=lane_rows, height=height,
+                            width_px=width, steps_cap=cap, sampler=sampler)
+                self._lanes[key] = lane
+                created = True
+                if not lane.try_enqueue(job):  # pragma: no cover
+                    raise LaneRetired("fresh lane refused the job")
+        if created:  # outside the lock: _count takes it too
+            self._count(lanes_created=1)
+
+    # ---- lifecycle / observability ----
+
+    def _lane_done(self, lane: Lane) -> None:
+        with self._lock:
+            if self._lanes.get(lane.key) is lane:
+                del self._lanes[lane.key]
+
+    def _note_lane_failure(self, key: tuple, width: int) -> None:
+        with self._lock:
+            self._failed_lane_hints[key] = int(width)
+            while len(self._failed_lane_hints) > 32:  # bounded
+                self._failed_lane_hints.pop(
+                    next(iter(self._failed_lane_hints)))
+
+    def note_oom(self) -> None:
+        """Degradation-ladder hook: after an OOM'd lane run, future lanes
+        rebuild at half width (the burst analog splits and re-runs
+        serially, node/worker.py). Limits are sticky for the process —
+        a chip that OOM'd once at width W will OOM again. Halves ONCE
+        per lane incident: every resident job's collector reports the
+        same failure, and N jobs must not shrink the width 2^N-fold."""
+        with self._lock:
+            incident = self._stats.get("lanes_failed", 0)
+            if incident == self._last_oom_incident:
+                return
+            self._last_oom_incident = incident
+            keys = (set(self._lanes) | set(self._width_limits)
+                    | set(self._failed_lane_hints))
+            for key in keys:
+                cur = self._width_limits.get(key)
+                if cur is None:
+                    lane = self._lanes.get(key)
+                    cur = (lane.width if lane is not None
+                           else self._failed_lane_hints.get(key, 2))
+                self._width_limits[key] = max(1, cur // 2)
+
+    def _count(self, **kw: int) -> None:
+        with self._lock:
+            for k, v in kw.items():
+                if v:
+                    self._stats[k] += v
+            self._total_steps = self._stats.get("steps_executed", 0)
+
+    def _maybe_fault(self, lane: Lane) -> None:
+        """Chaos seam (tests/test_chaos.py): raise a scripted fault inside
+        the driver loop once the scheduler has executed N total steps."""
+        if not self._fault:
+            return
+        with self._lock:
+            if self._fault and self._total_steps >= self._fault[0][0]:
+                _, exc = self._fault.pop(0)
+                raise exc
+
+    def inject_fault(self, after_steps: int, exc: BaseException) -> None:
+        with self._lock:
+            self._fault.append((int(after_steps), exc))
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            data = dict(self._stats)
+            lanes = list(self._lanes.values())
+        active = sum(lane.occupancy()[0] for lane in lanes)
+        width = sum(lane.occupancy()[1] for lane in lanes)
+        steps_a = data.get("row_steps_active", 0)
+        steps_p = data.get("row_steps_padded", 0)
+        denom = max(1, steps_a + steps_p)
+        data.update({
+            "lanes_live": len(lanes),
+            "rows_active": active,
+            "lane_rows_total": width,
+            "lane_occupancy": round(steps_a / denom, 4),
+            "padding_waste": round(steps_p / denom, 4),
+        })
+        return data
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait for every lane to go empty (in-flight rows finish, pending
+        rows admitted and finished). True when drained."""
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._lock:
+                lanes = list(self._lanes.values())
+            if not any(lane.busy() for lane in lanes):
+                return True
+            time.sleep(0.01)
+        return False
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Stop every lane; unfinished rows fail with LaneRetired so their
+        jobs bounce to the per-job path (or envelope) — never lost."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            lane.stop()
+        for lane in lanes:
+            lane.join(timeout_s)
+
+
+_EXIT_SCHEDULERS: "weakref.WeakSet[StepScheduler]"
+
+
+def _register_for_exit(sched: StepScheduler) -> None:
+    """Stop every lane at interpreter exit: a daemon driver thread still
+    dispatching XLA programs during teardown aborts the process with a
+    C++ ``terminate`` on this backend."""
+    global _EXIT_SCHEDULERS
+    try:
+        _EXIT_SCHEDULERS.add(sched)
+        return
+    except NameError:
+        pass
+    import atexit
+    import weakref
+
+    _EXIT_SCHEDULERS = weakref.WeakSet()
+    _EXIT_SCHEDULERS.add(sched)
+
+    @atexit.register
+    def _stop_all_lanes() -> None:
+        for scheduler in list(_EXIT_SCHEDULERS):
+            try:
+                scheduler.shutdown(timeout_s=2.0)
+            except Exception:  # teardown must never raise
+                pass
+
+
+def aggregate_stats(steppers) -> dict[str, Any]:
+    """Merge several schedulers' stats (one per slot) for /healthz:
+    counters sum, the occupancy/waste ratios recompute from the summed
+    row-step totals."""
+    total = collections.Counter()
+    for stepper in steppers:
+        for key, value in stepper.stats().items():
+            if key in ("lane_occupancy", "padding_waste"):
+                continue
+            total[key] += value
+    steps_a = total.get("row_steps_active", 0)
+    steps_p = total.get("row_steps_padded", 0)
+    denom = max(1, steps_a + steps_p)
+    data = dict(total)
+    data["lane_occupancy"] = round(steps_a / denom, 4)
+    data["padding_waste"] = round(steps_p / denom, 4)
+    return data
+
+
+_ATTACH_LOCK = threading.Lock()
+
+
+def get_stepper(slot: Any) -> StepScheduler:
+    """The slot's resident StepScheduler (created on first use). Lanes —
+    not the slot depth semaphore — serialize lane traffic; the slot is
+    only consulted for its mesh data width."""
+    with _ATTACH_LOCK:
+        stepper = getattr(slot, "_stepper", None)
+        if stepper is None:
+            stepper = StepScheduler(slot)
+            try:
+                slot._stepper = stepper
+            except (AttributeError, TypeError):  # exotic slot stubs
+                pass
+        return stepper

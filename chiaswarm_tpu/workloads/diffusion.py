@@ -11,6 +11,7 @@ large outputs, bf16 weights.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any
 
@@ -182,6 +183,152 @@ def coalescable(kwargs: dict[str, Any]) -> bool:
     # batching them would condition every job on job 0's; keep them solo
     return (not kwargs.get("upscale")
             and all(kwargs.get(k) is None for k in _UNCOALESCABLE))
+
+
+# ---- continuous step-level batching (serving/stepper.py) ---------------
+#
+# When lanes are enabled (CHIASWARM_STEPPER=1), plain txt2img jobs skip
+# the burst grouping entirely: each job's rows splice into the resident
+# step loop of its lane at the next step boundary — jobs with DIFFERENT
+# step counts and guidance scales share one program (those two fields
+# ride per row), so a job arriving one poll late no longer waits behind
+# a full solo program. Everything else (img2img/inpaint/controlnet/
+# pix2pix/upscale, low guidance, oversize, too many rows) falls back to
+# the burst/solo paths below.
+
+def stepper_eligible(kwargs: dict[str, Any]) -> bool:
+    """Can this (formatted) job ride a lane? Conservative pre-filter —
+    serving.stepper.StepScheduler.submit_request is the authority and
+    raises LaneReject for the residue (steps beyond the capacity
+    lattice, rows wider than the lane, non-sd families)."""
+    from chiaswarm_tpu.serving.stepper import stepper_enabled
+
+    if not stepper_enabled() or not coalescable(kwargs):
+        return False
+    if kwargs.get("image") is not None or kwargs.get("mask_image") is not None:
+        return False  # init-latent modes keep the burst path (per-job
+        # encode seeds + mask re-projection are not lane state yet)
+    guidance = kwargs.get("guidance_scale")
+    if guidance is not None and float(guidance) <= 1.0:
+        return False  # solo compiles the no-CFG program
+    height = kwargs.get("height")
+    width = kwargs.get("width")
+    if (height and int(height) > 1024) or (width and int(width) > 1024):
+        return False  # tiled decode stays solo
+    return True
+
+
+@dataclasses.dataclass
+class StepperTicket:
+    """A submitted lane job: resolves through ``stepper_finish`` into the
+    same (artifacts, config) contract the solo callback returns."""
+
+    future: Any
+    model_name: str
+    family: str
+    sampler_kind: str
+    steps: int
+    guidance: float
+    req_hw: tuple[int, int]
+    compiled_hw: tuple[int, int]
+    rows: int
+    seed: int
+    content_type: str
+    shared: dict[str, Any]
+    slot: Any
+    t0: float
+
+
+def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
+                   seed: int, job_id: Any = None) -> StepperTicket:
+    """Hand one formatted txt2img job to the slot's step scheduler.
+    Raises serving.stepper.LaneReject (or anything else) when the job
+    must run through the ordinary path instead."""
+    from chiaswarm_tpu.core.compile_cache import bucket_image_size
+    from chiaswarm_tpu.schedulers import resolve
+    from chiaswarm_tpu.serving.stepper import get_stepper
+
+    model_name = kwargs.get("model_name")
+    scale = kwargs.get("cross_attention_scale")
+    pipe = registry.pipeline(
+        model_name,
+        textual_inversion=kwargs.get("textual_inversion"),
+        lora=kwargs.get("lora"),
+        lora_scale=1.0 if scale is None else float(scale),
+        mesh=getattr(slot, "mesh", None))
+    fam = pipe.c.family
+    height = int(kwargs.get("height") or fam.default_size)
+    width = int(kwargs.get("width") or fam.default_size)
+    steps = int(kwargs.get("num_inference_steps") or 30)
+    guidance = kwargs.get("guidance_scale")
+    guidance = 7.5 if guidance is None else float(guidance)
+    rows = max(1, int(kwargs.get("num_images_per_prompt") or 1))
+    future = get_stepper(slot).submit_request(
+        pipe,
+        prompt=str(kwargs.get("prompt") or ""),
+        negative_prompt=str(kwargs.get("negative_prompt") or ""),
+        steps=steps, guidance_scale=guidance,
+        height=height, width=width, rows=rows, seed=int(seed),
+        scheduler=kwargs.get("scheduler_type"),
+        job_id=job_id)
+    sampler = resolve(kwargs.get("scheduler_type"),
+                      prediction_type=fam.prediction_type)
+    return StepperTicket(
+        future=future, model_name=model_name, family=fam.name,
+        sampler_kind=sampler.kind, steps=steps, guidance=guidance,
+        req_hw=(height, width),
+        compiled_hw=bucket_image_size(height, width),
+        rows=rows, seed=int(seed),
+        content_type=kwargs.get("content_type", "image/png"),
+        shared={k: kwargs.get(k) for k in ("textual_inversion", "lora",
+                                           "cross_attention_scale")},
+        slot=slot, t0=time.perf_counter())
+
+
+def stepper_finish(ticket: StepperTicket):
+    """Block on the lane rows, then postprocess exactly like the solo
+    callback (un-bucket crop, safety, artifact encode)."""
+    pending, lane_info = ticket.future.result()
+    # the lane decodes at the compiled bucket; un-bucket to the request
+    pending.requested_hw = ticket.req_hw
+    images = pending.wait()
+    elapsed = time.perf_counter() - ticket.t0
+
+    proc = OutputProcessor(ticket.content_type)
+    proc.add_images(images)
+    config = {
+        "model_name": ticket.model_name,
+        "family": ticket.family,
+        "scheduler": ticket.sampler_kind,
+        "steps": ticket.steps,
+        "denoise_steps": ticket.steps,
+        "guidance_scale": ticket.guidance,
+        "size": list(ticket.req_hw),
+        "compiled_size": list(ticket.compiled_hw),
+        "batch": ticket.rows,
+        "mode": "txt2img",
+        "seed": ticket.seed,
+        "stepper": dict(lane_info),
+    }
+    if ticket.shared.get("textual_inversion") is not None:
+        config["textual_inversion"] = ticket.shared["textual_inversion"]
+    if ticket.shared.get("lora") is not None:
+        config["lora"] = ticket.shared["lora"]
+        scale = ticket.shared.get("cross_attention_scale")
+        config["cross_attention_scale"] = (1.0 if scale is None
+                                           else float(scale))
+    from chiaswarm_tpu.workloads.safety import check_images
+
+    _, safety_fields = check_images(images, ticket.model_name)
+    config.update(safety_fields)
+    config.update({
+        "images_per_sec": round(images.shape[0] / max(elapsed, 1e-9), 4),
+        "generation_s": round(elapsed, 3),
+        "slot": (ticket.slot.descriptor()
+                 if hasattr(ticket.slot, "descriptor")
+                 else str(ticket.slot)),
+    })
+    return proc.get_results(), config
 
 
 def diffusion_coalesced_callback(slot, model_name: str, *, seed: int,

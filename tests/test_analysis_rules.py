@@ -452,6 +452,93 @@ def test_r6_allows_bucketed_shapes_and_forwarding_functions():
     assert fs == []
 
 
+# ---------------------------------------------------------------- R7
+
+def test_r7_flags_unpinned_mixed_dtype_scan_carry():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def body(x, _):
+            x32 = x.astype(jnp.float32)
+            return x32 * 2.0, None
+
+        def run(x0):
+            return jax.lax.scan(body, x0, None, length=4)
+        """, rule="R7")
+    assert [f.rule for f in fs] == ["scan-carry-dtype"]
+    assert fs[0].symbol == "body"
+    assert "carry" in fs[0].message
+
+
+def test_r7_flags_fori_loop_body_and_keyword_binding():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def run(x0):
+            def step(i, x):
+                return x + jnp.float32(1.5)
+
+            a = jax.lax.fori_loop(0, 4, step, x0)
+            b = jax.lax.scan(f=lambda c, _: (c.astype(jnp.float32) + 1, None),
+                             init=x0, xs=None, length=2)
+            return a, b
+        """, rule="R7")
+    assert len(fs) == 2
+    assert all(f.rule == "scan-carry-dtype" for f in fs)
+
+
+def test_r7_constructor_return_is_a_promotion_not_a_pin():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def body(c, _):
+            y = c.astype(jnp.float32)
+            return jnp.float32(y), None
+
+        def run(c0):
+            return jax.lax.scan(body, c0, None, length=2)
+        """, rule="R7")
+    assert [f.rule for f in fs] == ["scan-carry-dtype"]
+
+
+def test_r7_allows_pinned_carries_and_single_precision_bodies():
+    fs = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def pinned(x, _):
+            x32 = x.astype(jnp.float32)
+            x_next = (x32 * 2.0).astype(x.dtype)
+            return x_next, None
+
+        def helper_call(carry, t):
+            # opaque helper result + untouched state: the repo's
+            # sampler-shaped carry (pinning happens inside the helper)
+            x, state = carry
+            x2, state2 = step_helper(x, state, t)
+            return (x2, state2), None
+
+        def no_casts(x, _):
+            return x * 2.0, None   # single-precision body: silent
+
+        def int_casts(c, _):
+            # integer casts (token ids, counters) are not a precision
+            # hazard
+            tok = jnp.argmax(c, axis=-1).astype(jnp.int32)
+            return tok + 1, tok
+
+        def run(x0, s0):
+            jax.lax.scan(pinned, x0, None, length=2)
+            jax.lax.scan(helper_call, (x0, s0), jnp.arange(2))
+            jax.lax.scan(no_casts, x0, None, length=2)
+            jax.lax.scan(int_casts, x0, None, length=2)
+        """, rule="R7")
+    assert fs == []
+
+
 # ---------------------------------------------------------------- baseline
 
 BAD = """import jax

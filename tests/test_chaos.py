@@ -647,3 +647,70 @@ def test_transient_format_failure_is_not_fatal():
         assert classify_result(final) == "ok"
 
     asyncio.run(retries_then_succeeds())
+
+
+def test_mid_lane_fault_keeps_zero_loss(monkeypatch):
+    """ISSUE 3: a crash/OOM injected into a RUNNING step-scheduler lane
+    (serving/stepper.py) with spliced rows resident must not lose a job:
+    every row's future fails, the executor bounces each job to the
+    per-job path, and every id uploads exactly one envelope through a
+    real Worker loop."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.node.worker import Worker
+    from chiaswarm_tpu.serving.stepper import get_stepper
+
+    monkeypatch.setenv("CHIASWARM_STEPPER", "1")
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+    slot = pool.slots[0]
+    stepper = get_stepper(slot)
+    # the fault fires DURING the lane's denoise loop, after the rows of
+    # this burst have been admitted (mid-flight, not at submit time)
+    stepper.inject_fault(
+        after_steps=stepper.stats().get("steps_executed", 0) + 1,
+        exc=RuntimeError("RESOURCE_EXHAUSTED: chaos mid-lane"))
+
+    async def scenario():
+        hive = FakeHive()
+        await hive.start()
+        for i in range(3):
+            hive.jobs.append({
+                "id": f"lane-{i}", "model_name": "tiny",
+                "prompt": f"p{i}", "seed": 500 + i,
+                # mixed steps: only a lane (relaxed key) can merge these
+                "num_inference_steps": 2 + i,
+                "height": 64, "width": 64, "content_type": "image/png"})
+        worker = Worker(
+            settings=chaos_settings(hive.uri, job_deadline_s=600.0,
+                                    workflow_deadline_s={}),
+            registry=registry, pool=pool)
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(3, timeout=300)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=30)
+            await hive.stop()
+        return hive.results
+
+    results = asyncio.run(scenario())
+    by_id = {r["id"]: r for r in results}
+    # exactly-once: all three ids, no duplicates, no silent drops
+    assert sorted(by_id) == ["lane-0", "lane-1", "lane-2"]
+    assert len(results) == 3
+    for r in results:
+        # the fallback path served every bounced row successfully
+        assert r["pipeline_config"].get("error") is None, r
+        assert "fatal_error" not in r
+    assert stepper.stats().get("lanes_failed", 0) >= 1

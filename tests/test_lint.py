@@ -49,8 +49,8 @@ def test_linter_is_stdlib_only(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_all_six_rules_are_registered():
+def test_all_rules_are_registered():
     from chiaswarm_tpu.analysis import all_rules
 
     codes = [r.code for r in all_rules()]
-    assert codes == ["R1", "R2", "R3", "R4", "R5", "R6"], codes
+    assert codes == ["R1", "R2", "R3", "R4", "R5", "R6", "R7"], codes

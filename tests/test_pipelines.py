@@ -84,6 +84,16 @@ def test_init_noise_override_controls_trajectory(tiny_pipeline):
 
 
 def test_img2img_preserves_layout(tiny_pipeline):
+    """Strength maps to a ladder START INDEX (the reference's semantics).
+
+    The old pixel-distance monotonicity assertion (mean |out - init| at
+    strength 0.2 vs 1.0) landed within noise on the tiny random-weight
+    family (~78.7 vs ~78.0, ROADMAP) — the random VAE makes pixel
+    distance to the init meaningless. Assert the STABLE contract
+    instead: the executed ladder position (``denoise_steps`` in the
+    config) is monotone in strength, strengths that quantize to the
+    same start index produce bitwise-identical images, and different
+    start indices produce different images."""
     rng = np.random.default_rng(0)
     init = rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
     req = GenerateRequest(prompt="x", steps=6, height=64, width=64, seed=3,
@@ -91,22 +101,27 @@ def test_img2img_preserves_layout(tiny_pipeline):
     img, config = tiny_pipeline(req)
     assert config["mode"] == "img2img"
     assert img.shape == (1, 64, 64, 3)
+    assert config["denoise_steps"] == 2  # round(6 * 0.4)
 
-    # strength=1.0 wipes more of the init than strength=0.2
-    low, _ = tiny_pipeline(GenerateRequest(
-        prompt="x", steps=6, height=64, width=64, seed=3, init_image=init,
-        strength=0.2, guidance_scale=1.0))
-    high, _ = tiny_pipeline(GenerateRequest(
-        prompt="x", steps=6, height=64, width=64, seed=3, init_image=init,
-        strength=1.0, guidance_scale=1.0))
-    roundtrip, _ = tiny_pipeline(GenerateRequest(
-        prompt="x", steps=6, height=64, width=64, seed=3, init_image=init,
-        strength=0.05, guidance_scale=1.0))
-    d_low = np.abs(low.astype(int) - init.astype(int)).mean()
-    d_high = np.abs(high.astype(int) - init.astype(int)).mean()
-    d_rt = np.abs(roundtrip.astype(int) - init.astype(int)).mean()
-    assert d_low < d_high
-    assert d_rt <= d_low  # strength 0.05 ~ VAE roundtrip of the init
+    def run(strength):
+        return tiny_pipeline(GenerateRequest(
+            prompt="x", steps=6, height=64, width=64, seed=3,
+            init_image=init, strength=strength, guidance_scale=1.0))
+
+    roundtrip, c_rt = run(0.05)
+    low, c_low = run(0.5)
+    high, c_high = run(1.0)
+    # monotone: more strength -> more of the ladder actually executed
+    assert (c_rt["denoise_steps"] < c_low["denoise_steps"]
+            < c_high["denoise_steps"])
+    assert c_high["denoise_steps"] == 6  # full regenerate
+    # strengths quantizing to the SAME start index are the same program
+    # with the same seed: bitwise-equal images (stable, luck-free)
+    twin, c_twin = run(0.1)
+    assert c_twin["denoise_steps"] == c_rt["denoise_steps"]
+    assert np.array_equal(twin, roundtrip)
+    # different start indices genuinely change the trajectory
+    assert not np.array_equal(roundtrip, high)
 
 
 def test_inpaint_keeps_known_region(tiny_pipeline):

@@ -80,4 +80,5 @@ from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
     prng,
     recompile,
     scan_carry,
+    wallclock,
 )

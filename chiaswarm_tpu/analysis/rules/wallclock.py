@@ -1,0 +1,111 @@
+"""R8 wallclock-duration: durations come from perf_counter, not time.time.
+
+``time.time()`` is wall clock: NTP slews and steps it, VM migrations
+jump it, and a leap-smear can stretch it — a duration computed by
+subtracting two wall-clock stamps can be negative, or off by whatever
+the clock did in between. Everything in this repo that MEASURES
+(deadlines, backoff, lane pacing, the swarmscope span tracer in
+``chiaswarm_tpu/obs``) runs on ``time.perf_counter``/``time.monotonic``;
+wall clock is only for *labeling* a moment (log stamps, export
+metadata), never for differencing.
+
+Heuristic, per scope (module body or one function):
+
+- collect names assigned directly from a ``time.time()`` (or
+  ``datetime.datetime.now()`` / ``datetime.utcnow()``) call;
+- flag any binary subtraction where either operand is such a call or
+  such a name.
+
+Subtraction is the tell: a stamp that is stored, compared for ordering,
+or exported stays silent — only stamp-minus-stamp arithmetic (a
+duration) fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    register,
+)
+from chiaswarm_tpu.analysis.rules import FUNC_NODES, own_nodes, resolves_to
+
+#: call targets that read the wall clock
+_WALL_CALLS = (
+    "time.time",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.now",
+    "datetime.utcnow",
+)
+
+
+@register
+class WallclockDuration(Rule):
+    code = "R8"
+    name = "wallclock-duration"
+    description = ("durations must come from time.perf_counter/"
+                   "time.monotonic — subtracting time.time() stamps "
+                   "breaks under NTP slew and clock steps")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        # module body is a scope; every function is its own scope (a
+        # name assigned from time.time() in one function says nothing
+        # about a same-named local elsewhere)
+        yield from self._check_scope(ctx, self._module_nodes(ctx.tree))
+        for info in ctx.functions:
+            yield from self._check_scope(ctx, list(own_nodes(info.node)))
+
+    @staticmethod
+    def _module_nodes(tree: ast.Module) -> list[ast.AST]:
+        nodes: list[ast.AST] = []
+        todo = list(tree.body)
+        while todo:
+            node = todo.pop()
+            if isinstance(node, FUNC_NODES):
+                continue  # separate scope (checked via ctx.functions)
+            nodes.append(node)
+            todo.extend(ast.iter_child_nodes(node))
+        return nodes
+
+    def _check_scope(self, ctx: ModuleContext,
+                     nodes: list[ast.AST]) -> Iterator[Finding]:
+        wall_names: set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Assign) and \
+                    self._is_wall_call(ctx, node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        wall_names.add(target.id)
+        for node in nodes:
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            for operand in (node.left, node.right):
+                if self._is_wall(ctx, operand, wall_names):
+                    yield self.finding(
+                        ctx, node,
+                        "duration computed by subtracting wall-clock "
+                        "stamps (time.time() jumps under NTP/clock "
+                        "steps); use time.perf_counter() or "
+                        "time.monotonic()")
+                    break
+
+    @classmethod
+    def _is_wall(cls, ctx: ModuleContext, expr: ast.AST,
+                 wall_names: set[str]) -> bool:
+        if cls._is_wall_call(ctx, expr):
+            return True
+        return isinstance(expr, ast.Name) and expr.id in wall_names
+
+    @staticmethod
+    def _is_wall_call(ctx: ModuleContext, expr: ast.AST) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        target = ctx.resolve_call(expr)
+        return bool(target) and any(resolves_to(target, w)
+                                    for w in _WALL_CALLS)

@@ -427,6 +427,12 @@ def main() -> None:
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))
 
+    # swarmscope snapshot (chiaswarm_tpu/obs): compile counts/durations
+    # and lane step-latency histograms ride along with every BENCH run,
+    # so a perf regression can be split into "got slower" vs "started
+    # recompiling" without rerunning anything
+    from chiaswarm_tpu.obs.metrics import REGISTRY
+
     target = 4.0  # images/sec/chip, BASELINE.json north star
     print(json.dumps({
         "metric": f"{family} {size}px txt2img {steps} steps, images/sec/chip",
@@ -438,6 +444,7 @@ def main() -> None:
         "attn": attn,
         "backend": jax.default_backend(),
         "configs": configs,
+        "metrics": REGISTRY.snapshot(),
     }))
 
 

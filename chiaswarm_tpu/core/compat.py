@@ -70,6 +70,36 @@ COMPAT_TABLE: dict[str, CompatEntry] = {
              "returns the static size there (ring_attention relied on the "
              "modern name and broke every seq-parallel test on the pin)",
     ),
+    # jax.profiler is stable across the pin, but serving code must still
+    # cross here: the shims degrade to no-ops when the profiler plugin
+    # (or jax itself) is absent, so stdlib-only observability callers
+    # (chiaswarm_tpu/obs) never crash a job because tracing is broken
+    "jax.profiler:trace": CompatEntry(
+        symbol="profiler_trace",
+        modern="jax.profiler.trace",
+        pinned="jax.profiler.trace",
+        note="route through compat.profiler_trace: degrades to a no-op "
+             "context manager when the profiler backend is unavailable",
+    ),
+    "jax.profiler:TraceAnnotation": CompatEntry(
+        symbol="trace_annotation",
+        modern="jax.profiler.TraceAnnotation",
+        pinned="jax.profiler.TraceAnnotation",
+        note="route through compat.trace_annotation: degrades to a no-op "
+             "when the profiler backend is unavailable",
+    ),
+    "jax.profiler:start_trace": CompatEntry(
+        symbol="profiler_start_trace",
+        modern="jax.profiler.start_trace",
+        pinned="jax.profiler.start_trace",
+        note="route through compat.profiler_start_trace (no-op fallback)",
+    ),
+    "jax.profiler:stop_trace": CompatEntry(
+        symbol="profiler_stop_trace",
+        modern="jax.profiler.stop_trace",
+        pinned="jax.profiler.stop_trace",
+        note="route through compat.profiler_stop_trace (no-op fallback)",
+    ),
 }
 
 #: ``jax.experimental`` submodules that modules may import at module scope
@@ -107,7 +137,64 @@ def _resolve_axis_size():
     return axis_size
 
 
-_LAZY = {"shard_map": _resolve_shard_map, "axis_size": _resolve_axis_size}
+class _NoopAnnotation:
+    """Stand-in for jax.profiler.TraceAnnotation when the profiler (or
+    jax itself) is unavailable — observability must never fail a job."""
+
+    def __init__(self, *_args, **_kwargs) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+def _resolve_trace_annotation():
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation
+    except Exception:
+        return _NoopAnnotation
+
+
+def _resolve_profiler_trace():
+    try:
+        import jax
+
+        return jax.profiler.trace
+    except Exception:
+        return _NoopAnnotation  # same no-op context-manager shape
+
+
+def _resolve_profiler_start_trace():
+    try:
+        import jax
+
+        return jax.profiler.start_trace
+    except Exception:
+        return lambda *a, **k: None
+
+
+def _resolve_profiler_stop_trace():
+    try:
+        import jax
+
+        return jax.profiler.stop_trace
+    except Exception:
+        return lambda *a, **k: None
+
+
+_LAZY = {
+    "shard_map": _resolve_shard_map,
+    "axis_size": _resolve_axis_size,
+    "trace_annotation": _resolve_trace_annotation,
+    "profiler_trace": _resolve_profiler_trace,
+    "profiler_start_trace": _resolve_profiler_start_trace,
+    "profiler_stop_trace": _resolve_profiler_stop_trace,
+}
 _cache: dict[str, object] = {}
 
 

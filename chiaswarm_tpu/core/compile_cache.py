@@ -24,9 +24,75 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from typing import Any, Callable, Hashable
 
+from chiaswarm_tpu.obs.metrics import REGISTRY
+
 _POW2 = (1, 2, 4, 8, 16, 32, 64, 128)
+
+# ---- swarmscope hooks (chiaswarm_tpu/obs) ---------------------------------
+# A runtime recompile is the R6 lint hazard made flesh: a shape/config that
+# escaped the bucketing lattice silently costs seconds-to-minutes of chip
+# time. These counters make every executable-cache miss — and the duration
+# of the compile it triggered — visible on /metrics, labeled by the program
+# tag (generate / stepper_step / encode / ...).
+
+_CACHE_HITS = REGISTRY.counter(
+    "chiaswarm_compile_cache_hits_total",
+    "compile-cache lookups served from residency",
+    labelnames=("cache", "tag"))
+_CACHE_MISSES = REGISTRY.counter(
+    "chiaswarm_compile_cache_misses_total",
+    "compile-cache misses (each one built/loaded its value)",
+    labelnames=("cache", "tag"))
+_BUILD_SECONDS = REGISTRY.histogram(
+    "chiaswarm_compile_cache_build_seconds",
+    "time spent building a missed cache entry (trace/convert/load)",
+    labelnames=("cache", "tag"))
+_COMPILE_SECONDS = REGISTRY.histogram(
+    "chiaswarm_compile_seconds",
+    "first-call duration of a freshly built executable — the XLA "
+    "trace+compile cost a cache miss actually paid",
+    labelnames=("tag",))
+_COMPILES = REGISTRY.counter(
+    "chiaswarm_compiles_total",
+    "executables compiled at runtime (cache-miss first calls); a "
+    "nonzero rate after warmup means a shape escaped the buckets (R6)",
+    labelnames=("tag",))
+
+
+def _key_tag(key: Hashable) -> str:
+    """Program tag from a static_cache_key-shaped key (owner, tag, ...);
+    foreign key shapes fall into one bucket."""
+    if isinstance(key, tuple) and len(key) >= 2 and isinstance(key[1], str):
+        return key[1]
+    return "other"
+
+
+def _instrument_executable(fn: Any, tag: str) -> Any:
+    """Time a fresh executable's FIRST call into the compile histogram.
+
+    jax.jit compiles lazily, so the LRU-miss factory only builds the
+    wrapper — the XLA work happens on first invocation. The first call
+    includes one execution too; compile dominates it by orders of
+    magnitude on real programs, and one timed call per executable
+    lifetime costs nothing after."""
+    if not callable(fn):
+        return fn
+    state = {"timed": False}
+
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if state["timed"]:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        state["timed"] = True  # benign race: worst case two observations
+        _COMPILE_SECONDS.observe(time.perf_counter() - t0, tag=tag)
+        _COMPILES.inc(tag=tag)
+        return out
+
+    return wrapped
 
 
 def xla_compiler_options() -> dict[str, str] | None:
@@ -162,9 +228,11 @@ class _Entry:
 class LruCache:
     """A byte-budgeted LRU used for both param trees and executables."""
 
-    def __init__(self, budget_bytes: int | None = None, max_items: int | None = None):
+    def __init__(self, budget_bytes: int | None = None, max_items: int | None = None,
+                 kind: str = "cache"):
         self._budget = budget_bytes
         self._max_items = max_items
+        self._kind = kind  # /metrics label: "params" / "executables"
         self._entries: collections.OrderedDict[Hashable, _Entry] = collections.OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
@@ -176,17 +244,27 @@ class LruCache:
                       size_of: Callable[[Any], int] | None = None) -> Any:
         """``size_of`` computes the entry's byte size from the built value
         (for factories whose footprint is only known after loading)."""
+        tag = _key_tag(key)
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                return entry.value
-            self.misses += 1
+                hit = True
+            else:
+                self.misses += 1
+                hit = False
+        if hit:
+            _CACHE_HITS.inc(cache=self._kind, tag=tag)
+            return entry.value
+        _CACHE_MISSES.inc(cache=self._kind, tag=tag)
         # Build outside the lock: factories compile/convert and can take
         # minutes; concurrent misses on the *same* key are rare (jobs for one
         # model serialize on the slot) and harmless (last write wins).
+        t0 = time.perf_counter()
         value = factory()
+        _BUILD_SECONDS.observe(time.perf_counter() - t0,
+                               cache=self._kind, tag=tag)
         if size_of is not None:
             size_bytes = size_of(value)
         with self._lock:
@@ -229,8 +307,10 @@ class CompileCache:
 
     def __init__(self, param_budget_bytes: int = 24 * 1024**3,
                  max_executables: int = 16) -> None:
-        self.params = LruCache(budget_bytes=param_budget_bytes)
-        self.executables = LruCache(max_items=max_executables)
+        self.params = LruCache(budget_bytes=param_budget_bytes,
+                               kind="params")
+        self.executables = LruCache(max_items=max_executables,
+                                    kind="executables")
 
     def cached_params(self, key: Hashable, loader: Callable[[], Any],
                       size_bytes: int = 0,
@@ -238,7 +318,10 @@ class CompileCache:
         return self.params.get_or_create(key, loader, size_bytes, size_of)
 
     def cached_executable(self, key: Hashable, builder: Callable[[], Any]) -> Any:
-        return self.executables.get_or_create(key, builder)
+        # the first call of a fresh executable pays the lazy XLA compile;
+        # _instrument_executable times exactly that call into /metrics
+        return self.executables.get_or_create(
+            key, lambda: _instrument_executable(builder(), _key_tag(key)))
 
 
 GLOBAL_CACHE = CompileCache()

@@ -16,10 +16,7 @@ Capability parity with swarm/generator.py:12-95:
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import logging
-import os
-import threading
 from typing import Any
 
 import numpy as np
@@ -34,6 +31,9 @@ from chiaswarm_tpu.node.output_processor import (
 )
 from chiaswarm_tpu.node.registry import ModelRegistry
 from chiaswarm_tpu.node.resilience import classify_exception
+from chiaswarm_tpu.obs import trace as obs_trace
+from chiaswarm_tpu.obs.profiling import job_profile
+from chiaswarm_tpu.obs.trace import span
 
 log = logging.getLogger("chiaswarm.executor")
 
@@ -108,44 +108,23 @@ def error_result(job: dict[str, Any], exc_or_message: Any, *,
     return _result(job.get("id"), artifacts, config, fatal=fatal)
 
 
-_PROFILE_LOCK = threading.Lock()
-
-
-@contextlib.contextmanager
-def _maybe_profile(job_id):
-    """Per-job jax.profiler trace when CHIASWARM_PROFILE_DIR is set — the
-    tracing hook the reference lacks entirely (SURVEY.md §5: its only
-    telemetry is print statements). Traces open in XProf/TensorBoard.
-
-    jax.profiler is a process-global singleton: on multi-slot workers,
-    overlapping jobs skip profiling (the job must not fail because a
-    trace was already running)."""
-    profile_dir = os.environ.get("CHIASWARM_PROFILE_DIR")
-    if not profile_dir:
-        yield
-        return
-    if not _PROFILE_LOCK.acquire(blocking=False):
-        log.info("job %s not profiled: another trace is running", job_id)
-        yield
-        return
-    try:
-        import jax
-
-        target = os.path.join(profile_dir, str(job_id or "job"))
-        with jax.profiler.trace(target):
-            yield
-        log.info("job %s profile written to %s", job_id, target)
-    finally:
-        _PROFILE_LOCK.release()
+# per-job XLA tracing when CHIASWARM_PROFILE_DIR is set — the hook the
+# reference lacks entirely (SURVEY.md §5: its only telemetry is print
+# statements). Traces open in XProf/Perfetto. Now shared with the
+# worker's on-demand /debug/profile capture, which holds the same
+# process-global profiler lock (chiaswarm_tpu/obs/profiling.py).
+_maybe_profile = job_profile
 
 
 def _format(job: dict[str, Any], registry: ModelRegistry):
     """-> (job_id, content_type, callback, kwargs) or a fatal result."""
     job = dict(job)
+    job.pop(obs_trace.TRACE_KEY, None)  # never a pipeline kwarg
     job_id = job.pop("id", None)
     content_type = job.get("content_type", "image/jpeg")
     try:
-        callback, kwargs = format_args(job, registry)
+        with span("format"):
+            callback, kwargs = format_args(job, registry)
     except Exception as exc:
         # bad inputs are fatal (do not redispatch) — but formatting also
         # FETCHES input images, and a network blip is not the user's
@@ -231,16 +210,20 @@ def _stepper_collect(job_id, content_type, slot, ticket) -> dict | None:
 def synchronous_do_work(job: dict[str, Any], slot,
                         registry: ModelRegistry) -> dict[str, Any]:
     log.info("processing job %s", job.get("id"))
-    formatted, fatal = _format(job, registry)
-    if formatted is None:
-        return fatal
-    job_id, content_type, _, _ = formatted
-    ticket = _stepper_submit(*formatted, slot, registry)
-    if ticket is not None:
-        result = _stepper_collect(job_id, content_type, slot, ticket)
-        if result is not None:
-            return result
-    return _execute(*formatted, slot)
+    # the job's span tree follows it into this thread: format / encode /
+    # step / decode spans below attach under the worker's open
+    # "execute" phase (chiaswarm_tpu/obs/trace.py)
+    with obs_trace.activate(obs_trace.job_trace(job)):
+        formatted, fatal = _format(job, registry)
+        if formatted is None:
+            return fatal
+        job_id, content_type, _, _ = formatted
+        ticket = _stepper_submit(*formatted, slot, registry)
+        if ticket is not None:
+            result = _stepper_collect(job_id, content_type, slot, ticket)
+            if result is not None:
+                return result
+        return _execute(*formatted, slot)
 
 
 def _coalesce_key(kwargs: dict[str, Any]):
@@ -355,24 +338,29 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
     # lane tickets: eligible jobs are submitted FIRST so their rows
     # splice into running lanes while the rest of the burst executes
     tickets: list[tuple[int, Any, str, dict, Any]] = []
+    def _job_trace(i: int):
+        return obs_trace.job_trace(jobs[i])
+
     for i, job in enumerate(jobs):
         log.info("processing job %s (burst of %d)", job.get("id"),
                  len(jobs))
-        formatted, fatal = _format(job, registry)
-        if formatted is None:
-            results[i] = fatal
-            continue
-        job_id, content_type, callback, kwargs = formatted
-        if callback is diffusion_callback and coalescable(kwargs):
-            ticket = _stepper_submit(job_id, content_type, callback,
-                                     kwargs, slot, registry)
-            if ticket is not None:
-                tickets.append((i, job_id, content_type, kwargs, ticket))
+        with obs_trace.activate(_job_trace(i)):
+            formatted, fatal = _format(job, registry)
+            if formatted is None:
+                results[i] = fatal
                 continue
-            groups.setdefault(_coalesce_key(kwargs), []).append(
-                (i, job_id, content_type, kwargs))
-        else:
-            singles.append((i, job_id, content_type, callback, kwargs))
+            job_id, content_type, callback, kwargs = formatted
+            if callback is diffusion_callback and coalescable(kwargs):
+                ticket = _stepper_submit(job_id, content_type, callback,
+                                         kwargs, slot, registry)
+                if ticket is not None:
+                    tickets.append((i, job_id, content_type, kwargs,
+                                    ticket))
+                    continue
+                groups.setdefault(_coalesce_key(kwargs), []).append(
+                    (i, job_id, content_type, kwargs))
+            else:
+                singles.append((i, job_id, content_type, callback, kwargs))
 
     data_width = max(1, int(getattr(slot, "data_width", 1)))
     chunked = [chunk for whole in groups.values()
@@ -406,6 +394,14 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                 "content_type": kwargs.get("content_type", "image/png"),
             })
         ids = [job_id for _, job_id, _, _ in group]
+        # one batched program serves the whole group: each member's
+        # trace gets a "coalesced" span with the shared boundaries
+        group_spans = []
+        for i, _, _, _ in group:
+            trace = _job_trace(i)
+            if trace is not None:
+                group_spans.append(
+                    trace.tail().child("coalesced", jobs=len(group)))
         try:
             with _maybe_profile(f"coalesced-{ids[0]}"):
                 outs = slot.call_multi(
@@ -427,11 +423,15 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
             for i, job_id, content_type, kwargs in group:
                 singles.append((i, job_id, content_type,
                                 diffusion_callback, kwargs))
+        finally:
+            for group_span in group_spans:
+                group_span.end()
 
     # collect lane tickets after the burst groups dispatched: a failed
     # lane row falls back to the per-job path below (zero-loss)
     for i, job_id, content_type, kwargs, ticket in tickets:
-        result = _stepper_collect(job_id, content_type, slot, ticket)
+        with obs_trace.activate(_job_trace(i)):
+            result = _stepper_collect(job_id, content_type, slot, ticket)
         if result is not None:
             results[i] = result
         else:
@@ -439,5 +439,7 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                             kwargs))
 
     for i, job_id, content_type, callback, kwargs in singles:
-        results[i] = _execute(job_id, content_type, callback, kwargs, slot)
+        with obs_trace.activate(_job_trace(i)):
+            results[i] = _execute(job_id, content_type, callback, kwargs,
+                                  slot)
     return [r for r in results if r is not None]

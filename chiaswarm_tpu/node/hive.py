@@ -14,15 +14,30 @@ protocol's congestion control and is preserved as constants here.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
+import time
 from typing import Any
 
 import aiohttp
 
 from chiaswarm_tpu import WORKER_VERSION
+from chiaswarm_tpu.obs.metrics import REGISTRY
+from chiaswarm_tpu.obs.trace import span
 
 log = logging.getLogger("chiaswarm.hive")
+
+# control-plane request accounting (process-global: the HTTP client is
+# worker-agnostic; worker-scoped health lives on the worker's registry)
+_REQUESTS = REGISTRY.counter(
+    "chiaswarm_hive_requests_total",
+    "hive API requests by endpoint and coarse result",
+    labelnames=("endpoint", "result"))
+_REQUEST_SECONDS = REGISTRY.histogram(
+    "chiaswarm_hive_request_seconds",
+    "hive API request latency",
+    labelnames=("endpoint",))
 
 # the adaptive poll cadence constants are protocol-level but live in the
 # pure-config settings module (so config never imports aiohttp);
@@ -36,6 +51,23 @@ from chiaswarm_tpu.node.settings import (  # noqa: F401
     POLL_ERROR_S,
     POLL_IDLE_S,
 )
+
+
+@contextlib.contextmanager
+def _observe(endpoint: str):
+    """Count + time one hive API request (coarse ok/error result; the
+    timer spans the whole request including retried body reads)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException:
+        _REQUESTS.inc(endpoint=endpoint, result="error")
+        raise
+    else:
+        _REQUESTS.inc(endpoint=endpoint, result="ok")
+    finally:
+        _REQUEST_SECONDS.observe(time.perf_counter() - t0,
+                                 endpoint=endpoint)
 
 
 class BadWorkerError(RuntimeError):
@@ -60,55 +92,60 @@ class HiveClient:
 
     async def get_work(self, session: aiohttp.ClientSession) -> list[dict]:
         """Fetch queued jobs; raises on non-200 (caller applies backoff)."""
-        async with session.get(
-            f"{self.api}/work",
-            params={
-                "worker_version": WORKER_VERSION,
-                "worker_name": self.worker_name,
-            },
-            headers=self._headers(),
-            timeout=aiohttp.ClientTimeout(total=10),
-        ) as response:
-            if response.status == 200:
-                payload = await response.json()
-                return list(payload.get("jobs", []))
-            if response.status == 400:
-                # parse defensively: a misbehaving-worker signal must stay
-                # a BadWorkerError even when the hive (or an intermediary
-                # proxy) sends a non-JSON 400 body — letting json() raise
-                # here would demote it to a generic poll failure
-                message = "bad worker"
-                try:
-                    payload = await response.json(content_type=None)
-                    if isinstance(payload, dict):
-                        message = str(payload.get("message", message))
-                except Exception:
+        with _observe("work"):
+            async with session.get(
+                f"{self.api}/work",
+                params={
+                    "worker_version": WORKER_VERSION,
+                    "worker_name": self.worker_name,
+                },
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as response:
+                if response.status == 200:
+                    payload = await response.json()
+                    return list(payload.get("jobs", []))
+                if response.status == 400:
+                    # parse defensively: a misbehaving-worker signal must
+                    # stay a BadWorkerError even when the hive (or an
+                    # intermediary proxy) sends a non-JSON 400 body —
+                    # letting json() raise here would demote it to a
+                    # generic poll failure
+                    message = "bad worker"
                     try:
-                        body = (await response.text()).strip()
-                        if body:
-                            message = body[:200]
+                        payload = await response.json(content_type=None)
+                        if isinstance(payload, dict):
+                            message = str(payload.get("message", message))
                     except Exception:
-                        pass
-                raise BadWorkerError(message)
-            response.raise_for_status()
-            return []
+                        try:
+                            body = (await response.text()).strip()
+                            if body:
+                                message = body[:200]
+                        except Exception:
+                            pass
+                    raise BadWorkerError(message)
+                response.raise_for_status()
+                return []
 
     async def post_result(self, session: aiohttp.ClientSession,
                           result: dict[str, Any]) -> dict[str, Any]:
-        async with session.post(
-            f"{self.api}/results",
-            data=json.dumps(result),
-            headers=self._headers(),
-            timeout=aiohttp.ClientTimeout(total=60),
-        ) as response:
-            if response.status >= 400:
-                log.error("hive rejected result (%s): %s", response.status,
-                          response.reason)
-                response.raise_for_status()
-            try:
-                return await response.json()
-            except Exception:  # non-JSON 2xx body — accept the upload
-                return {"status": response.status}
+        # the span lands under the job's "upload" phase when the worker
+        # delivers with the trace active (node/worker.py::_deliver)
+        with _observe("results"), span("upload.http"):
+            async with session.post(
+                f"{self.api}/results",
+                data=json.dumps(result),
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=60),
+            ) as response:
+                if response.status >= 400:
+                    log.error("hive rejected result (%s): %s",
+                              response.status, response.reason)
+                    response.raise_for_status()
+                try:
+                    return await response.json()
+                except Exception:  # non-JSON 2xx body — accept upload
+                    return {"status": response.status}
 
     async def get_models(self, session: aiohttp.ClientSession) -> list[dict]:
         async with session.get(

@@ -33,7 +33,6 @@ job can load it anywhere.
 
 from __future__ import annotations
 
-import dataclasses
 import hashlib
 import json
 import logging
@@ -279,13 +278,22 @@ class BreakerBoard:
     ``on_probe(model)`` when a half-open probe is about to dispatch (the
     registry must accept the load again or the probe can never succeed).
     Callbacks may be None (test stubs without a real registry).
+
+    ``persist_path`` makes open breakers survive restarts (ROADMAP PR-2
+    candidate): every open/close transition serializes the non-closed
+    breakers to one JSON file next to the dead-letter spool, storing the
+    REMAINING cooldown (the monotonic clock does not survive a
+    restart); loading re-opens them, re-arms the residual cooldown, and
+    re-mirrors the quarantine — a checkpoint that broke the node five
+    minutes before a crash is still quarantined when it comes back.
     """
 
     def __init__(self, threshold: int, cooldown_s: float,
                  clock: Callable[[], float] = time.monotonic,
                  on_open: Callable[[str], Any] | None = None,
                  on_close: Callable[[str], Any] | None = None,
-                 on_probe: Callable[[str], Any] | None = None) -> None:
+                 on_probe: Callable[[str], Any] | None = None,
+                 persist_path: Path | str | None = None) -> None:
         self.threshold = max(1, int(threshold))
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
@@ -293,6 +301,10 @@ class BreakerBoard:
         self._on_open = on_open
         self._on_close = on_close
         self._on_probe = on_probe
+        self._persist_path = (None if persist_path is None
+                              else Path(persist_path))
+        if self._persist_path is not None:
+            self._load()
 
     @staticmethod
     def _notify(callback: Callable[[str], Any] | None, model: str) -> None:
@@ -331,6 +343,8 @@ class BreakerBoard:
         elif transition == "closed":
             log.info("breaker closed for %s (probe succeeded)", model)
             self._notify(self._on_close, model)
+        if transition is not None:
+            self._persist()
 
     def record_inconclusive(self, model: str) -> None:
         """The job's failure says nothing about the model (bad user
@@ -347,6 +361,86 @@ class BreakerBoard:
 
     def open_models(self) -> list[str]:
         return [m for m, b in self._breakers.items() if b.state == "open"]
+
+    # ---- persistence across restarts ----
+
+    def save(self) -> None:
+        """Re-serialize now (worker shutdown): transitions persist
+        eagerly, but a clean stop refreshes the REMAINING cooldowns so
+        a long-lived open breaker doesn't re-arm its full window on the
+        next start."""
+        self._persist()
+
+    def dump(self) -> dict[str, Any]:
+        """Serializable view of the non-closed breakers. Half-open is
+        stored as open with zero remaining cooldown: a restart aborts
+        any in-flight probe, so the next allow() re-probes cleanly."""
+        now = self._clock()
+        out: dict[str, Any] = {}
+        for model, breaker in self._breakers.items():
+            if breaker.state == "closed":
+                continue
+            if breaker.state == "half_open":
+                remaining = 0.0
+            else:
+                remaining = max(0.0, self.cooldown_s
+                                - (now - breaker._opened_at))
+            out[model] = {
+                "state": "open",
+                "consecutive_failures": int(breaker.failures),
+                "cooldown_remaining_s": round(remaining, 3),
+            }
+        return out
+
+    def _persist(self) -> None:
+        if self._persist_path is None:
+            return
+        try:
+            data = self.dump()
+            path = self._persist_path
+            if not data:
+                path.unlink(missing_ok=True)
+                return
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(path.suffix + ".tmp")
+            tmp.write_text(json.dumps({"version": 1, "breakers": data},
+                                      sort_keys=True), encoding="utf-8")
+            tmp.replace(path)
+        except OSError as exc:  # persistence must never break dispatch
+            log.warning("breaker-state persist to %s failed: %s",
+                        self._persist_path, exc)
+
+    def _load(self) -> None:
+        path = self._persist_path
+        if path is None or not path.is_file():
+            return
+        try:
+            payload = json.loads(path.read_text(encoding="utf-8"))
+            entries = dict(payload.get("breakers") or {})
+        except (OSError, json.JSONDecodeError, AttributeError) as exc:
+            log.error("unreadable breaker-state file %s (%s); starting "
+                      "with closed breakers", path, exc)
+            return
+        now = self._clock()
+        for model, entry in entries.items():
+            try:
+                remaining = max(0.0, min(
+                    self.cooldown_s,
+                    float(entry.get("cooldown_remaining_s", 0.0))))
+                failures = max(self.threshold,
+                               int(entry.get("consecutive_failures", 0)))
+            except (TypeError, ValueError):
+                continue
+            breaker = CircuitBreaker(self.threshold, self.cooldown_s,
+                                     self._clock)
+            breaker.state = "open"
+            breaker.failures = failures
+            breaker._opened_at = now - (self.cooldown_s - remaining)
+            self._breakers[str(model)] = breaker
+            log.warning("breaker for %s restored OPEN from %s "
+                        "(%.0fs cooldown remaining)", model, path,
+                        remaining)
+            self._notify(self._on_open, str(model))
 
 
 # ---------------------------------------------------------------------------
@@ -417,19 +511,56 @@ class DeadLetterSpool:
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass
-class ResilienceStats:
-    """Worker-level failure counters surfaced on /healthz
-    (node/worker.py::Worker.health) so the degradation ladder is
-    observable from outside the process."""
+_STAT_HELP = {
+    "jobs_failed": "jobs whose final envelope was a failure",
+    "jobs_timed_out": "jobs that exceeded their execution deadline",
+    "jobs_retried": "solo re-runs taken by the degradation ladder",
+    "jobs_quarantined": "jobs refused by an open circuit breaker",
+    "upload_retries": "result-upload attempts that failed and retried",
+    "results_dead_lettered": "results spooled after exhausting uploads",
+    "results_replayed": "dead-letter results replayed at startup",
+}
 
-    jobs_failed: int = 0
-    jobs_timed_out: int = 0
-    jobs_retried: int = 0
-    jobs_quarantined: int = 0
-    upload_retries: int = 0
-    results_dead_lettered: int = 0
-    results_replayed: int = 0
+
+def _stat_property(name: str):
+    def get(self: "ResilienceStats") -> int:
+        return int(self._counters[name].value())
+
+    def set_(self: "ResilienceStats", value: int) -> None:
+        # the worker's idiom is `stats.field += 1`; counters stay
+        # monotonic because the read-modify-write only ever grows
+        counter = self._counters[name]
+        counter.inc(max(0, int(value) - int(counter.value())))
+
+    return property(get, set_, doc=_STAT_HELP[name])
+
+
+class ResilienceStats:
+    """Worker-level failure counters, migrated onto the swarmscope
+    metrics registry (ISSUE 4): each field IS a registry counter
+    (``chiaswarm_<field>_total`` on the worker's /metrics), and
+    ``snapshot()`` keeps the original /healthz JSON keys as a
+    read-through view. The ``stats.field += 1`` call sites are
+    unchanged — the properties forward to the counters."""
+
+    _FIELDS = tuple(_STAT_HELP)
+
+    jobs_failed = _stat_property("jobs_failed")
+    jobs_timed_out = _stat_property("jobs_timed_out")
+    jobs_retried = _stat_property("jobs_retried")
+    jobs_quarantined = _stat_property("jobs_quarantined")
+    upload_retries = _stat_property("upload_retries")
+    results_dead_lettered = _stat_property("results_dead_lettered")
+    results_replayed = _stat_property("results_replayed")
+
+    def __init__(self, registry: Any = None) -> None:
+        from chiaswarm_tpu.obs.metrics import Registry
+
+        self.registry = registry if registry is not None else Registry()
+        self._counters = {
+            name: self.registry.counter(f"chiaswarm_{name}_total", help_)
+            for name, help_ in _STAT_HELP.items()
+        }
 
     def snapshot(self) -> dict[str, int]:
-        return dataclasses.asdict(self)
+        return {name: getattr(self, name) for name in self._FIELDS}

@@ -34,15 +34,21 @@ and matmul precision pinned (bf16 — the TPU analog of TF32 knobs).
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 import random
 import re
 import signal
+import time
 from pathlib import Path
 from typing import Any
 
 import aiohttp
 import jax
+
+from chiaswarm_tpu.obs import metrics as obs_metrics
+from chiaswarm_tpu.obs import profiling as obs_profiling
+from chiaswarm_tpu.obs import trace as obs_trace
 
 from chiaswarm_tpu.core.chip_pool import ChipPool
 from chiaswarm_tpu.node.executor import (
@@ -166,8 +172,27 @@ class Worker:
         # leaves this many jobs in the queue so coalescing on one slot
         # never starves an idle neighbor (multi-slot fairness reserve)
         self._hungry_slots = 0
+        # ---- observability (chiaswarm_tpu/obs, ISSUE 4) ----
+        # per-WORKER registry + trace ring: hermetic test workers must
+        # not bleed counters into each other; process-wide metrics
+        # (compile cache, lane step timing) live on obs.metrics.REGISTRY
+        # and /metrics serves both
+        self.metrics = obs_metrics.Registry()
+        self.traces = obs_trace.TraceRing()
+        self._job_seconds = self.metrics.histogram(
+            "chiaswarm_job_seconds",
+            "end-to-end job wall time (poll receipt -> upload settled)")
+        self._phase_seconds = self.metrics.histogram(
+            "chiaswarm_job_phase_seconds",
+            "per-phase job wall time from the trace spans",
+            labelnames=("phase",))
+        self._jobs_total = self.metrics.counter(
+            "chiaswarm_jobs_total",
+            "jobs settled (uploaded or dead-lettered), by final outcome",
+            labelnames=("outcome",))
+        self.metrics.add_collector(self._collect_metrics)
         # ---- fault-tolerance state (node/resilience.py) ----
-        self.stats = ResilienceStats()
+        self.stats = ResilienceStats(self.metrics)
         # deterministic per-worker jitter: chaos runs reproduce exactly,
         # while distinct workers still decorrelate from each other
         self._poll_backoff = Backoff(
@@ -178,13 +203,22 @@ class Worker:
             f"retry:{self.settings.worker_name}")
         # the registry mirror tolerates stub registries without
         # quarantine support (several worker tests pass object())
+        # breaker state persists NEXT TO the dead-letter spool and
+        # reloads here: a checkpoint quarantined before a restart stays
+        # quarantined after it (the residual cooldown rides the file)
         self.breakers = BreakerBoard(
             threshold=self.settings.breaker_threshold,
             cooldown_s=self.settings.breaker_cooldown_s,
             on_open=getattr(self.registry, "quarantine", None),
             on_close=getattr(self.registry, "unquarantine", None),
-            on_probe=getattr(self.registry, "unquarantine", None))
+            on_probe=getattr(self.registry, "unquarantine", None),
+            persist_path=self._breaker_state_path())
         self.dead_letters = DeadLetterSpool(self._dead_letter_dir())
+
+    def _breaker_state_path(self) -> Path:
+        spool = self._dead_letter_dir()
+        # sibling FILE, not inside the spool: replay() globs *.json there
+        return spool.parent / f"{spool.name}.breakers.json"
 
     def _dead_letter_dir(self) -> Path:
         if self.settings.dead_letter_dir:
@@ -322,6 +356,8 @@ class Worker:
             await asyncio.gather(*tasks, return_exceptions=True)
             # anything still queued embodies paid chip time: spool it
             self._spool_unsent_results()
+            # refresh persisted breaker cooldowns (they survive restarts)
+            self.breakers.save()
             if health_runner is not None:
                 await health_runner.cleanup()
             self._remove_signal_handlers(loop, signals)
@@ -374,10 +410,15 @@ class Worker:
                 result = self.result_queue.get_nowait()
             except asyncio.QueueEmpty:
                 return
+            trace = obs_trace.detach(result)  # never serializes to disk
             spooled = result.pop("_dead_letter_path", None)
             if spooled is None:  # replayed results already have a file
                 self.dead_letters.spool(result)
                 self.stats.results_dead_lettered += 1
+            # same settling as _deliver's cancelled-upload path: a job
+            # dead-lettered by shutdown still counts in jobs_total and
+            # leaves its trace in the ring
+            self._finish_trace(trace, result, settled="dead_letter")
             self.result_queue.task_done()
 
     # ---- health endpoint (observability gap fix, SURVEY.md §5: the
@@ -421,6 +462,58 @@ class Worker:
         data.update(aggregate_stats(steppers))
         return data
 
+    def _collect_metrics(self) -> None:
+        """Scrape-time mirror of worker state the registry does not see
+        increment-by-increment: queue depths, breaker states, and the
+        stepper's lane stats (their sources keep their own monotonic
+        totals; Prometheus collect-on-scrape copies them in)."""
+        m = self.metrics
+        m.gauge("chiaswarm_work_queue_depth",
+                "jobs queued and not yet claimed by a slot").set(
+            self.work_queue.qsize())
+        m.gauge("chiaswarm_results_pending",
+                "finished results waiting for upload").set(
+            self.result_queue.qsize())
+        m.counter("chiaswarm_jobs_done_total",
+                  "jobs that completed execution on this worker").set_to(
+            self.jobs_done)
+        m.gauge("chiaswarm_dead_letter_depth",
+                "result envelopes spooled on disk").set(
+            self.dead_letters.depth())
+        m.gauge("chiaswarm_poll_consecutive_errors",
+                "current poll-loop error streak (drives the backoff)").set(
+            self._poll_backoff.failures)
+        state_code = {"closed": 0, "half_open": 1, "open": 2}
+        breaker_state = m.gauge(
+            "chiaswarm_breaker_state",
+            "per-model circuit breaker (0=closed 1=half-open 2=open)",
+            labelnames=("model",))
+        breaker_failures = m.gauge(
+            "chiaswarm_breaker_consecutive_failures",
+            "per-model consecutive breaker-counted failures",
+            labelnames=("model",))
+        for model, snap in self.breakers.states().items():
+            breaker_state.set(state_code.get(snap["state"], 2), model=model)
+            breaker_failures.set(snap["consecutive_failures"], model=model)
+        stepper = self._stepper_health()
+        counters = ("steps_executed", "rows_admitted",
+                    "rows_admitted_midflight", "rows_completed",
+                    "rows_expired", "rows_failed", "lanes_created",
+                    "lanes_failed", "row_steps_active", "row_steps_padded")
+        for key in counters:
+            m.counter(f"chiaswarm_stepper_{key}_total",
+                      f"step scheduler: cumulative {key}").set_to(
+                stepper.get(key, 0))
+        gauges = ("lanes_live", "rows_active", "lane_rows_total",
+                  "lane_occupancy", "padding_waste")
+        for key in gauges:
+            m.gauge(f"chiaswarm_stepper_{key}",
+                    f"step scheduler: current {key}").set(
+                stepper.get(key, 0))
+        m.gauge("chiaswarm_stepper_enabled",
+                "1 when CHIASWARM_STEPPER lane routing is on").set(
+            1 if stepper.get("enabled") else 0)
+
     async def _start_health_server(self):
         port = int(self.settings.health_port or 0)
         if port <= 0 and not self.settings.health_bind_ephemeral:
@@ -430,8 +523,43 @@ class Worker:
         async def healthz(_request):
             return web.json_response(self.health())
 
+        async def metrics_endpoint(_request):
+            # worker-scoped metrics + the process-global registry
+            # (compile cache, lane step timing) in one scrape body
+            body = obs_metrics.render_all([self.metrics,
+                                           obs_metrics.REGISTRY])
+            return web.Response(
+                body=body.encode("utf-8"),
+                headers={"Content-Type": obs_metrics.CONTENT_TYPE})
+
+        async def traces_endpoint(request):
+            if request.query.get("format") == "tree":
+                return web.json_response(
+                    {"traces": self.traces.to_dicts()})
+            # default: chrome-tracing "complete" events — load the body
+            # as-is at https://ui.perfetto.dev
+            return web.json_response(self.traces.to_chrome())
+
+        async def profile_endpoint(request):
+            try:
+                seconds = float(request.query.get("seconds", "5"))
+            except ValueError:
+                return web.json_response(
+                    {"status": "error", "error": "seconds must be a "
+                     "number"}, status=400)
+            out = request.query.get("dir") or None
+            # capture blocks for the duration; keep the event loop free
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(obs_profiling.capture,
+                                        seconds, out))
+            status = {"ok": 200, "busy": 409}.get(result.get("status"), 500)
+            return web.json_response(result, status=status)
+
         app = web.Application()
         app.router.add_get("/healthz", healthz)
+        app.router.add_get("/metrics", metrics_endpoint)
+        app.router.add_get("/debug/traces", traces_endpoint)
+        app.router.add_get("/debug/profile", profile_endpoint)
         runner = web.AppRunner(app)
         await runner.setup()
         # loopback by default: the endpoint is operator observability,
@@ -441,7 +569,8 @@ class Worker:
         await site.start()
         bound_port = runner.addresses[0][1] if runner.addresses else port
         self.health_address = (host, bound_port)
-        log.info("health endpoint on %s:%d/healthz", host, bound_port)
+        log.info("health endpoints on %s:%d (/healthz /metrics "
+                 "/debug/traces /debug/profile)", host, bound_port)
         return runner
 
     # ---- tasks ----
@@ -469,6 +598,7 @@ class Worker:
         """One poll; returns the next delay. Errors back off exponentially
         with jitter (capped at hive.POLL_ERROR_S by default) and the
         schedule resets on the first successful poll."""
+        t_poll = time.perf_counter()
         try:
             jobs = await self.hive.get_work(session)
         except BadWorkerError as exc:
@@ -478,8 +608,20 @@ class Worker:
             log.warning("poll failed: %s", exc)
             return self._poll_backoff.next()
         self._poll_backoff.reset()
+        poll_http_s = time.perf_counter() - t_poll
         for job in jobs:
             log.info("got job %s", job.get("id"))
+            # the job's trace is born at hive receipt; its "poll" phase
+            # covers the queue wait until a slot picks the job up (the
+            # HTTP fetch itself rides as metadata — it served the whole
+            # poll, not this one job)
+            trace = obs_trace.JobTrace(
+                "job", id=job.get("id"),
+                model=str(job.get("model_name") or ""),
+                workflow=str(job.get("workflow") or ""),
+                worker=self.settings.worker_name)
+            trace.phase("poll", http_s=round(poll_http_s, 6))
+            obs_trace.attach(job, trace)
             await self.work_queue.put(job)
         if jobs:
             return float(self.settings.poll_busy_s)
@@ -553,8 +695,11 @@ class Worker:
                     self.stats.jobs_failed += 1
                     outcomes.setdefault(
                         str(job.get("model_name") or ""), set()).add(kind)
-                    await self.result_queue.put(
-                        error_result(job, exc, kind=kind))
+                    envelope = error_result(job, exc, kind=kind)
+                    trace = obs_trace.detach(job)
+                    if trace is not None:  # ride on to the upload phase
+                        obs_trace.attach(envelope, trace)
+                    await self.result_queue.put(envelope)
                     self.jobs_done += 1
                 self._record_outcomes(outcomes)
             finally:
@@ -707,6 +852,10 @@ class Worker:
         4. final outcomes feed the per-model breakers.
         """
         results: list[dict | None] = [None] * len(burst)
+        for job in burst:
+            trace = obs_trace.job_trace(job)
+            if trace is not None:  # poll phase ends, execute begins
+                trace.phase("execute")
         ready: list[int] = []
         for i, job in enumerate(burst):
             model = str(job.get("model_name") or "")
@@ -745,6 +894,12 @@ class Worker:
             outcomes.setdefault(
                 str(burst[i].get("model_name") or ""), set()).add(kind)
         self._record_outcomes(outcomes)
+        # the trace hops from the consumed job dict onto its result
+        # envelope so the upload phase (and finish) can find it
+        for i, job in enumerate(burst):
+            trace = obs_trace.detach(job)
+            if trace is not None and results[i] is not None:
+                obs_trace.attach(results[i], trace)
         return [result for result in results if result is not None]
 
     def _record_outcomes(self, outcomes: dict[str, set[str]]) -> None:
@@ -787,14 +942,19 @@ class Worker:
         worker flagged by the hive's timeout-based failure detection).
         Exhausted retries spool the envelope to the dead-letter directory
         for replay on the next startup."""
+        trace = obs_trace.detach(result)  # must never reach json.dumps
         spooled = result.pop("_dead_letter_path", None)
+        if trace is not None:
+            trace.phase("upload")
         try:
-            uploaded = await self._upload_with_retry(session, result)
+            with obs_trace.activate(trace):
+                uploaded = await self._upload_with_retry(session, result)
         except asyncio.CancelledError:
             # shutdown cancelled us mid-upload: persist before dying
             if spooled is None:
                 self.dead_letters.spool(result)
                 self.stats.results_dead_lettered += 1
+            self._finish_trace(trace, result, settled="dead_letter")
             raise
         if uploaded:
             if spooled is not None:
@@ -803,6 +963,23 @@ class Worker:
             self.dead_letters.spool(result)
             self.stats.results_dead_lettered += 1
         # a replayed result that failed again keeps its existing file
+        self._finish_trace(trace, result,
+                           settled="uploaded" if uploaded else "dead_letter")
+
+    def _finish_trace(self, trace, result: dict, settled: str) -> None:
+        """Close a job's span tree, publish it to the worker's trace
+        ring, and fold its phase durations into the latency histograms
+        — the per-job numbers the ROADMAP's perf work tunes against."""
+        if trace is None:
+            return
+        outcome = classify_result(result)
+        trace.meta["outcome"] = outcome
+        trace.meta["settled"] = settled
+        trace.finish(self.traces)
+        for phase in trace.root.children:
+            self._phase_seconds.observe(phase.duration_s, phase=phase.name)
+        self._job_seconds.observe(trace.root.duration_s)
+        self._jobs_total.inc(outcome=outcome)
 
     async def _upload_with_retry(self, session, result) -> bool:
         retries = max(1, int(self.settings.upload_retries))

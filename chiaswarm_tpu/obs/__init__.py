@@ -1,0 +1,52 @@
+"""swarmscope — the unified observability subsystem (stdlib-only).
+
+Three layers, one vocabulary (ISSUE 4):
+
+- ``metrics``   — Prometheus-style :class:`Registry` of counters /
+                  gauges / histograms; ``/metrics`` exposition, BENCH
+                  snapshots, and the ``/healthz`` read-through view.
+- ``trace``     — Dapper-style per-job span trees on ``perf_counter``
+                  (poll -> execute -> encode/step/decode -> upload),
+                  kept in a bounded ring and exported as
+                  Perfetto-loadable JSON at ``/debug/traces``.
+- ``profiling`` — ``jax.profiler`` behind ``core/compat.py``:
+                  ``TraceAnnotation`` names for the serving hot paths
+                  and on-demand XLA captures (``/debug/profile``,
+                  ``CHIASWARM_PROFILE_DIR``).
+
+Like ``analysis/``, this package imports without jax, aiohttp, or any
+accelerator — host tools, the linter environment, and CI jobs can load
+it anywhere. Instrumentation is always-on and allocation-light;
+profiler capture is opt-in.
+"""
+
+from chiaswarm_tpu.obs.metrics import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    render_all,
+)
+from chiaswarm_tpu.obs.trace import (  # noqa: F401
+    TRACE_KEY,
+    TRACE_RING,
+    JobTrace,
+    Span,
+    TraceRing,
+    activate,
+    attach,
+    current_span,
+    detach,
+    job_trace,
+    span,
+)
+from chiaswarm_tpu.obs.profiling import (  # noqa: F401
+    PROFILE_DIR_ENV,
+    annotate,
+    capture,
+    job_profile,
+    profiler_available,
+)

@@ -1,0 +1,338 @@
+"""Prometheus-style metrics registry: counters, gauges, histograms.
+
+The worker grew three generations of ad-hoc telemetry — the resilience
+counters (PR 2), the stepper lane stats (PR 3), and the seed's bare
+``/healthz`` dict. This module is the one vocabulary they all migrate
+onto: a :class:`Registry` of named metrics with label support, rendered
+in the Prometheus text exposition format at ``/metrics``
+(node/worker.py) and snapshot as JSON into BENCH runs (benchmark.py)
+and ``/healthz`` (which stays a read-through view for back-compat).
+
+Design constraints, in order:
+
+- **stdlib only** (like ``analysis/``): importable with no jax, no
+  aiohttp — the linter, host tools, and ``core/compile_cache.py`` all
+  load it.
+- **allocation-light on the hot path**: an ``inc()``/``observe()`` is a
+  dict lookup + float add under one lock; no per-event objects.
+- **hermetic**: :class:`Registry` is a class, not only a module global.
+  Each Worker owns its own registry (multiple hermetic workers share a
+  test process; their counters must not bleed into each other), while
+  process-wide machinery (the compile cache, lane step timing) uses the
+  shared :data:`REGISTRY`. ``render_all`` merges both for ``/metrics``.
+
+Counters are monotonic. For sources that already maintain their own
+monotonic totals (the stepper's lane stats), a *collector* callback
+registered via :meth:`Registry.add_collector` mirrors them in at scrape
+time with :meth:`Counter.set_to` — the Prometheus collect-on-scrape
+pattern, not a license to decrement.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Any, Callable, Iterable, Sequence
+
+log = logging.getLogger("chiaswarm.obs")
+
+#: default histogram buckets (seconds): spans poll blips (~ms) through
+#: cold XLA compiles (~minutes). Callers with tighter ranges pass their
+#: own.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                   600.0)
+
+
+def _escape_label(value: str) -> str:
+    return (value.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _escape_help(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _format_value(value: float) -> str:
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+class _Metric:
+    """Base: one named family holding a value per label-values tuple."""
+
+    typ = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()) -> None:
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._values: dict[tuple[str, ...], float] = {}
+        if not self.labelnames:
+            # unlabeled series exist from registration, so /metrics shows
+            # an explicit 0 instead of omitting the family entirely
+            self._values[()] = 0.0
+
+    def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def series(self) -> dict[tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._values)
+
+    # ---- exposition ----
+
+    def _series_name(self, suffix: str, key: tuple[str, ...],
+                     extra: tuple[tuple[str, str], ...] = ()) -> str:
+        pairs = tuple(zip(self.labelnames, key)) + extra
+        if not pairs:
+            return f"{self.name}{suffix}"
+        inner = ",".join(f'{n}="{_escape_label(v)}"' for n, v in pairs)
+        return f"{self.name}{suffix}{{{inner}}}"
+
+    def render(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.typ}")
+        series = self.series()
+        for key in sorted(series):
+            lines.append(f"{self._series_name('', key)} "
+                         f"{_format_value(series[key])}")
+        return lines
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": self.typ, "help": self.help,
+                "values": {",".join(k) if k else "": v
+                           for k, v in sorted(self.series().items())}}
+
+
+class Counter(_Metric):
+    """Monotonic counter. ``inc`` adds; ``set_to`` mirrors an external
+    monotonic total in (collector use only — never goes backward)."""
+
+    typ = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def set_to(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = max(self._values.get(key, 0.0),
+                                    float(value))
+
+
+class Gauge(_Metric):
+    typ = "gauge"
+
+    def set(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: Any) -> None:
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (cumulative ``le`` buckets + sum/count)."""
+
+    typ = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket")
+        self._counts: dict[tuple[str, ...], list[int]] = {}
+        self._sums: dict[tuple[str, ...], float] = {}
+        self._totals: dict[tuple[str, ...], int] = {}
+        self._values.clear()  # histograms expose bucket/sum/count instead
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = self._key(labels)
+        value = float(value)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = self._counts[key] = [0] * len(self.buckets)
+                self._sums[key] = 0.0
+                self._totals[key] = 0
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+                    break
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def count(self, **labels: Any) -> int:
+        with self._lock:
+            return self._totals.get(self._key(labels), 0)
+
+    def sum(self, **labels: Any) -> float:
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape_help(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.typ}")
+        with self._lock:
+            items = [(k, list(c), self._sums[k], self._totals[k])
+                     for k, c in sorted(self._counts.items())]
+        for key, counts, total_sum, total in items:
+            cum = 0
+            for bound, n in zip(self.buckets, counts):
+                cum += n
+                lines.append(
+                    f"{self._series_name('_bucket', key, (('le', _format_value(bound)),))} "
+                    f"{cum}")
+            lines.append(
+                f"{self._series_name('_bucket', key, (('le', '+Inf'),))} "
+                f"{total}")
+            lines.append(f"{self._series_name('_sum', key)} "
+                         f"{_format_value(total_sum)}")
+            lines.append(f"{self._series_name('_count', key)} {total}")
+        return lines
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "type": self.typ,
+                "help": self.help,
+                "buckets": list(self.buckets),
+                "values": {
+                    ",".join(k) if k else "": {
+                        "counts": list(c),
+                        "sum": self._sums[k],
+                        "count": self._totals[k],
+                    }
+                    for k, c in sorted(self._counts.items())
+                },
+            }
+
+
+class Registry:
+    """Named metric families + scrape-time collector callbacks.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: asking twice
+    for the same name returns the same object (so modules can declare
+    their metrics independently), but re-declaring with a different type
+    or label set is a programming error and raises.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Callable[[], None]] = []
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labelnames: Sequence[str], **kwargs) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (type(existing) is not cls
+                        or existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}{existing.labelnames}")
+                return existing
+            metric = cls(name, help, labelnames, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callback run before every render/snapshot — the
+        place to mirror externally-maintained state (lane stats, queue
+        depths, breaker states) into gauges/counters at scrape time."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # a broken mirror must never break scrapes
+                log.exception("metrics collector failed")
+
+    def _sorted_metrics(self) -> list[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def render(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        self.collect()
+        lines: list[str] = []
+        for metric in self._sorted_metrics():
+            lines.extend(metric.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-able view of every family — the BENCH ``metrics`` key
+        and the programmatic twin of ``render()``."""
+        self.collect()
+        return {m.name: m.snapshot() for m in self._sorted_metrics()}
+
+
+def render_all(registries: Iterable[Registry]) -> str:
+    """Concatenate several registries' expositions (the worker's own
+    registry + the process-global one) into one scrape body."""
+    return "".join(r.render() for r in registries)
+
+
+#: process-global registry: compile-cache activity, lane step timing —
+#: state that is genuinely one-per-process. Worker-scoped counters live
+#: on the worker's own Registry instance instead (hermetic tests).
+REGISTRY = Registry()
+
+#: the Prometheus text exposition content type
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

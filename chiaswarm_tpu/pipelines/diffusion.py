@@ -37,6 +37,9 @@ from chiaswarm_tpu.core.compile_cache import (
     bucket_image_size,
     static_cache_key,
 )
+from chiaswarm_tpu.obs import trace as obs_trace
+from chiaswarm_tpu.obs.profiling import annotate
+from chiaswarm_tpu.obs.trace import span
 from chiaswarm_tpu.parallel.context import seq_parallel_wrap
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.vae import AutoencoderKL
@@ -172,6 +175,13 @@ class PendingImages:
     requested_batch: int
 
     def wait(self) -> np.ndarray:
+        # the "decode" span: under async dispatch the denoise + VAE
+        # decode + device->host transfer all settle HERE, so for a solo
+        # job this is where the chip time shows in the trace
+        with span("decode", batch=self.requested_batch):
+            return self._wait()
+
+    def _wait(self) -> np.ndarray:
         img_u8 = np.asarray(jax.device_get(self.device_images))
         height, width = self.compiled_hw
         req_h, req_w = self.requested_hw
@@ -594,200 +604,224 @@ class DiffusionPipeline:
         _slot_worker), where two blocking jobs interleave across threads.
         No reference analog — torch blocks per pipeline call."""
         fam = self.c.family
-        # small sizes are honored like the reference (only a max clamp,
-        # swarm/job_arguments.py:96-102): a 192px request generates AT
-        # 192px rather than at a 256 floor and downscaled
-        height, width = bucket_image_size(req.height, req.width)
-        batch = bucket_batch(req.batch)
-        steps = max(int(req.steps), 1)
-        sampler = resolve(req.scheduler,
-                          prediction_type=fam.prediction_type)
-        use_cfg = req.guidance_scale > 1.0
-        has_init = req.init_image is not None
-        has_mask = req.mask is not None
-        if has_mask and not has_init:
-            raise ValueError("inpainting requires an init image with the mask")
-        if fam.image_conditioned:
-            if not has_init:
-                raise ValueError(
-                    "this model edits an input image; start_image_uri is "
-                    "required")
+        # span shape for a solo job (chiaswarm_tpu/obs): "encode" =
+        # host-side prep (tokenize, init-image VAE encode, masks),
+        # "step" = executable lookup (a cold compile lands here,
+        # visibly) + program dispatch; the device compute itself settles
+        # in the consumer's "decode" span (PendingImages.wait) because
+        # dispatch is async
+        parent = obs_trace.current_span()
+        enc_span = (parent.child("encode", batch=req.batch)
+                    if parent is not None else None)
+        try:
+            # small sizes are honored like the reference (only a max clamp,
+            # swarm/job_arguments.py:96-102): a 192px request generates AT
+            # 192px rather than at a 256 floor and downscaled
+            height, width = bucket_image_size(req.height, req.width)
+            batch = bucket_batch(req.batch)
+            steps = max(int(req.steps), 1)
+            sampler = resolve(req.scheduler,
+                              prediction_type=fam.prediction_type)
+            use_cfg = req.guidance_scale > 1.0
+            has_init = req.init_image is not None
+            has_mask = req.mask is not None
+            if has_mask and not has_init:
+                raise ValueError("inpainting requires an init image with the mask")
+            if fam.image_conditioned:
+                if not has_init:
+                    raise ValueError(
+                        "this model edits an input image; start_image_uri is "
+                        "required")
+                if has_mask:
+                    raise ValueError(
+                        "instruct-pix2pix models do not take a mask")
+                if req.controlnet is not None:
+                    raise ValueError(
+                        "instruct-pix2pix models do not support controlnet")
+
+            start_step = 0
+            init_latent = jnp.zeros((1,), jnp.float32)  # placeholder
+            mask_arr = jnp.zeros((1,), jnp.float32)
+            if has_init:
+                strength = float(np.clip(req.strength, 0.05, 1.0))
+                if not has_mask and not fam.image_conditioned:
+                    # img2img: skip the first (1-strength) of the ladder
+                    # (pix2pix starts from pure noise instead)
+                    start_step = min(int(round(steps * (1.0 - strength))),
+                                     steps - 1)
+                init = np.asarray(req.init_image)
+                if init.ndim == 4 and init.shape[1:3] != (height, width) or \
+                   init.ndim == 3 and init.shape[:2] != (height, width):
+                    init = _resize_batch(init, height, width)
+                if req.init_groups is not None:
+                    # coalesced jobs: encode each job's image with ITS seed
+                    # through the batch-1 executable its solo run uses, then
+                    # repeat over that job's rows — bitwise solo equality
+                    z = jnp.concatenate([
+                        jnp.repeat(self.encode_init_image(
+                            init[j], height, width, enc_seed), n_rows, axis=0)
+                        for j, (enc_seed, n_rows)
+                        in enumerate(req.init_groups)], axis=0)
+                else:
+                    z = self.encode_init_image(init, height, width, req.seed)
+                if z.shape[0] == 1:
+                    init_latent = jnp.repeat(z, batch, axis=0)
+                elif z.shape[0] == batch:
+                    init_latent = z
+                else:  # pad per-frame inits up to the bucketed batch
+                    pad = jnp.repeat(z[-1:], batch - z.shape[0], axis=0)
+                    init_latent = jnp.concatenate([z, pad], axis=0)
             if has_mask:
+                lh, lw = self._latent_hw(height, width)
+
+                def latent_mask(m: np.ndarray) -> np.ndarray:
+                    if m.shape != (lh, lw):
+                        f = fam.vae.downscale
+                        if m.shape != (lh * f, lw * f):
+                            # bring arbitrary mask sizes onto the bucketed
+                            # pixel grid
+                            from PIL import Image
+
+                            m = np.asarray(Image.fromarray(
+                                (m * 255).clip(0, 255).astype(np.uint8)
+                            ).resize((lw * f, lh * f), Image.NEAREST),
+                                dtype=np.float32) / 255.0
+                        # downsample to the latent grid by box-averaging
+                        m = m.reshape(lh, f, lw, f).mean((1, 3))
+                    return (m > 0.5).astype(np.float32)
+
+                m = np.asarray(req.mask, dtype=np.float32)
+                if req.init_groups is not None:
+                    # per-JOB masks -> per-row stack, padded to the bucket
+                    rows_m = np.concatenate([
+                        np.repeat(latent_mask(m[j])[None], n_rows, axis=0)
+                        for j, (_, n_rows) in enumerate(req.init_groups)])
+                    if rows_m.shape[0] < batch:
+                        rows_m = np.concatenate(
+                            [rows_m, np.repeat(rows_m[-1:],
+                                               batch - rows_m.shape[0], 0)])
+                    mask_arr = jnp.asarray(rows_m)[:, :, :, None]
+                else:
+                    mask_arr = jnp.asarray(latent_mask(m))[None, :, :, None]
+
+            has_control = req.controlnet is not None
+            control_params = {"zero": jnp.zeros((1,), jnp.float32)}
+            control_cond = jnp.zeros((1,), jnp.float32)
+            if has_control:
+                if req.control_image is None:
+                    raise ValueError("controlnet requires a conditioning image")
+                cond = np.asarray(req.control_image)
+                if cond.shape[:2] != (height, width):
+                    cond = _resize_batch(cond, height, width)
+                # hint encoder expects [0, 1] (diffusers ControlNet training
+                # normalization), NOT the VAE's [-1, 1]
+                cond = np.asarray(cond, np.float32)
+                if req.control_image.dtype == np.uint8 or cond.max() > 1.0:
+                    cond = cond / 255.0
+                control_cond = jnp.asarray(np.clip(cond, 0.0, 1.0))[None]
+                control_params = req.controlnet.params
+
+            def rows(value: str | tuple[str, ...]) -> list[str]:
+                vals = (list(value) if isinstance(value, (tuple, list))
+                        else [value or ""] * req.batch)
+                if len(vals) != req.batch:
+                    raise ValueError(
+                        f"{len(vals)} per-row prompts for batch {req.batch}")
+                # pad to the compile bucket by repeating the last row
+                return vals + [vals[-1]] * (batch - len(vals))
+
+            ids = [jnp.asarray(i) for i in self._tokenize(rows(req.prompt))]
+            neg = [jnp.asarray(i) for i in
+                   self._tokenize(rows(req.negative_prompt))]
+
+            # data parallelism: when the params live on a dp x tp mesh, seed
+            # GSPMD's batch-dim propagation by placing the token inputs (and a
+            # batch-shaped init) on the 'data' axis — weight sharding alone
+            # leaves the batch replicated
+            mesh = _params_mesh(self.c.params)
+            if mesh is not None and batch % mesh.shape["data"] == 0:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                row = NamedSharding(mesh, P("data", None))
+                ids = [jax.device_put(i, row) for i in ids]
+                neg = [jax.device_put(i, row) for i in neg]
+                if getattr(init_latent, "ndim", 0) == 4 and \
+                        init_latent.shape[0] == batch:
+                    init_latent = jax.device_put(
+                        init_latent,
+                        NamedSharding(mesh, P("data", None, None, None)))
+
+            has_noise = req.init_noise is not None
+            noise_arr = jnp.zeros((1,), jnp.float32)  # placeholder
+            if has_noise:
+                lh, lw = self._latent_hw(height, width)
+                noise_np = np.asarray(req.init_noise, np.float32)
+                want = (lh, lw, fam.vae.latent_channels)
+                if noise_np.ndim == 3:
+                    noise_np = noise_np[None]
+                if noise_np.shape[1:] != want:
+                    raise ValueError(
+                        f"init_noise shape {noise_np.shape[1:]} != latent "
+                        f"grid {want}")
+                if noise_np.shape[0] > batch:
+                    raise ValueError(
+                        f"init_noise carries {noise_np.shape[0]} rows but the "
+                        f"request buckets to batch {batch}")
+                if noise_np.shape[0] == 1:
+                    noise_np = np.repeat(noise_np, batch, axis=0)
+                elif noise_np.shape[0] != batch:
+                    pad = np.repeat(noise_np[-1:], batch - noise_np.shape[0],
+                                    axis=0)
+                    noise_np = np.concatenate([noise_np, pad], axis=0)
+                noise_arr = jnp.asarray(noise_np)
+
+        except BaseException:
+            # a prep failure (bad init image/mask/noise) must
+            # not leave the encode span open until the trace's
+            # force-close — the exported duration would absorb
+            # the whole execute phase
+            if enc_span is not None:
+                enc_span.end()
+            raise
+        if enc_span is not None:
+            enc_span.end()
+        with span("step", steps=steps, batch=batch), \
+                annotate("swarm.generate"):
+            fn = self._get_fn(
+                batch=batch, height=height, width=width, steps=steps,
+                start_step=start_step, sampler=sampler, use_cfg=use_cfg,
+                has_init=has_init, has_mask=has_mask,
+                tiled=req.tiled_decode,
+                has_control=has_control, has_noise=has_noise,
+            )
+            # one independent key per batch row: fold the row index into
+            # the row's seed, so row b is reproducible at ANY batch size
+            # (and a coalesced job's rows match what its solo run would
+            # produce)
+            pairs = (list(req.sample_seed_rows) if req.sample_seed_rows
+                     else [(req.seed, i) for i in range(req.batch)])
+            if len(pairs) != req.batch:
                 raise ValueError(
-                    "instruct-pix2pix models do not take a mask")
-            if req.controlnet is not None:
-                raise ValueError(
-                    "instruct-pix2pix models do not support controlnet")
-
-        start_step = 0
-        init_latent = jnp.zeros((1,), jnp.float32)  # placeholder
-        mask_arr = jnp.zeros((1,), jnp.float32)
-        if has_init:
-            strength = float(np.clip(req.strength, 0.05, 1.0))
-            if not has_mask and not fam.image_conditioned:
-                # img2img: skip the first (1-strength) of the ladder
-                # (pix2pix starts from pure noise instead)
-                start_step = min(int(round(steps * (1.0 - strength))),
-                                 steps - 1)
-            init = np.asarray(req.init_image)
-            if init.ndim == 4 and init.shape[1:3] != (height, width) or \
-               init.ndim == 3 and init.shape[:2] != (height, width):
-                init = _resize_batch(init, height, width)
-            if req.init_groups is not None:
-                # coalesced jobs: encode each job's image with ITS seed
-                # through the batch-1 executable its solo run uses, then
-                # repeat over that job's rows — bitwise solo equality
-                z = jnp.concatenate([
-                    jnp.repeat(self.encode_init_image(
-                        init[j], height, width, enc_seed), n_rows, axis=0)
-                    for j, (enc_seed, n_rows)
-                    in enumerate(req.init_groups)], axis=0)
-            else:
-                z = self.encode_init_image(init, height, width, req.seed)
-            if z.shape[0] == 1:
-                init_latent = jnp.repeat(z, batch, axis=0)
-            elif z.shape[0] == batch:
-                init_latent = z
-            else:  # pad per-frame inits up to the bucketed batch
-                pad = jnp.repeat(z[-1:], batch - z.shape[0], axis=0)
-                init_latent = jnp.concatenate([z, pad], axis=0)
-        if has_mask:
-            lh, lw = self._latent_hw(height, width)
-
-            def latent_mask(m: np.ndarray) -> np.ndarray:
-                if m.shape != (lh, lw):
-                    f = fam.vae.downscale
-                    if m.shape != (lh * f, lw * f):
-                        # bring arbitrary mask sizes onto the bucketed
-                        # pixel grid
-                        from PIL import Image
-
-                        m = np.asarray(Image.fromarray(
-                            (m * 255).clip(0, 255).astype(np.uint8)
-                        ).resize((lw * f, lh * f), Image.NEAREST),
-                            dtype=np.float32) / 255.0
-                    # downsample to the latent grid by box-averaging
-                    m = m.reshape(lh, f, lw, f).mean((1, 3))
-                return (m > 0.5).astype(np.float32)
-
-            m = np.asarray(req.mask, dtype=np.float32)
-            if req.init_groups is not None:
-                # per-JOB masks -> per-row stack, padded to the bucket
-                rows_m = np.concatenate([
-                    np.repeat(latent_mask(m[j])[None], n_rows, axis=0)
-                    for j, (_, n_rows) in enumerate(req.init_groups)])
-                if rows_m.shape[0] < batch:
-                    rows_m = np.concatenate(
-                        [rows_m, np.repeat(rows_m[-1:],
-                                           batch - rows_m.shape[0], 0)])
-                mask_arr = jnp.asarray(rows_m)[:, :, :, None]
-            else:
-                mask_arr = jnp.asarray(latent_mask(m))[None, :, :, None]
-
-        has_control = req.controlnet is not None
-        control_params = {"zero": jnp.zeros((1,), jnp.float32)}
-        control_cond = jnp.zeros((1,), jnp.float32)
-        if has_control:
-            if req.control_image is None:
-                raise ValueError("controlnet requires a conditioning image")
-            cond = np.asarray(req.control_image)
-            if cond.shape[:2] != (height, width):
-                cond = _resize_batch(cond, height, width)
-            # hint encoder expects [0, 1] (diffusers ControlNet training
-            # normalization), NOT the VAE's [-1, 1]
-            cond = np.asarray(cond, np.float32)
-            if req.control_image.dtype == np.uint8 or cond.max() > 1.0:
-                cond = cond / 255.0
-            control_cond = jnp.asarray(np.clip(cond, 0.0, 1.0))[None]
-            control_params = req.controlnet.params
-
-        def rows(value: str | tuple[str, ...]) -> list[str]:
-            vals = (list(value) if isinstance(value, (tuple, list))
-                    else [value or ""] * req.batch)
-            if len(vals) != req.batch:
-                raise ValueError(
-                    f"{len(vals)} per-row prompts for batch {req.batch}")
-            # pad to the compile bucket by repeating the last row
-            return vals + [vals[-1]] * (batch - len(vals))
-
-        ids = [jnp.asarray(i) for i in self._tokenize(rows(req.prompt))]
-        neg = [jnp.asarray(i) for i in
-               self._tokenize(rows(req.negative_prompt))]
-
-        # data parallelism: when the params live on a dp x tp mesh, seed
-        # GSPMD's batch-dim propagation by placing the token inputs (and a
-        # batch-shaped init) on the 'data' axis — weight sharding alone
-        # leaves the batch replicated
-        mesh = _params_mesh(self.c.params)
-        if mesh is not None and batch % mesh.shape["data"] == 0:
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-
-            row = NamedSharding(mesh, P("data", None))
-            ids = [jax.device_put(i, row) for i in ids]
-            neg = [jax.device_put(i, row) for i in neg]
-            if getattr(init_latent, "ndim", 0) == 4 and \
-                    init_latent.shape[0] == batch:
-                init_latent = jax.device_put(
-                    init_latent,
-                    NamedSharding(mesh, P("data", None, None, None)))
-
-        has_noise = req.init_noise is not None
-        noise_arr = jnp.zeros((1,), jnp.float32)  # placeholder
-        if has_noise:
-            lh, lw = self._latent_hw(height, width)
-            noise_np = np.asarray(req.init_noise, np.float32)
-            want = (lh, lw, fam.vae.latent_channels)
-            if noise_np.ndim == 3:
-                noise_np = noise_np[None]
-            if noise_np.shape[1:] != want:
-                raise ValueError(
-                    f"init_noise shape {noise_np.shape[1:]} != latent "
-                    f"grid {want}")
-            if noise_np.shape[0] > batch:
-                raise ValueError(
-                    f"init_noise carries {noise_np.shape[0]} rows but the "
-                    f"request buckets to batch {batch}")
-            if noise_np.shape[0] == 1:
-                noise_np = np.repeat(noise_np, batch, axis=0)
-            elif noise_np.shape[0] != batch:
-                pad = np.repeat(noise_np[-1:], batch - noise_np.shape[0],
-                                axis=0)
-                noise_np = np.concatenate([noise_np, pad], axis=0)
-            noise_arr = jnp.asarray(noise_np)
-
-        fn = self._get_fn(
-            batch=batch, height=height, width=width, steps=steps,
-            start_step=start_step, sampler=sampler, use_cfg=use_cfg,
-            has_init=has_init, has_mask=has_mask, tiled=req.tiled_decode,
-            has_control=has_control, has_noise=has_noise,
-        )
-        # one independent key per batch row: fold the row index into the
-        # row's seed, so row b is reproducible at ANY batch size (and a
-        # coalesced job's rows match what its solo run would produce)
-        pairs = (list(req.sample_seed_rows) if req.sample_seed_rows
-                 else [(req.seed, i) for i in range(req.batch)])
-        if len(pairs) != req.batch:
-            raise ValueError(
-                f"{len(pairs)} sample_seed_rows for batch {req.batch}")
-        pairs += [pairs[-1]] * (batch - len(pairs))  # bucket padding
-        sample_keys = jnp.stack(
-            [jax.random.fold_in(key_for_seed(int(s)), int(r))
-             for s, r in pairs])
-        img = fn(
-            self.c.params,
-            ids,
-            neg,
-            sample_keys,
-            jnp.float32(req.guidance_scale),
-            init_latent,
-            mask_arr,
-            control_params,
-            control_cond,
-            jnp.float32(req.control_scale),
-            jnp.float32(req.image_guidance_scale),
-            noise_arr,
-        )
+                    f"{len(pairs)} sample_seed_rows for batch {req.batch}")
+            pairs += [pairs[-1]] * (batch - len(pairs))  # bucket padding
+            sample_keys = jnp.stack(
+                [jax.random.fold_in(key_for_seed(int(s)), int(r))
+                 for s, r in pairs])
+            img = fn(
+                self.c.params,
+                ids,
+                neg,
+                sample_keys,
+                jnp.float32(req.guidance_scale),
+                init_latent,
+                mask_arr,
+                control_params,
+                control_cond,
+                jnp.float32(req.control_scale),
+                jnp.float32(req.image_guidance_scale),
+                noise_arr,
+            )
         config = {
             "model_name": self.c.model_name,
             "family": fam.name,

@@ -49,7 +49,27 @@ from typing import Any
 
 import numpy as np
 
+from chiaswarm_tpu.obs.metrics import REGISTRY
+from chiaswarm_tpu.obs.profiling import annotate
+from chiaswarm_tpu.obs.trace import span
+
 log = logging.getLogger("chiaswarm.stepper")
+
+# per-step latency distribution under mixed admission — THE signal lane
+# width and deadline tuning read (ISSUE 4). Process-global registry: the
+# lane drivers are detached threads without a worker handle; /metrics
+# serves this registry alongside the worker's own. The timer wraps the
+# dispatch INCLUDING the depth-2 window throttle, so in steady state it
+# converges on the true device step latency, not the async-submit cost.
+_STEP_SECONDS = REGISTRY.histogram(
+    "chiaswarm_stepper_step_seconds",
+    "lane step wall time (dispatch + pipelined-window backpressure)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0))
+_LANE_ADMIT_SECONDS = REGISTRY.histogram(
+    "chiaswarm_stepper_admission_seconds",
+    "submit-side admission prep (tokenize + encode + row init)",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0))
 
 ENV_ENABLE = "CHIASWARM_STEPPER"
 ENV_LANE_WIDTH = "CHIASWARM_STEPPER_LANE_WIDTH"
@@ -363,13 +383,16 @@ class Lane:
         fn = self.pipe.stepper_step_fn(
             batch=self.width, height=self.height, width=self.width_px,
             steps_cap=self.steps_cap, sampler=self.sampler)
-        dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
-            self.pipe.c.params,
-            dev["ctx_u"], dev["ctx_c"], dev["pooled_u"], dev["pooled_c"],
-            dev["x"], dev["keys"], dev["idx"],
-            dev["start"], dev["sig"], dev["ts"], dev["guid"],
-            dev["old"], dev["active"],
-        )
+        t0 = time.perf_counter()
+        with annotate("swarm.lane.step"):
+            dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
+                self.pipe.c.params,
+                dev["ctx_u"], dev["ctx_c"], dev["pooled_u"],
+                dev["pooled_c"],
+                dev["x"], dev["keys"], dev["idx"],
+                dev["start"], dev["sig"], dev["ts"], dev["guid"],
+                dev["old"], dev["active"],
+            )
         active = int(self._h_active.sum())
         self._h_idx[self._h_active] += 1
         self.steps_executed += 1
@@ -382,6 +405,7 @@ class Lane:
         self._window.append(dev["x"])
         if len(self._window) > 2:
             self._window.popleft().block_until_ready()
+        _STEP_SECONDS.observe(time.perf_counter() - t0)
 
     def _retire_rows(self) -> None:
         """Retire finished rows (decode dispatched async — it overlaps the
@@ -413,7 +437,8 @@ class Lane:
                                         bucket - job.n_rows, axis=0)])
             decode = self.pipe.stepper_decode_fn(
                 batch=bucket, height=self.height, width=self.width_px)
-            images = decode(self.pipe.c.params, rows_x)
+            with annotate("swarm.lane.decode"):
+                images = decode(self.pipe.c.params, rows_x)
             pending = PendingImages(
                 device_images=images,
                 compiled_hw=(self.height, self.width_px),
@@ -592,20 +617,26 @@ class StepScheduler:
         sig = np.asarray(sched.sigmas, np.float32)
         ts = np.asarray(sched.timesteps, np.float32)
 
-        eb = bucket_batch(rows)
-        ids = [jnp.asarray(i) for i in pipe._tokenize([prompt or ""] * eb)]
-        neg = [jnp.asarray(i) for i in
-               pipe._tokenize([negative_prompt or ""] * eb)]
-        ctx_u, ctx_c, pooled_u, pooled_c = pipe.stepper_encode_fn(
-            batch=eb)(pipe.c.params, ids, neg)
-        # per-row noise keys: fold the row index into the job's seed —
-        # exactly the solo program's key derivation, so every row matches
-        # its solo run bit-for-bit in key space
-        keys = jnp.stack([jax.random.fold_in(key_for_seed(int(seed)), r)
-                          for r in range(rows)] +
-                         [key_for_seed(int(seed))] * (eb - rows))
-        carry, x0 = pipe.stepper_row_init_fn(
-            batch=eb, height=height, width=width)(keys, jnp.float32(sig[0]))
+        t_prep = time.perf_counter()
+        with span("encode", rows=rows, steps=steps), \
+                annotate("swarm.lane.encode"):
+            eb = bucket_batch(rows)
+            ids = [jnp.asarray(i)
+                   for i in pipe._tokenize([prompt or ""] * eb)]
+            neg = [jnp.asarray(i) for i in
+                   pipe._tokenize([negative_prompt or ""] * eb)]
+            ctx_u, ctx_c, pooled_u, pooled_c = pipe.stepper_encode_fn(
+                batch=eb)(pipe.c.params, ids, neg)
+            # per-row noise keys: fold the row index into the job's seed
+            # — exactly the solo program's key derivation, so every row
+            # matches its solo run bit-for-bit in key space
+            keys = jnp.stack([jax.random.fold_in(key_for_seed(int(seed)), r)
+                              for r in range(rows)] +
+                             [key_for_seed(int(seed))] * (eb - rows))
+            carry, x0 = pipe.stepper_row_init_fn(
+                batch=eb, height=height, width=width)(keys,
+                                                      jnp.float32(sig[0]))
+        _LANE_ADMIT_SECONDS.observe(time.perf_counter() - t_prep)
         job = _RowJob(
             job_id=job_id, n_rows=rows, steps=steps,
             guidance=float(guidance_scale), sigmas=sig, timesteps=ts,

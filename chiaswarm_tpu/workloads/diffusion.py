@@ -19,6 +19,7 @@ import numpy as np
 
 from chiaswarm_tpu.node.output_processor import OutputProcessor
 from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.obs.trace import span
 from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
 
 
@@ -288,7 +289,11 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
 def stepper_finish(ticket: StepperTicket):
     """Block on the lane rows, then postprocess exactly like the solo
     callback (un-bucket crop, safety, artifact encode)."""
-    pending, lane_info = ticket.future.result()
+    # the job's "step" span: admission wait + its rows' residency in the
+    # lane's denoise loop (the lane-side timeline rides in as metadata)
+    with span("step", steps=ticket.steps, rows=ticket.rows) as step_span:
+        pending, lane_info = ticket.future.result()
+        step_span.meta.update(lane_info)
     # the lane decodes at the compiled bucket; un-bucket to the request
     pending.requested_hw = ticket.req_hw
     images = pending.wait()

@@ -539,6 +539,62 @@ def test_r7_allows_pinned_carries_and_single_precision_bodies():
     assert fs == []
 
 
+# ---------------------------------------------------------------- R8
+
+def test_r8_flags_wallclock_subtraction_patterns():
+    fs = lint("""
+        import time
+
+        def elapsed(work):
+            t0 = time.time()
+            work()
+            return time.time() - t0
+        """, rule="R8")
+    assert [f.rule for f in fs] == ["wallclock-duration"]
+    assert fs[0].symbol == "elapsed"
+    assert "perf_counter" in fs[0].message
+
+
+def test_r8_flags_assigned_stamp_and_module_scope_and_datetime():
+    fs = lint("""
+        import time
+        from datetime import datetime
+
+        _T0 = time.time()
+        STARTUP_COST = time.time() - _T0
+
+        def until_deadline(deadline):
+            started = datetime.now()
+            return deadline - started
+        """, rule="R8")
+    assert len(fs) == 2
+    assert {f.symbol for f in fs} == {"<module>", "until_deadline"}
+
+
+def test_r8_allows_monotonic_clocks_and_unsubtracted_stamps():
+    fs = lint("""
+        import time
+
+        def timed(work):
+            t0 = time.perf_counter()
+            work()
+            return time.perf_counter() - t0
+
+        def paced(last):
+            return time.monotonic() - last
+
+        def stamped():
+            # labeling a moment is fine; only differencing is the hazard
+            return {"started_at_unix": time.time()}
+
+        def local_scopes(t0):
+            # a name assigned from time.time() in ANOTHER scope must not
+            # poison this one's perf_counter arithmetic
+            return time.perf_counter() - t0
+        """, rule="R8")
+    assert fs == []
+
+
 # ---------------------------------------------------------------- baseline
 
 BAD = """import jax

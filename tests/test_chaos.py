@@ -649,6 +649,166 @@ def test_transient_format_failure_is_not_fatal():
     asyncio.run(retries_then_succeeds())
 
 
+def test_breaker_state_persists_across_restarts():
+    """ISSUE 4 satellite (ROADMAP PR-2 candidate): a model quarantined
+    before a restart is still quarantined after it — the breaker board
+    serializes open breakers next to the dead-letter spool and a fresh
+    worker on the same settings root reloads them (and re-mirrors the
+    registry quarantine) without a single new failure."""
+
+    async def scenario():
+        executor = ChaoticExecutor()
+        registry = ModelRegistry(catalog=[], allow_random=True)
+        settings = chaos_settings()  # threshold 2, cooldown 3600
+        worker1 = _worker(settings, executor, registry=registry)
+        bad = "bad/checkpoint"
+        for i in range(2):
+            await worker1._execute_burst(
+                [_cjob(f"bp{i}", chaos=["crash"], model=bad)], StubSlot())
+        assert registry.is_quarantined(bad)
+        assert worker1._breaker_state_path().is_file()
+
+        # "restart": fresh worker AND fresh registry on the same root
+        registry2 = ModelRegistry(catalog=[], allow_random=True)
+        worker2 = _worker(settings, executor, registry=registry2)
+        assert registry2.is_quarantined(bad)  # restored at construction
+        assert worker2.health()["breakers"][bad]["state"] == "open"
+        [refused] = await worker2._execute_burst(
+            [_cjob("bp2", chaos=["ok"], model=bad)], StubSlot())
+        assert refused["pipeline_config"]["error_kind"] == "quarantined"
+        assert "bp2" not in executor.attempts  # no chip time burned
+
+        # a successful probe after the cooldown clears the state file
+        worker2.breakers = BreakerBoard(
+            threshold=2, cooldown_s=0.0,
+            on_open=registry2.quarantine, on_close=registry2.unquarantine,
+            on_probe=registry2.unquarantine,
+            persist_path=worker2._breaker_state_path())
+        [probe] = await worker2._execute_burst(
+            [_cjob("bp3", chaos=["ok"], model=bad)], StubSlot())
+        assert classify_result(probe) == "ok"
+        assert not worker2._breaker_state_path().is_file()
+
+    asyncio.run(scenario())
+
+
+def test_breaker_persistence_restores_remaining_cooldown(tmp_path):
+    """The monotonic clock dies with the process, so the file carries
+    the REMAINING cooldown: save() at shutdown refreshes it and the
+    restored breaker re-opens for exactly that residue."""
+    clock = [100.0]
+    path = tmp_path / "breakers.json"
+    board = BreakerBoard(threshold=1, cooldown_s=50.0,
+                         clock=lambda: clock[0], persist_path=path)
+    board.record("m", ok=False)  # opens at t=100; file says remaining 50
+    clock[0] = 120.0
+    board.save()                 # clean shutdown: remaining 30
+
+    clock2 = [1000.0]            # new process, new monotonic epoch
+    board2 = BreakerBoard(threshold=1, cooldown_s=50.0,
+                          clock=lambda: clock2[0], persist_path=path)
+    assert board2.states()["m"]["state"] == "open"
+    assert not board2.allow("m")
+    clock2[0] = 1029.0           # 29s later: still inside the residue
+    assert not board2.allow("m")
+    clock2[0] = 1031.0           # residue elapsed: half-open probe
+    assert board2.allow("m")
+
+    # a corrupt state file must not break startup
+    path.write_text("{not json", encoding="utf-8")
+    board3 = BreakerBoard(threshold=1, cooldown_s=50.0,
+                          clock=lambda: clock2[0], persist_path=path)
+    assert board3.states() == {}
+
+
+@pytest.mark.slow
+def test_chaos_soak_zero_loss_from_seed():
+    """Nightly soak (ISSUE 4 satellite): a LONG randomized fault script
+    expanded from a seed (CHIASWARM_SOAK_SEED, defaulting stable for
+    local runs; nightly CI passes the run id) drives a real worker
+    through poll faults, executor faults, and upload faults at once —
+    and the PR-2 invariant must hold at scale: every issued job settles
+    as exactly one uploaded envelope or one dead-letter file."""
+    import os
+    import random
+
+    from chiaswarm_tpu.node.chaos import ChaosSchedule
+
+    seed = os.environ.get("CHIASWARM_SOAK_SEED", "soak-default")
+    n_jobs = int(os.environ.get("CHIASWARM_SOAK_JOBS", "60"))
+    rng = random.Random(f"chaos-soak:{seed}")
+
+    # every script terminates in a deterministic envelope: ok, a
+    # recovered retry, a fatal, a crash envelope, or a deadline timeout
+    outcome_scripts = (
+        (["ok"], 6),
+        (["oom", "ok"], 2),
+        (["fetch", "ok"], 2),
+        (["fetch", "fetch", "ok"], 1),
+        (["crash"], 1),
+        (["fatal"], 1),
+        (["hang"], 1),
+        (["slow"], 1),
+    )
+    weighted = [script for script, w in outcome_scripts for _ in range(w)]
+    jobs = [_cjob(f"soak-{i}", chaos=list(rng.choice(weighted)))
+            for i in range(n_jobs)]
+
+    # upload-side faults for a seeded subset; a couple exhaust every
+    # retry and MUST land in the dead-letter spool
+    result_faults: dict[str, list[str]] = {}
+    flaky = rng.sample([j["id"] for j in jobs], k=max(2, n_jobs // 6))
+    dead_ids = set(flaky[:2])
+    for job_id in flaky:
+        if job_id in dead_ids:
+            result_faults[job_id] = ["http_500"] * 10
+        else:
+            result_faults[job_id] = [rng.choice(["http_500", "drop"]), "ok"]
+
+    poll_faults = ChaosSchedule.from_seed(
+        f"poll:{seed}",
+        ("ok", "ok", "ok", "drop", "delay", "http_500", "malformed"),
+        length=n_jobs)
+
+    async def scenario():
+        hive = ChaoticHive(poll_faults=poll_faults._script,
+                           result_faults=result_faults, delay_s=0.01)
+        uri = await hive.start()
+        for job in jobs:
+            hive.submit(job)
+        executor = ChaoticExecutor(hang_s=1.0, slow_s=0.05)
+        worker = Worker(settings=chaos_settings(uri), pool=[StubSlot()],
+                        registry=ModelRegistry(catalog=[],
+                                               allow_random=True),
+                        executor=executor)
+        task = asyncio.create_task(worker.run())
+        try:
+            deadline = asyncio.get_running_loop().time() + 300
+            while asyncio.get_running_loop().time() < deadline:
+                settled = len(hive.results) + worker.dead_letters.depth()
+                if settled >= len(hive.issued_ids) and \
+                        len(hive.results) >= len(hive.issued_ids) - \
+                        len(dead_ids):
+                    break
+                await asyncio.sleep(0.1)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=30)
+            await hive.stop()
+
+        uploaded = hive.uploaded_ids()
+        dead = {json.loads(p.read_text())["id"]
+                for p in worker.dead_letters.directory.glob("*.json")}
+        issued = set(hive.issued_ids)
+        # the zero-loss invariant, at soak scale: exactly-once settling
+        assert len(uploaded) == len(set(uploaded)), "duplicate uploads"
+        assert set(uploaded) | dead == issued
+        assert set(uploaded) & dead == set()
+        assert dead == dead_ids
+
+    asyncio.run(scenario())
+
+
 def test_mid_lane_fault_keeps_zero_loss(monkeypatch):
     """ISSUE 3: a crash/OOM injected into a RUNNING step-scheduler lane
     (serving/stepper.py) with spliced rows resident must not lose a job:

@@ -1,0 +1,508 @@
+"""swarmscope suite (ISSUE 4): metrics registry semantics, Prometheus
+exposition, span-tree construction across threads, trace-ring eviction,
+the worker's /metrics + /debug/traces endpoints, and the end-to-end
+acceptance gate: a tiny txt2img job through a REAL worker — stepper off
+and on — must yield a trace whose span tree nests
+poll/execute/encode/step/decode/upload with positive durations,
+exported as Perfetto-loadable JSON.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from chiaswarm_tpu.obs import metrics as obs_metrics
+from chiaswarm_tpu.obs import trace as obs_trace
+from chiaswarm_tpu.obs.metrics import Registry, render_all
+from chiaswarm_tpu.obs.trace import JobTrace, TraceRing, span
+
+
+@pytest.fixture(autouse=True)
+def _tmp_root(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    return tmp_path
+
+
+@pytest.fixture(autouse=True)
+def _restore_matmul_precision():
+    """Worker.startup() pins bf16 matmuls; restore the suite default."""
+    import jax
+
+    before = jax.config.jax_default_matmul_precision
+    yield
+    jax.config.update("jax_default_matmul_precision", before)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_semantics():
+    reg = Registry()
+    jobs = reg.counter("jobs_total", "jobs", labelnames=("outcome",))
+    jobs.inc(outcome="ok")
+    jobs.inc(2, outcome="ok")
+    jobs.inc(outcome="error")
+    assert jobs.value(outcome="ok") == 3
+    assert jobs.value(outcome="error") == 1
+    assert jobs.value(outcome="never") == 0
+    with pytest.raises(ValueError):
+        jobs.inc(-1, outcome="ok")  # counters only go up
+    with pytest.raises(ValueError):
+        jobs.inc(bogus="label")  # undeclared label set
+
+    depth = reg.gauge("queue_depth", "depth")
+    depth.set(7)
+    depth.dec(3)
+    assert depth.value() == 4
+
+    lat = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.7, 5.0, 50.0):
+        lat.observe(v)
+    assert lat.count() == 5
+    assert lat.sum() == pytest.approx(56.25)
+
+    # get-or-create: same object back; type/label mismatch raises
+    assert reg.counter("jobs_total", labelnames=("outcome",)) is jobs
+    with pytest.raises(ValueError):
+        reg.gauge("jobs_total")
+    with pytest.raises(ValueError):
+        reg.counter("jobs_total", labelnames=("other",))
+
+    # set_to mirrors an external monotonic total and never regresses
+    done = reg.counter("done_total")
+    done.set_to(10)
+    done.set_to(4)
+    assert done.value() == 10
+
+
+def test_registry_collectors_run_at_scrape_time_and_never_raise():
+    reg = Registry()
+    calls = []
+
+    def good():
+        calls.append("good")
+        reg.gauge("live").set(len(calls))
+
+    def broken():
+        raise RuntimeError("mirror cracked")
+
+    reg.add_collector(good)
+    reg.add_collector(broken)
+    reg.render()
+    snap = reg.snapshot()
+    assert calls == ["good", "good"]  # once per scrape, errors contained
+    assert snap["live"]["values"][""] == 2
+
+
+def test_prometheus_exposition_format():
+    reg = Registry()
+    c = reg.counter("swarm_jobs_total", 'jobs with "quotes"\nand newline',
+                    labelnames=("model",))
+    c.inc(3, model='tiny "v1"\n')
+    reg.gauge("swarm_depth", "queue depth").set(2.5)
+    h = reg.histogram("swarm_lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(9.0)
+    body = reg.render()
+    lines = body.splitlines()
+    assert "# TYPE swarm_jobs_total counter" in lines
+    # label values escape quotes and newlines per the text format
+    assert 'swarm_jobs_total{model="tiny \\"v1\\"\\n"} 3' in lines
+    assert "# HELP swarm_jobs_total jobs with \"quotes\"\\nand newline" \
+        in lines
+    assert "swarm_depth 2.5" in lines
+    # histogram: cumulative le buckets, +Inf == count, sum present
+    assert 'swarm_lat_seconds_bucket{le="0.1"} 1' in lines
+    assert 'swarm_lat_seconds_bucket{le="1"} 2' in lines
+    assert 'swarm_lat_seconds_bucket{le="+Inf"} 3' in lines
+    assert "swarm_lat_seconds_count 3" in lines
+    assert body.endswith("\n")
+
+    # an unlabeled counter renders an explicit 0 from registration; a
+    # labeled one renders its TYPE header even before any sample
+    reg2 = Registry()
+    reg2.counter("zero_total", "nothing yet")
+    reg2.counter("labeled_total", "nothing yet", labelnames=("tag",))
+    body2 = reg2.render()
+    assert "zero_total 0" in body2
+    assert "# TYPE labeled_total counter" in body2
+    # merged scrape bodies concatenate cleanly
+    merged = render_all([reg, reg2])
+    assert "swarm_depth 2.5" in merged and "zero_total 0" in merged
+
+
+# ---------------------------------------------------------------------------
+# span trees + ring
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_nesting_and_ordering_across_threads():
+    """The worker's cross-thread shape, faked: phases open on the event
+    -loop side, pipeline spans attach from an executor thread via
+    activate(), and the finished tree nests in submission order."""
+    trace = JobTrace("job", id="fake-1", model="tiny")
+    trace.phase("poll")
+
+    def executor_thread():
+        with obs_trace.activate(trace):
+            with span("format"):
+                pass
+            with span("encode", batch=1):
+                with span("tokenize"):
+                    pass
+            with span("step", steps=2):
+                pass
+            with span("decode"):
+                pass
+
+    trace.phase("execute")
+    worker = threading.Thread(target=executor_thread)
+    worker.start()
+    worker.join()
+    trace.phase("upload")
+    ring = TraceRing(capacity=4)
+    trace.finish(ring)
+    trace.finish(ring)  # idempotent: one ring entry
+    assert len(ring) == 1
+
+    root = trace.root
+    assert [c.name for c in root.children] == ["poll", "execute", "upload"]
+    execute = root.children[1]
+    assert [c.name for c in execute.children] == \
+        ["format", "encode", "step", "decode"]
+    assert [c.name for c in execute.find("encode").children] == ["tokenize"]
+    for name in ("poll", "execute", "encode", "step", "decode", "upload"):
+        node = root.find(name)
+        assert node is not None and not node.open
+        assert node.duration_s > 0
+    # phases close their predecessor: no overlap leaks
+    assert root.children[0].t1 <= root.children[1].t0 + 1e-9
+
+    # chrome export: complete events, positive integer durations
+    events = trace.to_chrome_events(tid=3)
+    names = [e["name"] for e in events]
+    assert names[0] == "job" and "tokenize" in names
+    for event in events:
+        assert event["ph"] == "X"
+        assert isinstance(event["ts"], int)
+        assert event["dur"] >= 1
+        assert event["tid"] == 3
+    # the whole document is JSON-serializable as exported
+    json.dumps(ring.to_chrome())
+
+
+def test_span_outside_any_trace_is_detached_and_harmless():
+    with span("orphan") as orphan:
+        pass
+    assert orphan.duration_s > 0
+    assert obs_trace.current_span() is None
+
+
+def test_trace_ring_eviction_keeps_newest():
+    ring = TraceRing(capacity=3)
+    for i in range(5):
+        trace = JobTrace("job", id=f"t{i}")
+        trace.finish(ring)
+    assert len(ring) == 3
+    kept = [t.meta["id"] for t in ring.traces()]
+    assert kept == ["t2", "t3", "t4"]
+    chrome = ring.to_chrome()
+    assert len(chrome["traceEvents"]) == 3
+    # tree export carries the metadata
+    tree = ring.to_dicts()
+    assert tree[0]["root"]["meta"]["id"] == "t2"
+    assert "started_at_unix" in tree[0]
+
+
+def test_trace_rides_job_dicts_via_attach_detach():
+    job = {"id": "x"}
+    trace = JobTrace("job", id="x")
+    obs_trace.attach(job, trace)
+    assert obs_trace.job_trace(job) is trace
+    assert obs_trace.detach(job) is trace
+    assert obs_trace.TRACE_KEY not in job
+    assert obs_trace.detach(job) is None
+    assert obs_trace.job_trace(None) is None
+
+
+# ---------------------------------------------------------------------------
+# profiler hooks (unit level; the capture endpoint is covered below)
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_capture_and_job_profile_with_stub_backend(
+        tmp_path, monkeypatch):
+    from chiaswarm_tpu.core import compat
+    from chiaswarm_tpu.obs import profiling
+
+    calls = []
+    monkeypatch.setitem(compat._cache, "profiler_start_trace",
+                        lambda target: calls.append(("start", target)))
+    monkeypatch.setitem(compat._cache, "profiler_stop_trace",
+                        lambda: calls.append(("stop",)))
+    out = profiling.capture(0.01, out=str(tmp_path / "prof"))
+    assert out["status"] == "ok"
+    assert calls[0][0] == "start" and calls[-1] == ("stop",)
+    assert out["dir"].startswith(str(tmp_path / "prof"))
+
+    class StubTrace:
+        def __init__(self, target):
+            calls.append(("job", target))
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setitem(compat._cache, "profiler_trace", StubTrace)
+    monkeypatch.setenv(profiling.PROFILE_DIR_ENV, str(tmp_path / "jobs"))
+    with profiling.job_profile("job-7") as active:
+        assert active is True
+    assert calls[-1] == ("job", str(tmp_path / "jobs" / "job-7"))
+
+    monkeypatch.delenv(profiling.PROFILE_DIR_ENV)
+    with profiling.job_profile("job-8") as active:
+        assert active is False  # opt-in: no dir, no trace
+    assert profiling.capture(0.01)["status"] == "error"  # no dir either
+
+
+# ---------------------------------------------------------------------------
+# worker endpoints (/metrics, /debug/traces, /debug/profile, /healthz)
+# ---------------------------------------------------------------------------
+
+
+def _endpoint_settings(uri: str):
+    from chiaswarm_tpu.node.settings import Settings
+
+    return Settings(
+        hive_uri=uri, hive_token="t", worker_name="obs-worker",
+        health_bind_ephemeral=True, install_signal_handlers=False,
+        job_deadline_s=600.0, poll_busy_s=0.02, poll_idle_s=0.05,
+        poll_backoff_base_s=0.02, poll_backoff_cap_s=0.1,
+        upload_retries=2, upload_retry_delay_s=0.01,
+        drain_timeout_s=5.0, result_drain_timeout_s=5.0)
+
+
+def test_worker_serves_metrics_and_traces_endpoints():
+    """The health app (loopback) grows /metrics (Prometheus text,
+    resilience + stepper + compile-cache families), /debug/traces
+    (Perfetto JSON from the worker's ring), and /debug/profile
+    (validated, explicit errors) — while /healthz keeps its JSON keys
+    as the read-through view."""
+    import aiohttp
+
+    from chiaswarm_tpu.node.chaos import ChaoticExecutor, ChaoticHive
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.worker import Worker
+
+    class StubSlot:
+        depth = 2
+        data_width = 1
+
+        def descriptor(self):
+            return "stub"
+
+    async def scenario():
+        hive = ChaoticHive()
+        uri = await hive.start()
+        hive.submit({"id": "m-ok", "model_name": "m/ok", "prompt": "p",
+                     "content_type": "application/json"})
+        hive.submit({"id": "m-err", "model_name": "m/err", "prompt": "p",
+                     "chaos": ["crash"],
+                     "content_type": "application/json"})
+        worker = Worker(settings=_endpoint_settings(uri),
+                        pool=[StubSlot()],
+                        registry=ModelRegistry(catalog=[],
+                                               allow_random=True),
+                        executor=ChaoticExecutor())
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(2, timeout=30)
+            for _ in range(100):
+                if getattr(worker, "health_address", None):
+                    break
+                await asyncio.sleep(0.05)
+            host, port = worker.health_address
+            base = f"http://{host}:{port}"
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{base}/healthz") as resp:
+                    health = await resp.json()
+                async with session.get(f"{base}/metrics") as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"].startswith(
+                        "text/plain")
+                    metrics_body = await resp.text()
+                async with session.get(f"{base}/debug/traces") as resp:
+                    chrome = await resp.json()
+                async with session.get(
+                        f"{base}/debug/traces?format=tree") as resp:
+                    tree = await resp.json()
+                async with session.get(
+                        f"{base}/debug/profile?seconds=abc") as resp:
+                    assert resp.status == 400
+                async with session.get(
+                        f"{base}/debug/profile?seconds=0.2") as resp:
+                    # no CHIASWARM_PROFILE_DIR and no ?dir= -> explicit
+                    # error, never a crash
+                    assert resp.status == 500
+                    assert (await resp.json())["status"] == "error"
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=20)
+            await hive.stop()
+        return health, metrics_body, chrome, tree, worker
+
+    health, body, chrome, tree, worker = asyncio.run(scenario())
+
+    # /healthz read-through view unchanged (PR-2/PR-3 keys intact)
+    for key in ("jobs_failed", "jobs_retried", "results_dead_lettered",
+                "breakers", "dead_letter_depth", "stepper"):
+        assert key in health
+    assert health["jobs_failed"] == 1
+
+    # /metrics: resilience counters migrated onto the registry...
+    assert "chiaswarm_jobs_failed_total 1" in body
+    assert 'chiaswarm_jobs_total{outcome="error"} 1' in body
+    assert 'chiaswarm_jobs_total{outcome="ok"} 1' in body
+    # ...stepper-lane families...
+    assert "chiaswarm_stepper_steps_executed_total" in body
+    assert "chiaswarm_stepper_enabled 0" in body
+    # ...compile-cache + hive families from the process registry...
+    assert "chiaswarm_compile_cache_misses_total" in body
+    assert "# TYPE chiaswarm_compiles_total counter" in body
+    assert 'chiaswarm_hive_requests_total{endpoint="results",result="ok"}' \
+        in body
+    # ...phase latency histograms fed by the finished traces
+    assert 'chiaswarm_job_phase_seconds_bucket{phase="upload",le="+Inf"}' \
+        in body
+
+    # /debug/traces: Perfetto-loadable chrome events with worker phases
+    names = {e["name"] for e in chrome["traceEvents"]}
+    assert {"job", "poll", "execute", "upload"} <= names
+    assert {t["root"]["name"] for t in tree["traces"]} == {"job"}
+    assert len(worker.traces) == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: end-to-end tiny txt2img, stepper off AND on
+# ---------------------------------------------------------------------------
+
+
+def _run_tiny_job_and_get_trace(stepper: bool, monkeypatch, seed: int):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.worker import Worker
+
+    if stepper:
+        monkeypatch.setenv("CHIASWARM_STEPPER", "1")
+    else:
+        monkeypatch.delenv("CHIASWARM_STEPPER", raising=False)
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+
+    async def scenario():
+        hive = FakeHive()
+        uri_settings = None
+        await hive.start()
+        hive.jobs.append({
+            "id": f"e2e-{'lane' if stepper else 'solo'}",
+            "model_name": "tiny", "prompt": "an observable astronaut",
+            "seed": seed, "num_inference_steps": 2, "guidance_scale": 7.5,
+            "height": 64, "width": 64, "content_type": "image/png"})
+        uri_settings = _endpoint_settings(hive.uri)
+        worker = Worker(settings=uri_settings, registry=registry,
+                        pool=pool)
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(1, timeout=300)
+            for _ in range(100):
+                if getattr(worker, "health_address", None):
+                    break
+                await asyncio.sleep(0.05)
+            host, port = worker.health_address
+            import aiohttp
+
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                        f"http://{host}:{port}/debug/traces") as resp:
+                    chrome = await resp.json()
+                async with session.get(
+                        f"http://{host}:{port}/metrics") as resp:
+                    metrics_body = await resp.text()
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=30)
+            await hive.stop()
+        return hive.results, worker, chrome, metrics_body
+
+    results, worker, chrome, metrics_body = asyncio.run(scenario())
+    assert len(results) == 1
+    assert results[0]["pipeline_config"].get("error") is None, results
+    traces = worker.traces.traces()
+    assert len(traces) == 1
+    return traces[0], chrome, metrics_body
+
+
+@pytest.mark.parametrize("stepper", [False, True],
+                         ids=["stepper-off", "stepper-on"])
+def test_e2e_tiny_txt2img_trace_spans(stepper, monkeypatch):
+    """ISSUE 4 acceptance: the finished job's trace contains
+    poll/execute/encode/step/decode/upload spans with positive, nested
+    durations, on BOTH execution paths, and /debug/traces serves them
+    as Perfetto-loadable JSON next to a /metrics scrape that shows the
+    compile-cache counters the run populated."""
+    trace, chrome, metrics_body = _run_tiny_job_and_get_trace(
+        stepper, monkeypatch, seed=41 if stepper else 40)
+
+    root = trace.root
+    phases = [c.name for c in root.children]
+    assert phases == ["poll", "execute", "upload"]
+    execute = root.children[1]
+    for name in ("encode", "step", "decode"):
+        node = execute.find(name)
+        assert node is not None, f"missing {name} span in {phases}"
+        assert node.duration_s > 0
+        # nested INSIDE the execute phase's interval
+        assert node.t0 >= execute.t0 - 1e-9
+        assert node.t1 <= execute.t1 + 1e-9
+    for child in root.children:
+        assert child.duration_s > 0
+    assert root.find("upload.http") is not None  # nests under upload
+    assert trace.meta["outcome"] == "ok"
+    assert trace.meta["settled"] == "uploaded"
+    if stepper:
+        # the lane run stamps its lane-side timeline into the step span
+        assert "lane" in execute.find("step").meta
+
+    # Perfetto export of the same tree via the live endpoint
+    names = {e["name"] for e in chrome["traceEvents"]}
+    assert {"job", "poll", "execute", "encode", "step", "decode",
+            "upload"} <= names
+    for event in chrome["traceEvents"]:
+        assert event["ph"] == "X" and event["dur"] >= 1
+
+    # the run compiled real executables; the registry saw them
+    assert 'chiaswarm_compile_cache_misses_total{cache="executables"' \
+        in metrics_body
+    if stepper:
+        assert "chiaswarm_stepper_steps_executed_total 2" in metrics_body
+        assert "chiaswarm_stepper_step_seconds_count" in metrics_body

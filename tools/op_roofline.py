@@ -309,6 +309,7 @@ def main() -> None:
     peak_gbps = float(os.environ.get("CHIASWARM_PEAK_GBPS", "819"))
 
     import chiaswarm_tpu.pipelines.diffusion as diffusion_mod
+    from chiaswarm_tpu.core import compat
     from chiaswarm_tpu.pipelines.components import Components
     from chiaswarm_tpu.pipelines.diffusion import (
         DiffusionPipeline,
@@ -363,7 +364,7 @@ def main() -> None:
         ipipe(cond, num_frames=frames, steps=steps, height=height,
               width=width, seed=0)  # compile + warm
         trace_dir = tempfile.mkdtemp(prefix="xplane_")
-        with jax.profiler.trace(trace_dir):
+        with compat.profiler_trace(trace_dir):
             ipipe(cond, num_frames=frames, steps=steps, height=height,
                   width=width, seed=0)
         _report(trace_dir, executables, args, peak_tflops, peak_gbps)
@@ -394,7 +395,7 @@ def main() -> None:
     pipe(req)  # compile + warm
 
     trace_dir = tempfile.mkdtemp(prefix="xplane_")
-    with jax.profiler.trace(trace_dir):
+    with compat.profiler_trace(trace_dir):
         pipe(req)
     _report(trace_dir, executables, args, peak_tflops, peak_gbps)
 

@@ -49,12 +49,12 @@ def run_one(name: str, options: str, iters: int = 4,
     env["CHIASWARM_BENCH_CONFIGS"] = "headline"
     env["CHIASWARM_BENCH_ITERS"] = str(iters)
     env.update(EXTRA_ENV.get(name, {}))
-    t0 = time.time()
+    t0 = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env=env, cwd=REPO, capture_output=True, text=True,
         timeout=timeout_s)
-    wall = time.time() - t0
+    wall = time.perf_counter() - t0
     line = next((ln for ln in proc.stdout.splitlines()
                  if ln.startswith("{")), None)
     if proc.returncode != 0 or line is None:

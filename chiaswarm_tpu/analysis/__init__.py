@@ -34,17 +34,48 @@ R5    jit-hygiene             serving-path jits use compile_cache.toplevel_jit
                               cache-resident param tree
 R6    recompile-hazard        raw request shapes reach compiled code only
                               through the shape-bucketing helpers
+R7    scan-carry-dtype        mixed-precision scan/loop bodies pin the carry
+                              dtype before returning it
+R8    wallclock-duration      durations come from perf_counter/monotonic,
+                              never time.time() subtraction
+R9    host-sync-reachability  R1 across module boundaries: host syncs
+                              reachable from jit through the whole-program
+                              call graph (full chain in the finding)
+R10   sharding-spec-drift     PartitionSpec/shard_map/collective axis names
+                              bound by a real mesh; in_specs arity matches
+                              the callee signature
 ====  ======================  ===============================================
+
+**The project index** (``analysis/project.py``, "swarmflow"): R1-R8 are
+single-file AST passes sharing a per-file :class:`ModuleContext`; R9/R10
+subclass :class:`ProjectRule` and run once per lint against a
+:class:`~.project.ProjectIndex` built over every linted file — module
+graph with relative imports resolved, top-level symbol resolution
+following ``from x import y`` re-export chains (the ``core/compat``
+shims), string-constant resolution (mesh axis names), and a conservative
+call graph keyed by ``(module, qualname)`` (edges only where the callee
+resolves statically: import aliases, dotted paths, ``self.``/``cls.``
+methods, ``functools.partial`` unwrapped). Per-file summaries are plain
+JSON dicts cached in ``.swarmflow-cache.json`` keyed on content hashes,
+so a warm lint re-summarizes only edited files. Interprocedural findings
+carry a ``chain:`` trace (entry point -> ... -> sink) in text, ``--json``
+and ``--sarif`` output; the baseline key deliberately excludes the chain
+so grandfathered entries survive unrelated reroutes of intermediate hops.
 
 Baseline workflow: first adoption of a rule grandfathers existing findings
 into ``.swarmlint-baseline.json`` (``--write-baseline``). New findings fail;
 fixing a baselined finding makes its entry stale, which fails under
 ``--strict`` until the entry is deleted — the baseline can only shrink.
+``--changed-only`` lints just the files changed vs the merge base with
+origin/main plus their reverse-dependency closure from the import graph
+(pre-commit); ``--sarif FILE`` exports new findings for GitHub code
+scanning with chains as codeFlows.
 """
 
 from chiaswarm_tpu.analysis.core import (
     Finding,
     ModuleContext,
+    ProjectRule,
     Rule,
     all_rules,
     analyze_paths,
@@ -57,6 +88,7 @@ from chiaswarm_tpu.analysis.baseline import (
     load_baseline,
     write_baseline,
 )
+from chiaswarm_tpu.analysis.project import ProjectIndex
 from chiaswarm_tpu.analysis.runner import run
 
 __all__ = [
@@ -64,6 +96,8 @@ __all__ = [
     "DEFAULT_BASELINE_NAME",
     "Finding",
     "ModuleContext",
+    "ProjectIndex",
+    "ProjectRule",
     "Rule",
     "all_rules",
     "analyze_paths",

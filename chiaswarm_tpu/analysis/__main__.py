@@ -18,7 +18,8 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m chiaswarm_tpu.analysis",
         description="swarmlint — enforce the repo's TPU compilation/RNG/"
-                    "compat invariants (stdlib-only AST pass)")
+                    "compat/sharding invariants (stdlib-only AST pass; "
+                    "R9/R10 run on the swarmflow whole-program index)")
     p.add_argument("paths", nargs="*", default=list(DEFAULT_LINT_PATHS),
                    help="files/directories to lint (default: the package, "
                         "tests, tools and repo-root entry scripts, "
@@ -36,15 +37,29 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--select", metavar="RULES", default=None,
                    help="comma-separated rule names or codes to run "
                         "(e.g. R2,compat-import)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="pre-commit fast path: lint only files changed vs "
+                        "the merge base with origin/main, plus every file "
+                        "that (transitively) imports one of them")
+    p.add_argument("--sarif", metavar="FILE", default=None,
+                   help="also write new findings as SARIF 2.1.0 (GitHub "
+                        "code scanning; '-' for stdout)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="ignore and do not write the swarmflow project "
+                        "cache (.swarmflow-cache.json)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as a JSON array instead of text")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
     args = p.parse_args(argv)
+    if args.sarif == "-" and args.as_json:
+        # both would interleave JSON documents on stdout — unparseable
+        p.error("--sarif - and --json both write to stdout; give --sarif "
+                "a file path (or drop --json)")
 
     if args.list_rules:
         for r in all_rules():
-            print(f"{r.code}  {r.name:24s} {r.description}")
+            print(f"{r.code:4s} {r.name:24s} {r.description}")
         return 0
 
     import dataclasses
@@ -63,7 +78,22 @@ def main(argv: list[str] | None = None) -> int:
               if args.select else None)
     result = run(paths, baseline_path=baseline, strict=args.strict,
                  select=select, write_baseline=args.write_baseline,
-                 root=root)
+                 root=root, changed_only=args.changed_only,
+                 cache=not args.no_cache)
+    if args.sarif and result.exit_code != 2:  # bad input: nothing to report
+        from chiaswarm_tpu.analysis.core import get_rule
+        from chiaswarm_tpu.analysis.sarif import to_sarif
+
+        rules = ([get_rule(s) for s in select] if select else all_rules())
+        doc = to_sarif(result.new, rules)
+        if args.sarif == "-":
+            print(json.dumps(doc, indent=2))
+        else:
+            sarif_path = (args.sarif if os.path.isabs(args.sarif)
+                          else os.path.join(root, args.sarif))
+            with open(sarif_path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2)
+                fh.write("\n")
     if args.as_json:
         print(json.dumps(
             [dataclasses.asdict(f) for f in result.new], indent=2))

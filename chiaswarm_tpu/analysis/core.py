@@ -23,6 +23,12 @@ class Finding:
     baseline matches on (rule, path, symbol, message) — NOT on line
     numbers — so grandfathered findings survive unrelated edits to the
     same file.
+
+    ``chain`` is interprocedural evidence (R9/R10): (path, line, qualname)
+    hops from the entry point to the sink, rendered in text and carried in
+    JSON/SARIF output. It is deliberately NOT part of the baseline key —
+    an unrelated edit that reroutes an intermediate hop must not resurface
+    a grandfathered finding.
     """
 
     rule: str
@@ -31,14 +37,20 @@ class Finding:
     col: int
     message: str
     symbol: str = "<module>"
+    chain: tuple[tuple[str, int, str], ...] = ()
 
     @property
     def baseline_key(self) -> str:
         return f"{self.rule}::{self.path}::{self.symbol}::{self.message}"
 
     def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: "
+        text = (f"{self.path}:{self.line}:{self.col}: "
                 f"[{self.rule}] {self.message} (in {self.symbol})")
+        if self.chain:
+            hops = " -> ".join(f"{qual} ({path}:{line})"
+                               for path, line, qual in self.chain)
+            text += f"\n    chain: {hops}"
+        return text
 
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -214,6 +226,28 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """A whole-program rule: sees the :class:`~.project.ProjectIndex`
+    (module graph, symbol resolution, call graph) instead of one module.
+
+    Per-module ``check`` is a no-op; the driver calls
+    :meth:`check_project` exactly once per run with the index built over
+    every linted file.
+    """
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, index) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def _rule_order(rule: Rule) -> tuple:
+    # numeric by code (R2 before R10); string codes sort after
+    tail = rule.code[1:]
+    return ((0, int(tail)) if tail.isdigit() else (1, 0), rule.code)
+
+
 _REGISTRY: dict[str, Rule] = {}
 
 
@@ -230,7 +264,7 @@ def _ensure_rules_loaded() -> None:
 
 def all_rules() -> list[Rule]:
     _ensure_rules_loaded()
-    return [_REGISTRY[k] for k in sorted(_REGISTRY, key=lambda n: _REGISTRY[n].code)]
+    return sorted(_REGISTRY.values(), key=_rule_order)
 
 
 def get_rule(name: str) -> Rule:
@@ -251,8 +285,20 @@ def analyze_source(source: str, relpath: str = "<string>.py",
     tree = ast.parse(source, filename=relpath)
     ctx = ModuleContext(relpath, source, tree)
     findings: list[Finding] = []
+    project_rules: list[ProjectRule] = []
     for rule in (rules if rules is not None else all_rules()):
-        findings.extend(rule.check(ctx))
+        if isinstance(rule, ProjectRule):
+            project_rules.append(rule)
+        else:
+            findings.extend(rule.check(ctx))
+    if project_rules:
+        # a one-module "project": lets rule-fixture tests feed project
+        # rules the same way they feed per-file rules
+        from chiaswarm_tpu.analysis.project import ProjectIndex
+
+        index = ProjectIndex.from_sources([(relpath, source, tree)])
+        for rule in project_rules:
+            findings.extend(rule.check_project(index))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -269,13 +315,16 @@ def iter_python_files(paths: Iterable[str],
         else:
             files = []
             for dirpath, dirnames, filenames in os.walk(p):
-                # prune caches, dot-dirs (.venv/.git/...) and vendor
-                # trees: foreign code is neither ours to lint nor safe
-                # to parse
+                # prune caches, dot-dirs (.venv/.git/...), vendor trees
+                # (foreign code is neither ours to lint nor safe to
+                # parse) and test-fixture trees — fixture packages under
+                # tests/fixtures/ are deliberately-violating inputs the
+                # analysis tests copy out and lint hermetically
                 dirnames[:] = [d for d in dirnames
                                if not d.startswith(".")
                                and d not in ("__pycache__", "node_modules",
-                                             "venv", "site-packages")]
+                                             "venv", "site-packages",
+                                             "fixtures")]
                 files.extend(os.path.join(dirpath, fn)
                              for fn in filenames if fn.endswith(".py"))
             files.sort()
@@ -291,7 +340,13 @@ def analyze_paths(paths: Iterable[str],
                   rules: Iterable[Rule] | None = None,
                   root: str | None = None,
                   on_error: Callable[[str, Exception], None] | None = None,
+                  only_files: set[str] | None = None,
                   ) -> list[Finding]:
+    """Run per-file rules over every .py under ``paths``.
+
+    ``only_files`` (absolute paths) restricts which files are actually
+    linted WITHOUT relaxing path validation — the ``--changed-only``
+    fast path uses it so a typo'd path still fails loudly."""
     rules = list(rules if rules is not None else all_rules())
     findings: list[Finding] = []
     rootdir = os.path.abspath(root or os.getcwd())
@@ -318,6 +373,8 @@ def analyze_paths(paths: Iterable[str],
             if abspath in seen:
                 continue
             seen.add(abspath)
+            if only_files is not None and abspath not in only_files:
+                continue
             try:
                 with open(abspath, "r", encoding="utf-8") as fh:
                     source = fh.read()

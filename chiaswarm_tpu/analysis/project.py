@@ -1,0 +1,779 @@
+"""swarmflow: the whole-program index under swarmlint's interprocedural
+rules (R9 host-sync reachability, R10 sharding-spec drift).
+
+Every rule through R8 is a single-file AST pass — a jitted function that
+calls a helper in another module which does ``.item()`` is invisible to
+R1, and nothing checks that ``PartitionSpec``/``shard_map`` axis names
+agree across ``parallel/``, ``pipelines/`` and ``serving/``. This module
+builds the missing layer, still pure stdlib:
+
+- **module graph** — every linted file becomes a module (dotted name
+  derived by climbing ``__init__.py`` packages), with absolute import
+  edges (relative imports resolved against the module's package);
+- **symbol resolution** — top-level functions, classes' methods, string
+  constants and ``from x import y`` re-exports resolve by qualified name
+  across modules, following re-export chains (the ``core/compat`` shims);
+- **conservative call graph** — per-function call targets keyed by
+  qualified name. Conservative means *precise*: an edge exists only when
+  the callee resolves statically (bare names through import aliases,
+  dotted module paths, ``self.``/``cls.`` methods, ``functools.partial``
+  unwrapping). Instance-method calls on arbitrary objects are NOT edges —
+  a lint must not invent paths it cannot defend;
+- **incremental cache** — per-file summaries (everything the
+  interprocedural rules consume) persist to ``.swarmflow-cache.json``
+  keyed on content hashes, so a warm whole-repo lint re-summarizes only
+  edited files and stays inside the seconds-fast budget, jax never
+  imported.
+
+The index deliberately stores *summaries*, not ASTs: a summary is a small
+JSON-able dict, which makes the cache format trivial and keeps peak
+memory flat across ~100 modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import hashlib
+import json
+import os
+from typing import Any, Iterable
+
+from chiaswarm_tpu.analysis.core import FunctionInfo, ModuleContext
+from chiaswarm_tpu.analysis.rules import (
+    JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes, resolves_to,
+)
+
+SCHEMA = 1
+DEFAULT_CACHE_NAME = ".swarmflow-cache.json"
+
+#: cross-chip collective primitives and the axis-name argument position
+#: they read when it is not passed as ``axis_name=``
+_COLLECTIVES: dict[str, int] = {
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1, "jax.lax.ppermute": 1, "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1, "jax.lax.psum_scatter": 1,
+    "jax.lax.axis_index": 0, "jax.lax.pshuffle": 1,
+    "axis_size": 0,  # core/compat shim (jax.lax.axis_size on modern jax)
+}
+
+_SPEC_NAMES = ("jax.sharding.PartitionSpec", "PartitionSpec")
+_MESH_NAMES = ("jax.sharding.Mesh", "Mesh")
+_MESHSPEC_NAMES = ("MeshSpec",)
+
+
+# ---------------------------------------------------------------------------
+# module naming
+
+
+def module_name_for_file(abspath: str) -> tuple[str, bool]:
+    """(dotted module name, is_package) for a file on disk, climbing the
+    ``__init__.py`` chain so the name matches what ``import`` would use
+    regardless of where the lint root sits."""
+    dirpath, fname = os.path.split(os.path.abspath(abspath))
+    stem = fname[:-3] if fname.endswith(".py") else fname
+    is_package = stem == "__init__"
+    parts = [] if is_package else [stem]
+    while os.path.isfile(os.path.join(dirpath, "__init__.py")):
+        dirpath, pkg = os.path.split(dirpath)
+        parts.insert(0, pkg)
+    if not parts:  # a bare __init__.py with no package parent
+        parts = [os.path.basename(dirpath) or stem]
+    return ".".join(parts), is_package
+
+
+def module_name_from_relpath(relpath: str) -> tuple[str, bool]:
+    """In-memory variant (fixture sources): every path part is assumed a
+    package, so ``pkg/mod.py`` -> ``pkg.mod``."""
+    parts = [p for p in relpath.replace(os.sep, "/").split("/")
+             if p not in (".", "..")]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    is_package = bool(parts) and parts[-1] == "__init__"
+    if is_package:
+        parts = parts[:-1]
+    return ".".join(parts) or relpath, is_package
+
+
+# ---------------------------------------------------------------------------
+# per-module summary extraction
+
+
+def _axisref(node: ast.AST, resolve) -> list[dict]:
+    """Axis-name references inside one spec/collective argument: string
+    literals become ``{"lit": s}``, resolvable names ``{"ref": dotted}``.
+    Conditional expressions contribute both VALUE branches (never the
+    test — its variables are not axis names); ``None`` (the replicated
+    dimension) contributes nothing."""
+    out: list[dict] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append({"lit": n.value})
+        elif isinstance(n, (ast.Name, ast.Attribute)):
+            dotted = resolve(n)
+            if dotted and not dotted.startswith(("self.", "cls.")):
+                out.append({"ref": dotted})
+        elif isinstance(n, ast.IfExp):
+            visit(n.body)
+            visit(n.orelse)
+        elif isinstance(n, (ast.Tuple, ast.List)):
+            for e in n.elts:
+                visit(e)
+        elif isinstance(n, ast.Starred):
+            visit(n.value)
+
+    visit(node)
+    seen: set[str] = set()
+    uniq = []
+    for a in out:
+        key = json.dumps(a, sort_keys=True)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(a)
+    return uniq
+
+
+class _Summarizer:
+    """One module -> one JSON-able summary dict."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module,
+                 module: str, is_package: bool):
+        self.ctx = ModuleContext(relpath, source, tree)
+        self.module = module
+        self.is_package = is_package
+        if is_package:
+            self.package = module
+        else:
+            self.package = module.rsplit(".", 1)[0] if "." in module else ""
+        self.aliases: dict[str, str] = {}      # whole-tree, absolute
+        self.exports: dict[str, str] = {}      # top-level imports only
+        self.deps: list[dict] = []
+        self._collect_imports(tree)
+
+    # -- imports ----------------------------------------------------------
+    def _abs_from(self, node: ast.ImportFrom) -> str:
+        mod = node.module or ""
+        if not node.level:
+            return mod
+        parts = self.package.split(".") if self.package else []
+        up = node.level - 1
+        if up:
+            parts = parts[:-up] if up < len(parts) else []
+        if mod:
+            parts = parts + mod.split(".")
+        return ".".join(parts)
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        top = set(tree.body)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".", 1)[0]
+                    target = a.name if a.asname else a.name.split(".", 1)[0]
+                    self.aliases[local] = target
+                    if node in top:
+                        self.exports[local] = target
+                    self.deps.append({"m": a.name, "n": None})
+            elif isinstance(node, ast.ImportFrom):
+                abs_mod = self._abs_from(node)
+                for a in node.names:
+                    if a.name == "*":
+                        self.deps.append({"m": abs_mod, "n": None})
+                        continue
+                    target = f"{abs_mod}.{a.name}" if abs_mod else a.name
+                    self.aliases[a.asname or a.name] = target
+                    if node in top:
+                        self.exports[a.asname or a.name] = target
+                    self.deps.append({"m": abs_mod, "n": a.name})
+
+    # -- expression resolution (absolute aliases) -------------------------
+    def resolve(self, node: ast.AST) -> str | None:
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+    def callable_target(self, node: ast.AST) -> tuple[str | None, int]:
+        """(dotted target, positional args consumed by partial wrapping)."""
+        consumed = 0
+        while isinstance(node, ast.Call):
+            fn = self.resolve(node.func)
+            if resolves_to(fn, "functools.partial", "partial") and node.args:
+                consumed += len(node.args) - 1
+                node = node.args[0]
+                continue
+            return fn, consumed
+        return self.resolve(node), consumed
+
+    # -- summary ----------------------------------------------------------
+    def summarize(self) -> dict:
+        ctx = self.ctx
+        functions: dict[str, dict] = {}
+        by_name: dict[str, list[str]] = {}
+        for info in ctx.functions:
+            functions[info.qualname] = self._func_summary(info)
+            name = functions[info.qualname]["name"]
+            by_name.setdefault(name, []).append(info.qualname)
+
+        summary = {
+            "module": self.module,
+            "relpath": ctx.relpath,
+            "package": self.is_package,
+            "exports": self.exports,
+            "deps": self.deps,
+            "constants": self._constants(ctx.tree),
+            "functions": functions,
+            "names": by_name,
+        }
+        summary.update(self._jit_entries(ctx, functions))
+        summary.update(self._sharding_facts(ctx))
+        return summary
+
+    def _func_summary(self, info: FunctionInfo) -> dict:
+        node = info.node
+        if isinstance(node, ast.Lambda):
+            a = node.args
+            name = info.qualname.rsplit(".", 1)[-1]
+        else:
+            a = node.args
+            name = node.name
+        npos = len(a.posonlyargs) + len(a.args)
+        first = ([arg.arg for arg in a.posonlyargs + a.args] or [""])[0]
+        calls, methods = self._calls(info)
+        from chiaswarm_tpu.analysis.rules.host_sync import sync_sites
+
+        sync = [{"line": n.lineno, "col": n.col_offset, "what": what}
+                for n, what in sync_sites(self.ctx, info)]
+        return {
+            "name": name,
+            "line": getattr(node, "lineno", 0),
+            "npos": npos,
+            "ndef": len(a.defaults),
+            "vararg": a.vararg is not None,
+            "pargs": [arg.arg for arg in a.posonlyargs + a.args],
+            "kwonly": [arg.arg for arg in a.kwonlyargs],
+            "kwreq": [arg.arg for arg, d in zip(a.kwonlyargs, a.kw_defaults)
+                      if d is None],
+            "meth": first in ("self", "cls"),
+            "calls": calls,
+            "methods": methods,
+            "sync": sync,
+        }
+
+    def _calls(self, info: FunctionInfo) -> tuple[list[dict], list[str]]:
+        calls: list[dict] = []
+        methods: list[str] = []
+        for node in own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("self", "cls")):
+                methods.append(func.attr)
+                continue
+            target, consumed = self.callable_target(node)
+            if target is None:
+                continue
+            kw: dict[str, Any] = {}
+            for k in node.keywords:
+                if k.arg is None:
+                    continue
+                refs = _axisref(k.value, self.resolve) \
+                    if not isinstance(k.value, (ast.Lambda, ast.Call)) else []
+                kw[k.arg] = refs[0] if len(refs) == 1 else None
+            poslits = {str(i): arg.value for i, arg in enumerate(node.args)
+                       if isinstance(arg, ast.Constant)
+                       and isinstance(arg.value, str)}
+            # NB: callable_target already unwraps functools.partial, so a
+            # `partial(f, ..., axis_name=X)` expression records as a call
+            # to `f` with X among its kwargs — exactly what the R10
+            # binding check wants, and a conservative call edge (the
+            # partial object exists to be invoked)
+            calls.append({
+                "t": target, "line": node.lineno, "np": len(node.args),
+                "kw": kw, "poslits": poslits,
+            })
+        return calls, sorted(set(methods))
+
+    def _constants(self, tree: ast.Module) -> dict:
+        consts: dict[str, Any] = {}
+        for node in tree.body:
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target.id, node.value
+            if target is None:
+                continue
+            if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                consts[target] = value.value
+            elif isinstance(value, (ast.Tuple, ast.List)):
+                refs = []
+                ok = True
+                for elt in value.elts:
+                    r = _axisref(elt, self.resolve)
+                    if len(r) == 1:
+                        refs.append(r[0])
+                    else:
+                        ok = False
+                        break
+                if ok and refs:
+                    consts[target] = refs
+        return consts
+
+    # -- jit entry points --------------------------------------------------
+    def _jit_entries(self, ctx: ModuleContext, functions: dict) -> dict:
+        wrappers = JIT_WRAPPERS + TRACED_WRAPPERS
+        roots: list[str] = []
+        refs: list[dict] = []
+        by_name: dict[str, list[str]] = {}
+        by_node: dict[ast.AST, str] = {}
+        for info in ctx.functions:
+            by_node[info.node] = info.qualname
+            if isinstance(info.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(info.node.name, []).append(info.qualname)
+                for dec in info.node.decorator_list:
+                    t, _ = self.callable_target(dec)
+                    if resolves_to(t, *wrappers):
+                        roots.append(info.qualname)
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            t, _ = self.callable_target(call)
+            if not resolves_to(t, *wrappers):
+                continue
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(arg, ast.Lambda) and arg in by_node:
+                    roots.append(by_node[arg])
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    dotted = self.resolve(arg)
+                    if dotted is None:
+                        continue
+                    if dotted.startswith(("self.", "cls.")):
+                        roots.extend(by_name.get(dotted.split(".")[1], []))
+                    elif "." in dotted:
+                        refs.append({"t": dotted, "line": call.lineno,
+                                     "symbol": ctx.symbol_for(call)})
+                    else:
+                        local = by_name.get(dotted, [])
+                        roots.extend(local)
+        return {"jit_roots": sorted(set(roots)), "jit_refs": refs}
+
+    # -- sharding facts ----------------------------------------------------
+    def _sharding_facts(self, ctx: ModuleContext) -> dict:
+        mesh_axes: list[dict] = []
+        specs: list[dict] = []
+        shard_maps: list[dict] = []
+        collectives: list[dict] = []
+
+        for name, value in self._constants(ctx.tree).items():
+            if isinstance(value, str) and name.endswith("_AXIS"):
+                mesh_axes.append({"lit": value})
+            elif isinstance(value, list) and name.endswith("AXES"):
+                mesh_axes.extend(value)
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t, consumed = self.callable_target(node)
+            if t is None:
+                continue
+            loc = {"line": node.lineno, "col": node.col_offset,
+                   "symbol": ctx.symbol_for(node)}
+            kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+
+            if resolves_to(t, *_MESH_NAMES):
+                ax = kwargs.get("axis_names")
+                if ax is None and len(node.args) >= 2:
+                    ax = node.args[1]
+                if ax is not None:
+                    mesh_axes.extend(_axisref(ax, self.resolve))
+            elif resolves_to(t, *_MESHSPEC_NAMES):
+                shape = kwargs.get("shape")
+                if shape is None and node.args:
+                    shape = node.args[0]
+                if isinstance(shape, ast.Dict):
+                    for key in shape.keys:
+                        if key is not None:
+                            mesh_axes.extend(_axisref(key, self.resolve))
+            elif resolves_to(t, *_SPEC_NAMES):
+                axes: list[dict] = []
+                for arg in node.args:
+                    axes.extend(_axisref(arg, self.resolve))
+                specs.append({**loc, "arity": len(node.args), "axes": axes})
+            elif resolves_to(t, "shard_map"):
+                callee = None
+                pconsumed = 0
+                if node.args:
+                    callee, pconsumed = self.callable_target(node.args[0])
+                rec: dict[str, Any] = {**loc, "callee": callee,
+                                       "pconsumed": pconsumed,
+                                       "in_arity": None}
+                if node.args and isinstance(node.args[0], ast.Lambda):
+                    la = node.args[0].args
+                    rec["lam"] = {
+                        "npos": len(la.posonlyargs) + len(la.args),
+                        "ndef": len(la.defaults),
+                        "vararg": la.vararg is not None,
+                    }
+                in_specs = kwargs.get("in_specs")
+                if isinstance(in_specs, (ast.Tuple, ast.List)):
+                    rec["in_arity"] = len(in_specs.elts)
+                shard_maps.append(rec)
+            else:
+                resolved_op = None
+                for op in _COLLECTIVES:
+                    if resolves_to(t, op):
+                        resolved_op = op
+                        break
+                if resolved_op is None:
+                    continue
+                ax = kwargs.get("axis_name")
+                if ax is None:
+                    pos = _COLLECTIVES[resolved_op] - consumed
+                    if 0 <= pos < len(node.args):
+                        ax = node.args[pos]
+                axis: dict | None = None
+                if ax is not None:
+                    # a Name may be a parameter of any ENCLOSING function
+                    # (ring_attention's scan body reads the closure's
+                    # axis_name): the binding check targets the owner
+                    owner = None
+                    if isinstance(ax, ast.Name):
+                        info = ctx.enclosing_function(node)
+                        while info is not None and owner is None:
+                            fnode = info.node
+                            a_ = fnode.args
+                            names = {arg.arg for arg in a_.posonlyargs
+                                     + a_.args + a_.kwonlyargs}
+                            if ax.id in names:
+                                owner = info.qualname
+                            info = info.parent
+                    if owner is not None:
+                        axis = {"param": ax.id, "owner": owner}
+                    else:
+                        refs = _axisref(ax, self.resolve)
+                        axis = refs[0] if len(refs) == 1 else None
+                collectives.append({
+                    **loc, "op": resolved_op, "axis": axis,
+                    "func": ctx.symbol_for(node),
+                })
+        return {"mesh_axes": mesh_axes, "specs": specs,
+                "shard_maps": shard_maps, "collectives": collectives}
+
+
+def summarize_module(relpath: str, source: str, tree: ast.Module,
+                     module: str, is_package: bool) -> dict:
+    return _Summarizer(relpath, source, tree, module, is_package).summarize()
+
+
+# ---------------------------------------------------------------------------
+# the index
+
+
+class ProjectIndex:
+    """Whole-program view over per-module summaries.
+
+    ``funcs`` keys are ``(module, qualname)`` pairs; chains reported by the
+    interprocedural rules are lists of ``(relpath, line, dotted-qualname)``
+    hops suitable for :attr:`Finding.chain`.
+    """
+
+    def __init__(self, summaries: dict[str, dict]):
+        self.summaries = summaries              # relpath -> summary
+        self.modules: dict[str, str] = {}       # module name -> relpath
+        for rel in sorted(summaries):
+            mod = summaries[rel]["module"]
+            self.modules.setdefault(mod, rel)
+        self.funcs: dict[tuple[str, str], dict] = {}
+        for rel, s in summaries.items():
+            for qual, f in s["functions"].items():
+                self.funcs[(s["module"], qual)] = f
+        self._edges: dict[tuple[str, str], set[tuple[str, str]]] | None = None
+        self._redges: dict[str, set[str]] | None = None
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_sources(cls, entries: Iterable[tuple[str, str, ast.Module]],
+                     ) -> "ProjectIndex":
+        summaries = {}
+        for relpath, source, tree in entries:
+            rel = relpath.replace(os.sep, "/")
+            module, is_pkg = module_name_from_relpath(rel)
+            summaries[rel] = summarize_module(rel, source, tree, module,
+                                              is_pkg)
+        return cls(summaries)
+
+    @classmethod
+    def build(cls, files: Iterable[tuple[str, str]],
+              cache_path: str | None = None) -> "ProjectIndex":
+        """Index (abspath, relpath) files, reusing cached summaries for
+        files whose content hash is unchanged. Unparseable files are
+        skipped here — the per-file driver already reports them."""
+        cache: dict[str, Any] = {}
+        if cache_path:
+            try:
+                with open(cache_path, "r", encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                if isinstance(doc, dict) and doc.get("schema") == SCHEMA:
+                    cache = doc.get("files", {})
+            except (OSError, ValueError):
+                cache = {}
+        summaries: dict[str, dict] = {}
+        fresh: dict[str, Any] = {}
+        dirty = False
+        for abspath, rel in files:
+            rel = rel.replace(os.sep, "/")
+            try:
+                with open(abspath, "rb") as fh:
+                    raw = fh.read()
+            except OSError:
+                continue
+            digest = hashlib.sha256(raw).hexdigest()
+            entry = cache.get(rel)
+            if entry and entry.get("hash") == digest:
+                summaries[rel] = entry["summary"]
+                fresh[rel] = entry
+                continue
+            try:
+                source = raw.decode("utf-8")
+                tree = ast.parse(source, filename=rel)
+            except (SyntaxError, UnicodeDecodeError, ValueError):
+                dirty = True
+                continue
+            module, is_pkg = module_name_for_file(abspath)
+            summary = summarize_module(rel, source, tree, module, is_pkg)
+            summaries[rel] = summary
+            fresh[rel] = {"hash": digest, "summary": summary}
+            dirty = True
+        if cache_path and dirty:
+            # MERGE into the existing cache — a path-subset run must not
+            # evict the rest of the repo's warm entries — and drop
+            # entries whose files vanished so the cache cannot grow
+            # without bound across renames/deletions
+            merged = dict(cache)
+            merged.update(fresh)
+            base = os.path.dirname(os.path.abspath(cache_path))
+            merged = {rel: e for rel, e in merged.items()
+                      if rel in fresh
+                      or os.path.exists(os.path.join(base, rel))}
+            try:
+                tmp = cache_path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump({"schema": SCHEMA, "files": merged}, fh)
+                os.replace(tmp, cache_path)
+            except OSError:
+                pass  # read-only checkout (CI): the cache is an optimization
+        return cls(summaries)
+
+    # -- symbol resolution -------------------------------------------------
+    def resolve_qual(self, dotted: str,
+                     _seen: frozenset = frozenset()) -> tuple[str, Any] | None:
+        """Resolve a dotted name to ("func", (module, qualname)),
+        ("const", value), ("tuple", [...]) or ("module", name), following
+        top-level re-exports across modules."""
+        if dotted in _seen:
+            return None
+        _seen = _seen | {dotted}
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                rest = parts[i:]
+                break
+        else:
+            return None
+        if not rest:
+            return ("module", mod)
+        s = self.summaries[self.modules[mod]]
+        qual = ".".join(rest)
+        if qual in s["functions"]:
+            return ("func", (mod, qual))
+        head = rest[0]
+        if head in s["constants"] and len(rest) == 1:
+            v = s["constants"][head]
+            return ("const", v) if isinstance(v, str) else ("tuple", v)
+        target = s["exports"].get(head)
+        if target is not None:
+            follow = ".".join([target] + rest[1:])
+            return self.resolve_qual(follow, _seen)
+        return None
+
+    def resolve_axis(self, ref: dict | None, module: str) -> str | None:
+        """An axis reference ({"lit"}/{"ref"}) to its string, following
+        constants; None when it cannot be proven."""
+        if not ref:
+            return None
+        if "lit" in ref:
+            return ref["lit"]
+        dotted = ref.get("ref")
+        if not dotted:
+            return None
+        if "." not in dotted:
+            rel = self.modules.get(module)
+            if rel is not None:
+                s = self.summaries[rel]
+                v = s["constants"].get(dotted)
+                if isinstance(v, str):
+                    return v
+                target = s["exports"].get(dotted)
+                if target:
+                    dotted = target
+                else:
+                    return None
+            else:
+                return None
+        got = self.resolve_qual(dotted)
+        if got and got[0] == "const":
+            return got[1]
+        return None
+
+    # -- call graph --------------------------------------------------------
+    def func_targets(self, module: str, target: str) -> list[tuple[str, str]]:
+        s = self.summaries.get(self.modules.get(module, ""), None)
+        out: list[tuple[str, str]] = []
+        if "." not in target:
+            if s is not None:
+                out = [(module, q) for q in s["names"].get(target, [])]
+            return out
+        got = self.resolve_qual(target)
+        if got and got[0] == "func":
+            return [got[1]]
+        return []
+
+    def edges(self) -> dict[tuple[str, str], set[tuple[str, str]]]:
+        if self._edges is not None:
+            return self._edges
+        edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+        for (module, qual), f in self.funcs.items():
+            out: set[tuple[str, str]] = set()
+            for call in f["calls"]:
+                if call["t"]:
+                    out.update(self.func_targets(module, call["t"]))
+            for name in f["methods"]:
+                out.update(self.func_targets(module, name))
+            out.discard((module, qual))
+            edges[(module, qual)] = out
+        self._edges = edges
+        return edges
+
+    def jit_entry_points(self) -> dict[tuple[str, str], list[dict]]:
+        """Functions entering trace, mapped to their REGISTRATION sites:
+        ``{"module", "relpath", "line", "symbol"}`` per decoration site /
+        jit()/scan() call site. R9 uses the registering modules to
+        delimit R1's jurisdiction (a body registered from another module
+        is invisible to the per-file pass even when its whole chain stays
+        in one file) and prepends a cross-module registration site to the
+        reported chain."""
+        roots: dict[tuple[str, str], list[dict]] = {}
+        for rel in sorted(self.summaries):
+            s = self.summaries[rel]
+            module = s["module"]
+            for qual in s["jit_roots"]:
+                if (module, qual) in self.funcs:
+                    f = self.funcs[(module, qual)]
+                    roots.setdefault((module, qual), []).append(
+                        {"module": module, "relpath": rel,
+                         "line": f["line"], "symbol": qual})
+            for ref in s["jit_refs"]:
+                got = self.resolve_qual(ref["t"])
+                if got and got[0] == "func":
+                    roots.setdefault(got[1], []).append(
+                        {"module": module, "relpath": rel,
+                         "line": ref["line"],
+                         "symbol": ref.get("symbol", "<module>")})
+        return roots
+
+    def reach_with_parents(self, roots: Iterable[tuple[str, str]],
+                           ) -> dict[tuple[str, str],
+                                     tuple[str, str] | None]:
+        """BFS over the call graph; maps every reachable function to its
+        first-discovered caller (None for roots) for chain rebuilding."""
+        edges = self.edges()
+        parent: dict[tuple[str, str], tuple[str, str] | None] = {}
+        frontier: collections.deque = collections.deque()
+        for r in sorted(set(roots)):
+            parent[r] = None
+            frontier.append(r)
+        while frontier:
+            node = frontier.popleft()
+            for nxt in sorted(edges.get(node, ())):
+                if nxt not in parent:
+                    parent[nxt] = node
+                    frontier.append(nxt)
+        return parent
+
+    def chain(self, parent: dict, node: tuple[str, str],
+              ) -> tuple[tuple[str, int, str], ...]:
+        """Root -> ... -> node as Finding.chain hops."""
+        hops: list[tuple[str, int, str]] = []
+        cur: tuple[str, str] | None = node
+        while cur is not None:
+            f = self.funcs[cur]
+            rel = self.modules[cur[0]]
+            hops.append((rel, f["line"], f"{cur[0]}.{cur[1]}"))
+            cur = parent.get(cur)
+        return tuple(reversed(hops))
+
+    def callers_of(self, target: tuple[str, str]) -> list[tuple[str, str]]:
+        return sorted(n for n, outs in self.edges().items()
+                      if target in outs)
+
+    # -- import graph ------------------------------------------------------
+    def module_deps(self, rel: str) -> set[str]:
+        """relpaths this file imports (project-internal only)."""
+        out: set[str] = set()
+        for dep in self.summaries[rel]["deps"]:
+            cands = []
+            if dep["m"]:
+                cands.append(dep["m"])
+            if dep["n"]:
+                # `from m import n` may name a submodule, not a symbol
+                cands.append(f"{dep['m']}.{dep['n']}" if dep["m"]
+                             else dep["n"])
+            for cand in cands:
+                hit = self.modules.get(cand)
+                if hit is not None:
+                    out.add(hit)
+        out.discard(rel)
+        return out
+
+    def reverse_closure(self, seeds: Iterable[str]) -> set[str]:
+        """``seeds`` (relpaths) plus every file that transitively imports
+        one of them — the set a pre-commit run must re-lint."""
+        rdeps: dict[str, set[str]] = {}
+        for rel in self.summaries:
+            for dep in self.module_deps(rel):
+                rdeps.setdefault(dep, set()).add(rel)
+        out = {s for s in seeds if s in self.summaries}
+        frontier = list(out)
+        while frontier:
+            rel = frontier.pop()
+            for dependent in rdeps.get(rel, ()):
+                if dependent not in out:
+                    out.add(dependent)
+                    frontier.append(dependent)
+        return out
+
+    # -- misc --------------------------------------------------------------
+    def axis_universe(self) -> dict[str, list[str]]:
+        """axis name -> relpaths of the modules whose mesh constructs bind
+        it. Empty when the project defines no meshes at all."""
+        out: dict[str, list[str]] = {}
+        for rel in sorted(self.summaries):
+            s = self.summaries[rel]
+            for ref in s["mesh_axes"]:
+                v = self.resolve_axis(ref, s["module"])
+                if v is not None and rel not in out.setdefault(v, []):
+                    out[v].append(rel)
+        return out

@@ -80,5 +80,7 @@ from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
     prng,
     recompile,
     scan_carry,
+    sharding_drift,
+    sync_reach,
     wallclock,
 )

@@ -52,7 +52,7 @@ class HostSyncInJit(Rule):
         reachable = _reachable(ctx, roots)
         seen: set[tuple[int, int]] = set()
         for info in reachable:
-            for node, what in _sync_sites(ctx, info):
+            for node, what in sync_sites(ctx, info):
                 loc = (node.lineno, node.col_offset)
                 if loc in seen:
                     continue
@@ -169,7 +169,10 @@ def _local_array_names(ctx: ModuleContext, info: FunctionInfo) -> set[str]:
     return names
 
 
-def _sync_sites(ctx: ModuleContext, info: FunctionInfo):
+def sync_sites(ctx: ModuleContext, info: FunctionInfo):
+    """Host-forcing operations in one function (shared with R9: the
+    project-level reachability pass taints the same sites, so the two
+    rules can never disagree on what counts as a sync)."""
     array_names = _local_array_names(ctx, info)
     for node in own_nodes(info.node):
         if not isinstance(node, ast.Call):

@@ -1,0 +1,224 @@
+"""R10 sharding-spec-drift: mesh axis names must agree across modules.
+
+The mesh vocabulary is defined once (``core/mesh.py``: ``data`` /
+``model`` / ``seq``) but consumed everywhere — ``PartitionSpec`` layouts
+in ``parallel/sharding.py``, ``shard_map`` in/out specs in
+``ops/attention.py``, collective ``axis_name=`` deep inside
+``parallel/ring_attention.py``, lane row sharding in
+``serving/stepper.py``. Nothing ties them together: a renamed axis, a
+misspelled spec, or an in_specs tuple that no longer matches the callee's
+signature compiles fine in whatever unit test never builds the real mesh,
+then fails (or silently reshards) on the pod. The open seq-parallel
+numerics divergence is exactly this class of bug.
+
+Checks, over the swarmflow project index:
+
+- **unknown axis**: an axis name in a ``PartitionSpec``, ``shard_map``
+  spec or collective that no mesh construct anywhere binds. The universe
+  is every ``*_AXIS``/``*AXES`` string constant, ``Mesh(..., axis_names)``
+  literal and ``MeshSpec({...})`` key in the project, with constants
+  resolved through imports. No meshes in the project -> the rule is
+  silent (nothing to drift from).
+- **in_specs arity**: ``shard_map(f, in_specs=(...))`` passes exactly
+  ``len(in_specs)`` positional arguments to ``f`` — flagged when ``f``
+  resolves to a project function (``functools.partial`` unwrapped, its
+  positional bindings counted) whose signature cannot accept that many.
+  The finding chains caller -> callee.
+- **unbound collective axis**: a collective reading its axis name from a
+  function parameter (including via closure, e.g. a scan body) where
+  callers exist but none binds that parameter — a guaranteed TypeError
+  once the code path runs — or where a caller binds it to an axis no
+  mesh defines (finding at the caller, chained caller -> callee).
+
+All value judgments are conservative: an axis expression that cannot be
+resolved to a string constant is silent, a callee that does not resolve
+to a project function is silent. This is a lint, not a prover.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import Finding, ProjectRule, register
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # the index arrives at check time; no runtime dep
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+
+@register
+class ShardingSpecDrift(ProjectRule):
+    code = "R10"
+    name = "sharding-spec-drift"
+    description = ("PartitionSpec/shard_map/collective axis names must be "
+                   "bound by a mesh; in_specs arity must match the callee "
+                   "(whole-program)")
+
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        universe = index.axis_universe()
+        if not universe:
+            return
+        known = ", ".join(sorted(universe))
+        for rel in sorted(index.summaries):
+            s = index.summaries[rel]
+            module = s["module"]
+            yield from self._unknown_axes(index, s, universe, known)
+            for rec in s["shard_maps"]:
+                yield from self._arity(index, module, rel, rec)
+        yield from self._collectives(index, universe, known)
+
+    # ---- unknown axis names in specs -----------------------------------
+    def _unknown_axes(self, index, s, universe, known) -> Iterator[Finding]:
+        for spec in s["specs"]:
+            for ref in spec["axes"]:
+                axis = index.resolve_axis(ref, s["module"])
+                if axis is not None and axis not in universe:
+                    yield Finding(
+                        rule=self.name, path=s["relpath"],
+                        line=spec["line"], col=spec["col"],
+                        message=(f"PartitionSpec axis {axis!r} is bound by "
+                                 f"no mesh in the project (known axes: "
+                                 f"{known})"),
+                        symbol=spec["symbol"],
+                    )
+
+    # ---- shard_map in_specs arity vs callee signature ------------------
+    def _arity(self, index, module, rel, rec) -> Iterator[Finding]:
+        if rec["in_arity"] is None:
+            return
+        caller_hop = (rel, rec["line"], f"{module}.{rec['symbol']}")
+        if rec.get("lam"):  # inline `shard_map(lambda q, k, v: ...)`
+            lam = rec["lam"]
+            if lam["vararg"]:
+                return
+            lo, hi = lam["npos"] - lam["ndef"], lam["npos"]
+            if not lo <= rec["in_arity"] <= hi:
+                want = str(hi) if lo == hi else f"{lo}..{hi}"
+                yield Finding(
+                    rule=self.name, path=rel,
+                    line=rec["line"], col=rec["col"],
+                    message=(f"shard_map supplies {rec['in_arity']} "
+                             f"positional arg(s) (in_specs arity) but its "
+                             f"lambda takes {want}"),
+                    symbol=rec["symbol"],
+                    chain=(caller_hop,),
+                )
+            return
+        if not rec["callee"]:
+            return
+        targets = index.func_targets(module, rec["callee"])
+        if len(targets) != 1:
+            return  # unresolvable or ambiguous: stay silent
+        callee = targets[0]
+        f = index.funcs[callee]
+        if f["vararg"] or f["meth"]:
+            return
+        supplied = rec["in_arity"] + rec["pconsumed"]
+        lo, hi = f["npos"] - f["ndef"], f["npos"]
+        if lo <= supplied <= hi:
+            return
+        callee_rel = index.modules[callee[0]]
+        callee_hop = (callee_rel, f["line"], f"{callee[0]}.{callee[1]}")
+        want = str(hi) if lo == hi else f"{lo}..{hi}"
+        yield Finding(
+            rule=self.name, path=rel, line=rec["line"], col=rec["col"],
+            message=(f"shard_map supplies {supplied} positional arg(s) "
+                     f"(in_specs arity {rec['in_arity']}"
+                     + (f" + {rec['pconsumed']} partial-bound"
+                        if rec["pconsumed"] else "")
+                     + f") but '{callee[0]}.{callee[1]}' takes {want}"),
+            symbol=rec["symbol"],
+            chain=(caller_hop, callee_hop),
+        )
+
+    # ---- collectives reading parameter-borne axis names ----------------
+    def _collectives(self, index, universe, known) -> Iterator[Finding]:
+        # caller records per callee, built once: (caller, call-record)
+        calls_to: dict[tuple, list[tuple[tuple, dict]]] = {}
+        for caller, f in index.funcs.items():
+            for call in f["calls"]:
+                if not call["t"]:
+                    continue
+                for target in index.func_targets(caller[0], call["t"]):
+                    calls_to.setdefault(target, []).append((caller, call))
+
+        # collective sites grouped by the (function, parameter) whose value
+        # they read — ring_attention's ppermute/ppermute/axis_size all read
+        # one axis_name, and a bad caller binding is ONE finding, not three
+        by_param: dict[tuple[str, str, str], list[tuple[str, dict]]] = {}
+        for rel in sorted(index.summaries):
+            s = index.summaries[rel]
+            module = s["module"]
+            for col in s["collectives"]:
+                axis = col["axis"]
+                if axis is None:
+                    continue
+                if "param" in axis:
+                    key = (module, axis["owner"], axis["param"])
+                    by_param.setdefault(key, []).append((rel, col))
+                    continue
+                v = index.resolve_axis(axis, module)
+                if v is not None and v not in universe:
+                    yield Finding(
+                        rule=self.name, path=rel,
+                        line=col["line"], col=col["col"],
+                        message=(f"collective {col['op']} uses axis "
+                                 f"name {v!r} which no mesh binds "
+                                 f"(known axes: {known})"),
+                        symbol=col["symbol"],
+                    )
+        for (module, owner_qual, param), sites in sorted(by_param.items()):
+            yield from self._param_axis(index, universe, known, module,
+                                        owner_qual, param, sites, calls_to)
+
+    def _param_axis(self, index, universe, known, module, owner_qual,
+                    param, sites, calls_to) -> Iterator[Finding]:
+        owner = (module, owner_qual)
+        f = index.funcs.get(owner)
+        if f is None:
+            return
+        callers = calls_to.get(owner, [])
+        if not callers:
+            return  # library entry point: nothing to check against
+        ops = "/".join(sorted({col["op"] for _, col in sites}))
+        owner_rel = index.modules[owner[0]]
+        owner_hop = (owner_rel, f["line"], f"{owner[0]}.{owner[1]}")
+        pidx = f["pargs"].index(param) if param in f["pargs"] else None
+        bound = False
+        for caller, call in callers:
+            value = None
+            if param in call["kw"]:
+                bound = True
+                value = index.resolve_axis(call["kw"][param], caller[0])
+            elif pidx is not None and call["np"] > pidx:
+                bound = True
+                value = call["poslits"].get(str(pidx))
+            if value is not None and value not in universe:
+                caller_rel = index.modules[caller[0]]
+                caller_f = index.funcs[caller]
+                yield Finding(
+                    rule=self.name, path=caller_rel,
+                    line=call["line"], col=0,
+                    message=(f"caller binds axis parameter {param!r} of "
+                             f"'{owner[0]}.{owner[1]}' to {value!r} which "
+                             f"no mesh binds (known axes: {known}); "
+                             f"collective(s) {ops} read it"),
+                    symbol=caller[1],
+                    chain=((caller_rel, caller_f["line"],
+                            f"{caller[0]}.{caller[1]}"), owner_hop),
+                )
+        has_default = not (
+            param in f["kwreq"]
+            or (pidx is not None and pidx < f["npos"] - f["ndef"]))
+        if not bound and not has_default:
+            for rel, col in sites:
+                yield Finding(
+                    rule=self.name, path=rel,
+                    line=col["line"], col=col["col"],
+                    message=(f"collective {col['op']} reads axis name "
+                             f"from parameter {param!r} of "
+                             f"'{owner[0]}.{owner[1]}' which no caller "
+                             f"binds"),
+                    symbol=col["symbol"],
+                    chain=(owner_hop,),
+                )

@@ -1,0 +1,92 @@
+"""R9 host-sync-reachability: R1 across module boundaries.
+
+R1's reachability stops at the file edge — a jitted function that calls a
+helper in another module which does ``.item()`` is invisible to it (the
+rule's docstring even says so). R9 closes the gap over the swarmflow
+project index: taint every host-forcing operation (the exact
+``sync_sites`` vocabulary R1 uses, so the two rules can never disagree),
+then walk the whole-program call graph from every function that enters
+trace (``toplevel_jit``/``jax.jit`` decorations and registrations, scan/
+vmap bodies — the lane executables included) and report any tainted
+function it reaches.
+
+Findings carry the full call chain (entry point -> ... -> sink) as
+:attr:`Finding.chain` evidence, rendered in text and JSON, so a
+cross-module report is actionable without re-deriving the path by hand.
+
+Division of labor with R1: chains that stay inside one module are R1's
+jurisdiction (it additionally understands callback escapes and local
+array dataflow at the root site) — R9 only reports chains that cross at
+least one module boundary, so the two rules never double-report a site.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from chiaswarm_tpu.analysis.core import Finding, ProjectRule, register
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # the index arrives at check time; no runtime dep
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+
+@register
+class HostSyncReachability(ProjectRule):
+    code = "R9"
+    name = "host-sync-reachability"
+    description = ("no host sync reachable from jitted/traced code through "
+                   "cross-module call chains (whole-program call graph)")
+
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        roots = index.jit_entry_points()
+        if not roots:
+            return
+        parent = index.reach_with_parents(roots)
+        seen: set[tuple[str, int, int]] = set()
+        for node in sorted(parent):
+            func = index.funcs[node]
+            if not func["sync"]:
+                continue
+            # walk back to the root to find the modules on the path
+            path_nodes = [node]
+            while parent.get(path_nodes[-1]) is not None:
+                path_nodes.append(parent[path_nodes[-1]])
+            chain_modules = {m for m, _ in path_nodes}
+            root_node = path_nodes[-1]
+            regs = roots.get(root_node, [])
+            reg_modules = {r["module"] for r in regs}
+            if len(chain_modules) == 1 and \
+                    next(iter(chain_modules)) in reg_modules:
+                # chain AND registration in one file: R1's jurisdiction
+                continue
+            chain = index.chain(parent, node)
+            root = chain[0][2]
+            if chain_modules == {root_node[0]} and regs:
+                # single-module chain rooted at a body REGISTERED from
+                # another module: the registration site IS the missing
+                # cross-module hop — prepend it so the evidence (and the
+                # --changed-only chain filter) sees the registering file
+                reg = next((r for r in regs
+                            if r["module"] != root_node[0]), regs[0])
+                chain = ((reg["relpath"], reg["line"],
+                          f"{reg['module']}.{reg['symbol']}"),) + chain
+            rel = index.modules[node[0]]
+            for site in func["sync"]:
+                key = (rel, site["line"], site["col"])
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=site["line"],
+                    col=site["col"],
+                    message=(f"host sync {site['what']} is reachable from "
+                             f"jit-traced '{root}' through a cross-module "
+                             f"call chain; hoist it out of the compiled "
+                             f"region (or use jax.pure_callback if the "
+                             f"host round-trip is intentional)"),
+                    symbol=node[1],
+                    chain=chain,
+                )

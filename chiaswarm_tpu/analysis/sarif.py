@@ -1,0 +1,90 @@
+"""SARIF 2.1.0 output: swarmlint findings as GitHub code-scanning input.
+
+One run, one tool ("swarmlint"), results from the NEW (non-baselined)
+findings only — grandfathered entries are suppressions, not PR
+annotations. Interprocedural findings (R9/R10) export their ``chain`` as
+a SARIF codeFlow so the caller -> ... -> sink path renders inline in the
+code-scanning UI. ``partialFingerprints`` carries the baseline key, which
+is line-number-free by construction — GitHub's alert dedup then survives
+unrelated edits exactly like the baseline does.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from chiaswarm_tpu.analysis.core import Finding, Rule
+
+SARIF_VERSION = "2.1.0"
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+           "Schemata/sarif-schema-2.1.0.json")
+
+
+def _location(path: str, line: int, col: int, message: str | None = None):
+    loc = {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path,
+                                 "uriBaseId": "%SRCROOT%"},
+            "region": {"startLine": max(1, line),
+                       "startColumn": max(1, col + 1)},
+        },
+    }
+    if message:
+        loc["message"] = {"text": message}
+    return loc
+
+
+def _code_flow(finding: Finding) -> dict:
+    return {
+        "threadFlows": [{
+            "locations": [
+                {"location": _location(path, line, 0, qual)}
+                for path, line, qual in finding.chain
+            ],
+        }],
+    }
+
+
+def to_sarif(findings: Iterable[Finding], rules: Iterable[Rule]) -> dict:
+    """The SARIF document (a JSON-able dict) for one lint run."""
+    rule_list = sorted({r.name: r for r in rules}.values(),
+                       key=lambda r: r.code)
+    rule_index = {r.name: i for i, r in enumerate(rule_list)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": rule_index.get(f.rule, -1),
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [_location(f.path, f.line, f.col)],
+            "partialFingerprints": {
+                "swarmlintBaselineKey/v1": f.baseline_key,
+            },
+        }
+        if f.chain:
+            result["codeFlows"] = [_code_flow(f)]
+        results.append(result)
+    return {
+        "$schema": _SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "swarmlint",
+                    "informationUri":
+                        "https://github.com/Jsewill/chiaSWARM",
+                    "rules": [
+                        {
+                            "id": r.name,
+                            "name": r.code,
+                            "shortDescription": {"text": r.description},
+                            "defaultConfiguration": {"level": "error"},
+                        }
+                        for r in rule_list
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }

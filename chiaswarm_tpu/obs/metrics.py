@@ -334,5 +334,32 @@ def render_all(registries: Iterable[Registry]) -> str:
 #: on the worker's own Registry instance instead (hermetic tests).
 REGISTRY = Registry()
 
+#: occupancy-ratio buckets: one per eighth of the lane, matching the
+#: pow2 lane widths (a 16-wide lane quantizes occupancy to sixteenths;
+#: eighths keep the histogram readable at every width)
+OCCUPANCY_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+def lane_occupancy_histogram(registry: Registry | None = None) -> Histogram:
+    """Per-lane occupancy ratio (active rows / lane width), sampled at
+    every lane step by serving/stepper.py and exposed at ``/metrics``.
+
+    THE padding-efficiency signal for lane-width tuning: a lane stepping
+    at 0.25 occupancy spends 3/4 of its batched UNet FLOPs on padding
+    rows, which the scalar ``padding_waste`` ratio in ``/healthz`` only
+    shows as a long-run average — the histogram shows whether waste is a
+    steady trickle (width too large for the arrival rate) or admission
+    bursts draining out (width fine, arrivals lumpy).
+
+    Labeled by lane WIDTH, not lane id: widths come from the bounded
+    pow2 bucket lattice, while lane ids increment for every rebuilt lane
+    — id labels on the process-global registry would leak one series
+    family per retired lane forever (Prometheus cardinality 101)."""
+    return (registry or REGISTRY).histogram(
+        "chiaswarm_stepper_lane_occupancy_ratio",
+        "active rows / lane width at each lane step, by lane width",
+        labelnames=("width",),
+        buckets=OCCUPANCY_BUCKETS)
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -49,7 +49,7 @@ from typing import Any
 
 import numpy as np
 
-from chiaswarm_tpu.obs.metrics import REGISTRY
+from chiaswarm_tpu.obs.metrics import REGISTRY, lane_occupancy_histogram
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
 
@@ -70,6 +70,10 @@ _LANE_ADMIT_SECONDS = REGISTRY.histogram(
     "chiaswarm_stepper_admission_seconds",
     "submit-side admission prep (tokenize + encode + row init)",
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0))
+# per-lane occupancy ratio at each step (obs/metrics.py ISSUE-5 tie-in):
+# distribution over time, where /healthz's lane_occupancy is only the
+# lifetime average
+_LANE_OCCUPANCY = lane_occupancy_histogram()
 
 ENV_ENABLE = "CHIASWARM_STEPPER"
 ENV_LANE_WIDTH = "CHIASWARM_STEPPER_LANE_WIDTH"
@@ -398,6 +402,7 @@ class Lane:
         self.steps_executed += 1
         self._sched._count(steps_executed=1, row_steps_active=active,
                            row_steps_padded=self.width - active)
+        _LANE_OCCUPANCY.observe(active / self.width, width=str(self.width))
         # throttle: keep at most two dispatched steps in flight (the
         # depth-2 philosophy of core/chip_pool.py) so the async queue
         # cannot run away from the device — and execution errors surface

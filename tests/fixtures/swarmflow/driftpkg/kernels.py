@@ -1,0 +1,12 @@
+"""Collective kernels consumed (and mis-consumed) across the package."""
+
+import jax
+
+
+def ring(q, k, v, *, axis_name):
+    return jax.lax.ppermute(q, axis_name, [(0, 1)])
+
+
+def orphan_axis(x, *, axis_name):
+    # axis_name reaches a collective but specs.py's caller never binds it
+    return jax.lax.psum(x, axis_name)

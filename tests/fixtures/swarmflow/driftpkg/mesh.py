@@ -1,0 +1,5 @@
+"""The mesh vocabulary: exactly two axis names exist."""
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+DEFAULT_AXES = (DATA_AXIS, MODEL_AXIS)

@@ -1,0 +1,28 @@
+"""Spec consumers: one clean, one drifted axis, one arity mismatch, one
+unbound collective parameter — each invisible to any per-file pass."""
+
+from jax.sharding import PartitionSpec as P
+
+from driftpkg.kernels import orphan_axis, ring
+from driftpkg.mesh import DATA_AXIS
+
+
+def clean_spec():
+    return P(DATA_AXIS, None)
+
+
+def drifted_spec():
+    return P("batch", None)  # no mesh anywhere binds "batch"
+
+
+def wrong_arity(mesh, q, k):
+    from chiaswarm_tpu.core.compat import shard_map
+
+    spec = P(DATA_AXIS)
+    # ring() takes THREE positional args; in_specs supplies two
+    fn = shard_map(ring, mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(q, k)
+
+
+def forgets_the_axis(x):
+    return orphan_axis(x)  # TypeError at run time: axis_name unbound

@@ -719,7 +719,7 @@ def test_overlapping_paths_and_bad_select_are_handled(tmp_path):
     assert r.exit_code == 0, r.report
     # a typo'd rule selection is bad input (exit 2), not lint findings
     r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
-            root=str(tmp_path), select=["R9"])
+            root=str(tmp_path), select=["R99"])
     assert r.exit_code == 2 and "unknown rule" in r.report
 
 
@@ -787,3 +787,566 @@ def test_partial_runs_do_not_corrupt_baseline(tmp_path):
     doc = json.loads(bl.read_text())
     assert sorted(e["path"] for e in doc["findings"]) == [
         "other/mod.py", "pkg/dev.py"]
+
+
+# ------------------------------------------------- swarmflow (R9/R10)
+
+import os
+import shutil
+import subprocess
+import sys
+
+from chiaswarm_tpu.analysis import ProjectIndex, get_rule as _get_rule
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "swarmflow")
+
+
+def _copy_fixture(tmp_path, name):
+    dst = tmp_path / name
+    shutil.copytree(os.path.join(FIXTURES, name), dst)
+    return dst
+
+
+def _index_of(*entries):
+    """ProjectIndex over (relpath, source) pairs of dedented fixtures."""
+    import ast as _ast
+
+    return ProjectIndex.from_sources(
+        [(rel, textwrap.dedent(src), _ast.parse(textwrap.dedent(src)))
+         for rel, src in entries])
+
+
+def test_r9_flags_cross_module_chain_that_r1_provably_misses(tmp_path):
+    pkg = _copy_fixture(tmp_path, "syncpkg")
+    r1 = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+             root=str(tmp_path), select=["R1"])
+    assert r1.exit_code == 0 and r1.new == []  # per-file pass is blind
+    r9 = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+             root=str(tmp_path), select=["R9"])
+    assert r9.exit_code == 1 and len(r9.new) == 1
+    f = r9.new[0]
+    assert f.rule == "host-sync-reachability"
+    assert f.path == "syncpkg/helpers.py" and f.symbol == "postprocess_mean"
+    assert "'.item()'" in f.message and "syncpkg.program.step" in f.message
+    # the full chain rides the finding: entry -> sink with paths + lines
+    assert [hop[2] for hop in f.chain] == [
+        "syncpkg.program.step", "syncpkg.helpers.postprocess_mean"]
+    assert f.chain[0][0] == "syncpkg/program.py" and f.chain[0][1] > 0
+    assert "chain:" in f.render()
+
+
+def test_r9_cli_acceptance_chain_in_text_and_json(tmp_path):
+    """The ISSUE acceptance command: --select R9 on the seeded fixture."""
+    pkg = _copy_fixture(tmp_path, "syncpkg")
+    proc = subprocess.run(
+        [sys.executable, "-m", "chiaswarm_tpu.analysis", "--select", "R9",
+         "--no-cache", str(pkg)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "chain: syncpkg.program.step" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "chiaswarm_tpu.analysis", "--select", "R9",
+         "--no-cache", "--json", str(pkg)],
+        capture_output=True, text=True, timeout=300)
+    doc = json.loads(proc.stdout)
+    assert len(doc) == 1 and len(doc[0]["chain"]) == 2
+    assert doc[0]["chain"][0][2] == "syncpkg.program.step"
+
+
+def test_r9_leaves_intra_module_chains_to_r1():
+    src = """
+        import jax
+
+        def helper(x):
+            return x.mean().item()
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+        """
+    assert lint(src, rule="R9") == []      # same module: R1's jurisdiction
+    assert len(lint(src, rule="R1")) == 1  # and R1 does flag it
+
+
+def test_r9_traced_wrapper_registration_roots_cross_module(tmp_path):
+    """scan/vmap bodies and functions PASSED to jit (not decorated) are
+    entry points too."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            import jax
+            from pkg.b import body
+
+            def run(xs):
+                return jax.lax.scan(body, xs, None, length=2)
+            """),
+        ("pkg/b.py", """
+            def body(c, _):
+                return c.sum().item(), None
+            """),
+    )
+    fs = list(_get_rule("R9").check_project(idx))
+    assert len(fs) == 1 and fs[0].path == "pkg/b.py"
+
+
+def test_r10_drift_fixture_flags_all_three_classes(tmp_path):
+    pkg = _copy_fixture(tmp_path, "driftpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R10"])
+    assert r.exit_code == 1
+    msgs = sorted(f.message for f in r.new)
+    assert len(msgs) == 3
+    assert any("'batch'" in m and "no mesh" in m for m in msgs)
+    assert any("in_specs arity 2" in m and "takes 3" in m for m in msgs)
+    assert any("no caller binds" in m for m in msgs)
+    # the clean consumers stay silent
+    assert all(f.symbol not in ("clean_spec", "ring") for f in r.new)
+    arity = next(f for f in r.new if "in_specs" in f.message)
+    assert [hop[2] for hop in arity.chain] == [
+        "driftpkg.specs.wrong_arity", "driftpkg.kernels.ring"]
+
+
+def test_r10_is_silent_without_any_mesh():
+    # nothing to drift from: a lone P("anything") defines no universe
+    assert lint("""
+        from jax.sharding import PartitionSpec as P
+
+        def f():
+            return P("anything", None)
+        """, rule="R10") == []
+
+
+def test_r10_consistent_axes_and_bound_params_stay_silent():
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/mesh.py", 'SEQ_AXIS = "seq"\n'),
+        ("pkg/kern.py", """
+            import jax
+
+            def ring(q, k, v, *, axis_name):
+                return jax.lax.ppermute(q, axis_name, [(0, 1)])
+            """),
+        ("pkg/use.py", """
+            from functools import partial
+
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            from pkg.kern import ring
+            from pkg.mesh import SEQ_AXIS
+
+            def build(devs, q, k, v):
+                mesh = Mesh(devs, (SEQ_AXIS,))
+                from chiaswarm_tpu.core.compat import shard_map
+                spec = P(None, SEQ_AXIS, None, None)
+                fn = shard_map(partial(ring, axis_name=SEQ_AXIS),
+                               mesh=mesh, in_specs=(spec, spec, spec),
+                               out_specs=spec)
+                return fn(q, k, v)
+            """),
+    )
+    assert list(_get_rule("R10").check_project(idx)) == []
+
+
+def test_r10_flags_caller_binding_an_unknown_axis():
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/mesh.py", 'DATA_AXIS = "data"\n'),
+        ("pkg/kern.py", """
+            import jax
+
+            def allreduce(x, *, axis_name):
+                return jax.lax.psum(x, axis_name)
+            """),
+        ("pkg/use.py", """
+            from pkg.kern import allreduce
+
+            def agg(x):
+                return allreduce(x, axis_name="rows")
+            """),
+    )
+    fs = list(_get_rule("R10").check_project(idx))
+    assert len(fs) == 1
+    assert "'rows'" in fs[0].message and fs[0].path == "pkg/use.py"
+    assert [hop[2] for hop in fs[0].chain] == [
+        "pkg.use.agg", "pkg.kern.allreduce"]
+
+
+# ------------------------------------------------- project index units
+
+
+def test_project_symbol_resolution_follows_reexport_chains():
+    idx = _index_of(
+        ("pkg/__init__.py", "from pkg.shim import fn2\n"),
+        ("pkg/impl.py", """
+            AXIS = "data"
+
+            def fn(x):
+                return x
+            """),
+        ("pkg/shim.py", "from pkg.impl import fn as fn2, AXIS\n"),
+    )
+    assert idx.resolve_qual("pkg.shim.fn2") == ("func", ("pkg.impl", "fn"))
+    assert idx.resolve_qual("pkg.fn2") == ("func", ("pkg.impl", "fn"))
+    assert idx.resolve_qual("pkg.shim.AXIS") == ("const", "data")
+    assert idx.resolve_axis({"ref": "pkg.shim.AXIS"}, "pkg.impl") == "data"
+    assert idx.resolve_qual("pkg.impl.missing") is None
+    assert idx.resolve_qual("nowhere.at.all") is None
+
+
+def test_project_call_graph_edges_and_jit_roots():
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            import jax
+            from functools import partial
+
+            from pkg import b
+            from pkg.b import helper
+
+            @jax.jit
+            def root(x):
+                return helper(x)
+
+            def other(x):
+                return b.helper(x) + partial(b.sibling, 1)(x)
+
+            class C:
+                def m(self):
+                    return self.n()
+
+                def n(self):
+                    return 1
+            """),
+        ("pkg/b.py", """
+            def helper(x):
+                return x
+
+            def sibling(k, x):
+                return x
+            """),
+    )
+    edges = idx.edges()
+    assert ("pkg.b", "helper") in edges[("pkg.a", "root")]
+    assert ("pkg.b", "helper") in edges[("pkg.a", "other")]
+    assert ("pkg.b", "sibling") in edges[("pkg.a", "other")]
+    assert ("pkg.a", "C.n") in edges[("pkg.a", "C.m")]
+    assert set(idx.jit_entry_points()) == {("pkg.a", "root")}
+    # relative imports resolve against the package
+    idx2 = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            from .b import helper
+
+            def f(x):
+                return helper(x)
+            """),
+        ("pkg/b.py", "def helper(x):\n    return x\n"),
+    )
+    assert ("pkg.b", "helper") in idx2.edges()[("pkg.a", "f")]
+
+
+def test_project_import_graph_reverse_closure():
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/base.py", "X = 1\n"),
+        ("pkg/mid.py", "from pkg.base import X\n"),
+        ("pkg/top.py", "import pkg.mid\n"),
+        ("pkg/island.py", "Y = 2\n"),
+    )
+    assert idx.reverse_closure({"pkg/base.py"}) == {
+        "pkg/base.py", "pkg/mid.py", "pkg/top.py"}
+    assert idx.reverse_closure({"pkg/top.py"}) == {"pkg/top.py"}
+    assert idx.reverse_closure({"pkg/island.py"}) == {"pkg/island.py"}
+    assert idx.module_deps("pkg/mid.py") == {"pkg/base.py"}
+
+
+def test_project_cache_hits_and_invalidates_on_edit(tmp_path):
+    a = _write(tmp_path, "pkg/a.py", "def f(x):\n    return x\n")
+    b = _write(tmp_path, "pkg/b.py", "def g(x):\n    return x\n")
+    cache = tmp_path / "cache.json"
+    files = [(str(a), "pkg/a.py"), (str(b), "pkg/b.py")]
+    ProjectIndex.build(files, cache_path=str(cache))
+    assert cache.exists()
+
+    # plant a marker in the cached summary of a.py: a cache HIT must
+    # surface the marker, a content edit must rebuild and drop it
+    doc = json.loads(cache.read_text())
+    doc["files"]["pkg/a.py"]["summary"]["marker"] = True
+    cache.write_text(json.dumps(doc))
+    idx = ProjectIndex.build(files, cache_path=str(cache))
+    assert idx.summaries["pkg/a.py"].get("marker") is True
+
+    a.write_text("def f(x):\n    return x + 1\n")
+    idx = ProjectIndex.build(files, cache_path=str(cache))
+    assert "marker" not in idx.summaries["pkg/a.py"]
+    # and the refreshed summary was persisted back
+    doc = json.loads(cache.read_text())
+    assert "marker" not in doc["files"]["pkg/a.py"]["summary"]
+
+    # a corrupt cache is ignored, not fatal
+    cache.write_text("{nope")
+    idx = ProjectIndex.build(files, cache_path=str(cache))
+    assert set(idx.summaries) == {"pkg/a.py", "pkg/b.py"}
+
+
+def test_chain_keyed_baseline_survives_reroutes_and_goes_stale(tmp_path):
+    """Baseline lifecycle for chain-carrying findings: the key excludes
+    the chain, so rerouting an intermediate hop keeps the entry live;
+    fixing the sink makes it stale."""
+    pkg = _copy_fixture(tmp_path, "syncpkg")
+    bl = tmp_path / "baseline.json"
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path))
+    assert r.exit_code == 1 and [f.rule for f in r.new] == [
+        "host-sync-reachability"]
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 0
+    doc = json.loads(bl.read_text())
+    assert len(doc["findings"]) == 1
+    assert set(doc["findings"][0]) == {  # identity only, no hops
+        "rule", "path", "symbol", "message", "count"}
+
+    # reroute: the jitted entry now reaches the sink through a NEW
+    # intermediate function (different chain, same finding identity)
+    (pkg / "program.py").write_text(textwrap.dedent("""
+        import jax
+
+        from syncpkg.helpers import postprocess_mean
+
+
+        def indirection(x):
+            return postprocess_mean(x)
+
+
+        @jax.jit
+        def step(x):
+            return indirection(x) + 1.0
+        """))
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 0 and len(r.suppressed) == 1 and not r.stale
+
+    # fix the sink: entry goes stale, strict fails until deleted
+    (pkg / "helpers.py").write_text(
+        "def postprocess_mean(x):\n    return x.mean()\n")
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            strict=True)
+    assert r.exit_code == 1 and r.stale
+
+
+def test_changed_only_lints_reverse_dependency_closure(tmp_path):
+    """--changed-only: edited file + everything importing it, nothing
+    else (the pre-existing finding in the untouched island must not
+    resurface, and staleness scope stays narrow)."""
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True)
+
+    _write(tmp_path, "pkg/__init__.py", "")
+    base = _write(tmp_path, "pkg/base.py", "def f():\n    return 1\n")
+    _write(tmp_path, "pkg/top.py", "from pkg.base import f\n")
+    _write(tmp_path, "pkg/island.py", BAD)  # pre-existing R4 finding
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "--allow-empty", "-m", "x")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "seed")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+
+    # introduce a finding in base.py (working tree, uncommitted)
+    base.write_text(BAD)
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True)
+    assert r.exit_code == 1
+    assert [f.path for f in r.new] == ["pkg/base.py"]  # island NOT linted
+    assert r.checked_files == 2 and r.total_files == 4  # base + top
+    assert "changed-only" in r.report
+
+    # a full run still sees both findings
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path))
+    assert sorted(f.path for f in r.new) == ["pkg/base.py",
+                                             "pkg/island.py"]
+
+    # --write-baseline from a partial run is refused
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, write_baseline=True)
+    assert r.exit_code == 2 and "refusing" in r.report
+
+
+def test_changed_only_without_git_is_bad_input(tmp_path):
+    _write(tmp_path, "pkg/mod.py", "x = 1\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True)
+    assert r.exit_code == 2 and "git" in r.report
+
+
+def test_sarif_output_carries_chains_and_fingerprints(tmp_path):
+    pkg = _copy_fixture(tmp_path, "syncpkg")
+    out = tmp_path / "findings.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "chiaswarm_tpu.analysis", "--no-cache",
+         "--sarif", str(out), str(pkg)],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "swarmlint"
+    assert any(r["id"] == "host-sync-reachability"
+               for r in driver["rules"])
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    res = results[0]
+    assert res["ruleId"] == "host-sync-reachability"
+    assert res["partialFingerprints"]["swarmlintBaselineKey/v1"].startswith(
+        "host-sync-reachability::")
+    flow = res["codeFlows"][0]["threadFlows"][0]["locations"]
+    assert len(flow) == 2
+    assert flow[0]["location"]["message"]["text"] == "syncpkg.program.step"
+    # columns/lines are 1-based per the SARIF spec
+    assert res["locations"][0]["physicalLocation"]["region"][
+        "startColumn"] >= 1
+
+
+def test_r10_inline_lambda_callee_arity():
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/mesh.py", 'DATA_AXIS = "data"\n'),
+        ("pkg/use.py", """
+            from jax.sharding import PartitionSpec as P
+
+            from pkg.mesh import DATA_AXIS
+
+            def f(mesh, q, k):
+                from chiaswarm_tpu.core.compat import shard_map
+                spec = P(DATA_AXIS)
+                fn = shard_map(lambda q, k, v: q, mesh=mesh,
+                               in_specs=(spec, spec), out_specs=spec)
+                return fn(q, k)
+
+            def ok(mesh, q, k):
+                from chiaswarm_tpu.core.compat import shard_map
+                spec = P(DATA_AXIS)
+                fn = shard_map(lambda a, b: a, mesh=mesh,
+                               in_specs=(spec, spec), out_specs=spec)
+                return fn(q, k)
+            """),
+    )
+    fs = list(_get_rule("R10").check_project(idx))
+    assert len(fs) == 1
+    assert "lambda takes 3" in fs[0].message and fs[0].symbol == "f"
+
+
+def test_r9_registration_site_is_a_chain_hop(tmp_path):
+    """A traced body whose sync chain stays in ONE module but whose
+    registration lives in ANOTHER must chain the registration site —
+    that is the only cross-module evidence, and --changed-only's chain
+    filter depends on it."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            import jax
+            from pkg.b import body
+
+            def run(xs):
+                return jax.lax.scan(body, xs, None, length=2)
+            """),
+        ("pkg/b.py", """
+            def body(c, _):
+                return c.sum().item(), None
+            """),
+    )
+    fs = list(_get_rule("R9").check_project(idx))
+    assert len(fs) == 1
+    assert [hop[2] for hop in fs[0].chain] == ["pkg.a.run", "pkg.b.body"]
+    assert fs[0].chain[0][0] == "pkg/a.py"
+
+
+def test_changed_only_keeps_findings_rooted_in_the_changed_file(tmp_path):
+    """Code-review regression: editing ONLY the registering file (the
+    sink module is its dependency, outside the reverse closure) must
+    still surface the R9 finding — via the chain's registration hop."""
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True)
+
+    _write(tmp_path, "pkg/__init__.py", "")
+    a = _write(tmp_path, "pkg/a.py", "from pkg.b import body\n")
+    _write(tmp_path, "pkg/b.py",
+           "def body(c, _):\n    return c.sum().item(), None\n")
+    git("init", "-q")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "seed")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+
+    a.write_text("import jax\nfrom pkg.b import body\n\n\n"
+                 "def run(xs):\n"
+                 "    return jax.lax.scan(body, xs, None, length=2)\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "bl.json"),
+            root=str(tmp_path), changed_only=True)
+    assert r.checked_files == 1  # only a.py re-linted per-file...
+    assert [f.rule for f in r.new] == ["host-sync-reachability"]
+    assert r.new[0].path == "pkg/b.py"  # ...but the chained finding lands
+    assert r.new[0].chain[0][0] == "pkg/a.py"
+    # and the fast path agrees with the full run
+    full = run([str(tmp_path)], baseline_path=str(tmp_path / "bl.json"),
+               root=str(tmp_path))
+    assert [f.baseline_key for f in full.new] == [
+        f.baseline_key for f in r.new]
+
+
+def test_changed_only_fails_loudly_on_unparseable_changed_file(tmp_path):
+    """Code-review regression: a syntax error in the CHANGED file must
+    exit 2 from the fast path too — the import graph cannot see the file,
+    but the raw changed set still reaches the per-file pass."""
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True)
+
+    a = _write(tmp_path, "pkg/a.py", "x = 1\n")
+    _write(tmp_path, "pkg/b.py", "y = 2\n")
+    git("init", "-q")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "seed")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+
+    a.write_text("def broken(:\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "bl.json"),
+            root=str(tmp_path), changed_only=True)
+    assert r.exit_code == 2 and any("pkg/a.py" in e for e in r.errors)
+
+
+def test_subset_index_build_merges_into_cache_instead_of_evicting(tmp_path):
+    """Code-review regression: building the index over a path subset
+    must not truncate the whole-repo cache; deleted files DO get pruned
+    at the next dirty write."""
+    a = _write(tmp_path, "pkg/a.py", "x = 1\n")
+    b = _write(tmp_path, "pkg/b.py", "y = 2\n")
+    cache = tmp_path / "cache.json"
+    both = [(str(a), "pkg/a.py"), (str(b), "pkg/b.py")]
+    ProjectIndex.build(both, cache_path=str(cache))
+    assert set(json.loads(cache.read_text())["files"]) == {
+        "pkg/a.py", "pkg/b.py"}
+
+    # subset run over a.py only (with an edit, so the cache is written):
+    # b.py's warm entry survives
+    a.write_text("x = 3\n")
+    ProjectIndex.build([(str(a), "pkg/a.py")], cache_path=str(cache))
+    assert set(json.loads(cache.read_text())["files"]) == {
+        "pkg/a.py", "pkg/b.py"}
+
+    # a fully-warm run does not rewrite the file at all
+    before = cache.read_text()
+    ProjectIndex.build(both, cache_path=str(cache))
+    assert cache.read_text() == before
+
+    # a deleted file's entry is pruned on the next dirty write
+    b.unlink()
+    a.write_text("x = 4\n")
+    ProjectIndex.build([(str(a), "pkg/a.py")], cache_path=str(cache))
+    assert set(json.loads(cache.read_text())["files"]) == {"pkg/a.py"}

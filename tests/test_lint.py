@@ -53,4 +53,5 @@ def test_all_rules_are_registered():
     from chiaswarm_tpu.analysis import all_rules
 
     codes = [r.code for r in all_rules()]
-    assert codes == ["R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8"], codes
+    assert codes == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
+                     "R8", "R9", "R10"], codes

@@ -506,3 +506,44 @@ def test_e2e_tiny_txt2img_trace_spans(stepper, monkeypatch):
     if stepper:
         assert "chiaswarm_stepper_steps_executed_total 2" in metrics_body
         assert "chiaswarm_stepper_step_seconds_count" in metrics_body
+        # the per-lane occupancy histogram sampled at each lane step
+        # (ISSUE 5 obs tie-in) rides the same scrape, labeled by the
+        # lane's (bounded) width — never by unbounded lane id
+        assert 'chiaswarm_stepper_lane_occupancy_ratio_bucket{width="' \
+            in metrics_body
+
+
+def test_lane_occupancy_histogram_semantics():
+    """The per-lane occupancy family (obs/metrics.py): ratio buckets in
+    eighths, one series per lane-width label (bounded — lane IDs would
+    leak a series per retired lane), registered on the process-global
+    registry exactly once (get-or-create)."""
+    from chiaswarm_tpu.obs.metrics import (
+        OCCUPANCY_BUCKETS, lane_occupancy_histogram)
+
+    reg = Registry()
+    hist = lane_occupancy_histogram(reg)
+    assert lane_occupancy_histogram(reg) is hist  # idempotent
+    assert hist.buckets == OCCUPANCY_BUCKETS
+
+    # a 4-wide lane stepping at 1, 2, 4, 4 active rows
+    for active in (1, 2, 4, 4):
+        hist.observe(active / 4, width="4")
+    hist.observe(0.5, width="16")  # wider lane family: its own series
+    assert hist.count(width="4") == 4 and hist.count(width="16") == 1
+    assert hist.sum(width="4") == pytest.approx(2.75)
+
+    body = reg.render()
+    assert ('chiaswarm_stepper_lane_occupancy_ratio_bucket'
+            '{width="4",le="0.25"} 1') in body
+    assert ('chiaswarm_stepper_lane_occupancy_ratio_bucket'
+            '{width="4",le="1"} 4') in body
+    assert ('chiaswarm_stepper_lane_occupancy_ratio_count{width="16"} 1'
+            ) in body
+
+    # the real sampler feeds the process-global registry
+    global_hist = lane_occupancy_histogram()
+    from chiaswarm_tpu.obs import metrics as obs_metrics
+
+    assert obs_metrics.REGISTRY.get(
+        "chiaswarm_stepper_lane_occupancy_ratio") is global_hist

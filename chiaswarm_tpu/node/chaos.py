@@ -119,12 +119,43 @@ class ChaoticHive:
         self.pending_jobs.append(job)
         self.issued_ids.append(str(job.get("id")))
 
+    # ---- subclass seams (node/minihive.py grows these into a real
+    # lease-tracking mini-hive; the base class stays the PR-2 fault
+    # injector with reference handout semantics) ----
+
+    def _take_jobs(self, worker_name: str) -> list[dict[str, Any]]:
+        """Hand out jobs for one poll (reference semantics: everything
+        queued goes to the first poller)."""
+        jobs, self.pending_jobs = self.pending_jobs, []
+        return jobs
+
+    def _record_result(self, result: dict[str, Any],
+                       worker_name: str) -> dict[str, Any]:
+        """Settle one uploaded result; returns the ack body."""
+        self.results.append(result)
+        self.result_event.set()
+        return {"status": "ok"}
+
+    def _worker_reachable(self, worker_name: str) -> bool:
+        """Partition seam: False drops this worker's requests on the
+        floor (connection reset), simulating a network partition between
+        one worker and the hive."""
+        return True
+
+    @staticmethod
+    def _worker_from(request) -> str:
+        return str(request.query.get("worker_name", "") or "")
+
     # ---- endpoints ----
 
     async def _work(self, request):
         from aiohttp import web
 
         self.poll_count += 1
+        worker_name = self._worker_from(request)
+        if not self._worker_reachable(worker_name):
+            request.transport.close()
+            raise ConnectionResetError("chaos: partitioned worker poll")
         mode = self.poll_faults.next()
         if mode == "drop":
             # connection dies mid-request: the client sees a disconnect,
@@ -143,8 +174,7 @@ class ChaoticHive:
         if mode == "malformed":
             self._malformed += 1
             self.submit(_malformed_job(self._malformed))
-        jobs, self.pending_jobs = self.pending_jobs, []
-        return web.json_response({"jobs": jobs})
+        return web.json_response({"jobs": self._take_jobs(worker_name)})
 
     async def _results(self, request):
         from aiohttp import web
@@ -155,6 +185,10 @@ class ChaoticHive:
             result = await request.json()
         except Exception:
             return web.Response(status=400, text="unparseable result")
+        worker_name = str(result.get("worker_name") or "")
+        if not self._worker_reachable(worker_name):
+            request.transport.close()
+            raise ConnectionResetError("chaos: partitioned worker upload")
         job_id = str(result.get("id"))
         schedule = self.result_faults.get(job_id)
         mode = schedule.next() if schedule else "ok"
@@ -163,9 +197,7 @@ class ChaoticHive:
             raise ConnectionResetError("chaos: dropped result connection")
         if mode == "http_500":
             return web.Response(status=500, text="chaos: results on fire")
-        self.results.append(result)
-        self.result_event.set()
-        return web.json_response({"status": "ok"})
+        return web.json_response(self._record_result(result, worker_name))
 
     async def _models(self, request):
         from aiohttp import web

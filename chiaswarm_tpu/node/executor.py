@@ -30,7 +30,11 @@ from chiaswarm_tpu.node.output_processor import (
     make_text_result,
 )
 from chiaswarm_tpu.node.registry import ModelRegistry
-from chiaswarm_tpu.node.resilience import classify_exception
+from chiaswarm_tpu.node.resilience import (
+    NONFATAL_KINDS,
+    checkpoint_scope,
+    classify_exception,
+)
 from chiaswarm_tpu.obs import trace as obs_trace
 from chiaswarm_tpu.obs.profiling import job_profile
 from chiaswarm_tpu.obs.trace import span
@@ -129,9 +133,11 @@ def _format(job: dict[str, Any], registry: ModelRegistry):
         # bad inputs are fatal (do not redispatch) — but formatting also
         # FETCHES input images, and a network blip is not the user's
         # fault: transient kinds upload without the fatal flag so the
-        # worker's ladder (and failing that, the hive) may retry
+        # worker's ladder (and failing that, the hive) may retry, and a
+        # node-local model-unavailable is a ROUTING problem a lease-aware
+        # hive redispatches (resilience.REDISPATCH_KINDS), never fatal
         kind = classify_exception(exc)
-        fatal = kind not in ("transient", "oom")
+        fatal = kind not in NONFATAL_KINDS
         log.warning("job %s failed formatting (%s): %s", job_id, kind, exc)
         artifacts, config = _error_payload(exc, content_type, kind=kind)
         return None, _result(job_id, artifacts, config, fatal=fatal)
@@ -143,9 +149,17 @@ def _execute(job_id, content_type, callback, kwargs, slot) -> dict:
         with _maybe_profile(job_id):
             artifacts, config = slot(callback, **kwargs)
     except ValueError as exc:  # callback-declared unrecoverable input error
-        log.warning("job %s fatal: %s", job_id, exc)
-        artifacts, config = _error_payload(exc, content_type)
-        return _result(job_id, artifacts, config, fatal=True)
+        # ...EXCEPT a node-local model-unavailable (missing/broken/
+        # quarantined checkpoint): that is this node refusing, not the
+        # inputs being bad — it uploads WITHOUT the fatal flag so a
+        # lease-aware hive redispatches it to a node that holds the
+        # model (ISSUE 6; resolves the PR-2 taxonomy tension)
+        kind = classify_exception(exc)
+        fatal = kind not in NONFATAL_KINDS
+        log.warning("job %s %s: %s", job_id,
+                    "fatal" if fatal else kind, exc)
+        artifacts, config = _error_payload(exc, content_type, kind=kind)
+        return _result(job_id, artifacts, config, fatal=fatal)
     except Exception as exc:  # error artifact without the fatal flag: the
         log.exception("job %s errored", job_id)  # hive may retry elsewhere
         artifacts, config = _error_payload(exc, content_type)
@@ -212,8 +226,12 @@ def synchronous_do_work(job: dict[str, Any], slot,
     log.info("processing job %s", job.get("id"))
     # the job's span tree follows it into this thread: format / encode /
     # step / decode spans below attach under the worker's open
-    # "execute" phase (chiaswarm_tpu/obs/trace.py)
-    with obs_trace.activate(obs_trace.job_trace(job)):
+    # "execute" phase (chiaswarm_tpu/obs/trace.py). The checkpoint scope
+    # binds the worker's spool so the solo path can record its coarse
+    # phase markers (workloads/diffusion.py; lanes snapshot themselves).
+    with obs_trace.activate(obs_trace.job_trace(job)), \
+            checkpoint_scope(getattr(slot, "_checkpoint_spool", None),
+                             job.get("id")):
         formatted, fatal = _format(job, registry)
         if formatted is None:
             return fatal
@@ -439,7 +457,9 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                             kwargs))
 
     for i, job_id, content_type, callback, kwargs in singles:
-        with obs_trace.activate(_job_trace(i)):
+        with obs_trace.activate(_job_trace(i)), \
+                checkpoint_scope(getattr(slot, "_checkpoint_spool", None),
+                                 job_id):
             results[i] = _execute(job_id, content_type, callback, kwargs,
                                   slot)
     return [r for r in results if r is not None]

@@ -147,6 +147,26 @@ class HiveClient:
                 except Exception:  # non-JSON 2xx body — accept upload
                     return {"status": response.status}
 
+    async def post_heartbeat(self, session: aiohttp.ClientSession,
+                             payload: dict[str, Any]) -> dict[str, Any]:
+        """Lease keep-alive for lease-aware hives (node/minihive.py):
+        ``payload`` carries the worker name, its in-flight job ids, and
+        their latest resume checkpoints. NOT part of the reference wire
+        protocol — the worker only calls this when ``heartbeat_s`` > 0
+        (node/settings.py) and tolerates any failure."""
+        with _observe("heartbeat"):
+            async with session.post(
+                f"{self.api}/heartbeat",
+                data=json.dumps(payload),
+                headers=self._headers(),
+                timeout=aiohttp.ClientTimeout(total=10),
+            ) as response:
+                response.raise_for_status()
+                try:
+                    return await response.json()
+                except Exception:  # non-JSON 2xx: the beat still landed
+                    return {"status": response.status}
+
     async def get_models(self, session: aiohttp.ClientSession) -> list[dict]:
         async with session.get(
             f"{self.api}/models",

@@ -1,0 +1,397 @@
+"""MiniHive: a lease-tracking in-process hive — fleet-scale fault seams.
+
+The PR-2 :class:`~chiaswarm_tpu.node.chaos.ChaoticHive` proves ONE worker
+is fault-contained; the failure mode that dominates real TPU fleets — a
+whole worker preempted mid-job — needs the hive side of the contract.
+This module grows the chaos hive into a real mini-hive with the standard
+lease-and-redeliver recipe of large-scale serving systems:
+
+- **Leases**: every job handed out by ``GET /api/work`` is leased to the
+  polling worker for ``lease_s`` seconds. Polls and ``POST
+  /api/heartbeat`` calls from the holder extend its leases.
+- **Redelivery**: an expired lease (worker died, was partitioned, or
+  went silent) puts the job back in the queue with an incremented
+  attempt count and the late worker on the job's excluded list, so the
+  next poll hands it to a DIFFERENT worker.
+- **Resume state**: heartbeats carry the worker's latest step-boundary
+  checkpoint per in-flight job (node/resilience.py::CheckpointSpool,
+  serving/stepper.py lane snapshots). The redelivered job rides out
+  with a ``resume`` field, so the surviving worker splices it into a
+  lane at step k instead of restarting at step 0.
+- **Exactly-once completion**: the first success-or-error envelope for
+  a job id settles it; any later upload — the classic race of a
+  presumed-dead worker's stale result against the redelivered copy — is
+  acked idempotently (``{"status": "duplicate"}``) and never counted
+  twice. Chip time is salvaged whichever copy lands first.
+- **Redispatch by error kind**: envelopes whose ``error_kind`` is in
+  :data:`~chiaswarm_tpu.node.resilience.REDISPATCH_KINDS`
+  (``model_unavailable``, ``quarantined``) are NOT settled: the job
+  requeues with the refusing worker excluded. This resolves the
+  reference-parity taxonomy tension ROADMAP carried since PR 2 — a
+  node-local model-unavailable is a routing problem, not a fatal error.
+
+Chaos composition: all of :class:`ChaoticHive`'s scripted poll/result
+faults still apply, plus :meth:`partition`/:meth:`heal` cut one worker
+off from every endpoint (its requests see connection resets) — the
+deterministic stand-in for a network partition outliving the lease.
+
+Like the chaos harness, this is product code: operators smoke a
+multi-worker build against one MiniHive in one process
+(tests/test_minihive.py is the executable spec), and the ROADMAP's
+fleet-scale load harness builds on the same queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Iterable
+
+from chiaswarm_tpu.node.chaos import ChaoticHive
+from chiaswarm_tpu.node.resilience import REDISPATCH_KINDS, classify_result
+from chiaswarm_tpu.obs.metrics import Registry
+
+log = logging.getLogger("chiaswarm.minihive")
+
+
+def result_error_kind(result: dict[str, Any]) -> str | None:
+    """The ``error_kind`` an envelope carries, or None for a success.
+
+    Delegates to the worker-side classifier so hive and worker can never
+    disagree about what counts as an error envelope."""
+    kind = classify_result(result)
+    return None if kind == "ok" else kind
+
+
+class MiniHive(ChaoticHive):
+    """In-process hive with leases, heartbeats, redelivery, and
+    exactly-once completion. See the module docstring for semantics.
+
+    ``lease_s``             seconds a handed-out job stays leased without
+                            a heartbeat/poll from its holder
+    ``max_attempts``        delivery attempts per job before it is
+                            abandoned (parked in ``self.abandoned``)
+    ``max_jobs_per_poll``   cap per poll (0 = reference semantics: the
+                            first poller drains the queue)
+    ``clock``               injectable monotonic clock for tests
+    """
+
+    def __init__(self, poll_faults: Iterable[str] | None = None,
+                 result_faults: dict[str, Iterable[str]] | None = None,
+                 delay_s: float = 0.05, *,
+                 lease_s: float = 30.0,
+                 max_attempts: int = 4,
+                 max_jobs_per_poll: int = 0,
+                 redispatch_kinds: frozenset[str] = REDISPATCH_KINDS,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        super().__init__(poll_faults, result_faults, delay_s)
+        self.lease_s = float(lease_s)
+        self.max_attempts = max(1, int(max_attempts))
+        self.max_jobs_per_poll = max(0, int(max_jobs_per_poll))
+        self.redispatch_kinds = frozenset(redispatch_kinds)
+        self._clock = clock
+        # job id -> {job, worker, expires_at, attempt}
+        self.leases: dict[str, dict[str, Any]] = {}
+        self.attempts: dict[str, int] = {}
+        self.excluded: dict[str, set[str]] = {}
+        self.checkpoints: dict[str, dict[str, Any]] = {}
+        self.completed: dict[str, dict[str, Any]] = {}
+        self.duplicate_results: list[dict[str, Any]] = []
+        self.abandoned: list[str] = []
+        self.known_workers: set[str] = set()
+        self.worker_seen: dict[str, float] = {}  # last poll/heartbeat
+        self.partitioned: set[str] = set()
+        self._app.router.add_post("/api/heartbeat", self._heartbeat)
+        self._app.router.add_get("/api/stats", self._stats_endpoint)
+        # per-hive registry (hermetic, like the worker's): the snapshot
+        # is the accounting tests reconcile against the result lists
+        self.metrics = Registry()
+        m = self.metrics
+        self._leases_granted = m.counter(
+            "chiaswarm_hive_leases_granted_total",
+            "jobs handed out under a lease")
+        self._leases_expired = m.counter(
+            "chiaswarm_hive_leases_expired_total",
+            "leases that expired without a settling upload")
+        self._redelivered = m.counter(
+            "chiaswarm_hive_jobs_redelivered_total",
+            "expired-lease jobs requeued for another worker")
+        self._redispatched = m.counter(
+            "chiaswarm_hive_jobs_redispatched_total",
+            "jobs requeued because a worker refused them", ("kind",))
+        self._completed = m.counter(
+            "chiaswarm_hive_results_completed_total",
+            "results settled exactly once")
+        self._duplicates = m.counter(
+            "chiaswarm_hive_results_duplicate_total",
+            "late/racing uploads acked idempotently, never counted")
+        self._heartbeats = m.counter(
+            "chiaswarm_hive_heartbeats_total", "heartbeats received")
+        self._ckpt_stored = m.counter(
+            "chiaswarm_hive_checkpoints_stored_total",
+            "resume checkpoints accepted from lease holders")
+        self._ckpt_stale = m.counter(
+            "chiaswarm_hive_checkpoints_stale_total",
+            "checkpoints rejected because the sender lost the lease")
+        self._abandoned = m.counter(
+            "chiaswarm_hive_jobs_abandoned_total",
+            "jobs parked after exhausting max_attempts deliveries")
+
+    # ---- chaos controls -------------------------------------------------
+
+    def partition(self, worker_name: str) -> None:
+        """Cut ``worker_name`` off: its polls, heartbeats, and uploads
+        all see dropped connections until :meth:`heal`. Its leases expire
+        on schedule — the deterministic worker-vanished fault."""
+        self.partitioned.add(str(worker_name))
+
+    def heal(self, worker_name: str) -> None:
+        self.partitioned.discard(str(worker_name))
+
+    def _worker_reachable(self, worker_name: str) -> bool:
+        return worker_name not in self.partitioned
+
+    # ---- leases ---------------------------------------------------------
+
+    def sweep(self) -> list[str]:
+        """Expire overdue leases; requeue (or abandon) their jobs.
+        Runs on every poll/heartbeat/upload — callers never wait on a
+        background timer — and returns the redelivered job ids."""
+        now = self._clock()
+        redelivered: list[str] = []
+        for job_id in [j for j, lease in self.leases.items()
+                       if now >= lease["expires_at"]]:
+            lease = self.leases.pop(job_id)
+            self._leases_expired.inc()
+            self.excluded.setdefault(job_id, set()).add(lease["worker"])
+            if self.attempts.get(job_id, 0) >= self.max_attempts:
+                log.error("job %s abandoned after %d deliveries",
+                          job_id, self.attempts.get(job_id, 0))
+                self.abandoned.append(job_id)
+                self._abandoned.inc()
+                # GC like the settle path does: an abandoned job's
+                # latent-sized checkpoint blob is never resumed again
+                self.checkpoints.pop(job_id, None)
+                continue
+            log.warning("lease for job %s (worker %s) expired; "
+                        "redelivering (attempt %d done)", job_id,
+                        lease["worker"], lease["attempt"])
+            self.pending_jobs.append(lease["job"])
+            self._redelivered.inc()
+            redelivered.append(job_id)
+        return redelivered
+
+    def expire_worker(self, worker_name: str) -> list[str]:
+        """Declare ``worker_name`` dead NOW: every lease it holds expires
+        immediately and redelivers on this very sweep, without waiting
+        out ``lease_s``. The TPU-fleet analog is a preemption notice —
+        the scheduler knows the node is gone before the lease clock
+        does. Pairs with :meth:`partition` (cut it off first, so nothing
+        it still uploads can race ahead of the revocation)."""
+        for lease in self.leases.values():
+            if lease["worker"] == worker_name:
+                lease["expires_at"] = float("-inf")
+        return self.sweep()
+
+    def _extend_leases(self, worker_name: str) -> None:
+        expiry = self._clock() + self.lease_s
+        for lease in self.leases.values():
+            if lease["worker"] == worker_name:
+                lease["expires_at"] = expiry
+
+    def live_workers(self) -> set[str]:
+        """Workers seen (poll or heartbeat) within the last two lease
+        periods. The starvation valve compares exclusion against THIS
+        set, not ``known_workers``: a dead worker stays known forever,
+        and waiting for its refusal would strand a job that every
+        surviving worker has already refused."""
+        horizon = self._clock() - 2 * self.lease_s
+        return {name for name, seen in self.worker_seen.items()
+                if seen >= horizon}
+
+    def lease_holder(self, job_id: Any) -> str | None:
+        lease = self.leases.get(str(job_id))
+        return None if lease is None else lease["worker"]
+
+    def leased_ids(self, worker_name: str) -> list[str]:
+        return sorted(job_id for job_id, lease in self.leases.items()
+                      if lease["worker"] == worker_name)
+
+    # ---- handout (ChaoticHive seam) ------------------------------------
+
+    def _take_jobs(self, worker_name: str) -> list[dict[str, Any]]:
+        self.known_workers.add(worker_name)
+        self.worker_seen[worker_name] = self._clock()
+        self.sweep()
+        self._extend_leases(worker_name)  # a poll proves liveness
+        live = self.live_workers()
+        handed: list[dict[str, Any]] = []
+        remaining: list[dict[str, Any]] = []
+        for job in self.pending_jobs:
+            job_id = str(job.get("id"))
+            if job_id in self.completed:
+                # settled while queued (a late upload raced ahead of
+                # this redelivery): drop the copy, never re-execute
+                continue
+            excluded = self.excluded.get(job_id, set())
+            # starvation valve: once every LIVE worker has refused or
+            # lost this job, exclusion has nothing left to route around
+            # (a dead worker must not hold the valve shut forever)
+            if worker_name in excluded and not live <= excluded:
+                remaining.append(job)
+                continue
+            if self.max_jobs_per_poll and \
+                    len(handed) >= self.max_jobs_per_poll:
+                remaining.append(job)
+                continue
+            handed.append(job)
+        self.pending_jobs = remaining
+        out: list[dict[str, Any]] = []
+        for job in handed:
+            job_id = str(job.get("id"))
+            attempt = self.attempts.get(job_id, 0) + 1
+            self.attempts[job_id] = attempt
+            self.leases[job_id] = {
+                "job": job, "worker": worker_name, "attempt": attempt,
+                "expires_at": self._clock() + self.lease_s,
+            }
+            self._leases_granted.inc()
+            # the wire copy carries its lineage + resume state; the
+            # queued original stays pristine for the next redelivery
+            payload = dict(job)
+            payload["attempt"] = attempt
+            checkpoint = self.checkpoints.get(job_id)
+            if checkpoint is not None:
+                payload["resume"] = checkpoint
+            out.append(payload)
+        return out
+
+    # ---- settling (ChaoticHive seam) ------------------------------------
+
+    def _record_result(self, result: dict[str, Any],
+                       worker_name: str) -> dict[str, Any]:
+        self.sweep()
+        job_id = str(result.get("id"))
+        if job_id in self.completed:
+            # the redelivery race settled already: ack idempotently so
+            # the uploader stops retrying, but never double-count
+            self.duplicate_results.append(result)
+            self._duplicates.inc()
+            log.info("duplicate result for %s from %s acked (job already "
+                     "settled)", job_id, worker_name or "unknown")
+            return {"status": "duplicate"}
+        kind = result_error_kind(result)
+        lease = self.leases.get(job_id)
+        # does the refuser still hold the lease? A refusal can also land
+        # LATE — after its lease expired (sweep already requeued the
+        # job) or after redelivery to another worker (the job is in
+        # flight elsewhere). In both cases there is nothing to requeue,
+        # but the refusal still must not settle the job as an error.
+        held_by_refuser = lease is not None and \
+            (not worker_name or lease["worker"] == worker_name)
+        if (kind in self.redispatch_kinds
+                and not result.get("fatal_error")
+                and job_id not in self.abandoned
+                and (self.attempts.get(job_id, 0) < self.max_attempts
+                     or not held_by_refuser)):
+            # THIS worker cannot serve the model; another may. Requeue
+            # with the refuser excluded instead of settling the error.
+            # A refusal from a worker that no longer holds the lease
+            # never settles, even at max_attempts — the live copy
+            # (queued or running elsewhere) owns the outcome; only the
+            # current holder's refusal on the final attempt is final.
+            refuser = worker_name or (lease["worker"] if lease else "")
+            if refuser:
+                self.excluded.setdefault(job_id, set()).add(refuser)
+            if held_by_refuser:
+                self.leases.pop(job_id, None)
+                self.pending_jobs.append(lease["job"])
+            self._redispatched.inc(kind=kind)
+            log.warning("job %s refused by %s (%s); redispatching with "
+                        "the refuser excluded", job_id,
+                        refuser or "unknown", kind)
+            return {"status": "requeued", "kind": kind}
+        # exactly-once settle: first envelope wins, whoever sent it —
+        # even a worker whose lease already expired (salvaged chip time).
+        # Withdraw any queued redelivery copy too: without this, a late
+        # upload landing after its lease expired would leave the requeued
+        # copy to burn a full re-execution on another worker.
+        self.completed[job_id] = result
+        self.results.append(result)
+        self.result_event.set()
+        self.leases.pop(job_id, None)
+        self.checkpoints.pop(job_id, None)  # hive-side checkpoint GC
+        self.pending_jobs = [j for j in self.pending_jobs
+                             if str(j.get("id")) != job_id]
+        self._completed.inc()
+        return {"status": "ok"}
+
+    # ---- heartbeats ------------------------------------------------------
+
+    async def _heartbeat(self, request):
+        from aiohttp import web
+
+        try:
+            payload = await request.json()
+        except Exception:
+            return web.Response(status=400, text="unparseable heartbeat")
+        worker_name = str(payload.get("worker_name") or "")
+        if not self._worker_reachable(worker_name):
+            request.transport.close()
+            raise ConnectionResetError("chaos: partitioned heartbeat")
+        self.known_workers.add(worker_name)
+        self.worker_seen[worker_name] = self._clock()
+        self.sweep()
+        self._heartbeats.inc()
+        expiry = self._clock() + self.lease_s
+        lost: list[str] = []
+        for entry in payload.get("jobs") or []:
+            job_id = str(entry.get("id"))
+            lease = self.leases.get(job_id)
+            if lease is None or lease["worker"] != worker_name:
+                settled = self.completed.get(job_id)
+                if settled is not None and \
+                        settled.get("worker_name") in (worker_name, "", None):
+                    # the sender's OWN upload just raced this beat: NOT
+                    # a lost lease — its ack path clears the in-flight
+                    # entry, and counting it would show phantom lease
+                    # churn on every healthy run. (Settled by a DIFFERENT
+                    # worker still reports lost below: the sender is
+                    # burning chip time on a finished job.)
+                    continue
+                # the lease moved on (expired + redelivered): tell the
+                # sender so it can stop burning chip time on it; a stale
+                # checkpoint must NOT shadow the new holder's progress
+                lost.append(job_id)
+                if entry.get("checkpoint") is not None:
+                    self._ckpt_stale.inc()
+                continue
+            lease["expires_at"] = expiry
+            checkpoint = entry.get("checkpoint")
+            if checkpoint is not None:
+                self.checkpoints[job_id] = checkpoint
+                self._ckpt_stored.inc()
+        return web.json_response({"status": "ok", "lost": lost})
+
+    # ---- observability ---------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """Lease-table view + the counter snapshot — the registry the
+        exactly-once tests reconcile against the result lists."""
+        self.sweep()
+        return {
+            "pending": len(self.pending_jobs),
+            "leased": {job_id: {"worker": lease["worker"],
+                                "attempt": lease["attempt"]}
+                       for job_id, lease in self.leases.items()},
+            "completed": len(self.completed),
+            "duplicates": len(self.duplicate_results),
+            "abandoned": list(self.abandoned),
+            "checkpoints": sorted(self.checkpoints),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    async def _stats_endpoint(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.stats())

@@ -33,11 +33,14 @@ job can load it anywhere.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import hashlib
 import json
 import logging
 import random
 import re
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable
@@ -53,7 +56,22 @@ log = logging.getLogger("chiaswarm.resilience")
 RETRYABLE_KINDS = frozenset({"transient", "oom"})
 
 #: kinds that count as a model-level failure toward its circuit breaker
-BREAKER_KINDS = frozenset({"model", "timeout", "error", "oom"})
+BREAKER_KINDS = frozenset({"model_unavailable", "timeout", "error", "oom"})
+
+#: kinds a lease-aware hive redispatches to ANOTHER worker instead of
+#: settling (node/minihive.py): this node cannot serve the model — by
+#: load failure or by an open breaker — but a different node may. These
+#: envelopes upload WITHOUT the fatal flag (node/executor.py), resolving
+#: the reference-parity taxonomy tension where a node-local
+#: model-unavailable used to read as fatal and strand the job.
+REDISPATCH_KINDS = frozenset({"model_unavailable", "quarantined"})
+
+#: kinds whose error envelopes upload WITHOUT the fatal flag — locally
+#: retryable kinds plus hive-side redispatch kinds. The executor derives
+#: its fatal/non-fatal split from this set so a kind added to either
+#: family above can never silently stay fatal (drift between the
+#: taxonomy here and hand-written literals was a real near-miss).
+NONFATAL_KINDS = RETRYABLE_KINDS | REDISPATCH_KINDS
 
 _OOM_MARKERS = (
     "RESOURCE_EXHAUSTED",
@@ -94,12 +112,14 @@ _MODEL_UNAVAILABLE_MARKERS = (
 def classify_exception(exc: BaseException) -> str:
     """Sort an exception into a failure kind for the degradation ladder.
 
-    Returns one of ``oom`` / ``model`` / ``transient`` / ``fatal`` /
-    ``error``:
+    Returns one of ``oom`` / ``model_unavailable`` / ``transient`` /
+    ``fatal`` / ``error``:
 
     - ``oom``: device memory exhaustion (XLA RESOURCE_EXHAUSTED et al).
-    - ``model``: this node cannot load the model (missing/broken
-      checkpoint, quarantine) — breaker fodder.
+    - ``model_unavailable``: this node cannot load the model
+      (missing/broken checkpoint, quarantine) — breaker fodder, and a
+      hive-side redispatch signal (REDISPATCH_KINDS): other nodes may
+      hold the checkpoint this one lacks.
     - ``transient``: network-shaped (input-image fetch, 5xx upstream) —
       retried locally.
     - ``fatal``: the job's inputs are bad; no node can succeed, do not
@@ -111,7 +131,7 @@ def classify_exception(exc: BaseException) -> str:
     if any(marker in text for marker in _OOM_MARKERS):
         return "oom"
     if any(marker in str(exc) for marker in _MODEL_UNAVAILABLE_MARKERS):
-        return "model"
+        return "model_unavailable"
     names = {cls.__name__ for cls in type(exc).__mro__}
     if "HTTPError" in names:
         # requests.HTTPError subclasses OSError via RequestException, so
@@ -507,6 +527,185 @@ class DeadLetterSpool:
 
 
 # ---------------------------------------------------------------------------
+# checkpoint spool (ISSUE 6: step-boundary resume state)
+# ---------------------------------------------------------------------------
+
+
+class CheckpointSpool:
+    """Disk spool of in-flight job checkpoints — the resume-state twin of
+    the dead-letter spool, namespaced per worker the same way.
+
+    One JSON file per job id, overwritten in place as the job progresses
+    (lanes snapshot per-row state at step boundaries,
+    serving/stepper.py; the solo path records coarser phase markers).
+    The worker's heartbeat pushes the latest state to a lease-aware hive
+    (node/minihive.py) so a job redelivered after this worker dies
+    resumes at step k on a survivor instead of restarting at step 0.
+
+    Hygiene rules (ISSUE 6 satellite):
+
+    - files live under ``<root>/checkpoints/<worker name>/`` — two
+      workers sharing a settings root can never read (or garbage-
+      collect) each other's state;
+    - a corrupt snapshot is skipped LOUDLY: parked as ``.bad``, counted
+      in ``corrupt_skipped`` (mirrored to /metrics), never returned;
+    - the checkpoint of a completed job is garbage-collected the moment
+      its result upload is acked (node/worker.py::_deliver), and a
+      fresh startup clears leftovers wholesale — after a restart the
+      hive's pushed copy is the authority, not this spool.
+
+    Stdlib-only and thread-safe: lane driver threads save while the
+    event loop's heartbeat task loads.
+    """
+
+    def __init__(self, directory: Path | str) -> None:
+        self.directory = Path(directory)
+        self._lock = threading.Lock()
+        self.written = 0
+        self.corrupt_skipped = 0
+        # per-job write sequence (path name -> self.written at last save):
+        # the heartbeat's has-it-changed probe. File mtime is NOT usable
+        # for this — several saves can land within one timestamp tick on
+        # coarse-resolution filesystems, and an "unchanged" verdict there
+        # would leave a stale snapshot as the hive's resume authority.
+        self._versions: dict[str, int] = {}
+
+    def _path_for(self, job_id: Any) -> Path:
+        # digest of the FULL raw id, like DeadLetterSpool._path_for:
+        # sanitize+truncate alone lets distinct ids ("job 1"/"job_1", or
+        # two sharing an 80-char prefix) collide onto one file — and a
+        # collided checkpoint can resume the OTHER job's trajectory
+        raw = str(job_id or "job")
+        digest = hashlib.sha256(raw.encode("utf-8")).hexdigest()[:12]
+        name = re.sub(r"[^A-Za-z0-9._-]+", "_", raw)[:80]
+        return self.directory / f"{name}-{digest}.ckpt.json"
+
+    def save(self, job_id: Any, state: dict[str, Any]) -> Path:
+        payload = json.dumps(state, sort_keys=True)
+        path = self._path_for(job_id)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(payload, encoding="utf-8")
+        tmp.replace(path)
+        with self._lock:
+            self.written += 1
+            self._versions[path.name] = self.written
+        return path
+
+    def load(self, job_id: Any) -> dict[str, Any] | None:
+        path = self._path_for(job_id)
+        if not path.is_file():
+            return None
+        try:
+            return json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError) as exc:
+            log.error("corrupt checkpoint %s (%s); parking as .bad — the "
+                      "job restarts from scratch", path, exc)
+            with self._lock:
+                self.corrupt_skipped += 1
+            try:
+                path.replace(path.with_suffix(".json.bad"))
+            except OSError:
+                pass
+            return None
+
+    def version(self, job_id: Any) -> int | None:
+        """Monotone write sequence of a job's checkpoint, or None if
+        absent — the heartbeat's cheap has-it-changed probe, so unchanged
+        latent-sized snapshots are not re-read and re-pushed every beat.
+        A file this process never wrote (possible only with an external
+        ``checkpoint_dir``; startup clear() wipes our own leftovers)
+        reports 0, which still reads as "present"."""
+        path = self._path_for(job_id)
+        with self._lock:
+            seq = self._versions.get(path.name)
+        if seq is not None:
+            return seq
+        return 0 if path.is_file() else None
+
+    def discard(self, job_id: Any) -> None:
+        """GC on ack: the job settled, its resume state is garbage."""
+        path = self._path_for(job_id)
+        with self._lock:
+            self._versions.pop(path.name, None)
+        try:
+            path.unlink()
+        except FileNotFoundError:
+            pass
+        except OSError as exc:
+            log.warning("checkpoint GC for %s failed: %s", job_id, exc)
+
+    def clear(self) -> int:
+        """Startup hygiene: drop every leftover checkpoint — including
+        parked ``.bad`` corpses and orphaned ``.tmp`` files from a crash
+        mid-save, which would otherwise accumulate forever. The hive's
+        heartbeat-pushed copies are the resume authority across a
+        restart; stale local files would only shadow them."""
+        with self._lock:
+            self._versions.clear()
+        if not self.directory.is_dir():
+            return 0
+        removed = 0
+        for path in self.directory.glob("*.ckpt.json*"):
+            try:
+                path.unlink()
+                removed += 1
+            except OSError:
+                pass
+        if removed:
+            log.info("cleared %d stale checkpoint(s) from %s", removed,
+                     self.directory)
+        return removed
+
+    def depth(self) -> int:
+        if not self.directory.is_dir():
+            return 0
+        return sum(1 for _ in self.directory.glob("*.ckpt.json"))
+
+
+# the executor binds (spool, job id) for the duration of one job so
+# workload callbacks can record phase checkpoints without threading the
+# spool through every signature (the obs_trace.activate idiom)
+_CKPT_SCOPE: contextvars.ContextVar = contextvars.ContextVar(
+    "chiaswarm_checkpoint_scope", default=None)
+
+
+@contextlib.contextmanager
+def checkpoint_scope(spool: CheckpointSpool | None, job_id: Any):
+    """Bind ``phase_checkpoint`` to (spool, job_id) for this thread's
+    execution of one job (node/executor.py). A None spool (stub slots,
+    checkpointing disabled) makes the scope — and every
+    ``phase_checkpoint`` inside it — a no-op."""
+    if spool is None or job_id is None:
+        yield
+        return
+    token = _CKPT_SCOPE.set((spool, job_id))
+    try:
+        yield
+    finally:
+        _CKPT_SCOPE.reset(token)
+
+
+def phase_checkpoint(phase: str, **extra: Any) -> None:
+    """Record a coarse phase boundary for the current solo-path job
+    (encoded -> denoised, workloads/diffusion.py). Solo programs have no
+    step boundary to snapshot at — the marker records how far the job
+    got, so redelivery telemetry can distinguish "died cold" from "died
+    with the expensive denoise already done" (the finished-result case
+    is the dead-letter spool's job, not this one's)."""
+    scope = _CKPT_SCOPE.get()
+    if scope is None:
+        return
+    spool, job_id = scope
+    try:
+        spool.save(job_id, {"version": 1, "kind": "phase",
+                            "phase": str(phase), **extra})
+    except OSError as exc:  # durability must never fail the job
+        log.warning("phase checkpoint %r for %s failed: %s", phase,
+                    job_id, exc)
+
+
+# ---------------------------------------------------------------------------
 # counters
 # ---------------------------------------------------------------------------
 
@@ -519,6 +718,8 @@ _STAT_HELP = {
     "upload_retries": "result-upload attempts that failed and retried",
     "results_dead_lettered": "results spooled after exhausting uploads",
     "results_replayed": "dead-letter results replayed at startup",
+    "lease_heartbeats": "heartbeats delivered to a lease-aware hive",
+    "leases_lost": "in-flight jobs whose lease the hive reassigned",
 }
 
 
@@ -552,6 +753,8 @@ class ResilienceStats:
     upload_retries = _stat_property("upload_retries")
     results_dead_lettered = _stat_property("results_dead_lettered")
     results_replayed = _stat_property("results_replayed")
+    lease_heartbeats = _stat_property("lease_heartbeats")
+    leases_lost = _stat_property("leases_lost")
 
     def __init__(self, registry: Any = None) -> None:
         from chiaswarm_tpu.obs.metrics import Registry

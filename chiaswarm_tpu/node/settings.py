@@ -98,6 +98,14 @@ class Settings:
     result_drain_timeout_s: float = 20.0  # shutdown: upload-queue drain
     dead_letter_dir: str = ""           # default <settings root>/dead_letter
     install_signal_handlers: bool = True  # SIGTERM/SIGINT -> graceful stop
+    # ---- fleet / lease participation (node/minihive.py) ----
+    # >0: POST /api/heartbeat every N seconds with the in-flight job ids
+    # and their latest resume checkpoints, so a lease-aware hive keeps
+    # this worker's leases alive and can redeliver-with-resume if the
+    # worker dies. The reference hive has no heartbeat endpoint — leave
+    # 0 there (its timeout detector stays the only failure story).
+    heartbeat_s: float = 0.0
+    checkpoint_dir: str = ""            # default <root>/checkpoints/<worker>
 
     def deadline_for(self, workflow: str | None) -> float:
         """Execution budget (seconds) for one job of ``workflow`` (None /

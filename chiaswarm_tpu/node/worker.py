@@ -67,6 +67,7 @@ from chiaswarm_tpu.node.resilience import (
     RETRYABLE_KINDS,
     Backoff,
     BreakerBoard,
+    CheckpointSpool,
     DeadLetterSpool,
     ResilienceStats,
     backoff_delay,
@@ -92,6 +93,10 @@ def _burst_key(job: dict) -> tuple | None:
     soon as each job finishes."""
     if job.get("workflow") not in (None, "", "txt2img", "img2img",
                                    "inpaint"):
+        return None
+    if job.get("resume") is not None:
+        # a redelivered job with resume state rides a lane (or runs
+        # solo); coalescing it with fresh jobs would discard the resume
         return None
     model = str(job.get("model_name", ""))
     if model.startswith("DeepFloyd/") or "pix2pix" in model:
@@ -214,6 +219,36 @@ class Worker:
             on_probe=getattr(self.registry, "unquarantine", None),
             persist_path=self._breaker_state_path())
         self.dead_letters = DeadLetterSpool(self._dead_letter_dir())
+        # ---- fleet durability (ISSUE 6) ----
+        # resume-state spool next to the dead-letter spool (same
+        # per-worker namespacing); lanes snapshot into it via the slot
+        # handle, heartbeats push its latest entries to a lease-aware
+        # hive, and an acked upload garbage-collects the job's file.
+        # Only the heartbeat ever delivers a checkpoint anywhere (the
+        # spool is wholesale-cleared at startup), so with heartbeats off
+        # — the reference-hive default — the spool is never attached and
+        # lanes/solo jobs pay no snapshot cost for state nothing reads.
+        self.checkpoints = CheckpointSpool(self._checkpoint_dir())
+        if float(self.settings.heartbeat_s or 0) > 0:
+            for slot in self.pool:
+                try:
+                    slot._checkpoint_spool = self.checkpoints
+                except (AttributeError, TypeError):  # exotic slot stubs
+                    pass
+        # jobs between poll receipt and settled upload — the id set the
+        # heartbeat keeps leased (insertion-ordered for stable payloads)
+        self._inflight: dict[Any, float] = {}
+
+    def _spool_dirname(self) -> str:
+        return re.sub(r"[^A-Za-z0-9._-]+", "_",
+                      self.settings.worker_name or "worker")
+
+    def _checkpoint_dir(self) -> Path:
+        if self.settings.checkpoint_dir:
+            return Path(self.settings.checkpoint_dir).expanduser()
+        from chiaswarm_tpu.node.settings import settings_root
+
+        return settings_root() / "checkpoints" / self._spool_dirname()
 
     def _breaker_state_path(self) -> Path:
         spool = self._dead_letter_dir()
@@ -228,9 +263,7 @@ class Worker:
         # namespaced by worker name: hermetic test workers (and multiple
         # workers sharing one settings root) must never replay — and then
         # DELETE — each other's spooled results
-        name = re.sub(r"[^A-Za-z0-9._-]+", "_",
-                      self.settings.worker_name or "worker")
-        return settings_root() / "dead_letter" / name
+        return settings_root() / "dead_letter" / self._spool_dirname()
 
     def _default_pool(self) -> ChipPool:
         """One slot over all chips. An explicit ``mesh_shape`` setting
@@ -334,6 +367,10 @@ class Worker:
     async def run(self) -> None:
         self.startup()
         self._replay_dead_letters()
+        # stale resume state from a previous run is superseded by the
+        # hive's heartbeat-pushed copies (a redelivered job arrives WITH
+        # its resume payload); leftovers would only shadow them
+        self.checkpoints.clear()
         # bind the health endpoint BEFORE spawning workers: a port clash
         # must fail fast, not leave unsupervised poll/slot tasks running
         health_runner = await self._start_health_server()
@@ -347,6 +384,12 @@ class Worker:
                                           name="results")
         poll_task = asyncio.create_task(self._poll_loop(), name="poll")
         tasks = slot_tasks + [result_task, poll_task]
+        if float(self.settings.heartbeat_s or 0) > 0:
+            # heartbeats outlive the poll loop on purpose: they keep the
+            # leases of draining in-flight jobs alive until the final
+            # task cancellation below
+            tasks.append(asyncio.create_task(self._heartbeat_loop(),
+                                             name="heartbeat"))
         try:
             await self._stop.wait()
             await self._shutdown(poll_task, slot_tasks, result_task)
@@ -418,6 +461,7 @@ class Worker:
             # same settling as _deliver's cancelled-upload path: a job
             # dead-lettered by shutdown still counts in jobs_total and
             # leaves its trace in the ring
+            self._settle_inflight(result)
             self._finish_trace(trace, result, settled="dead_letter")
             self.result_queue.task_done()
 
@@ -441,6 +485,11 @@ class Worker:
             "breakers": self.breakers.states(),
             "dead_letter_depth": self.dead_letters.depth(),
             "poll_consecutive_errors": self._poll_backoff.failures,
+            # fleet durability (ISSUE 6): resume-state spool + lease view
+            "checkpoint_depth": self.checkpoints.depth(),
+            "checkpoints_written": self.checkpoints.written,
+            "checkpoints_corrupt_skipped": self.checkpoints.corrupt_skipped,
+            "inflight_jobs": len(self._inflight),
         }
         data.update(self.stats.snapshot())
         data["stepper"] = self._stepper_health()
@@ -483,6 +532,19 @@ class Worker:
         m.gauge("chiaswarm_poll_consecutive_errors",
                 "current poll-loop error streak (drives the backoff)").set(
             self._poll_backoff.failures)
+        # fleet durability (ISSUE 6): checkpoint spool + lease signals
+        m.gauge("chiaswarm_checkpoint_depth",
+                "in-flight resume checkpoints on disk").set(
+            self.checkpoints.depth())
+        m.counter("chiaswarm_checkpoints_written_total",
+                  "lane/phase resume checkpoints written").set_to(
+            self.checkpoints.written)
+        m.counter("chiaswarm_checkpoints_corrupt_total",
+                  "corrupt checkpoint files skipped loudly").set_to(
+            self.checkpoints.corrupt_skipped)
+        m.gauge("chiaswarm_inflight_jobs",
+                "jobs between poll receipt and settled upload (the "
+                "lease-heartbeat set)").set(len(self._inflight))
         state_code = {"closed": 0, "half_open": 1, "open": 2}
         breaker_state = m.gauge(
             "chiaswarm_breaker_state",
@@ -499,7 +561,9 @@ class Worker:
         counters = ("steps_executed", "rows_admitted",
                     "rows_admitted_midflight", "rows_completed",
                     "rows_expired", "rows_failed", "lanes_created",
-                    "lanes_failed", "row_steps_active", "row_steps_padded")
+                    "lanes_failed", "row_steps_active", "row_steps_padded",
+                    "rows_resumed", "resumes_rejected",
+                    "checkpoints_written")
         for key in counters:
             m.counter(f"chiaswarm_stepper_{key}_total",
                       f"step scheduler: cumulative {key}").set_to(
@@ -610,22 +674,128 @@ class Worker:
         self._poll_backoff.reset()
         poll_http_s = time.perf_counter() - t_poll
         for job in jobs:
+            if job.get("id") in self._inflight:
+                # a lease-aware hive's starvation valve can redeliver a
+                # job BACK to the worker still running it (every other
+                # worker excluded). Running a second local copy would
+                # orphan the heartbeat coverage of whichever copy
+                # outlives the first settle (single id-keyed _inflight
+                # entry) and churn the lease forever — drop the
+                # duplicate; heartbeats re-hold the new lease and the
+                # first run's upload settles it
+                log.warning("job %s redelivered here while still in "
+                            "flight; dropping the duplicate copy",
+                            job.get("id"))
+                self._inflight[job.get("id")] = time.monotonic()
+                continue
             log.info("got job %s", job.get("id"))
             # the job's trace is born at hive receipt; its "poll" phase
             # covers the queue wait until a slot picks the job up (the
             # HTTP fetch itself rides as metadata — it served the whole
-            # poll, not this one job)
+            # poll, not this one job). Redelivered jobs carry their
+            # lineage: delivery attempt + the checkpoint step they
+            # resume from (lease-aware hives, node/minihive.py).
+            resume = job.get("resume")
             trace = obs_trace.JobTrace(
                 "job", id=job.get("id"),
                 model=str(job.get("model_name") or ""),
                 workflow=str(job.get("workflow") or ""),
-                worker=self.settings.worker_name)
+                worker=self.settings.worker_name,
+                attempt=job.get("attempt") or 1,
+                resume_step=(resume.get("step", 0)
+                             if isinstance(resume, dict) else 0))
             trace.phase("poll", http_s=round(poll_http_s, 6))
             obs_trace.attach(job, trace)
+            self._inflight[job.get("id")] = time.monotonic()
             await self.work_queue.put(job)
         if jobs:
             return float(self.settings.poll_busy_s)
         return float(self.settings.poll_idle_s)
+
+    async def _heartbeat_loop(self) -> None:
+        """Lease keep-alive (ISSUE 6): every ``heartbeat_s``, tell the
+        hive which jobs are in flight here and push their latest resume
+        checkpoints (node/resilience.py spool; lanes write it at step
+        boundaries). A hive that reassigned one of our leases answers
+        with the lost ids — the local run keeps going (its result is
+        deduped hive-side; first upload wins either way), but the loss
+        is counted and logged so operators see lease churn."""
+        interval = float(self.settings.heartbeat_s)
+        pushed: dict[Any, int] = {}  # job id -> spool version last pushed
+        # leases the hive already told us it reassigned: count + warn
+        # ONCE per loss, not once per beat for as long as the local run
+        # keeps going (a 60s job at heartbeat_s=0.1 would otherwise
+        # inflate leases_lost ~600x for a single reassignment)
+        lost_reported: set[str] = set()
+
+        def build_jobs(ids: list) -> list[dict]:
+            # runs in a thread: checkpoint files are latent-sized, and a
+            # synchronous read+parse per job per beat would stall the
+            # event loop (polls, uploads, the health server). A None
+            # checkpoint means "unchanged since my last beat" — the hive
+            # keeps its stored copy, so skipping the re-push is free.
+            jobs = []
+            for job_id in ids:
+                version = self.checkpoints.version(job_id)
+                if (version is None or pushed.get(job_id) == version
+                        or str(job_id) in lost_reported):
+                    # a lost lease's checkpoint custody moved with the
+                    # lease — the hive would reject the push as stale
+                    jobs.append({"id": job_id, "checkpoint": None})
+                    continue
+                checkpoint = self.checkpoints.load(job_id)
+                if checkpoint is not None:
+                    pushed[job_id] = version
+                jobs.append({"id": job_id, "checkpoint": checkpoint})
+            return jobs
+
+        async with aiohttp.ClientSession() as session:
+            while True:
+                await asyncio.sleep(interval)
+                if self._stop.is_set() and not self._inflight:
+                    return
+                if not self._inflight:
+                    pushed.clear()
+                    lost_reported.clear()
+                    continue
+                inflight = list(self._inflight)
+                for job_id in [j for j in pushed if j not in self._inflight]:
+                    pushed.pop(job_id, None)
+                lost_reported &= {str(j) for j in inflight}
+                payload = {
+                    "worker_name": self.settings.worker_name,
+                    "jobs": await asyncio.to_thread(build_jobs, inflight),
+                }
+                try:
+                    response = await self.hive.post_heartbeat(session,
+                                                              payload)
+                    # a malformed 2xx body (non-dict JSON, non-list
+                    # "lost") counts as a failed beat, NOT a loop exit:
+                    # one bad proxy answer must never kill the keep-alive
+                    # for the rest of the process lifetime
+                    lost_raw = response.get("lost") or []
+                    if not isinstance(lost_raw, list):
+                        raise TypeError("non-list 'lost' in heartbeat "
+                                        f"response: {lost_raw!r}")
+                    reported = {str(j) for j in lost_raw}
+                except Exception as exc:
+                    # reference hives have no heartbeat endpoint, and a
+                    # partitioned hive is exactly when we keep beating
+                    log.debug("heartbeat failed: %s", exc)
+                    continue
+                self.stats.lease_heartbeats += 1
+                reported &= {str(j) for j in inflight}
+                lost = sorted(reported - lost_reported)
+                # REPLACE, don't accumulate: a job the hive stops
+                # reporting lost was re-leased to us (starvation-valve
+                # redelivery back to this worker) — checkpoint custody
+                # returns, pushes resume, and a future loss warns anew
+                lost_reported = reported
+                if lost:
+                    self.stats.leases_lost += len(lost)
+                    log.warning("hive reassigned lease(s) for %s; local "
+                                "work continues, upload will dedupe",
+                                lost)
 
     async def _next_job(self) -> dict | None:
         """Block for the next queued job; returns None once the worker is
@@ -944,6 +1114,10 @@ class Worker:
         for replay on the next startup."""
         trace = obs_trace.detach(result)  # must never reach json.dumps
         spooled = result.pop("_dead_letter_path", None)
+        # lease attribution: a lease-aware hive partitions faults per
+        # worker and dedupes redelivery races by uploader; the reference
+        # hive ignores the extra field
+        result.setdefault("worker_name", self.settings.worker_name)
         if trace is not None:
             trace.phase("upload")
         try:
@@ -954,17 +1128,27 @@ class Worker:
             if spooled is None:
                 self.dead_letters.spool(result)
                 self.stats.results_dead_lettered += 1
+            self._settle_inflight(result)
             self._finish_trace(trace, result, settled="dead_letter")
             raise
         if uploaded:
             if spooled is not None:
                 self.dead_letters.discard(spooled)
+            # GC on ack (ISSUE 6 satellite): the job settled, its resume
+            # checkpoint is stale by definition
+            self.checkpoints.discard(result.get("id"))
         elif spooled is None:
             self.dead_letters.spool(result)
             self.stats.results_dead_lettered += 1
         # a replayed result that failed again keeps its existing file
+        self._settle_inflight(result)
         self._finish_trace(trace, result,
                            settled="uploaded" if uploaded else "dead_letter")
+
+    def _settle_inflight(self, result: dict) -> None:
+        """The job left this worker's hands (uploaded or dead-lettered):
+        stop heartbeating its lease."""
+        self._inflight.pop(result.get("id"), None)
 
     def _finish_trace(self, trace, result: dict, settled: str) -> None:
         """Close a job's span tree, publish it to the worker's trace

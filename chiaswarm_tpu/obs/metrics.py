@@ -361,5 +361,28 @@ def lane_occupancy_histogram(registry: Registry | None = None) -> Histogram:
         labelnames=("width",),
         buckets=OCCUPANCY_BUCKETS)
 
+#: resume-step buckets: pow2 over the step-capacity lattice
+#: (core/compile_cache.py bucket_steps caps at 128)
+RESUME_STEP_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+def resume_step_histogram(registry: Registry | None = None) -> Histogram:
+    """Step index at which redelivered rows splice back into a lane
+    (ISSUE 6), observed by serving/stepper.py at admission.
+
+    THE fleet-invariant proof signal: a redelivered job that resumed
+    records step >= 1 here (and in its result's
+    ``pipeline_config.stepper.resume_step``); a distribution stuck at
+    low steps means leases expire faster than the checkpoint cadence
+    (``CHIASWARM_STEPPER_CKPT_EVERY``) can push progress — lengthen the
+    lease or tighten the cadence. Unlabeled: lane identity would leak
+    unbounded series (same cardinality rule as the occupancy family)."""
+    return (registry or REGISTRY).histogram(
+        "chiaswarm_stepper_resume_step",
+        "step index at which resumed (redelivered) rows spliced into "
+        "a lane",
+        buckets=RESUME_STEP_BUCKETS)
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

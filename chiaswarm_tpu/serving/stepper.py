@@ -27,17 +27,37 @@ carry their own in-lane deadline; an OOM'd lane additionally halves the
 lane width it will rebuild with. ``drain``/``shutdown`` retire lanes
 cleanly on worker stop.
 
-Knobs (operator guide: README "Continuous batching"):
+Fleet durability (ISSUE 6): when the owning worker attaches a
+checkpoint spool to the slot (``slot._checkpoint_spool``,
+node/worker.py), each lane snapshots every resident job's per-row state
+— latents, carry PRNG keys, multistep history, step index — at step
+boundaries, every ``CHIASWARM_STEPPER_CKPT_EVERY`` steps. The worker's
+heartbeat pushes the latest snapshot to a lease-aware hive
+(node/minihive.py); a job redelivered after this worker dies arrives
+with a ``resume`` payload and splices into a lane at step k through the
+SAME mid-flight admission path fresh jobs use — restored rows walk the
+identical solo trajectory from step k because keys/latents/history are
+bit-exact.
+
+Knobs (operator guide: README "Continuous batching" and "Fleet
+operations"):
 
 - ``CHIASWARM_STEPPER=1``  enable lane routing (default off)
 - ``CHIASWARM_STEPPER_LANE_WIDTH``  rows per lane (default: the slot's
   data width x the measured per-chip profitable batch, pow2-bucketed)
 - ``CHIASWARM_STEPPER_ROW_DEADLINE_S``  per-row in-lane deadline (600)
 - ``CHIASWARM_STEPPER_IDLE_S``  idle grace before a lane retires (15)
+- ``CHIASWARM_STEPPER_CKPT_EVERY``  steps between lane checkpoints
+  (default 8; 0 disables — each snapshot costs one device->host copy
+  of the lane state)
+- ``CHIASWARM_STEPPER_STEP_DELAY_S``  artificial per-step delay
+  (chaos/test seam: stretches lane wall time so fleet faults can land
+  deterministically mid-lane; keep 0 in production)
 """
 
 from __future__ import annotations
 
+import base64
 import collections
 import dataclasses
 import logging
@@ -49,7 +69,11 @@ from typing import Any
 
 import numpy as np
 
-from chiaswarm_tpu.obs.metrics import REGISTRY, lane_occupancy_histogram
+from chiaswarm_tpu.obs.metrics import (
+    REGISTRY,
+    lane_occupancy_histogram,
+    resume_step_histogram,
+)
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
 
@@ -74,12 +98,48 @@ _LANE_ADMIT_SECONDS = REGISTRY.histogram(
 # distribution over time, where /healthz's lane_occupancy is only the
 # lifetime average
 _LANE_OCCUPANCY = lane_occupancy_histogram()
+# resume telemetry (ISSUE 6): which step redelivered rows splice back in
+# at — the fleet-level proof that redelivery resumes instead of
+# restarting (obs/metrics.py documents the tuning story)
+_RESUME_STEP = resume_step_histogram()
+_CKPT_SECONDS = REGISTRY.histogram(
+    "chiaswarm_stepper_checkpoint_seconds",
+    "wall time of one lane checkpoint snapshot (device->host + spool)",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
 
 ENV_ENABLE = "CHIASWARM_STEPPER"
 ENV_LANE_WIDTH = "CHIASWARM_STEPPER_LANE_WIDTH"
 ENV_ROW_DEADLINE = "CHIASWARM_STEPPER_ROW_DEADLINE_S"
 ENV_IDLE_S = "CHIASWARM_STEPPER_IDLE_S"
 ENV_SHARD_ROWS = "CHIASWARM_STEPPER_SHARD_ROWS"
+ENV_CKPT_EVERY = "CHIASWARM_STEPPER_CKPT_EVERY"
+ENV_STEP_DELAY = "CHIASWARM_STEPPER_STEP_DELAY_S"
+
+
+# ---- resume-state packing ------------------------------------------------
+#
+# Checkpoints must survive JSON serialization end to end: spool file ->
+# heartbeat body -> hive store -> redelivered job payload. Arrays ride
+# as base64 raw bytes + dtype/shape — exact (bit-for-bit, no float
+# round-trip through decimal), compact enough for latent-sized state.
+
+
+def pack_array(arr: Any) -> dict[str, Any]:
+    a = np.ascontiguousarray(np.asarray(arr))
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "b64": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def unpack_array(spec: dict[str, Any]) -> np.ndarray:
+    a = np.frombuffer(base64.b64decode(spec["b64"]),
+                      dtype=np.dtype(str(spec["dtype"])))
+    return a.reshape([int(s) for s in spec["shape"]]).copy()
+
+
+class ResumeReject(RuntimeError):
+    """The resume payload does not match this job (wrong shape/steps,
+    corrupt arrays): the job restarts from step 0 — losing progress is
+    acceptable, resuming onto the WRONG trajectory is not."""
 
 
 def stepper_enabled() -> bool:
@@ -124,6 +184,11 @@ class _RowJob:                    # must never compare device/numpy fields
     future: Future = dataclasses.field(default_factory=Future)
     admitted_at_step: int = -1
     slots: list[int] = dataclasses.field(default_factory=list)
+    # redelivered-job resume (ISSUE 6): rows splice in at step
+    # ``resume_step`` with restored latents/keys and the multistep
+    # history ``old0`` instead of freshly drawn noise at step 0
+    resume_step: int = 0
+    old0: Any = None
 
 
 class Lane:
@@ -162,6 +227,14 @@ class Lane:
         self._mesh = None
         self._deferred_counts: list[dict] = []
         self._window: collections.deque = collections.deque()
+        # step-boundary resume snapshots (ISSUE 6): only when the owning
+        # worker attached its checkpoint spool to the slot
+        self._spool = getattr(getattr(sched, "slot", None),
+                              "_checkpoint_spool", None)
+        self._ckpt_every = int(
+            os.environ.get(ENV_CKPT_EVERY, "8") or 8)
+        self._step_delay = float(
+            os.environ.get(ENV_STEP_DELAY, "0") or 0)
         # retired rows whose async decode is still in flight: the future
         # resolves only once the images are RESIDENT (same cross-thread
         # hazard as admission — the consumer must never read an array
@@ -235,6 +308,7 @@ class Lane:
                 self._sched._maybe_fault(self)
                 self._dispatch_step()
                 self._retire_rows()
+                self._maybe_checkpoint()
                 self._flush_handoff(block=not self._h_active.any())
         except BaseException as exc:  # noqa: BLE001 — containment seam
             self._fail_all(exc)
@@ -341,7 +415,7 @@ class Lane:
             # results when a program consumes another thread's still-
             # compiling outputs, so the barrier is correctness, not style.
             for arr in (job.x0, job.keys0, job.ctx_u, job.ctx_c,
-                        job.pooled_u, job.pooled_c):
+                        job.pooled_u, job.pooled_c, job.old0):
                 if arr is not None:
                     arr.block_until_ready()
             slots, free = free[:job.n_rows], free[job.n_rows:]
@@ -352,15 +426,19 @@ class Lane:
             dev = self._dev
             dev["x"] = dev["x"].at[sel].set(job.x0)
             dev["keys"] = dev["keys"].at[sel].set(job.keys0)
+            # a resumed row restores its multistep history and rejoins
+            # at step k; a fresh row starts clean at step 0 — both
+            # through the one admission path (the step program never
+            # knows the difference)
             dev["old"] = dev["old"].at[sel].set(
-                jnp.zeros_like(job.x0))
-            dev["idx"] = dev["idx"].at[sel].set(0)
+                jnp.zeros_like(job.x0) if job.old0 is None else job.old0)
+            dev["idx"] = dev["idx"].at[sel].set(job.resume_step)
             dev["ctx_u"] = dev["ctx_u"].at[sel].set(job.ctx_u)
             dev["ctx_c"] = dev["ctx_c"].at[sel].set(job.ctx_c)
             if job.pooled_u is not None:
                 dev["pooled_u"] = dev["pooled_u"].at[sel].set(job.pooled_u)
                 dev["pooled_c"] = dev["pooled_c"].at[sel].set(job.pooled_c)
-            self._h_idx[sel] = 0
+            self._h_idx[sel] = job.resume_step
             self._h_start[sel] = 0
             self._h_sig[sel, :] = 0.0
             self._h_sig[sel, : job.steps + 1] = job.sigmas
@@ -380,7 +458,13 @@ class Lane:
             self._deferred_counts.append(dict(
                 rows_admitted=job.n_rows,
                 rows_admitted_midflight=(job.n_rows if mid_flight
-                                         else 0)))
+                                         else 0),
+                rows_resumed=(job.n_rows if job.resume_step > 0 else 0)))
+            if job.resume_step > 0:
+                _RESUME_STEP.observe(job.resume_step)
+                log.info("job %s resumed at step %d/%d (%d row(s))",
+                         job.job_id, job.resume_step, job.steps,
+                         job.n_rows)
 
     def _dispatch_step(self) -> None:
         dev = self._dev
@@ -410,6 +494,8 @@ class Lane:
         self._window.append(dev["x"])
         if len(self._window) > 2:
             self._window.popleft().block_until_ready()
+        if self._step_delay > 0:  # chaos seam: stretch lane wall time
+            time.sleep(self._step_delay)
         _STEP_SECONDS.observe(time.perf_counter() - t0)
 
     def _retire_rows(self) -> None:
@@ -457,6 +543,9 @@ class Lane:
                 "lane_width": self.width,
                 "admitted_at_step": job.admitted_at_step,
                 "steps_executed": self.steps_executed,
+                # the fleet-invariant proof point: >0 means this job was
+                # redelivered and resumed mid-trajectory, not restarted
+                "resume_step": job.resume_step,
             }))
         for job in expired:
             self._release_rows(job)
@@ -469,6 +558,53 @@ class Lane:
         if changed:
             with self._cond:
                 self._cond.notify_all()
+
+    def _maybe_checkpoint(self) -> None:
+        """Snapshot every resident job's per-row state to the worker's
+        checkpoint spool at this step boundary (every ``_ckpt_every``
+        steps). The snapshot is exact resume state: latents, carry PRNG
+        keys, and multistep history at step k — restored rows continue
+        on the bit-identical solo trajectory. Runs in the driver thread,
+        so the device->host reads only stall THIS lane's pipeline (by
+        one window drain), never the submitters."""
+        if (self._spool is None or self._ckpt_every <= 0
+                or self._dev is None):
+            return
+        if self.steps_executed % self._ckpt_every:
+            return
+        jobs = {id(j): j for j in self._rows if j is not None}
+        if not jobs:
+            return
+        t0 = time.perf_counter()
+        # one transfer for the whole lane, sliced per job below
+        x = np.asarray(self._dev["x"])
+        keys = np.asarray(self._dev["keys"])
+        old = np.asarray(self._dev["old"])
+        written = 0
+        for job in jobs.values():
+            sel = list(job.slots)
+            step = int(self._h_idx[sel[0]])
+            if step <= 0 or step >= job.steps:
+                continue  # nothing to resume yet / rows about to retire
+            state = {
+                "version": 1, "kind": "lane",
+                "step": step, "steps": int(job.steps),
+                "rows": int(job.n_rows),
+                "height": int(self.height), "width": int(self.width_px),
+                "guidance": float(job.guidance),
+                "x": pack_array(x[sel]),
+                "keys": pack_array(keys[sel]),
+                "old": pack_array(old[sel]),
+            }
+            try:
+                self._spool.save(job.job_id, state)
+                written += 1
+            except OSError as exc:  # durability never fails the lane
+                log.warning("checkpoint for job %s failed: %s",
+                            job.job_id, exc)
+        if written:
+            self._sched._count(checkpoints_written=written)
+            _CKPT_SECONDS.observe(time.perf_counter() - t0)
 
     def _flush_handoff(self, block: bool) -> None:
         """Resolve retired rows whose decoded images are resident. With
@@ -579,11 +715,19 @@ class StepScheduler:
                        rows: int = 1, seed: int = 0,
                        scheduler: str | None = None,
                        deadline_s: float | None = None,
-                       job_id: Any = None) -> Future:
+                       job_id: Any = None,
+                       resume: dict[str, Any] | None = None) -> Future:
         """Prepare a job's rows (tokenize, encode, ladder, initial noise)
         and hand them to the matching lane. Returns a Future resolving to
         ``(PendingImages, lane_info)``; raises :class:`LaneReject` when
-        the job cannot ride a lane."""
+        the job cannot ride a lane.
+
+        ``resume`` (a lane checkpoint from a redelivered job) replaces
+        the fresh-noise prologue with the snapshotted latents, keys, and
+        multistep history, splicing the rows in at the recorded step. An
+        invalid/corrupt payload is rejected LOUDLY and the job restarts
+        at step 0 — progress is expendable, trajectory integrity is
+        not."""
         import jax
         import jax.numpy as jnp
 
@@ -622,6 +766,20 @@ class StepScheduler:
         sig = np.asarray(sched.sigmas, np.float32)
         ts = np.asarray(sched.timesteps, np.float32)
 
+        resume_step = 0
+        restored = None
+        if resume is not None:
+            try:
+                resume_step, restored = self._validate_resume(
+                    pipe, resume, steps=steps, rows=rows,
+                    height=height, width=width,
+                    guidance=float(guidance_scale))
+            except ResumeReject as exc:
+                log.error("resume state for job %s rejected (%s); "
+                          "restarting at step 0", job_id, exc)
+                self._count(resumes_rejected=1)
+                resume_step, restored = 0, None
+
         t_prep = time.perf_counter()
         with span("encode", rows=rows, steps=steps), \
                 annotate("swarm.lane.encode"):
@@ -632,15 +790,25 @@ class StepScheduler:
                    pipe._tokenize([negative_prompt or ""] * eb)]
             ctx_u, ctx_c, pooled_u, pooled_c = pipe.stepper_encode_fn(
                 batch=eb)(pipe.c.params, ids, neg)
-            # per-row noise keys: fold the row index into the job's seed
-            # — exactly the solo program's key derivation, so every row
-            # matches its solo run bit-for-bit in key space
-            keys = jnp.stack([jax.random.fold_in(key_for_seed(int(seed)), r)
-                              for r in range(rows)] +
-                             [key_for_seed(int(seed))] * (eb - rows))
-            carry, x0 = pipe.stepper_row_init_fn(
-                batch=eb, height=height, width=width)(keys,
-                                                      jnp.float32(sig[0]))
+            if restored is not None:
+                # redelivered rows: the context re-encodes (it is a pure
+                # function of the prompt), but latents/keys/history come
+                # back exactly as the dead worker checkpointed them
+                carry_rows = jnp.asarray(restored["keys"])
+                x0_rows = jnp.asarray(restored["x"])
+                old_rows = jnp.asarray(restored["old"])
+            else:
+                # per-row noise keys: fold the row index into the job's
+                # seed — exactly the solo program's key derivation, so
+                # every row matches its solo run bit-for-bit in key space
+                keys = jnp.stack(
+                    [jax.random.fold_in(key_for_seed(int(seed)), r)
+                     for r in range(rows)] +
+                    [key_for_seed(int(seed))] * (eb - rows))
+                carry, x0 = pipe.stepper_row_init_fn(
+                    batch=eb, height=height, width=width)(
+                        keys, jnp.float32(sig[0]))
+                carry_rows, x0_rows, old_rows = carry[:rows], x0[:rows], None
         _LANE_ADMIT_SECONDS.observe(time.perf_counter() - t_prep)
         job = _RowJob(
             job_id=job_id, n_rows=rows, steps=steps,
@@ -648,11 +816,74 @@ class StepScheduler:
             ctx_u=ctx_u[:rows], ctx_c=ctx_c[:rows],
             pooled_u=None if pooled_u is None else pooled_u[:rows],
             pooled_c=None if pooled_c is None else pooled_c[:rows],
-            keys0=carry[:rows], x0=x0[:rows],
+            keys0=carry_rows, x0=x0_rows,
+            resume_step=resume_step, old0=old_rows,
             deadline=time.monotonic() + (deadline_s if deadline_s is not None
                                          else self.row_deadline_s()))
         self._enqueue(key, pipe, job, lane_rows, height, width, cap, sampler)
         return job.future
+
+    def _validate_resume(self, pipe, resume: dict[str, Any], *,
+                         steps: int, rows: int, height: int, width: int,
+                         guidance: float) -> tuple[int, dict[str, np.ndarray]]:
+        """Check a redelivered job's checkpoint against the job it claims
+        to resume; returns (step, restored host arrays) or raises
+        :class:`ResumeReject`. Every field is hostile until proven
+        consistent — the payload crossed two serializations and a worker
+        death."""
+        if resume.get("kind") != "lane":
+            raise ResumeReject(
+                f"not a lane checkpoint (kind={resume.get('kind')!r})")
+        try:
+            step = int(resume["step"])
+            ck_steps = int(resume["steps"])
+            ck_rows = int(resume["rows"])
+            ck_h, ck_w = int(resume["height"]), int(resume["width"])
+            ck_guidance = float(resume["guidance"])
+            x = unpack_array(resume["x"])
+            keys = unpack_array(resume["keys"])
+            old = unpack_array(resume["old"])
+        except (KeyError, TypeError, ValueError) as exc:
+            raise ResumeReject(f"corrupt payload: {exc}") from exc
+        if not 0 < step < steps:
+            raise ResumeReject(f"step {step} outside (0, {steps})")
+        if (ck_steps, ck_rows) != (steps, rows):
+            raise ResumeReject(
+                f"job mismatch: checkpoint is {ck_rows} row(s) x "
+                f"{ck_steps} step(s), job wants {rows} x {steps}")
+        if (ck_h, ck_w) != (height, width):
+            raise ResumeReject(
+                f"size mismatch: checkpoint {ck_h}x{ck_w}, "
+                f"job {height}x{width}")
+        if ck_guidance != guidance:
+            # latents stepped so far under a DIFFERENT guidance would
+            # finish under this job's and deliver the wrong image as a
+            # success — a mixed-up checkpoint must restart clean instead
+            raise ResumeReject(
+                f"guidance mismatch: checkpoint {ck_guidance}, "
+                f"job {guidance}")
+        lh, lw = pipe._latent_hw(height, width)
+        ch = pipe.c.family.vae.latent_channels
+        if x.shape != (rows, lh, lw, ch) or old.shape != x.shape:
+            raise ResumeReject(
+                f"latent shape {x.shape} != {(rows, lh, lw, ch)}")
+        if x.dtype != np.float32 or old.dtype != np.float32:
+            raise ResumeReject(
+                f"latent dtype {x.dtype}/{old.dtype}, lanes carry float32")
+        # the per-row carry keys must match the lane's key template in
+        # FULL shape and dtype: a (rows,)-shaped or wrong-dtype keys
+        # array would pass a first-axis check here only to explode
+        # inside lane admission, where _fail_all takes every co-resident
+        # job down with it
+        from chiaswarm_tpu.core.rng import key_for_seed
+
+        template = np.asarray(key_for_seed(0))
+        if keys.shape != (rows,) + template.shape or \
+                keys.dtype != template.dtype:
+            raise ResumeReject(
+                f"key array {keys.dtype}{keys.shape} != expected "
+                f"{template.dtype}{(rows,) + template.shape}")
+        return step, {"x": x, "keys": keys, "old": old}
 
     def _enqueue(self, key, pipe, job, lane_rows, height, width, cap,
                  sampler) -> None:

@@ -19,6 +19,7 @@ import numpy as np
 
 from chiaswarm_tpu.node.output_processor import OutputProcessor
 from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.node.resilience import phase_checkpoint
 from chiaswarm_tpu.obs.trace import span
 from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
 
@@ -120,9 +121,20 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                                    if image_guidance_scale is not None
                                    else 1.5),
     )
+    # coarse phase checkpoints (ISSUE 6): the solo program has no step
+    # boundary to snapshot at (encode/denoise/decode fuse into one
+    # dispatch), so the spool records phase markers instead — "encoded"
+    # once the model is bound and inputs are staged, "denoised" once the
+    # expensive generation finished. A redelivered solo job restarts its
+    # phase; the marker tells the fleet telemetry (and the operator) how
+    # much chip time the death cost. Lane-riding jobs get real
+    # step-boundary resume instead (serving/stepper.py).
+    phase_checkpoint("encoded", model=str(model_name))
     t0 = time.perf_counter()
     images, config = pipe(req)
     elapsed = time.perf_counter() - t0
+    phase_checkpoint("denoised", model=str(model_name),
+                     generation_s=round(elapsed, 3))
 
     if upscale:
         # x2 latent upscale pass over the generated images, 20 steps at
@@ -264,6 +276,15 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
     guidance = kwargs.get("guidance_scale")
     guidance = 7.5 if guidance is None else float(guidance)
     rows = max(1, int(kwargs.get("num_images_per_prompt") or 1))
+    # redelivered jobs carry their dead worker's last lane checkpoint
+    # (node/minihive.py): the scheduler splices the rows back in at the
+    # recorded step instead of restarting at 0. A solo-path PHASE marker
+    # (the dead worker ran this job outside a lane) carries no lane
+    # state to splice — filter it silently, it is a routine redelivery,
+    # not the tamper/corruption signal ResumeReject counts.
+    resume = kwargs.get("resume")
+    if not (isinstance(resume, dict) and resume.get("kind") == "lane"):
+        resume = None
     future = get_stepper(slot).submit_request(
         pipe,
         prompt=str(kwargs.get("prompt") or ""),
@@ -271,7 +292,8 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
         steps=steps, guidance_scale=guidance,
         height=height, width=width, rows=rows, seed=int(seed),
         scheduler=kwargs.get("scheduler_type"),
-        job_id=job_id)
+        job_id=job_id,
+        resume=resume)
     sampler = resolve(kwargs.get("scheduler_type"),
                       prediction_type=fam.prediction_type)
     return StepperTicket(

@@ -55,7 +55,10 @@ def _restore_matmul_precision():
 
 
 class StubSlot:
-    """Executor-less slot: the ChaoticExecutor never touches the mesh."""
+    """Executor-less slot: the ChaoticExecutor never touches the mesh.
+    ``__call__`` mirrors the real slot contract (core/chip_pool.py) just
+    enough for tests that drive the REAL executor's error paths —
+    callbacks that raise before touching any device."""
 
     def __init__(self, depth: int = 2, data_width: int = 1,
                  name: str = "stub"):
@@ -65,6 +68,14 @@ class StubSlot:
 
     def descriptor(self):
         return self.name
+
+    def __call__(self, callback, **kwargs):
+        model_name = kwargs.pop("model_name", None)
+        seed = int(kwargs.pop("seed", None) or 0)
+        artifacts, config = callback(self, model_name, seed=seed, **kwargs)
+        config = dict(config)
+        config["seed"] = seed
+        return artifacts, config
 
 
 def chaos_settings(uri: str = "http://unused", **over) -> Settings:
@@ -359,6 +370,60 @@ def test_burst_level_failure_counts_once_toward_breaker():
     asyncio.run(scenario())
 
 
+def test_model_unavailable_redispatchable_but_still_breaker_fodder():
+    """ISSUE 6 satellite (resolves the PR-2 taxonomy tension): a
+    node-local model-unavailable uploads WITHOUT the fatal flag and with
+    ``error_kind=model_unavailable`` — the hive may redispatch it — yet
+    it still counts toward the model's circuit breaker, so K misses in a
+    row quarantine the checkpoint locally exactly as before."""
+    from chiaswarm_tpu.node.executor import synchronous_do_work
+    from chiaswarm_tpu.node.resilience import BREAKER_KINDS, REDISPATCH_KINDS
+
+    assert "model_unavailable" in BREAKER_KINDS
+    assert "model_unavailable" in REDISPATCH_KINDS
+    assert "quarantined" in REDISPATCH_KINDS
+
+    # the REAL executor path: a registry without the model raises the
+    # load ValueError; the envelope must be non-fatal + redispatchable
+    registry = ModelRegistry(catalog=[], allow_random=False)
+    result = synchronous_do_work(
+        _cjob("mu-1", model="not/served"), StubSlot(), registry)
+    config = result["pipeline_config"]
+    assert config["error_kind"] == "model_unavailable"
+    assert "fatal_error" not in result  # the hive may redispatch
+
+    async def breaker_still_quarantines():
+        executor = ChaoticExecutor()
+        reg = ModelRegistry(catalog=[], allow_random=True)
+        worker = _worker(chaos_settings(), executor, registry=reg)
+        bad = "missing/checkpoint"
+
+        async def refuse(job, slot, registry):
+            return {
+                "id": job.get("id"),
+                "artifacts": {},
+                "pipeline_config": {
+                    "error": "model is not available on this node",
+                    "error_kind": "model_unavailable"},
+            }
+
+        executor.do_work = refuse  # threshold is 2
+        for i in range(2):
+            [envelope] = await worker._execute_burst(
+                [_cjob(f"mu{i}", model=bad)], StubSlot())
+            assert classify_result(envelope) == "model_unavailable"
+        assert reg.is_quarantined(bad)
+        assert worker.health()["breakers"][bad]["state"] == "open"
+        # and the refusal envelope of the OPEN breaker is itself
+        # redispatchable (kind "quarantined", non-fatal)
+        [refused] = await worker._execute_burst(
+            [_cjob("mu2", model=bad)], StubSlot())
+        assert refused["pipeline_config"]["error_kind"] == "quarantined"
+        assert "fatal_error" not in refused
+
+    asyncio.run(breaker_still_quarantines())
+
+
 def test_breaker_ignores_user_input_errors():
     """K bad *requests* in a row must not quarantine a healthy model."""
 
@@ -587,8 +652,11 @@ def test_classify_exception_taxonomy():
     assert classify_exception(ValueError("max image size")) == "fatal"
     assert classify_exception(
         RuntimeError("RESOURCE_EXHAUSTED: out of memory")) == "oom"
+    # ISSUE 6: node-local model-unavailable is a redispatch signal, not
+    # a fatal user-input error (the hive routes it to another worker)
     assert classify_exception(
-        ValueError("model 'x' is not available on this node")) == "model"
+        ValueError("model 'x' is not available on this node")) == \
+        "model_unavailable"
     assert classify_exception(ConnectionResetError("peer")) == "transient"
     assert classify_exception(
         requests.exceptions.ConnectTimeout("slow cdn")) == "transient"

@@ -114,11 +114,19 @@ def test_lora_entries_are_cache_keyed_by_scale(tmp_path, monkeypatch,
                                rtol=1e-4, atol=1e-6)
 
 
-def test_missing_lora_is_fatal(tmp_path, monkeypatch, registry, pool):
+def test_missing_lora_is_redispatchable(tmp_path, monkeypatch, registry,
+                                        pool):
+    """ISSUE 6 taxonomy resolution: a LoRA missing from THIS node is the
+    same node-local availability problem as a missing checkpoint — the
+    envelope uploads as ``error_kind=model_unavailable`` WITHOUT the
+    fatal flag, so a lease-aware hive (node/minihive.py) redispatches it
+    to a node that downloaded the adapter (bounded by max_attempts)."""
     monkeypatch.setenv("SDAAS_ROOT", str(tmp_path))
     job = {"id": "j-miss", "model_name": "tiny", "prompt": "x",
            "num_inference_steps": 1, "height": 64, "width": 64,
            "lora": "acme/not-downloaded"}
     result = synchronous_do_work(job, pool.slots[0], registry)
-    assert result["fatal_error"] is True
-    assert "not available" in result["pipeline_config"]["error"]
+    assert "fatal_error" not in result
+    config = result["pipeline_config"]
+    assert config["error_kind"] == "model_unavailable"
+    assert "not available" in config["error"]

@@ -134,12 +134,20 @@ def test_executor_format_error_is_fatal(registry, pool):
     assert "primary" in result["artifacts"]  # error rendered as artifact
 
 
-def test_executor_unavailable_model_is_fatal(pool):
+def test_executor_unavailable_model_is_redispatchable(pool):
+    """ISSUE 6 taxonomy resolution: a node-LOCAL model-unavailable is a
+    routing problem — the envelope uploads with
+    ``error_kind=model_unavailable`` and WITHOUT the fatal flag, so a
+    lease-aware hive (node/minihive.py) redispatches it to a node that
+    serves the model instead of failing the job forever."""
     registry = ModelRegistry(catalog=[], allow_random=False)
     job = {"id": "job-3", "model_name": "some/unknown-model", "prompt": "x",
            "num_inference_steps": 1}
     result = synchronous_do_work(job, pool.slots[0], registry)
-    assert result["fatal_error"] is True
+    assert "fatal_error" not in result
+    config = result["pipeline_config"]
+    assert config["error_kind"] == "model_unavailable"
+    assert "is not available on this node" in config["error"]
 
 
 def test_executor_txt2audio_workflow(registry, pool):

@@ -375,6 +375,16 @@ def test_worker_serves_metrics_and_traces_endpoints():
     # ...stepper-lane families...
     assert "chiaswarm_stepper_steps_executed_total" in body
     assert "chiaswarm_stepper_enabled 0" in body
+    # ...lease/checkpoint/resume families (ISSUE 6) exist from scrape
+    # one, even before any fleet event — dashboards need the zeroes...
+    assert "chiaswarm_lease_heartbeats_total 0" in body
+    assert "chiaswarm_leases_lost_total 0" in body
+    assert "chiaswarm_checkpoints_written_total 0" in body
+    assert "chiaswarm_checkpoints_corrupt_total 0" in body
+    assert "chiaswarm_checkpoint_depth 0" in body
+    assert "chiaswarm_inflight_jobs 0" in body
+    assert "chiaswarm_stepper_rows_resumed_total 0" in body
+    assert "# TYPE chiaswarm_stepper_resume_step histogram" in body
     # ...compile-cache + hive families from the process registry...
     assert "chiaswarm_compile_cache_misses_total" in body
     assert "# TYPE chiaswarm_compiles_total counter" in body
@@ -511,6 +521,13 @@ def test_e2e_tiny_txt2img_trace_spans(stepper, monkeypatch):
         # lane's (bounded) width — never by unbounded lane id
         assert 'chiaswarm_stepper_lane_occupancy_ratio_bucket{width="' \
             in metrics_body
+        # lease/resume families (ISSUE 6): present at zero on a healthy
+        # run — they only move when the fleet machinery redelivers
+        assert "chiaswarm_stepper_rows_resumed_total 0" in metrics_body
+        assert "chiaswarm_stepper_resumes_rejected_total 0" in metrics_body
+        assert "# TYPE chiaswarm_stepper_resume_step histogram" \
+            in metrics_body
+        assert "chiaswarm_checkpoints_written_total" in metrics_body
 
 
 def test_lane_occupancy_histogram_semantics():

@@ -409,3 +409,263 @@ def test_worker_health_reports_stepper_counters(monkeypatch, registry,
     assert stepper["rows_completed"] >= 1
     assert stepper["steps_executed"] >= 2
     assert 0.0 <= stepper["lane_occupancy"] <= 1.0
+
+
+# ---- step-boundary checkpoint / resume (ISSUE 6) -----------------------
+
+
+def test_pack_unpack_roundtrip_is_bit_exact():
+    """Resume state crosses two JSON serializations (spool file ->
+    heartbeat -> redelivered job); the array packing must be exact —
+    float bits and PRNG key words alike."""
+    from chiaswarm_tpu.serving.stepper import pack_array, unpack_array
+
+    rng = np.random.default_rng(7)
+    latents = rng.standard_normal((2, 8, 8, 4)).astype(np.float32)
+    keys = rng.integers(0, 2**32, size=(2, 2), dtype=np.uint32)
+    for arr in (latents, keys):
+        spec = pack_array(arr)
+        back = unpack_array(spec)
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        assert np.array_equal(back, arr)
+    # and through an actual JSON round trip
+    import json
+
+    back = unpack_array(json.loads(json.dumps(pack_array(latents))))
+    assert np.array_equal(back, latents)
+
+
+class _SpoolSlot:
+    """Slot stub carrying only what lanes read: a checkpoint spool."""
+
+    data_width = 1
+
+    def __init__(self, spool):
+        self._checkpoint_spool = spool
+
+
+def test_lane_checkpoint_then_resume_matches_uninterrupted_run(
+        tiny_pipe, tmp_path, monkeypatch):
+    """The resume equivalence gate: a job restarted from a mid-run lane
+    checkpoint (restored latents + keys + multistep history, spliced in
+    at step k) finishes with images IDENTICAL to the uninterrupted lane
+    run — and its lane info carries the nonzero resume step the
+    acceptance criterion asserts on."""
+    from chiaswarm_tpu.node.resilience import CheckpointSpool
+
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    spool = CheckpointSpool(tmp_path / "ckpt")
+    sched = StepScheduler(_SpoolSlot(spool))
+
+    fut = sched.submit_request(
+        tiny_pipe, prompt="resume me", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=77, job_id="ck-1")
+    pending, info = fut.result(timeout=300)
+    imgs_fresh = pending.wait()
+    assert info["resume_step"] == 0  # the uninterrupted run
+    assert sched.stats().get("checkpoints_written", 0) >= 1
+
+    # the spool holds the LAST pre-completion snapshot (step k >= 1);
+    # hand it to a fresh scheduler as a redelivered job would arrive
+    ckpt = spool.load("ck-1")
+    assert ckpt is not None and ckpt["kind"] == "lane"
+    assert 1 <= ckpt["step"] < 6
+
+    sched2 = StepScheduler()
+    fut2 = sched2.submit_request(
+        tiny_pipe, prompt="resume me", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1,
+        seed=0,  # deliberately different: resume must not re-derive keys
+        job_id="ck-1", resume=ckpt)
+    pending2, info2 = fut2.result(timeout=300)
+    imgs_resumed = pending2.wait()
+    assert info2["resume_step"] == ckpt["step"] >= 1
+    assert sched2.stats().get("rows_resumed", 0) == 1
+    # bit-identical: same executables, same restored state
+    assert np.array_equal(imgs_resumed, imgs_fresh)
+
+
+def test_resume_validation_rejects_mismatch_and_restarts_clean(
+        tiny_pipe, tmp_path, monkeypatch):
+    """A checkpoint that does not match the job (tampered steps) or is
+    corrupt is rejected loudly: the job still completes — from step 0 —
+    and the rejection is counted."""
+    from chiaswarm_tpu.node.resilience import CheckpointSpool
+
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    spool = CheckpointSpool(tmp_path / "ckpt2")
+    sched = StepScheduler(_SpoolSlot(spool))
+    fut = sched.submit_request(
+        tiny_pipe, prompt="tamper", steps=5, guidance_scale=7.0,
+        height=64, width=64, rows=1, seed=11, job_id="tp-1")
+    imgs_solo = fut.result(timeout=300)[0].wait()
+
+    ckpt = spool.load("tp-1")
+    assert ckpt is not None
+    tampered = dict(ckpt)
+    tampered["steps"] = 9  # claims a different job
+
+    sched2 = StepScheduler()
+    fut2 = sched2.submit_request(
+        tiny_pipe, prompt="tamper", steps=5, guidance_scale=7.0,
+        height=64, width=64, rows=1, seed=11, job_id="tp-1",
+        resume=tampered)
+    pending2, info2 = fut2.result(timeout=300)
+    assert info2["resume_step"] == 0  # restarted clean
+    assert sched2.stats().get("resumes_rejected", 0) == 1
+    assert np.array_equal(pending2.wait(), imgs_solo)
+
+    # corrupt payloads reject the same way (never crash the submit)
+    garbage = dict(ckpt)
+    garbage["x"] = {"dtype": "float32", "shape": [1], "b64": "!!!"}
+    fut3 = sched2.submit_request(
+        tiny_pipe, prompt="tamper", steps=5, guidance_scale=7.0,
+        height=64, width=64, rows=1, seed=11, resume=garbage)
+    pending3, info3 = fut3.result(timeout=300)
+    assert info3["resume_step"] == 0
+    assert pending3.wait().shape == (1, 64, 64, 3)
+
+    # a keys array with the right row count but the wrong tail shape
+    # must reject at VALIDATION — inside lane admission it would take
+    # every co-resident job down via the containment seam
+    from chiaswarm_tpu.serving.stepper import pack_array
+    bad_keys = dict(ckpt)
+    bad_keys["keys"] = pack_array(np.zeros((1, 7), np.uint32))
+    fut4 = sched2.submit_request(
+        tiny_pipe, prompt="tamper", steps=5, guidance_scale=7.0,
+        height=64, width=64, rows=1, seed=11, resume=bad_keys)
+    pending4, info4 = fut4.result(timeout=300)
+    assert info4["resume_step"] == 0
+    assert sched2.stats().get("resumes_rejected", 0) == 3
+
+    # latents stepped under a different guidance must not splice in and
+    # finish under this job's guidance (wrong image delivered as a
+    # success) — a mixed-up checkpoint restarts clean instead
+    wrong_guidance = dict(ckpt)
+    wrong_guidance["guidance"] = 3.0
+    fut5 = sched2.submit_request(
+        tiny_pipe, prompt="tamper", steps=5, guidance_scale=7.0,
+        height=64, width=64, rows=1, seed=11, resume=wrong_guidance)
+    pending5, info5 = fut5.result(timeout=300)
+    assert info5["resume_step"] == 0
+    assert sched2.stats().get("resumes_rejected", 0) == 4
+
+
+def test_phase_checkpoint_resume_is_filtered_not_rejected(
+        monkeypatch, registry, single_chip_slot):
+    """A redelivered job whose dead worker ran it SOLO carries a
+    phase-kind marker, not lane state: the lane path must filter it
+    silently (fresh start at step 0) — a routine redelivery, not the
+    tamper/corruption signal ``resumes_rejected`` counts."""
+    from chiaswarm_tpu.node.executor import synchronous_do_work
+
+    monkeypatch.setenv("CHIASWARM_STEPPER", "1")
+    before = single_chip_slot._stepper.stats().get("resumes_rejected", 0) \
+        if getattr(single_chip_slot, "_stepper", None) else 0
+    result = synchronous_do_work(
+        _job(30, num_inference_steps=2,
+             resume={"version": 1, "kind": "phase", "phase": "denoised"}),
+        single_chip_slot, registry)
+    cfg = result["pipeline_config"]
+    assert cfg.get("error") is None, cfg
+    assert cfg["stepper"]["resume_step"] == 0
+    stats = single_chip_slot._stepper.stats()
+    assert stats.get("resumes_rejected", 0) == before  # NOT a rejection
+
+
+def test_checkpoint_spool_hygiene(tmp_path):
+    """ISSUE 6 satellite: per-worker namespacing, loud corrupt-file
+    skip with a counter, GC on ack, wholesale clear at startup."""
+    from chiaswarm_tpu.node.resilience import CheckpointSpool
+
+    spool_a = CheckpointSpool(tmp_path / "checkpoints" / "worker-a")
+    spool_b = CheckpointSpool(tmp_path / "checkpoints" / "worker-b")
+    spool_a.save("j1", {"kind": "phase", "phase": "encoded"})
+    spool_b.save("j1", {"kind": "phase", "phase": "denoised"})
+    # namespaced: same job id, two workers, two files
+    assert spool_a.load("j1")["phase"] == "encoded"
+    assert spool_b.load("j1")["phase"] == "denoised"
+    assert spool_a.depth() == spool_b.depth() == 1
+    assert spool_a.written == 1
+
+    # corrupt snapshot: skipped loudly, parked as .bad, counted
+    path = spool_a.save("j2", {"kind": "lane", "step": 3})
+    path.write_text("{truncated", encoding="utf-8")
+    assert spool_a.load("j2") is None
+    assert spool_a.corrupt_skipped == 1
+    assert not path.exists()  # parked as .bad, not retried forever
+    assert path.with_suffix(".json.bad").exists()
+
+    # GC on ack removes exactly the acked job's file
+    spool_a.save("j3", {"kind": "phase", "phase": "encoded"})
+    spool_a.discard("j3")
+    assert spool_a.load("j3") is None
+    spool_a.discard("never-existed")  # idempotent
+
+    # startup clear wipes leftovers (the hive's copies are authority),
+    # including parked .bad corpses and orphaned mid-save .tmp files —
+    # otherwise they accumulate forever across restarts
+    spool_b.save("j4", {"kind": "phase", "phase": "encoded"})
+    (spool_b.directory / "old.ckpt.json.tmp").write_text("{", "utf-8")
+    assert spool_a.clear() >= 2            # j1 + the parked j2 .bad
+    assert not list(spool_a.directory.glob("*.bad"))
+    assert spool_b.clear() >= 2            # j4 + the orphaned .tmp
+    assert spool_b.depth() == 0
+    assert not list(spool_b.directory.glob("*.tmp"))
+
+
+def test_checkpoint_spool_version_probe(tmp_path):
+    """The heartbeat's has-it-changed probe must advance on EVERY save —
+    including several within one filesystem-timestamp tick (coarse-mtime
+    mounts), where an mtime-equality probe would report "unchanged" and
+    leave a stale snapshot as the hive's resume authority."""
+    from chiaswarm_tpu.node.resilience import CheckpointSpool
+
+    spool = CheckpointSpool(tmp_path / "vers")
+    assert spool.version("j1") is None  # absent
+    spool.save("j1", {"kind": "lane", "step": 1})
+    v1 = spool.version("j1")
+    spool.save("j1", {"kind": "lane", "step": 2})  # same tick is fine
+    v2 = spool.version("j1")
+    assert v1 is not None and v2 is not None and v2 > v1
+    spool.save("j2", {"kind": "phase", "phase": "encoded"})
+    assert spool.version("j2") != spool.version("j1")
+    spool.discard("j1")
+    assert spool.version("j1") is None
+    # a file this process never wrote (external checkpoint_dir) still
+    # reads as present
+    spool._path_for("ghost").write_text("{}", "utf-8")
+    assert spool.version("ghost") == 0
+    spool.clear()
+    assert spool.version("j2") is None
+    # distinct ids that sanitize identically ("job 1" vs "job_1") must
+    # never collide onto one file — a collided checkpoint could resume
+    # the OTHER job's latent trajectory
+    spool.save("job 1", {"kind": "phase", "phase": "encoded"})
+    spool.save("job_1", {"kind": "phase", "phase": "denoised"})
+    assert spool.load("job 1")["phase"] == "encoded"
+    assert spool.load("job_1")["phase"] == "denoised"
+    assert spool.depth() == 2
+
+
+def test_solo_path_records_phase_checkpoints(tmp_path):
+    """The solo path's coarse markers (encoded -> denoised) ride the
+    same spool through the executor's checkpoint scope; the file is
+    GC'd on ack by the worker (covered in the spool hygiene test)."""
+    from chiaswarm_tpu.node.resilience import (
+        CheckpointSpool, checkpoint_scope, phase_checkpoint)
+
+    spool = CheckpointSpool(tmp_path / "phases")
+    phase_checkpoint("orphan")  # outside any scope: silent no-op
+    assert spool.depth() == 0
+    with checkpoint_scope(spool, "solo-1"):
+        phase_checkpoint("encoded", model="tiny")
+        assert spool.load("solo-1")["phase"] == "encoded"
+        phase_checkpoint("denoised", model="tiny", generation_s=1.25)
+    state = spool.load("solo-1")
+    assert state["phase"] == "denoised"
+    assert state["generation_s"] == 1.25
+    # a None spool (stub slot, feature off) makes the scope a no-op
+    with checkpoint_scope(None, "solo-2"):
+        phase_checkpoint("encoded")
+    assert spool.load("solo-2") is None

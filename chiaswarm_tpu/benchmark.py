@@ -95,11 +95,12 @@ def _bench_mixed_arrival(*, on_tpu: bool, attn: str) -> dict:
     Runs on a dp-sharded mesh slot when enough devices exist (the virtual
     8-device CPU mesh in CI): a solo batch-1 program replicates over the
     data axis, wasting (dp-1)/dp of the slot — exactly what lane
-    occupancy recovers. `sharded_rows` rides the opt-in
-    CHIASWARM_STEPPER_SHARD_ROWS knob; on the pinned jax build the
-    sharded step program has a known numerics divergence (ROADMAP), so
-    this config measures THROUGHPUT mechanics, and serving keeps the
-    knob off until that is debugged."""
+    occupancy recovers. Lanes run UNSHARDED here, matching serving: on
+    the pinned jax build the row-sharded step program has a known
+    numerics divergence (ROADMAP item 2, the GSPMD divergence family),
+    so the bench must not publish throughput from a program the serving
+    path refuses to run. Re-enable CHIASWARM_STEPPER_SHARD_ROWS in this
+    config when ROADMAP item 2 lands."""
     import os
     import time
 
@@ -126,7 +127,9 @@ def _bench_mixed_arrival(*, on_tpu: bool, attn: str) -> dict:
     saved = {k: os.environ.get(k) for k in
              ("CHIASWARM_STEPPER_LANE_WIDTH", "CHIASWARM_STEPPER_SHARD_ROWS")}
     os.environ["CHIASWARM_STEPPER_LANE_WIDTH"] = str(max(2, dp))
-    os.environ["CHIASWARM_STEPPER_SHARD_ROWS"] = "1" if dp > 1 else "0"
+    # ROADMAP item 2: sharded lanes diverge numerically on the pinned
+    # build — serving runs lanes unsharded, and so does the bench
+    os.environ["CHIASWARM_STEPPER_SHARD_ROWS"] = "0"
     try:
         registry = ModelRegistry(
             catalog=[{"name": fam, "family": fam, "parameters": {}}],
@@ -208,7 +211,191 @@ def _bench_mixed_arrival(*, on_tpu: bool, attn: str) -> dict:
                 - before.get("rows_admitted_midflight", 0)),
             "lane_width": max(2, dp),
             "mesh_data_axis": dp,
-            "sharded_rows": dp > 1,
+            # lanes run unsharded until the ROADMAP-item-2 numerics
+            # divergence is debugged (the key stays for r-trajectory
+            # continuity in BENCH json diffs)
+            "sharded_rows": False,
+        }
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def _bench_mixed_workloads(*, on_tpu: bool, attn: str) -> dict:
+    """Adaptive-width lanes under a staggered txt2img + img2img + inpaint
+    arrival stream (ISSUE 7): the workload mix real hive traffic shows,
+    where the burst path cannot coalesce ACROSS workloads at all and the
+    static-width lane pays the padding for whichever regime it guessed.
+
+    Two runs over the identical arrival schedule: per-job solo programs
+    (submit/wait pipelined — the pre-ISSUE-7 reality for img2img and
+    inpaint, which were lane-ineligible) vs adaptive-width lanes
+    (CHIASWARM_STEPPER_LANE_WIDTH unset, so the occupancy/arrival-rate
+    controller sets capacity). Reported per workload: p50 latency both
+    ways plus the lane occupancy, padding-waste, resize-count, and
+    per-workload admission counters from the scheduler stats — the r06
+    BENCH json trajectory for the adaptive-width win."""
+    import os
+    import time
+
+    import jax
+    import numpy as np
+
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
+    from chiaswarm_tpu.serving.stepper import StepScheduler
+
+    fam = "sd15" if on_tpu else "tiny"
+    size = 512 if on_tpu else 64
+    steps_mix = [20, 25, 30] if on_tpu else [6, 8, 10]
+    # same slot shape as _bench_mixed_arrival: a dp-sharded mesh when
+    # devices allow (the virtual 8-device CPU mesh in CI) — a solo
+    # batch-1 program replicates over the data axis, wasting (dp-1)/dp
+    # of the slot, which is exactly the capacity lanes pack rows into
+    n_dev = len(jax.devices())
+    if n_dev >= 8:
+        mesh = build_mesh(MeshSpec({"data": 4, "model": 2}))
+    elif n_dev >= 2:
+        mesh = build_mesh(MeshSpec({"data": n_dev}))
+    else:
+        mesh = None
+    dp = 1 if mesh is None else dict(
+        zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+
+    rng = np.random.default_rng(7)
+    init = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+    half_mask = np.zeros((size, size), np.float32)
+    half_mask[size // 2:] = 1.0
+
+    # the arrival stream: workloads interleaved so no two consecutive
+    # jobs share a solo program, steps mixed so no two share a burst key
+    kinds = ["txt2img", "img2img", "txt2img", "inpaint",
+             "img2img", "txt2img", "inpaint", "txt2img",
+             "img2img", "inpaint", "txt2img", "img2img"]
+    jobs = [(kind, steps_mix[i % len(steps_mix)], 700 + i)
+            for i, kind in enumerate(kinds)]
+
+    saved = {k: os.environ.get(k) for k in
+             ("CHIASWARM_STEPPER_LANE_WIDTH", "CHIASWARM_STEPPER_SHARD_ROWS",
+              "CHIASWARM_STEPPER_ADAPTIVE", "CHIASWARM_STEPPER_MAX_WIDTH")}
+    # adaptive width on (the ISSUE-7 default): no pinned width, bounds
+    # left to the controller; lanes unsharded per ROADMAP item 2
+    os.environ.pop("CHIASWARM_STEPPER_LANE_WIDTH", None)
+    os.environ.pop("CHIASWARM_STEPPER_ADAPTIVE", None)
+    os.environ["CHIASWARM_STEPPER_SHARD_ROWS"] = "0"
+    os.environ["CHIASWARM_STEPPER_MAX_WIDTH"] = "8"
+    try:
+        registry = ModelRegistry(
+            catalog=[{"name": fam, "family": fam, "parameters": {}}],
+            allow_random=True, attn_impl=attn)
+        pipe = registry.pipeline(fam, mesh=mesh)
+
+        def req(kind: str, steps: int, seed: int) -> GenerateRequest:
+            return GenerateRequest(
+                prompt=f"{kind} {seed}", steps=steps, guidance_scale=7.5,
+                height=size, width=size, seed=seed,
+                init_image=init if kind != "txt2img" else None,
+                strength=0.6,
+                mask=half_mask if kind == "inpaint" else None)
+
+        def lane_submit(sched, kind, steps, seed):
+            return sched.submit_request(
+                pipe, prompt=f"{kind} {seed}", steps=steps,
+                guidance_scale=7.5, height=size, width=size, rows=1,
+                seed=seed,
+                init_image=init if kind != "txt2img" else None,
+                strength=0.6,
+                mask=half_mask if kind == "inpaint" else None)
+
+        # warm every solo program and lane executable the stream needs
+        for kind in ("txt2img", "img2img", "inpaint"):
+            for steps in sorted(set(s for _, s, _ in jobs)):
+                pipe(req(kind, steps, 0))
+        sched = StepScheduler()
+        lane_submit(sched, "inpaint", max(steps_mix), 1).result(
+            timeout=600)[0].wait()
+        s0 = dict(sched.stats())
+        t0 = time.perf_counter()
+        lane_submit(sched, "img2img", max(steps_mix), 2).result(
+            timeout=600)[0].wait()
+        step_t = (time.perf_counter() - t0) / max(
+            1, sched.stats()["steps_executed"] - s0["steps_executed"])
+        stagger = step_t
+
+        def arrivals(run_one):
+            t_start = time.perf_counter()
+            handles = []
+            for i, job in enumerate(jobs):
+                target = t_start + i * stagger
+                now = time.perf_counter()
+                if now < target:
+                    time.sleep(target - now)
+                handles.append((job[0], time.perf_counter(), run_one(job)))
+            return t_start, handles
+
+        def p50_by_kind(samples: list[tuple[str, float]]) -> dict:
+            out = {}
+            for kind in ("txt2img", "img2img", "inpaint"):
+                lat = sorted(t for k, t in samples if k == kind)
+                if lat:
+                    out[kind] = round(lat[len(lat) // 2], 4)
+            return out
+
+        # per-job reality for this stream: every job its own solo
+        # program (img2img/inpaint had NO batched path before ISSUE 7)
+        t_start, handles = arrivals(
+            lambda job: pipe.submit(req(*job))[0])
+        solo_lat = []
+        for kind, t_sub, pending in handles:
+            pending.wait()
+            solo_lat.append((kind, time.perf_counter() - t_sub))
+        solo_total = time.perf_counter() - t_start
+
+        before = dict(sched.stats())
+        t_start, handles = arrivals(
+            lambda job: lane_submit(sched, *job))
+        lane_lat = []
+        for kind, t_sub, fut in handles:
+            fut.result(timeout=600)[0].wait()
+            lane_lat.append((kind, time.perf_counter() - t_sub))
+        lane_total = time.perf_counter() - t_start
+        after = dict(sched.stats())
+        sched.shutdown()
+
+        active = after["row_steps_active"] - before["row_steps_active"]
+        padded = (after.get("row_steps_padded", 0)
+                  - before.get("row_steps_padded", 0))
+        denom = max(1, active + padded)
+        admitted = {
+            kind: (after.get(f"rows_admitted_{kind}", 0)
+                   - before.get(f"rows_admitted_{kind}", 0))
+            for kind in ("txt2img", "img2img", "inpaint")}
+        return {
+            "jobs": len(jobs),
+            "workload_mix": {k: kinds.count(k) for k in
+                             ("txt2img", "img2img", "inpaint")},
+            "steps_mix": steps_mix,
+            "stagger_s": round(stagger, 4),
+            "images_per_sec_lanes": round(len(jobs) / lane_total, 4),
+            "images_per_sec_per_job": round(len(jobs) / solo_total, 4),
+            "speedup": round(solo_total / lane_total, 4),
+            "p50_latency_s_lanes": p50_by_kind(lane_lat),
+            "p50_latency_s_per_job": p50_by_kind(solo_lat),
+            "lane_occupancy": round(active / denom, 4),
+            "padding_waste": round(padded / denom, 4),
+            "lane_resizes": (after.get("lane_resizes", 0)
+                             - before.get("lane_resizes", 0)),
+            "rows_admitted_by_workload": admitted,
+            "rows_admitted_midflight": (
+                after.get("rows_admitted_midflight", 0)
+                - before.get("rows_admitted_midflight", 0)),
+            "adaptive_width": True,
+            "mesh_data_axis": dp,
+            "sharded_rows": False,
         }
     finally:
         for key, value in saved.items():
@@ -333,6 +520,12 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         results["stepper_mixed_arrival"] = _bench_mixed_arrival(
             on_tpu=on_tpu, attn=attn)
 
+    if "stepper_mixed_workloads" in names:
+        # ISSUE 7: adaptive-width lanes under a staggered txt2img +
+        # img2img + inpaint stream vs those jobs' per-job solo paths
+        results["stepper_mixed_workloads"] = _bench_mixed_workloads(
+            on_tpu=on_tpu, attn=attn)
+
     if "txt2vid" in names:
         # the model class the reference actually serves for video
         # (ModelScope-class temporal UNet, swarm/video/tx2vid.py)
@@ -422,7 +615,7 @@ def main() -> None:
     configs = {"sdxl_txt2img_1024": headline}
     if which != "headline":
         names = (["sd15", "sd21", "controlnet", "img2vid", "stepper",
-                  "txt2vid"]
+                  "stepper_mixed_workloads", "txt2vid"]
                  if which == "all" else which.split(","))
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))

@@ -110,6 +110,11 @@ class ChaoticHive:
         self._app.router.add_get("/api/work", self._work)
         self._app.router.add_post("/api/results", self._results)
         self._app.router.add_get("/api/models", self._models)
+        # static test assets so image-workload jobs (img2img/inpaint —
+        # lane-eligible since ISSUE 7) flow through the full
+        # start_image_uri/mask_image_uri fetch path under chaos
+        self._app.router.add_get("/assets/image.png", self._asset_image)
+        self._app.router.add_get("/assets/mask.png", self._asset_mask)
         self._runner = None
         self.uri = ""
 
@@ -145,6 +150,33 @@ class ChaoticHive:
     @staticmethod
     def _worker_from(request) -> str:
         return str(request.query.get("worker_name", "") or "")
+
+    # ---- static assets (deterministic inputs for image workloads) ----
+
+    @staticmethod
+    def _png_response(pixels):
+        import io
+
+        from aiohttp import web
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(pixels).save(buf, format="PNG")
+        return web.Response(body=buf.getvalue(), content_type="image/png")
+
+    async def _asset_image(self, request):
+        import numpy as np
+
+        rng = np.random.default_rng(12)
+        return self._png_response(
+            rng.integers(0, 255, (64, 64, 3), dtype=np.uint8))
+
+    async def _asset_mask(self, request):
+        import numpy as np
+
+        mask = np.zeros((64, 64), dtype=np.uint8)
+        mask[32:] = 255  # regenerate the bottom half
+        return self._png_response(mask)
 
     # ---- endpoints ----
 

@@ -169,10 +169,11 @@ def _execute(job_id, content_type, callback, kwargs, slot) -> dict:
 
 def _stepper_submit(job_id, content_type, callback, kwargs, slot,
                     registry):
-    """Submit an eligible txt2img job to the slot's continuous step
-    scheduler (serving/stepper.py). Returns a ticket or None (run the
-    job through the ordinary burst/solo path instead). Submission
-    failures are never terminal for the job — it just falls back."""
+    """Submit an eligible diffusion job (txt2img / img2img / inpaint /
+    ControlNet, ISSUE 7) to the slot's continuous step scheduler
+    (serving/stepper.py). Returns a ticket or None (run the job through
+    the ordinary burst/solo path instead). Submission failures are
+    never terminal for the job — it just falls back."""
     from chiaswarm_tpu.workloads.diffusion import (
         diffusion_callback,
         stepper_eligible,
@@ -368,13 +369,17 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
                 results[i] = fatal
                 continue
             job_id, content_type, callback, kwargs = formatted
-            if callback is diffusion_callback and coalescable(kwargs):
+            if callback is diffusion_callback:
+                # lanes first (the default engine, ISSUE 7) — incl.
+                # non-coalescable ControlNet jobs, which ride
+                # bundle-keyed lanes the burst path has no analog for
                 ticket = _stepper_submit(job_id, content_type, callback,
                                          kwargs, slot, registry)
                 if ticket is not None:
                     tickets.append((i, job_id, content_type, kwargs,
                                     ticket))
                     continue
+            if callback is diffusion_callback and coalescable(kwargs):
                 groups.setdefault(_coalesce_key(kwargs), []).append(
                     (i, job_id, content_type, kwargs))
             else:

@@ -107,16 +107,18 @@ def _burst_key(job: dict) -> tuple | None:
     image = job.get("image")
     steps = job.get("num_inference_steps")
     guidance = job.get("guidance_scale")
-    if not (job.get("start_image_uri") or image is not None
-            or job.get("mask_image_uri")
-            or job.get("mask_image") is not None):
-        from chiaswarm_tpu.serving.stepper import stepper_enabled
+    strength = job.get("strength")
+    from chiaswarm_tpu.serving.stepper import stepper_enabled
 
-        if stepper_enabled():
-            # lanes carry steps + guidance PER ROW (serving/stepper.py):
-            # plain txt2img jobs differing only in those two fields drain
-            # as one burst and splice into one lane
-            steps = guidance = None
+    if stepper_enabled():
+        # lanes carry steps, guidance AND the img2img strength (its
+        # start index) PER ROW (serving/stepper.py): jobs differing only
+        # in those fields drain as one burst and splice into one lane —
+        # since ISSUE 7 that covers img2img and inpaint too, not just
+        # txt2img (the mode split below still keeps workloads apart,
+        # and the executor's post-format grouping stays the authority
+        # for whatever falls back off a lane)
+        steps = guidance = strength = None
     return (model, job.get("height"), job.get("width"),
             steps, guidance,
             job.get("lora"), job.get("textual_inversion"),
@@ -126,7 +128,7 @@ def _burst_key(job: dict) -> tuple | None:
             bool(job.get("start_image_uri") or image is not None),
             bool(job.get("mask_image_uri")
                  or job.get("mask_image") is not None),
-            job.get("strength"),
+            strength,
             None if image is None else tuple(getattr(image, "shape", ())),
             repr(sorted(params.items())))
 
@@ -673,6 +675,19 @@ class Worker:
             return self._poll_backoff.next()
         self._poll_backoff.reset()
         poll_http_s = time.perf_counter() - t_poll
+        if jobs:
+            # poll-loop / step-boundary merge (ISSUE 7c): tell each
+            # slot's resident step scheduler how many rows this poll is
+            # about to format and submit, so adaptive lanes can grow at
+            # their NEXT boundary instead of queueing the burst behind a
+            # full lane. A hint only — never creates a scheduler.
+            rows_hint = sum(
+                max(1, int(job.get("num_images_per_prompt") or 1))
+                for job in jobs)
+            for slot in self.pool:
+                stepper = getattr(slot, "_stepper", None)
+                if stepper is not None:
+                    stepper.note_poll(rows_hint)
         for job in jobs:
             if job.get("id") in self._inflight:
                 # a lease-aware hive's starvation valve can redeliver a

@@ -384,5 +384,43 @@ def resume_step_histogram(registry: Registry | None = None) -> Histogram:
         buckets=RESUME_STEP_BUCKETS)
 
 
+def lane_resizes_counter(registry: Registry | None = None) -> Counter:
+    """Adaptive-width control-loop actions (ISSUE 7): lanes growing or
+    shrinking their row file at a step boundary, labeled by direction.
+
+    The closed loop's activity signal: a healthy loop resizes a handful
+    of times as traffic regime shifts; a high rate means the controller
+    is thrashing (occupancy oscillating around a threshold — raise the
+    patience knob or pin ``CHIASWARM_STEPPER_LANE_WIDTH``). Direction
+    split matters: all-grow means demand keeps outrunning capacity
+    (raise ``CHIASWARM_STEPPER_MAX_WIDTH``), all-shrink means the
+    initial width is habitually too large."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_stepper_lane_resizes_total",
+        "adaptive lane-width resizes at step boundaries, by direction",
+        labelnames=("direction",))
+
+
+def arrival_rate_gauge(registry: Registry | None = None) -> Gauge:
+    """The lane scheduler's arrival-rate EWMA (rows/second), the demand
+    half of the adaptive-width control signal (occupancy is the supply
+    half). Sampled at each control decision; 0 when lanes are idle."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_stepper_arrival_rate",
+        "EWMA of lane row arrivals per second (adaptive-width demand "
+        "signal)")
+
+
+def lane_admissions_counter(registry: Registry | None = None) -> Counter:
+    """Rows admitted into lanes, by workload (ISSUE 7: lanes serve
+    img2img/inpaint/controlnet alongside txt2img). The eligibility-
+    breadth proof: a workload stuck at 0 while its jobs flow means it is
+    falling back to the per-job path (check LaneReject logs)."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_stepper_lane_admissions_total",
+        "lane rows admitted, by workload kind",
+        labelnames=("workload",))
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

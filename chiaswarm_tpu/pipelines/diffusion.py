@@ -49,6 +49,8 @@ from chiaswarm_tpu.schedulers import (
     SamplingSchedule,
     make_noise_schedule,
     make_sampling_schedule,
+    reproject_known,
+    reproject_known_rows,
     resolve,
     sampler_step,
     sampler_step_rows,
@@ -135,6 +137,38 @@ def _params_mesh(params):
                 and s.mesh.devices.size > 1:
             return s.mesh
     return None
+
+
+def img2img_start_index(steps: int, strength: float) -> int:
+    """img2img strength -> denoise start index, the ONE quantization
+    (clip to [0.05, 1], round, never past the last step). Shared by the
+    solo program (below), the lane scheduler (serving/stepper.py) and
+    the ticket's observable ``denoise_steps`` (workloads/diffusion.py)
+    — resume validation keys on this value, so a drift between call
+    sites would force spurious clean restarts."""
+    strength = float(np.clip(strength, 0.05, 1.0))
+    return min(int(round(steps * (1.0 - strength))), steps - 1)
+
+
+def latent_mask(mask: np.ndarray, lh: int, lw: int,
+                downscale: int) -> np.ndarray:
+    """Arbitrary-size inpaint mask -> binarized (lh, lw) latent-grid mask
+    (1 = regenerate). Shared by the solo generate program's prep and the
+    lane admission path (serving/stepper.py) so an inpaint row's mask
+    quantization is identical wherever the job runs."""
+    mask = np.asarray(mask, dtype=np.float32)
+    if mask.shape != (lh, lw):
+        if mask.shape != (lh * downscale, lw * downscale):
+            # bring arbitrary mask sizes onto the bucketed pixel grid
+            from PIL import Image
+
+            mask = np.asarray(Image.fromarray(
+                (mask * 255).clip(0, 255).astype(np.uint8)
+            ).resize((lw * downscale, lh * downscale), Image.NEAREST),
+                dtype=np.float32) / 255.0
+        # downsample to the latent grid by box-averaging
+        mask = mask.reshape(lh, downscale, lw, downscale).mean((1, 3))
+    return (mask > 0.5).astype(np.float32)
 
 
 def _to_float_image(img: np.ndarray) -> np.ndarray:
@@ -374,8 +408,7 @@ class DiffusionPipeline:
                     keys, mkeys = jax.vmap(
                         lambda k: tuple(jax.random.split(k)))(keys)
                     renoise = draw(mkeys)
-                    known_t = known + renoise * sched.sigmas[i + 1]
-                    x = x * mask + known_t * (1.0 - mask)
+                    x = reproject_known(sched, i, x, known, mask, renoise)
                 return (x, state, keys), None
 
             n_steps = steps - start_step
@@ -505,26 +538,49 @@ class DiffusionPipeline:
                               "width": width}), build)
 
     def stepper_step_fn(self, *, batch: int, height: int, width: int,
-                        steps_cap: int, sampler: SamplerConfig):
+                        steps_cap: int, sampler: SamplerConfig,
+                        has_control: bool = False):
         """ONE denoise step over a full lane of ``batch`` rows.
 
         Per-row traced state: latents, carry keys, step index, start
         index, sigma/timestep tables (each row owns its ladder, padded to
-        ``steps_cap``), guidance scale, multistep history, active mask.
-        Inactive (padding / retired) rows compute and are discarded by
-        the mask — their carries freeze, so a row admitted into their
-        slot later starts clean. Classifier-free guidance is always
-        compiled in; per-row guidance rides as a traced vector.
+        ``steps_cap``), guidance scale, multistep history, active mask —
+        and, since ISSUE 7, the image-mode row state: ``known`` (clean
+        source latents), ``mask`` (latent-grid inpaint mask) and
+        ``mask_on`` (per-row flag selecting the inpaint re-projection).
+        Inpaint math is always compiled in and selected per ROW: rows
+        without a mask keep the txt2img/img2img carry-key trajectory
+        bit-for-bit (the second key split is computed but discarded), so
+        txt2img, img2img (nonzero per-row start index) and inpaint rows
+        share one lane program. Inactive (padding / retired) rows
+        compute and are discarded by the active mask — their carries
+        freeze, so a row admitted into their slot later starts clean.
+        Classifier-free guidance is always compiled in; per-row guidance
+        rides as a traced vector.
+
+        ``has_control`` compiles the ControlNet branch in: the lane then
+        additionally takes the bundle's params, a per-row pre-embedded
+        hint stack (``stepper_control_embed_fn``) and a per-row
+        conditioning-scale vector. Control lanes are keyed by bundle
+        (serving/stepper.py), so every row shares the branch params
+        while conditioning images/scales stay per row.
         """
         fam = self.c.family
         unet = self.c.unet
         lh, lw = self._latent_hw(height, width)
         needs_xl = fam.unet.addition_embed_dim is not None
 
+        control_net = None
+        if has_control:
+            from chiaswarm_tpu.models.controlnet import ControlNet
+
+            control_net = ControlNet(fam.unet)
+
         def build():
             def fn(params, ctx_u, ctx_c, pooled_u, pooled_c, x, carry_keys,
                    idx, start_idx, sigmas_tab, ts_tab, guidance,
-                   old_denoised, active):
+                   old_denoised, active, known, mask, mask_on,
+                   control_params, cond, cscale):
                 sched_rows = SamplingSchedule(sigmas=sigmas_tab,
                                               timesteps=ts_tab)
                 inp = scale_model_input_rows(sched_rows, x, idx)
@@ -541,7 +597,19 @@ class DiffusionPipeline:
                     added = {"time_ids": time_ids,
                              "text_embeds":
                                  pooled[:, : fam.unet.addition_pooled_dim]}
-                out = unet.apply(params["unet"], inp2, t2, ctx, added)
+                down_res = mid_res = None
+                if has_control:
+                    # per-row conditioning: hint embeddings and scales are
+                    # row state; the scale broadcasts (2B,1,1,1) over the
+                    # zero-conv residuals — scalar-scale solo math per row
+                    cond2 = jnp.concatenate([cond, cond], axis=0)
+                    scale2 = jnp.concatenate(
+                        [cscale, cscale]).reshape(-1, 1, 1, 1)
+                    down_res, mid_res = control_net.apply(
+                        control_params["net"], inp2, t2, ctx, cond2,
+                        added, scale2)
+                out = unet.apply(params["unet"], inp2, t2, ctx, added,
+                                 down_res, mid_res)
                 eps_u, eps_c = jnp.split(out, 2, axis=0)
                 eps = eps_u + guidance.reshape(-1, 1, 1, 1) * (eps_c - eps_u)
                 both = jax.vmap(jax.random.split)(carry_keys)
@@ -553,6 +621,20 @@ class DiffusionPipeline:
                     sampler, sched_rows, idx, x, eps,
                     SamplerState(old_denoised=old_denoised),
                     step_noise, start_idx)
+                # inpaint re-projection, selected per row: the masked
+                # variant (and its second key split) is computed for
+                # every row, applied only where mask_on — unmasked rows
+                # keep the single-split solo trajectory
+                both_m = jax.vmap(jax.random.split)(keys)
+                keys_m, mkeys = both_m[:, 0], both_m[:, 1]
+                renoise = jax.vmap(lambda k: jax.random.normal(
+                    k, (lh, lw, fam.vae.latent_channels), jnp.float32)
+                )(mkeys)
+                x_masked = reproject_known_rows(
+                    sched_rows, idx, x_next, known, mask, renoise)
+                m_img = mask_on.reshape(-1, 1, 1, 1)
+                x_next = jnp.where(m_img, x_masked, x_next)
+                keys = jnp.where(mask_on.reshape(-1, 1), keys_m, keys)
                 act = active.reshape(-1, 1, 1, 1)
                 x_next = jnp.where(act, x_next, x)
                 new_old = jnp.where(act, state.old_denoised, old_denoised)
@@ -566,7 +648,32 @@ class DiffusionPipeline:
             static_cache_key(id(self.c), "stepper_step",
                              {"batch": batch, "height": height,
                               "width": width, "steps_cap": steps_cap,
-                              "sampler": sampler}), build)
+                              "sampler": sampler,
+                              "has_control": has_control}), build)
+
+    def stepper_control_embed_fn(self, *, height: int, width: int):
+        """(embed_params, cond (1, H, W, 3) in [0, 1]) -> (1, lh, lw, C0)
+        hint embedding — the admission-time ControlNet prep. The embedder
+        is timestep-independent, so each job's conditioning image is
+        embedded ONCE here (exactly the solo program's hoisting) and the
+        result rides per row as lane state."""
+        fam = self.c.family
+
+        def build():
+            from chiaswarm_tpu.models.controlnet import ControlCondEmbedding
+
+            control_embed = ControlCondEmbedding(
+                fam.unet.block_out_channels[0],
+                downscale=fam.vae.downscale)
+
+            def fn(embed_params, cond):
+                return control_embed.apply(embed_params, cond)
+
+            return toplevel_jit(fn)
+
+        return GLOBAL_CACHE.cached_executable(
+            static_cache_key(id(self.c), "stepper_ctrl_embed",
+                             {"height": height, "width": width}), build)
 
     def stepper_decode_fn(self, *, batch: int, height: int, width: int):
         """Latents -> uint8 images for retiring rows — dispatched
@@ -643,12 +750,10 @@ class DiffusionPipeline:
             init_latent = jnp.zeros((1,), jnp.float32)  # placeholder
             mask_arr = jnp.zeros((1,), jnp.float32)
             if has_init:
-                strength = float(np.clip(req.strength, 0.05, 1.0))
                 if not has_mask and not fam.image_conditioned:
                     # img2img: skip the first (1-strength) of the ladder
                     # (pix2pix starts from pure noise instead)
-                    start_step = min(int(round(steps * (1.0 - strength))),
-                                     steps - 1)
+                    start_step = img2img_start_index(steps, req.strength)
                 init = np.asarray(req.init_image)
                 if init.ndim == 4 and init.shape[1:3] != (height, width) or \
                    init.ndim == 3 and init.shape[:2] != (height, width):
@@ -673,28 +778,13 @@ class DiffusionPipeline:
                     init_latent = jnp.concatenate([z, pad], axis=0)
             if has_mask:
                 lh, lw = self._latent_hw(height, width)
-
-                def latent_mask(m: np.ndarray) -> np.ndarray:
-                    if m.shape != (lh, lw):
-                        f = fam.vae.downscale
-                        if m.shape != (lh * f, lw * f):
-                            # bring arbitrary mask sizes onto the bucketed
-                            # pixel grid
-                            from PIL import Image
-
-                            m = np.asarray(Image.fromarray(
-                                (m * 255).clip(0, 255).astype(np.uint8)
-                            ).resize((lw * f, lh * f), Image.NEAREST),
-                                dtype=np.float32) / 255.0
-                        # downsample to the latent grid by box-averaging
-                        m = m.reshape(lh, f, lw, f).mean((1, 3))
-                    return (m > 0.5).astype(np.float32)
-
+                f = fam.vae.downscale
                 m = np.asarray(req.mask, dtype=np.float32)
                 if req.init_groups is not None:
                     # per-JOB masks -> per-row stack, padded to the bucket
                     rows_m = np.concatenate([
-                        np.repeat(latent_mask(m[j])[None], n_rows, axis=0)
+                        np.repeat(latent_mask(m[j], lh, lw, f)[None],
+                                  n_rows, axis=0)
                         for j, (_, n_rows) in enumerate(req.init_groups)])
                     if rows_m.shape[0] < batch:
                         rows_m = np.concatenate(
@@ -702,7 +792,8 @@ class DiffusionPipeline:
                                                batch - rows_m.shape[0], 0)])
                     mask_arr = jnp.asarray(rows_m)[:, :, :, None]
                 else:
-                    mask_arr = jnp.asarray(latent_mask(m))[None, :, :, None]
+                    mask_arr = jnp.asarray(
+                        latent_mask(m, lh, lw, f))[None, :, :, None]
 
             has_control = req.controlnet is not None
             control_params = {"zero": jnp.zeros((1,), jnp.float32)}

@@ -23,6 +23,8 @@ from chiaswarm_tpu.schedulers.sampling import (
     make_sampling_schedule,
     scale_model_input,
     scale_model_input_rows,
+    reproject_known,
+    reproject_known_rows,
     sampler_step,
     sampler_step_rows,
     init_noise_scale,
@@ -40,6 +42,8 @@ __all__ = [
     "make_sampling_schedule",
     "scale_model_input",
     "scale_model_input_rows",
+    "reproject_known",
+    "reproject_known_rows",
     "sampler_step",
     "sampler_step_rows",
     "init_noise_scale",

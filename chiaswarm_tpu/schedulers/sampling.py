@@ -185,6 +185,29 @@ def sampler_step(
     return x_next.astype(sample.dtype), SamplerState(old_denoised=denoised.astype(sample.dtype))
 
 
+def reproject_known(sched: SamplingSchedule, i: jnp.ndarray,
+                    sample: jnp.ndarray, known: jnp.ndarray,
+                    mask: jnp.ndarray, renoise: jnp.ndarray) -> jnp.ndarray:
+    """Model-agnostic ("legacy") inpainting step: after the sampler step
+    to noise level ``i+1``, re-noise the clean source latents onto that
+    level and paste them into the kept (mask == 0) region. ``mask`` is 1
+    where the model regenerates. One function shared by the solo denoise
+    scan (pipelines/diffusion.py) and the per-row lane step below, so an
+    inpaint row's trajectory in a lane is the solo math by construction."""
+    known_t = known + renoise * sched.sigmas[i + 1]
+    return sample * mask + known_t * (1.0 - mask)
+
+
+def reproject_known_rows(sched: SamplingSchedule, i: jnp.ndarray,
+                         sample: jnp.ndarray, known: jnp.ndarray,
+                         mask: jnp.ndarray,
+                         renoise: jnp.ndarray) -> jnp.ndarray:
+    """Per-row :func:`reproject_known`: each row carries its own sigma
+    ladder (B, S+1) and step index (B,) — inpaint rows at different
+    ladder positions coexist in one lane program (serving/stepper.py)."""
+    return jax.vmap(reproject_known)(sched, i, sample, known, mask, renoise)
+
+
 def scale_model_input_rows(sched: SamplingSchedule, sample: jnp.ndarray,
                            i: jnp.ndarray) -> jnp.ndarray:
     """Per-row :func:`scale_model_input`: every array in ``sched`` carries

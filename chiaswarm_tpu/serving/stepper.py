@@ -10,14 +10,33 @@ per call over a fixed lane width of rows; incoming jobs splice into free
 row slots at the next step boundary, finished rows retire early and their
 VAE decode + host transfer overlap the ongoing UNet steps.
 
-Per-row traced state (latents, carry keys, step index, sigma/timestep
-tables, guidance, multistep history, active mask) makes rows at different
-progress — and with different step counts — coexist in one program; the
-per-row math is a ``vmap`` of the solo sampler step, so every row walks
-exactly its solo trajectory (the numerical-equivalence gate,
-tests/test_stepper.py). Admission never compiles: the four lane
-executables (encode / row-init / step / decode,
-pipelines/diffusion.py ``stepper_*_fn``) are keyed by buckets alone.
+Per-row traced state (latents, carry keys, step index, START index,
+sigma/timestep tables, guidance, multistep history, inpaint mask/known
+stacks, ControlNet hint embeddings, active mask) makes rows at different
+progress — and with different step counts and WORKLOADS — coexist in one
+program; the per-row math is a ``vmap`` of the solo sampler step, so
+every row walks exactly its solo trajectory (the numerical-equivalence
+gate, tests/test_stepper.py). Since ISSUE 7 lanes are the ENGINE, not
+the experiment: the default is ON (``CHIASWARM_STEPPER=0`` opts out and
+restores the burst/solo routing), and eligibility spans txt2img,
+img2img (per-row denoise start indices), inpaint (per-row mask + clean
+latents, reprojected by the shared sampler helper) and ControlNet
+(bundle-keyed lanes; per-row hint embeddings + conditioning scales).
+Admission never compiles: the lane executables (encode / row-init /
+control-embed / step / decode, pipelines/diffusion.py ``stepper_*_fn``)
+are keyed by buckets alone.
+
+Lane capacity is a CLOSED LOOP (ISSUE 7c): instead of a fixed width,
+each lane carries a :class:`LaneWidthController` that follows the
+scheduler's arrival-rate EWMA (fed by submissions) plus the worker
+poll loop's short-lived row hints, and the lane's occupancy EWMA — the
+same signal the
+``chiaswarm_stepper_lane_occupancy_ratio`` histogram exports. Lanes
+grow when pending rows cannot fit (or occupancy stays high while
+arrivals continue) and shrink when occupancy stays low, ONLY at step
+boundaries, and only onto the pow2 width lattice the compile cache
+already buckets by — so a resize reuses (or compiles once, bounded) a
+lattice program, and admission itself still never compiles.
 
 Fault containment composes with the PR-2 machinery: a failed lane fails
 every resident row's future — the executor falls back to the per-job path
@@ -42,9 +61,13 @@ bit-exact.
 Knobs (operator guide: README "Continuous batching" and "Fleet
 operations"):
 
-- ``CHIASWARM_STEPPER=1``  enable lane routing (default off)
-- ``CHIASWARM_STEPPER_LANE_WIDTH``  rows per lane (default: the slot's
-  data width x the measured per-chip profitable batch, pow2-bucketed)
+- ``CHIASWARM_STEPPER=0``  opt OUT of lane routing (default on)
+- ``CHIASWARM_STEPPER_LANE_WIDTH``  PIN rows per lane (disables the
+  adaptive controller; unset = adaptive width over the pow2 lattice)
+- ``CHIASWARM_STEPPER_ADAPTIVE=0``  disable adaptive width without
+  pinning (lanes stay at their initial width)
+- ``CHIASWARM_STEPPER_MIN_WIDTH`` / ``_MAX_WIDTH``  adaptive bounds
+  (defaults: 1 and 4x the slot-saturation heuristic, pow2-bucketed)
 - ``CHIASWARM_STEPPER_ROW_DEADLINE_S``  per-row in-lane deadline (600)
 - ``CHIASWARM_STEPPER_IDLE_S``  idle grace before a lane retires (15)
 - ``CHIASWARM_STEPPER_CKPT_EVERY``  steps between lane checkpoints
@@ -71,7 +94,10 @@ import numpy as np
 
 from chiaswarm_tpu.obs.metrics import (
     REGISTRY,
+    arrival_rate_gauge,
+    lane_admissions_counter,
     lane_occupancy_histogram,
+    lane_resizes_counter,
     resume_step_histogram,
 )
 from chiaswarm_tpu.obs.profiling import annotate
@@ -106,14 +132,34 @@ _CKPT_SECONDS = REGISTRY.histogram(
     "chiaswarm_stepper_checkpoint_seconds",
     "wall time of one lane checkpoint snapshot (device->host + spool)",
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+# adaptive-width control loop (ISSUE 7c): resize actions, the demand
+# EWMA, and per-workload admission breadth — declared in obs/metrics.py
+_LANE_RESIZES = lane_resizes_counter()
+_ARRIVAL_RATE = arrival_rate_gauge()
+_LANE_ADMISSIONS = lane_admissions_counter()
 
 ENV_ENABLE = "CHIASWARM_STEPPER"
 ENV_LANE_WIDTH = "CHIASWARM_STEPPER_LANE_WIDTH"
+ENV_ADAPTIVE = "CHIASWARM_STEPPER_ADAPTIVE"
+ENV_MIN_WIDTH = "CHIASWARM_STEPPER_MIN_WIDTH"
+ENV_MAX_WIDTH = "CHIASWARM_STEPPER_MAX_WIDTH"
 ENV_ROW_DEADLINE = "CHIASWARM_STEPPER_ROW_DEADLINE_S"
 ENV_IDLE_S = "CHIASWARM_STEPPER_IDLE_S"
 ENV_SHARD_ROWS = "CHIASWARM_STEPPER_SHARD_ROWS"
 ENV_CKPT_EVERY = "CHIASWARM_STEPPER_CKPT_EVERY"
 ENV_STEP_DELAY = "CHIASWARM_STEPPER_STEP_DELAY_S"
+
+#: lane workload kinds (the ``workload`` label vocabulary)
+WORKLOADS = ("txt2img", "img2img", "inpaint", "controlnet")
+
+# pre-seed every label vocabulary at import so the control-loop families
+# render zeroes from the FIRST /metrics scrape (dashboards need the
+# zeroes — the ISSUE-6 convention for the lease/resume families)
+_ARRIVAL_RATE.set(0.0)
+for _direction in ("grow", "shrink"):
+    _LANE_RESIZES.inc(0, direction=_direction)
+for _workload in WORKLOADS:
+    _LANE_ADMISSIONS.inc(0, workload=_workload)
 
 
 # ---- resume-state packing ------------------------------------------------
@@ -143,10 +189,23 @@ class ResumeReject(RuntimeError):
 
 
 def stepper_enabled() -> bool:
-    """Continuous batching is opt-in: the burst-coalescing path stays the
-    default until lanes are enabled (worker env / operator config)."""
-    return os.environ.get(ENV_ENABLE, "").strip().lower() in (
-        "1", "true", "on", "yes")
+    """Continuous batching is the DEFAULT engine (ISSUE 7): eligible
+    diffusion jobs ride lanes unless the operator opts out with
+    ``CHIASWARM_STEPPER=0``, which restores the pre-lane burst/solo
+    routing end to end (the per-job fallback path is unchanged either
+    way)."""
+    return os.environ.get(ENV_ENABLE, "").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def adaptive_enabled() -> bool:
+    """Adaptive lane width is on by default; a pinned
+    ``CHIASWARM_STEPPER_LANE_WIDTH`` or ``CHIASWARM_STEPPER_ADAPTIVE=0``
+    turns the controller off (lanes then keep their creation width)."""
+    if os.environ.get(ENV_LANE_WIDTH, "").strip():
+        return False
+    return os.environ.get(ENV_ADAPTIVE, "").strip().lower() not in (
+        "0", "false", "off", "no")
 
 
 class LaneReject(RuntimeError):
@@ -189,6 +248,127 @@ class _RowJob:                    # must never compare device/numpy fields
     # history ``old0`` instead of freshly drawn noise at step 0
     resume_step: int = 0
     old0: Any = None
+    # workload row state (ISSUE 7b): img2img rows start partway down the
+    # ladder; inpaint rows carry their latent-grid mask + clean source
+    # latents; ControlNet rows carry the pre-embedded hint + scale
+    workload: str = "txt2img"
+    start_step: int = 0
+    known0: Any = None          # (n, lh, lw, C) clean init latents
+    mask0: Any = None           # (n, lh, lw, 1) latent mask, 1=regenerate
+    cond0: Any = None           # (n, lh, lw, C0) pre-embedded hint
+    cscale: float = 1.0         # ControlNet conditioning scale
+
+    @property
+    def idx0(self) -> int:
+        """Ladder index a freshly admitted row begins at: the recorded
+        resume step for redelivered rows, else the workload's start
+        index (0 for txt2img/inpaint, strength-derived for img2img)."""
+        return self.resume_step if self.resume_step > 0 else self.start_step
+
+
+class LaneWidthController:
+    """Closed-loop lane capacity (ISSUE 7c): width follows demand.
+
+    Two signals, one actuator. Demand is the scheduler's arrival-rate
+    EWMA (rows/sec, fed by submissions and the worker's poll hints);
+    supply is the lane's occupancy EWMA — the per-step ratio the
+    ``chiaswarm_stepper_lane_occupancy_ratio`` histogram exports.
+    Decisions land ONLY at step boundaries (the driver calls
+    :meth:`decide` between dispatches — a lane mid-step is untouchable
+    by construction) and only onto the pow2 width lattice, so the
+    program set stays bounded by the compile-cache buckets:
+
+    - **grow under burst**: pending rows that cannot fit the free slots
+      resize immediately to the bucket that holds them; sustained
+      occupancy >= ``grow_at`` with arrivals still flowing doubles the
+      width ahead of the queue.
+    - **shrink under trickle**: occupancy <= ``shrink_at`` for
+      ``patience`` consecutive boundaries with nothing pending halves
+      the width — padding rows are batched UNet FLOPs burned, the
+      exact waste BENCH r05's 0.33 padding ratio measures.
+    - bounds are clamped per decision, so an OOM width-limit recorded
+      by the scheduler (``note_oom`` halving) is respected even when it
+      arrives between boundaries.
+
+    Pure host arithmetic on an injected clock — unit-testable without
+    lanes (tests/test_stepper.py::TestLaneWidthController)."""
+
+    def __init__(self, *, min_width: int = 1, max_width: int = 128,
+                 alpha: float = 0.25, grow_at: float = 0.875,
+                 shrink_at: float = 0.375, patience: int = 6,
+                 rate_window_s: float = 10.0) -> None:
+        self.min_width = max(1, int(min_width))
+        self.max_width = max(self.min_width, int(max_width))
+        self.alpha = float(alpha)
+        self.grow_at = float(grow_at)
+        self.shrink_at = float(shrink_at)
+        self.patience = max(1, int(patience))
+        self.rate_window_s = float(rate_window_s)
+        self.occ_ewma = 0.0
+        # EWMA-driven moves need ``patience`` boundaries of evidence
+        # from birth too — only the pending-cannot-fit burst reaction
+        # is allowed to act immediately
+        self._boundaries_since_resize = 0
+
+    def decide(self, width: int, occupied: int, pending_rows: int,
+               rate: float, *, max_width: int | None = None) -> int:
+        """Target width for the NEXT step, given current occupancy,
+        rows waiting at the gate, and the arrival-rate EWMA. Returns
+        ``width`` unchanged when the loop holds steady."""
+        from chiaswarm_tpu.core.compile_cache import bucket_batch
+
+        hi = self.max_width if max_width is None else max(1, min(
+            self.max_width, int(max_width)))
+        lo = min(self.min_width, hi)
+        self.occ_ewma += self.alpha * (occupied / max(1, width)
+                                       - self.occ_ewma)
+        self._boundaries_since_resize += 1
+        target = width
+        need = occupied + pending_rows
+        if need > width:
+            # burst reaction: pending rows must not queue behind a full
+            # lane when a wider lattice program can hold them now
+            target = bucket_batch(min(need, hi))
+        elif self._boundaries_since_resize >= self.patience:
+            if (self.occ_ewma >= self.grow_at and rate > 0.0
+                    and width * 2 <= hi):
+                target = width * 2
+            elif (self.occ_ewma <= self.shrink_at and pending_rows == 0
+                    and occupied <= width // 2 and width > lo):
+                target = width // 2
+        target = max(lo, min(hi, bucket_batch(max(1, target))))
+        target = max(target, bucket_batch(max(1, occupied)))
+        if target != width:
+            self._boundaries_since_resize = 0
+            # re-seed the EWMA at the post-resize ratio so one resize
+            # does not immediately argue for the next
+            self.occ_ewma = occupied / max(1, target)
+        return target
+
+
+class _ArrivalEwma:
+    """Rows/second EWMA over inter-arrival gaps, decayed while idle —
+    the scheduler-level demand signal the width controllers read. All
+    methods take an explicit monotonic ``now`` (testable on a fake
+    clock; obs R8 forbids wallclock deltas anyway)."""
+
+    def __init__(self, window_s: float = 10.0) -> None:
+        self.window_s = float(window_s)
+        self._rate = 0.0
+        self._last: float | None = None
+
+    def note(self, rows: int, now: float) -> None:
+        if self._last is not None:
+            gap = max(now - self._last, 1e-3)
+            decay = 0.5 ** (gap / self.window_s)
+            self._rate = decay * self._rate + (1.0 - decay) * (rows / gap)
+        self._last = now
+
+    def rate(self, now: float) -> float:
+        if self._last is None:
+            return 0.0
+        return self._rate * 0.5 ** (max(now - self._last, 0.0)
+                                    / self.window_s)
 
 
 class Lane:
@@ -199,7 +379,8 @@ class Lane:
 
     def __init__(self, sched: "StepScheduler", key: tuple, pipe,
                  *, width: int, height: int, width_px: int,
-                 steps_cap: int, sampler) -> None:
+                 steps_cap: int, sampler, control: Any = None,
+                 width_bounds: tuple[int, int] | None = None) -> None:
         self._sched = sched
         self.key = key
         self.pipe = pipe
@@ -208,6 +389,9 @@ class Lane:
         self.width_px = int(width_px)
         self.steps_cap = int(steps_cap)
         self.sampler = sampler
+        # ControlNet lanes are keyed by bundle: every row shares the
+        # branch params; hint embeddings + scales stay per row
+        self.ctrl = control
         self.lane_id = next(Lane._ids)
         self._cond = threading.Condition()
         self._pending: collections.deque[_RowJob] = collections.deque()
@@ -215,6 +399,12 @@ class Lane:
         self._stop = False
         self._retired = False
         self.steps_executed = 0
+        # adaptive capacity (ISSUE 7c): decisions land at step
+        # boundaries only; bounds come from the scheduler's policy and
+        # are re-clamped per decision by the OOM width limits
+        self._adaptive = adaptive_enabled()
+        lo, hi = width_bounds if width_bounds else (self.width, self.width)
+        self._ctl = LaneWidthController(min_width=lo, max_width=hi)
         # host mirrors of the slow-changing per-row inputs (rebuilt on
         # device only when admission/retirement changes them)
         self._h_start = np.zeros(self.width, np.int32)
@@ -223,6 +413,8 @@ class Lane:
         self._h_ts = np.zeros((self.width, self.steps_cap), np.float32)
         self._h_guid = np.ones(self.width, np.float32)
         self._h_active = np.zeros(self.width, bool)
+        self._h_mask_on = np.zeros(self.width, bool)
+        self._h_cscale = np.ones(self.width, np.float32)
         self._dev = None  # device state dict, allocated at first admission
         self._mesh = None
         self._deferred_counts: list[dict] = []
@@ -279,10 +471,17 @@ class Lane:
         idle_since: float | None = None
         try:
             while True:
+                # scheduler-side control signals, read OUTSIDE the lane
+                # lock (sched._lock nests inside submitters holding it
+                # while they wait on this lane's cond — taking it under
+                # self._cond would invert the order and deadlock)
+                width_limit = self._sched.width_limit_for(self.key)
+                rate, hint_rows = self._sched.demand_signal()
                 with self._cond:
                     while True:
                         if self._stop:
                             raise LaneRetired("lane stopped")
+                        self._resize_locked(width_limit, rate, hint_rows)
                         self._admit_locked()
                         if self._h_active.any():
                             idle_since = None
@@ -361,6 +560,16 @@ class Lane:
             "pooled_c": (placeholder if job.pooled_c is None else
                          jnp.zeros((self.width,) + job.pooled_c.shape[1:],
                                    job.pooled_c.dtype)),
+            # image-mode row state (ISSUE 7b): clean source latents +
+            # latent mask for inpaint rows; mask=1 everywhere keeps
+            # non-inpaint rows untouched if the selection ever engages
+            "known": zero_row,
+            "mask": jnp.ones((self.width, lh, lw, 1), jnp.float32),
+            # pre-embedded ControlNet hint rows (control lanes only; a
+            # placeholder rides through the no-control step signature)
+            "cond": (placeholder if job.cond0 is None else
+                     jnp.zeros((self.width,) + job.cond0.shape[1:],
+                               job.cond0.dtype)),
         }
         self._sync_tables()
 
@@ -397,6 +606,8 @@ class Lane:
         dev["ts"] = jnp.asarray(self._h_ts.copy())
         dev["guid"] = jnp.asarray(self._h_guid.copy())
         dev["active"] = jnp.asarray(self._h_active.copy())
+        dev["mask_on"] = jnp.asarray(self._h_mask_on.copy())
+        dev["cscale"] = jnp.asarray(self._h_cscale.copy())
 
     def _admit_locked(self) -> None:
         """Splice pending jobs into free row slots — the step boundary is
@@ -415,7 +626,8 @@ class Lane:
             # results when a program consumes another thread's still-
             # compiling outputs, so the barrier is correctness, not style.
             for arr in (job.x0, job.keys0, job.ctx_u, job.ctx_c,
-                        job.pooled_u, job.pooled_c, job.old0):
+                        job.pooled_u, job.pooled_c, job.old0,
+                        job.known0, job.mask0, job.cond0):
                 if arr is not None:
                     arr.block_until_ready()
             slots, free = free[:job.n_rows], free[job.n_rows:]
@@ -427,19 +639,29 @@ class Lane:
             dev["x"] = dev["x"].at[sel].set(job.x0)
             dev["keys"] = dev["keys"].at[sel].set(job.keys0)
             # a resumed row restores its multistep history and rejoins
-            # at step k; a fresh row starts clean at step 0 — both
-            # through the one admission path (the step program never
-            # knows the difference)
+            # at step k; a fresh row starts clean at its workload's
+            # start index — both through the one admission path (the
+            # step program never knows the difference)
             dev["old"] = dev["old"].at[sel].set(
                 jnp.zeros_like(job.x0) if job.old0 is None else job.old0)
-            dev["idx"] = dev["idx"].at[sel].set(job.resume_step)
+            dev["idx"] = dev["idx"].at[sel].set(job.idx0)
             dev["ctx_u"] = dev["ctx_u"].at[sel].set(job.ctx_u)
             dev["ctx_c"] = dev["ctx_c"].at[sel].set(job.ctx_c)
             if job.pooled_u is not None:
                 dev["pooled_u"] = dev["pooled_u"].at[sel].set(job.pooled_u)
                 dev["pooled_c"] = dev["pooled_c"].at[sel].set(job.pooled_c)
-            self._h_idx[sel] = job.resume_step
-            self._h_start[sel] = 0
+            dev["known"] = dev["known"].at[sel].set(
+                jnp.zeros_like(job.x0) if job.known0 is None
+                else job.known0)
+            dev["mask"] = dev["mask"].at[sel].set(
+                jnp.ones_like(dev["mask"][sel]) if job.mask0 is None
+                else job.mask0)
+            if job.cond0 is not None:
+                dev["cond"] = dev["cond"].at[sel].set(job.cond0)
+            self._h_idx[sel] = job.idx0
+            self._h_start[sel] = job.start_step
+            self._h_mask_on[sel] = job.mask0 is not None
+            self._h_cscale[sel] = job.cscale
             self._h_sig[sel, :] = 0.0
             self._h_sig[sel, : job.steps + 1] = job.sigmas
             self._h_ts[sel, :] = 0.0
@@ -452,6 +674,9 @@ class Lane:
                 self._rows[s] = job
             job.slots = slots
             job.admitted_at_step = self.steps_executed
+            # workload-labeled admission breadth (metric-local lock
+            # only — safe under self._cond)
+            _LANE_ADMISSIONS.inc(job.n_rows, workload=job.workload)
             # deferred: _admit_locked runs under self._cond while
             # submitters hold sched._lock and wait on self._cond —
             # taking sched._lock (inside _count) HERE would deadlock
@@ -459,18 +684,111 @@ class Lane:
                 rows_admitted=job.n_rows,
                 rows_admitted_midflight=(job.n_rows if mid_flight
                                          else 0),
-                rows_resumed=(job.n_rows if job.resume_step > 0 else 0)))
+                rows_resumed=(job.n_rows if job.resume_step > 0 else 0),
+                **{f"rows_admitted_{job.workload}": job.n_rows}))
             if job.resume_step > 0:
                 _RESUME_STEP.observe(job.resume_step)
                 log.info("job %s resumed at step %d/%d (%d row(s))",
                          job.job_id, job.resume_step, job.steps,
                          job.n_rows)
 
+    def _resize_locked(self, width_limit: int | None, rate: float,
+                       hint_rows: int) -> None:
+        """Adaptive capacity, applied ONLY here — between dispatches, so
+        a step in flight never sees its row file change under it. Runs
+        under ``self._cond`` (mutates ``_rows``/host mirrors submitters
+        read); the scheduler-side signals were prefetched lock-free by
+        the driver. Sharded-row lanes skip (their width must divide the
+        mesh data axis; ROADMAP item 2)."""
+        if not self._adaptive or self._mesh is not None:
+            return
+        occupied = sum(r is not None for r in self._rows)
+        pending = sum(j.n_rows for j in self._pending
+                      if not j.future.cancelled())
+        target = self._ctl.decide(self.width, occupied,
+                                  pending + max(0, hint_rows), rate,
+                                  max_width=width_limit)
+        if target == self.width:
+            return
+        self._apply_resize_locked(target)
+
+    def _apply_resize_locked(self, new_width: int) -> None:
+        """Rebuild the row file at ``new_width``: resident rows compact
+        onto the first slots (their device state gathered across), host
+        mirrors re-seed, and the next dispatch fetches the lattice
+        program for the new batch — a cache hit after the first resize
+        to any given width."""
+        import jax.numpy as jnp
+
+        old_width, self.width = self.width, int(new_width)
+        occupied = [(s, self._rows[s]) for s in range(old_width)
+                    if self._rows[s] is not None]
+        grow = self.width > old_width
+        log.info("lane %d %s %d -> %d rows (%d resident)", self.lane_id,
+                 "grows" if grow else "shrinks", old_width, self.width,
+                 len(occupied))
+        _LANE_RESIZES.inc(direction="grow" if grow else "shrink")
+        self._deferred_counts.append(dict(lane_resizes=1))
+        old_h = (self._h_start, self._h_idx, self._h_sig, self._h_ts,
+                 self._h_guid, self._h_active, self._h_mask_on,
+                 self._h_cscale)
+        self._h_start = np.zeros(self.width, np.int32)
+        self._h_idx = np.zeros(self.width, np.int32)
+        self._h_sig = np.ones((self.width, self.steps_cap + 1), np.float32)
+        self._h_ts = np.zeros((self.width, self.steps_cap), np.float32)
+        self._h_guid = np.ones(self.width, np.float32)
+        self._h_active = np.zeros(self.width, bool)
+        self._h_mask_on = np.zeros(self.width, bool)
+        self._h_cscale = np.ones(self.width, np.float32)
+        new_mirrors = (self._h_start, self._h_idx, self._h_sig, self._h_ts,
+                       self._h_guid, self._h_active, self._h_mask_on,
+                       self._h_cscale)
+        for new_s, (old_s, _) in enumerate(occupied):
+            for old_m, new_m in zip(old_h, new_mirrors):
+                new_m[new_s] = old_m[old_s]
+        self._rows = [None] * self.width
+        for new_s, (_, job) in enumerate(occupied):
+            self._rows[new_s] = job
+        for job in {id(j): j for _, j in occupied}.values():
+            job.slots = [s for s, (_, j) in enumerate(occupied) if j is job]
+        if self._dev is not None:
+            sel = jnp.asarray([old_s for old_s, _ in occupied]
+                              or [0])[: len(occupied) or None]
+
+            def remap(name, arr):
+                # non-XL pooled / no-control placeholders are exactly
+                # the 1-D (1,) arrays under these keys: pass them
+                # through BY NAME — shape alone misreads them as row
+                # state when old_width == 1, and padding a placeholder
+                # would change a traced input shape (a recompile no
+                # fresh lane ever pays)
+                if name in ("pooled_u", "pooled_c", "cond") and \
+                        getattr(arr, "ndim", 0) == 1:
+                    return arr
+                if occupied:
+                    taken = jnp.take(arr, sel, axis=0)
+                else:
+                    taken = arr[:0]
+                pad_n = self.width - int(taken.shape[0])
+                if pad_n <= 0:
+                    return taken
+                pad = jnp.zeros((pad_n,) + tuple(arr.shape[1:]), arr.dtype)
+                return jnp.concatenate([taken, pad], axis=0)
+
+            self._dev = {k: remap(k, v) for k, v in self._dev.items()}
+            self._sync_tables()
+            self._place_rows()
+
     def _dispatch_step(self) -> None:
         dev = self._dev
         fn = self.pipe.stepper_step_fn(
             batch=self.width, height=self.height, width=self.width_px,
-            steps_cap=self.steps_cap, sampler=self.sampler)
+            steps_cap=self.steps_cap, sampler=self.sampler,
+            has_control=self.ctrl is not None)
+        import jax.numpy as jnp
+
+        ctrl_params = (self.ctrl.params if self.ctrl is not None
+                       else {"zero": jnp.zeros((1,), jnp.float32)})
         t0 = time.perf_counter()
         with annotate("swarm.lane.step"):
             dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
@@ -480,6 +798,8 @@ class Lane:
                 dev["x"], dev["keys"], dev["idx"],
                 dev["start"], dev["sig"], dev["ts"], dev["guid"],
                 dev["old"], dev["active"],
+                dev["known"], dev["mask"], dev["mask_on"],
+                ctrl_params, dev["cond"], dev["cscale"],
             )
         active = int(self._h_active.sum())
         self._h_idx[self._h_active] += 1
@@ -584,7 +904,7 @@ class Lane:
         for job in jobs.values():
             sel = list(job.slots)
             step = int(self._h_idx[sel[0]])
-            if step <= 0 or step >= job.steps:
+            if step <= job.start_step or step >= job.steps:
                 continue  # nothing to resume yet / rows about to retire
             state = {
                 "version": 1, "kind": "lane",
@@ -592,6 +912,11 @@ class Lane:
                 "rows": int(job.n_rows),
                 "height": int(self.height), "width": int(self.width_px),
                 "guidance": float(job.guidance),
+                # workload identity (ISSUE 7b): a resumed img2img row
+                # must rejoin the SAME truncated ladder; mask/known/hint
+                # state re-derives from the redelivered job's own inputs
+                "workload": str(job.workload),
+                "start": int(job.start_step),
                 "x": pack_array(x[sel]),
                 "keys": pack_array(keys[sel]),
                 "old": pack_array(old[sel]),
@@ -687,11 +1012,22 @@ class StepScheduler:
         # (key -> width) of recently failed lanes: note_oom must still
         # find the lane that just died even after _lane_done removed it
         self._failed_lane_hints: dict[tuple, int] = {}
+        # adaptive-width demand signal (ISSUE 7c): submissions feed the
+        # rows/sec EWMA; the worker's poll loop leaves a short-lived
+        # rows hint so lanes can grow BEFORE the formatted submissions
+        # land — the poll-loop / step-boundary merge
+        self._arrivals = _ArrivalEwma()
+        self._poll_hint_rows = 0
+        self._poll_hint_t = float("-inf")
         _register_for_exit(self)
 
     # ---- policy ----
 
     def lane_width(self, height: int, width: int) -> int:
+        """Pinned width (``CHIASWARM_STEPPER_LANE_WIDTH``) or the static
+        slot-saturation heuristic: data width x the measured per-chip
+        profitable batch, pow2-bucketed. With the adaptive controller on
+        this is only the anchor for :meth:`width_bounds`."""
         env = os.environ.get(ENV_LANE_WIDTH, "").strip()
         if env:
             width_rows = int(env)
@@ -704,8 +1040,82 @@ class StepScheduler:
             width_rows = bucket_batch(max(2, data_width * per_device))
         return max(1, width_rows)
 
+    def width_bounds(self, height: int, width: int) -> tuple[int, int]:
+        """(min, max) lane width for the adaptive controller. Defaults:
+        1 to 4x the saturation heuristic (pow2, capped at the batch
+        lattice maximum) — wide enough that the closed loop, not a
+        static guess, decides how much padding a traffic mix pays.
+        Pinned width collapses the range to a point."""
+        from chiaswarm_tpu.core.compile_cache import bucket_batch
+
+        if not adaptive_enabled():
+            pinned = self.lane_width(height, width)
+            return pinned, pinned
+        env_min = os.environ.get(ENV_MIN_WIDTH, "").strip()
+        env_max = os.environ.get(ENV_MAX_WIDTH, "").strip()
+        lo = max(1, int(env_min)) if env_min else 1
+        if env_max:
+            hi = bucket_batch(min(128, max(1, int(env_max))))
+        else:
+            hi = bucket_batch(min(128, 4 * self.lane_width(height, width)))
+        return min(lo, hi), max(lo, hi)
+
+    def initial_width(self, rows: int, height: int, width: int) -> int:
+        """A fresh lane opens just big enough for its first job (plus
+        headroom for one more) and lets the controller follow demand
+        from there — idle-start lanes must not pay a saturation-sized
+        padding bill while traffic ramps."""
+        from chiaswarm_tpu.core.compile_cache import bucket_batch
+
+        lo, hi = self.width_bounds(height, width)
+        if not adaptive_enabled():
+            return hi
+        return max(lo, min(hi, bucket_batch(max(2, int(rows)))))
+
     def row_deadline_s(self) -> float:
         return float(os.environ.get(ENV_ROW_DEADLINE, "600") or 600)
+
+    # ---- demand signal (adaptive width, ISSUE 7c) ----
+
+    def _note_arrival(self, rows: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._arrivals.note(int(rows), now)
+            # the hinted rows have (partly) landed as real submissions:
+            # burn the hint down so lanes never count the same rows as
+            # both pending AND hinted (which would over-grow the width)
+            self._poll_hint_rows = max(0, self._poll_hint_rows - int(rows))
+            rate = self._arrivals.rate(now)
+        _ARRIVAL_RATE.set(rate)
+
+    def note_poll(self, jobs: int, now: float | None = None) -> None:
+        """Worker poll hook: a poll just returned ``jobs`` jobs, so that
+        many rows are about to be formatted and submitted. Lanes read
+        the hint at their next step boundary and can grow BEFORE the
+        submissions land — the queue never waits out a full lane."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._poll_hint_rows = max(0, int(jobs))
+            self._poll_hint_t = now
+
+    def demand_signal(self, now: float | None = None) -> tuple[float, int]:
+        """(arrival-rate EWMA rows/sec, fresh poll-hint rows) — read by
+        lane drivers lock-free relative to the lanes (only the scheduler
+        lock is taken, never a lane's)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            rate = self._arrivals.rate(now)
+            hint = (self._poll_hint_rows
+                    if now - self._poll_hint_t <= 2.0 else 0)
+        _ARRIVAL_RATE.set(rate)
+        return rate, hint
+
+    def width_limit_for(self, key: tuple) -> int | None:
+        """The OOM-halving width cap for ``key`` (note_oom), read by the
+        lane driver before each boundary so limits recorded mid-flight
+        clamp the very next resize decision."""
+        with self._lock:
+            return self._width_limits.get(key)
 
     # ---- submission ----
 
@@ -716,11 +1126,26 @@ class StepScheduler:
                        scheduler: str | None = None,
                        deadline_s: float | None = None,
                        job_id: Any = None,
-                       resume: dict[str, Any] | None = None) -> Future:
-        """Prepare a job's rows (tokenize, encode, ladder, initial noise)
-        and hand them to the matching lane. Returns a Future resolving to
-        ``(PendingImages, lane_info)``; raises :class:`LaneReject` when
-        the job cannot ride a lane.
+                       resume: dict[str, Any] | None = None,
+                       init_image: Any = None, strength: float = 0.8,
+                       mask: Any = None,
+                       controlnet: Any = None, control_image: Any = None,
+                       control_scale: float = 1.0) -> Future:
+        """Prepare a job's rows (tokenize, encode, ladder, initial noise
+        — plus, per workload: init-latent VAE encode, latent-mask
+        quantization, ControlNet hint embedding) and hand them to the
+        matching lane. Returns a Future resolving to ``(PendingImages,
+        lane_info)``; raises :class:`LaneReject` when the job cannot
+        ride a lane.
+
+        Workloads (ISSUE 7b): ``init_image`` makes the rows img2img —
+        ``strength`` maps to a per-row denoise START index exactly as
+        the solo program quantizes it; ``mask`` (with ``init_image``)
+        makes them inpaint — the latent-grid mask + clean source
+        latents ride as row state and the step program re-projects the
+        kept region per step; ``controlnet`` (a ControlNetBundle, with
+        ``control_image``) routes to a bundle-keyed control lane with
+        the pre-embedded hint + ``control_scale`` per row.
 
         ``resume`` (a lane checkpoint from a redelivered job) replaces
         the fresh-noise prologue with the snapshotted latents, keys, and
@@ -737,6 +1162,10 @@ class StepScheduler:
             bucket_steps,
         )
         from chiaswarm_tpu.core.rng import key_for_seed
+        from chiaswarm_tpu.pipelines.diffusion import (
+            _resize_batch,
+            latent_mask,
+        )
         from chiaswarm_tpu.schedulers import make_sampling_schedule, resolve
 
         fam = pipe.c.family
@@ -744,6 +1173,13 @@ class StepScheduler:
             raise LaneReject(f"family {fam.name!r} does not ride lanes")
         if float(guidance_scale) <= 1.0:
             raise LaneReject("guidance <= 1 runs the solo (no-CFG) program")
+        if mask is not None and init_image is None:
+            raise LaneReject("inpainting requires an init image")
+        if controlnet is not None and (control_image is None
+                                       or mask is not None
+                                       or init_image is not None):
+            raise LaneReject("controlnet lanes take exactly a "
+                             "conditioning image")
         height, width = bucket_image_size(int(height or fam.default_size),
                                           int(width or fam.default_size))
         steps = max(1, int(steps))
@@ -752,15 +1188,31 @@ class StepScheduler:
         except ValueError as exc:
             raise LaneReject(str(exc)) from exc
         rows = max(1, int(rows))
-        lane_rows = self.lane_width(height, width)
-        if rows > lane_rows:
+        workload = ("controlnet" if controlnet is not None else
+                    "inpaint" if mask is not None else
+                    "img2img" if init_image is not None else "txt2img")
+        # img2img strength -> start index: the solo program's exact
+        # quantization (the shared helper), so a lane row executes the
+        # identical truncated ladder
+        start_step = 0
+        if workload == "img2img":
+            from chiaswarm_tpu.pipelines.diffusion import (
+                img2img_start_index,
+            )
+
+            start_step = img2img_start_index(steps, strength)
+        bounds_lo, bounds_hi = self.width_bounds(height, width)
+        if rows > bounds_hi:
             raise LaneReject(
-                f"{rows} rows exceed the lane width {lane_rows}")
+                f"{rows} rows exceed the lane width cap {bounds_hi}")
         sampler = resolve(scheduler, prediction_type=fam.prediction_type)
-        key = (id(pipe.c), height, width, cap, sampler)
+        key = (id(pipe.c), height, width, cap, sampler,
+               None if controlnet is None else id(controlnet))
+        lane_rows = self.initial_width(rows, height, width)
         limit = self._width_limits.get(key)
         if limit is not None and limit < lane_rows:
             lane_rows = max(rows, limit)
+        self._note_arrival(rows)
 
         sched = make_sampling_schedule(pipe.noise_schedule, steps, sampler)
         sig = np.asarray(sched.sigmas, np.float32)
@@ -773,7 +1225,8 @@ class StepScheduler:
                 resume_step, restored = self._validate_resume(
                     pipe, resume, steps=steps, rows=rows,
                     height=height, width=width,
-                    guidance=float(guidance_scale))
+                    guidance=float(guidance_scale),
+                    start=start_step, workload=workload)
             except ResumeReject as exc:
                 log.error("resume state for job %s rejected (%s); "
                           "restarting at step 0", job_id, exc)
@@ -790,6 +1243,37 @@ class StepScheduler:
                    pipe._tokenize([negative_prompt or ""] * eb)]
             ctx_u, ctx_c, pooled_u, pooled_c = pipe.stepper_encode_fn(
                 batch=eb)(pipe.c.params, ids, neg)
+            # workload row state: init latents encoded with the job's
+            # OWN seed through the same batch-1 executable the solo run
+            # uses (bitwise solo equality by construction); masks
+            # quantize through the shared latent_mask helper; hints
+            # pre-embed once per job (the solo hoisting, kept)
+            init_rows = mask_rows = cond_rows = None
+            if init_image is not None:
+                init = np.asarray(init_image)
+                if init.shape[:2] != (height, width):
+                    init = _resize_batch(init, height, width)
+                z = pipe.encode_init_image(init, height, width, int(seed))
+                init_rows = jnp.repeat(z, rows, axis=0)
+            if mask is not None:
+                lh, lw = pipe._latent_hw(height, width)
+                m = latent_mask(np.asarray(mask, np.float32), lh, lw,
+                                fam.vae.downscale)
+                mask_rows = jnp.repeat(
+                    jnp.asarray(m)[None, :, :, None], rows, axis=0)
+            if controlnet is not None:
+                cond = np.asarray(control_image)
+                as_u8 = cond.dtype == np.uint8
+                if cond.shape[:2] != (height, width):
+                    cond = _resize_batch(cond, height, width)
+                cond = np.asarray(cond, np.float32)
+                if as_u8 or cond.max() > 1.0:
+                    cond = cond / 255.0
+                emb = pipe.stepper_control_embed_fn(
+                    height=height, width=width)(
+                        controlnet.params["embed"],
+                        jnp.asarray(np.clip(cond, 0.0, 1.0))[None])
+                cond_rows = jnp.repeat(emb, rows, axis=0)
             if restored is not None:
                 # redelivered rows: the context re-encodes (it is a pure
                 # function of the prompt), but latents/keys/history come
@@ -807,8 +1291,12 @@ class StepScheduler:
                     [key_for_seed(int(seed))] * (eb - rows))
                 carry, x0 = pipe.stepper_row_init_fn(
                     batch=eb, height=height, width=width)(
-                        keys, jnp.float32(sig[0]))
+                        keys, jnp.float32(sig[start_step]))
                 carry_rows, x0_rows, old_rows = carry[:rows], x0[:rows], None
+                if init_rows is not None:
+                    # img2img/inpaint prologue: x = init + noise * sigma
+                    # (row_init returned the noise term at sigma[start])
+                    x0_rows = init_rows + x0_rows
         _LANE_ADMIT_SECONDS.observe(time.perf_counter() - t_prep)
         job = _RowJob(
             job_id=job_id, n_rows=rows, steps=steps,
@@ -818,14 +1306,21 @@ class StepScheduler:
             pooled_c=None if pooled_c is None else pooled_c[:rows],
             keys0=carry_rows, x0=x0_rows,
             resume_step=resume_step, old0=old_rows,
+            workload=workload, start_step=start_step,
+            known0=init_rows if mask is not None else None,
+            mask0=mask_rows, cond0=cond_rows,
+            cscale=float(control_scale),
             deadline=time.monotonic() + (deadline_s if deadline_s is not None
                                          else self.row_deadline_s()))
-        self._enqueue(key, pipe, job, lane_rows, height, width, cap, sampler)
+        self._enqueue(key, pipe, job, lane_rows, height, width, cap, sampler,
+                      control=controlnet, bounds=(bounds_lo, bounds_hi))
         return job.future
 
     def _validate_resume(self, pipe, resume: dict[str, Any], *,
                          steps: int, rows: int, height: int, width: int,
-                         guidance: float) -> tuple[int, dict[str, np.ndarray]]:
+                         guidance: float, start: int = 0,
+                         workload: str = "txt2img",
+                         ) -> tuple[int, dict[str, np.ndarray]]:
         """Check a redelivered job's checkpoint against the job it claims
         to resume; returns (step, restored host arrays) or raises
         :class:`ResumeReject`. Every field is hostile until proven
@@ -840,13 +1335,25 @@ class StepScheduler:
             ck_rows = int(resume["rows"])
             ck_h, ck_w = int(resume["height"]), int(resume["width"])
             ck_guidance = float(resume["guidance"])
+            # pre-ISSUE-7 checkpoints carry no workload fields: they
+            # could only have come from txt2img lanes, which is exactly
+            # what the defaults assert
+            ck_start = int(resume.get("start", 0))
+            ck_workload = str(resume.get("workload", "txt2img"))
             x = unpack_array(resume["x"])
             keys = unpack_array(resume["keys"])
             old = unpack_array(resume["old"])
         except (KeyError, TypeError, ValueError) as exc:
             raise ResumeReject(f"corrupt payload: {exc}") from exc
-        if not 0 < step < steps:
-            raise ResumeReject(f"step {step} outside (0, {steps})")
+        if not start < step < steps:
+            raise ResumeReject(f"step {step} outside ({start}, {steps})")
+        if (ck_start, ck_workload) != (start, workload):
+            # a checkpoint stepped down a different ladder suffix (or a
+            # different workload's trajectory) must not finish under
+            # this job's identity — restart clean instead
+            raise ResumeReject(
+                f"workload mismatch: checkpoint is {ck_workload} from "
+                f"step {ck_start}, job is {workload} from {start}")
         if (ck_steps, ck_rows) != (steps, rows):
             raise ResumeReject(
                 f"job mismatch: checkpoint is {ck_rows} row(s) x "
@@ -886,18 +1393,27 @@ class StepScheduler:
         return step, {"x": x, "keys": keys, "old": old}
 
     def _enqueue(self, key, pipe, job, lane_rows, height, width, cap,
-                 sampler) -> None:
+                 sampler, control=None, bounds=None) -> None:
         created = False
         with self._lock:
             lane = self._lanes.get(key)
-            # a lane narrower than the job (width-limited after an OOM)
-            # could never admit it: open a fresh, wide-enough lane — the
-            # old one drains its residents and idles out
+            # a lane narrower than the job could never admit it and
+            # _admit_locked is FIFO — the job (and everything behind it)
+            # would starve while the lane stays busy. An adaptive lane
+            # grows to fit at its next boundary, UNLESS an OOM width cap
+            # holds it below the job's rows; a pinned lane never grows.
+            # Either way out: open a fresh, wide-enough lane — the old
+            # one drains its residents and idles out.
             if lane is not None and lane.width < job.n_rows:
-                lane = None
+                limit = self._width_limits.get(key)
+                can_grow = lane._adaptive and (limit is None
+                                               or limit >= job.n_rows)
+                if not can_grow:
+                    lane = None
             if lane is None or not lane.try_enqueue(job):
                 lane = Lane(self, key, pipe, width=lane_rows, height=height,
-                            width_px=width, steps_cap=cap, sampler=sampler)
+                            width_px=width, steps_cap=cap, sampler=sampler,
+                            control=control, width_bounds=bounds)
                 self._lanes[key] = lane
                 created = True
                 if not lane.try_enqueue(job):  # pragma: no cover
@@ -963,9 +1479,11 @@ class StepScheduler:
             self._fault.append((int(after_steps), exc))
 
     def stats(self) -> dict[str, Any]:
+        now = time.monotonic()
         with self._lock:
             data = dict(self._stats)
             lanes = list(self._lanes.values())
+            rate = self._arrivals.rate(now)
         active = sum(lane.occupancy()[0] for lane in lanes)
         width = sum(lane.occupancy()[1] for lane in lanes)
         steps_a = data.get("row_steps_active", 0)
@@ -977,6 +1495,7 @@ class StepScheduler:
             "lane_rows_total": width,
             "lane_occupancy": round(steps_a / denom, 4),
             "padding_waste": round(steps_p / denom, 4),
+            "arrival_rate": round(rate, 4),
         })
         return data
 
@@ -1036,8 +1555,12 @@ def aggregate_stats(steppers) -> dict[str, Any]:
     counters sum, the occupancy/waste ratios recompute from the summed
     row-step totals."""
     total = collections.Counter()
+    rate = 0.0
     for stepper in steppers:
         for key, value in stepper.stats().items():
+            if key == "arrival_rate":
+                rate = max(rate, value)  # EWMAs do not sum
+                continue
             if key in ("lane_occupancy", "padding_waste"):
                 continue
             total[key] += value
@@ -1047,6 +1570,7 @@ def aggregate_stats(steppers) -> dict[str, Any]:
     data = dict(total)
     data["lane_occupancy"] = round(steps_a / denom, 4)
     data["padding_waste"] = round(steps_p / denom, 4)
+    data["arrival_rate"] = round(rate, 4)
     return data
 
 

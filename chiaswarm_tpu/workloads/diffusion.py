@@ -200,32 +200,42 @@ def coalescable(kwargs: dict[str, Any]) -> bool:
 
 # ---- continuous step-level batching (serving/stepper.py) ---------------
 #
-# When lanes are enabled (CHIASWARM_STEPPER=1), plain txt2img jobs skip
-# the burst grouping entirely: each job's rows splice into the resident
-# step loop of its lane at the next step boundary — jobs with DIFFERENT
-# step counts and guidance scales share one program (those two fields
-# ride per row), so a job arriving one poll late no longer waits behind
-# a full solo program. Everything else (img2img/inpaint/controlnet/
-# pix2pix/upscale, low guidance, oversize, too many rows) falls back to
-# the burst/solo paths below.
+# Lanes are the DEFAULT engine (ISSUE 7; CHIASWARM_STEPPER=0 opts out):
+# eligible diffusion jobs skip the burst grouping entirely — each job's
+# rows splice into the resident step loop of its lane at the next step
+# boundary. Steps, guidance, img2img start indices, inpaint mask/known
+# stacks and ControlNet hint embeddings all ride PER ROW, so txt2img,
+# img2img and inpaint jobs with different parameters share one program
+# (ControlNet rows ride bundle-keyed lanes), and a job arriving one poll
+# late no longer waits behind a full solo program. The residue
+# (pix2pix/upscale, explicit image_guidance remaps, low guidance,
+# oversize, steps beyond the lattice) falls back to the burst/solo
+# paths below.
 
 def stepper_eligible(kwargs: dict[str, Any]) -> bool:
     """Can this (formatted) job ride a lane? Conservative pre-filter —
     serving.stepper.StepScheduler.submit_request is the authority and
     raises LaneReject for the residue (steps beyond the capacity
-    lattice, rows wider than the lane, non-sd families)."""
+    lattice, rows wider than the lane cap, non-sd / pix2pix families)."""
     from chiaswarm_tpu.serving.stepper import stepper_enabled
 
-    if not stepper_enabled() or not coalescable(kwargs):
+    if not stepper_enabled():
         return False
-    if kwargs.get("image") is not None or kwargs.get("mask_image") is not None:
-        return False  # init-latent modes keep the burst path (per-job
-        # encode seeds + mask re-projection are not lane state yet)
+    if kwargs.get("upscale"):
+        return False  # the x2 pass chains a second pipeline — solo
+    if kwargs.get("image_guidance_scale") is not None:
+        return False  # pix2pix dual CFG / strength remap stays solo
     guidance = kwargs.get("guidance_scale")
     if guidance is not None and float(guidance) <= 1.0:
         return False  # solo compiles the no-CFG program
+    if kwargs.get("mask_image") is not None \
+            and kwargs.get("controlnet_model_name") is not None:
+        return False  # invalid combination — solo raises the user error
     height = kwargs.get("height")
     width = kwargs.get("width")
+    image = kwargs.get("image")
+    if image is not None and getattr(image, "ndim", 0) >= 2:
+        height, width = int(image.shape[0]), int(image.shape[1])
     if (height and int(height) > 1024) or (width and int(width) > 1024):
         return False  # tiled decode stays solo
     return True
@@ -250,13 +260,18 @@ class StepperTicket:
     shared: dict[str, Any]
     slot: Any
     t0: float
+    mode: str = "txt2img"
+    denoise_steps: int = 0
+    controlnet_name: str | None = None
+    controlnet_scale: float = 1.0
 
 
 def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
                    seed: int, job_id: Any = None) -> StepperTicket:
-    """Hand one formatted txt2img job to the slot's step scheduler.
-    Raises serving.stepper.LaneReject (or anything else) when the job
-    must run through the ordinary path instead."""
+    """Hand one formatted diffusion job (txt2img / img2img / inpaint /
+    ControlNet, ISSUE 7) to the slot's step scheduler. Raises
+    serving.stepper.LaneReject (or anything else) when the job must run
+    through the ordinary path instead."""
     from chiaswarm_tpu.core.compile_cache import bucket_image_size
     from chiaswarm_tpu.schedulers import resolve
     from chiaswarm_tpu.serving.stepper import get_stepper
@@ -270,12 +285,48 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
         lora_scale=1.0 if scale is None else float(scale),
         mesh=getattr(slot, "mesh", None))
     fam = pipe.c.family
-    height = int(kwargs.get("height") or fam.default_size)
-    width = int(kwargs.get("width") or fam.default_size)
-    steps = int(kwargs.get("num_inference_steps") or 30)
+    image = kwargs.get("image")
+    # ControlNet: the fetched input IS the conditioning image (exactly
+    # the solo callback's remap); the bundle keys the lane
+    controlnet = None
+    control_image = None
+    controlnet_name = kwargs.get("controlnet_model_name")
+    if controlnet_name is not None:
+        controlnet = registry.controlnet(controlnet_name, fam)
+        control_image, image = image, None
+    if image is not None:
+        height, width = int(image.shape[0]), int(image.shape[1])
+    else:
+        height = int(kwargs.get("height") or fam.default_size)
+        width = int(kwargs.get("width") or fam.default_size)
+    steps = max(1, int(kwargs.get("num_inference_steps") or 30))
     guidance = kwargs.get("guidance_scale")
     guidance = 7.5 if guidance is None else float(guidance)
     rows = max(1, int(kwargs.get("num_images_per_prompt") or 1))
+    # None-check, not `or`: strength=0.0 (near-identity img2img) and
+    # controlnet_scale=0.0 (zero conditioning) are valid values the
+    # solo callback honors — the lane path must quantize the same way
+    strength = kwargs.get("strength")
+    strength = 0.75 if strength is None else float(strength)
+    cscale = kwargs.get("controlnet_scale")
+    cscale = 1.0 if cscale is None else float(cscale)
+    mask = None
+    if kwargs.get("mask_image") is not None:
+        # same normalization the solo callback applies before the
+        # pipeline's latent-grid quantization
+        m = np.asarray(kwargs["mask_image"], dtype=np.float32)
+        if m.ndim == 3:
+            m = m.mean(axis=-1)
+        mask = m / 255.0 if m.max() > 1.0 else m
+    # mode + executed-ladder suffix, mirroring the solo config contract
+    # (the strength -> start-index quantization is an observable field)
+    mode = ("inpaint" if mask is not None else
+            "img2img" if image is not None else "txt2img")
+    start_step = 0
+    if mode == "img2img":
+        from chiaswarm_tpu.pipelines.diffusion import img2img_start_index
+
+        start_step = img2img_start_index(steps, strength)
     # redelivered jobs carry their dead worker's last lane checkpoint
     # (node/minihive.py): the scheduler splices the rows back in at the
     # recorded step instead of restarting at 0. A solo-path PHASE marker
@@ -293,7 +344,10 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
         height=height, width=width, rows=rows, seed=int(seed),
         scheduler=kwargs.get("scheduler_type"),
         job_id=job_id,
-        resume=resume)
+        resume=resume,
+        init_image=image, strength=strength, mask=mask,
+        controlnet=controlnet, control_image=control_image,
+        control_scale=cscale)
     sampler = resolve(kwargs.get("scheduler_type"),
                       prediction_type=fam.prediction_type)
     return StepperTicket(
@@ -305,7 +359,10 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
         content_type=kwargs.get("content_type", "image/png"),
         shared={k: kwargs.get(k) for k in ("textual_inversion", "lora",
                                            "cross_attention_scale")},
-        slot=slot, t0=time.perf_counter())
+        slot=slot, t0=time.perf_counter(),
+        mode=mode, denoise_steps=steps - start_step,
+        controlnet_name=controlnet_name,
+        controlnet_scale=cscale)
 
 
 def stepper_finish(ticket: StepperTicket):
@@ -328,15 +385,18 @@ def stepper_finish(ticket: StepperTicket):
         "family": ticket.family,
         "scheduler": ticket.sampler_kind,
         "steps": ticket.steps,
-        "denoise_steps": ticket.steps,
+        "denoise_steps": ticket.denoise_steps or ticket.steps,
         "guidance_scale": ticket.guidance,
         "size": list(ticket.req_hw),
         "compiled_size": list(ticket.compiled_hw),
         "batch": ticket.rows,
-        "mode": "txt2img",
+        "mode": ticket.mode,
         "seed": ticket.seed,
         "stepper": dict(lane_info),
     }
+    if ticket.controlnet_name is not None:
+        config["controlnet"] = ticket.controlnet_name
+        config["controlnet_scale"] = ticket.controlnet_scale
     if ticket.shared.get("textual_inversion") is not None:
         config["textual_inversion"] = ticket.shared["textual_inversion"]
     if ticket.shared.get("lora") is not None:

@@ -514,8 +514,10 @@ def test_forced_cancel_requeues_held_job():
         worker = _worker(chaos_settings(job_deadline_s=100.0), executor,
                          slots=[StubSlot(depth=1, data_width=4)])
         job_a = _cjob("A", chaos=["hang"], model="tiny")
-        job_b = _cjob("B", chaos=["ok"], model="tiny",
-                      num_inference_steps=7)  # key mismatch -> held
+        # key mismatch -> held: size splits the burst key even with lanes
+        # on (steps/guidance/strength relax when the stepper rides them
+        # per row, ISSUE 7 — a size mismatch never relaxes)
+        job_b = _cjob("B", chaos=["ok"], model="tiny", height=128)
         worker.work_queue.put_nowait(job_a)
         worker.work_queue.put_nowait(job_b)
         task = asyncio.create_task(worker._slot_worker(worker.pool[0]))

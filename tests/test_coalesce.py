@@ -227,12 +227,17 @@ def test_worker_coalesces_queue_burst(registry):
     asyncio.run(main())
 
 
-def test_burst_key_prefilter():
+def test_burst_key_prefilter(monkeypatch):
     """The worker's raw-job drain filter: txt2img/img2img/inpaint jobs
     with identical static fields share a burst key; modes never mix;
-    cascade/controlnet/upscale/pix2pix stay per-job."""
+    cascade/controlnet/upscale/pix2pix stay per-job. Runs with lanes
+    opted OUT — the strict per-field key is the pre-lane burst-path
+    contract that CHIASWARM_STEPPER=0 must restore
+    (test_stepper.py::test_burst_key_relaxes_only_with_stepper covers
+    the lanes-on relaxation)."""
     from chiaswarm_tpu.node.worker import _burst_key
 
+    monkeypatch.setenv("CHIASWARM_STEPPER", "0")
     a = _job(0)
     b = _job(1)
     assert _burst_key(a) is not None
@@ -326,12 +331,16 @@ def test_mismatched_job_keeps_fifo_position(monkeypatch):
     """The drain holds a non-matching candidate as the NEXT burst instead
     of re-queueing it at the tail (ADVICE r2): with queue
     [A, B, A2, A3] the mismatch B must execute before A2/A3 — the old
-    tail re-queue ran [A, A2?]... and pushed B behind later arrivals."""
+    tail re-queue ran [A, A2?]... and pushed B behind later arrivals.
+    Lanes opted out: with the ISSUE-7 relaxed key the whole queue would
+    drain as ONE burst and there would be no mismatch to hold."""
     import asyncio
 
     from chiaswarm_tpu.node import worker as worker_mod
     from chiaswarm_tpu.node.settings import Settings
     from chiaswarm_tpu.node.worker import Worker
+
+    monkeypatch.setenv("CHIASWARM_STEPPER", "0")
 
     class StubSlot:
         depth = 1          # serialize bursts so order is deterministic

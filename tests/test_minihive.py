@@ -769,3 +769,132 @@ def test_fleet_soak_three_workers_kill_faults():
     if victim is not None:
         assert _counter(hive,
                         "chiaswarm_hive_jobs_redelivered_total") >= 0
+
+
+@pytest.mark.slow
+def test_fleet_soak_mixed_workload_lanes_kill_resume(monkeypatch):
+    """Nightly fleet soak for the ISSUE-7 workloads: txt2img, img2img
+    and inpaint jobs ride lanes (default-on) across 3 workers; the
+    worker holding a checkpointed IMAGE-workload job is killed mid-lane.
+    Every job completes exactly once with its correct mode stamp, and
+    the redelivered image-workload job resumes from checkpoint step >= 1
+    on its own truncated ladder — the kill/resume coverage for the
+    newly lane-eligible workloads."""
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    monkeypatch.setenv("CHIASWARM_STEPPER_STEP_DELAY_S", "0.08")
+
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+
+    def mixed_job(i: int, uri: str) -> dict:
+        kind = ("txt2img", "img2img", "inpaint")[i % 3]
+        job = {"id": f"mix-{i}", "model_name": "tiny",
+               "prompt": f"soak prompt {i}", "seed": 950 + i,
+               "num_inference_steps": 24, "guidance_scale": 7.5,
+               "height": 64, "width": 64, "content_type": "image/png"}
+        if kind != "txt2img":
+            job["start_image_uri"] = f"{uri}/assets/image.png"
+            job["strength"] = 0.6
+        if kind == "inpaint":
+            job["mask_image_uri"] = f"{uri}/assets/mask.png"
+        return job
+
+    async def scenario():
+        hive = MiniHive(lease_s=60.0, delay_s=0.01, max_jobs_per_poll=1)
+        uri = await hive.start()
+        jobs = [mixed_job(i, uri) for i in range(6)]
+        for job in jobs:
+            hive.submit(job)
+
+        workers = []
+        for tag in ("a", "b", "c"):
+            pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                            devices=jax.devices()[:1])
+            workers.append(Worker(
+                settings=fleet_settings(uri, f"mixfleet-{tag}",
+                                        job_deadline_s=600.0,
+                                        heartbeat_s=0.05),
+                registry=registry, pool=pool))
+        tasks = {w.settings.worker_name: asyncio.create_task(w.run())
+                 for w in workers}
+        victim = victim_job = None
+        try:
+            # wait for an IMAGE-workload lane checkpoint (img2img rows
+            # only checkpoint past their start index), then kill its
+            # holder mid-lane with the partition+expire preemption path
+            deadline = time.monotonic() + 240
+            while victim is None and time.monotonic() < deadline:
+                for job_id, ckpt in list(hive.checkpoints.items()):
+                    holder = hive.lease_holder(job_id)
+                    if ckpt.get("kind") == "lane" and \
+                            ckpt.get("workload") in ("img2img",
+                                                     "inpaint") and \
+                            int(ckpt.get("step", 0)) >= 1 and \
+                            holder is not None:
+                        victim_job, victim = job_id, holder
+                        hive.partition(holder)
+                        break
+                if victim is None:
+                    await asyncio.sleep(0.02)
+            assert victim is not None, \
+                f"no image-workload lane checkpoint: {hive.stats()}"
+            tasks[victim].cancel()
+            await asyncio.gather(tasks[victim], return_exceptions=True)
+            assert victim_job in hive.expire_worker(victim)
+
+            await hive.wait_for_results(6, timeout=500)
+        finally:
+            for worker in workers:
+                worker.request_stop()
+            await asyncio.gather(*(asyncio.wait_for(t, timeout=60)
+                                   for t in tasks.values()),
+                                 return_exceptions=True)
+            for worker in workers:
+                for slot in worker.pool:
+                    stepper = getattr(slot, "_stepper", None)
+                    if stepper is not None:
+                        stepper.shutdown()
+            await hive.stop()
+        return hive, workers, victim, victim_job, jobs
+
+    hive, workers, victim, victim_job, jobs = asyncio.run(scenario())
+
+    uploaded = hive.uploaded_ids()
+    assert sorted(uploaded) == sorted(j["id"] for j in jobs)
+    assert len(uploaded) == len(set(uploaded))
+    by_id = {j["id"]: j for j in jobs}
+    for result in hive.results:
+        assert result["pipeline_config"].get("error") is None, result
+        assert "fatal_error" not in result
+        job = by_id[result["id"]]
+        want = ("inpaint" if "mask_image_uri" in job else
+                "img2img" if "start_image_uri" in job else "txt2img")
+        assert result["pipeline_config"]["mode"] == want, result["id"]
+
+    # the redelivered image-workload job resumed mid-ladder, not from
+    # its start index
+    resumed = hive.completed[victim_job]
+    assert resumed["worker_name"] != victim
+    stepper_info = resumed["pipeline_config"].get("stepper") or {}
+    assert int(stepper_info.get("resume_step", 0)) >= 1, stepper_info
+    # the truncated img2img ladder is preserved through redelivery
+    assert resumed["pipeline_config"]["denoise_steps"] <= 24
+
+    survivor_stats = [
+        slot._stepper.stats()
+        for worker in workers
+        if worker.settings.worker_name != victim
+        for slot in worker.pool
+        if getattr(slot, "_stepper", None) is not None
+    ]
+    assert sum(s.get("rows_resumed", 0) for s in survivor_stats) >= 1
+    admitted_img = sum(s.get("rows_admitted_img2img", 0)
+                       + s.get("rows_admitted_inpaint", 0)
+                       for s in survivor_stats)
+    assert admitted_img >= 1, survivor_stats

@@ -1,8 +1,9 @@
 """swarmscope suite (ISSUE 4): metrics registry semantics, Prometheus
 exposition, span-tree construction across threads, trace-ring eviction,
 the worker's /metrics + /debug/traces endpoints, and the end-to-end
-acceptance gate: a tiny txt2img job through a REAL worker — stepper off
-and on — must yield a trace whose span tree nests
+acceptance gate: a tiny txt2img job through a REAL worker — stepper
+opted out and on (the ISSUE-7 default) — must yield a trace whose span
+tree nests
 poll/execute/encode/step/decode/upload with positive durations,
 exported as Perfetto-loadable JSON.
 """
@@ -372,9 +373,23 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert "chiaswarm_jobs_failed_total 1" in body
     assert 'chiaswarm_jobs_total{outcome="error"} 1' in body
     assert 'chiaswarm_jobs_total{outcome="ok"} 1' in body
-    # ...stepper-lane families...
+    # ...stepper-lane families (lanes are default-ON since ISSUE 7)...
     assert "chiaswarm_stepper_steps_executed_total" in body
-    assert "chiaswarm_stepper_enabled 0" in body
+    assert "chiaswarm_stepper_enabled 1" in body
+    # ...the adaptive-width control-loop families (ISSUE 7): resize
+    # actions by direction, the arrival-rate demand gauge, and the
+    # per-workload admission breadth — all present from scrape one
+    # (values are process-cumulative, so assert the series, not 0)
+    assert "# TYPE chiaswarm_stepper_lane_resizes_total counter" in body
+    assert 'chiaswarm_stepper_lane_resizes_total{direction="grow"}' in body
+    assert ('chiaswarm_stepper_lane_resizes_total{direction="shrink"}'
+            in body)
+    assert "# TYPE chiaswarm_stepper_arrival_rate gauge" in body
+    assert ("# TYPE chiaswarm_stepper_lane_admissions_total counter"
+            in body)
+    for workload in ("txt2img", "img2img", "inpaint", "controlnet"):
+        assert (f'chiaswarm_stepper_lane_admissions_total'
+                f'{{workload="{workload}"}}' in body), workload
     # ...lease/checkpoint/resume families (ISSUE 6) exist from scrape
     # one, even before any fleet event — dashboards need the zeroes...
     assert "chiaswarm_lease_heartbeats_total 0" in body
@@ -419,10 +434,8 @@ def _run_tiny_job_and_get_trace(stepper: bool, monkeypatch, seed: int):
     from chiaswarm_tpu.node.registry import ModelRegistry
     from chiaswarm_tpu.node.worker import Worker
 
-    if stepper:
-        monkeypatch.setenv("CHIASWARM_STEPPER", "1")
-    else:
-        monkeypatch.delenv("CHIASWARM_STEPPER", raising=False)
+    # lanes are default-on (ISSUE 7): the off leg must opt OUT explicitly
+    monkeypatch.setenv("CHIASWARM_STEPPER", "1" if stepper else "0")
     registry = ModelRegistry(
         catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
         allow_random=True)

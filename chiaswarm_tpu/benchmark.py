@@ -405,6 +405,73 @@ def _bench_mixed_workloads(*, on_tpu: bool, attn: str) -> dict:
                 os.environ[key] = value
 
 
+def _bench_model_churn(*, on_tpu: bool, attn: str) -> dict:
+    """ISSUE 8: model-swap latency + resident-model count under a budget
+    that cannot hold the catalog — the residency ledger's headline
+    numbers (evict-then-load donation, measured footprints), stamped
+    into BENCH json. CPU hosts churn the tiny family; TPU churns
+    sd15-class checkpoints (random weights — load+convert cost is real,
+    weight content does not change it)."""
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.obs.metrics import Registry as ObsRegistry
+    from chiaswarm_tpu.serving.residency import ResidencyManager
+
+    family = "sd15" if on_tpu else "tiny"
+    models = [f"bench/churn-{tag}" for tag in "abc"]
+
+    def build(budget_bytes: int | None) -> tuple:
+        manager = ResidencyManager(
+            budget_bytes=budget_bytes or (1 << 40),
+            hard_limit_bytes=(budget_bytes or (1 << 40)) * 8,
+            metrics_registry=ObsRegistry(), persist_path=None)
+        registry = ModelRegistry(
+            catalog=[{"name": name, "family": family} for name in models],
+            allow_random=True, residency=manager, attn_impl=attn)
+        return manager, registry
+
+    # probe one load for the measured footprint the budget is
+    # denominated in (exactly what production learns on load one)
+    probe_manager, probe_registry = build(None)
+    probe_registry.pipeline(models[0])
+    footprint = probe_manager.measured_footprints()[models[0]]
+
+    budget = int(footprint * 1.5)  # one resident at a time: every
+    manager, registry = build(budget)  # model switch is a swap
+    manager.reset_peak()
+    swap_times: list[float] = []
+    hit_times: list[float] = []
+    for round_i in range(2):
+        for name in models:
+            before = manager.misses
+            t0 = time.perf_counter()
+            pipe = registry.pipeline(name)
+            # touch the pipeline so lazy placement settles into the time
+            del pipe
+            elapsed = time.perf_counter() - t0
+            (swap_times if manager.misses > before
+             else hit_times).append(elapsed)
+    snap = manager.snapshot()
+    largest = max(manager.measured_footprints().values())
+    return {
+        "family": family,
+        "models": len(models),
+        "budget_bytes": budget,
+        "footprint_bytes": footprint,
+        "swap_p50_s": round(_percentile50(swap_times), 4),
+        "swaps": len(swap_times),
+        "hit_p50_s": (round(_percentile50(hit_times), 6)
+                      if hit_times else 0.0),
+        "evictions": snap["evictions"],
+        "resident_models": len(snap["resident_models"]),
+        "resident_bytes": snap["resident_bytes"],
+        "peak_bytes": snap["peak_bytes"],
+        # THE no-double-buffer invariant, stamped per run
+        "peak_within_budget_plus_one": bool(
+            snap["peak_bytes"] <= budget + largest),
+        "weights_format": os.environ.get("CHIASWARM_WEIGHTS", "bf16"),
+    }
+
+
 def run_configs(names: list[str], *, on_tpu: bool, iters: int,
                 attn: str) -> dict:
     import jax
@@ -561,6 +628,12 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         }
         del vpipe, vc
 
+    if "model_churn" in names:
+        # ISSUE 8: swap latency + resident-model count under a tight
+        # residency budget (the ledger's BENCH headline)
+        results["model_churn"] = _bench_model_churn(on_tpu=on_tpu,
+                                                    attn=attn)
+
     return results
 
 
@@ -615,7 +688,7 @@ def main() -> None:
     configs = {"sdxl_txt2img_1024": headline}
     if which != "headline":
         names = (["sd15", "sd21", "controlnet", "img2vid", "stepper",
-                  "stepper_mixed_workloads", "txt2vid"]
+                  "stepper_mixed_workloads", "txt2vid", "model_churn"]
                  if which == "all" else which.split(","))
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))

@@ -15,13 +15,23 @@ from chiaswarm_tpu.convert.torch_to_flax import (
     read_torch_weights,
 )
 from chiaswarm_tpu.convert.lora import load_lora, merge_lora
+from chiaswarm_tpu.convert.quantize import (
+    dequantize_tree,
+    int8_enabled,
+    maybe_quantize_params,
+    quantize_tree,
+)
 
 __all__ = [
     "convert_text_encoder",
     "convert_unet",
     "convert_vae",
+    "dequantize_tree",
+    "int8_enabled",
     "load_checkpoint",
     "load_lora",
+    "maybe_quantize_params",
+    "quantize_tree",
     "read_torch_weights",
     "merge_lora",
 ]

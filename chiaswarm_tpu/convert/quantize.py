@@ -1,0 +1,158 @@
+"""int8 scale-per-channel weight residency (``CHIASWARM_WEIGHTS=int8``).
+
+Half of the ISSUE-8 capacity lever: the residency ledger decides WHICH
+models stay in HBM; this module multiplies HOW MANY fit by storing the
+big weight matrices as int8 codes plus one float scale per output
+channel (~4x smaller than fp32 checkpoints, ~2x smaller than the bf16
+serving default). Dequantization happens AT USE, inside the jitted
+programs: each quantized leaf rides the param tree as an
+:class:`Int8Param` pytree node (children ``q`` int8 + ``scale`` f32, so
+jit treats them as ordinary inputs and HBM holds the int8 bytes), and
+the pipelines' traced functions call :func:`dequantize_tree` first —
+XLA fuses the ``convert * scale`` into the consuming matmul/conv where
+it can, and the bf16 copies are transient program temporaries, never
+residency.
+
+Scope: the diffusion families (``kind == "sd"``) and their ControlNet
+bundles — the checkpoint classes the catalog multiplies — gated by the
+forward-parity tests in tests/test_residency.py. Multi-chip (sharded)
+placements stay fp: the sharding rules match fp param paths
+(parallel/sharding.py), so :func:`maybe_quantize_params` declines when
+the target mesh has more than one device.
+
+Quantization rule: per-OUTPUT-channel absmax scaling over every other
+axis (dense kernels are ``(in, out)``, NHWC convs ``(kh, kw, in, out)``
+— the last axis is the output channel everywhere in this stack), codes
+clipped to [-127, 127]. Leaves below :data:`MIN_QUANT_SIZE` elements or
+with ndim < 2 (biases, norm gains, time embeddings) stay fp — they are
+noise in the byte count and precision-critical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("chiaswarm.quantize")
+
+ENV_WEIGHTS = "CHIASWARM_WEIGHTS"
+
+#: leaves smaller than this many elements stay fp (biases, layer norms)
+MIN_QUANT_SIZE = 4096
+
+
+def weights_format() -> str:
+    """Serving weight format: ``bf16`` (default) or ``int8``."""
+    raw = os.environ.get(ENV_WEIGHTS, "").strip().lower()
+    return raw or "bf16"
+
+
+def int8_enabled() -> bool:
+    return weights_format() == "int8"
+
+
+def bytes_per_param() -> int:
+    """Planning density for footprint estimates (node/registry.py):
+    int8 stores ~1 byte/param (scales are negligible), bf16 stores 2."""
+    return 1 if int8_enabled() else 2
+
+
+class Int8Param:
+    """One quantized weight leaf: ``q`` int8 codes, ``scale`` f32 per
+    output channel (keepdims, so ``q * scale`` broadcasts), and the
+    original dtype string to dequantize back into. Registered as a jax
+    pytree node: tree utilities (placement, flatten-at-jit, byte
+    accounting via ``jax.tree.leaves``) see the two arrays."""
+
+    __slots__ = ("q", "scale", "dtype")
+
+    def __init__(self, q: Any, scale: Any, dtype: str) -> None:
+        self.q = q
+        self.scale = scale
+        self.dtype = str(dtype)
+
+    def dequantize(self) -> Any:
+        w = self.q.astype(jnp.float32) * self.scale
+        return w.astype(jnp.dtype(self.dtype))
+
+    def __repr__(self) -> str:  # debugging/test readability
+        shape = tuple(getattr(self.q, "shape", ()))
+        return f"Int8Param(shape={shape}, dtype={self.dtype})"
+
+
+jax.tree_util.register_pytree_node(
+    Int8Param,
+    lambda p: ((p.q, p.scale), p.dtype),
+    lambda dtype, children: Int8Param(children[0], children[1], dtype),
+)
+
+
+def _is_quant(x: Any) -> bool:
+    return isinstance(x, Int8Param)
+
+
+def quantize_leaf(w: Any) -> Any:
+    """Quantize one weight leaf (or return it unchanged when it is not
+    a big float matrix). Round-to-nearest with per-output-channel
+    absmax scales: |dequant - w| <= scale/2 elementwise, the bound the
+    parity tests assert."""
+    if _is_quant(w):
+        return w
+    dtype = getattr(w, "dtype", None)
+    ndim = getattr(w, "ndim", 0)
+    size = getattr(w, "size", 0)
+    if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+        return w
+    if ndim < 2 or size < MIN_QUANT_SIZE:
+        return w
+    wf = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=tuple(range(ndim - 1)),
+                     keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return Int8Param(q, scale, str(dtype))
+
+
+def quantize_tree(tree: Any) -> Any:
+    return jax.tree.map(quantize_leaf, tree, is_leaf=_is_quant)
+
+
+def dequantize_tree(tree: Any) -> Any:
+    """Inverse, called INSIDE the jitted programs (pipelines/diffusion.py)
+    — a no-op identity map on fp trees, so the fp path traces
+    unchanged."""
+    return jax.tree.map(
+        lambda x: x.dequantize() if _is_quant(x) else x, tree,
+        is_leaf=_is_quant)
+
+
+def quantized_leaf_count(tree: Any) -> int:
+    return sum(1 for x in jax.tree.leaves(tree, is_leaf=_is_quant)
+               if _is_quant(x))
+
+
+def maybe_quantize_params(params: Any, *, family: Any = None,
+                          mesh: Any = None) -> Any:
+    """The registry's load-time gate: quantize when ``CHIASWARM_WEIGHTS=
+    int8``, the family is a diffusion ("sd") family — the class the
+    parity tests cover — and placement is single-device (sharded
+    placements match fp param paths)."""
+    if not int8_enabled():
+        return params
+    kind = getattr(family, "kind", "sd")
+    if kind != "sd":
+        return params
+    if mesh is not None and getattr(mesh.devices, "size", 1) > 1:
+        log.warning("CHIASWARM_WEIGHTS=int8 skipped for a %d-chip "
+                    "placement (sharding specs are fp-tree-shaped); "
+                    "params stay %s", mesh.devices.size,
+                    "bf16/fp32")
+        return params
+    quantized = quantize_tree(params)
+    log.info("quantized %d weight leaves to int8 scale-per-channel",
+             quantized_leaf_count(quantized))
+    return quantized

@@ -289,6 +289,20 @@ class LruCache:
             _, entry = self._entries.popitem(last=False)
             self._bytes -= entry.size_bytes
 
+    def drop_where(self, predicate: Callable[[Hashable], bool]) -> int:
+        """Drop every entry whose KEY satisfies ``predicate``; returns
+        the count. The residency manager (serving/residency.py) uses
+        this to purge a released load-per-job model's executables —
+        keyed by the dead components' ``id()``, they can never hit
+        again and would otherwise thrash hot models out of the bounded
+        executable LRU."""
+        with self._lock:
+            doomed = [k for k in self._entries if predicate(k)]
+            for key in doomed:
+                entry = self._entries.pop(key)
+                self._bytes -= entry.size_bytes
+        return len(doomed)
+
     def __len__(self) -> int:
         return len(self._entries)
 
@@ -303,7 +317,13 @@ class LruCache:
 
 
 class CompileCache:
-    """Process-wide residency for params and compiled pipelines."""
+    """Process-wide residency for params and compiled pipelines.
+
+    Since ISSUE 8, MODEL param residency is owned by the measured-ledger
+    ``serving/residency.py::ResidencyManager`` (the registry routes every
+    pipeline load through it); the byte-budgeted ``params`` LRU below
+    remains for non-registry callers and API compatibility. Compiled
+    executables stay here — they are per-process like before."""
 
     def __init__(self, param_budget_bytes: int = 24 * 1024**3,
                  max_executables: int = 16) -> None:

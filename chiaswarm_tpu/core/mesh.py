@@ -78,8 +78,31 @@ def local_chip_count() -> int:
 
 _DEFAULT_HBM_BYTES = 16 * 1024**3  # v5e-class chip; used when stats absent
 # params may take at most this fraction of a chip; the rest is activations,
-# compiled executables, coalesced-batch latents, and the resident-model LRU
+# compiled executables, coalesced-batch latents, and the resident-model
+# ledger headroom. Since ISSUE 8 this fraction is only the INITIAL budget
+# (resident_param_budget_bytes): once models load, the residency manager
+# (serving/residency.py) runs on measured footprints, and the operator
+# env override below wins outright.
 _PARAM_HBM_FRACTION = 0.35
+
+ENV_RESIDENCY_BUDGET = "CHIASWARM_RESIDENCY_BUDGET"
+
+
+def resident_param_budget_bytes(hbm_bytes: int | None = None) -> int:
+    """Per-chip byte budget for RESIDENT model params — the single
+    source both the mesh policy (below) and the residency ledger
+    (serving/residency.py) plan against. ``CHIASWARM_RESIDENCY_BUDGET``
+    (bytes) overrides; otherwise the classic HBM fraction applies as
+    the no-model-has-loaded-yet fallback (ISSUE 8 satellite)."""
+    raw = os.environ.get(ENV_RESIDENCY_BUDGET, "").strip()
+    if raw:
+        try:
+            return max(1, int(float(raw)))
+        except ValueError:
+            pass  # malformed override: fall through to the fraction
+    if hbm_bytes is None:
+        hbm_bytes = device_hbm_bytes()
+    return int(_PARAM_HBM_FRACTION * hbm_bytes)
 
 
 def device_hbm_bytes(device: jax.Device | None = None) -> int:
@@ -120,7 +143,7 @@ def derive_mesh_spec(n_devices: int,
         return MeshSpec({DATA_AXIS: 1})
     if hbm_bytes is None:
         hbm_bytes = device_hbm_bytes()
-    budget = _PARAM_HBM_FRACTION * hbm_bytes
+    budget = resident_param_budget_bytes(hbm_bytes)
     tp = 1
     if heaviest_param_bytes:
         while (heaviest_param_bytes / tp > budget

@@ -182,6 +182,15 @@ def _stepper_submit(job_id, content_type, callback, kwargs, slot,
 
     if callback is not diffusion_callback or not stepper_eligible(kwargs):
         return None
+    # residency fast-path (ISSUE 8): a model the ledger knows is
+    # degraded to load-per-job must not pin a lane resident — and must
+    # not pay a full transient load just to be rejected by the lane
+    # (workloads.stepper_submit re-checks after first-ever loads)
+    lane_ok = getattr(registry, "lane_resident_ok", None)
+    if callable(lane_ok) and not lane_ok(str(kwargs.get("model_name"))):
+        log.debug("job %s model degraded to load-per-job; skipping lanes",
+                  job_id)
+        return None
     from chiaswarm_tpu.core.rng import draw_seed
     from chiaswarm_tpu.serving.stepper import LaneReject
 

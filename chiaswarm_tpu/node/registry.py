@@ -22,11 +22,11 @@ import logging
 from pathlib import Path
 from typing import Any
 
-from chiaswarm_tpu.core.compile_cache import GLOBAL_CACHE
 from chiaswarm_tpu.models.configs import FAMILIES, ModelFamily, get_family
 from chiaswarm_tpu.node.settings import load_file, settings_root
 from chiaswarm_tpu.pipelines.components import Components
 from chiaswarm_tpu.pipelines.diffusion import DiffusionPipeline
+from chiaswarm_tpu.serving.residency import ResidencyManager, default_manager
 
 log = logging.getLogger("chiaswarm.registry")
 
@@ -64,7 +64,8 @@ def _place_params(params, mesh, model_name: str):
 class ModelRegistry:
     def __init__(self, catalog: list[dict] | None = None,
                  allow_random: bool = False,
-                 attn_impl: str = "auto") -> None:
+                 attn_impl: str = "auto",
+                 residency: ResidencyManager | None = None) -> None:
         if catalog is None:
             catalog = load_file("models.json") or []
         self._catalog = {m.get("name", m.get("model_name", "")): m
@@ -72,6 +73,12 @@ class ModelRegistry:
         self.allow_random = allow_random
         self.attn_impl = attn_impl
         self._quarantined: dict[str, str] = {}
+        # the HBM ledger every pipeline load routes through (ISSUE 8):
+        # measured footprints, priority eviction with donation, prefetch,
+        # and the degradation rungs. Process-global by default (like the
+        # compile cache); tests pass private managers with tiny budgets.
+        self.residency = (residency if residency is not None
+                          else default_manager())
 
     # ---- quarantine (circuit breaker, node/resilience.py) ----
 
@@ -83,10 +90,12 @@ class ModelRegistry:
         log.error("quarantining model %s%s", model_name,
                   f": {reason}" if reason else "")
         self._quarantined[model_name] = reason or "circuit breaker open"
+        self.residency.note_quarantined(model_name)
 
     def unquarantine(self, model_name: str) -> None:
         if self._quarantined.pop(model_name, None) is not None:
             log.warning("model %s released from quarantine", model_name)
+        self.residency.note_unquarantined(model_name)
 
     def is_quarantined(self, model_name: str) -> bool:
         return model_name in self._quarantined
@@ -113,7 +122,56 @@ class ModelRegistry:
     def known_models(self) -> list[str]:
         return list(self._catalog)
 
-    # ---- residency ----
+    # ---- residency (serving/residency.py is the authority) ----
+
+    def model_states(self) -> dict[str, str]:
+        """ONE authoritative per-model state enum (ISSUE 8 satellite):
+        quarantine (previously a side dict) and residency (previously
+        invisible) merged — ``cold`` / ``loading`` / ``resident`` /
+        ``degraded`` / ``evicted`` / ``unavailable`` / ``quarantined``.
+        Served at ``/healthz`` (node/worker.py)."""
+        states = {name: "cold" for name in self._catalog if name}
+        states.update(self.residency.model_states())
+        for model in self._quarantined:
+            states[model] = "quarantined"
+        return states
+
+    def lane_resident_ok(self, model_name: str) -> bool:
+        """May this model pin a resident stepper lane? A model degraded
+        to load-per-job must run solo (load -> run -> release) — a lane
+        would hold its over-budget params live between jobs, defeating
+        the rung (node/executor.py checks this BEFORE the lane submit
+        path pays a transient load)."""
+        return not self.residency.would_degrade(str(model_name))
+
+    def _priority_for(self, model_name: str) -> int:
+        """Catalog-driven eviction priority (higher = evicted later);
+        the hive can pin its headline families hot via a
+        ``residency_priority`` entry/parameter field."""
+        entry = self.entry(model_name)
+        raw = entry.get("residency_priority",
+                        (entry.get("parameters") or {}).get(
+                            "residency_priority", 0))
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            return 0
+
+    def _estimate_bytes(self, model_name: str) -> int | None:
+        """Pre-load reservation fallback for a model never measured:
+        the family estimate at the serving weight density (1 byte/param
+        under CHIASWARM_WEIGHTS=int8, else bf16's 2). Replaced by the
+        measured footprint after the first load."""
+        try:
+            from chiaswarm_tpu.convert.quantize import bytes_per_param
+            from chiaswarm_tpu.pipelines.components import (
+                estimate_family_bytes,
+            )
+
+            return estimate_family_bytes(self.family_for(model_name).name,
+                                         bytes_per_param())
+        except Exception:  # unknown family shapes: load-then-measure
+            return None
 
     def family_for(self, model_name: str) -> ModelFamily:
         fam = self.entry(model_name).get("family")
@@ -144,8 +202,10 @@ class ModelRegistry:
                  lora_scale: float = 1.0,
                  mesh=None):
         """Resident pipeline (components + params + compiled executables),
-        one LRU entry under the HBM byte budget: evicting the entry drops
-        the only strong reference to the param tree. The pipeline class is
+        one measured entry in the residency ledger (serving/residency.py):
+        evicting it drops the manager's strong reference to the param
+        tree, and a model whose measured footprint exceeds the budget
+        degrades to load-per-job instead. The pipeline class is
         selected by the family's ``kind`` ("sd" -> DiffusionPipeline,
         "upscaler" -> LatentUpscalePipeline). A textual inversion keys a
         SEPARATE entry: the concept rows merge into that entry's private
@@ -200,6 +260,17 @@ class ModelRegistry:
                 log.info("merged LoRA %s into %s (%d projections, "
                          "scale %.3g)", lora, model_name, n_merged,
                          lora_scale)
+            # int8 weight residency (convert/quantize.py, gated by
+            # CHIASWARM_WEIGHTS=int8 + the forward-parity tests):
+            # quantize AFTER the adapter merges (fp math) and BEFORE
+            # placement; multi-chip placements decline (sharding specs
+            # are fp-tree-shaped)
+            from chiaswarm_tpu.convert.quantize import (
+                maybe_quantize_params,
+            )
+
+            components.params = maybe_quantize_params(
+                components.params, family=components.family, mesh=mesh)
             # place AFTER the embedding-table/LoRA merges so the final
             # tree gets uniform placement
             components.params = _place_params(components.params, mesh,
@@ -221,10 +292,12 @@ class ModelRegistry:
             return DiffusionPipeline(components, attn_impl=self.attn_impl)
 
         lora_key = (lora, float(lora_scale)) if lora is not None else None
-        return GLOBAL_CACHE.cached_params(
+        return self.residency.acquire(
             ("pipeline", model_name, textual_inversion, lora_key, mesh_key),
-            build,
+            build, model=model_name,
             size_of=lambda pipe: pipe.c.param_bytes(),
+            estimate=lambda: self._estimate_bytes(model_name),
+            priority=self._priority_for(model_name),
         )
 
     def components(self, model_name: str) -> Components:
@@ -272,9 +345,10 @@ class ModelRegistry:
                                               model_name)
             return CascadePipeline(components)
 
-        return GLOBAL_CACHE.cached_params(
-            ("cascade", model_name, mesh_key), build,
+        return self.residency.acquire(
+            ("cascade", model_name, mesh_key), build, model=model_name,
             size_of=lambda pipe: pipe.c.param_bytes(),
+            priority=self._priority_for(model_name),
         )
 
     def audio_pipeline(self, model_name: str):
@@ -309,9 +383,10 @@ class ModelRegistry:
                 f"(no checkpoint at {ckpt})"
             )
 
-        return GLOBAL_CACHE.cached_params(
-            ("audio", model_name), build,
+        return self.residency.acquire(
+            ("audio", model_name), build, model=model_name,
             size_of=lambda pipe: pipe.c.param_bytes(),
+            priority=self._priority_for(model_name),
         )
 
     def video_pipeline(self, model_name: str, mesh=None):
@@ -367,9 +442,10 @@ class ModelRegistry:
                                               model_name)
             return pipeline_cls(components, attn_impl=self.attn_impl)
 
-        return GLOBAL_CACHE.cached_params(
-            ("video", model_name, mesh_key), build,
+        return self.residency.acquire(
+            ("video", model_name, mesh_key), build, model=model_name,
             size_of=lambda pipe: pipe.c.param_bytes(),
+            priority=self._priority_for(model_name),
         )
 
     def tts_pipeline(self, model_name: str):
@@ -408,9 +484,10 @@ class ModelRegistry:
                 f"(no checkpoint at {ckpt})"
             )
 
-        return GLOBAL_CACHE.cached_params(
-            ("tts", model_name), build,
+        return self.residency.acquire(
+            ("tts", model_name), build, model=model_name,
             size_of=lambda pipe: pipe.c.param_bytes(),
+            priority=self._priority_for(model_name),
         )
 
     def caption_pipeline(self, model_name: str, mesh=None):
@@ -463,34 +540,61 @@ class ModelRegistry:
                                                    device)
             return CaptionPipeline(components)
 
-        return GLOBAL_CACHE.cached_params(
-            ("caption", model_name, mesh_key), build,
+        return self.residency.acquire(
+            ("caption", model_name, mesh_key), build, model=model_name,
             size_of=lambda pipe: pipe.c.param_bytes(),
+            priority=self._priority_for(model_name),
         )
 
-    def controlnet(self, controlnet_name: str, family: ModelFamily):
+    def controlnet(self, controlnet_name: str, family: ModelFamily,
+                   mesh=None):
         """Resident ControlNetBundle (the per-job ControlNetModel load of
-        swarm/diffusion/diffusion_func.py:29-34, made resident + LRU'd)."""
+        swarm/diffusion/diffusion_func.py:29-34, made resident + LRU'd).
+
+        ``mesh`` (the consuming slot's mesh) only gates the int8 path:
+        sharded placements decline quantization exactly like the base
+        pipeline's params, so a multi-chip generate program never mixes
+        sharded fp weights with a single-device-committed int8 control
+        tree. The quantization decision rides the cache key — a bundle
+        requested from both a single-chip and a multi-chip slot keys
+        two entries rather than serving whichever loaded first."""
+        from chiaswarm_tpu.convert.quantize import int8_enabled
         from chiaswarm_tpu.pipelines.components import ControlNetBundle
 
+        quantize = (int8_enabled() and family.kind == "sd"
+                    and (mesh is None or mesh.devices.size <= 1))
+
         def load() -> ControlNetBundle:
+            from chiaswarm_tpu.convert.quantize import (
+                maybe_quantize_params,
+            )
+
             ckpt = model_dir(controlnet_name)
             if ckpt.exists():
                 log.info("loading controlnet %s from %s",
                          controlnet_name, ckpt)
-                return ControlNetBundle.from_checkpoint(
+                bundle = ControlNetBundle.from_checkpoint(
                     ckpt, controlnet_name, family)
-            if self.allow_random:
+            elif self.allow_random:
                 log.warning("no checkpoint for controlnet %s; using random "
                             "weights", controlnet_name)
-                return ControlNetBundle.random(family,
-                                               model_name=controlnet_name)
-            raise ValueError(
-                f"controlnet {controlnet_name!r} is not available on this "
-                f"node (no checkpoint at {ckpt})"
-            )
+                bundle = ControlNetBundle.random(family,
+                                                model_name=controlnet_name)
+            else:
+                raise ValueError(
+                    f"controlnet {controlnet_name!r} is not available on "
+                    f"this node (no checkpoint at {ckpt})"
+                )
+            # bundles are the catalog's multiplied checkpoint class —
+            # the int8 path applies to them like the base families
+            if quantize:
+                bundle.params = maybe_quantize_params(
+                    bundle.params, family=family, mesh=None)
+            return bundle
 
-        return GLOBAL_CACHE.cached_params(
-            ("controlnet", controlnet_name, family.name), load,
+        return self.residency.acquire(
+            ("controlnet", controlnet_name, family.name, quantize), load,
+            model=controlnet_name,
             size_of=lambda b: b.param_bytes(),
+            priority=self._priority_for(controlnet_name),
         )

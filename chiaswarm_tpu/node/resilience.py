@@ -104,7 +104,11 @@ _TRANSIENT_TYPE_NAMES = frozenset({
 })
 
 _MODEL_UNAVAILABLE_MARKERS = (
-    "is not available on this node",   # node/registry.py load errors
+    # node/registry.py load errors AND the residency bounce
+    # (serving/residency.py::ModelUnavailable — the model cannot fit
+    # this node's HBM even transiently; a different node may have the
+    # room, so the hive should redispatch)
+    "is not available on this node",
     "quarantined",                     # breaker refusal re-entering a load
 )
 

@@ -106,6 +106,15 @@ class Settings:
     # 0 there (its timeout detector stays the only failure story).
     heartbeat_s: float = 0.0
     checkpoint_dir: str = ""            # default <root>/checkpoints/<worker>
+    # ---- HBM model residency (serving/residency.py, ISSUE 8) ----
+    # explicit resident-param budget in bytes; 0 = auto (the
+    # CHIASWARM_RESIDENCY_BUDGET env var, else the classic HBM fraction
+    # from core/mesh.py as the initial no-model-loaded fallback)
+    residency_budget_bytes: int = 0
+    # demand-driven prefetch: idle polls warm-load the hottest evicted
+    # model back into free budget (CHIASWARM_RESIDENCY_PREFETCH=0 and
+    # this flag both disable it)
+    residency_prefetch: bool = True
 
     def deadline_for(self, workflow: str | None) -> float:
         """Execution budget (seconds) for one job of ``workflow`` (None /

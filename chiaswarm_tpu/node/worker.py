@@ -240,6 +240,18 @@ class Worker:
         # jobs between poll receipt and settled upload — the id set the
         # heartbeat keeps leased (insertion-ordered for stable payloads)
         self._inflight: dict[Any, float] = {}
+        # ---- HBM residency (ISSUE 8, serving/residency.py) ----
+        # push the operator's settings into the registry's ledger: an
+        # explicit budget override, and the prefetch toggle (idle polls
+        # trigger demand-driven warm loads below)
+        residency = getattr(self.registry, "residency", None)
+        if residency is not None:
+            if int(self.settings.residency_budget_bytes or 0) > 0:
+                residency.set_budget(
+                    int(self.settings.residency_budget_bytes))
+            residency.prefetch_enabled = bool(
+                self.settings.residency_prefetch
+                and residency.prefetch_enabled)
 
     def _spool_dirname(self) -> str:
         return re.sub(r"[^A-Za-z0-9._-]+", "_",
@@ -285,8 +297,15 @@ class Worker:
         return ChipPool(n_slots=1, mesh_spec=spec)
 
     def _heaviest_catalog_bytes(self) -> int | None:
-        """bf16 footprint of the largest diffusion family the catalog
-        serves (None = empty catalog). Non-SD names (tts/audio/caption)
+        """Footprint of the heaviest model the catalog serves (None =
+        empty catalog), feeding the default dp x tp mesh policy.
+
+        MEASURED first (ISSUE 8): the residency ledger persists real
+        per-model footprints across restarts (serving/residency.py), so
+        a node that has served its catalog before derives its mesh from
+        live numbers. Models never measured fall back to the bf16
+        family estimate — the pre-ISSUE-8 knob, kept exactly for this
+        no-model-has-loaded-yet case. Non-SD names (tts/audio/caption)
         fall through get_family to sd15 — a small, harmless overestimate
         that never turns tp on by itself."""
         try:
@@ -298,8 +317,16 @@ class Worker:
             names = self.registry.known_models()
             if not names:
                 return None
-            families = {get_family(name).name for name in names}
-            return max(estimate_family_bytes(f) for f in families)
+            residency = getattr(self.registry, "residency", None)
+            measured = (residency.measured_footprints()
+                        if residency is not None else {})
+            heaviest = 0
+            for name in names:
+                nbytes = measured.get(name)
+                if nbytes is None:
+                    nbytes = estimate_family_bytes(get_family(name).name)
+                heaviest = max(heaviest, int(nbytes))
+            return heaviest or None
         except Exception as exc:  # policy must never block startup
             log.warning("mesh policy estimate failed (%s); using dp-only",
                         exc)
@@ -495,6 +522,14 @@ class Worker:
         }
         data.update(self.stats.snapshot())
         data["stepper"] = self._stepper_health()
+        # HBM residency (ISSUE 8): the measured ledger + the one
+        # authoritative per-model state enum (quarantine merged in)
+        residency = getattr(self.registry, "residency", None)
+        if residency is not None:
+            data["residency"] = residency.snapshot()
+        model_states = getattr(self.registry, "model_states", None)
+        if callable(model_states):
+            data["models"] = model_states()
         return data
 
     def _stepper_health(self) -> dict[str, Any]:
@@ -725,6 +760,17 @@ class Worker:
             await self.work_queue.put(job)
         if jobs:
             return float(self.settings.poll_busy_s)
+        # demand-driven prefetch (ISSUE 8): an empty poll is the ONLY
+        # moment background warm loads may run — the ledger picks the
+        # hottest evicted model (arrival EWMA) that fits the free budget
+        # and loads it on a daemon thread; busy polls never trigger it
+        if not self._stop.is_set() and self.work_queue.empty():
+            residency = getattr(self.registry, "residency", None)
+            if residency is not None:
+                try:
+                    residency.note_idle()
+                except Exception as exc:  # prefetch must never stop polls
+                    log.debug("residency prefetch tick failed: %s", exc)
         return float(self.settings.poll_idle_s)
 
     async def _heartbeat_loop(self) -> None:

@@ -422,5 +422,111 @@ def lane_admissions_counter(registry: Registry | None = None) -> Counter:
         labelnames=("workload",))
 
 
+# ---- HBM model-residency families (ISSUE 8, serving/residency.py) ----
+#
+# The residency manager owns the ledger; these helpers only declare the
+# families (on the process-global REGISTRY by default — the manager is
+# one-per-process like the compile cache; hermetic test managers pass
+# their own Registry). The manager pre-seeds every label vocabulary at
+# import so dashboards see zeroes from the first scrape (the ISSUE-6
+# convention for the lease/resume families).
+
+#: the authoritative per-model state vocabulary (registry + residency,
+#: ISSUE 8 satellite: quarantine and residency share one enum)
+RESIDENCY_STATES = ("cold", "loading", "resident", "degraded",
+                    "evicted", "unavailable", "quarantined")
+
+#: why a resident model was dropped from HBM
+RESIDENCY_EVICT_REASONS = ("capacity", "squeeze")
+
+#: how a model load was served (resident admit / degraded load-per-job /
+#: background prefetch)
+RESIDENCY_LOAD_MODES = ("resident", "per_job", "prefetch")
+
+
+def residency_bytes_gauge(registry: Registry | None = None) -> Gauge:
+    """Bytes of model params the residency ledger holds resident —
+    MEASURED from the live trees at load (summed shard .nbytes), not
+    estimated. The headroom signal: steady-state near the budget with a
+    nonzero eviction rate means the catalog is HBM-bound (quantize, or
+    raise CHIASWARM_RESIDENCY_BUDGET)."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_residency_resident_bytes",
+        "measured bytes of model params currently resident in HBM")
+
+
+def residency_budget_gauge(registry: Registry | None = None) -> Gauge:
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_residency_budget_bytes",
+        "HBM byte budget the residency ledger evicts down to")
+
+
+def residency_peak_gauge(registry: Registry | None = None) -> Gauge:
+    """High-water mark of resident + reserved bytes — THE no-double-
+    buffer proof: a swap that evicts before loading keeps this at
+    most budget + one model (the churn tests assert exactly that)."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_residency_peak_bytes",
+        "high-water mark of resident + in-flight reserved bytes")
+
+
+def residency_models_gauge(registry: Registry | None = None) -> Gauge:
+    """Model count per residency state (the /healthz ``models`` enum,
+    aggregated). ``degraded`` > 0 is the graceful-degradation rung in
+    action: some model serves load-per-job because its measured
+    footprint exceeds the budget."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_residency_models",
+        "models per residency state (cold/loading/resident/degraded/"
+        "evicted/unavailable/quarantined)",
+        labelnames=("state",))
+
+
+def residency_evictions_counter(registry: Registry | None = None) -> Counter:
+    """Ledger evictions by reason: ``capacity`` (donation — room made
+    for an incoming load) vs ``squeeze`` (the budget itself shrank). A
+    high capacity rate with a small catalog means footprints ~ budget:
+    expect swap latency on every model switch."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_residency_evictions_total",
+        "models evicted from HBM residency, by reason",
+        labelnames=("reason",))
+
+
+def residency_loads_counter(registry: Registry | None = None) -> Counter:
+    """Model loads by mode. ``per_job`` counting up is the degradation
+    rung burning load latency per job — the signal to quantize
+    (CHIASWARM_WEIGHTS=int8) or grow the budget; ``prefetch`` counts
+    idle-poll warm loads driven by the per-model arrival EWMA."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_residency_loads_total",
+        "model param-tree loads, by residency mode",
+        labelnames=("mode",))
+
+
+def residency_bounces_counter(registry: Registry | None = None) -> Counter:
+    """Jobs refused because the model cannot fit even transiently
+    (footprint > hard limit): uploaded as non-fatal
+    ``model_unavailable`` so a lease-aware hive redispatches them
+    (node/minihive.py REDISPATCH_KINDS)."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_residency_bounces_total",
+        "jobs bounced model_unavailable: model cannot fit transiently")
+
+
+def residency_load_seconds_histogram(
+        registry: Registry | None = None) -> Histogram:
+    """Wall time of one model load (convert/build + measure), by mode —
+    with ``swapped="1"`` when the load had to evict first. The swap
+    latency the ``model_churn`` bench config stamps into BENCH json."""
+    return (registry or REGISTRY).histogram(
+        "chiaswarm_residency_load_seconds",
+        "model load wall time, by residency mode and whether the load "
+        "evicted residents first",
+        labelnames=("mode", "swapped"),
+        buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                 60.0, 120.0, 300.0))
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -83,6 +83,37 @@ def abstract_params(family: ModelFamily | str) -> dict[str, Any]:
     return shapes
 
 
+def measured_param_bytes(tree: Any) -> int:
+    """MEASURED per-chip HBM footprint of a live param tree (ISSUE 8):
+    sum each leaf's ``.nbytes`` across its addressable shards, bucketed
+    per device, max over devices — replicated copies cost every chip
+    their full size, tensor-parallel shards split it. This is what the
+    residency ledger (serving/residency.py) accounts with, replacing
+    the worker's bf16 family-size estimate. Host/numpy leaves (not yet
+    placed) count toward a shared bucket. int8-quantized leaves
+    (convert/quantize.py Int8Param pytree nodes) flatten to their code
+    + scale arrays, so the measurement sees the real int8 bytes."""
+    per_device: dict[Any, int] = {}
+    host_bytes = 0
+    for leaf in jax.tree.leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for shard in shards:
+                nbytes = int(getattr(shard.data, "nbytes", 0) or 0)
+                per_device[shard.device] = (
+                    per_device.get(shard.device, 0) + nbytes)
+        else:
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is None:
+                import numpy as np
+
+                nbytes = np.asarray(leaf).nbytes
+            host_bytes += int(nbytes)
+    if not per_device:
+        return host_bytes
+    return max(per_device.values()) + host_bytes
+
+
 _FAMILY_BYTES_CACHE: dict[tuple[str, int], int] = {}
 
 
@@ -241,8 +272,7 @@ class Components:
         )
 
     def param_bytes(self) -> int:
-        leaves = jax.tree.leaves(self.params)
-        return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+        return measured_param_bytes(self.params)
 
 
 @dataclasses.dataclass
@@ -369,5 +399,4 @@ class ControlNetBundle:
                    params=convert_controlnet(state, family.unet))
 
     def param_bytes(self) -> int:
-        leaves = jax.tree.leaves(self.params)
-        return sum(leaf.size * leaf.dtype.itemsize for leaf in leaves)
+        return measured_param_bytes(self.params)

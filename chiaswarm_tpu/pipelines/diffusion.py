@@ -41,6 +41,7 @@ from chiaswarm_tpu.obs import trace as obs_trace
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
 from chiaswarm_tpu.parallel.context import seq_parallel_wrap
+from chiaswarm_tpu.convert.quantize import dequantize_tree
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.vae import AutoencoderKL
 from chiaswarm_tpu.pipelines.components import Components
@@ -302,6 +303,11 @@ class DiffusionPipeline:
         def fn(params, ids, neg_ids, sample_keys, guidance, init_latent,
                mask, control_params, control_cond, control_scale,
                image_guidance, noise_override):
+            # int8 weight residency (convert/quantize.py): dequantize AT
+            # USE, inside the traced program — HBM holds the int8 codes,
+            # XLA fuses the casts into the consumers. No-op on fp trees.
+            params = dequantize_tree(params)
+            control_params = dequantize_tree(control_params)
             ctx, pooled = encode_text(params, ids)
             if pix2pix:
                 # dual CFG rides a tripled batch: [uncond, image-only,
@@ -478,7 +484,8 @@ class DiffusionPipeline:
                               "width": width}),
             lambda: toplevel_jit(
                 lambda params, x, key: vae.apply(
-                    params, x, key, method=AutoencoderKL.encode)))
+                    dequantize_tree(params), x, key,
+                    method=AutoencoderKL.encode)))
         z = fn(self.c.params["vae"], jnp.asarray(img), key_for_seed(seed))
         return z[:n]
 
@@ -503,6 +510,7 @@ class DiffusionPipeline:
             encode_text = _make_text_encode(text_encoders)
 
             def fn(params, ids, neg_ids):
+                params = dequantize_tree(params)
                 ctx_c, pooled_c = encode_text(params, ids)
                 ctx_u, pooled_u = encode_text(params, neg_ids)
                 return ctx_u, ctx_c, pooled_u, pooled_c
@@ -581,6 +589,8 @@ class DiffusionPipeline:
                    idx, start_idx, sigmas_tab, ts_tab, guidance,
                    old_denoised, active, known, mask, mask_on,
                    control_params, cond, cscale):
+                params = dequantize_tree(params)
+                control_params = dequantize_tree(control_params)
                 sched_rows = SamplingSchedule(sigmas=sigmas_tab,
                                               timesteps=ts_tab)
                 inp = scale_model_input_rows(sched_rows, x, idx)
@@ -667,7 +677,8 @@ class DiffusionPipeline:
                 downscale=fam.vae.downscale)
 
             def fn(embed_params, cond):
-                return control_embed.apply(embed_params, cond)
+                return control_embed.apply(dequantize_tree(embed_params),
+                                           cond)
 
             return toplevel_jit(fn)
 
@@ -683,6 +694,7 @@ class DiffusionPipeline:
 
         def build():
             def fn(params, x):
+                params = dequantize_tree(params)
                 img = vae.apply(params["vae"], x,
                                 method=AutoencoderKL.decode)
                 return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)

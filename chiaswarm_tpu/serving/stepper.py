@@ -103,6 +103,11 @@ from chiaswarm_tpu.obs.metrics import (
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
 
+# the rows/second EWMA the width controllers read is the SAME demand
+# primitive the residency manager ranks prefetch candidates with — one
+# implementation, shared (ISSUE 8 reuses the ISSUE-7c pattern)
+from chiaswarm_tpu.serving.residency import ArrivalEwma as _ArrivalEwma
+
 log = logging.getLogger("chiaswarm.stepper")
 
 # per-step latency distribution under mixed admission — THE signal lane
@@ -346,29 +351,6 @@ class LaneWidthController:
         return target
 
 
-class _ArrivalEwma:
-    """Rows/second EWMA over inter-arrival gaps, decayed while idle —
-    the scheduler-level demand signal the width controllers read. All
-    methods take an explicit monotonic ``now`` (testable on a fake
-    clock; obs R8 forbids wallclock deltas anyway)."""
-
-    def __init__(self, window_s: float = 10.0) -> None:
-        self.window_s = float(window_s)
-        self._rate = 0.0
-        self._last: float | None = None
-
-    def note(self, rows: int, now: float) -> None:
-        if self._last is not None:
-            gap = max(now - self._last, 1e-3)
-            decay = 0.5 ** (gap / self.window_s)
-            self._rate = decay * self._rate + (1.0 - decay) * (rows / gap)
-        self._last = now
-
-    def rate(self, now: float) -> float:
-        if self._last is None:
-            return 0.0
-        return self._rate * 0.5 ** (max(now - self._last, 0.0)
-                                    / self.window_s)
 
 
 class Lane:

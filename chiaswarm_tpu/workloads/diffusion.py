@@ -56,6 +56,9 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
     pipe = registry.pipeline(model_name, textual_inversion=textual_inversion,
                              lora=lora, lora_scale=cross_attention_scale,
                              mesh=getattr(slot, "mesh", None))
+    from chiaswarm_tpu.serving.residency import is_transient
+
+    degraded = is_transient(pipe)  # load-per-job rung (serving/residency.py)
     fam = pipe.c.family
     if fam.kind != "sd":
         raise ValueError(
@@ -84,7 +87,8 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         # the fetched input IS the (preprocessed) conditioning image — it
         # steers generation instead of seeding latents
         # (swarm/job_arguments.py:116-124)
-        controlnet = registry.controlnet(controlnet_model_name, fam)
+        controlnet = registry.controlnet(controlnet_model_name, fam,
+                                         mesh=getattr(slot, "mesh", None))
         control_image, image = image, None
 
     if image_guidance_scale is not None and not fam.image_conditioned:
@@ -167,6 +171,10 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         "generation_s": round(elapsed, 3),
         "slot": slot.descriptor() if hasattr(slot, "descriptor") else str(slot),
     })
+    if degraded:
+        # observable per job: this result paid a load (the model exceeds
+        # the residency budget and serves load -> run -> release)
+        config["residency"] = "per_job"
     return artifacts, config
 
 
@@ -274,7 +282,8 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
     through the ordinary path instead."""
     from chiaswarm_tpu.core.compile_cache import bucket_image_size
     from chiaswarm_tpu.schedulers import resolve
-    from chiaswarm_tpu.serving.stepper import get_stepper
+    from chiaswarm_tpu.serving.residency import is_transient
+    from chiaswarm_tpu.serving.stepper import LaneReject, get_stepper
 
     model_name = kwargs.get("model_name")
     scale = kwargs.get("cross_attention_scale")
@@ -284,6 +293,13 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
         lora=kwargs.get("lora"),
         lora_scale=1.0 if scale is None else float(scale),
         mesh=getattr(slot, "mesh", None))
+    if is_transient(pipe):
+        # degradation rung (serving/residency.py): a lane would hold the
+        # over-budget params resident between jobs — run load-per-job
+        # solo instead. The executor's lane_resident_ok pre-check makes
+        # this a first-ever-load-only cost.
+        raise LaneReject(
+            f"model {model_name!r} degraded to load-per-job (residency)")
     fam = pipe.c.family
     image = kwargs.get("image")
     # ControlNet: the fetched input IS the conditioning image (exactly
@@ -292,7 +308,8 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
     control_image = None
     controlnet_name = kwargs.get("controlnet_model_name")
     if controlnet_name is not None:
-        controlnet = registry.controlnet(controlnet_name, fam)
+        controlnet = registry.controlnet(controlnet_name, fam,
+                                         mesh=getattr(slot, "mesh", None))
         control_image, image = image, None
     if image is not None:
         height, width = int(image.shape[0]), int(image.shape[1])

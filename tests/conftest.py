@@ -2,6 +2,17 @@
 so multi-chip mesh/sharding code is exercised without a TPU (SURVEY.md §4)."""
 
 import os
+import tempfile
+
+# session-level settings-root isolation: the process-global residency
+# manager (serving/residency.py, ISSUE 8) persists measured footprints
+# under settings_root() at its FIRST registry construction — without
+# this default, any test building a ModelRegistry before a per-test
+# SWARM_TPU_ROOT fixture runs would write tiny/random-model footprints
+# into the operator's real ~/.swarm-tpu/residency.json. Tests that set
+# their own root (monkeypatch.setenv) still override per-test.
+os.environ.setdefault(
+    "SWARM_TPU_ROOT", tempfile.mkdtemp(prefix="swarm-tpu-test-root-"))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")

@@ -297,6 +297,10 @@ def test_breaker_quarantines_model_then_probes_and_recovers():
                 [_cjob(f"q{i}", chaos=["crash"], model=bad)], StubSlot())
             assert classify_result(result) == "error"
         assert registry.is_quarantined(bad)
+        # satellite (ISSUE 8): quarantine surfaces through the ONE
+        # authoritative per-model state enum /healthz serves
+        assert registry.model_states()[bad] == "quarantined"
+        assert worker.health()["models"][bad] == "quarantined"
         assert worker.health()["breakers"][bad]["state"] == "open"
         with pytest.raises(ValueError, match="quarantined"):
             registry.pipeline(bad)
@@ -314,6 +318,7 @@ def test_breaker_quarantines_model_then_probes_and_recovers():
             [_cjob("q3", chaos=["ok"], model=bad)], StubSlot())
         assert classify_result(probe) == "ok"
         assert not registry.is_quarantined(bad)
+        assert registry.model_states().get(bad) != "quarantined"
         assert worker.health()["breakers"][bad]["state"] == "closed"
 
     asyncio.run(scenario())
@@ -944,3 +949,202 @@ def test_mid_lane_fault_keeps_zero_loss(monkeypatch):
         assert r["pipeline_config"].get("error") is None, r
         assert "fatal_error" not in r
     assert stepper.stats().get("lanes_failed", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: the budget-squeeze fault — residency churn under the chaos
+# harness (evict -> reload -> degraded load-per-job -> bounce/redispatch)
+# ---------------------------------------------------------------------------
+
+
+def _residency_worker_parts(budget_bytes, hard_bytes, models,
+                            monkeypatch):
+    """Real tiny pipelines + a private residency ledger + a single-chip
+    pool — the substrate both squeeze tests share. Lanes are opted out:
+    a lane holds its pipe between jobs, which would blur the ledger
+    accounting these tests assert exactly."""
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.obs.metrics import Registry as ObsRegistry
+    from chiaswarm_tpu.serving.residency import ResidencyManager
+
+    monkeypatch.setenv("CHIASWARM_STEPPER", "0")
+    manager = ResidencyManager(budget_bytes=budget_bytes,
+                               hard_limit_bytes=hard_bytes,
+                               metrics_registry=ObsRegistry(),
+                               persist_path=None, reserve_wait_s=0.2)
+    registry = ModelRegistry(
+        catalog=[{"name": name, "family": "tiny"} for name in models],
+        allow_random=True, residency=manager)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+    return manager, registry, pool
+
+
+def test_budget_squeeze_churn_zero_loss(monkeypatch):
+    """ISSUE 8 satellite: a scripted budget squeeze while a mixed-model
+    stream flows — models churn through every rung (resident -> evicted
+    -> reloaded -> degraded load-per-job -> model_unavailable bounce)
+    and NO job is lost: every id settles as exactly one envelope, the
+    bounce uploads non-fatal model_unavailable (redispatchable, PR 6),
+    and peak ledger bytes never exceed budget + one model."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+
+    models = ["tiny/a", "tiny/b"]
+    # probe one load to denominate the budget in measured bytes
+    probe_mgr, probe_reg, _ = _residency_worker_parts(
+        1 << 30, 2 << 30, ["tiny/probe"], monkeypatch)
+    probe_reg.pipeline("tiny/probe")
+    footprint = probe_mgr.measured_footprints()["tiny/probe"]
+
+    budget = int(footprint * 1.5)
+    manager, registry, pool = _residency_worker_parts(
+        budget, footprint * 4, models, monkeypatch)
+    manager.reset_peak()
+
+    async def scenario():
+        hive = FakeHive()
+        await hive.start()
+        worker = Worker(
+            settings=chaos_settings(hive.uri, job_deadline_s=600.0,
+                                    workflow_deadline_s={}),
+            registry=registry, pool=pool)
+        task = asyncio.create_task(worker.run())
+        try:
+            # phase 1: alternate models under the tight budget — churn.
+            # One job at a time: a depth-2 slot would otherwise load
+            # both models concurrently and make the eviction count
+            # depend on admit order.
+            for i in range(3):
+                hive.jobs.append(
+                    {"id": f"sq-{i}", "model_name": models[i % 2],
+                     "prompt": f"p{i}", "seed": 40 + i,
+                     "num_inference_steps": 2, "height": 64, "width": 64,
+                     "content_type": "image/png"})
+                await hive.wait_for_results(i + 1, timeout=600)
+            # phase 2: SQUEEZE below one model — the next job must
+            # degrade to load-per-job, not fail
+            manager.set_budget(int(footprint * 0.5))
+            hive.jobs.append(
+                {"id": "sq-degraded", "model_name": models[0],
+                 "prompt": "pd", "seed": 50, "num_inference_steps": 2,
+                 "height": 64, "width": 64,
+                 "content_type": "image/png"})
+            await hive.wait_for_results(4, timeout=600)
+            # phase 3: squeeze the HARD limit below one model — the job
+            # bounces model_unavailable for the hive to redispatch
+            manager.set_budget(int(footprint * 0.5),
+                               hard_limit_bytes=int(footprint * 0.6))
+            hive.jobs.append(
+                {"id": "sq-bounce", "model_name": models[1],
+                 "prompt": "pb", "seed": 51, "num_inference_steps": 2,
+                 "height": 64, "width": 64,
+                 "content_type": "application/json"})
+            await hive.wait_for_results(5, timeout=600)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=60)
+            await hive.stop()
+        return hive.results
+
+    results = asyncio.run(scenario())
+    by_id = {r["id"]: r for r in results}
+    # zero loss: every id exactly once
+    assert sorted(by_id) == ["sq-0", "sq-1", "sq-2", "sq-bounce",
+                             "sq-degraded"]
+    assert len(results) == 5
+    for i in range(3):
+        assert by_id[f"sq-{i}"]["pipeline_config"].get("error") is None
+    degraded = by_id["sq-degraded"]["pipeline_config"]
+    assert degraded.get("error") is None
+    assert degraded.get("residency") == "per_job"
+    bounce = by_id["sq-bounce"]
+    assert bounce["pipeline_config"]["error_kind"] == "model_unavailable"
+    assert "fatal_error" not in bounce  # a lease-aware hive redispatches
+    from chiaswarm_tpu.node.resilience import REDISPATCH_KINDS
+
+    assert bounce["pipeline_config"]["error_kind"] in REDISPATCH_KINDS
+    # the ledger churned within its invariant
+    snap = manager.snapshot()
+    assert snap["evictions"] >= 2
+    assert snap["degraded_loads"] >= 1
+    assert snap["bounces"] >= 1
+    largest = max(manager.measured_footprints().values())
+    assert manager.peak_bytes <= budget + largest
+
+
+@pytest.mark.slow
+def test_residency_squeeze_soak_zero_loss(monkeypatch):
+    """Nightly residency soak (ISSUE 8 satellite, runs in the chaos-soak
+    workflow's ``-k soak`` selection): a seeded mixed-model stream with
+    randomized mid-run budget squeezes/restores. The gate is the
+    zero-loss invariant plus the no-double-buffer peak bound, at soak
+    scale."""
+    import os
+    import random
+    import sys
+
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+
+    seed = os.environ.get("CHIASWARM_SOAK_SEED", "residency-default")
+    # divided down from the chaos-soak job knob: unlike the stub-executor
+    # soaks, every one of these jobs runs a REAL tiny pipeline, and every
+    # swap recompiles — ~10x the per-job cost
+    n_jobs = max(8, int(os.environ.get("CHIASWARM_SOAK_JOBS", "120")) // 10)
+    rng = random.Random(f"residency-soak:{seed}")
+
+    models = ["tiny/a", "tiny/b", "tiny/c"]
+    probe_mgr, probe_reg, _ = _residency_worker_parts(
+        1 << 30, 2 << 30, ["tiny/probe"], monkeypatch)
+    probe_reg.pipeline("tiny/probe")
+    footprint = probe_mgr.measured_footprints()["tiny/probe"]
+    budget = int(footprint * 1.7)
+    manager, registry, pool = _residency_worker_parts(
+        budget, footprint * 4, models, monkeypatch)
+    manager.reset_peak()
+
+    async def scenario():
+        hive = FakeHive()
+        await hive.start()
+        worker = Worker(
+            settings=chaos_settings(hive.uri, job_deadline_s=600.0,
+                                    workflow_deadline_s={}),
+            registry=registry, pool=pool)
+        task = asyncio.create_task(worker.run())
+        try:
+            done = 0
+            for i in range(n_jobs):
+                hive.jobs.append(
+                    {"id": f"rsoak-{i}",
+                     "model_name": rng.choice(models),
+                     "prompt": f"p{i}", "seed": 7000 + i,
+                     "num_inference_steps": 2, "height": 64,
+                     "width": 64, "content_type": "image/png"})
+                done += 1
+                await hive.wait_for_results(done, timeout=600)
+                # seeded squeezes: shrink below one model (degrade) or
+                # restore; the stream must keep settling either way
+                roll = rng.random()
+                if roll < 0.25:
+                    manager.set_budget(int(footprint * 0.5))
+                elif roll < 0.5:
+                    manager.set_budget(budget)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=60)
+            await hive.stop()
+        return hive.results
+
+    results = asyncio.run(scenario())
+    ids = [r["id"] for r in results]
+    assert len(ids) == len(set(ids)) == n_jobs  # exactly once, no loss
+    for r in results:
+        assert r["pipeline_config"].get("error") is None, r
+    largest = max(manager.measured_footprints().values())
+    assert manager.peak_bytes <= budget + largest

@@ -400,6 +400,27 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert "chiaswarm_inflight_jobs 0" in body
     assert "chiaswarm_stepper_rows_resumed_total 0" in body
     assert "# TYPE chiaswarm_stepper_resume_step histogram" in body
+    # ...HBM residency families (ISSUE 8, serving/residency.py): every
+    # label vocabulary pre-seeded to zero from scrape one...
+    assert "# TYPE chiaswarm_residency_resident_bytes gauge" in body
+    assert "chiaswarm_residency_budget_bytes" in body
+    assert "chiaswarm_residency_peak_bytes" in body
+    assert "chiaswarm_residency_bounces_total" in body
+    from chiaswarm_tpu.obs.metrics import (
+        RESIDENCY_EVICT_REASONS,
+        RESIDENCY_LOAD_MODES,
+        RESIDENCY_STATES,
+    )
+
+    for state in RESIDENCY_STATES:
+        assert f'chiaswarm_residency_models{{state="{state}"}}' in body
+    for reason in RESIDENCY_EVICT_REASONS:
+        assert (f'chiaswarm_residency_evictions_total{{reason="{reason}"}}'
+                in body)
+    for mode in RESIDENCY_LOAD_MODES:
+        assert (f'chiaswarm_residency_loads_total{{mode="{mode}"}}'
+                in body)
+    assert "# TYPE chiaswarm_residency_load_seconds histogram" in body
     # ...compile-cache + hive families from the process registry...
     assert "chiaswarm_compile_cache_misses_total" in body
     assert "# TYPE chiaswarm_compiles_total counter" in body

@@ -1,0 +1,705 @@
+"""Residency suite (ISSUE 8): the HBM ledger, the int8 weight path, and
+model churn through a real Worker.
+
+Three tiers:
+
+1. **Ledger units** — fake loaders, explicit budgets, a fake clock:
+   reservation/hit semantics, the donation no-double-buffer peak
+   assertion, priority vs LRU eviction order, the degradation rungs
+   (load-per-job, model_unavailable bounce), prefetch from the arrival
+   EWMA, and the budget squeeze.
+2. **Quantized-vs-fp parity gates** — per diffusion family kind (tiny
+   ~ sd15-shaped, tiny_xl ~ SDXL-shaped): the per-channel round-trip
+   error bound, and end-to-end generated images within tolerance of the
+   fp path through the real registry.
+3. **E2E churn** — a real Worker serving a mixed-model job stream under
+   a budget that cannot hold the catalog: zero job loss, evictions
+   observed, and peak ledger bytes never exceeding budget + one model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import sys
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.node.resilience import classify_exception
+from chiaswarm_tpu.obs.metrics import Registry
+from chiaswarm_tpu.serving.residency import (
+    ArrivalEwma,
+    ModelUnavailable,
+    ResidencyManager,
+    is_transient,
+)
+
+
+@pytest.fixture(autouse=True)
+def _tmp_root(tmp_path, monkeypatch):
+    """Isolate the settings root (residency.json, spools) per test."""
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    return tmp_path
+
+
+def manager(budget: int, hard: int | None = None, **over) -> ResidencyManager:
+    over.setdefault("metrics_registry", Registry())
+    over.setdefault("persist_path", None)
+    over.setdefault("reserve_wait_s", 0.2)
+    return ResidencyManager(budget_bytes=budget,
+                            hard_limit_bytes=hard or budget * 2, **over)
+
+
+class FakeModel:
+    def __init__(self, nbytes: int) -> None:
+        self.nbytes = nbytes
+
+
+def loader_of(log: list, name: str, nbytes: int):
+    def load():
+        log.append(name)
+        return FakeModel(nbytes)
+
+    return load
+
+
+def size_of(value: FakeModel) -> int:
+    return value.nbytes
+
+
+# ---------------------------------------------------------------------------
+# 1. ledger units
+# ---------------------------------------------------------------------------
+
+
+def test_reservation_hit_and_measured_accounting():
+    loads: list[str] = []
+    m = manager(1000)
+    a = m.acquire("ka", loader_of(loads, "a", 400), model="a",
+                  size_of=size_of)
+    assert m.acquire("ka", loader_of(loads, "a", 400), model="a",
+                     size_of=size_of) is a
+    assert loads == ["a"]          # the second acquire is a pure hit
+    assert m.hits == 1 and m.misses == 1
+    assert m.resident_bytes == 400  # measured, not estimated
+    assert m.model_states()["a"] == "resident"
+    assert m.measured_footprints()["a"] == 400
+
+
+def test_donation_swap_never_double_buffers():
+    """THE no-double-buffer invariant: with the footprint known, a swap
+    evicts the victim BEFORE loading the replacement, so peak bytes stay
+    within the budget; an unknown first load is allowed budget + one
+    model and never more."""
+    loads: list[str] = []
+    m = manager(1000)
+    m.acquire("ka", loader_of(loads, "a", 600), model="a", size_of=size_of)
+    # first-ever load of b: footprint unknown, so the ledger may briefly
+    # hold a while b loads — bounded by budget + b itself
+    m.acquire("kb", loader_of(loads, "b", 700), model="b", size_of=size_of)
+    assert m.resident_bytes <= 1000
+    assert m.peak_bytes <= 1000 + 700
+    # now both footprints are measured: the swap back to a must reserve
+    # and evict FIRST — peak never exceeds the budget during this swap
+    m.reset_peak()
+    m.acquire("ka", loader_of(loads, "a", 600), model="a", size_of=size_of)
+    assert m.resident_models() == ["a"]
+    assert m.peak_bytes <= 1000, (
+        f"double-buffered swap: peak {m.peak_bytes} > budget 1000")
+    assert m.evictions >= 2
+    assert m.model_states()["b"] == "evicted"
+
+
+def test_priority_evicts_low_before_lru():
+    """Eviction order is (priority, LRU): a high-priority family stays
+    resident even when it is the least recently used entry."""
+    loads: list[str] = []
+    clock = [0.0]
+    m = manager(1000, clock=lambda: clock[0])
+    m.acquire("ka", loader_of(loads, "hot", 400), model="hot",
+              size_of=size_of, priority=5)
+    clock[0] = 1.0
+    m.acquire("kb", loader_of(loads, "cold", 400), model="cold",
+              size_of=size_of, priority=0)
+    clock[0] = 2.0
+    # c needs room: "hot" is older (LRU would evict it) but outranks
+    # "cold" — cold must go first
+    m.acquire("kc", loader_of(loads, "c", 400), model="c", size_of=size_of,
+              priority=5)
+    states = m.model_states()
+    assert states["hot"] == "resident"
+    assert states["cold"] == "evicted"
+    # equal priorities fall back to LRU: "hot" (older) goes before "c"
+    clock[0] = 3.0
+    m.acquire("kd", loader_of(loads, "d", 400), model="d", size_of=size_of,
+              priority=5)
+    assert m.model_states()["hot"] == "evicted"
+    assert m.model_states()["c"] == "resident"
+
+
+def test_degraded_model_loads_per_job_and_releases():
+    """The graceful-degradation rung: a model bigger than the budget
+    (but within the hard limit) still serves — load -> run -> release,
+    nothing admitted resident, the transient reservation freed when the
+    job's references die."""
+    loads: list[str] = []
+    m = manager(500, hard=2000)
+    value = m.acquire("kx", loader_of(loads, "x", 800), model="x",
+                      size_of=size_of, estimate=lambda: 800)
+    assert is_transient(value)
+    assert m.resident_models() == []
+    assert m.degraded_loads == 1
+    assert m.model_states()["x"] == "degraded"
+    assert m.reserved_bytes == 800
+    del value
+    gc.collect()
+    assert m.reserved_bytes == 0
+    # every job pays its own load — nothing was cached
+    m.acquire("kx", loader_of(loads, "x", 800), model="x",
+              size_of=size_of)
+    assert loads == ["x", "x"]
+    assert m.would_degrade("x")       # the executor's lane pre-check
+
+
+def test_bounce_is_model_unavailable():
+    """A model that cannot fit even transiently bounces with the
+    redispatch taxonomy: classify_exception -> model_unavailable (the
+    mini-hive REDISPATCH_KINDS contract, PR 6)."""
+    m = manager(500, hard=1000)
+    with pytest.raises(ModelUnavailable) as err:
+        m.acquire("kz", loader_of([], "z", 4000), model="z",
+                  estimate=lambda: 4000)
+    assert classify_exception(err.value) == "model_unavailable"
+    assert m.bounces == 1
+    assert m.model_states()["z"] == "unavailable"
+
+
+def test_budget_squeeze_evicts_immediately():
+    loads: list[str] = []
+    m = manager(1000)
+    m.acquire("ka", loader_of(loads, "a", 400), model="a", size_of=size_of)
+    m.acquire("kb", loader_of(loads, "b", 400), model="b", size_of=size_of)
+    m.set_budget(450)
+    assert m.resident_bytes <= 450
+    assert len(m.resident_models()) == 1
+    reg = m._m_evictions
+    assert reg.value(reason="squeeze") >= 1
+
+
+def test_prefetch_reloads_hottest_evicted_model():
+    """Idle polls warm-load by demand: the evicted model with the higher
+    arrival EWMA comes back first, into FREE budget only."""
+    loads: list[str] = []
+    m = manager(1000)
+    m.acquire("ka", loader_of(loads, "a", 400), model="a", size_of=size_of)
+    for _ in range(5):  # b is the hot one
+        m.acquire("kb", loader_of(loads, "b", 400), model="b",
+                  size_of=size_of)
+    m.set_budget(100)
+    m.set_budget(1000)
+    assert m.resident_models() == []
+    assert m.note_idle()
+    deadline = 100
+    while "b" not in m.resident_models() and deadline:
+        deadline -= 1
+        import time
+
+        time.sleep(0.02)
+    assert m.resident_models() == ["b"]
+    assert m.prefetch_loads == 1
+    # no free room -> no prefetch (it must never evict the working set)
+    m.set_budget(400)
+    assert not m.note_idle()
+
+
+def test_prefetch_disabled_and_quarantine_skipped():
+    loads: list[str] = []
+    m = manager(1000, prefetch=False)
+    m.acquire("ka", loader_of(loads, "a", 400), model="a", size_of=size_of)
+    m.set_budget(100)
+    m.set_budget(1000)
+    assert not m.note_idle()
+    m.prefetch_enabled = True
+    m.note_quarantined("a")
+    assert not m.note_idle()  # quarantined models never prefetch
+    assert m.model_states()["a"] == "quarantined"
+    m.note_unquarantined("a")
+    assert m.model_states()["a"] == "evicted"
+
+
+def test_failed_load_releases_reservation_and_marks_unavailable():
+    m = manager(1000)
+
+    def boom():
+        raise RuntimeError("conversion exploded")
+
+    with pytest.raises(RuntimeError):
+        m.acquire("ka", boom, model="a", estimate=lambda: 400)
+    assert m.reserved_bytes == 0
+    assert m.model_states()["a"] == "unavailable"
+    # the model is not poisoned: a later working load admits normally
+    m.acquire("ka", loader_of([], "a", 400), model="a", size_of=size_of)
+    assert m.model_states()["a"] == "resident"
+
+
+def test_footprints_persist_across_managers(tmp_path):
+    """Measured footprints survive restarts: the next manager (and the
+    worker's mesh policy) plans with real numbers from load one."""
+    path = tmp_path / "residency.json"
+    m1 = manager(1000, persist_path=path)
+    m1.acquire("ka", loader_of([], "a", 321), model="a", size_of=size_of)
+    m2 = manager(1000, persist_path=path)
+    assert m2.measured_footprints() == {"a": 321}
+    # corrupt file: loud fallback to estimates, not a crash
+    path.write_text("{not json", encoding="utf-8")
+    m3 = manager(1000, persist_path=path)
+    assert m3.measured_footprints() == {}
+
+
+def test_arrival_ewma_decays_idle():
+    ewma = ArrivalEwma(window_s=2.0)
+    now = 0.0
+    for _ in range(10):
+        now += 0.1
+        ewma.note(1, now)
+    busy = ewma.rate(now)
+    assert busy > 1.0
+    assert ewma.rate(now + 10.0) < busy / 8
+
+
+# ---------------------------------------------------------------------------
+# 2. int8 quantization: units + per-family-kind forward parity gates
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_round_trip_error_bound():
+    import jax
+
+    from chiaswarm_tpu.convert.quantize import (
+        Int8Param,
+        dequantize_tree,
+        quantize_tree,
+    )
+
+    rng = np.random.default_rng(0)
+    tree = {
+        "dense": np.asarray(rng.standard_normal((128, 96)), np.float32),
+        "conv": np.asarray(rng.standard_normal((3, 3, 32, 64)), np.float32),
+        "bias": np.zeros((96,), np.float32),     # 1-D: stays fp
+        "small": np.ones((8, 8), np.float32),    # < MIN_QUANT_SIZE: fp
+    }
+    q = quantize_tree(jax.tree.map(np.asarray, tree))
+    assert isinstance(q["dense"], Int8Param)
+    assert isinstance(q["conv"], Int8Param)
+    assert not isinstance(q["bias"], Int8Param)
+    assert not isinstance(q["small"], Int8Param)
+    d = dequantize_tree(q)
+    for key in ("dense", "conv"):
+        w = tree[key]
+        r = np.asarray(d[key])
+        assert r.dtype == w.dtype
+        scale = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)),
+                       keepdims=True) / 127.0
+        # round-to-nearest bound: half a code per channel
+        assert np.all(np.abs(w - r) <= scale / 2 + 1e-8), key
+    # the capacity claim: int8 + scales well under half the fp bytes
+    q_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(q))
+    fp_bytes = sum(w.nbytes for w in tree.values())
+    assert q_bytes < fp_bytes * 0.5
+
+
+@pytest.mark.parametrize("family", ["tiny", "tiny_xl"])
+def test_int8_forward_parity_per_family_kind(family, monkeypatch):
+    """The gate on the int8 path (ISSUE 8): generated images through the
+    REAL registry with CHIASWARM_WEIGHTS=int8 must match the fp path
+    within tolerance, per diffusion family kind (sd15-shaped and
+    SDXL-shaped tiny twins)."""
+    monkeypatch.setenv("CHIASWARM_STEPPER", "0")
+    from chiaswarm_tpu.convert.quantize import quantized_leaf_count
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
+
+    def registry():
+        return ModelRegistry(
+            catalog=[{"name": family, "family": family}],
+            allow_random=True,
+            residency=manager(1 << 30, hard=2 << 30))
+
+    req = GenerateRequest(prompt="parity", steps=2, guidance_scale=7.5,
+                          height=64, width=64, batch=1, seed=11)
+    monkeypatch.delenv("CHIASWARM_WEIGHTS", raising=False)
+    pipe_fp = registry().pipeline(family)
+    img_fp, _ = pipe_fp(req)
+
+    monkeypatch.setenv("CHIASWARM_WEIGHTS", "int8")
+    pipe_q = registry().pipeline(family)
+    assert quantized_leaf_count(pipe_q.c.params) > 0
+    # the capacity multiplier, measured on the live tree
+    assert pipe_q.c.param_bytes() < pipe_fp.c.param_bytes() * 0.8
+    img_q, _ = pipe_q(req)
+
+    assert img_q.shape == img_fp.shape
+    diff = np.abs(img_fp.astype(np.float32) - img_q.astype(np.float32))
+    rel = (np.linalg.norm(diff)
+           / max(np.linalg.norm(img_fp.astype(np.float32)), 1e-9))
+    assert diff.mean() < 4.0, f"mean abs uint8 diff {diff.mean():.2f}"
+    assert rel < 0.05, f"relative error {rel:.4f}"
+
+
+def test_int8_skipped_for_sharded_placement(monkeypatch):
+    """Sharded placements stay fp: the sharding rules match fp param
+    paths, so maybe_quantize_params declines multi-chip meshes."""
+    import jax
+
+    from chiaswarm_tpu.convert.quantize import (
+        maybe_quantize_params,
+        quantized_leaf_count,
+    )
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.models.configs import FAMILIES
+
+    monkeypatch.setenv("CHIASWARM_WEIGHTS", "int8")
+    params = {"w": np.asarray(
+        np.random.default_rng(0).standard_normal((128, 64)), np.float32)}
+    family = FAMILIES["tiny"]
+    mesh = build_mesh(MeshSpec({"data": 2}), devices=jax.devices()[:2])
+    assert quantized_leaf_count(
+        maybe_quantize_params(params, family=family, mesh=mesh)) == 0
+    single = build_mesh(MeshSpec({"data": 1}), devices=jax.devices()[:1])
+    assert quantized_leaf_count(
+        maybe_quantize_params(params, family=family, mesh=single)) == 1
+
+
+# ---------------------------------------------------------------------------
+# 3. e2e: tiny-model churn through a real Worker
+# ---------------------------------------------------------------------------
+
+
+def _churn_registry(budget_bytes: int | None, models: list[str],
+                    **manager_over):
+    from chiaswarm_tpu.node.registry import ModelRegistry
+
+    return ModelRegistry(
+        catalog=[{"name": name, "family": "tiny"} for name in models],
+        allow_random=True,
+        residency=manager(budget_bytes or (1 << 30), **manager_over))
+
+
+def _tiny_footprint() -> int:
+    """Measured bytes of one resident tiny pipeline (the unit the churn
+    budgets are denominated in)."""
+    registry = _churn_registry(None, ["tiny/probe"])
+    registry.pipeline("tiny/probe")
+    return registry.residency.measured_footprints()["tiny/probe"]
+
+
+def _job(job_id: str, model: str) -> dict:
+    return {"id": job_id, "model_name": model, "prompt": f"p {job_id}",
+            "seed": 900, "num_inference_steps": 2, "height": 64,
+            "width": 64, "content_type": "image/png"}
+
+
+def test_e2e_model_churn_zero_loss(monkeypatch):
+    """THE churn proof (acceptance): with the budget tightened so the
+    catalog cannot fit resident, a mixed-model job stream completes with
+    zero job loss, evictions observed, and peak ledger bytes never
+    exceeding budget + one model."""
+    monkeypatch.setenv("CHIASWARM_STEPPER", "0")
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+    from test_chaos import chaos_settings
+
+    from chiaswarm_tpu.node.worker import Worker
+
+    footprint = _tiny_footprint()
+    budget = int(footprint * 1.5)  # one model resident at a time
+    models = ["tiny/a", "tiny/b"]
+    registry = _churn_registry(budget, models, hard=footprint * 4)
+    mgr = registry.residency
+    mgr.reset_peak()
+
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+
+    async def scenario():
+        hive = FakeHive()
+        await hive.start()
+        worker = Worker(
+            settings=chaos_settings(hive.uri, job_deadline_s=600.0,
+                                    workflow_deadline_s={}),
+            registry=registry, pool=pool)
+        task = asyncio.create_task(worker.run())
+        try:
+            # alternating models, offered ONE AT A TIME so every other
+            # job deterministically forces a swap (a depth-2 slot would
+            # otherwise load both models concurrently and the eviction
+            # count would depend on admit order)
+            for i, model in enumerate([models[0], models[1], models[0]]):
+                hive.jobs.append(_job(f"churn-{i}", model))
+                await hive.wait_for_results(i + 1, timeout=600)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=60)
+            await hive.stop()
+        return hive.results, worker
+
+    results, worker = asyncio.run(scenario())
+    by_id = {r["id"]: r for r in results}
+    # zero loss, exactly once, all successes
+    assert sorted(by_id) == ["churn-0", "churn-1", "churn-2"]
+    for result in results:
+        assert result["pipeline_config"].get("error") is None, result
+
+    snap = mgr.snapshot()
+    assert snap["evictions"] >= 2, snap        # the stream churned
+    largest = max(mgr.measured_footprints().values())
+    # THE no-double-buffer invariant at system scale
+    assert mgr.peak_bytes <= budget + largest, (
+        f"peak {mgr.peak_bytes} > budget {budget} + one model {largest}")
+    assert snap["resident_bytes"] <= budget
+    # the health endpoint surfaces the ledger + the state enum
+    health = worker.health()
+    assert health["residency"]["evictions"] >= 2
+    states = health["models"]
+    assert set(models) <= set(states)
+    assert all(state in ("resident", "evicted", "loading", "cold")
+               for state in states.values()), states
+
+
+def test_e2e_degraded_model_serves_load_per_job(monkeypatch):
+    """Squeeze the budget BELOW one model: jobs still complete through
+    the load-per-job rung, stamped ``residency: per_job`` in the result
+    config; lanes are skipped for the degraded model."""
+    monkeypatch.setenv("CHIASWARM_STEPPER", "0")
+    sys.path.insert(0, "tests")
+    from fake_hive import FakeHive
+    from test_chaos import chaos_settings
+
+    from chiaswarm_tpu.node.worker import Worker
+
+    footprint = _tiny_footprint()
+    registry = _churn_registry(int(footprint * 0.5), ["tiny/d"],
+                               hard=footprint * 4)
+    # pre-teach the ledger the footprint so the FIRST job already takes
+    # the degraded path (production learns it on load one)
+    registry.residency._footprints["tiny/d"] = footprint
+
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+
+    async def scenario():
+        hive = FakeHive()
+        await hive.start()
+        hive.jobs.append(_job("deg-0", "tiny/d"))
+        worker = Worker(
+            settings=chaos_settings(hive.uri, job_deadline_s=600.0,
+                                    workflow_deadline_s={}),
+            registry=registry, pool=pool)
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(1, timeout=600)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=60)
+            await hive.stop()
+        return hive.results
+
+    [result] = asyncio.run(scenario())
+    assert result["pipeline_config"].get("error") is None, result
+    assert result["pipeline_config"].get("residency") == "per_job"
+    assert registry.residency.degraded_loads >= 1
+    assert registry.residency.resident_models() == []
+    assert registry.model_states()["tiny/d"] == "degraded"
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions (pre-commit code review findings)
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_transient_does_not_starve_resident_loads():
+    """A degraded load-per-job reservation in flight (held for the whole
+    job) must not make concurrent resident loads evict the working set
+    or bounce: transient bytes count against the HARD limit only."""
+    loads: list[str] = []
+    m = manager(1000, hard=5000)
+    m.acquire("ka", loader_of(loads, "a", 400), model="a", size_of=size_of)
+    big = m.acquire("kx", loader_of(loads, "x", 1500), model="x",
+                    size_of=size_of, estimate=lambda: 1500)
+    assert is_transient(big)
+    assert m.reserved_bytes == 1500
+    # resident load while the transient is outstanding: fits the budget,
+    # must neither bounce nor evict a
+    m.acquire("kb", loader_of(loads, "b", 500), model="b", size_of=size_of)
+    assert m.model_states()["a"] == "resident"
+    assert m.model_states()["b"] == "resident"
+    assert m.bounces == 0
+    assert m.evictions == 0
+    del big
+    gc.collect()
+    assert m.reserved_bytes == 0
+
+
+def test_prefetch_load_never_evicts_even_when_racing():
+    """The no-churn invariant holds at RESERVATION time, not just at
+    candidate selection: a prefetch whose free budget vanished in the
+    race window skips instead of evicting (and counts nothing)."""
+    from chiaswarm_tpu.serving.residency import _PrefetchSkip
+
+    loads: list[str] = []
+    m = manager(1000)
+    m.acquire("ka", loader_of(loads, "a", 700), model="a", size_of=size_of)
+    m._footprints["b"] = 600
+    # simulate the race: the budget is already full when the prefetch
+    # load itself runs (note_idle's selection happened "earlier")
+    with pytest.raises(_PrefetchSkip):
+        m._load("kb", loader_of(loads, "b", 600), model="b",
+                size_of=size_of, estimate=None, priority=0,
+                mode="prefetch")
+    assert m.model_states()["a"] == "resident"
+    assert m.evictions == 0
+    assert m.prefetch_loads == 0
+
+
+def test_eviction_purges_orphaned_executables():
+    """Evicting a model drops its compiled executables from the bounded
+    global LRU — keyed by the dead components' id, they can never hit
+    again and would thrash live models' programs out of the cache."""
+    from chiaswarm_tpu.core.compile_cache import GLOBAL_CACHE
+
+    class WithComponents(FakeModel):
+        def __init__(self, nbytes):
+            super().__init__(nbytes)
+            self.c = object()
+
+    m = manager(1000)
+    value = WithComponents(700)
+    m.acquire("ka", lambda: value, model="a", size_of=size_of)
+    owner = id(value.c)
+    GLOBAL_CACHE.cached_executable((owner, "fake_prog", ()), lambda: "x")
+    assert GLOBAL_CACHE.executables._entries.get((owner, "fake_prog", ()))
+    m.acquire("kb", loader_of([], "b", 700), model="b", size_of=size_of)
+    assert m.model_states()["a"] == "evicted"
+    assert (owner, "fake_prog", ()) not in GLOBAL_CACHE.executables._entries
+
+
+def test_footprints_namespaced_by_weights_format(tmp_path, monkeypatch):
+    """An int8 measurement must not size a bf16 restart's reservations
+    (and vice versa): the persisted footprint file keeps one section
+    per CHIASWARM_WEIGHTS format."""
+    path = tmp_path / "residency.json"
+    monkeypatch.delenv("CHIASWARM_WEIGHTS", raising=False)
+    m_bf16 = manager(1000, persist_path=path)
+    m_bf16.acquire("ka", loader_of([], "a", 800), model="a",
+                   size_of=size_of)
+    monkeypatch.setenv("CHIASWARM_WEIGHTS", "int8")
+    m_int8 = manager(1000, persist_path=path)
+    assert m_int8.measured_footprints() == {}  # bf16 bytes not reused
+    m_int8.acquire("ka", loader_of([], "a", 300), model="a",
+                   size_of=size_of)
+    # both sections persist side by side
+    monkeypatch.delenv("CHIASWARM_WEIGHTS", raising=False)
+    assert manager(1000, persist_path=path).measured_footprints() == {
+        "a": 800}
+    monkeypatch.setenv("CHIASWARM_WEIGHTS", "int8")
+    assert manager(1000, persist_path=path).measured_footprints() == {
+        "a": 300}
+
+
+def test_persist_path_none_disables_persistence(tmp_path):
+    """Benches and hermetic tests pass ``persist_path=None`` meaning
+    OFF — the manager must not fall back to the operator's real
+    ``<settings root>/residency.json`` (the default-path sentinel is
+    reserved for omission)."""
+    from chiaswarm_tpu.node.settings import settings_root
+
+    m = manager(1000)  # helper passes persist_path=None
+    m.acquire("ka", loader_of([], "a", 400), model="a", size_of=size_of)
+    assert not (settings_root() / "residency.json").exists()
+    # omission (the sentinel) picks the settings-root default
+    m2 = ResidencyManager(budget_bytes=1000, hard_limit_bytes=2000,
+                          metrics_registry=Registry())
+    assert m2._persist_path == settings_root() / "residency.json"
+
+
+def test_concurrent_resident_loads_wait_instead_of_bouncing():
+    """Two models whose footprints each fit the budget (but not both)
+    demanded concurrently must BOTH load — the second reservation waits
+    for the first to settle into an evictable entry, then swaps; no
+    spurious model_unavailable bounce, no fatal error."""
+    import threading
+    import time as _time
+
+    m = manager(1000, hard=2000, reserve_wait_s=5.0)
+    m._footprints.update({"a": 600, "b": 600})  # both known, both fit
+    gate = threading.Event()
+
+    def slow_loader(name):
+        def load():
+            gate.wait(timeout=10)  # hold the reservation open
+            return FakeModel(600)
+
+        return load
+
+    results: dict[str, object] = {}
+
+    def job(name):
+        results[name] = m.acquire(
+            f"k{name}", slow_loader(name), model=name, size_of=size_of)
+
+    threads = [threading.Thread(target=job, args=(name,))
+               for name in ("a", "b")]
+    for thread in threads:
+        thread.start()
+    _time.sleep(0.1)
+    gate.set()
+    for thread in threads:
+        thread.join(timeout=30)
+    assert set(results) == {"a", "b"}
+    assert m.bounces == 0
+    assert not any(is_transient(v) for v in results.values())
+    # one of them was swapped out to admit the other
+    assert m.evictions >= 1
+    assert m.resident_bytes <= 1000
+
+
+def test_demand_admit_survives_concurrent_reservation_pressure():
+    """A first-ever demand load whose measured footprint cannot be
+    evicted for (concurrent reservations hold the budget) must still
+    ADMIT — the memory is already allocated; refusing would fail a
+    healthy job with an internal error."""
+    import threading
+    import time as _time
+
+    m = manager(1000, hard=2000, reserve_wait_s=0.3)
+    m._footprints["big"] = 900
+    gate = threading.Event()
+
+    def slow_big():
+        gate.wait(timeout=10)
+        return FakeModel(900)
+
+    holder = threading.Thread(target=lambda: m.acquire(
+        "kbig", slow_big, model="big", size_of=size_of))
+    holder.start()
+    _time.sleep(0.1)  # big's 900-byte resident reservation is in flight
+    # first-ever load of small (no estimate -> reserves 0): its admit
+    # pass finds nothing evictable, must not raise
+    value = m.acquire("ksmall", lambda: FakeModel(500), model="small",
+                      size_of=size_of)
+    assert isinstance(value, FakeModel)
+    assert m.model_states()["small"] == "resident"
+    gate.set()
+    holder.join(timeout=30)
+    assert m.bounces == 0

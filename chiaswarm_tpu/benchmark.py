@@ -472,6 +472,51 @@ def _bench_model_churn(*, on_tpu: bool, attn: str) -> dict:
     }
 
 
+def _bench_load_harness(*, on_tpu: bool, attn: str) -> dict:
+    """ISSUE 9: the swarmload capacity model + tuning sweeps, stamped
+    into BENCH json. One compact seeded diurnal 10x-overload run with a
+    mid-run worker kill through the mini-hive (synthetic overload-
+    controlled workers — this config measures the CONTROL plane:
+    shed/backpressure/brownout behavior and jobs/s/chip at fleet scale,
+    not pipeline FLOPs, so it runs identically on CPU and TPU hosts),
+    plus the pure-host controller sweeps whose winners are the shipped
+    LaneWidthController gains and residency prefetch-ranking window
+    (tests/test_loadgen.py pins defaults == winner)."""
+    import asyncio
+
+    from chiaswarm_tpu.node import loadgen
+
+    seed = "swarmload"  # FIXED: BENCH r-trajectories must diff runs,
+    # not seeds (the nightly chaos soak explores fresh seeds instead)
+    schedule = loadgen.build_scenario(seed=seed, n_users=1000,
+                                      duration_s=2.5, rate_jobs_s=120)
+    report = asyncio.run(loadgen.run_load(
+        schedule, n_workers=3, seed=seed, lease_s=3.0,
+        max_jobs_per_poll=4, kill=loadgen.KillPlan(after_frac=0.5),
+        settle_timeout_s=180))
+    workers = report["workers"]
+    return {
+        "seed": seed,
+        "capacity_model": report["capacity"],
+        "offered": report["offered"],
+        "outcomes": report["outcomes"],
+        "zero_loss": report["reconciliation"]["zero_loss"],
+        "admitted_p99_within_deadline":
+            report["admitted_deadline"]["p99_within_deadline"],
+        "latency_s": report["latency_s"]["end_to_end"],
+        "jobs_shed": sum(w["jobs_shed"] for w in workers.values()),
+        "polls_backpressured": sum(w["polls_backpressured"]
+                                   for w in workers.values()),
+        "kill": report["kill"],
+        # the satellite's tuning story: sweep tables + the winners the
+        # shipped defaults were landed from
+        "sweeps": {
+            "lane_gains": loadgen.sweep_lane_gains(seed),
+            "prefetch_window": loadgen.sweep_prefetch_window(seed),
+        },
+    }
+
+
 def run_configs(names: list[str], *, on_tpu: bool, iters: int,
                 attn: str) -> dict:
     import jax
@@ -634,6 +679,13 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         results["model_churn"] = _bench_model_churn(on_tpu=on_tpu,
                                                     attn=attn)
 
+    if "load_harness" in names:
+        # ISSUE 9: the swarmload capacity model (jobs/s/chip per
+        # workload mix), overload-control outcomes under scripted 10x
+        # + worker kill, and the gain/prefetch sweep tables
+        results["load_harness"] = _bench_load_harness(on_tpu=on_tpu,
+                                                      attn=attn)
+
     return results
 
 
@@ -688,7 +740,8 @@ def main() -> None:
     configs = {"sdxl_txt2img_1024": headline}
     if which != "headline":
         names = (["sd15", "sd21", "controlnet", "img2vid", "stepper",
-                  "stepper_mixed_workloads", "txt2vid", "model_churn"]
+                  "stepper_mixed_workloads", "txt2vid", "model_churn",
+                  "load_harness"]
                  if which == "all" else which.split(","))
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))

@@ -98,6 +98,12 @@ class MiniHive(ChaoticHive):
         self.completed: dict[str, dict[str, Any]] = {}
         self.duplicate_results: list[dict[str, Any]] = []
         self.abandoned: list[str] = []
+        # first-submission stamp per job id: every delivery carries the
+        # job's total queue age as ``queued_s`` so a worker's overload
+        # controller (ISSUE 9, node/overload.py) can count hive-side
+        # waiting against the deadline — under overload the backlog
+        # lives HERE, not in the worker's bounded local queue
+        self.submitted_at: dict[str, float] = {}
         self.known_workers: set[str] = set()
         self.worker_seen: dict[str, float] = {}  # last poll/heartbeat
         self.partitioned: set[str] = set()
@@ -136,6 +142,15 @@ class MiniHive(ChaoticHive):
         self._abandoned = m.counter(
             "chiaswarm_hive_jobs_abandoned_total",
             "jobs parked after exhausting max_attempts deliveries")
+        self._salvaged = m.counter(
+            "chiaswarm_hive_jobs_salvaged_total",
+            "abandoned jobs settled late by a straggler upload "
+            "(chip time recovered; the job leaves the abandoned list)")
+
+    def submit(self, job: dict[str, Any]) -> None:
+        job_id = str(job.get("id"))
+        self.submitted_at.setdefault(job_id, self._clock())
+        super().submit(job)
 
     # ---- chaos controls -------------------------------------------------
 
@@ -260,6 +275,13 @@ class MiniHive(ChaoticHive):
             # queued original stays pristine for the next redelivery
             payload = dict(job)
             payload["attempt"] = attempt
+            submitted = self.submitted_at.get(job_id)
+            if submitted is not None:
+                # total time since FIRST submission (across attempts):
+                # the worker's admission estimator charges this against
+                # the job's deadline budget
+                payload["queued_s"] = round(
+                    max(0.0, self._clock() - submitted), 4)
             checkpoint = self.checkpoints.get(job_id)
             if checkpoint is not None:
                 payload["resume"] = checkpoint
@@ -316,6 +338,16 @@ class MiniHive(ChaoticHive):
         # Withdraw any queued redelivery copy too: without this, a late
         # upload landing after its lease expired would leave the requeued
         # copy to burn a full re-execution on another worker.
+        if job_id in self.abandoned:
+            # a straggler upload for a job policy already gave up on:
+            # the work EXISTS, so the job settles and leaves the
+            # abandoned list — one job must never read as both
+            # abandoned AND completed (the reconciliation invariant
+            # tests/test_minihive.py holds at harness scale)
+            self.abandoned.remove(job_id)
+            self._salvaged.inc()
+            log.warning("job %s salvaged by a straggler upload after "
+                        "abandonment", job_id)
         self.completed[job_id] = result
         self.results.append(result)
         self.result_event.set()

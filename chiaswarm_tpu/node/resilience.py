@@ -64,7 +64,12 @@ BREAKER_KINDS = frozenset({"model_unavailable", "timeout", "error", "oom"})
 #: envelopes upload WITHOUT the fatal flag (node/executor.py), resolving
 #: the reference-parity taxonomy tension where a node-local
 #: model-unavailable used to read as fatal and strand the job.
-REDISPATCH_KINDS = frozenset({"model_unavailable", "quarantined"})
+#: ``overloaded`` (ISSUE 9, node/overload.py) is the admission-control
+#: shed: THIS node predicts the job would miss its deadline behind the
+#: local backlog — a less-loaded node may still make it. Deliberately
+#: NOT breaker fodder: shedding says nothing about the model.
+REDISPATCH_KINDS = frozenset({"model_unavailable", "quarantined",
+                              "overloaded"})
 
 #: kinds whose error envelopes upload WITHOUT the fatal flag — locally
 #: retryable kinds plus hive-side redispatch kinds. The executor derives
@@ -724,6 +729,14 @@ _STAT_HELP = {
     "results_replayed": "dead-letter results replayed at startup",
     "lease_heartbeats": "heartbeats delivered to a lease-aware hive",
     "leases_lost": "in-flight jobs whose lease the hive reassigned",
+    # overload control (ISSUE 9, node/overload.py): sheds and
+    # backpressure waits are capacity decisions, counted DISTINCTLY
+    # from failures — a shed job is redispatchable work this node
+    # declined, not work it broke
+    "jobs_shed": "jobs shed by deadline-aware admission control "
+                 "(overloaded, redispatched by a lease-aware hive)",
+    "polls_backpressured": "poll-loop waits inserted by queue-depth "
+                           "backpressure before over-committing",
 }
 
 
@@ -759,6 +772,8 @@ class ResilienceStats:
     results_replayed = _stat_property("results_replayed")
     lease_heartbeats = _stat_property("lease_heartbeats")
     leases_lost = _stat_property("leases_lost")
+    jobs_shed = _stat_property("jobs_shed")
+    polls_backpressured = _stat_property("polls_backpressured")
 
     def __init__(self, registry: Any = None) -> None:
         from chiaswarm_tpu.obs.metrics import Registry

@@ -115,6 +115,27 @@ class Settings:
     # model back into free budget (CHIASWARM_RESIDENCY_PREFETCH=0 and
     # this flag both disable it)
     residency_prefetch: bool = True
+    # ---- overload control (node/overload.py, ISSUE 9) ----
+    # deadline-aware admission shedding + queue-depth backpressure +
+    # the brownout rung. OFF by default for reference-hive parity:
+    # sheds upload as non-fatal "overloaded" envelopes only a
+    # lease-aware hive redispatches (node/minihive.py) — the reference
+    # hive would settle them as plain errors. The swarmload harness
+    # (node/loadgen.py) and lease-aware fleets turn it on.
+    overload_control: bool = False
+    # shed when predicted completion > margin x remaining deadline
+    # budget (job "deadline_s" field, else deadline_for(workflow))
+    overload_margin: float = 1.0
+    # poll-loop backpressure: stop asking for work once the queued
+    # backlog's drain estimate exceeds this many seconds (0 = derive
+    # half the default job deadline)
+    backpressure_s: float = 0.0
+    # brownout rung: this many sheds inside overload_window_s tighten
+    # the margin and cap lane admissions per step boundary
+    overload_brownout_sheds: int = 6
+    overload_window_s: float = 10.0
+    overload_cooldown_s: float = 5.0
+    overload_admission_cap: int = 2
 
     def deadline_for(self, workflow: str | None) -> float:
         """Execution budget (seconds) for one job of ``workflow`` (None /

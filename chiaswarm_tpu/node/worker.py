@@ -61,6 +61,7 @@ from chiaswarm_tpu.node.executor import (
 )
 from chiaswarm_tpu.node.hive import BadWorkerError, HiveClient
 from chiaswarm_tpu.node.logging_setup import setup_logging
+from chiaswarm_tpu.node.overload import OverloadController
 from chiaswarm_tpu.node.registry import ModelRegistry
 from chiaswarm_tpu.node.resilience import (
     BREAKER_KINDS,
@@ -200,6 +201,19 @@ class Worker:
         self.metrics.add_collector(self._collect_metrics)
         # ---- fault-tolerance state (node/resilience.py) ----
         self.stats = ResilienceStats(self.metrics)
+        # ---- overload control (node/overload.py, ISSUE 9) ----
+        # always constructed (its chiaswarm_overload_* families must
+        # render zeroes from scrape one), only CONSULTED when the
+        # settings gate is on — reference-hive parity keeps it off
+        self.overload = OverloadController(
+            margin=self.settings.overload_margin,
+            backpressure_s=(float(self.settings.backpressure_s)
+                            or self.settings.job_deadline_s / 2.0),
+            brownout_sheds=self.settings.overload_brownout_sheds,
+            window_s=self.settings.overload_window_s,
+            cooldown_s=self.settings.overload_cooldown_s,
+            admission_cap_rows=self.settings.overload_admission_cap,
+            metrics_registry=self.metrics)
         # deterministic per-worker jitter: chaos runs reproduce exactly,
         # while distinct workers still decorrelate from each other
         self._poll_backoff = Backoff(
@@ -522,6 +536,11 @@ class Worker:
         }
         data.update(self.stats.snapshot())
         data["stepper"] = self._stepper_health()
+        # overload control (ISSUE 9): admission-estimator state next to
+        # the resilience stats — shed totals, brownout rung, EWMAs
+        data["overload"] = dict(
+            self.overload.snapshot(),
+            enabled=bool(self.settings.overload_control))
         # HBM residency (ISSUE 8): the measured ledger + the one
         # authoritative per-model state enum (quarantine merged in)
         residency = getattr(self.registry, "residency", None)
@@ -600,7 +619,7 @@ class Worker:
                     "rows_expired", "rows_failed", "lanes_created",
                     "lanes_failed", "row_steps_active", "row_steps_padded",
                     "rows_resumed", "resumes_rejected",
-                    "checkpoints_written")
+                    "checkpoints_written", "lanes_evict_retired")
         for key in counters:
             m.counter(f"chiaswarm_stepper_{key}_total",
                       f"step scheduler: cumulative {key}").set_to(
@@ -689,6 +708,23 @@ class Worker:
                         pass
                 if self._stop.is_set():
                     return
+                # predictive backpressure (ISSUE 9): the queue-full wait
+                # above only engages once the worker has ALREADY
+                # over-committed a full queue of jobs it may then shed;
+                # the overload controller throttles intake earlier, the
+                # moment the queued backlog's drain estimate outruns the
+                # backpressure budget
+                if self.settings.overload_control:
+                    throttle = self.overload.poll_throttle(
+                        self.work_queue.qsize(), len(self.pool))
+                    if throttle > 0:
+                        self.stats.polls_backpressured += 1
+                        try:
+                            await asyncio.wait_for(self._stop.wait(),
+                                                   timeout=throttle)
+                        except asyncio.TimeoutError:
+                            pass
+                        continue
                 delay = await self._ask_for_work(session)
                 try:
                     await asyncio.wait_for(self._stop.wait(), timeout=delay)
@@ -723,6 +759,11 @@ class Worker:
                 stepper = getattr(slot, "_stepper", None)
                 if stepper is not None:
                     stepper.note_poll(rows_hint)
+        # brownout rung (ISSUE 9): refresh every slot's per-boundary
+        # lane-admission cap on EVERY poll — entering brownout caps
+        # promptly under load, and a cleared brownout lifts the cap on
+        # the next (possibly idle) poll instead of lingering
+        self._push_admission_caps()
         for job in jobs:
             if job.get("id") in self._inflight:
                 # a lease-aware hive's starvation valve can redeliver a
@@ -772,6 +813,16 @@ class Worker:
                 except Exception as exc:  # prefetch must never stop polls
                     log.debug("residency prefetch tick failed: %s", exc)
         return float(self.settings.poll_idle_s)
+
+    def _push_admission_caps(self) -> None:
+        """Mirror the overload controller's brownout admission cap into
+        every slot's resident step scheduler (None clears it)."""
+        cap = (self.overload.admission_cap()
+               if self.settings.overload_control else None)
+        for slot in self.pool:
+            stepper = getattr(slot, "_stepper", None)
+            if stepper is not None:
+                stepper.set_admission_cap(cap)
 
     async def _heartbeat_loop(self) -> None:
         """Lease keep-alive (ISSUE 6): every ``heartbeat_s``, tell the
@@ -1036,6 +1087,16 @@ class Worker:
         """
         budget = max(self.settings.deadline_for(job.get("workflow"))
                      for job in jobs)
+        for job in jobs:
+            trace = obs_trace.job_trace(job)
+            if trace is not None:
+                # every member's "execute" phase spans this WHOLE
+                # attempt (the burst runs as one call), so the service
+                # EWMA must divide by the attempt size or a coalesced
+                # burst teaches it N x the true per-job cost — and the
+                # shed gate then sheds comfortably-servable jobs
+                # (caught by review). Solo retries overwrite this to 1.
+                trace.meta["attempt_jobs"] = len(jobs)
         executor = self._executor
         if len(jobs) == 1:
             dw = executor.do_work if executor is not None else do_work
@@ -1048,6 +1109,9 @@ class Worker:
             out = await asyncio.wait_for(call, timeout=budget)
         except asyncio.TimeoutError:
             self.stats.jobs_timed_out += len(jobs)
+            # the estimator must learn the slowness a timeout proves:
+            # the job burned at least the whole budget
+            self.overload.note_service(jobs[0].get("workflow"), budget)
             log.error("burst %s exceeded its %.0fs deadline",
                       [job.get("id") for job in jobs], budget)
             return [error_result(
@@ -1099,6 +1163,13 @@ class Worker:
                          f"(circuit breaker open)", kind="quarantined")
             else:
                 ready.append(i)
+        # deadline-aware admission (ISSUE 9): shed jobs the estimator
+        # predicts would miss their deadline behind the local backlog —
+        # BEFORE any chip time is spent. Sheds upload as non-fatal
+        # "overloaded" envelopes (REDISPATCH_KINDS) and count as
+        # capacity decisions, never failures.
+        if ready and self.settings.overload_control:
+            ready = self._shed_gate(burst, results, ready)
         if ready:
             attempt = await self._attempt([burst[i] for i in ready], slot)
             for i, result in zip(ready, attempt):
@@ -1132,6 +1203,75 @@ class Worker:
             if trace is not None and results[i] is not None:
                 obs_trace.attach(results[i], trace)
         return [result for result in results if result is not None]
+
+    def _job_deadline_s(self, job: dict) -> float:
+        """A job's end-to-end deadline budget: its own ``deadline_s``
+        field (the swarmload harness attaches one per workload profile;
+        the reference hive sends none) else the per-workflow setting."""
+        raw = job.get("deadline_s")
+        if raw is not None:
+            try:
+                value = float(raw)
+                if value > 0:
+                    return value
+            except (TypeError, ValueError):
+                pass
+        return self.settings.deadline_for(job.get("workflow"))
+
+    def _shed_gate(self, burst: list[dict], results: list,
+                   ready: list[int]) -> list[int]:
+        """Per-job admission verdicts for a burst about to execute;
+        returns the indices that survive. Shed envelopes settle through
+        the normal result path (exactly-once accounting unchanged)."""
+        now = time.monotonic()
+        stepper = self._stepper_health()
+        step_ewma = float(stepper.get("step_seconds_ewma") or 0.0)
+        queued = self.work_queue.qsize()
+        slots = len(self.pool)
+        admitted: list[int] = []
+        for i in ready:
+            job = burst[i]
+            received = self._inflight.get(job.get("id"))
+            # the job's age is hive queue time (the "queued_s" stamp a
+            # lease-aware hive sends with each delivery — under
+            # overload the backlog lives there) plus local queue wait
+            try:
+                queued_s = max(0.0, float(job.get("queued_s") or 0.0))
+            except (TypeError, ValueError):
+                queued_s = 0.0
+            lane_estimate = None
+            if stepper.get("enabled") and step_ewma > 0.0:
+                try:
+                    steps = int(job.get("num_inference_steps") or 0)
+                except (TypeError, ValueError):
+                    steps = 0
+                if steps > 0:
+                    lane_estimate = steps * step_ewma
+            decision = self.overload.should_shed(
+                workflow=job.get("workflow"),
+                waited_s=queued_s + (0.0 if received is None
+                                     else max(0.0, now - received)),
+                deadline_s=self._job_deadline_s(job),
+                # burst peers admitted ahead of this job are backlog
+                # too — they left the work queue together, so qsize
+                # alone undercounts exactly the jobs that will run
+                # first (the 30-50 ms misses the harness caught)
+                queued_ahead=queued + len(admitted), slots=slots,
+                lane_estimate_s=lane_estimate)
+            if not decision.shed:
+                admitted.append(i)
+                continue
+            self.stats.jobs_shed += 1
+            log.warning("job %s shed at admission: %s", job.get("id"),
+                        decision.reason)
+            results[i] = error_result(
+                job, f"shed by overload control on this node "
+                     f"({decision.reason}); a less-loaded node may "
+                     f"still serve it", kind="overloaded")
+        if len(admitted) < len(ready):
+            # sheds may have tripped (or extended) brownout: cap lanes
+            self._push_admission_caps()
+        return admitted
 
     def _record_outcomes(self, outcomes: dict[str, set[str]]) -> None:
         """Feed the per-model circuit breakers, ONE record per model per
@@ -1221,10 +1361,28 @@ class Worker:
         trace.meta["outcome"] = outcome
         trace.meta["settled"] = settled
         trace.finish(self.traces)
+        service_s = 0.0
         for phase in trace.root.children:
             self._phase_seconds.observe(phase.duration_s, phase=phase.name)
+            if phase.name in ("execute", "upload"):
+                service_s += phase.duration_s
         self._job_seconds.observe(trace.root.duration_s)
         self._jobs_total.inc(outcome=outcome)
+        if outcome == "ok" and service_s > 0.0:
+            # the admission estimator's service EWMA (node/overload.py)
+            # learns the worker-side cost of a successful job — execute
+            # + upload, queue wait excluded (the queue-drain term
+            # models that separately), divided by the attempt size its
+            # execute phase spanned (see _attempt). Failure envelopes
+            # are excluded: a fast refusal would drag the estimate
+            # toward zero and re-admit exactly the jobs being shed.
+            try:
+                attempt_jobs = max(1, int(
+                    trace.meta.get("attempt_jobs") or 1))
+            except (TypeError, ValueError):
+                attempt_jobs = 1
+            self.overload.note_service(trace.meta.get("workflow"),
+                                       service_s / attempt_jobs)
 
     async def _upload_with_retry(self, session, result) -> bool:
         retries = max(1, int(self.settings.upload_retries))

@@ -528,5 +528,75 @@ def residency_load_seconds_histogram(
                  60.0, 120.0, 300.0))
 
 
+# ---- overload-control families (ISSUE 9, node/overload.py) ----
+#
+# Declared here like the residency families; the controller is
+# per-WORKER (hermetic test workers must not bleed shed counts into
+# each other), so these take the worker's registry and the controller
+# pre-seeds every label vocabulary at construction.
+
+#: overload controller states (the brownout rung ladder)
+OVERLOAD_STATES = ("normal", "brownout")
+
+
+def overload_state_gauge(registry: Registry | None = None) -> Gauge:
+    """Overload-control state: 0 = normal, 1 = brownout (sustained
+    shedding tripped the rung — lane admissions are capped per step and
+    the shed margin tightens until sheds stop for the cooldown)."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_overload_state",
+        "overload control state (0=normal, 1=brownout)")
+
+
+def overload_shed_counter(registry: Registry | None = None) -> Counter:
+    """Jobs shed at admission because the estimator predicted a
+    deadline miss, by workload. Sheds upload as non-fatal ``overloaded``
+    envelopes a lease-aware hive redispatches (with this worker
+    excluded) — a rising rate means offered load exceeds this node's
+    capacity; compare against ``chiaswarm_jobs_total{outcome="ok"}`` to
+    read the admitted fraction."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_overload_shed_total",
+        "jobs shed by deadline-aware admission control, by workload",
+        labelnames=("workload",))
+
+
+def overload_backpressure_counter(
+        registry: Registry | None = None) -> Counter:
+    """Poll-loop waits inserted by queue-depth backpressure: the worker
+    predicted its queued backlog alone would outlast the backpressure
+    budget and stopped asking for MORE work. Jobs already queued keep
+    executing — backpressure throttles intake, shedding handles what
+    was already admitted."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_overload_backpressure_waits_total",
+        "poll-loop waits inserted by queue-depth backpressure")
+
+
+def overload_predicted_wait_histogram(
+        registry: Registry | None = None) -> Histogram:
+    """The admission estimator's predicted completion time (queue drain
+    + service estimate) sampled at every shed decision. Compare the
+    distribution against the deadline knobs: mass past the deadline IS
+    the shed rate; mass near it means the margin is doing the work."""
+    return (registry or REGISTRY).histogram(
+        "chiaswarm_overload_predicted_wait_seconds",
+        "admission estimator's predicted completion time at each "
+        "shed decision",
+        buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                 120.0, 300.0, 600.0, 1800.0))
+
+
+def overload_admission_cap_gauge(
+        registry: Registry | None = None) -> Gauge:
+    """Current brownout lane-admission cap (rows per step boundary);
+    0 = uncapped (normal state). Pushed into every slot's
+    StepScheduler (serving/stepper.py) while brownout holds."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_overload_admission_cap",
+        "brownout cap on lane rows admitted per step boundary "
+        "(0 = uncapped)")
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -111,6 +111,16 @@ class ModelUnavailable(ValueError):
     redispatch signal (another node may have the HBM this one lacks)."""
 
 
+#: half-life of the PER-MODEL arrival EWMAs the prefetch ranking reads.
+#: Deliberately longer than the lane demand EWMA's 10 s default: model
+#: reuse has minutes-scale locality while lane demand has seconds-scale
+#: — the swarmload harness sweep (ISSUE 9, node/loadgen.py::
+#: sweep_prefetch_window, seed "swarmload") ranks 20 s best across its
+#: seeded regime-shift streams, and tests/test_loadgen.py pins this
+#: constant to the sweep winner.
+PREFETCH_RANK_WINDOW_S = 20.0
+
+
 class ArrivalEwma:
     """Events/second EWMA over inter-arrival gaps, decayed while idle.
 
@@ -405,6 +415,27 @@ class ResidencyManager:
         except Exception:  # cache hygiene must never break the ledger
             pass
 
+    @staticmethod
+    def _retire_owner_lanes(owner_id: int | None, model: str) -> None:
+        """Eviction→lane-retire (ISSUE 9 satellite, ROADMAP item 4c
+        residue): a resident stepper lane holds the evicted model's
+        pipeline between jobs, so without this hook its HBM only frees
+        after the lane's idle grace (the old README caveat). Retire the
+        victim's lanes at drain — idle lanes free immediately. Lazy
+        import: stepper imports this module's ArrivalEwma, so the
+        dependency must stay one-way at import time."""
+        if owner_id is None:
+            return
+        try:
+            from chiaswarm_tpu.serving.stepper import retire_lanes_for_owner
+
+            retired = retire_lanes_for_owner(owner_id)
+            if retired:
+                log.info("eviction of %s retired %d lane(s) at drain",
+                         model, retired)
+        except Exception:  # lane hygiene must never break the ledger
+            pass
+
     def _charge_locked(self, need_bytes: int, limit: int,
                        count_transient: bool) -> int:
         """Bytes the ``limit`` check sees: resident + resident-bound
@@ -437,6 +468,7 @@ class ResidencyManager:
             if victim.model not in self._models_with_entries_locked():
                 self._set_state_locked(victim.model, "evicted")
             self._drop_owner_executables(victim.owner_id, victim.model)
+            self._retire_owner_lanes(victim.owner_id, victim.model)
             log.info("evicted %s (%.1f MiB, priority %d, reason %s); "
                      "resident now %.1f MiB", victim.model,
                      victim.bytes / 2**20, victim.priority, reason,
@@ -478,7 +510,9 @@ class ResidencyManager:
             if mode != "prefetch":
                 # prefetch re-loads must not inflate the demand signal
                 # they themselves are ranked by
-                self._arrivals.setdefault(model, ArrivalEwma()).note(1, now)
+                self._arrivals.setdefault(
+                    model, ArrivalEwma(
+                        window_s=PREFETCH_RANK_WINDOW_S)).note(1, now)
                 self._recipes[key] = _Recipe(loader, model, size_of,
                                              priority)
             entry = self._entries.get(key)

@@ -299,9 +299,15 @@ class LaneWidthController:
     lanes (tests/test_stepper.py::TestLaneWidthController)."""
 
     def __init__(self, *, min_width: int = 1, max_width: int = 128,
-                 alpha: float = 0.25, grow_at: float = 0.875,
-                 shrink_at: float = 0.375, patience: int = 6,
+                 alpha: float = 0.25, grow_at: float = 0.75,
+                 shrink_at: float = 0.25, patience: int = 6,
                  rate_window_s: float = 10.0) -> None:
+        # defaults are the swarmload harness sweep winner (ISSUE 9:
+        # node/loadgen.py::sweep_lane_gains, seed "swarmload" — grow
+        # earlier at 0.75 occupancy, hold width until 0.25): the table
+        # rides every BENCH json under configs.load_harness, and
+        # tests/test_loadgen.py pins defaults == winner
+        # (pre-sweep statics were grow_at=0.875, shrink_at=0.375)
         self.min_width = max(1, int(min_width))
         self.max_width = max(self.min_width, int(max_width))
         self.alpha = float(alpha)
@@ -380,6 +386,12 @@ class Lane:
         self._rows: list[_RowJob | None] = [None] * self.width
         self._stop = False
         self._retired = False
+        # eviction→retire (ISSUE 9 satellite): the residency ledger
+        # evicted this lane's model — retire the moment the row file
+        # drains (idle lanes retire on the next driver wakeup) instead
+        # of waiting out the idle grace, so HBM actually frees at
+        # eviction
+        self._retire_asap = False
         self.steps_executed = 0
         # adaptive capacity (ISSUE 7c): decisions land at step
         # boundaries only; bounds come from the scheduler's policy and
@@ -443,6 +455,15 @@ class Lane:
             self._stop = True
             self._cond.notify_all()
 
+    def request_retire(self) -> None:
+        """Retire as soon as the row file drains (resident rows finish,
+        pending rows admitted and finished) — the eviction hook. Unlike
+        :meth:`stop` this never fails resident rows: their params are
+        still live on device until they release them."""
+        with self._cond:
+            self._retire_asap = True
+            self._cond.notify_all()
+
     def join(self, timeout: float | None = None) -> None:
         self._thread.join(timeout)
 
@@ -459,15 +480,26 @@ class Lane:
                 # self._cond would invert the order and deadlock)
                 width_limit = self._sched.width_limit_for(self.key)
                 rate, hint_rows = self._sched.demand_signal()
+                admit_cap = self._sched.admission_cap()
                 with self._cond:
                     while True:
                         if self._stop:
                             raise LaneRetired("lane stopped")
                         self._resize_locked(width_limit, rate, hint_rows)
-                        self._admit_locked()
+                        self._admit_locked(admit_cap)
                         if self._h_active.any():
                             idle_since = None
                             break
+                        if self._retire_asap and not self._pending:
+                            # eviction retire: the model left the HBM
+                            # ledger and the row file is drained — free
+                            # the device state NOW, not after the idle
+                            # grace (handoffs were flushed blocking
+                            # before the loop came back around)
+                            self._retired = True
+                            self._deferred_counts.append(
+                                dict(lanes_evict_retired=1))
+                            return
                         now = time.monotonic()
                         if idle_since is None:
                             idle_since = now
@@ -591,13 +623,22 @@ class Lane:
         dev["mask_on"] = jnp.asarray(self._h_mask_on.copy())
         dev["cscale"] = jnp.asarray(self._h_cscale.copy())
 
-    def _admit_locked(self) -> None:
+    def _admit_locked(self, cap: int | None = None) -> None:
         """Splice pending jobs into free row slots — the step boundary is
-        wherever the driver is between dispatches."""
+        wherever the driver is between dispatches. ``cap`` is the
+        brownout rung (node/overload.py via the scheduler): at most that
+        many rows splice in per boundary, so resident rows finish ahead
+        of fresh admissions under sustained overload. The first pending
+        job always admits when slots allow — the cap throttles breadth,
+        it must never wedge a job wider than itself."""
         import jax.numpy as jnp
 
+        admitted_rows = 0
         free = [s for s in range(self.width) if self._rows[s] is None]
         while self._pending and self._pending[0].n_rows <= len(free):
+            if (cap is not None and admitted_rows > 0
+                    and admitted_rows + self._pending[0].n_rows > cap):
+                break
             job = self._pending.popleft()
             if job.future.cancelled():
                 continue
@@ -613,6 +654,7 @@ class Lane:
                 if arr is not None:
                     arr.block_until_ready()
             slots, free = free[:job.n_rows], free[job.n_rows:]
+            admitted_rows += job.n_rows
             if self._dev is None:
                 self._alloc_dev(job)
             mid_flight = bool(self._h_active.any())
@@ -798,7 +840,11 @@ class Lane:
             self._window.popleft().block_until_ready()
         if self._step_delay > 0:  # chaos seam: stretch lane wall time
             time.sleep(self._step_delay)
-        _STEP_SECONDS.observe(time.perf_counter() - t0)
+        step_s = time.perf_counter() - t0
+        _STEP_SECONDS.observe(step_s)
+        # the overload estimator's lane-path signal (node/overload.py):
+        # job steps x this EWMA floors the predicted service time
+        self._sched.note_step_seconds(step_s)
 
     def _retire_rows(self) -> None:
         """Retire finished rows (decode dispatched async — it overlaps the
@@ -1001,6 +1047,11 @@ class StepScheduler:
         self._arrivals = _ArrivalEwma()
         self._poll_hint_rows = 0
         self._poll_hint_t = float("-inf")
+        # overload control (ISSUE 9): the per-step lane-admission cap
+        # the worker pushes while brownout holds, and the step-latency
+        # EWMA the admission estimator floors its predictions with
+        self._admission_cap: int | None = None
+        self._step_ewma = 0.0
         _register_for_exit(self)
 
     # ---- policy ----
@@ -1098,6 +1149,42 @@ class StepScheduler:
         clamp the very next resize decision."""
         with self._lock:
             return self._width_limits.get(key)
+
+    # ---- overload control (ISSUE 9, node/overload.py) ----
+
+    def set_admission_cap(self, rows: int | None) -> None:
+        """Brownout rung: cap lane rows admitted per step boundary
+        (None/0 = uncapped). Pushed by the worker on every poll and
+        every shed while its overload controller holds brownout."""
+        with self._lock:
+            self._admission_cap = (None if not rows or int(rows) <= 0
+                                   else int(rows))
+
+    def admission_cap(self) -> int | None:
+        with self._lock:
+            return self._admission_cap
+
+    def note_step_seconds(self, seconds: float) -> None:
+        """Lane drivers feed each step's wall time; the EWMA rides
+        ``stats()`` so the worker's admission estimator can floor a
+        lane job's predicted service at steps x step-latency."""
+        with self._lock:
+            self._step_ewma = (float(seconds) if self._step_ewma <= 0.0
+                               else self._step_ewma + 0.25 * (
+                                   float(seconds) - self._step_ewma))
+
+    def retire_lanes_for_owner(self, owner_id: int) -> int:
+        """Eviction→lane-retire (ISSUE 9 satellite, ROADMAP item 4c
+        residue): ask every lane built on the components object with
+        ``id == owner_id`` to retire as soon as its rows drain — idle
+        lanes free their device state on the next driver wakeup instead
+        of after the idle grace. Returns the number of lanes asked."""
+        with self._lock:
+            lanes = [lane for key, lane in self._lanes.items()
+                     if key and key[0] == owner_id]
+        for lane in lanes:
+            lane.request_retire()
+        return len(lanes)
 
     # ---- submission ----
 
@@ -1466,6 +1553,7 @@ class StepScheduler:
             data = dict(self._stats)
             lanes = list(self._lanes.values())
             rate = self._arrivals.rate(now)
+            step_ewma = self._step_ewma
         active = sum(lane.occupancy()[0] for lane in lanes)
         width = sum(lane.occupancy()[1] for lane in lanes)
         steps_a = data.get("row_steps_active", 0)
@@ -1478,6 +1566,7 @@ class StepScheduler:
             "lane_occupancy": round(steps_a / denom, 4),
             "padding_waste": round(steps_p / denom, 4),
             "arrival_rate": round(rate, 4),
+            "step_seconds_ewma": round(step_ewma, 6),
         })
         return data
 
@@ -1537,11 +1626,14 @@ def aggregate_stats(steppers) -> dict[str, Any]:
     counters sum, the occupancy/waste ratios recompute from the summed
     row-step totals."""
     total = collections.Counter()
-    rate = 0.0
+    rate = step_ewma = 0.0
     for stepper in steppers:
         for key, value in stepper.stats().items():
             if key == "arrival_rate":
                 rate = max(rate, value)  # EWMAs do not sum
+                continue
+            if key == "step_seconds_ewma":
+                step_ewma = max(step_ewma, value)
                 continue
             if key in ("lane_occupancy", "padding_waste"):
                 continue
@@ -1553,7 +1645,23 @@ def aggregate_stats(steppers) -> dict[str, Any]:
     data["lane_occupancy"] = round(steps_a / denom, 4)
     data["padding_waste"] = round(steps_p / denom, 4)
     data["arrival_rate"] = round(rate, 4)
+    data["step_seconds_ewma"] = round(step_ewma, 6)
     return data
+
+
+def retire_lanes_for_owner(owner_id: int) -> int:
+    """Process-wide eviction→lane-retire hook: ask EVERY scheduler's
+    lanes built on the components object ``id(c) == owner_id`` to
+    retire at drain (idle lanes retire immediately). Called by the
+    residency ledger when it evicts a model (serving/residency.py) so
+    the lane's device state — the last holder of the evicted params —
+    frees at eviction, not after the idle grace."""
+    try:
+        schedulers = list(_EXIT_SCHEDULERS)
+    except NameError:  # no StepScheduler was ever constructed
+        return 0
+    return sum(sched.retire_lanes_for_owner(owner_id)
+               for sched in schedulers)
 
 
 _ATTACH_LOCK = threading.Lock()

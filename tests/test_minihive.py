@@ -317,6 +317,127 @@ def test_redispatch_on_model_unavailable_error_kind():
     assert bounded.uploaded_ids() == ["j3"]
 
 
+def test_stats_reconciliation_exactly_once_at_harness_scale():
+    """ISSUE 9 satellite: the ``GET /api/stats`` registry snapshot stays
+    exactly-once-consistent at swarmload scale — thousands of settled
+    jobs churned through 4 rotating workers on a fake clock, with
+    duplicates, late uploads after redelivery, overload/model refusals,
+    and lease-expiry abandonment injected throughout. The counters must
+    reconcile with the settle lists to the job."""
+    clock = [0.0]
+    hive = MiniHive(lease_s=5.0, max_attempts=3, max_jobs_per_poll=8,
+                    clock=lambda: clock[0])
+    n = 3000
+    for i in range(n):
+        hive.submit(_job(f"scale-{i}"))
+    workers = [f"w{k}" for k in range(4)]
+    rng = __import__("random").Random("scale-recon")
+
+    injected_dupes = 0
+    late_uploads = 0
+    salvaged = 0
+    refusals = 0
+    step = 0
+
+    def record(result, worker):
+        # mirror the salvage bookkeeping: ANY settle landing on an
+        # abandoned job (a straggler upload — incl. a lease that a
+        # mid-batch clock jump expired before its upload was recorded)
+        # must move it abandoned -> completed, counted once
+        nonlocal salvaged
+        was_abandoned = str(result.get("id")) in hive.abandoned
+        ack = hive._record_result(result, worker)
+        if was_abandoned and ack.get("status") == "ok":
+            salvaged += 1
+        return ack
+
+    while True:
+        clock[0] += 0.5
+        worker = workers[step % len(workers)]
+        step += 1
+        handed = hive._take_jobs(worker)
+        if not handed and not hive.leases and not hive.pending_jobs:
+            break
+        for payload in handed:
+            job_id = str(payload["id"])
+            # every delivery carries a monotone queue-age stamp
+            assert payload["queued_s"] >= 0.0
+            roll = rng.random()
+            if int(job_id.rsplit("-", 1)[1]) % 97 == 0:
+                # a pathological cohort that NEVER uploads: every
+                # delivery goes silent, so these jobs march through
+                # redelivery to abandonment-by-policy and stay there
+                clock[0] += hive.lease_s + 0.1
+                hive.sweep()
+                continue
+            if roll < 0.04 and payload["attempt"] < hive.max_attempts:
+                # an overload shed: requeued, shedder excluded
+                ack = record(error_result(
+                    _job(job_id), "shed", kind="overloaded"), worker)
+                assert ack["status"] == "requeued"
+                refusals += 1
+            elif roll < 0.07:
+                # worker goes silent on this one: its lease expires
+                # (redelivery, or abandonment at max_attempts)...
+                clock[0] += hive.lease_s + 0.1
+                hive.sweep()
+                if roll < 0.055:
+                    # ...and then the straggler upload lands anyway:
+                    # the first settle wins; if policy had already
+                    # abandoned the job, the upload SALVAGES it (one
+                    # job must never read as abandoned AND completed)
+                    ack = record(_ok_result(job_id, worker), worker)
+                    assert ack["status"] in ("ok", "duplicate")
+                    late_uploads += 1
+            else:
+                ack = record(_ok_result(job_id, worker), worker)
+                if ack["status"] == "ok" and rng.random() < 0.05:
+                    # a racing double upload: acked, never counted
+                    dup = record(_ok_result(job_id, "other"), "other")
+                    assert dup == {"status": "duplicate"}
+                    injected_dupes += 1
+        if step > 50_000:  # safety valve: must never loop forever
+            raise AssertionError("reconciliation churn did not converge")
+
+    stats = hive.stats()
+    issued = [f"scale-{i}" for i in range(n)]
+    completed = set(hive.completed)
+    abandoned = set(hive.abandoned)
+    # exactly once: every job settled XOR abandoned, none twice, none
+    # lost — at thousands of jobs with every race injected
+    assert completed.isdisjoint(abandoned)
+    assert completed | abandoned == set(issued)
+    assert len(hive.abandoned) == len(abandoned)  # no double-abandon
+    uploaded = hive.uploaded_ids()
+    assert len(uploaded) == len(set(uploaded)) == len(completed)
+    # the registry snapshot agrees with the lists TO THE JOB
+    metrics = stats["metrics"]
+
+    def counter(name: str, label: str = "") -> float:
+        return metrics[name]["values"].get(label, 0)
+
+    assert stats["completed"] == len(completed)
+    assert set(stats["abandoned"]) == abandoned
+    assert counter("chiaswarm_hive_results_completed_total") \
+        == len(completed)
+    assert counter("chiaswarm_hive_results_duplicate_total") \
+        == len(hive.duplicate_results) >= injected_dupes
+    # abandonments are monotone events; the LIST shrinks when a
+    # straggler upload salvages one — counters reconcile exactly
+    assert counter("chiaswarm_hive_jobs_salvaged_total") == salvaged
+    assert counter("chiaswarm_hive_jobs_abandoned_total") \
+        == len(abandoned) + salvaged
+    assert counter("chiaswarm_hive_jobs_redispatched_total",
+                   "overloaded") == refusals
+    # grants = attempts actually handed out — nothing leaks
+    assert counter("chiaswarm_hive_leases_granted_total") \
+        == sum(hive.attempts.values())
+    assert stats["pending"] == 0 and not stats["leased"]
+    assert injected_dupes > 20 and late_uploads > 20 and refusals > 20
+    assert salvaged > 0, "the salvage path never exercised"
+    assert abandoned, "the abandonment path never exercised"
+
+
 # ---------------------------------------------------------------------------
 # fleet chaos: real workers, scripted executors
 # ---------------------------------------------------------------------------

@@ -421,6 +421,20 @@ def test_worker_serves_metrics_and_traces_endpoints():
         assert (f'chiaswarm_residency_loads_total{{mode="{mode}"}}'
                 in body)
     assert "# TYPE chiaswarm_residency_load_seconds histogram" in body
+    # ...overload-control families (ISSUE 9, node/overload.py): the
+    # shed/backpressure counters live on the worker registry DISTINCT
+    # from the failure counters, pre-seeded from scrape one...
+    assert "chiaswarm_jobs_shed_total 0" in body
+    assert "chiaswarm_polls_backpressured_total 0" in body
+    assert "chiaswarm_overload_state 0" in body
+    assert "chiaswarm_overload_admission_cap 0" in body
+    assert "chiaswarm_overload_backpressure_waits_total 0" in body
+    assert ("# TYPE chiaswarm_overload_predicted_wait_seconds histogram"
+            in body)
+    for workload in ("txt2img", "img2img", "inpaint", "controlnet"):
+        assert (f'chiaswarm_overload_shed_total{{workload="{workload}"}} 0'
+                in body), workload
+    assert "overload" in health and health["overload"]["state"] == "normal"
     # ...compile-cache + hive families from the process registry...
     assert "chiaswarm_compile_cache_misses_total" in body
     assert "# TYPE chiaswarm_compiles_total counter" in body

@@ -1,0 +1,307 @@
+"""Overload control (ISSUE 9, node/overload.py): the admission
+estimator, backpressure, and brownout — units on a fake clock, then the
+worker-level shed path against a lease-aware mini-hive.
+
+Three layers:
+
+- **Controller units**: service EWMAs, the shed verdicts (cold never
+  sheds; predicted-past-margin and expired-in-queue shed), the
+  brownout rung state machine, and the poll-throttle brake.
+- **Taxonomy**: ``overloaded`` is a redispatch kind (non-fatal, NOT
+  breaker fodder) and the mini-hive requeues it with the shedding
+  worker excluded.
+- **Worker level** (real Worker + SyntheticExecutor, no pipelines): a
+  flooded overload-controlled worker sheds stale jobs as redispatchable
+  envelopes counted DISTINCTLY from failures, while a control-off
+  worker (reference parity) admits everything.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from chiaswarm_tpu.node.executor import error_result
+from chiaswarm_tpu.node.minihive import MiniHive
+from chiaswarm_tpu.node.overload import OverloadController
+from chiaswarm_tpu.node.resilience import (
+    BREAKER_KINDS,
+    NONFATAL_KINDS,
+    REDISPATCH_KINDS,
+    classify_result,
+)
+from chiaswarm_tpu.obs.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def _tmp_root(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    return tmp_path
+
+
+def controller(clock, **over) -> OverloadController:
+    over.setdefault("metrics_registry", Registry())
+    return OverloadController(clock=clock, **over)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_overloaded_is_redispatchable_and_not_breaker_fodder():
+    assert "overloaded" in REDISPATCH_KINDS
+    assert "overloaded" in NONFATAL_KINDS
+    # shedding says nothing about the model: K sheds in a row must not
+    # quarantine a healthy checkpoint
+    assert "overloaded" not in BREAKER_KINDS
+    envelope = error_result({"id": "j1", "content_type":
+                             "application/json"},
+                            "shed by overload control", kind="overloaded")
+    assert classify_result(envelope) == "overloaded"
+    assert not envelope.get("fatal_error")
+
+
+def test_minihive_redispatches_overloaded_with_shedder_excluded():
+    clock = [0.0]
+    hive = MiniHive(lease_s=30.0, clock=lambda: clock[0])
+    assert hive._take_jobs("wB") == []  # wB is a live alternative
+    hive.submit({"id": "j1", "model_name": "m"})
+    [handed] = hive._take_jobs("wA")
+    assert handed.get("queued_s") == 0.0  # age rides every delivery
+    shed = error_result({"id": "j1", "content_type": "application/json"},
+                        "shed", kind="overloaded")
+    ack = hive._record_result(shed, "wA")
+    assert ack == {"status": "requeued", "kind": "overloaded"}
+    assert hive.uploaded_ids() == []           # NOT settled
+    assert hive._take_jobs("wA") == []         # shedder excluded
+    clock[0] = 5.0
+    [redelivered] = hive._take_jobs("wB")      # a less-loaded worker
+    assert redelivered["attempt"] == 2
+    assert redelivered["queued_s"] == 5.0      # age keeps accruing
+    assert hive.metrics.get("chiaswarm_hive_jobs_redispatched_total") \
+        .value(kind="overloaded") == 1
+
+
+# ---------------------------------------------------------------------------
+# controller units (fake clock)
+# ---------------------------------------------------------------------------
+
+
+def test_cold_estimator_never_sheds_on_predictions():
+    ctl = controller(lambda: 0.0)
+    # no service evidence: a PREDICTION-based shed is impossible...
+    decision = ctl.should_shed(workflow="txt2img", waited_s=0.5,
+                               deadline_s=1.0, queued_ahead=50, slots=1)
+    assert not decision.shed and decision.reason == "cold"
+    # ...but an ALREADY-expired budget needs no evidence: even a
+    # just-restarted worker must not burn chip time on a sure miss
+    expired = ctl.should_shed(workflow="txt2img", waited_s=100.0,
+                              deadline_s=1.0, queued_ahead=0, slots=1)
+    assert expired.shed and "expired" in expired.reason
+
+
+def test_sheds_when_predicted_exceeds_remaining_budget():
+    ctl = controller(lambda: 0.0)
+    ctl.note_service("txt2img", 2.0)
+    # plenty of budget: admit
+    ok = ctl.should_shed(workflow="txt2img", waited_s=0.0, deadline_s=30.0,
+                         queued_ahead=0, slots=1)
+    assert not ok.shed
+    # 5 queued x 2 s + own 2 s = 12 s predicted vs 10 s remaining: shed
+    shed = ctl.should_shed(workflow="txt2img", waited_s=0.0,
+                           deadline_s=10.0, queued_ahead=5, slots=1)
+    assert shed.shed and shed.predicted_s == pytest.approx(12.0)
+    # the per-workflow EWMA is the estimate (not the overall)
+    ctl.note_service("img2img", 0.1)
+    assert ctl.service_estimate("img2img") == pytest.approx(0.1)
+    assert ctl.service_estimate("txt2img") == pytest.approx(2.0)
+    # "" and None normalize to the plain txt2img path
+    assert ctl.service_estimate(None) == ctl.service_estimate("txt2img")
+
+
+def test_expired_in_queue_sheds_even_with_fast_service():
+    ctl = controller(lambda: 0.0)
+    ctl.note_service("txt2img", 0.01)
+    decision = ctl.should_shed(workflow="txt2img", waited_s=5.0,
+                               deadline_s=2.0, queued_ahead=0, slots=1)
+    assert decision.shed and "expired" in decision.reason
+
+
+def test_lane_estimate_floors_a_cold_workflow_ewma():
+    ctl = controller(lambda: 0.0)
+    ctl.note_service("txt2img", 0.05)  # warm overall, cheap workflow
+    # 30 steps x 0.2 s/step floors the prediction at 6 s
+    decision = ctl.should_shed(workflow="txt2img", waited_s=0.0,
+                               deadline_s=3.0, queued_ahead=0, slots=1,
+                               lane_estimate_s=6.0)
+    assert decision.shed and decision.predicted_s >= 6.0
+
+
+def test_brownout_trips_on_sustained_sheds_and_cools_down():
+    clock = [0.0]
+    ctl = controller(lambda: clock[0], brownout_sheds=3, window_s=10.0,
+                     cooldown_s=5.0, admission_cap_rows=2)
+    ctl.note_service("txt2img", 1.0)
+    assert ctl.admission_cap() is None
+
+    def shed_once():
+        decision = ctl.should_shed(workflow="txt2img", waited_s=9.0,
+                                   deadline_s=1.0, queued_ahead=0,
+                                   slots=1)
+        assert decision.shed
+
+    shed_once()
+    shed_once()
+    assert ctl.state == "normal"       # below the rung
+    shed_once()
+    assert ctl.state == "brownout"     # 3 sheds inside the window
+    assert ctl.admission_cap() == 2
+    assert ctl.snapshot()["admission_cap"] == 2
+    # sheds keep it held; a shed-free cooldown clears it
+    clock[0] = 4.0
+    shed_once()
+    clock[0] = 8.0
+    assert ctl.admission_cap() == 2
+    clock[0] = 9.5                     # 5.5 s past the last shed
+    assert ctl.admission_cap() is None
+    assert ctl.state == "normal"
+    # ...and STAYS normal: the sheds that tripped the rung drained
+    # with the transition, so repeated polls inside the old window
+    # must not flap the state (regression: review finding)
+    for dt in (0.1, 0.2, 0.3, 2.0):
+        clock[0] = 9.5 + dt
+        assert ctl.admission_cap() is None
+        assert ctl.state == "normal"
+
+
+def test_brownout_tightens_the_shed_margin():
+    clock = [0.0]
+    ctl = controller(lambda: clock[0], brownout_sheds=2, window_s=10.0,
+                     cooldown_s=60.0, brownout_margin_scale=0.5)
+    ctl.note_service("txt2img", 1.0)
+    borderline = dict(workflow="txt2img", waited_s=0.0, deadline_s=1.5,
+                      queued_ahead=0, slots=1)
+    assert not ctl.should_shed(**borderline).shed  # 1.0 < 1.5 admits
+    for _ in range(2):                              # trip the rung
+        assert ctl.should_shed(workflow="txt2img", waited_s=9.0,
+                               deadline_s=1.0, queued_ahead=0,
+                               slots=1).shed
+    assert ctl.state == "brownout"
+    # same job now sheds: 1.0 > 0.5 x 1.5
+    assert ctl.should_shed(**borderline).shed
+
+
+def test_poll_throttle_engages_past_backpressure_budget():
+    ctl = controller(lambda: 0.0, backpressure_s=1.0)
+    assert ctl.poll_throttle(queue_depth=100, slots=1) == 0.0  # cold
+    ctl.note_service("txt2img", 0.5)
+    assert ctl.poll_throttle(queue_depth=1, slots=1) == 0.0
+    wait = ctl.poll_throttle(queue_depth=10, slots=1)  # 5 s drain > 1 s
+    assert 0.05 <= wait <= 2.0
+    assert ctl.backpressure_waits == 1
+    # more slots drain the same queue faster: below budget again
+    assert ctl.poll_throttle(queue_depth=10, slots=8) == 0.0
+    snap = ctl.snapshot()
+    assert snap["backpressure_waits"] == 1
+    assert snap["service_ewma_s"]["txt2img"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# worker level: the shed path end to end
+# ---------------------------------------------------------------------------
+
+
+def _worker(uri: str, name: str, **over):
+    from chiaswarm_tpu.node.loadgen import default_worker_factory
+
+    return default_worker_factory(seed=name, **over)(uri, name)
+
+
+def _flood_jobs(n: int, deadline_s: float) -> list[dict]:
+    return [{"id": f"flood-{i}", "model_name": "m", "workflow": "txt2img",
+             "prompt": f"p{i}", "deadline_s": deadline_s,
+             "content_type": "application/json"} for i in range(n)]
+
+
+def test_worker_sheds_stale_jobs_distinctly_from_failures():
+    """A flooded overload-controlled worker: stale jobs (hive queue age
+    past the deadline) shed as redispatchable envelopes; jobs_shed
+    counts them, jobs_failed does NOT, and every job still settles
+    exactly once (the shed->redispatch->final-attempt-settles flow)."""
+
+    async def scenario():
+        hive = MiniHive(lease_s=5.0, delay_s=0.0, max_attempts=2,
+                        max_jobs_per_poll=4)
+        uri = await hive.start()
+        for job in _flood_jobs(24, deadline_s=0.4):
+            hive.submit(job)
+        # one slow worker: service ~0.15 s vs 0.4 s deadlines at 24
+        # deep — most of the queue is doomed and must shed
+        worker = _worker(uri, "shed-w0")
+        task = asyncio.create_task(worker.run())
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                hive.sweep()
+                if len(hive.completed) >= 24:
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=30)
+            await hive.stop()
+        return hive, worker
+
+    hive, worker = asyncio.run(scenario())
+    uploaded = hive.uploaded_ids()
+    assert len(uploaded) == len(set(uploaded))
+    assert sorted(hive.completed) == sorted(f"flood-{i}"
+                                            for i in range(24))
+    kinds = {classify_result(r) for r in hive.completed.values()}
+    assert "overloaded" in kinds            # sheds happened...
+    assert worker.stats.jobs_shed > 0
+    assert worker.stats.jobs_failed == 0    # ...but are NOT failures
+    redispatched = hive.metrics.get(
+        "chiaswarm_hive_jobs_redispatched_total")
+    assert redispatched.value(kind="overloaded") >= 1
+    # /healthz surfaces the controller next to the resilience stats
+    health = worker.health()
+    assert health["overload"]["enabled"] is True
+    assert health["overload"]["sheds_total"] == worker.stats.jobs_shed
+    assert health["jobs_shed"] == worker.stats.jobs_shed
+
+
+def test_overload_control_off_is_reference_parity():
+    """The settings gate OFF (the default): the same flood admits
+    everything — zero sheds, zero backpressure waits — because sheds
+    only help when the hive redispatches them."""
+
+    async def scenario():
+        hive = MiniHive(lease_s=10.0, delay_s=0.0, max_jobs_per_poll=4)
+        uri = await hive.start()
+        for job in _flood_jobs(10, deadline_s=0.2):
+            hive.submit(job)
+        worker = _worker(uri, "parity-w0", overload_control=False)
+        assert worker.settings.overload_control is False
+        task = asyncio.create_task(worker.run())
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if len(hive.completed) >= 10:
+                    break
+                await asyncio.sleep(0.05)
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=30)
+            await hive.stop()
+        return hive, worker
+
+    hive, worker = asyncio.run(scenario())
+    assert worker.stats.jobs_shed == 0
+    assert worker.stats.polls_backpressured == 0
+    assert all(classify_result(r) == "ok"
+               for r in hive.completed.values())
+    assert worker.health()["overload"]["enabled"] is False

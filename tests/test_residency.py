@@ -594,6 +594,46 @@ def test_eviction_purges_orphaned_executables():
     assert (owner, "fake_prog", ()) not in GLOBAL_CACHE.executables._entries
 
 
+def test_eviction_requests_lane_retire_for_victim_owner():
+    """ISSUE 9 satellite (ROADMAP item 4c residue): evicting a model
+    asks every lane built on its components object to retire at drain,
+    so HBM frees at eviction instead of after the lane idle grace.
+    Lanes of OTHER models are untouched."""
+    from chiaswarm_tpu.serving.stepper import StepScheduler
+
+    class WithComponents(FakeModel):
+        def __init__(self, nbytes):
+            super().__init__(nbytes)
+            self.c = object()
+
+    class FakeLane:
+        def __init__(self, key):
+            self.key = key
+            self.retire_requested = False
+
+        def request_retire(self):
+            self.retire_requested = True
+
+    m = manager(1000)
+    value = WithComponents(700)
+    m.acquire("ka", lambda: value, model="a", size_of=size_of)
+    owner = id(value.c)
+    sched = StepScheduler()  # registers in the process-wide exit set
+    victim_lane = FakeLane((owner, 64, 64, 16, "sampler", None))
+    other_lane = FakeLane((id(object()), 64, 64, 16, "sampler", None))
+    sched._lanes[victim_lane.key] = victim_lane
+    sched._lanes[other_lane.key] = other_lane
+    try:
+        # loading b evicts a (budget 1000 cannot hold 700 + 700)
+        m.acquire("kb", loader_of([], "b", 700), model="b",
+                  size_of=size_of)
+        assert m.model_states()["a"] == "evicted"
+        assert victim_lane.retire_requested
+        assert not other_lane.retire_requested
+    finally:
+        sched._lanes.clear()
+
+
 def test_footprints_namespaced_by_weights_format(tmp_path, monkeypatch):
     """An int8 measurement must not size a bf16 restart's reservations
     (and vice versa): the persisted footprint file keeps one section

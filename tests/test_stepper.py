@@ -1045,3 +1045,92 @@ def test_adaptive_resize_compiles_only_new_lattice_widths(
     one_pass()
     after = GLOBAL_CACHE.executables.stats["misses"]
     assert after == before, (before, after)
+
+
+# ---- overload hooks (ISSUE 9): eviction retire + admission cap ---------
+
+
+def test_eviction_retire_hook_frees_idle_lane_immediately(
+        tiny_pipe, monkeypatch):
+    """ISSUE 9 satellite: an idle lane asked to retire by the residency
+    eviction hook frees its device state NOW — long before the idle
+    grace (pinned to 10 minutes here so it provably wasn't the
+    timeout), counted as lanes_evict_retired."""
+    monkeypatch.setenv("CHIASWARM_STEPPER_IDLE_S", "600")
+    from chiaswarm_tpu.serving.stepper import retire_lanes_for_owner
+
+    sched = StepScheduler()
+    fut = sched.submit_request(
+        tiny_pipe, prompt="soon evicted", steps=3, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=41)
+    fut.result(timeout=300)[0].wait()
+    assert sched.stats()["lanes_live"] == 1  # idle but resident
+
+    assert retire_lanes_for_owner(id(tiny_pipe.c)) >= 1
+    end = time.monotonic() + 30
+    while time.monotonic() < end and sched.stats()["lanes_live"]:
+        time.sleep(0.02)
+    stats = sched.stats()
+    assert stats["lanes_live"] == 0, stats
+    assert stats.get("lanes_evict_retired", 0) >= 1
+    # rows were never harmed: nothing failed or expired
+    assert stats.get("rows_failed", 0) == 0
+
+
+def test_eviction_retire_waits_for_resident_rows(tiny_pipe, monkeypatch):
+    """A BUSY lane asked to retire finishes its resident rows first
+    (their params are still live on device), then retires at drain —
+    the in-flight job completes normally."""
+    monkeypatch.setenv("CHIASWARM_STEPPER_IDLE_S", "600")
+    monkeypatch.setenv("CHIASWARM_STEPPER_STEP_DELAY_S", "0.05")
+    from chiaswarm_tpu.serving.stepper import retire_lanes_for_owner
+
+    sched = StepScheduler()
+    base = sched.stats().get("steps_executed", 0)
+    fut = sched.submit_request(
+        tiny_pipe, prompt="evicted mid-flight", steps=10,
+        guidance_scale=7.5, height=64, width=64, rows=1, seed=42)
+    _wait_steps(sched, base + 2)
+    assert retire_lanes_for_owner(id(tiny_pipe.c)) >= 1
+    pending, _info = fut.result(timeout=300)
+    assert pending.wait().shape[0] == 1      # the job completed
+    end = time.monotonic() + 30
+    while time.monotonic() < end and sched.stats()["lanes_live"]:
+        time.sleep(0.02)
+    stats = sched.stats()
+    assert stats["lanes_live"] == 0, stats
+    assert stats.get("rows_failed", 0) == 0
+    assert stats.get("rows_completed", 0) >= 1
+
+
+def test_admission_cap_throttles_rows_per_boundary(tiny_pipe, monkeypatch):
+    """The brownout rung (node/overload.py via set_admission_cap): with
+    cap=1, two jobs pending at the same boundary splice in one per
+    boundary; the uncapped control admits both at once. The cap can
+    never wedge a job wider than itself (first admit always allowed)."""
+    monkeypatch.setenv("CHIASWARM_STEPPER_LANE_WIDTH", "4")
+    monkeypatch.setenv("CHIASWARM_STEPPER_STEP_DELAY_S", "0.2")
+
+    def run_pair(cap):
+        sched = StepScheduler()
+        if cap is not None:
+            sched.set_admission_cap(cap)
+            assert sched.admission_cap() == cap
+        base = sched.stats().get("steps_executed", 0)
+        lead = sched.submit_request(
+            tiny_pipe, prompt="lead", steps=12, guidance_scale=7.5,
+            height=64, width=64, rows=1, seed=51)
+        _wait_steps(sched, base + 1)
+        pair = [sched.submit_request(
+            tiny_pipe, prompt=f"pending {i}", steps=3,
+            guidance_scale=7.5, height=64, width=64, rows=1,
+            seed=52 + i) for i in range(2)]
+        infos = [fut.result(timeout=300)[1] for fut in pair]
+        lead.result(timeout=300)[0].wait()
+        sched.shutdown()
+        return [info["admitted_at_step"] for info in infos]
+
+    capped = run_pair(1)
+    assert capped[0] != capped[1], capped      # one row per boundary
+    uncapped = run_pair(None)
+    assert uncapped[0] == uncapped[1], uncapped  # both splice together

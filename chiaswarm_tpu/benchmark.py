@@ -508,11 +508,16 @@ def _bench_load_harness(*, on_tpu: bool, attn: str) -> dict:
         "polls_backpressured": sum(w["polls_backpressured"]
                                    for w in workers.values()),
         "kill": report["kill"],
+        # measured per-family deadline suggestions (ISSUE 10 satellite)
+        "suggested_deadlines": report["suggested_deadlines"],
         # the satellite's tuning story: sweep tables + the winners the
         # shipped defaults were landed from
         "sweeps": {
             "lane_gains": loadgen.sweep_lane_gains(seed),
             "prefetch_window": loadgen.sweep_prefetch_window(seed),
+            # ISSUE 10: the derivation DEFAULT_FAMILY_DEADLINES ships
+            # (pinned defaults == winner, tests/test_loadgen.py)
+            "deadline_table": loadgen.sweep_deadline_table(seed),
         },
     }
 

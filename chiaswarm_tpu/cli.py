@@ -53,8 +53,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "worker":
         from chiaswarm_tpu.node.worker import run_worker
 
-        asyncio.run(run_worker())
-        return 0
+        # the guard's restart rung surfaces as a distinct exit code
+        # (serving/guard.py GUARD_RESTART_EXIT_CODE) so supervisors
+        # restart-on-73 instead of paging a crash
+        return asyncio.run(run_worker())
     if args.command == "smoke":
         from chiaswarm_tpu.node.smoke import main as smoke_main
 

@@ -343,5 +343,15 @@ class CompileCache:
         return self.executables.get_or_create(
             key, lambda: _instrument_executable(builder(), _key_tag(key)))
 
+    def flush_executables(self) -> int:
+        """Drop EVERY cached executable (the guard's cache-flush heal
+        rung, serving/guard.py): a sick device can serve a corrupted
+        compiled program, and recompiling fresh is the cheapest rung
+        above a lane rebuild. Params stay resident — the corruption
+        mode this rung targets is the executable, not the weights.
+        Returns the number dropped; the next calls recompile (or reload
+        from the persistent XLA cache)."""
+        return self.executables.drop_where(lambda _key: True)
+
 
 GLOBAL_CACHE = CompileCache()

@@ -43,8 +43,13 @@ log = logging.getLogger("chiaswarm.chaos")
 POLL_MODES = ("ok", "drop", "delay", "http_500", "bad_worker", "malformed")
 #: fault modes a ChaoticHive result endpoint understands (per job id)
 RESULT_MODES = ("ok", "drop", "http_500")
-#: fault modes a ChaoticExecutor understands (per job attempt)
-EXECUTOR_MODES = ("ok", "slow", "hang", "crash", "oom", "fetch", "fatal")
+#: fault modes a ChaoticExecutor understands (per job attempt).
+#: ``invalid`` (ISSUE 10) is the guard's poisoned-row retirement: a
+#: non-fatal ``invalid_output`` envelope a lease-aware hive
+#: redispatches with this worker excluded (REDISPATCH_KINDS), so fleet
+#: tests exercise the redispatch path without compiling a pipeline.
+EXECUTOR_MODES = ("ok", "slow", "hang", "crash", "oom", "fetch", "fatal",
+                  "invalid")
 
 
 class ChaosSchedule:
@@ -314,6 +319,10 @@ class ChaoticExecutor:
         if mode == "fatal":
             return error_result(job, "chaos: unusable job inputs",
                                 kind="fatal", fatal=True)
+        if mode == "invalid":
+            return error_result(
+                job, "chaos: non-finite latents screened before upload",
+                kind="invalid_output")
         return {
             "id": job.get("id"),
             "artifacts": {"primary": make_text_result(

@@ -145,9 +145,46 @@ def _format(job: dict[str, Any], registry: ModelRegistry):
 
 
 def _execute(job_id, content_type, callback, kwargs, slot) -> dict:
+    from chiaswarm_tpu.serving.guard import (
+        InvalidOutput,
+        _slot_devices,
+        watch_solo,
+    )
+
+    # swarmguard (ISSUE 10): the solo denoise phase runs under the hang
+    # watchdog (budget = steps x the lane step EWMA x k; never armed
+    # cold, so a first-call compile cannot false-positive). DIFFUSION
+    # callbacks only — the step EWMA is a diffusion-lane signal and
+    # says nothing about video/audio/caption service times. A
+    # hung-but-returned call raises StepHung -> classified transient ->
+    # the PR-2 ladder re-runs it; one that never returns is the
+    # deadline envelope's job (node/worker.py).
+    watched_steps = (kwargs.get("num_inference_steps")
+                     if getattr(callback, "__name__", "")
+                     == "diffusion_callback" else None)
+    # warmth key ~ the solo program variant: a new model or resolution
+    # compiles its own executable, and its first call must get the
+    # ceiling budget, not another variant's steady-state one
+    watch_key = (str(kwargs.get("model_name")), kwargs.get("height"),
+                 kwargs.get("width"))
     try:
-        with _maybe_profile(job_id):
+        with _maybe_profile(job_id), \
+                watch_solo(slot, watched_steps, key=watch_key):
             artifacts, config = slot(callback, **kwargs)
+    except InvalidOutput as exc:
+        # numerically poisoned output screened before upload: a
+        # non-fatal invalid_output envelope (REDISPATCH_KINDS) instead
+        # of garbage pixels, and a health event for this slot's devices
+        guard = getattr(slot, "_guard", None)
+        if guard is not None:
+            guard.note_invalid_output(
+                _slot_devices(slot),
+                model=str(kwargs.get("model_name") or ""))
+        log.error("job %s produced invalid output (%s); envelope "
+                  "uploaded instead of the poisoned image", job_id, exc)
+        artifacts, config = _error_payload(exc, content_type,
+                                           kind="invalid_output")
+        return _result(job_id, artifacts, config)
     except ValueError as exc:  # callback-declared unrecoverable input error
         # ...EXCEPT a node-local model-unavailable (missing/broken/
         # quarantined checkpoint): that is this node refusing, not the
@@ -207,10 +244,23 @@ def _stepper_submit(job_id, content_type, callback, kwargs, slot,
         return None
 
 
-def _stepper_collect(job_id, content_type, slot, ticket) -> dict | None:
+def _stepper_collect(job_id, content_type, slot, ticket,
+                     registry=None, kwargs=None) -> dict | None:
     """Wait out a lane ticket. Returns the finished result, a timeout
-    envelope (in-lane deadline expiry), or None — meaning the job must
-    re-run through the per-job path (lane fault; zero-loss fallback)."""
+    envelope (in-lane deadline expiry), an ``invalid_output`` envelope
+    (poisoned row, swarmguard), or None — meaning the job must re-run
+    through the per-job path (lane fault; zero-loss fallback).
+
+    When ``kwargs`` is provided and the lane was CONDEMNED by the hang
+    watchdog (guard.LaneHung), the job is re-admitted ONCE to a freshly
+    built lane, resuming from the condemnation checkpoint — the
+    self-healing lane-rebuild rung. A second hang (or a reject) falls
+    through to the per-job path, the PR-2 ladder."""
+    from chiaswarm_tpu.serving.guard import (
+        InvalidOutput,
+        LaneHung,
+        _slot_devices,
+    )
     from chiaswarm_tpu.serving.stepper import LaneDeadline
     from chiaswarm_tpu.workloads.diffusion import stepper_finish
 
@@ -219,6 +269,26 @@ def _stepper_collect(job_id, content_type, slot, ticket) -> dict | None:
     except LaneDeadline as exc:
         return error_result({"id": job_id, "content_type": content_type},
                             exc, kind="timeout")
+    except InvalidOutput as exc:
+        guard = getattr(slot, "_guard", None)
+        if guard is not None:
+            guard.note_invalid_output(_slot_devices(slot),
+                                      model=str(ticket.model_name))
+        log.error("job %s retired invalid_output (%s); envelope "
+                  "uploaded instead of a poisoned image", job_id, exc)
+        return error_result({"id": job_id, "content_type": content_type},
+                            exc, kind="invalid_output")
+    except LaneHung as exc:
+        # hang accounting (device health, condemned-lane counters)
+        # already happened lane-side when the watchdog condemned it
+        if kwargs is not None:
+            healed = _stepper_resubmit(job_id, content_type, slot,
+                                       registry, kwargs, ticket, exc)
+            if healed is not None:
+                return healed
+        log.warning("job %s lost its lane to the watchdog (%s); "
+                    "per-job path", job_id, exc)
+        return None
     except Exception as exc:
         kind = classify_exception(exc)
         if kind == "oom":
@@ -229,6 +299,40 @@ def _stepper_collect(job_id, content_type, slot, ticket) -> dict | None:
                     job_id, kind, exc)
         return None
     return _result(job_id, artifacts, config)
+
+
+def _stepper_resubmit(job_id, content_type, slot, registry, kwargs,
+                      ticket, exc) -> dict | None:
+    """Re-admit a condemned lane's job to a freshly built lane
+    (swarmguard lane-rebuild rung): same kwargs, the SAME seed the
+    first admission drew (a resumed trajectory must not re-derive its
+    noise), and the condemnation checkpoint as the resume payload so
+    surviving rows splice back in at step k instead of restarting.
+    Returns the finished result or None (fall back to the per-job
+    path). The inner collect passes no kwargs — a second hang is not
+    healed again."""
+    from chiaswarm_tpu.workloads.diffusion import stepper_submit
+
+    retry_kwargs = dict(kwargs)
+    retry_kwargs["seed"] = ticket.seed
+    resume = getattr(exc, "resume", None)
+    if isinstance(resume, dict):
+        retry_kwargs["resume"] = resume
+    else:
+        retry_kwargs.pop("resume", None)
+    try:
+        retry = stepper_submit(slot, registry, retry_kwargs, ticket.seed,
+                               job_id=job_id)
+    except Exception as submit_exc:
+        log.warning("job %s lane re-admission failed (%s); per-job "
+                    "path", job_id, submit_exc)
+        return None
+    log.warning("job %s re-admitted to a fresh lane after condemnation"
+                "%s", job_id,
+                (f", resuming at step {resume.get('step')}"
+                 if isinstance(resume, dict) else " (no checkpoint — "
+                 "restarting at step 0)"))
+    return _stepper_collect(job_id, content_type, slot, retry)
 
 
 def synchronous_do_work(job: dict[str, Any], slot,
@@ -245,10 +349,11 @@ def synchronous_do_work(job: dict[str, Any], slot,
         formatted, fatal = _format(job, registry)
         if formatted is None:
             return fatal
-        job_id, content_type, _, _ = formatted
+        job_id, content_type, _, kwargs = formatted
         ticket = _stepper_submit(*formatted, slot, registry)
         if ticket is not None:
-            result = _stepper_collect(job_id, content_type, slot, ticket)
+            result = _stepper_collect(job_id, content_type, slot, ticket,
+                                      registry, kwargs)
             if result is not None:
                 return result
         return _execute(*formatted, slot)
@@ -463,7 +568,8 @@ def synchronous_do_work_batch(jobs: list[dict[str, Any]], slot,
     # lane row falls back to the per-job path below (zero-loss)
     for i, job_id, content_type, kwargs, ticket in tickets:
         with obs_trace.activate(_job_trace(i)):
-            result = _stepper_collect(job_id, content_type, slot, ticket)
+            result = _stepper_collect(job_id, content_type, slot, ticket,
+                                      registry, kwargs)
         if result is not None:
             results[i] = result
         else:

@@ -24,12 +24,28 @@ import numpy as np
 from PIL import Image, ImageOps
 
 from chiaswarm_tpu.node.registry import ModelRegistry
+from chiaswarm_tpu.node.resilience import BadAssetError
 
 log = logging.getLogger("chiaswarm.dispatch")
 
 MAX_SIZE = 1024
 MAX_IMAGE_BYTES = 3 * 1048576   # input guard, job_arguments.py:172-176
 DEFAULT_STEPS = 30              # job_arguments.py:139-141
+
+# ---- asset trust-boundary hardening (ISSUE 10 satellite) ----
+# Asset fetches cross an open-network trust boundary with hostile
+# parties on the far side. Beyond the reference's byte cap: explicit
+# connect/read timeouts (a stalling asset host must not wedge an
+# executor thread into its job deadline), a STREAMED read capped at
+# MAX_IMAGE_BYTES (a body larger than its Content-Length claim is cut
+# off without buffering it), and a decoded-pixel-dimension cap (a
+# 20 KB PNG claiming 30000x30000 pixels is a decompression bomb — PIL
+# exposes the dimensions before decoding, so the bomb never inflates).
+# Violations raise resilience.BadAssetError -> non-fatal "bad_asset";
+# network faults stay "transient" (the PR-2 taxonomy).
+CONNECT_TIMEOUT_S = 10.0
+READ_TIMEOUT_S = 60.0
+MAX_IMAGE_PIXELS = 16 * 1024 * 1024  # 16 Mpx; served max is ~1 Mpx
 
 FormatResult = tuple[Callable[..., tuple[dict, dict]], dict[str, Any]]
 
@@ -179,18 +195,64 @@ def _format_stable_diffusion_args(args: dict[str, Any]) -> FormatResult:
 # ---- input fetching with trust-boundary guards ------------------------
 
 
-def download_image(url: str) -> Image.Image:
+def _read_capped(response, cap: int) -> bytes:
+    """Stream a response body up to ``cap`` bytes; one byte more is a
+    :class:`BadAssetError` — the body is never buffered past the cap,
+    so a hostile server cannot make this worker hold a multi-GB asset
+    in memory no matter what Content-Length it claimed."""
+    chunks: list[bytes] = []
+    total = 0
+    for chunk in response.iter_content(chunk_size=65536):
+        total += len(chunk)
+        if total > cap:
+            raise BadAssetError(
+                f"Input image too large.\nMax size is {cap} bytes.\n"
+                f"Stream exceeded the cap at {total} bytes.")
+        chunks.append(chunk)
+    return b"".join(chunks)
+
+
+def _check_decoded_dims(image: Image.Image) -> None:
+    """Decompression-bomb guard: PIL exposes the claimed dimensions
+    before decoding any pixels — reject the bomb while it is still a
+    few KB of compressed bytes."""
+    pixels = int(image.size[0]) * int(image.size[1])
+    if pixels > MAX_IMAGE_PIXELS:
+        raise BadAssetError(
+            f"Input image decodes to {image.size[0]}x{image.size[1]} "
+            f"({pixels} pixels), over the {MAX_IMAGE_PIXELS}-pixel cap "
+            f"(decompression-bomb guard).")
+
+
+def download_image(url: str,
+                   max_bytes: int = MAX_IMAGE_BYTES) -> Image.Image:
+    """Guarded image fetch. ``max_bytes`` defaults to the user-INPUT
+    cap; callers fetching the system's own outputs (stitch pulls prior
+    RESULT images, which an upscaled 2048px PNG legitimately pushes
+    past 3 MiB) pass a larger cap — the decoded-dimension bomb guard
+    and content-type/timeout checks still apply unchanged."""
     import requests
 
-    response = requests.get(url, allow_redirects=True, timeout=60)
-    response.raise_for_status()
-    # re-check after download: HEAD Content-Length can be absent or forged
-    if len(response.content) > MAX_IMAGE_BYTES:
-        raise ValueError(
-            f"Input image too large.\nMax size is {MAX_IMAGE_BYTES} bytes.\n"
-            f"Image was {len(response.content)}."
-        )
-    image = Image.open(io.BytesIO(response.content))
+    # the context manager closes the streamed response on EVERY path —
+    # a guard violation raised mid-stream must not leave the pooled
+    # connection checked out until GC (a burst of hostile assets would
+    # otherwise pin one dead socket per executor thread)
+    with requests.get(url, allow_redirects=True, stream=True,
+                      timeout=(CONNECT_TIMEOUT_S,
+                               READ_TIMEOUT_S)) as response:
+        response.raise_for_status()
+        content_type = response.headers.get("Content-Type", "")
+        if content_type and not content_type.startswith("image"):
+            # the GET's own content type — a host that passed the HEAD
+            # check must not switch to text/html for the real body
+            raise BadAssetError(
+                "Input does not appear to be an image.\n"
+                f"Content type was {content_type}.")
+        # streamed + capped: Content-Length can be absent or forged; a
+        # compliant header says nothing about the body that follows
+        data = _read_capped(response, max_bytes)
+    image = Image.open(io.BytesIO(data))
+    _check_decoded_dims(image)
     image = ImageOps.exif_transpose(image)
     return image.convert("RGB")
 
@@ -198,20 +260,25 @@ def download_image(url: str) -> Image.Image:
 def get_image(uri: str, size: tuple[int, int] | None,
               controlnet: dict | None = None) -> Image.Image:
     """Fetch an input image with the open-network guards the reference
-    enforces (job_arguments.py:162-190): content-type must be an image,
-    payload capped at 3 MiB, downscaled to the requested / max size."""
+    enforces (job_arguments.py:162-190) plus the ISSUE-10 hardening:
+    content-type must be an image, payload streamed and capped at 3 MiB,
+    decoded dimensions capped (decompression-bomb guard), explicit
+    connect/read timeouts, downscaled to the requested / max size.
+    Guard violations raise :class:`BadAssetError` (non-fatal
+    ``bad_asset``); network faults classify ``transient``."""
     import requests
 
-    head = requests.head(uri, allow_redirects=True, timeout=30)
+    head = requests.head(uri, allow_redirects=True,
+                         timeout=(CONNECT_TIMEOUT_S, 30.0))
     content_type = head.headers.get("Content-Type", "")
     content_length = int(head.headers.get("Content-Length", 0) or 0)
     if not content_type.startswith("image"):
-        raise ValueError(
+        raise BadAssetError(
             "Input does not appear to be an image.\n"
             f"Content type was {content_type}."
         )
     if content_length > MAX_IMAGE_BYTES:
-        raise ValueError(
+        raise BadAssetError(
             f"Input image too large.\nMax size is {MAX_IMAGE_BYTES} bytes.\n"
             f"Image was {content_length}."
         )

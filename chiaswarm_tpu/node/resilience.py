@@ -55,8 +55,14 @@ log = logging.getLogger("chiaswarm.resilience")
 #: coalesced bursts into serial solo re-runs first)
 RETRYABLE_KINDS = frozenset({"transient", "oom"})
 
-#: kinds that count as a model-level failure toward its circuit breaker
-BREAKER_KINDS = frozenset({"model_unavailable", "timeout", "error", "oom"})
+#: kinds that count as a model-level failure toward its circuit breaker.
+#: ``invalid_output`` (swarmguard, serving/guard.py) counts: a checkpoint
+#: that keeps producing NaN trajectories is broken the same way a
+#: checkpoint that keeps crashing is — K poisoned rows in a row
+#: quarantine it here while the guard's per-device ledger decides
+#: whether the DEVICE (not the model) is the sick one.
+BREAKER_KINDS = frozenset({"model_unavailable", "timeout", "error", "oom",
+                           "invalid_output"})
 
 #: kinds a lease-aware hive redispatches to ANOTHER worker instead of
 #: settling (node/minihive.py): this node cannot serve the model — by
@@ -68,15 +74,26 @@ BREAKER_KINDS = frozenset({"model_unavailable", "timeout", "error", "oom"})
 #: shed: THIS node predicts the job would miss its deadline behind the
 #: local backlog — a less-loaded node may still make it. Deliberately
 #: NOT breaker fodder: shedding says nothing about the model.
+#: ``invalid_output`` (ISSUE 10, serving/guard.py) is the poisoned-row
+#: retirement: THIS node's trajectory went NaN — a healthy node (or a
+#: healthy device) may render the same job fine, so the hive re-runs it
+#: elsewhere instead of settling garbage-or-error.
 REDISPATCH_KINDS = frozenset({"model_unavailable", "quarantined",
-                              "overloaded"})
+                              "overloaded", "invalid_output"})
 
 #: kinds whose error envelopes upload WITHOUT the fatal flag — locally
-#: retryable kinds plus hive-side redispatch kinds. The executor derives
-#: its fatal/non-fatal split from this set so a kind added to either
-#: family above can never silently stay fatal (drift between the
-#: taxonomy here and hand-written literals was a real near-miss).
-NONFATAL_KINDS = RETRYABLE_KINDS | REDISPATCH_KINDS
+#: retryable kinds plus hive-side redispatch kinds, plus ``bad_asset``
+#: (ISSUE 10 satellite, node/job_args.py): an input asset that violated
+#: the trust-boundary guards (size/content-type/decoded-dimension caps).
+#: Not retried locally (the caps are deterministic) and not breaker
+#: fodder (says nothing about the model), but non-fatal — the hive may
+#: retry elsewhere or surface it, exactly like a generic ``error``. The
+#: executor derives its fatal/non-fatal split from this set so a kind
+#: added to any family above can never silently stay fatal (drift
+#: between the taxonomy here and hand-written literals was a real
+#: near-miss).
+NONFATAL_KINDS = RETRYABLE_KINDS | REDISPATCH_KINDS | frozenset(
+    {"bad_asset"})
 
 _OOM_MARKERS = (
     "RESOURCE_EXHAUSTED",
@@ -106,7 +123,23 @@ _TRANSIENT_TYPE_NAMES = frozenset({
     "ServerDisconnectedError",
     "ClientConnectorError",
     "ClientOSError",
+    # swarmguard (serving/guard.py): a hung compiled call that finally
+    # returned — the call was declared dead wall-clock-wise, but the
+    # job's inputs are fine; the ladder re-runs it (a hung LANE is
+    # handled explicitly by the executor's lane-heal path first)
+    "StepHung",
+    "LaneHung",
 })
+
+class BadAssetError(ValueError):
+    """An input asset violated the trust-boundary guards (ISSUE 10
+    satellite, node/job_args.py): payload over the byte cap, wrong
+    content type, or decoded pixel dimensions over the
+    decompression-bomb cap. Subclasses ValueError so pre-existing
+    fatal-input handling still matches, but classifies as the
+    NON-fatal ``bad_asset`` kind (the job's PROMPT may be fine — the
+    asset host misbehaved; the hive decides whether to retry)."""
+
 
 _MODEL_UNAVAILABLE_MARKERS = (
     # node/registry.py load errors AND the residency bounce
@@ -142,6 +175,14 @@ def classify_exception(exc: BaseException) -> str:
     if any(marker in str(exc) for marker in _MODEL_UNAVAILABLE_MARKERS):
         return "model_unavailable"
     names = {cls.__name__ for cls in type(exc).__mro__}
+    if "InvalidOutput" in names:
+        # swarmguard (serving/guard.py): a numerically poisoned row —
+        # non-fatal, redispatchable, breaker fodder
+        return "invalid_output"
+    if "BadAssetError" in names:
+        # trust-boundary guard (node/job_args.py): checked BEFORE the
+        # blanket ValueError->fatal rule it subclasses into
+        return "bad_asset"
     if "HTTPError" in names:
         # requests.HTTPError subclasses OSError via RequestException, so
         # decide by status class BEFORE the blanket OSError check: 5xx is

@@ -136,6 +136,27 @@ class Settings:
     overload_window_s: float = 10.0
     overload_cooldown_s: float = 5.0
     overload_admission_cap: int = 2
+    # ---- gray-failure guard (serving/guard.py, ISSUE 10) ----
+    # the self-healing ladder: hang/slow-step/invalid-output events
+    # grow a per-device sickness streak (hang weighs 2, the rest 1; an
+    # OK event decays 1); crossing each threshold queues one rung —
+    # executable-cache flush, device quarantine (slot mesh shrinks to
+    # the healthy chips), graceful self-restart (exit code
+    # guard.GUARD_RESTART_EXIT_CODE for supervisors). The watchdog and
+    # validation knobs are env vars (CHIASWARM_GUARD*), like the
+    # stepper's.
+    guard_enabled: bool = True
+    guard_cache_flush_after: int = 3
+    guard_quarantine_after: int = 5
+    guard_restart_after: int = 7
+    # per-model-family deadline overrides (ISSUE 10 satellite, ROADMAP
+    # 5b): {"sdxl": 45.0, ...} — consulted between a job's explicit
+    # deadline_s field and the per-workflow table. The swarmload
+    # harness derives suggested values from measured percentiles
+    # (node/loadgen.py::score_run "suggested_deadlines" /
+    # sweep_deadline_table; shipped defaults pinned by test).
+    family_deadline_s: dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     def deadline_for(self, workflow: str | None) -> float:
         """Execution budget (seconds) for one job of ``workflow`` (None /

@@ -76,6 +76,11 @@ from chiaswarm_tpu.node.resilience import (
     classify_result,
 )
 from chiaswarm_tpu.node.settings import Settings, load_settings
+from chiaswarm_tpu.serving.guard import (
+    GUARD_RESTART_EXIT_CODE,
+    DeviceGuard,
+    _slot_devices,
+)
 
 log = logging.getLogger("chiaswarm.worker")
 
@@ -214,6 +219,27 @@ class Worker:
             cooldown_s=self.settings.overload_cooldown_s,
             admission_cap_rows=self.settings.overload_admission_cap,
             metrics_registry=self.metrics)
+        # ---- gray-failure guard (serving/guard.py, ISSUE 10) ----
+        # per-worker device-health ledger + healing ladder. Always
+        # constructed (its chiaswarm_guard_* families must render
+        # zeroes from scrape one); rung ACTIONS apply only when the
+        # settings gate is on. Lane drivers and the solo watchdog find
+        # it through the slot handle, like the checkpoint spool.
+        self.guard = DeviceGuard(
+            enabled=self.settings.guard_enabled,
+            cache_flush_after=self.settings.guard_cache_flush_after,
+            quarantine_after=self.settings.guard_quarantine_after,
+            restart_after=self.settings.guard_restart_after,
+            metrics_registry=self.metrics)
+        for slot in self.pool:
+            try:
+                slot._guard = self.guard
+            except (AttributeError, TypeError):  # exotic slot stubs
+                pass
+            self.guard.seed_devices(_slot_devices(slot))
+        # process exit status: 0, or GUARD_RESTART_EXIT_CODE after the
+        # restart rung's graceful drain (supervisors restart-on-73)
+        self.exit_code = 0
         # deterministic per-worker jitter: chaos runs reproduce exactly,
         # while distinct workers still decorrelate from each other
         self._poll_backoff = Backoff(
@@ -536,6 +562,13 @@ class Worker:
         }
         data.update(self.stats.snapshot())
         data["stepper"] = self._stepper_health()
+        # gray-failure guard (ISSUE 10): device health, sickness
+        # streaks, rung thresholds, quarantined devices — plus the
+        # in-service chip count so a quarantine's capacity shrink is
+        # visible next to the static device total
+        data["guard"] = self.guard.snapshot()
+        data["chips_in_service"] = sum(
+            len(_slot_devices(slot)) or 1 for slot in self.pool)
         # overload control (ISSUE 9): admission-estimator state next to
         # the resilience stats — shed totals, brownout rung, EWMAs
         data["overload"] = dict(
@@ -619,7 +652,11 @@ class Worker:
                     "rows_expired", "rows_failed", "lanes_created",
                     "lanes_failed", "row_steps_active", "row_steps_padded",
                     "rows_resumed", "resumes_rejected",
-                    "checkpoints_written", "lanes_evict_retired")
+                    "checkpoints_written", "lanes_evict_retired",
+                    # swarmguard (ISSUE 10): condemnations, hung rows,
+                    # poisoned rows, slow steps
+                    "lanes_condemned", "rows_hung", "rows_invalid",
+                    "steps_slow")
         for key in counters:
             m.counter(f"chiaswarm_stepper_{key}_total",
                       f"step scheduler: cumulative {key}").set_to(
@@ -726,6 +763,10 @@ class Worker:
                             pass
                         continue
                 delay = await self._ask_for_work(session)
+                # self-healing ladder (ISSUE 10): apply any rungs the
+                # device guard queued since the last poll — cache
+                # flush, device quarantine (mesh shrink), restart
+                self._apply_heal_rungs()
                 try:
                     await asyncio.wait_for(self._stop.wait(), timeout=delay)
                 except asyncio.TimeoutError:
@@ -823,6 +864,90 @@ class Worker:
             stepper = getattr(slot, "_stepper", None)
             if stepper is not None:
                 stepper.set_admission_cap(cap)
+
+    # ---- the self-healing ladder (serving/guard.py, ISSUE 10) ----
+
+    def _apply_heal_rungs(self) -> None:
+        """Drain the device guard's queued ladder actions. The first
+        rung (lane rebuild) is intrinsic to condemnation and already
+        happened lane-side; this applies the worker-level escalations:
+
+        - **cache_flush**: drop every cached executable — a sick
+          device sometimes serves a corrupted compiled program; the
+          next call recompiles fresh (``LruCache.drop_where``).
+        - **device_quarantine**: shrink every slot's mesh to the
+          healthy chips (data-axis meshes only — model-parallel slots
+          cannot lose a chip and stay well-formed, so they escalate to
+          restart instead). Capacity re-advertises through /healthz
+          (``chips_in_service``) and the lane width bounds, which read
+          the live ``slot.data_width``.
+        - **restart**: request the graceful PR-2 drain and leave
+          :data:`GUARD_RESTART_EXIT_CODE` for the supervisor — the
+          "heal me by replacing me" rung of last resort.
+        """
+        if not self.settings.guard_enabled:
+            return
+        for action in self.guard.take_actions():
+            if action.rung == "cache_flush":
+                from chiaswarm_tpu.core.compile_cache import GLOBAL_CACHE
+                from chiaswarm_tpu.serving.guard import note_cache_flush
+
+                dropped = GLOBAL_CACHE.flush_executables()
+                # re-cold every lane's hang budget: the recompiles this
+                # flush causes must run under the ceiling, or the rung
+                # would manufacture its own "hangs"
+                note_cache_flush()
+                log.error("guard heal: flushed %d cached executable(s) "
+                          "(%s)", dropped, action.reason)
+            elif action.rung == "device_quarantine":
+                self._quarantine_device(action.device, action.reason)
+            elif action.rung == "restart":
+                log.error("guard heal: self-restart requested (%s); "
+                          "draining gracefully, exit code %d",
+                          action.reason, GUARD_RESTART_EXIT_CODE)
+                self.exit_code = GUARD_RESTART_EXIT_CODE
+                self.request_stop()
+
+    def _quarantine_device(self, device: str, reason: str) -> None:
+        """Shrink every slot mesh that contains ``device`` to its
+        healthy chips. Lanes on the slot retire first (their rows
+        bounce through the zero-loss fallback paths); fresh programs
+        then build on the shrunk mesh. A slot that cannot shrink (its
+        only chip, or a model-parallel mesh) logs and leaves the
+        ladder to escalate."""
+        from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+
+        for slot in self.pool:
+            mesh = getattr(slot, "mesh", None)
+            if mesh is None:
+                continue
+            devices = list(mesh.devices.flatten())
+            healthy = [d for d in devices if str(d.id) != str(device)]
+            if len(healthy) == len(devices):
+                continue  # this slot never held the sick chip
+            shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+            non_data = 1
+            for name, size in shape.items():
+                if name != "data":
+                    non_data *= int(size)
+            if not healthy or non_data != 1:
+                log.error("guard heal: cannot quarantine device %s out "
+                          "of slot %s (mesh %s); the ladder escalates "
+                          "to restart instead", device,
+                          getattr(slot, "index", "?"), shape)
+                continue
+            stepper = getattr(slot, "_stepper", None)
+            if stepper is not None:
+                # retire resident lanes: their device state is the last
+                # holder of programs placed on the sick chip; unfinished
+                # rows fail over to the per-job path (never lost)
+                stepper.shutdown(timeout_s=5.0)
+            slot.mesh = build_mesh(MeshSpec({"data": len(healthy)}),
+                                   devices=healthy)
+            log.error("guard heal: device %s quarantined (%s); slot %s "
+                      "mesh shrunk to %d healthy chip(s) — capacity "
+                      "re-advertised", device, reason,
+                      getattr(slot, "index", "?"), len(healthy))
 
     async def _heartbeat_loop(self) -> None:
         """Lease keep-alive (ISSUE 6): every ``heartbeat_s``, tell the
@@ -1207,7 +1332,11 @@ class Worker:
     def _job_deadline_s(self, job: dict) -> float:
         """A job's end-to-end deadline budget: its own ``deadline_s``
         field (the swarmload harness attaches one per workload profile;
-        the reference hive sends none) else the per-workflow setting."""
+        the reference hive sends none), else the operator's per-model-
+        FAMILY override (ISSUE 10 satellite — heavy families need more
+        budget than their workflow's default; the harness derives
+        suggested values from measured percentiles,
+        node/loadgen.py::score_run), else the per-workflow setting."""
         raw = job.get("deadline_s")
         if raw is not None:
             try:
@@ -1216,7 +1345,30 @@ class Worker:
                     return value
             except (TypeError, ValueError):
                 pass
+        table = self.settings.family_deadline_s or {}
+        if table:
+            family = self._model_family(job.get("model_name"))
+            if family is not None and family in table:
+                try:
+                    value = float(table[family])
+                    if value > 0:
+                        return value
+                except (TypeError, ValueError):
+                    pass
         return self.settings.deadline_for(job.get("workflow"))
+
+    @staticmethod
+    def _model_family(model_name: Any) -> str | None:
+        """Catalog family of a model name (None when unresolvable) —
+        the key of the ``family_deadline_s`` override table."""
+        if not model_name:
+            return None
+        try:
+            from chiaswarm_tpu.models.configs import get_family
+
+            return str(get_family(str(model_name)).name)
+        except Exception:
+            return None
 
     def _shed_gate(self, burst: list[dict], results: list,
                    ready: list[int]) -> list[int]:
@@ -1404,12 +1556,19 @@ class Worker:
         return False
 
 
-async def run_worker(settings: Settings | None = None) -> None:
-    await Worker(settings).run()
+async def run_worker(settings: Settings | None = None) -> int:
+    """Run one worker to completion; returns its exit code — 0, or
+    guard.GUARD_RESTART_EXIT_CODE when the self-healing ladder's
+    restart rung requested a supervisor-visible restart (ISSUE 10)."""
+    worker = Worker(settings)
+    await worker.run()
+    return int(worker.exit_code)
 
 
 def main() -> None:  # `python -m chiaswarm_tpu.node.worker`
-    asyncio.run(run_worker())
+    import sys
+
+    sys.exit(asyncio.run(run_worker()))
 
 
 if __name__ == "__main__":

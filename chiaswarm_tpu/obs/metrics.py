@@ -598,5 +598,77 @@ def overload_admission_cap_gauge(
         "(0 = uncapped)")
 
 
+# ---- swarmguard families (ISSUE 10, serving/guard.py) ----
+#
+# Declared here like the overload families; the DeviceGuard is
+# per-WORKER (hermetic test workers must not bleed health events into
+# each other), takes the worker's registry, and pre-seeds every
+# enumerable label vocabulary at construction. The ``model`` and
+# ``device`` labels are bounded by the catalog / chip count, not by
+# time (the occupancy-family cardinality rule).
+
+
+def guard_hangs_counter(registry: Registry | None = None) -> Counter:
+    """Compiled calls the watchdog declared hung, by phase (``lane``
+    step dispatch vs ``solo`` denoise). A nonzero rate is THE
+    gray-failure signal: the chip wedges without dying — check the
+    device health gauge to see whether one chip owns the hangs."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_guard_hangs_total",
+        "compiled calls declared hung by the step watchdog, by phase",
+        labelnames=("phase",))
+
+
+def guard_condemned_counter(registry: Registry | None = None) -> Counter:
+    """Lanes condemned by the watchdog: each one is a lane-rebuild heal
+    rung — the condemned lane's rows re-admit to a freshly built lane,
+    resuming from their last step-boundary checkpoint."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_guard_condemned_lanes_total",
+        "lanes condemned by the hang watchdog (rows re-admitted to a "
+        "fresh lane)")
+
+
+def guard_invalid_counter(registry: Registry | None = None) -> Counter:
+    """Rows retired with ``invalid_output`` (non-finite latents or a
+    poisoned decoded frame), by model. One model owning the count while
+    others stay clean points at the checkpoint; every model counting
+    together points at the device (watch the health gauge)."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_guard_invalid_outputs_total",
+        "jobs retired invalid_output instead of uploading a poisoned "
+        "image, by model",
+        labelnames=("model",))
+
+
+def guard_device_health_gauge(registry: Registry | None = None) -> Gauge:
+    """Per-device health score in [0, 1]: 1 = healthy, decays with the
+    consecutive hang/slow-step/invalid-output streak and recovers with
+    OK events. The ladder rungs quote their thresholds in streak units;
+    the gauge is the operator-facing normalization."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_guard_device_health",
+        "per-device health score (1 = healthy; ladder rungs fire as "
+        "the sickness streak grows)",
+        labelnames=("device",))
+
+
+def guard_heal_rung_counter(registry: Registry | None = None) -> Counter:
+    """Healing-ladder escalations by rung: ``lane_rebuild`` (every
+    condemnation), ``cache_flush`` (executable LRU dropped),
+    ``device_quarantine`` (mesh shrunk to the healthy chips), and
+    ``restart`` (graceful drain + the distinct supervisor exit code)."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_guard_heal_rung_total",
+        "self-healing ladder escalations, by rung",
+        labelnames=("rung",))
+
+
+def guard_quarantined_gauge(registry: Registry | None = None) -> Gauge:
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_guard_quarantined_devices",
+        "devices currently quarantined out of the serving mesh")
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

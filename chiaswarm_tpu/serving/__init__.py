@@ -2,5 +2,9 @@
 
 ``stepper`` implements continuous step-level batching: jobs join and
 leave a resident batched denoise loop at step boundaries instead of
-queueing behind whole solo programs.
+queueing behind whole solo programs. ``residency`` owns the HBM model
+ledger (measured footprints, eviction, prefetch, degradation rungs).
+``guard`` is the gray-failure layer (ISSUE 10): the in-flight step
+watchdog, per-row output validation, and the per-device self-healing
+ladder.
 """

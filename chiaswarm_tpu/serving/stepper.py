@@ -76,6 +76,15 @@ operations"):
 - ``CHIASWARM_STEPPER_STEP_DELAY_S``  artificial per-step delay
   (chaos/test seam: stretches lane wall time so fleet faults can land
   deterministically mid-lane; keep 0 in production)
+
+Gray-failure guard (ISSUE 10, serving/guard.py): every step dispatch
+runs under the watchdog's hang budget (k x the step EWMA) — a wedged
+call condemns the lane from the monitor thread and its rows re-admit
+to a freshly built lane, resuming from the last step-boundary
+checkpoint; the checkpoint transfer doubles as a per-row finite-check,
+so a NaN-poisoned row retires ``invalid_output`` without touching its
+peers. ``CHIASWARM_GUARD*`` knobs and the ``CHIASWARM_CHAOS_*`` seams
+(scripted wedge / slow-step / NaN) are documented there.
 """
 
 from __future__ import annotations
@@ -102,6 +111,11 @@ from chiaswarm_tpu.obs.metrics import (
 )
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
+
+# swarmguard (ISSUE 10): the in-flight step watchdog, per-row output
+# validation, and the chaos seams that prove them deterministically
+from chiaswarm_tpu.serving import guard as _guard
+from chiaswarm_tpu.serving.guard import InvalidOutput, LaneHung
 
 # the rows/second EWMA the width controllers read is the SAME demand
 # primitive the residency manager ranks prefetch candidates with — one
@@ -421,6 +435,27 @@ class Lane:
             os.environ.get(ENV_CKPT_EVERY, "8") or 8)
         self._step_delay = float(
             os.environ.get(ENV_STEP_DELAY, "0") or 0)
+        # swarmguard (ISSUE 10): the watchdog condemns a wedged lane
+        # from the MONITOR thread; resume state for the re-admission
+        # comes from this in-memory twin of the spool checkpoint (kept
+        # even without a spool — condemnation must not depend on the
+        # fleet heartbeat being on), and the chaos plan scripts
+        # wedge/slow/NaN faults deterministically
+        self._condemned = False
+        self._ckpt_mem: dict[int, dict[str, Any]] = {}
+        # chaos plan is re-read per dispatch; triggers count steps
+        # relative to when the CURRENT plan first appeared on THIS
+        # lane (a changed plan re-bases, so sequentially-armed seams
+        # each get their own step window)
+        self._chaos_base: int | None = None
+        self._chaos_seen: _guard.LaneChaos | None = None
+        # widths whose step program has completed a dispatch in THIS
+        # lane: a dispatch at a new width (fresh lane, resize) may
+        # COMPILE, so it runs under the watchdog's ceiling budget, not
+        # the steady-state EWMA budget; a cache-flush heal rung bumps
+        # the epoch and re-colds every lane (serving/guard.py)
+        self._warm_widths: set[int] = set()
+        self._flush_epoch = _guard.flush_epoch()
         # retired rows whose async decode is still in flight: the future
         # resolves only once the images are RESIDENT (same cross-thread
         # hazard as admission — the consumer must never read an array
@@ -466,6 +501,71 @@ class Lane:
 
     def join(self, timeout: float | None = None) -> None:
         self._thread.join(timeout)
+
+    def condemn(self, reason: str) -> None:
+        """Declare this lane HUNG (swarmguard watchdog, ISSUE 10).
+
+        Runs in the watchdog MONITOR thread while the driver is blocked
+        inside the wedged dispatch, so it must never touch the device:
+        it retires the lane (submitters open a fresh one), clears the
+        row file, and fails every job's future with :class:`LaneHung`
+        carrying the last in-memory step-boundary checkpoint — the
+        executor re-admits those rows to a freshly built lane resuming
+        at step k (node/executor.py::_stepper_collect). The wedged
+        driver thread notices on return and exits without touching the
+        row file it no longer owns."""
+        with self._cond:
+            if self._retired or self._condemned:
+                return
+            self._condemned = True
+            # retire BEFORE failing over, like _fail_all: a racing
+            # submit must see a dead lane and open a fresh one
+            self._retired = True
+            jobs = {id(j): j for j in self._rows if j is not None}
+            pending = [j for j in self._pending]
+            self._pending.clear()
+            for s in range(self.width):
+                self._rows[s] = None
+            self._h_active[:] = False
+            resumes = {jid: self._ckpt_mem.get(jid) for jid in jobs}
+            handoff = list(self._handoff)
+            self._handoff.clear()
+            self._cond.notify_all()
+        # outside the lane lock: _lane_done/_count take sched._lock,
+        # which submitters hold while waiting on this lane's cond —
+        # nesting them here would invert the order and deadlock
+        self._sched._lane_done(self)
+        rows_hung = 0
+        for jid, job in jobs.items():
+            rows_hung += job.n_rows
+            if not job.future.done():
+                job.future.set_exception(LaneHung(
+                    f"lane {self.lane_id} condemned: {reason}",
+                    resume=resumes.get(jid)))
+        for job in pending:
+            rows_hung += job.n_rows
+            if not job.future.done():
+                job.future.set_exception(LaneHung(
+                    f"lane {self.lane_id} condemned with the job still "
+                    f"pending: {reason}"))
+        for job, _pending_imgs, _info in handoff:
+            # the retired rows' decode was dispatched onto the wedged
+            # device — waiting on it HERE would wedge the watchdog too;
+            # the job re-runs instead (chip time lost, rows never are)
+            if not job.future.done():
+                job.future.set_exception(LaneHung(
+                    f"lane {self.lane_id} condemned with the decode "
+                    f"in flight: {reason}"))
+        self._sched._count(lanes_condemned=1, rows_hung=rows_hung)
+        device_guard = getattr(getattr(self._sched, "slot", None),
+                               "_guard", None)
+        if device_guard is not None:
+            device_guard.note_hang(
+                _guard._slot_devices(self._sched.slot), phase="lane")
+            device_guard.note_condemned()
+        log.error("lane %d CONDEMNED (%s): %d row(s) failed over with "
+                  "resume state for a fresh lane", self.lane_id, reason,
+                  rows_hung)
 
     # ---- driver ----
 
@@ -813,38 +913,140 @@ class Lane:
 
         ctrl_params = (self.ctrl.params if self.ctrl is not None
                        else {"zero": jnp.zeros((1,), jnp.float32)})
+        this_step = self.steps_executed + 1
+        # chaos plan (swarmguard seams): env re-read each dispatch, and
+        # trigger steps count from the dispatch that first SAW the plan
+        # — deterministic on fresh and warm (reused) lanes alike
+        chaos = _guard.LaneChaos.from_env()
+        if not chaos.armed:
+            self._chaos_base = self._chaos_seen = None
+        elif chaos != self._chaos_seen:
+            self._chaos_base = self.steps_executed
+            self._chaos_seen = chaos
+        chaos_step = (this_step - self._chaos_base
+                      if self._chaos_base is not None else 0)
+        # swarmguard (ISSUE 10): arm the hang watchdog around the whole
+        # dispatch INCLUDING the depth-2 window drain — that drain is
+        # where a wedged device actually blocks this thread. Budget is
+        # k x the scheduler's step EWMA, EXCEPT when this dispatch may
+        # compile — the lane's first dispatch at this width, or the
+        # first after a cache-flush heal rung — which runs under the
+        # generous ceiling instead: a compile is not a gray failure,
+        # and condemning one would feed the very ladder that caused it.
+        # If the watchdog fires while we are away, the lane was
+        # condemned from the MONITOR thread (rows already failed over
+        # with their resume state) — this thread just exits without
+        # touching the dead row file.
+        epoch = _guard.flush_epoch()
+        if epoch != self._flush_epoch:
+            self._flush_epoch = epoch
+            self._warm_widths.clear()
+        budget = self._sched.hang_budget()
+        if budget is not None and self.width not in self._warm_widths:
+            budget = _guard.hang_budget_s(0.0)  # the cold ceiling
+        ticket = None
+        if budget is not None:
+            ticket = _guard.WATCHDOG.arm(
+                budget, lambda: self.condemn(
+                    f"step {this_step} exceeded its {budget:.1f}s hang "
+                    f"budget"),
+                tag=f"lane-{self.lane_id}-step-{this_step}")
         t0 = time.perf_counter()
-        with annotate("swarm.lane.step"):
-            dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
-                self.pipe.c.params,
-                dev["ctx_u"], dev["ctx_c"], dev["pooled_u"],
-                dev["pooled_c"],
-                dev["x"], dev["keys"], dev["idx"],
-                dev["start"], dev["sig"], dev["ts"], dev["guid"],
-                dev["old"], dev["active"],
-                dev["known"], dev["mask"], dev["mask_on"],
-                ctrl_params, dev["cond"], dev["cscale"],
-            )
+        fired = False
+        try:
+            with annotate("swarm.lane.step"):
+                dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
+                    self.pipe.c.params,
+                    dev["ctx_u"], dev["ctx_c"], dev["pooled_u"],
+                    dev["pooled_c"],
+                    dev["x"], dev["keys"], dev["idx"],
+                    dev["start"], dev["sig"], dev["ts"], dev["guid"],
+                    dev["old"], dev["active"],
+                    dev["known"], dev["mask"], dev["mask_on"],
+                    ctrl_params, dev["cond"], dev["cscale"],
+                )
+            wedge_s = chaos.wedge_at(chaos_step)
+            if wedge_s > 0:  # scripted wedged-compiled-call stand-in
+                log.warning("chaos: wedging lane %d step %d for %.1fs",
+                            self.lane_id, this_step, wedge_s)
+                time.sleep(wedge_s)
+            # throttle: keep at most two dispatched steps in flight
+            # (the depth-2 philosophy of core/chip_pool.py) so the
+            # async queue cannot run away from the device — and
+            # execution errors surface here, inside the containment
+            # try of the driver loop
+            self._window.append(dev["x"])
+            if len(self._window) > 2:
+                self._window.popleft().block_until_ready()
+        finally:
+            if ticket is not None:
+                fired = _guard.WATCHDOG.disarm(ticket)
+        if fired:
+            # the watchdog declared this step hung. condemn() usually
+            # already ran in the monitor thread — but the monitor marks
+            # ``fired`` BEFORE invoking the callback, so a dispatch
+            # returning in that window could reach _fail_all first and
+            # strand the rows with a resume-less LaneRetired. Condemn
+            # from HERE too (idempotent): whichever thread wins, every
+            # job fails over as LaneHung with its checkpoint, and the
+            # hang reaches the device-health ledger exactly once.
+            self.condemn(
+                f"step {this_step} exceeded its hang budget")
+            raise LaneRetired(
+                f"lane {self.lane_id} condemned by the hang watchdog "
+                f"at step {this_step}")
+        self._warm_widths.add(self.width)  # this width's program ran
+        nan_row = chaos.nan_wants(chaos_step)
+        if nan_row is not None:  # scripted trajectory poisoning —
+            # consume the one-shot only when the target row is ACTIVE
+            # with at least one more step boundary before retirement,
+            # so the poison is deterministically caught by the
+            # checkpoint-boundary finite-check (a seam spent on a
+            # padding row or a retiring row was the fleet-gate flake)
+            row = min(max(0, int(nan_row)), self.width - 1)
+            victim = self._rows[row]
+            if (victim is not None and self._h_active[row]
+                    and int(self._h_idx[row]) + 1 < victim.steps
+                    and _guard.consume_chaos("nan")):
+                log.warning("chaos: poisoning lane %d row %d with NaN "
+                            "after step %d", self.lane_id, row,
+                            this_step)
+                dev["x"] = dev["x"].at[row].set(jnp.nan)
         active = int(self._h_active.sum())
         self._h_idx[self._h_active] += 1
         self.steps_executed += 1
         self._sched._count(steps_executed=1, row_steps_active=active,
                            row_steps_padded=self.width - active)
         _LANE_OCCUPANCY.observe(active / self.width, width=str(self.width))
-        # throttle: keep at most two dispatched steps in flight (the
-        # depth-2 philosophy of core/chip_pool.py) so the async queue
-        # cannot run away from the device — and execution errors surface
-        # here, inside the containment try of the driver loop
-        self._window.append(dev["x"])
-        if len(self._window) > 2:
-            self._window.popleft().block_until_ready()
         if self._step_delay > 0:  # chaos seam: stretch lane wall time
             time.sleep(self._step_delay)
         step_s = time.perf_counter() - t0
+        slow_extra = chaos.slow_extra_s(step_s)
+        if slow_extra > 0:  # chaos: the sick-but-alive device
+            time.sleep(slow_extra)
+            step_s += slow_extra
         _STEP_SECONDS.observe(step_s)
         # the overload estimator's lane-path signal (node/overload.py):
-        # job steps x this EWMA floors the predicted service time
-        self._sched.note_step_seconds(step_s)
+        # job steps x this EWMA floors the predicted service time —
+        # and the guard's slow-step health signal AND hang budget read
+        # the same EWMA. The lane's FIRST dispatch compiles (seconds to
+        # minutes); feeding it would poison the EWMA and inflate the
+        # watchdog's hang budget k-fold for many steps — a real wedge
+        # would then sail under the budget. Skip it: the watchdog
+        # already covers the cold window with the ceiling budget.
+        ewma_before = self._sched.step_ewma()
+        if self.steps_executed > 1:
+            self._sched.note_step_seconds(step_s)
+        device_guard = getattr(getattr(self._sched, "slot", None),
+                               "_guard", None)
+        if device_guard is not None:
+            devices = _guard._slot_devices(self._sched.slot)
+            if ewma_before > 0 and step_s > _guard.slow_factor() * \
+                    ewma_before:
+                self._sched._count(steps_slow=1)
+                device_guard.note_slow_step(devices)
+            else:
+                device_guard.note_ok(devices)
 
     def _retire_rows(self) -> None:
         """Retire finished rows (decode dispatched async — it overlaps the
@@ -908,15 +1110,25 @@ class Lane:
                 self._cond.notify_all()
 
     def _maybe_checkpoint(self) -> None:
-        """Snapshot every resident job's per-row state to the worker's
-        checkpoint spool at this step boundary (every ``_ckpt_every``
-        steps). The snapshot is exact resume state: latents, carry PRNG
-        keys, and multistep history at step k — restored rows continue
-        on the bit-identical solo trajectory. Runs in the driver thread,
-        so the device->host reads only stall THIS lane's pipeline (by
+        """Snapshot every resident job's per-row state at this step
+        boundary (every ``_ckpt_every`` steps) — to the worker's
+        checkpoint spool when one is attached (fleet heartbeats, ISSUE
+        6) and ALWAYS to the in-memory twin the guard's condemnation
+        path resumes from (ISSUE 10). The snapshot is exact resume
+        state: latents, carry PRNG keys, and multistep history at step
+        k — restored rows continue on the bit-identical solo
+        trajectory.
+
+        The guard's per-row finite-check rides the SAME device->host
+        transfer: a job whose latents went non-finite is poisoned — it
+        retires with :class:`InvalidOutput` (no checkpoint, no decode,
+        no upload) while its lane peers keep stepping. Runs in the
+        driver thread, so the reads only stall THIS lane's pipeline (by
         one window drain), never the submitters."""
-        if (self._spool is None or self._ckpt_every <= 0
-                or self._dev is None):
+        validate = _guard.validation_enabled()
+        want_ckpt = self._spool is not None or _guard.watchdog_enabled()
+        if (self._ckpt_every <= 0 or self._dev is None
+                or not (validate or want_ckpt)):
             return
         if self.steps_executed % self._ckpt_every:
             return
@@ -926,14 +1138,22 @@ class Lane:
         t0 = time.perf_counter()
         # one transfer for the whole lane, sliced per job below
         x = np.asarray(self._dev["x"])
-        keys = np.asarray(self._dev["keys"])
-        old = np.asarray(self._dev["old"])
+        keys = old = None
         written = 0
+        poisoned: list[_RowJob] = []
         for job in jobs.values():
             sel = list(job.slots)
+            if validate and not np.isfinite(x[sel]).all():
+                poisoned.append(job)
+                continue
             step = int(self._h_idx[sel[0]])
             if step <= job.start_step or step >= job.steps:
                 continue  # nothing to resume yet / rows about to retire
+            if not want_ckpt:
+                continue
+            if keys is None:
+                keys = np.asarray(self._dev["keys"])
+                old = np.asarray(self._dev["old"])
             state = {
                 "version": 1, "kind": "lane",
                 "step": step, "steps": int(job.steps),
@@ -949,15 +1169,40 @@ class Lane:
                 "keys": pack_array(keys[sel]),
                 "old": pack_array(old[sel]),
             }
+            self._ckpt_mem[id(job)] = state
+            if self._spool is None:
+                continue
             try:
                 self._spool.save(job.job_id, state)
                 written += 1
             except OSError as exc:  # durability never fails the lane
                 log.warning("checkpoint for job %s failed: %s",
                             job.job_id, exc)
+        for job in poisoned:
+            self._poison_rows(job)
         if written:
             self._sched._count(checkpoints_written=written)
             _CKPT_SECONDS.observe(time.perf_counter() - t0)
+
+    def _poison_rows(self, job: _RowJob) -> None:
+        """Retire ONE job's rows as numerically poisoned (swarmguard,
+        ISSUE 10): non-finite latents never decode, never upload, and
+        never take the lane's other jobs down — the job's future fails
+        with :class:`InvalidOutput`, which the executor envelopes as a
+        non-fatal ``invalid_output`` (REDISPATCH_KINDS member: a
+        lease-aware hive re-runs it on a different node)."""
+        step = int(self._h_idx[job.slots[0]]) if job.slots else 0
+        self._release_rows(job)
+        self._sched._count(rows_invalid=job.n_rows)
+        if not job.future.done():
+            job.future.set_exception(InvalidOutput(
+                f"job {job.job_id}: non-finite latents at step {step} — "
+                f"row(s) retired without decoding"))
+        log.error("lane %d: job %s poisoned (non-finite latents at step "
+                  "%d); %d row(s) retired invalid_output, peers keep "
+                  "stepping", self.lane_id, job.job_id, step, job.n_rows)
+        with self._cond:
+            self._cond.notify_all()
 
     def _flush_handoff(self, block: bool) -> None:
         """Resolve retired rows whose decoded images are resident. With
@@ -982,6 +1227,7 @@ class Lane:
         for s in job.slots:
             self._rows[s] = None
             self._h_active[s] = False
+        self._ckpt_mem.pop(id(job), None)
         if self._dev is not None:
             self._sync_tables()
 
@@ -1172,6 +1418,30 @@ class StepScheduler:
             self._step_ewma = (float(seconds) if self._step_ewma <= 0.0
                                else self._step_ewma + 0.25 * (
                                    float(seconds) - self._step_ewma))
+
+    def step_ewma(self) -> float:
+        """The step-seconds EWMA (0.0 while cold) — shared by the
+        overload estimator's lane floor and the guard's hang-budget and
+        slow-step signals (serving/guard.py)."""
+        with self._lock:
+            return self._step_ewma
+
+    def hang_budget(self) -> float | None:
+        """Wall-clock budget the watchdog arms around one lane step
+        dispatch (swarmguard, ISSUE 10): k x the step EWMA between the
+        floor/ceiling knobs; the ceiling alone while cold, so a lane's
+        first (compiling) call is never condemned. None = watchdog off
+        (``CHIASWARM_GUARD=0``)."""
+        from chiaswarm_tpu.serving.guard import (
+            hang_budget_s,
+            watchdog_enabled,
+        )
+
+        if not watchdog_enabled():
+            return None
+        with self._lock:
+            ewma = self._step_ewma
+        return hang_budget_s(ewma)
 
     def retire_lanes_for_owner(self, owner_id: int) -> int:
         """Eviction→lane-retire (ISSUE 9 satellite, ROADMAP item 4c

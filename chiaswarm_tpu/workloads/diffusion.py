@@ -148,6 +148,13 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         images, up_config = upscaler(images, prompt=prompt or "", seed=seed)
         config.update(up_config)
 
+    # swarmguard post-decode screen (ISSUE 10): a NaN-poisoned
+    # trajectory must raise invalid_output here, never upload as a
+    # "completed" black frame (serving/guard.py)
+    from chiaswarm_tpu.serving.guard import screen_images
+
+    screen_images(images, context="solo decode")
+
     proc = OutputProcessor(content_type)
     proc.add_images(images)
     if control_image is not None and save_preprocessed_input:
@@ -393,6 +400,13 @@ def stepper_finish(ticket: StepperTicket):
     # the lane decodes at the compiled bucket; un-bucket to the request
     pending.requested_hw = ticket.req_hw
     images = pending.wait()
+    # swarmguard post-decode screen (ISSUE 10): rows whose poisoning
+    # slipped past the checkpoint-boundary finite-check (e.g. a job
+    # retiring between boundaries) are caught here — the envelope says
+    # invalid_output, the garbage frame never uploads
+    from chiaswarm_tpu.serving.guard import screen_images
+
+    screen_images(images, context="lane decode")
     elapsed = time.perf_counter() - ticket.t0
 
     proc = OutputProcessor(ticket.content_type)
@@ -516,6 +530,16 @@ def diffusion_coalesced_callback(slot, model_name: str, *, seed: int,
     t0 = time.perf_counter()
     images, base_config = pipe(req)
     elapsed = time.perf_counter() - t0
+
+    # swarmguard post-decode screen (ISSUE 10): the invariant — no
+    # poisoned frame ever uploads — must hold on the coalesced path
+    # too. Raising fails the WHOLE batched run, and the executor's
+    # fallback re-runs every member per-job (zero-loss): the poisoned
+    # job then gets its precise invalid_output envelope from the solo
+    # screen while healthy peers complete.
+    from chiaswarm_tpu.serving.guard import screen_images
+
+    screen_images(images, context="coalesced decode")
 
     from chiaswarm_tpu.workloads.safety import check_images
 

@@ -8,7 +8,6 @@ UI can hyperlink each cell back to its source job.
 
 from __future__ import annotations
 
-import io
 import math
 from typing import Any
 
@@ -23,13 +22,22 @@ from chiaswarm_tpu.node.output_processor import (
 
 THUMB = 144
 
+#: byte cap for fetched RESULT images: stitch pulls the system's OWN
+#: outputs, and an upscaled 2048px photographic PNG legitimately
+#: exceeds the 3 MiB user-input cap — 32 MiB bounds memory without
+#: rejecting real results (the decoded-dimension bomb guard still
+#: applies underneath)
+MAX_RESULT_BYTES = 32 * 1048576
+
 
 def _fetch_image(url: str) -> Image.Image:
-    import requests
+    # the ISSUE-10 trust-boundary guard set (connect/read timeouts,
+    # streamed byte cap, content-type + decoded-dimension caps) —
+    # stitch inputs are prior RESULT uris, but the fetch still crosses
+    # the open network and deserves the same suspicion
+    from chiaswarm_tpu.node.job_args import download_image
 
-    response = requests.get(url, timeout=30)
-    response.raise_for_status()
-    return Image.open(io.BytesIO(response.content)).convert("RGB")
+    return download_image(url, max_bytes=MAX_RESULT_BYTES)
 
 
 def _thumb_with_label(image: Image.Image, index: int) -> Image.Image:

@@ -1,0 +1,205 @@
+"""Asset-fetch trust-boundary hardening (ISSUE 10 satellite).
+
+``node/job_args.py::download_image``/``get_image`` and
+``workloads/stitch.py::_fetch_image`` pull bytes from hostile parties
+across the open network. These tests run a REAL local HTTP server
+serving crafted hostile fixtures — lying Content-Length, wrong content
+types, bodies streaming past the byte cap, a decompression-bomb PNG
+(tiny compressed bytes, enormous decoded dimensions), and a stalling
+endpoint — and assert the guards reject each one with the right PR-2
+taxonomy kind: ``bad_asset`` (deterministic cap violations, non-fatal)
+vs ``transient`` (network-shaped, locally retried).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from chiaswarm_tpu.node import job_args
+from chiaswarm_tpu.node.job_args import (
+    MAX_IMAGE_BYTES,
+    download_image,
+    get_image,
+)
+from chiaswarm_tpu.node.resilience import (
+    BadAssetError,
+    classify_exception,
+)
+
+
+def _png_bytes(pixels) -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(pixels).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+_OK_PNG = _png_bytes(
+    np.random.default_rng(5).integers(0, 255, (32, 32, 3), dtype=np.uint8))
+
+# a decompression bomb: ~1-bit 6000x6000 (36 Mpx > the 16 Mpx cap)
+# compressing to a few KB — the dimensions are visible before decode
+_BOMB_PNG = _png_bytes(np.zeros((6000, 6000), dtype=bool))
+
+
+class _HostileHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, body: bytes, content_type: str,
+              content_length: int | None = None) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length",
+                         str(len(body) if content_length is None
+                             else content_length))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def do_HEAD(self):
+        self.do_GET(head=True)
+
+    def do_GET(self, head: bool = False):
+        path = self.path
+        if path == "/ok.png":
+            self._send(_OK_PNG, "image/png")
+        elif path == "/not-an-image":
+            self._send(b"<html>gotcha</html>", "text/html")
+        elif path == "/liar-head":
+            # HEAD claims image/png; GET serves text/html — the GET's
+            # own content type must still be checked
+            if self.command == "HEAD":
+                self._send(b"", "image/png")
+            else:
+                self._send(b"<html>switcheroo</html>", "text/html")
+        elif path == "/huge-header":
+            # Content-Length far over the cap (body tiny): HEAD check
+            self._send(_OK_PNG, "image/png",
+                       content_length=MAX_IMAGE_BYTES * 10)
+        elif path == "/oversized-stream":
+            # claims a small Content-Length, streams 4 MiB anyway: the
+            # capped streaming read must cut it off
+            body = b"x" * (MAX_IMAGE_BYTES + 1024 * 1024)
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if not head:
+                self.wfile.write(body)
+        elif path == "/bomb.png":
+            self._send(_BOMB_PNG, "image/png")
+        elif path == "/slow":
+            if self.command == "HEAD":
+                self._send(b"", "image/png")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(_OK_PNG)))
+            self.end_headers()
+            time.sleep(2.0)  # past the test's read timeout
+            try:
+                self.wfile.write(_OK_PNG)
+            except BrokenPipeError:
+                pass
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+@pytest.fixture(scope="module")
+def hostile_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _HostileHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+    server.server_close()
+
+
+def test_happy_path_image_fetches(hostile_server):
+    image = download_image(f"{hostile_server}/ok.png")
+    assert image.size == (32, 32) and image.mode == "RGB"
+    image = get_image(f"{hostile_server}/ok.png", None)
+    assert image.size == (32, 32)
+
+
+def test_wrong_content_type_is_bad_asset(hostile_server):
+    with pytest.raises(BadAssetError) as excinfo:
+        get_image(f"{hostile_server}/not-an-image", None)
+    assert classify_exception(excinfo.value) == "bad_asset"
+
+
+def test_get_content_type_checked_even_after_clean_head(hostile_server):
+    """A host whose HEAD lies clean must still fail on the GET body's
+    own content type."""
+    with pytest.raises(BadAssetError):
+        get_image(f"{hostile_server}/liar-head", None)
+
+
+def test_huge_content_length_header_is_bad_asset(hostile_server):
+    with pytest.raises(BadAssetError) as excinfo:
+        get_image(f"{hostile_server}/huge-header", None)
+    assert "too large" in str(excinfo.value)
+    assert classify_exception(excinfo.value) == "bad_asset"
+
+
+def test_oversized_stream_is_cut_off_not_buffered(hostile_server):
+    """A body streaming past the cap is rejected mid-stream no matter
+    what Content-Length claimed — the worker never buffers it whole."""
+    with pytest.raises(BadAssetError) as excinfo:
+        download_image(f"{hostile_server}/oversized-stream")
+    assert "exceeded the cap" in str(excinfo.value)
+    assert classify_exception(excinfo.value) == "bad_asset"
+
+
+def test_decompression_bomb_rejected_before_decode(hostile_server):
+    """A few-KB PNG claiming 6000x6000 pixels is rejected on its
+    DECLARED dimensions — the bomb never inflates."""
+    assert len(_BOMB_PNG) < 64 * 1024  # genuinely a bomb fixture
+    with pytest.raises(BadAssetError) as excinfo:
+        download_image(f"{hostile_server}/bomb.png")
+    assert "decompression-bomb" in str(excinfo.value)
+    assert classify_exception(excinfo.value) == "bad_asset"
+
+
+def test_read_timeout_classifies_transient(hostile_server, monkeypatch):
+    """A stalling asset host trips the read timeout — a network-shaped
+    fault the ladder retries locally, never a fatal input error."""
+    monkeypatch.setattr(job_args, "READ_TIMEOUT_S", 0.3)
+    with pytest.raises(Exception) as excinfo:
+        download_image(f"{hostile_server}/slow")
+    assert not isinstance(excinfo.value, BadAssetError)
+    assert classify_exception(excinfo.value) == "transient"
+
+
+def test_bad_asset_is_nonfatal_in_the_format_path(hostile_server):
+    """End to end through the executor's _format: a bomb fetched via
+    start_image_uri envelopes as non-fatal ``bad_asset`` (the hive may
+    retry elsewhere), not a fatal input error."""
+    from chiaswarm_tpu.node.executor import _format
+    from chiaswarm_tpu.node.registry import ModelRegistry
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    job = {"id": "bomb-1", "model_name": "tiny", "prompt": "p",
+           "start_image_uri": f"{hostile_server}/bomb.png",
+           "content_type": "application/json"}
+    formatted, fatal = _format(job, registry)
+    assert formatted is None
+    assert "fatal_error" not in fatal
+    assert fatal["pipeline_config"]["error_kind"] == "bad_asset"
+
+
+def test_stitch_fetch_uses_the_guards(hostile_server):
+    from chiaswarm_tpu.workloads.stitch import _fetch_image
+
+    image = _fetch_image(f"{hostile_server}/ok.png")
+    assert image.mode == "RGB"
+    with pytest.raises(BadAssetError):
+        _fetch_image(f"{hostile_server}/bomb.png")

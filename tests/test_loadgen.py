@@ -207,6 +207,16 @@ def test_load_smoke_seeded_zero_loss():
     assert set(capacity["workload_mix"]) <= {p.name
                                              for p in DEFAULT_PROFILES}
     assert report["hive"]["pending"] == 0
+    # the measured suggested-deadline table (ISSUE 10 satellite) rides
+    # every report: per-family p99 x margin over completed-ok jobs
+    suggested = report["suggested_deadlines"]
+    assert suggested["margin"] == loadgen.DEADLINE_MARGIN
+    families = suggested["families"]
+    assert families, suggested
+    for entry in families.values():
+        assert entry["suggested_s"] == pytest.approx(
+            entry["p99_s"] * loadgen.DEADLINE_MARGIN, rel=1e-3)
+        assert entry["n"] > 0
 
 
 def test_overload_gate_10x_mixed_kill():
@@ -265,6 +275,144 @@ def test_overload_gate_10x_mixed_kill():
     # the run itself stays CI-sized: shedding keeps the backlog from
     # serializing 10x load through 3 slots
     assert wall < 180, wall
+
+
+# ---------------------------------------------------------------------------
+# per-model-family deadline tables (ISSUE 10 satellite, ROADMAP 5b)
+# ---------------------------------------------------------------------------
+
+
+def test_family_deadline_defaults_pinned_to_sweep():
+    """The shipped DEFAULT_FAMILY_DEADLINES must equal the default-seed
+    sweep derivation — pinned defaults == winner, the PR-9 convention
+    (a default and the harness can never silently disagree)."""
+    assert loadgen.DEFAULT_FAMILY_DEADLINES == \
+        loadgen.sweep_deadline_table()
+    # sanity of the derivation itself: deterministic per seed, scales
+    # with the family cost factor, margin applied over the p99
+    again = loadgen.sweep_deadline_table()
+    assert again == loadgen.DEFAULT_FAMILY_DEADLINES
+    table = loadgen.DEFAULT_FAMILY_DEADLINES
+    assert table["tiny"] < table["sd15"] < table["sdxl"]
+
+
+def test_model_family_heuristic():
+    assert loadgen.model_family("stabilityai/sdxl-base") == "sdxl"
+    assert loadgen.model_family("tiny") == "tiny"
+    assert loadgen.model_family("swarm/sd15") == "sd15"
+    assert loadgen.model_family(None) == "sd15"
+
+
+def test_worker_honors_family_deadline_override():
+    """The settings-side half: ``family_deadline_s`` slots between a
+    job's explicit deadline_s and the per-workflow table
+    (node/worker.py::_job_deadline_s)."""
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.settings import Settings
+    from chiaswarm_tpu.node.worker import Worker
+
+    class StubSlot:
+        depth = 2
+        data_width = 1
+
+        def descriptor(self):
+            return "stub"
+
+    worker = Worker(
+        settings=Settings(hive_uri="http://h", hive_token="t",
+                          worker_name="deadline-w",
+                          install_signal_handlers=False,
+                          job_deadline_s=600.0,
+                          family_deadline_s={"tiny": 42.0}),
+        pool=[StubSlot()],
+        registry=ModelRegistry(catalog=[], allow_random=True))
+    # family override engages for a catalog-resolvable model name
+    assert worker._job_deadline_s({"model_name": "tiny"}) == 42.0
+    # the job's explicit deadline always wins
+    assert worker._job_deadline_s(
+        {"model_name": "tiny", "deadline_s": 7.5}) == 7.5
+    # a family not in the table falls through to the workflow default
+    # (unknown names resolve to the sd15 family via get_family)
+    assert worker._job_deadline_s(
+        {"model_name": "no/such-family-model"}) == 600.0
+    no_table = Worker(
+        settings=Settings(hive_uri="http://h", hive_token="t",
+                          worker_name="deadline-x",
+                          install_signal_handlers=False,
+                          job_deadline_s=123.0),
+        pool=[StubSlot()],
+        registry=ModelRegistry(catalog=[], allow_random=True))
+    assert no_table._job_deadline_s({"model_name": "tiny"}) == 123.0
+
+
+# ---------------------------------------------------------------------------
+# nightly REAL-lane load soak (ISSUE 10 satellite, ROADMAP 5a):
+# the harness's control-plane numbers meet the compute plane — real
+# tiny-family lanes behind the same worker_factory seam
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_real_lane_load_soak_tiny_family(monkeypatch):
+    """Swap the SyntheticExecutor for REAL tiny-family lanes via the
+    worker_factory seam: a seeded diurnal stream of txt2img jobs runs
+    through two workers with real pools/registries (lanes default-on),
+    every job settles exactly once, and real frames come back."""
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.settings import Settings
+    from chiaswarm_tpu.node.worker import Worker
+
+    monkeypatch.setenv("CHIASWARM_STEPPER", "1")
+    seed = os.environ.get("CHIASWARM_SOAK_SEED", "real-lane-default")
+    jobs_scale = int(os.environ.get("CHIASWARM_SOAK_JOBS", "120"))
+    # real compiles are the cost driver: a handful of jobs exercises
+    # the whole path (poll -> format -> lane -> decode -> upload)
+    profiles = (loadgen.WorkloadProfile("txt2img", 1.0, 60.0, (2, 4),
+                                        0.5),)
+    population = UserPopulation(n_users=50, profiles=profiles,
+                                models=("tiny",),
+                                seed=f"real:{seed}")
+    curve = DiurnalCurve(seed=f"real:{seed}")
+    schedule = generate_schedule(
+        population, curve, duration_s=2.0,
+        rate_jobs_s=max(3.0, jobs_scale / 30.0),
+        seed=f"real:{seed}", id_prefix="real",
+        content_type="image/png")
+    assert schedule, "seeded schedule came out empty"
+
+    def factory(uri: str, name: str) -> Worker:
+        pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                        devices=jax.devices()[:1])
+        return Worker(
+            settings=Settings(
+                hive_uri=uri, hive_token="t", worker_name=name,
+                job_deadline_s=600.0, heartbeat_s=0.1,
+                poll_busy_s=0.02, poll_idle_s=0.05,
+                poll_backoff_base_s=0.02, poll_backoff_cap_s=0.2,
+                upload_retries=5, upload_retry_delay_s=0.02,
+                drain_timeout_s=60.0, result_drain_timeout_s=30.0,
+                install_signal_handlers=False),
+            registry=ModelRegistry(
+                catalog=[{"name": "tiny", "family": "tiny",
+                          "parameters": {}}],
+                allow_random=True),
+            pool=pool)
+
+    report = asyncio.run(run_load(
+        schedule, n_workers=2, worker_factory=factory,
+        seed=f"real:{seed}", lease_s=120.0, max_jobs_per_poll=1,
+        settle_timeout_s=900))
+    rec = report["reconciliation"]
+    assert rec["zero_loss"], rec
+    assert report["outcomes"]["ok"] == len(schedule), report["outcomes"]
+    assert report["capacity"]["jobs_per_s_per_chip"] > 0
+    # the suggested-deadline table now reflects MEASURED tiny-family
+    # latencies — the live refinement of the shipped sweep defaults
+    assert "tiny" in report["suggested_deadlines"]["families"]
 
 
 # ---------------------------------------------------------------------------

@@ -435,6 +435,27 @@ def test_worker_serves_metrics_and_traces_endpoints():
         assert (f'chiaswarm_overload_shed_total{{workload="{workload}"}} 0'
                 in body), workload
     assert "overload" in health and health["overload"]["state"] == "normal"
+    # ...swarmguard families (ISSUE 10, serving/guard.py): hang/rung
+    # counters pre-seeded across their vocabularies, the condemned-lane
+    # and quarantine series at zero, the health/invalid families
+    # declared — all from scrape one, before any gray failure...
+    from chiaswarm_tpu.serving.guard import HANG_PHASES, HEAL_RUNGS
+
+    for phase in HANG_PHASES:
+        assert f'chiaswarm_guard_hangs_total{{phase="{phase}"}} 0' \
+            in body, phase
+    for rung in HEAL_RUNGS:
+        assert f'chiaswarm_guard_heal_rung_total{{rung="{rung}"}} 0' \
+            in body, rung
+    assert "chiaswarm_guard_condemned_lanes_total 0" in body
+    assert "chiaswarm_guard_quarantined_devices 0" in body
+    assert "# TYPE chiaswarm_guard_invalid_outputs_total counter" in body
+    assert "# TYPE chiaswarm_guard_device_health gauge" in body
+    assert "chiaswarm_stepper_lanes_condemned_total 0" in body
+    assert "chiaswarm_stepper_rows_invalid_total 0" in body
+    assert "guard" in health and health["guard"]["enabled"] is True
+    assert health["guard"]["restart_requested"] is False
+    assert "chips_in_service" in health
     # ...compile-cache + hive families from the process registry...
     assert "chiaswarm_compile_cache_misses_total" in body
     assert "# TYPE chiaswarm_compiles_total counter" in body

@@ -44,7 +44,7 @@ from chiaswarm_tpu.analysis.rules import (
     JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes, resolves_to,
 )
 
-SCHEMA = 1
+SCHEMA = 2  # v2: dispatch-table facts ("tables", "@table:" call targets)
 DEFAULT_CACHE_NAME = ".swarmflow-cache.json"
 
 #: cross-chip collective primitives and the axis-name argument position
@@ -227,6 +227,7 @@ class _Summarizer:
             "exports": self.exports,
             "deps": self.deps,
             "constants": self._constants(ctx.tree),
+            "tables": self._dispatch_tables(ctx.tree),
             "functions": functions,
             "names": by_name,
         }
@@ -268,6 +269,15 @@ class _Summarizer:
     def _calls(self, info: FunctionInfo) -> tuple[list[dict], list[str]]:
         calls: list[dict] = []
         methods: list[str] = []
+        # function-local dispatch dicts: ``handlers = {...}`` followed by
+        # ``handlers[k](...)`` expands inline to a call per member
+        local_tables: dict[str, list[str]] = {}
+        for node in own_nodes(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                entries = self._table_entries(node.value)
+                if entries:
+                    local_tables[node.targets[0].id] = entries
         for node in own_nodes(info.node):
             if not isinstance(node, ast.Call):
                 continue
@@ -276,6 +286,24 @@ class _Summarizer:
                     and isinstance(func.value, ast.Name)
                     and func.value.id in ("self", "cls")):
                 methods.append(func.attr)
+                continue
+            if isinstance(func, ast.Subscript) and isinstance(
+                    func.value, (ast.Name, ast.Attribute)):
+                # a dispatch-table call: TABLE[key](...) — expand local
+                # tables inline; module-level (possibly cross-module)
+                # tables defer to the index via an "@table:" target
+                dotted = self.resolve(func.value)
+                if dotted and not dotted.startswith(("self.", "cls.")):
+                    if dotted in local_tables:
+                        for t in local_tables[dotted]:
+                            calls.append({"t": t, "line": node.lineno,
+                                          "np": len(node.args), "kw": {},
+                                          "poslits": {}})
+                    else:
+                        calls.append({"t": "@table:" + dotted,
+                                      "line": node.lineno,
+                                      "np": len(node.args), "kw": {},
+                                      "poslits": {}})
                 continue
             target, consumed = self.callable_target(node)
             if target is None:
@@ -300,6 +328,43 @@ class _Summarizer:
                 "kw": kw, "poslits": poslits,
             })
         return calls, sorted(set(methods))
+
+    def _table_entries(self, value: ast.AST) -> list[str] | None:
+        """Function references in a dict-literal dispatch table, or None
+        when ``value`` is not one. A table is a dict whose VALUES are
+        (at least one) resolvable callables — keys are routing strings
+        and don't matter for reachability."""
+        if not isinstance(value, ast.Dict):
+            return None
+        targets: list[str] = []
+        for v in value.values:
+            if isinstance(v, (ast.Name, ast.Attribute)):
+                dotted = self.resolve(v)
+                if dotted and not dotted.startswith(("self.", "cls.")):
+                    targets.append(dotted)
+        return sorted(set(targets)) or None
+
+    def _dispatch_tables(self, tree: ast.Module) -> dict:
+        """Module-level ``TABLE = {"key": fn, ...}`` dispatch dicts:
+        name -> resolved function refs. ``TABLE[key](...)`` calls were
+        unresolvable edges before (the ROADMAP lint-extension candidate)
+        — R9's call graph now expands them to every member."""
+        tables: dict[str, list[str]] = {}
+        for node in tree.body:
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target.id, node.value
+            if target is None:
+                continue
+            entries = self._table_entries(value)
+            if entries:
+                tables[target] = entries
+        return tables
 
     def _constants(self, tree: ast.Module) -> dict:
         consts: dict[str, Any] = {}
@@ -651,6 +716,27 @@ class ProjectIndex:
             return [got[1]]
         return []
 
+    def table_targets(self, module: str,
+                      dotted: str) -> list[tuple[str, str]]:
+        """Members of a dispatch table referenced as ``dotted`` from
+        ``module`` — the expansion of an ``@table:`` call target. The
+        table may live in this module (bare name) or in another one
+        (import-aliased dotted path), and its VALUES were resolved in
+        the OWNING module's namespace at summarize time."""
+        owner, name = module, dotted
+        if "." in dotted:
+            head, _, tail = dotted.rpartition(".")
+            got = self.resolve_qual(head)
+            if got is None or got[0] != "module":
+                return []
+            owner, name = got[1], tail
+        s = self.summaries.get(self.modules.get(owner, ""), None)
+        entries = (s or {}).get("tables", {}).get(name, [])
+        out: list[tuple[str, str]] = []
+        for target in entries:
+            out.extend(self.func_targets(owner, target))
+        return out
+
     def edges(self) -> dict[tuple[str, str], set[tuple[str, str]]]:
         if self._edges is not None:
             return self._edges
@@ -658,7 +744,15 @@ class ProjectIndex:
         for (module, qual), f in self.funcs.items():
             out: set[tuple[str, str]] = set()
             for call in f["calls"]:
-                if call["t"]:
+                if not call["t"]:
+                    continue
+                if call["t"].startswith("@table:"):
+                    # workload dispatch dicts (R9 extension): a
+                    # TABLE[key](...) call conservatively reaches every
+                    # member of the table
+                    out.update(self.table_targets(
+                        module, call["t"][len("@table:"):]))
+                else:
                     out.update(self.func_targets(module, call["t"]))
             for name in f["methods"]:
                 out.update(self.func_targets(module, name))

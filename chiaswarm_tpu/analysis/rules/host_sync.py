@@ -18,6 +18,17 @@ host), so per-file analysis matches the architecture.
 
 Host-callback escapes (``jax.pure_callback``/``io_callback``/
 ``jax.debug.*``) are exempt: their bodies run on host by design.
+
+Sanctioned-sync allowlist (swarmlens, ISSUE 11): a sync site whose
+source line — or whose immediately preceding comment line — carries the
+marker ``swarmlens: allow-host-sync`` is skipped by BOTH R1 and R9 (the
+rules share :func:`sync_sites`, so they cannot disagree). The marker
+exists for the numerics flight recorder's host-side callback bodies:
+an ``io_callback`` tap's receiver legitimately converts its tiny
+summary payload on host, and without the marker every sanctioned tap
+would become permanent baseline noise. Use it ONLY for code that runs
+on host by design; the marker is grep-able precisely so reviews can
+audit every use.
 """
 
 from __future__ import annotations
@@ -36,6 +47,25 @@ from chiaswarm_tpu.analysis.rules import (
 _SYNC_CALLS = ("jax.device_get", "jax.block_until_ready",
                "numpy.asarray", "numpy.array", "numpy.copy")
 _SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+
+#: sanctioned-host-sync marker (swarmlens taps): on the sync line or the
+#: comment line directly above it
+ALLOW_MARKER = "swarmlens: allow-host-sync"
+
+
+def _allowed_lines(ctx: ModuleContext) -> set[int]:
+    """1-based line numbers whose sync sites are sanctioned: marker on
+    the line itself, or on a standalone comment line directly above
+    (the marker then covers the next code line)."""
+    allowed: set[int] = set()
+    lines = ctx.source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        if ALLOW_MARKER not in text:
+            continue
+        allowed.add(i)
+        if text.lstrip().startswith("#"):
+            allowed.add(i + 1)
+    return allowed
 
 
 @register
@@ -172,10 +202,14 @@ def _local_array_names(ctx: ModuleContext, info: FunctionInfo) -> set[str]:
 def sync_sites(ctx: ModuleContext, info: FunctionInfo):
     """Host-forcing operations in one function (shared with R9: the
     project-level reachability pass taints the same sites, so the two
-    rules can never disagree on what counts as a sync)."""
+    rules can never disagree on what counts as a sync — including the
+    sanctioned-tap allowlist marker, honored here for both)."""
     array_names = _local_array_names(ctx, info)
+    allowed = _allowed_lines(ctx)
     for node in own_nodes(info.node):
         if not isinstance(node, ast.Call):
+            continue
+        if node.lineno in allowed:
             continue
         if _in_callback(ctx, node):
             continue

@@ -36,14 +36,39 @@ def _percentile50(times: list[float]) -> float:
     return sorted(times)[len(times) // 2]
 
 
+def _step_seconds_snapshot() -> dict | None:
+    """Percentiles of the process-cumulative lane step-seconds
+    histogram (swarmlens, ISSUE 11) — None before any lane stepped."""
+    from chiaswarm_tpu.obs.metrics import REGISTRY
+
+    hist = REGISTRY.get("chiaswarm_stepper_step_seconds")
+    if hist is None or not hist.count():
+        return None
+    pct = hist.percentiles((0.5, 0.9, 0.99))
+    if pct is None:
+        return None
+    return dict({k: round(v, 6) for k, v in pct.items()},
+                count=hist.count())
+
+
 def _bench_diffusion(pipe, *, size: int, steps: int, batch: int, iters: int,
                      scheduler: str | None = None, init_image=None,
                      mask=None, controlnet=None, control_image=None,
-                     pipelined: bool = False) -> dict:
+                     pipelined: bool = False, roofline: bool = True) -> dict:
     """Warm once, then measure. ``pipelined=True`` additionally measures
-    steady-state throughput with submit/wait overlap."""
+    steady-state throughput with submit/wait overlap.
+
+    ``roofline=True`` (swarmlens, ISSUE 11) AOT-captures the generate
+    program during the warm call and stamps its static roofline model
+    (modeled FLOPs/bytes, the compute-vs-memory bound, attainment vs
+    the measured p50) into the result — the per-config *where does the
+    chip time go* signal the r06+ BENCH trajectory tracks next to
+    img/s. Peaks are the TPU defaults, so on CPU hosts the attainment
+    percentage is notional while the modeled-work numbers stay exact."""
     import numpy as np
 
+    import chiaswarm_tpu.pipelines.diffusion as diffusion_mod
+    from chiaswarm_tpu.obs import hlocost
     from chiaswarm_tpu.pipelines.diffusion import GenerateRequest
 
     def req(seed: int) -> GenerateRequest:
@@ -55,7 +80,15 @@ def _bench_diffusion(pipe, *, size: int, steps: int, batch: int, iters: int,
             mask=mask, controlnet=controlnet, control_image=control_image,
         )
 
-    imgs, _ = pipe(req(0))  # compile + warm
+    capture = hlocost.ProgramCapture()
+    if roofline:
+        # the warm call is where the cold build happens — capture it;
+        # later calls ride the same AOT executables, so measurement
+        # semantics are unchanged
+        with capture.patching(diffusion_mod):
+            imgs, config = pipe(req(0))
+    else:
+        imgs, config = pipe(req(0))
     assert imgs.shape[0] == batch
 
     times = []
@@ -68,6 +101,15 @@ def _bench_diffusion(pipe, *, size: int, steps: int, batch: int, iters: int,
         "p50_latency_s": round(p50, 3),
         "images_per_sec": round(batch / p50, 4),
     }
+    if roofline:
+        hlo = capture.largest_hlo()
+        if hlo:
+            # fold the while body by the steps the ladder actually ran
+            # (img2img strength truncates the ladder — the observable
+            # denoise_steps contract)
+            out["roofline"] = hlocost.static_program_report(
+                hlo, steps=int(config.get("denoise_steps", steps)),
+                achieved_s=p50)
 
     if pipelined:
         # steady-state: keep one job in flight while fetching the last
@@ -201,6 +243,10 @@ def _bench_mixed_arrival(*, on_tpu: bool, attn: str) -> dict:
             "jobs": len(jobs),
             "steps_mix": steps_mix,
             "stagger_s": round(stagger, 4),
+            # swarmlens (ISSUE 11): the live lane step-latency
+            # distribution — the signal the measured hang budget and
+            # deadline tables derive from
+            "step_seconds": _step_seconds_snapshot(),
             "images_per_sec_continuous": round(len(jobs) / cont_total, 4),
             "images_per_sec_burst_only": round(len(jobs) / burst_total, 4),
             "speedup": round(burst_total / cont_total, 4),
@@ -376,6 +422,7 @@ def _bench_mixed_workloads(*, on_tpu: bool, attn: str) -> dict:
             for kind in ("txt2img", "img2img", "inpaint")}
         return {
             "jobs": len(jobs),
+            "step_seconds": _step_seconds_snapshot(),
             "workload_mix": {k: kinds.count(k) for k in
                              ("txt2img", "img2img", "inpaint")},
             "steps_mix": steps_mix,
@@ -756,6 +803,7 @@ def main() -> None:
     # so a perf regression can be split into "got slower" vs "started
     # recompiling" without rerunning anything
     from chiaswarm_tpu.obs.metrics import REGISTRY
+    from chiaswarm_tpu.serving.guard import suggest_hang_budget
 
     target = 4.0  # images/sec/chip, BASELINE.json north star
     print(json.dumps({
@@ -768,6 +816,11 @@ def main() -> None:
         "attn": attn,
         "backend": jax.default_backend(),
         "configs": configs,
+        # swarmlens (ISSUE 11): whole-run lane step-seconds percentiles
+        # + the MEASURED watchdog-budget suggestion they imply — the
+        # numbers that graduate the PR-10 hang-budget priors
+        "step_seconds_percentiles": _step_seconds_snapshot(),
+        "suggested_hang_budget": suggest_hang_budget(),
         "metrics": REGISTRY.snapshot(),
     }))
 

@@ -100,6 +100,17 @@ COMPAT_TABLE: dict[str, CompatEntry] = {
         pinned="jax.profiler.stop_trace",
         note="route through compat.profiler_stop_trace (no-op fallback)",
     ),
+    # io_callback lives under jax.experimental on the pin and graduates
+    # to jax.io_callback on modern jax — and it is the swarmlens
+    # numerics-tap emission primitive (obs/numerics.py), so serving code
+    # needs ONE sanctioned spelling that survives the move
+    "jax.experimental:io_callback": CompatEntry(
+        symbol="io_callback",
+        modern="jax.io_callback",
+        pinned="jax.experimental.io_callback",
+        note="graduates out of jax.experimental on modern jax; route "
+             "through compat so the numerics taps survive a pin bump",
+    ),
 }
 
 #: ``jax.experimental`` submodules that modules may import at module scope
@@ -187,6 +198,16 @@ def _resolve_profiler_stop_trace():
         return lambda *a, **k: None
 
 
+def _resolve_io_callback():
+    import jax
+
+    if hasattr(jax, "io_callback"):  # modern jax: graduated export
+        return jax.io_callback
+    from jax.experimental import io_callback as cb
+
+    return cb
+
+
 _LAZY = {
     "shard_map": _resolve_shard_map,
     "axis_size": _resolve_axis_size,
@@ -194,6 +215,7 @@ _LAZY = {
     "profiler_trace": _resolve_profiler_trace,
     "profiler_start_trace": _resolve_profiler_start_trace,
     "profiler_stop_trace": _resolve_profiler_stop_trace,
+    "io_callback": _resolve_io_callback,
 }
 _cache: dict[str, object] = {}
 

@@ -150,7 +150,14 @@ def static_cache_key(owner: int, tag: str, static: dict) -> tuple:
 
     Shared by every pipeline's ``_get_fn`` (diffusion/upscale/cascade/
     audio) so dataclass-valued statics (sampler configs, ...) normalize the
-    same way everywhere — including nested dataclasses and containers."""
+    same way everywhere — including nested dataclasses and containers.
+
+    swarmlens (ISSUE 11): while ``CHIASWARM_NUMERICS`` enables any
+    probe, the live tap fingerprint is appended — a program traced with
+    taps must never be served to (or from) a taps-off cache slot, and a
+    probe-filter change retraces. With numerics OFF (the default) the
+    key is byte-identical to the historical 3-tuple, so the taps-off
+    invariance gate can hold trivially."""
 
     def norm(v: Any) -> Hashable:
         if dataclasses.is_dataclass(v) and not isinstance(v, type):
@@ -163,8 +170,13 @@ def static_cache_key(owner: int, tag: str, static: dict) -> tuple:
             return tuple(norm(x) for x in v)
         return v
 
-    return (owner, tag, tuple(sorted((k, norm(v))
-                                     for k, v in static.items())))
+    key = (owner, tag, tuple(sorted((k, norm(v))
+                                    for k, v in static.items())))
+    from chiaswarm_tpu.obs import numerics
+
+    if numerics.enabled():
+        key = key + (("numerics", numerics.fingerprint()),)
+    return key
 
 
 def bucket_batch(n: int) -> int:

@@ -70,6 +70,14 @@ from chiaswarm_tpu.node.resilience import classify_result
 log = logging.getLogger("chiaswarm.loadgen")
 
 
+def _suggest_hang_budget() -> dict:
+    """The guard's measured hang-budget derivation over THIS process's
+    step-seconds histogram (import deferred: loadgen is host-only)."""
+    from chiaswarm_tpu.serving.guard import suggest_hang_budget
+
+    return suggest_hang_budget()
+
+
 def percentile(values: Sequence[float], q: float) -> float:
     """Nearest-rank percentile (q in [0, 1]) of an unsorted sequence;
     0.0 for an empty one. Shared by the scorer and the BENCH config so
@@ -768,6 +776,12 @@ def score_run(hive: LoadHive, issued: Sequence[str], workers: Sequence[Any],
                 for family, values in sorted(family_latencies.items())
             },
         },
+        # measured watchdog-knob suggestion (swarmlens, ISSUE 11): from
+        # the process-global step-seconds histogram — populated by runs
+        # that drive REAL lanes (the nightly real-lane soak); synthetic
+        # executors step no lanes, so those runs report measured=False
+        # rather than inventing numbers from simulated service times
+        "suggested_hang_budget": _suggest_hang_budget(),
         "workers": {w.settings.worker_name: _worker_snapshot(w)
                     for w in workers},
         "hive": hive.stats(),

@@ -80,6 +80,7 @@ from chiaswarm_tpu.serving.guard import (
     GUARD_RESTART_EXIT_CODE,
     DeviceGuard,
     _slot_devices,
+    suggest_hang_budget,
 )
 
 log = logging.getLogger("chiaswarm.worker")
@@ -567,6 +568,12 @@ class Worker:
         # in-service chip count so a quarantine's capacity shrink is
         # visible next to the static device total
         data["guard"] = self.guard.snapshot()
+        # swarmlens (ISSUE 11): the MEASURED hang-budget suggestion
+        # derived from this process's chiaswarm_stepper_step_seconds
+        # histogram — closes the "watchdog knobs are priors, not
+        # measurements" carry-over: a real deployment reads its
+        # suggested factor/floor/ceiling here
+        data["guard"]["suggested_hang_budget"] = suggest_hang_budget()
         data["chips_in_service"] = sum(
             len(_slot_devices(slot)) or 1 for slot in self.pool)
         # overload control (ISSUE 9): admission-estimator state next to
@@ -697,6 +704,25 @@ class Worker:
             # as-is at https://ui.perfetto.dev
             return web.json_response(self.traces.to_chrome())
 
+        async def numerics_endpoint(request):
+            # swarmlens flight recorder (ISSUE 11): the bounded ring of
+            # per-probe summaries, filterable by probe prefix; the
+            # payload documents enablement so "empty because off" and
+            # "empty because nothing tapped" read differently
+            from chiaswarm_tpu.obs import numerics as obs_numerics
+
+            limit = None
+            try:
+                if request.query.get("limit"):
+                    limit = int(request.query["limit"])
+            except ValueError:
+                return web.json_response(
+                    {"status": "error",
+                     "error": "limit must be an integer"}, status=400)
+            return web.json_response(obs_numerics.debug_payload(
+                probe_prefix=request.query.get("probe") or None,
+                limit=limit))
+
         async def profile_endpoint(request):
             try:
                 seconds = float(request.query.get("seconds", "5"))
@@ -717,6 +743,7 @@ class Worker:
         app.router.add_get("/metrics", metrics_endpoint)
         app.router.add_get("/debug/traces", traces_endpoint)
         app.router.add_get("/debug/profile", profile_endpoint)
+        app.router.add_get("/debug/numerics", numerics_endpoint)
         runner = web.AppRunner(app)
         await runner.setup()
         # loopback by default: the endpoint is operator observability,
@@ -727,7 +754,8 @@ class Worker:
         bound_port = runner.addresses[0][1] if runner.addresses else port
         self.health_address = (host, bound_port)
         log.info("health endpoints on %s:%d (/healthz /metrics "
-                 "/debug/traces /debug/profile)", host, bound_port)
+                 "/debug/traces /debug/profile /debug/numerics)",
+                 host, bound_port)
         return runner
 
     # ---- tasks ----

@@ -1,6 +1,6 @@
-"""swarmscope — the unified observability subsystem (stdlib-only).
+"""swarmscope + swarmlens — the unified observability subsystem.
 
-Three layers, one vocabulary (ISSUE 4):
+Five layers, one vocabulary (ISSUE 4 + ISSUE 11):
 
 - ``metrics``   — Prometheus-style :class:`Registry` of counters /
                   gauges / histograms; ``/metrics`` exposition, BENCH
@@ -13,11 +13,20 @@ Three layers, one vocabulary (ISSUE 4):
                   ``TraceAnnotation`` names for the serving hot paths
                   and on-demand XLA captures (``/debug/profile``,
                   ``CHIASWARM_PROFILE_DIR``).
+- ``numerics``  — the swarmlens flight recorder (ISSUE 11): named
+                  probes compiled INTO jitted programs behind
+                  ``CHIASWARM_NUMERICS`` (env off = identity at trace
+                  time), per-step per-shard summaries in a bounded
+                  ring at ``/debug/numerics``, and the stream format
+                  ``tools/divergence_bisect.py`` aligns.
+- ``hlocost``   — the static HLO cost model (conv/dot/flash FLOPs, HBM
+                  bytes, roofline attainment) shared by
+                  ``tools/op_roofline.py`` and the BENCH stamping.
 
 Like ``analysis/``, this package imports without jax, aiohttp, or any
 accelerator — host tools, the linter environment, and CI jobs can load
 it anywhere. Instrumentation is always-on and allocation-light;
-profiler capture is opt-in.
+profiler capture and numerics taps are opt-in.
 """
 
 from chiaswarm_tpu.obs.metrics import (  # noqa: F401
@@ -49,4 +58,12 @@ from chiaswarm_tpu.obs.profiling import (  # noqa: F401
     capture,
     job_profile,
     profiler_available,
+)
+from chiaswarm_tpu.obs.numerics import (  # noqa: F401
+    RING,
+    TAPS,
+    NumericsRing,
+    TapRegistry,
+    numerics_enabled,
+    tap,
 )

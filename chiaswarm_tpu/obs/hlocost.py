@@ -1,0 +1,569 @@
+"""Static HLO cost model + roofline attainment (swarmlens, ISSUE 11).
+
+Extracted from ``tools/op_roofline.py`` (which is now a thin CLI over
+this module) so roofline attainment is an importable SIGNAL instead of
+a one-off script: ``benchmark.py`` stamps per-config attainment into
+BENCH json, tests cost canned HLO fixtures without a TPU, and the CLI
+keeps printing the per-fusion table.
+
+Three layers:
+
+- **parsing/costing** — :func:`parse_hlo_text` statically costs every
+  fusion / bare conv / dot / flash custom-call in a scheduled-HLO dump:
+  conv FLOPs from window/dim_labels/feature_group_count, dot FLOPs from
+  contracting dims, flash FLOPs from the folded (B*H, L, D) operands,
+  HBM bytes as operands+result touched once. Each entry also records
+  its enclosing computation, and :func:`while_body_computations` names
+  the computations executed once per loop trip — so a denoise scan's
+  per-step work can be folded N times into a whole-program bound.
+- **measured attainment** — :func:`collect_op_times` reads per-op
+  device durations from a profiler xplane dump (TPU only) and
+  :func:`attainment_rows` joins them against the static costs:
+  achieved TFLOP/s, both roofline components, percent-of-roofline per
+  fusion (``tools/op_roofline.py``'s table).
+- **static attainment** — :func:`static_program_report` needs no
+  profiler: the program's modeled FLOPs/bytes and its roofline lower
+  bound (sum over fusions of max(compute time, memory time)), compared
+  against a measured wall time. This is what BENCH stamps per config —
+  on CPU hosts the TPU peak numbers make the percentage notional, but
+  the schema and the modeled-work numbers are stable across rounds, so
+  the r06+ trajectory can track *where the chip time goes*.
+
+Peaks default to TPU v5e (197 bf16 TFLOP/s, 819 GB/s), overridable via
+``CHIASWARM_PEAK_TFLOPS`` / ``CHIASWARM_PEAK_GBPS`` or keyword args.
+Pure stdlib at import (jax only inside :func:`collect_op_times` /
+:class:`ProgramCapture`), like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from typing import Any, Callable, Iterable
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+}
+
+_SHAPE_RE = re.compile(r"\b(pred|[su]\d+|bf16|f16|f32|f64)\[([\d,]*)\]")
+_NAME_RE = re.compile(r"%([\w.-]+)")
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(.+)$")
+
+
+def default_peaks() -> tuple[float, float]:
+    """(peak TFLOP/s, peak GB/s) from env or the TPU v5e defaults."""
+    return (float(os.environ.get("CHIASWARM_PEAK_TFLOPS", "197")),
+            float(os.environ.get("CHIASWARM_PEAK_GBPS", "819")))
+
+
+def _shape_dims(dtype_dims: tuple[str, str]):
+    dtype, dims = dtype_dims
+    return dtype, [int(d) for d in dims.split(",") if d]
+
+
+def _shape_bytes(dtype: str, dims: list[int]) -> int:
+    return math.prod(dims, start=1) * _DTYPE_BYTES.get(dtype, 4)
+
+
+def build_shape_map(text: str) -> dict[str, tuple[str, list[int]]]:
+    """instruction name -> (dtype, dims) of its (first) result shape.
+
+    Scheduled HLO prints operands as bare ``%names`` (no inline shapes),
+    so operand shapes must be resolved through the defining instruction.
+    """
+    shape_map: dict[str, tuple[str, list[int]]] = {}
+    for line in text.splitlines():
+        d = _DEF_RE.match(line)
+        if not d:
+            continue
+        m = _SHAPE_RE.search(d.group(2))
+        if m:
+            shape_map[d.group(1)] = _shape_dims(m.groups())
+    return shape_map
+
+
+def operand_shapes(line: str, opcode: str,
+                   shape_map) -> list[tuple[str, list[int]]]:
+    """(dtype, dims) of each operand of ``opcode`` on ``line`` — inline
+    shapes when the printer emitted them, the definition map otherwise."""
+    start = line.find(opcode + "(")
+    if start < 0:
+        return []
+    seg = line[start + len(opcode) + 1:]
+    # the operand list ends at the first ")" outside {} layout braces and
+    # outside nested "(" groups (tuple-typed inline shapes)
+    brace = paren = 0
+    end = len(seg)
+    for i, ch in enumerate(seg):
+        if ch == "{":
+            brace += 1
+        elif ch == "}":
+            brace -= 1
+        elif brace == 0 and ch == "(":
+            paren += 1
+        elif brace == 0 and ch == ")":
+            if paren:
+                paren -= 1
+            else:
+                end = i
+                break
+    seg = seg[:end]
+    inline = _SHAPE_RE.findall(seg)
+    names = _NAME_RE.findall(seg)
+    if inline and len(inline) >= len(names):
+        return [_shape_dims(s) for s in inline]
+    return [shape_map[n] for n in names if n in shape_map]
+
+
+def conv_flops(line: str, shape_map) -> float:
+    """FLOPs of one HLO convolution instruction (per execution):
+    2 * out_elems * window_elems * in_features / feature_group_count."""
+    m = _SHAPE_RE.search(line.split("=", 1)[-1])
+    if not m:
+        return 0.0
+    _, out_dims = _shape_dims(m.groups())
+    out_elems = math.prod(out_dims, start=1)
+
+    window = re.search(r"window={[^}]*?size=([\dx]+)", line)
+    window_elems = 1
+    if window:
+        for d in window.group(1).split("x"):
+            window_elems *= int(d)
+
+    labels = re.search(r"dim_labels=(\S+?)->", line)
+    groups = re.search(r"feature_group_count=(\d+)", line)
+    group_n = int(groups.group(1)) if groups else 1
+
+    in_features = 1
+    operands = operand_shapes(line, "convolution", shape_map)
+    if labels and len(operands) >= 2:
+        lhs_rhs = labels.group(1).split("_")
+        if len(lhs_rhs) == 2:
+            rhs_spec = lhs_rhs[1]  # e.g. "01io"
+            rhs_dims = operands[1][1]
+            i_pos = rhs_spec.find("i")
+            if 0 <= i_pos < len(rhs_dims):
+                in_features = rhs_dims[i_pos]
+    return 2.0 * out_elems * window_elems * in_features / group_n
+
+
+def dot_flops(line: str, shape_map) -> float:
+    """FLOPs of one HLO dot: 2 * out_elems * prod(contracting dims)."""
+    m = _SHAPE_RE.search(line.split("=", 1)[-1])
+    if not m:
+        return 0.0
+    _, out_dims = _shape_dims(m.groups())
+    out_elems = math.prod(out_dims, start=1)
+    contract = re.search(r"lhs_contracting_dims={([\d,]*)}", line)
+    operands = operand_shapes(line, "dot", shape_map)
+    k = 1
+    if contract and contract.group(1) and operands:
+        lhs_dims = operands[0][1]
+        for idx in contract.group(1).split(","):
+            i = int(idx)
+            if i < len(lhs_dims):
+                k *= lhs_dims[i]
+    return 2.0 * out_elems * k
+
+
+def flash_flops(line: str, shape_map) -> float:
+    """Attention FLOPs of a flash custom call: 2*BH*L*S*D for QK^T plus
+    the same for PV — 4*BH*L*S*D. The kernel folds heads into the lead
+    dim and pads L/S to its block lattice, so operands are
+    (B*H, L_pad, D) (ops/flash_attention.py) — padded work is real
+    compute and is costed as such."""
+    operands = [dims for _, dims in
+                operand_shapes(line, "custom-call", shape_map)
+                if len(dims) == 3]
+    if len(operands) < 2:
+        return 0.0
+    bh, l, d = operands[0]
+    s = operands[1][1]
+    return 4.0 * bh * l * s * d
+
+
+def io_bytes(line: str, opcode: str, shape_map) -> int:
+    """HBM traffic estimate of one instruction: result + operand shapes,
+    each touched once."""
+    total = 0
+    m = _SHAPE_RE.search(line.split("=", 1)[-1])
+    if m:
+        total += _shape_bytes(*_shape_dims(m.groups()))
+    for dtype, dims in operand_shapes(line, opcode, shape_map):
+        total += _shape_bytes(dtype, dims)
+    return total
+
+
+_COMP_HEADER_RE = re.compile(
+    r"\s*(?:ENTRY\s+)?%?([\w.-]+)\s+\(.*\)\s*->\s*.+\{\s*$")
+
+
+def called_computations(text: str) -> set[str]:
+    """Computation names referenced by ``calls=`` (fused computations).
+    Instructions INSIDE them also parse as bare conv/dot rows — fine for
+    the measured join (the profiler only emits fusion names) but a
+    double count for a static whole-program sum, which must skip them."""
+    return {m.group(1)
+            for m in re.finditer(r"calls=%?([\w.-]+)", text)}
+
+
+def while_body_computations(text: str) -> set[str]:
+    """Computation names executed once per while-loop trip (body AND
+    condition) — the denoise scan's per-step region. Instructions
+    costed inside these computations should be folded by the trip
+    count when modeling a whole program."""
+    bodies: set[str] = set()
+    for line in text.splitlines():
+        if re.search(r"\bwhile\(", line):
+            for field in ("body", "condition"):
+                m = re.search(field + r"=%?([\w.-]+)", line)
+                if m:
+                    bodies.add(m.group(1))
+    return bodies
+
+
+def parse_hlo_text(text: str) -> dict[str, dict]:
+    """fusion/conv/dot name -> {flops, bytes, kind, computation} from
+    scheduled HLO. ``computation`` is the enclosing computation name
+    ("" at module scope) — join against
+    :func:`while_body_computations` to find per-loop-trip work."""
+    shape_map = build_shape_map(text)
+
+    # computation name -> [total conv+dot flops inside it, kind]
+    comp_flops: dict[str, list] = {}
+    current = None
+    for line in text.splitlines():
+        header = _COMP_HEADER_RE.match(line)
+        if header:
+            current = header.group(1)
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        if current is None:
+            continue
+        if " convolution(" in line:
+            entry = comp_flops.setdefault(current, [0.0, "conv"])
+            entry[0] += conv_flops(line, shape_map)
+        elif re.search(r"\bdot\(", line):
+            entry = comp_flops.setdefault(current, [0.0, "dot"])
+            entry[0] += dot_flops(line, shape_map)
+            if entry[1] == "conv":
+                entry[1] = "mixed"
+
+    fusions: dict[str, dict] = {}
+    current = None
+    for line in text.splitlines():
+        header = _COMP_HEADER_RE.match(line)
+        if header:
+            current = header.group(1)
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        comp = current or ""
+        m = re.match(r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*.*?\bfusion\(",
+                     line)
+        if not m:
+            # bare convs/dots outside fusions still deserve a row
+            b = re.match(
+                r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*.*?\b"
+                r"(convolution|dot)\(", line)
+            if b:
+                op = b.group(2)
+                flops = (conv_flops(line, shape_map)
+                         if op == "convolution"
+                         else dot_flops(line, shape_map))
+                fusions[b.group(1)] = {
+                    "flops": flops,
+                    "bytes": io_bytes(line, op, shape_map),
+                    "kind": "conv" if op == "convolution" else "dot",
+                    "computation": comp}
+            elif "custom-call" in line and "flash_attention" in line:
+                c = re.match(r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=", line)
+                if c:
+                    fusions[c.group(1)] = {
+                        "flops": flash_flops(line, shape_map),
+                        "bytes": io_bytes(line, "custom-call", shape_map),
+                        "kind": "flash",
+                        "computation": comp}
+            continue
+        name = m.group(1)
+        called = re.search(r"calls=%?([\w.-]+)", line)
+        flops, kind = 0.0, "other"
+        if called and called.group(1) in comp_flops:
+            flops, kind = comp_flops[called.group(1)]
+        # HBM traffic estimate: every operand + the result, touched once
+        # (fusions stream operands from HBM exactly once)
+        fusions[name] = {"flops": flops,
+                         "bytes": io_bytes(line, "fusion", shape_map),
+                         "kind": kind,
+                         "computation": comp}
+    return fusions
+
+
+# ---------------------------------------------------------------------------
+# measured attainment (profiler join — TPU hosts)
+# ---------------------------------------------------------------------------
+
+
+def collect_op_times(xplane_path: str) -> dict[str, dict]:
+    """op name -> {total_ps, count} from the TPU device plane."""
+    from jax.profiler import ProfileData
+
+    pd = ProfileData.from_file(xplane_path)
+    times: dict[str, dict] = {}
+    for plane in pd.planes:
+        if not plane.name.startswith("/device:TPU"):
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for event in line.events:
+                stats = dict(event.stats)
+                dur = stats.get("device_duration_ps")
+                if dur is None:
+                    continue
+                name = event.name.split(" = ")[0].lstrip("%")
+                entry = times.setdefault(
+                    name, {"total_ps": 0, "count": 0,
+                           "signature": event.name})
+                entry["total_ps"] += int(dur)
+                entry["count"] += 1
+    return times
+
+
+def is_container_op(name: str) -> bool:
+    """A while/conditional event SPANS its body ops, which also appear
+    on the same profiler line — counting both would double-book time."""
+    return name.split(".")[0] in ("while", "conditional", "call")
+
+
+def attainment_rows(times: dict[str, dict], costs: dict[str, dict], *,
+                    peak_tflops: float, peak_gbps: float) -> list[dict]:
+    """Join measured per-op durations against static costs: one row per
+    op with achieved TFLOP/s, the binding roofline side, and
+    percent-of-roofline, sorted heaviest-first."""
+    total_ps = sum(t["total_ps"] for name, t in times.items()
+                   if not is_container_op(name))
+    rows = []
+    for name, t in times.items():
+        if is_container_op(name):
+            continue
+        cost = costs.get(name) or {}
+        secs = t["total_ps"] * 1e-12
+        flops = cost.get("flops", 0.0) * t["count"]
+        bts = cost.get("bytes", 0) * t["count"]
+        t_compute = flops / (peak_tflops * 1e12)
+        t_bw = bts / (peak_gbps * 1e9)
+        t_roof = max(t_compute, t_bw)
+        kind = cost.get("kind", "other")
+        if kind == "other" and "flash" in name:
+            kind = "flash"
+        rows.append({
+            "name": name, "kind": kind, "count": t["count"],
+            "ms": secs * 1e3,
+            "gflop": flops / 1e9, "mb": bts / 1e6,
+            "tflops": (flops / secs / 1e12) if secs else 0.0,
+            "bound": "flops" if t_compute >= t_bw else "hbm",
+            "roof_pct": (100.0 * t_roof / secs) if secs else 0.0,
+            "share_pct": 100.0 * t["total_ps"] / max(total_ps, 1),
+        })
+    rows.sort(key=lambda r: -r["ms"])
+    return rows
+
+
+def conv_attainment_summary(rows: list[dict]) -> dict:
+    """Time-weighted conv-fusion roofline attainment over the SANELY
+    costed rows. A fusion whose static cost model exceeds its measured
+    time by >1.2x is MIS-COSTED (e.g. a multi-conv fusion
+    double-counted, or a rematerialized op the profiler books
+    elsewhere) — folding it into the average would report >100%
+    nonsense; it is counted separately instead."""
+    conv_rows = [r for r in rows if r["kind"] in ("conv", "mixed")]
+    conv_ms = sum(r["ms"] for r in conv_rows)
+    sane = [r for r in conv_rows if r["roof_pct"] <= 120.0]
+    sane_ms = sum(r["ms"] for r in sane)
+    weighted = (sum(r["roof_pct"] * r["ms"] for r in sane)
+                / max(sane_ms, 1e-9))
+    total_ms = sum(r["ms"] for r in rows)
+    return {
+        "total_ms": total_ms,
+        "conv_ms": conv_ms,
+        "conv_share_pct": 100.0 * conv_ms / max(total_ms, 1e-9),
+        "weighted_conv_roof_pct": weighted,
+        "sane_ms": sane_ms,
+        "miscosted_fusions": len(conv_rows) - len(sane),
+        "miscosted_ms": conv_ms - sane_ms,
+    }
+
+
+# ---------------------------------------------------------------------------
+# static attainment (no profiler — the BENCH stamping)
+# ---------------------------------------------------------------------------
+
+
+def static_program_report(hlo_text: str, *, steps: int = 1,
+                          peak_tflops: float | None = None,
+                          peak_gbps: float | None = None,
+                          achieved_s: float | None = None,
+                          top: int = 5) -> dict:
+    """Whole-program roofline model from HLO text alone.
+
+    ``steps`` folds instructions inside while-loop bodies (the denoise
+    scan executes its body once per step; static HLO prints it once).
+    ``achieved_s`` (a measured wall time for one program execution)
+    turns the modeled bound into an attainment percentage; without it
+    only the modeled quantities are reported."""
+    if peak_tflops is None or peak_gbps is None:
+        d_tflops, d_gbps = default_peaks()
+        peak_tflops = peak_tflops or d_tflops
+        peak_gbps = peak_gbps or d_gbps
+    costs = parse_hlo_text(hlo_text)
+    loop_comps = while_body_computations(hlo_text)
+    fused_comps = called_computations(hlo_text)
+    total_flops = total_bytes = 0.0
+    bound_s = compute_s = memory_s = 0.0
+    heaviest: list[dict] = []
+    for name, cost in costs.items():
+        if cost.get("computation") in fused_comps:
+            continue  # costed via the fusion row that calls it
+        count = steps if cost.get("computation") in loop_comps else 1
+        flops = cost["flops"] * count
+        bts = cost["bytes"] * count
+        t_c = flops / (peak_tflops * 1e12)
+        t_b = bts / (peak_gbps * 1e9)
+        total_flops += flops
+        total_bytes += bts
+        compute_s += t_c
+        memory_s += t_b
+        bound_s += max(t_c, t_b)
+        heaviest.append({
+            "name": name, "kind": cost["kind"], "count": count,
+            "gflop": round(flops / 1e9, 3), "mb": round(bts / 1e6, 3),
+            "bound_ms": round(max(t_c, t_b) * 1e3, 4),
+            "bound": "flops" if t_c >= t_b else "hbm",
+        })
+    heaviest.sort(key=lambda r: -r["bound_ms"])
+    report = {
+        "modeled_gflop": round(total_flops / 1e9, 3),
+        "modeled_gb": round(total_bytes / 1e9, 4),
+        "roofline_bound_s": round(bound_s, 9),
+        "bound": "flops" if compute_s >= memory_s else "hbm",
+        "steps_folded": int(steps),
+        "loop_computations": len(loop_comps),
+        "costed_ops": len(costs),
+        "heaviest": heaviest[:top],
+        "peaks": {"tflops": peak_tflops, "gbps": peak_gbps},
+    }
+    if achieved_s is not None and achieved_s > 0:
+        report["achieved_s"] = round(float(achieved_s), 6)
+        report["attainment_pct"] = round(
+            100.0 * bound_s / float(achieved_s), 2)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# program capture (AOT-compile seam for benchmark.py / op_roofline.py)
+# ---------------------------------------------------------------------------
+
+
+def compiled_hlo_text(compiled: Any) -> str:
+    """Post-optimization HLO of a jax Compiled object, across backends:
+    CPU exposes ``as_text``; the TPU plugin's scheduled HLO comes from
+    ``runtime_executable().get_hlo_text()`` (the exact text the chip
+    runs, which op_roofline joins against profiler op names)."""
+    runtime = getattr(compiled, "runtime_executable", None)
+    if callable(runtime):
+        try:
+            return runtime().get_hlo_text()
+        except Exception:
+            pass
+    return compiled.as_text()
+
+
+class ProgramCapture:
+    """AOT-capturing stand-in for ``toplevel_jit``: patch it into a
+    pipeline module so every top-level program the pipeline builds is
+    compiled via ``.lower().compile()`` and its executable is kept for
+    HLO extraction. Executables are keyed per input-shape signature, so
+    a wrapper reused across shapes (stepper lattice programs) recompiles
+    per signature exactly like the real jit would.
+
+    Usage::
+
+        cap = ProgramCapture()
+        with cap.patching(diffusion_mod):
+            pipe(req)                       # compile + run as usual
+        hlo = cap.largest_hlo()             # the generate program
+    """
+
+    def __init__(self, real_toplevel_jit: Callable | None = None) -> None:
+        if real_toplevel_jit is None:
+            from chiaswarm_tpu.core.compile_cache import toplevel_jit
+            real_toplevel_jit = toplevel_jit
+        self._real = real_toplevel_jit
+        self.executables: list[Any] = []
+        self._mark = 0
+
+    def capturing_toplevel_jit(self, fn, **kwargs):
+        jitted = self._real(fn, **kwargs)
+        compiled_by_sig: dict[tuple, Any] = {}
+
+        def signature(args):
+            return tuple(
+                (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+                if hasattr(a, "shape") else type(a).__name__
+                for a in args)
+
+        def wrapper(*args):
+            sig = signature(args)
+            compiled = compiled_by_sig.get(sig)
+            if compiled is None:
+                compiled = jitted.lower(*args).compile()
+                compiled_by_sig[sig] = compiled
+                self.executables.append(compiled)
+            return compiled(*args)
+
+        return wrapper
+
+    def patching(self, *modules):
+        """Context manager: swap each module's ``toplevel_jit`` binding
+        for the capturing wrapper (pipelines import the NAME, so the
+        module attribute — not compile_cache — is what must change)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            saved = [(m, m.toplevel_jit) for m in modules]
+            for m in modules:
+                m.toplevel_jit = self.capturing_toplevel_jit
+            try:
+                yield self
+            finally:
+                for m, real in saved:
+                    m.toplevel_jit = real
+
+        return cm()
+
+    def mark(self) -> list[Any]:
+        """Executables captured since the previous mark (per-config
+        attribution in a multi-config bench run)."""
+        fresh = self.executables[self._mark:]
+        self._mark = len(self.executables)
+        return fresh
+
+    def largest_hlo(self, executables: Iterable[Any] | None = None) -> str | None:
+        """The longest HLO text among captured executables — in a
+        pipeline build that is the end-to-end generate program."""
+        pool = list(self.executables if executables is None
+                    else executables)
+        texts = []
+        for compiled in pool:
+            try:
+                texts.append(compiled_hlo_text(compiled))
+            except Exception:
+                continue
+        return max(texts, key=len) if texts else None

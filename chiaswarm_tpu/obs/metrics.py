@@ -201,6 +201,51 @@ class Histogram(_Metric):
         with self._lock:
             return self._sums.get(self._key(labels), 0.0)
 
+    def percentile(self, q: float, **labels: Any) -> float | None:
+        """Estimate the q-quantile (q in [0, 1]) from the bucket counts
+        by linear interpolation inside the covering bucket — the
+        Prometheus ``histogram_quantile`` estimate, computed locally.
+
+        Returns None for an empty series. Mass above the last finite
+        bucket clamps to that bound (the estimate cannot exceed what
+        the buckets resolve), so pick buckets that cover the tail you
+        care about. This is the primitive behind the BENCH
+        step-seconds percentiles and the measured hang-budget
+        suggestion (serving/guard.py, ISSUE 11)."""
+        key = self._key(labels)
+        with self._lock:
+            # COPY under the lock: a concurrent observe() mutates the
+            # bucket list in place, and iterating the live list against
+            # a stale total skews the interpolation
+            counts = list(self._counts.get(key) or ())
+            total = self._totals.get(key, 0)
+        if not counts or total <= 0:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        rank = q * total
+        cum = 0
+        for i, n in enumerate(counts):
+            if not n:
+                continue
+            lo = self.buckets[i - 1] if i else 0.0
+            hi = self.buckets[i]
+            if cum + n >= rank:
+                frac = (rank - cum) / n
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += n
+        return self.buckets[-1]  # overflow mass: clamp to the last bound
+
+    def percentiles(self, qs: Sequence[float] = (0.5, 0.9, 0.99),
+                    **labels: Any) -> dict[str, float] | None:
+        """{"p50": ..., "p90": ..., ...} or None when empty."""
+        out = {}
+        for q in qs:
+            v = self.percentile(q, **labels)
+            if v is None:
+                return None
+            out[f"p{str(round(q * 100, 1)).rstrip('0').rstrip('.')}"] = v
+        return out
+
     def render(self) -> list[str]:
         lines = []
         if self.help:

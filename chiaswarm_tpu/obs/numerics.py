@@ -1,0 +1,369 @@
+"""swarmlens numerics flight recorder: named probes INSIDE compiled programs.
+
+swarmscope (obs/metrics.py, obs/trace.py) sees job phases and lane
+stats; a sharded denoise step is still a black box between dispatch and
+result — which is exactly where the GSPMD divergence family (ROADMAP
+item 1) has hidden for five rounds. This module puts the instrument
+taps inside the jitted programs themselves:
+
+- :func:`tap` is a **trace-time identity** unless ``CHIASWARM_NUMERICS``
+  enables the probe: with the env unset the value is returned untouched,
+  the lowered HLO is byte-identical to an untapped program, no callback
+  exists, and the compile-cache counters cannot move (the invariance
+  gate, tests/test_obs.py). With the probe enabled, a handful of
+  device-side reductions (L2 norm, mean, absmax, non-finite count, a
+  bitwise content checksum) ride an ``io_callback`` into the bounded
+  in-process :class:`NumericsRing` — a few floats per probe per step,
+  never the tensor itself.
+- Probes carry a ``step`` (traced loop index) and a ``shard`` (traced
+  ``axis_index`` inside ``shard_map``; -1 = the global value of a
+  GSPMD program, which jax gathers before the callback), so two runs of
+  a program pair can be aligned record-for-record and bisected to the
+  FIRST divergent (step, probe, shard) — ``tools/divergence_bisect.py``.
+- Host-side code that already holds a transferred array (the lane
+  checkpoint boundary, serving/stepper.py) records through
+  :func:`record_host` with the SAME summary math, so device-tapped and
+  host-tapped streams are directly comparable.
+
+Enablement (read at TRACE time — flipping it poisons no cached
+executable because ``core/compile_cache.py`` folds the live fingerprint
+into every static cache key while enabled):
+
+- ``CHIASWARM_NUMERICS`` unset/empty  -> all taps are identity (default)
+- ``CHIASWARM_NUMERICS=1``            -> every probe records
+- ``CHIASWARM_NUMERICS=diffusion,ring`` -> only probes whose name starts
+  with one of the comma-separated prefixes
+- ``CHIASWARM_NUMERICS_RING``         -> ring capacity (default 8192)
+
+The ring is served at ``/debug/numerics`` (node/worker.py) and dumps to
+a JSONL run file via :func:`dump`. Like the rest of ``obs/``, this
+module imports without jax; jax is touched only inside an enabled tap.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Iterable
+
+ENV_ENABLE = "CHIASWARM_NUMERICS"
+ENV_RING = "CHIASWARM_NUMERICS_RING"
+
+_DEFAULT_CAPACITY = 8192
+
+#: the summary fields every record carries, in comparison order — the
+#: bisect driver tolerance-compares the floats and equality-compares
+#: ``nonfinite``/``checksum``
+SUMMARY_FIELDS = ("l2", "mean", "absmax", "nonfinite", "checksum")
+
+
+#: values that mean OFF — an operator writing ``CHIASWARM_NUMERICS=0``
+#: must get a disabled recorder, not a fingerprinted cache-key churn
+#: with zero matching probes
+_OFF_VALUES = frozenset({"", "0", "off", "false", "no", "none"})
+
+
+def _raw() -> str:
+    raw = os.environ.get(ENV_ENABLE, "").strip()
+    return "" if raw.lower() in _OFF_VALUES else raw
+
+
+def enabled() -> bool:
+    """True when ANY probe is enabled (the trace-time master switch).
+    ``0``/``off``/``false``/``no`` count as unset."""
+    return bool(_raw())
+
+
+def fingerprint() -> str:
+    """The raw enablement value, folded into compile-cache keys while
+    taps are on so an env flip retraces instead of reusing a tap-less
+    (or differently-tapped) executable."""
+    return _raw()
+
+
+#: package-level export alias (``from chiaswarm_tpu.obs import
+#: numerics_enabled`` — "enabled" alone is too generic a name there)
+def numerics_enabled() -> bool:
+    return enabled()
+
+
+def enabled_for(probe: str) -> bool:
+    """Prefix filter, BIDIRECTIONAL so family guards compose with
+    per-probe filters: token ``attn`` enables ``attn.q``; token
+    ``attn.q`` also satisfies the family guard ``enabled_for("attn")``
+    (the call site traces its taps in, and each tap then filters
+    itself — so ``CHIASWARM_NUMERICS=attn.q`` records exactly q)."""
+    raw = _raw()
+    if not raw:
+        return False
+    if raw.lower() in ("1", "true", "on", "all"):
+        return True
+    return any(probe.startswith(tok) or tok.startswith(probe)
+               for tok in (t.strip() for t in raw.split(",")) if tok)
+
+
+class NumericsRing:
+    """Bounded ring of per-probe summary records (oldest evicted).
+
+    Thread-safe: records arrive from jax callback threads, lane driver
+    threads, and the solo executor concurrently. Each record is a plain
+    dict (JSON-able end to end: /debug/numerics, dump files, the bisect
+    report)."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(ENV_RING, "") or
+                               _DEFAULT_CAPACITY)
+            except ValueError:
+                capacity = _DEFAULT_CAPACITY
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._records: collections.deque[dict] = collections.deque(
+            maxlen=self.capacity)
+        self._seq = 0
+        self.total = 0      # records ever appended
+        self.evicted = 0    # records pushed out by the bound
+
+    def record(self, probe: str, *, step: int = -1, shard: int = -1,
+               l2: float = 0.0, mean: float = 0.0, absmax: float = 0.0,
+               nonfinite: int = 0, checksum: int = 0, size: int = 0,
+               note: str | None = None) -> dict:
+        rec = {
+            "probe": str(probe), "step": int(step), "shard": int(shard),
+            "l2": float(l2), "mean": float(mean), "absmax": float(absmax),
+            "nonfinite": int(nonfinite), "checksum": int(checksum),
+            "size": int(size), "t": time.time(),
+        }
+        if note is not None:
+            rec["note"] = str(note)
+        with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            if len(self._records) == self.capacity:
+                self.evicted += 1
+            self._records.append(rec)
+            self.total += 1
+        return rec
+
+    def snapshot(self, probe_prefix: str | None = None,
+                 limit: int | None = None) -> list[dict]:
+        with self._lock:
+            records = list(self._records)
+        if probe_prefix:
+            records = [r for r in records
+                       if r["probe"].startswith(probe_prefix)]
+        if limit is not None and limit >= 0:
+            # records[-0:] is the WHOLE list — limit=0 must mean none
+            records = records[-limit:] if limit else []
+        return records
+
+    def drain(self) -> list[dict]:
+        """Snapshot AND clear atomically (the bisect driver's per-run
+        capture primitive)."""
+        with self._lock:
+            records = list(self._records)
+            self._records.clear()
+        return records
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"capacity": self.capacity, "depth": len(self._records),
+                    "total": self.total, "evicted": self.evicted}
+
+
+class TapRegistry:
+    """Named probe points + the ring their summaries land in.
+
+    ``traced_probes`` counts how many times each probe was compiled into
+    a program (trace-time, not per-step) — the /debug/numerics header
+    that tells an operator which taps exist in the currently-resident
+    executables."""
+
+    def __init__(self, ring: NumericsRing | None = None) -> None:
+        self.ring = ring if ring is not None else NumericsRing()
+        self._lock = threading.Lock()
+        self._traced: dict[str, int] = {}
+        self._trace_seq: dict[str, int] = {}
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def note_traced(self, probe: str) -> None:
+        with self._lock:
+            self._traced[probe] = self._traced.get(probe, 0) + 1
+
+    def traced_probes(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._traced)
+
+    def trace_seq(self, name: str) -> int:
+        """TRACE-time sequence number per counter name — the call-site
+        index shared-structure probes use (ops/attention.py): two twin
+        programs trace the same modules in the same order, so index N
+        aligns across runs. The bisect driver resets these between its
+        paired runs (:meth:`reset_trace_seq`) so both twins count from
+        zero."""
+        with self._lock:
+            n = self._trace_seq.get(name, 0)
+            self._trace_seq[name] = n + 1
+            return n
+
+    def reset_trace_seq(self) -> None:
+        with self._lock:
+            self._trace_seq.clear()
+
+    # -- the traced tap ----------------------------------------------------
+
+    def tap(self, probe: str, value: Any, *, step: Any = None,
+            shard: Any = None, note: str | None = None) -> Any:
+        """Summarize ``value`` into the ring — IDENTITY unless ``probe``
+        is enabled at trace time.
+
+        ``step``/``shard`` may be traced scalars (a scan's loop index,
+        ``lax.axis_index`` inside shard_map) or plain ints; None means
+        -1 (unstepped / global). Returns ``value`` unchanged either
+        way, so call sites read as pass-throughs."""
+        if not enabled_for(probe):
+            return value
+
+        import jax
+        import jax.numpy as jnp
+
+        from chiaswarm_tpu.core.compat import io_callback
+
+        self.note_traced(probe)
+        x = jnp.asarray(value)
+        size = int(x.size)
+        xf = x.astype(jnp.float32)
+        finite = jnp.isfinite(xf)
+        xz = jnp.where(finite, xf, 0.0)
+        summary = jnp.stack([
+            jnp.sqrt(jnp.sum(xz * xz)),
+            jnp.sum(xz) / max(size, 1),
+            jnp.max(jnp.abs(xz)) if size else jnp.float32(0.0),
+        ])
+        nonfinite = jnp.sum(~finite, dtype=jnp.int32)
+        # bitwise content checksum of the f32 view: integer addition is
+        # associative, so the reduction is order-insensitive (bit-exact
+        # across shardings) — equality here means "same f32 content"
+        checksum = jnp.sum(
+            jax.lax.bitcast_convert_type(xz, jnp.uint32),
+            dtype=jnp.uint32)
+        step_arr = jnp.int32(-1 if step is None else step)
+        shard_arr = jnp.int32(-1 if shard is None else shard)
+
+        def _record(step_v, shard_v, summary_v, nonfinite_v, checksum_v):
+            # host side of the io_callback: the incoming values are tiny
+            # (3 floats + 2 ints); the conversions below never touch the
+            # tapped tensor  # swarmlens: allow-host-sync
+            s = [float(v) for v in summary_v]
+            self.ring.record(
+                probe, step=int(step_v), shard=int(shard_v),
+                l2=s[0], mean=s[1], absmax=s[2],
+                nonfinite=int(nonfinite_v), checksum=int(checksum_v),
+                size=size, note=note)
+
+        io_callback(_record, None, step_arr, shard_arr, summary,
+                    nonfinite, checksum, ordered=False)
+        return value
+
+    # -- the host-side twin ------------------------------------------------
+
+    def record_host(self, probe: str, array: Any, *, step: int = -1,
+                    shard: int = -1, note: str | None = None) -> dict | None:
+        """Summarize a host-resident array with the SAME math as the
+        device tap (f32 view, non-finites zeroed out of the moments), so
+        host-tapped streams align against device-tapped ones."""
+        if not enabled_for(probe):
+            return None
+        import numpy as np
+
+        x = np.asarray(array)
+        size = int(x.size)
+        xf = x.astype(np.float32)
+        finite = np.isfinite(xf)
+        xz = np.where(finite, xf, np.float32(0.0))
+        checksum = int(np.sum(xz.view(np.uint32), dtype=np.uint64)
+                       & 0xFFFFFFFF)
+        return self.ring.record(
+            probe, step=step, shard=shard,
+            l2=float(np.sqrt(np.sum(xz.astype(np.float64) ** 2))),
+            mean=float(np.sum(xz, dtype=np.float64) / max(size, 1)),
+            absmax=float(np.max(np.abs(xz))) if size else 0.0,
+            nonfinite=int(np.sum(~finite)), checksum=checksum,
+            size=size, note=note)
+
+
+#: process-global recorder: the serving taps, /debug/numerics, and the
+#: bisect driver all share it (one program, one stream)
+RING = NumericsRing()
+TAPS = TapRegistry(RING)
+
+
+def tap(probe: str, value: Any, *, step: Any = None, shard: Any = None,
+        note: str | None = None) -> Any:
+    """Module-level convenience over the global :data:`TAPS` registry —
+    the spelling the serving taps use."""
+    return TAPS.tap(probe, value, step=step, shard=shard, note=note)
+
+
+def record_host(probe: str, array: Any, *, step: int = -1, shard: int = -1,
+                note: str | None = None) -> dict | None:
+    return TAPS.record_host(probe, array, step=step, shard=shard, note=note)
+
+
+def flush() -> None:
+    """Best-effort barrier for in-flight unordered callbacks: records
+    from a finished computation may still be draining through the jax
+    callback machinery when the output future resolves. The bisect
+    driver calls this between runs so stream A cannot bleed into
+    stream B."""
+    try:
+        import jax
+
+        barrier = getattr(jax, "effects_barrier", None)
+        if barrier is not None:
+            barrier()
+            return
+    except Exception:
+        pass
+    time.sleep(0.05)  # no barrier on this jax: give the drain a beat
+
+
+def dump(path: str, records: Iterable[dict] | None = None) -> int:
+    """Write records (default: the live ring) to a JSONL run file.
+    Returns the record count."""
+    records = list(RING.snapshot() if records is None else records)
+    with open(path, "w", encoding="utf-8") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(records)
+
+
+def load_dump(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def debug_payload(probe_prefix: str | None = None,
+                  limit: int | None = None) -> dict:
+    """The ``/debug/numerics`` response body: enablement, ring stats,
+    trace-time probe census, and the (filtered) records."""
+    return {
+        "enabled": enabled(),
+        "filter": fingerprint(),
+        "ring": RING.stats(),
+        "traced_probes": TAPS.traced_probes(),
+        "records": RING.snapshot(probe_prefix, limit),
+    }

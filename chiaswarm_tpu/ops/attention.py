@@ -30,6 +30,8 @@ from typing import Literal
 import jax
 import jax.numpy as jnp
 
+from chiaswarm_tpu.obs import numerics as _numerics
+
 AttentionImpl = Literal["auto", "xla", "flash", "ring"]
 
 _RING_MIN_TOKENS = 1024  # same bar as the flash kernel; env-overridable
@@ -122,6 +124,24 @@ def attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
+    # swarmlens (ISSUE 11): per-call-site I/O probes. ``step`` carries a
+    # TRACE-time call index — twin programs trace the same module
+    # structure in the same order, so call N aligns across runs (the
+    # bisect drill-down from "eps diverged" to "THIS attention layer,
+    # and on the input or the output side"; the driver resets the
+    # counter between paired runs).
+    if _numerics.enabled_for("attn"):
+        idx = _numerics.TAPS.trace_seq("attn")
+        q = _numerics.tap("attn.q", q, step=idx)
+        k = _numerics.tap("attn.k", k, step=idx)
+        v = _numerics.tap("attn.v", v, step=idx)
+
+        def _out_tap(out: jnp.ndarray) -> jnp.ndarray:
+            return _numerics.tap("attn.out", out, step=idx)
+    else:
+        def _out_tap(out: jnp.ndarray) -> jnp.ndarray:
+            return out
+
     # sequence-parallel dispatch is orthogonal to the LOCAL impl choice:
     # under an active seq>1 mesh even impl="xla" callers (e.g. a
     # latency_mode worker with use_flash_attention=false) ring their
@@ -129,7 +149,7 @@ def attention(
     # sequences on the local paths
     out = _try_ring(q, k, v, scale, impl)
     if out is not None:
-        return out
+        return _out_tap(out)
     if impl == "ring":
         from chiaswarm_tpu.parallel.context import active_seq_mesh
 
@@ -160,5 +180,5 @@ def attention(
     if use_flash:
         from chiaswarm_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, scale=scale)
-    return _xla_attention(q, k, v, scale)
+        return _out_tap(flash_attention(q, k, v, scale=scale))
+    return _out_tap(_xla_attention(q, k, v, scale))

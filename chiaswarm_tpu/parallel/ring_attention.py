@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from chiaswarm_tpu.core.compat import axis_size
+from chiaswarm_tpu.obs import numerics as _numerics
 
 _NEG_INF = -1e30
 
@@ -80,9 +81,24 @@ def ring_attention(
     m0 = zrow + _NEG_INF
     l0 = zrow
 
-    def body(carry, _):
+    # swarmlens per-hop probes (ISSUE 11): when enabled at trace time
+    # the scan consumes an explicit hop index and each shard emits its
+    # partial-softmax summaries per rotation — the drill-down stream for
+    # the seq-parallel divergence bisect. Off (default): xs stays None
+    # and the lowered scan is byte-identical to the untapped program.
+    tap_on = _numerics.enabled_for("ring")
+
+    def body(carry, hop):
         k_blk, v_blk, o_acc, m_acc, l_acc = carry
         o_i, m_i, l_i = _partial_attention(q, k_blk, v_blk, scale)
+        if tap_on:
+            shard = jax.lax.axis_index(axis_name)
+            o_i = _numerics.tap("ring.hop_partial", o_i,
+                                step=hop, shard=shard)
+            m_i = _numerics.tap("ring.hop_rowmax", m_i,
+                                step=hop, shard=shard)
+            l_i = _numerics.tap("ring.hop_rowsum", l_i,
+                                step=hop, shard=shard)
         m_new = jnp.maximum(m_acc, m_i)
         a_old = jnp.exp(m_acc - m_new)
         a_new = jnp.exp(m_i - m_new)
@@ -95,7 +111,12 @@ def ring_attention(
         return (k_blk, v_blk, o_acc, m_new, l_acc), None
 
     (_, _, o, m, l), _ = jax.lax.scan(
-        body, (k, v, o0, m0, l0), None, length=n
+        body, (k, v, o0, m0, l0),
+        jnp.arange(n) if tap_on else None,
+        length=None if tap_on else n,
     )
     out = o / l.transpose(0, 2, 1)[..., None]
+    if tap_on:
+        out = _numerics.tap("ring.out", out,
+                            shard=jax.lax.axis_index(axis_name))
     return out.astype(q.dtype)

@@ -37,6 +37,7 @@ from chiaswarm_tpu.core.compile_cache import (
     bucket_image_size,
     static_cache_key,
 )
+from chiaswarm_tpu.obs import numerics as _numerics
 from chiaswarm_tpu.obs import trace as obs_trace
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
@@ -309,6 +310,11 @@ class DiffusionPipeline:
             params = dequantize_tree(params)
             control_params = dequantize_tree(control_params)
             ctx, pooled = encode_text(params, ids)
+            # swarmlens probes (ISSUE 11): identity unless the probe is
+            # enabled via CHIASWARM_NUMERICS at trace time — the cache
+            # key carries the tap fingerprint, so flipping the env can
+            # never serve a tapped program from a taps-off slot
+            ctx = _numerics.tap("diffusion.text_ctx", ctx)
             if pix2pix:
                 # dual CFG rides a tripled batch: [uncond, image-only,
                 # text+image] (timbrooks/instruct-pix2pix semantics; the
@@ -403,6 +409,7 @@ class DiffusionPipeline:
                             added, control_scale)
                     eps = unet.apply(params["unet"], inp, t1, ctx, added,
                                      down_res, mid_res)
+                eps = _numerics.tap("diffusion.eps", eps, step=i)
                 keys, skeys = jax.vmap(
                     lambda k: tuple(jax.random.split(k)))(carry_keys)
                 step_noise = draw(skeys)
@@ -415,6 +422,8 @@ class DiffusionPipeline:
                         lambda k: tuple(jax.random.split(k)))(keys)
                     renoise = draw(mkeys)
                     x = reproject_known(sched, i, x, known, mask, renoise)
+                # the scheduler carry: the value the next step consumes
+                x = _numerics.tap("diffusion.latents", x, step=i)
                 return (x, state, keys), None
 
             n_steps = steps - start_step
@@ -422,6 +431,7 @@ class DiffusionPipeline:
                 body, (x, init_sampler_state(x), sample_keys),
                 jnp.arange(n_steps)
             )
+            x = _numerics.tap("diffusion.final_latents", x)
 
             if tiled:
                 from chiaswarm_tpu.models.vae import tiled_decode
@@ -433,8 +443,10 @@ class DiffusionPipeline:
             # quantize ON DEVICE: the host link (a tunnel on dev pods, PCIe
             # otherwise) moves 4x fewer bytes as uint8 — at 1024px this is
             # worth ~0.5s/image end-to-end
-            return (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
-                    ).astype(jnp.uint8)
+            return _numerics.tap(
+                "diffusion.image_u8",
+                (jnp.clip((img + 1.0) * 127.5 + 0.5, 0.0, 255.0)
+                 ).astype(jnp.uint8))
 
         # seq>1 param meshes trace under the sequence-parallel context so
         # ops.attention routes the large spatial self-attentions through

@@ -182,6 +182,72 @@ def slow_factor() -> float:
     return max(1.0, _env_float(ENV_SLOW_FACTOR, 4.0))
 
 
+#: minimum step-seconds observations before a hang-budget suggestion is
+#: called MEASURED — below this the histogram is warm-up noise
+SUGGEST_MIN_SAMPLES = 32
+
+
+def suggest_hang_budget(histogram: Any = None, *,
+                        min_samples: int = SUGGEST_MIN_SAMPLES) -> dict:
+    """MEASURED watchdog-knob suggestion from the live step-seconds
+    histogram (swarmlens, ISSUE 11) — closes the PR-10 carry-over that
+    factor 20 / floor 30 s / ceiling 600 s are priors, not measurements.
+
+    Derivation (documented so operators can audit the numbers):
+
+    - ``factor``  = 4x the measured p99/p50 dispersion, clamped to
+      [4, 20] — the budget tracks the EWMA, so the factor only needs to
+      absorb step-to-step variance plus headroom, not absolute scale.
+    - ``floor_s`` = 20x p99, at least 1 s — guards the budget when the
+      EWMA is tiny (fast lanes), so scheduler jitter cannot condemn.
+    - ``ceil_s``  = 200x p99 bounded to [60 s, the configured ceiling]
+      — the worst legitimate warm step; cold COMPILES are exempt from
+      this bound by construction (the watchdog gives un-warmed
+      dispatches the ceiling alone, so the ceiling need not cover
+      compile time, only pathological-but-alive steps).
+
+    Returns ``{"measured": False, "samples": n}`` until ``min_samples``
+    observations exist; /healthz, the loadgen report, and BENCH all
+    stamp this payload, so a real TPU deployment reads its knobs off
+    its own histogram.
+    """
+    if histogram is None:
+        from chiaswarm_tpu.obs.metrics import REGISTRY
+
+        histogram = REGISTRY.get("chiaswarm_stepper_step_seconds")
+    current = {
+        "factor": _env_float(ENV_HANG_FACTOR, 20.0),
+        "floor_s": _env_float(ENV_HANG_FLOOR, 30.0),
+        "ceil_s": max(_env_float(ENV_HANG_FLOOR, 30.0),
+                      _env_float(ENV_HANG_CEIL, 600.0)),
+    }
+    samples = histogram.count() if histogram is not None else 0
+    if histogram is None or samples < min_samples:
+        return {"measured": False, "samples": int(samples),
+                "min_samples": int(min_samples), "current": current}
+    p50 = histogram.percentile(0.5)
+    p99 = histogram.percentile(0.99)
+    if not p50 or not p99:
+        return {"measured": False, "samples": int(samples),
+                "min_samples": int(min_samples), "current": current}
+    dispersion = max(1.0, p99 / p50)
+    factor = min(20.0, max(4.0, 4.0 * dispersion))
+    floor_s = max(1.0, 20.0 * p99)
+    ceil_s = min(current["ceil_s"], max(60.0, 200.0 * p99))
+    return {
+        "measured": True,
+        "samples": int(samples),
+        "p50_s": round(p50, 6),
+        "p99_s": round(p99, 6),
+        "suggested": {
+            "factor": round(factor, 2),
+            "floor_s": round(floor_s, 3),
+            "ceil_s": round(max(ceil_s, floor_s), 3),
+        },
+        "current": current,
+    }
+
+
 # ---------------------------------------------------------------------------
 # the watchdog monitor thread
 # ---------------------------------------------------------------------------

@@ -101,6 +101,7 @@ from typing import Any
 
 import numpy as np
 
+from chiaswarm_tpu.obs import numerics as _numerics
 from chiaswarm_tpu.obs.metrics import (
     REGISTRY,
     arrival_rate_gauge,
@@ -1127,8 +1128,14 @@ class Lane:
         one window drain), never the submitters."""
         validate = _guard.validation_enabled()
         want_ckpt = self._spool is not None or _guard.watchdog_enabled()
+        # swarmlens (ISSUE 11): numerics probing rides the SAME
+        # checkpoint-boundary device->host transfer — enabling the
+        # lane_row probe forces the transfer even with durability and
+        # the watchdog off (set CHIASWARM_STEPPER_CKPT_EVERY=1 for
+        # per-step resolution when bisecting)
+        numerics_on = _numerics.enabled_for("lane_row")
         if (self._ckpt_every <= 0 or self._dev is None
-                or not (validate or want_ckpt)):
+                or not (validate or want_ckpt or numerics_on)):
             return
         if self.steps_executed % self._ckpt_every:
             return
@@ -1143,6 +1150,16 @@ class Lane:
         poisoned: list[_RowJob] = []
         for job in jobs.values():
             sel = list(job.slots)
+            if numerics_on:
+                # per-row lane-state summaries, recorded BEFORE the
+                # finite screen so a poisoned row's NaN step is on the
+                # record; slot index doubles as the shard id, so a
+                # sharded lane aligns row-for-row with its unsharded
+                # twin in the bisect streams
+                for s in sel:
+                    _numerics.record_host(
+                        "lane_row", x[s], step=int(self._h_idx[s]),
+                        shard=s, note=str(job.job_id))
             if validate and not np.isfinite(x[sel]).all():
                 poisoned.append(job)
                 continue

@@ -1350,3 +1350,185 @@ def test_subset_index_build_merges_into_cache_instead_of_evicting(tmp_path):
     a.write_text("x = 4\n")
     ProjectIndex.build([(str(a), "pkg/a.py")], cache_path=str(cache))
     assert set(json.loads(cache.read_text())["files"]) == {"pkg/a.py"}
+
+
+# ----------------------------------- R9 dispatch tables + allow marker
+
+
+def test_r9_reaches_through_module_level_dispatch_table():
+    """ISSUE 11 satellite (the ROADMAP lint-extension candidate): a
+    ``TABLE[key](...)`` call was an unresolvable edge — the call graph
+    now conservatively reaches every table member, so a sync sink behind
+    a workload dispatch dict is no longer invisible."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            import jax
+            from pkg.b import sink
+
+            TABLE = {"img": sink}
+
+            def dispatch(kind, x):
+                return TABLE[kind](x)
+
+            @jax.jit
+            def step(x):
+                return dispatch("img", x)
+            """),
+        ("pkg/b.py", """
+            def sink(x):
+                return x.mean().item()
+            """),
+    )
+    rule = _get_rule("R9")
+    findings = list(rule.check_project(idx))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "pkg/b.py"
+    assert [hop[2] for hop in f.chain] == [
+        "pkg.a.step", "pkg.a.dispatch", "pkg.b.sink"]
+
+
+def test_r9_reaches_through_cross_module_table_reference():
+    """The table may live in ANOTHER module than the caller —
+    ``jobs.TABLE[k](...)`` resolves through the import alias to the
+    owning module's table, whose values resolved in ITS namespace."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/jobs.py", """
+            from pkg.sinks import drain
+
+            CALLBACKS = {"audio": drain}
+            """),
+        ("pkg/exec.py", """
+            import jax
+            from pkg import jobs
+
+            @jax.jit
+            def step(x):
+                return jobs.CALLBACKS["audio"](x)
+            """),
+        ("pkg/sinks.py", """
+            def drain(x):
+                return float(x.sum())
+            """),
+    )
+    rule = _get_rule("R9")
+    findings = list(rule.check_project(idx))
+    assert len(findings) == 1
+    assert findings[0].path == "pkg/sinks.py"
+    assert [hop[2] for hop in findings[0].chain] == [
+        "pkg.exec.step", "pkg.sinks.drain"]
+
+
+def test_r9_local_dispatch_dict_expands_inline():
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            import jax
+            from pkg.b import sink
+
+            def route(kind, x):
+                handlers = {"img": sink}
+                return handlers[kind](x)
+
+            @jax.jit
+            def step(x):
+                return route("img", x)
+            """),
+        ("pkg/b.py", """
+            def sink(x):
+                return x.item()
+            """),
+    )
+    findings = list(_get_rule("R9").check_project(idx))
+    assert [f.path for f in findings] == ["pkg/b.py"]
+
+
+def test_r9_table_of_non_callables_is_not_a_dispatch_table():
+    """A dict of strings/numbers must NOT create call edges."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/a.py", """
+            import jax
+            from pkg.b import sink
+
+            SIZES = {"img": 3, "vid": 4}
+
+            @jax.jit
+            def step(x):
+                return x * SIZES["img"]
+            """),
+        ("pkg/b.py", """
+            def sink(x):
+                return x.item()
+            """),
+    )
+    assert list(_get_rule("R9").check_project(idx)) == []
+
+
+def test_allow_marker_sanctions_sync_site_for_r1_and_r9():
+    """swarmlens taps (ISSUE 11): the ``swarmlens: allow-host-sync``
+    marker — on the sync line or the comment line directly above —
+    silences the shared sync_sites vocabulary, so sanctioned io_callback
+    receiver bodies never become baseline noise. Both rules honor it
+    (they share the extractor) and unmarked sites still flag."""
+    marked_same_line = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            y = np.asarray(x)  # swarmlens: allow-host-sync
+            return y
+        """
+    assert lint(marked_same_line, rule="R1") == []
+
+    marked_above = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            # swarmlens: allow-host-sync
+            y = np.asarray(x)
+            return y
+        """
+    assert lint(marked_above, rule="R1") == []
+
+    unmarked = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            y = np.asarray(x)
+            return y
+        """
+    assert len(lint(unmarked, rule="R1")) == 1
+
+    # R9 shares the extractor: a marked sink across modules stays silent
+    def cross(sink_body: str):
+        return _index_of(
+            ("pkg/__init__.py", ""),
+            ("pkg/a.py", """
+                import jax
+                from pkg.b import sink
+
+                @jax.jit
+                def step(x):
+                    return sink(x)
+                """),
+            ("pkg/b.py", sink_body),
+        )
+
+    marked = cross("""
+        def sink(x):
+            return x.mean().item()  # swarmlens: allow-host-sync
+        """)
+    assert list(_get_rule("R9").check_project(marked)) == []
+    unmarked_idx = cross("""
+        def sink(x):
+            return x.mean().item()
+        """)
+    assert len(list(_get_rule("R9").check_project(unmarked_idx))) == 1

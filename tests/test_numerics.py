@@ -1,0 +1,430 @@
+"""swarmlens numerics flight recorder (ISSUE 11): taps-off invariance,
+per-step/per-shard recording, the checkpoint-boundary lane probes, and
+the divergence-bisect machinery end to end.
+
+THE gates here:
+
+- **taps-off invariance** — with ``CHIASWARM_NUMERICS`` unset a tapped
+  program lowers to HLO byte-identical to its untapped twin, cache keys
+  keep their historical shape, re-running a cached program compiles
+  nothing new, and the ring stays empty.
+- **bisect localization** — the intentionally-divergent fixture pair
+  must be localized to exactly its planted (step, probe); this is the
+  same gate CI runs via ``tools/divergence_bisect.py --config fixture``.
+
+Runs on the hermetic CPU platform (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.obs import numerics
+
+_BISECT_PATH = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "divergence_bisect.py")
+_spec = importlib.util.spec_from_file_location("divergence_bisect",
+                                               _BISECT_PATH)
+bisect_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bisect_mod)
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder(monkeypatch):
+    """Every test starts taps-off with an empty ring and fresh trace
+    counters; the global recorder is shared process-wide."""
+    monkeypatch.delenv("CHIASWARM_NUMERICS", raising=False)
+    numerics.RING.clear()
+    numerics.TAPS.reset_trace_seq()
+    yield
+    numerics.RING.clear()
+    numerics.TAPS.reset_trace_seq()
+
+
+# ---------------------------------------------------------------------------
+# enablement + gating
+# ---------------------------------------------------------------------------
+
+
+def test_enablement_prefix_filter(monkeypatch):
+    assert not numerics.enabled()
+    assert not numerics.enabled_for("diffusion.eps")
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "1")
+    assert numerics.enabled() and numerics.enabled_for("anything")
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "diffusion,ring")
+    assert numerics.enabled_for("diffusion.eps")
+    assert numerics.enabled_for("ring.hop_partial")
+    assert not numerics.enabled_for("lane_row")
+    assert numerics.fingerprint() == "diffusion,ring"
+
+
+def test_static_cache_key_shape_invariant_off_and_fingerprinted_on(
+        monkeypatch):
+    """Taps-off cache keys keep the historical 3-tuple byte for byte;
+    taps-on appends the fingerprint, so an env flip can never serve a
+    tapped executable from a taps-off slot (or vice versa)."""
+    from chiaswarm_tpu.core.compile_cache import static_cache_key
+
+    off = static_cache_key(7, "generate", {"batch": 1})
+    assert off == (7, "generate", (("batch", 1),))  # historical shape
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "diffusion")
+    on = static_cache_key(7, "generate", {"batch": 1})
+    assert on != off
+    assert on[:3] == off
+    assert ("numerics", "diffusion") in on[3:]
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "1")
+    assert static_cache_key(7, "generate", {"batch": 1}) != on
+
+
+# ---------------------------------------------------------------------------
+# THE taps-off invariance gate
+# ---------------------------------------------------------------------------
+
+
+def _scan_program(tapped: bool):
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x):
+        def body(carry, i):
+            carry = carry * 1.01 + 0.001
+            if tapped:
+                carry = numerics.tap("invariance.carry", carry, step=i)
+            return carry, None
+
+        out, _ = jax.lax.scan(body, x, jnp.arange(4))
+        if tapped:
+            out = numerics.tap("invariance.out", out)
+        return out
+
+    return fn
+
+
+def test_taps_off_lower_to_identical_hlo():
+    """CHIASWARM_NUMERICS unset: the tapped program's lowered HLO is
+    byte-identical to the untapped twin — zero callbacks, zero changed
+    ops, nothing for XLA to schedule differently."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((4, 4))
+    hlo_tapped = jax.jit(_scan_program(True)).lower(x).as_text()
+    hlo_plain = jax.jit(_scan_program(False)).lower(x).as_text()
+    assert hlo_tapped == hlo_plain
+    assert "custom_call" not in hlo_tapped.replace("-", "_").lower()
+    assert len(numerics.RING) == 0
+
+
+def test_taps_off_reruns_compile_nothing_and_record_nothing():
+    """A cached generate program re-runs under taps-off with compile
+    counters unchanged — the admission/compile-cache half of the
+    invariance gate."""
+    import jax
+
+    from chiaswarm_tpu.obs.metrics import REGISTRY
+    from chiaswarm_tpu.pipelines import (
+        Components,
+        DiffusionPipeline,
+        GenerateRequest,
+    )
+
+    pipe = DiffusionPipeline(Components.random("tiny", seed=3))
+    req = GenerateRequest(prompt="invariance", steps=2, height=64,
+                          width=64, seed=5, guidance_scale=5.0)
+    first, _ = pipe(req)
+
+    compiles = REGISTRY.get("chiaswarm_compiles_total")
+    misses = REGISTRY.get("chiaswarm_compile_cache_misses_total")
+    before = (dict(compiles.series()), dict(misses.series()))
+    again, _ = pipe(req)
+    after = (dict(compiles.series()), dict(misses.series()))
+    assert after == before, "taps-off rerun moved compile counters"
+    assert len(numerics.RING) == 0
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(again))
+
+
+# ---------------------------------------------------------------------------
+# taps-on recording
+# ---------------------------------------------------------------------------
+
+
+def test_tap_records_per_step_and_output_unchanged(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((4, 4))
+    plain = jax.jit(_scan_program(False))(x)
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "invariance")
+    tapped = jax.jit(_scan_program(True))(x)
+    jax.block_until_ready(tapped)
+    numerics.flush()
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(tapped))
+    records = numerics.RING.snapshot()
+    carry_steps = sorted(r["step"] for r in records
+                         if r["probe"] == "invariance.carry")
+    assert carry_steps == [0, 1, 2, 3]
+    out = [r for r in records if r["probe"] == "invariance.out"]
+    assert len(out) == 1 and out[0]["step"] == -1 and out[0]["shard"] == -1
+    for r in records:
+        assert r["size"] == 16 and r["nonfinite"] == 0
+        assert r["l2"] > 0 and r["checksum"] != 0
+    assert numerics.TAPS.traced_probes()["invariance.carry"] == 1
+
+
+def test_tap_counts_nonfinites_and_keeps_them_out_of_moments(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "nan_probe")
+
+    def fn(x):
+        return numerics.tap("nan_probe", x)
+
+    x = jnp.asarray([1.0, float("nan"), 3.0, float("inf")])
+    jax.block_until_ready(jax.jit(fn)(x))
+    numerics.flush()
+    (rec,) = numerics.RING.snapshot()
+    assert rec["nonfinite"] == 2
+    # moments computed over the finite values only (NaN/Inf zeroed)
+    assert rec["absmax"] == pytest.approx(3.0)
+    assert rec["l2"] == pytest.approx(np.sqrt(1.0 + 9.0))
+
+
+def test_per_shard_taps_inside_shard_map(monkeypatch):
+    """ring.* probes: each seq shard emits its own per-hop record, with
+    the shard id from axis_index — the drill-down stream for the
+    seq-parallel bisect."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from chiaswarm_tpu.core.compat import shard_map
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.parallel.ring_attention import ring_attention
+
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "ring")
+    mesh = build_mesh(MeshSpec({"seq": 4}), devices=jax.devices()[:4])
+    b, l, h, d = 1, 16, 2, 8
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+               for _ in range(3))
+    spec = P(None, "seq", None, None)
+    fn = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = jax.jit(fn)(q, k, v)
+    jax.block_until_ready(out)
+    numerics.flush()
+    records = numerics.RING.snapshot()
+    partials = [r for r in records if r["probe"] == "ring.hop_partial"]
+    # 4 shards x 4 hops, each with its own (step=hop, shard) identity
+    assert {(r["step"], r["shard"]) for r in partials} == {
+        (hop, shard) for hop in range(4) for shard in range(4)}
+    outs = [r for r in records if r["probe"] == "ring.out"]
+    assert {r["shard"] for r in outs} == {0, 1, 2, 3}
+
+    # the tapped ring still matches the plain xla reference
+    from chiaswarm_tpu.ops.attention import _xla_attention
+
+    ref = _xla_attention(q, k, v, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5)
+
+
+def test_lane_row_probes_ride_checkpoint_boundary(monkeypatch):
+    """serving/stepper.py extends the checkpoint-boundary device->host
+    transfer: with the lane_row probe on (and CKPT_EVERY=1), every
+    active row records a summary per step — keyed by slot and step, the
+    stream the SHARD_ROWS bisect aligns."""
+    from chiaswarm_tpu.pipelines import Components, DiffusionPipeline
+    from chiaswarm_tpu.serving.stepper import StepScheduler
+
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "lane_row")
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    monkeypatch.setenv("CHIASWARM_STEPPER_LANE_WIDTH", "2")
+    pipe = DiffusionPipeline(Components.random("tiny", seed=0))
+    sched = StepScheduler()
+    try:
+        fut = sched.submit_request(
+            pipe, prompt="lane probes", steps=6, guidance_scale=7.5,
+            height=64, width=64, rows=2, seed=9)
+        fut.result(timeout=300)[0].wait()
+    finally:
+        sched.shutdown()
+    records = [r for r in numerics.RING.snapshot()
+               if r["probe"] == "lane_row"]
+    assert records, "no lane_row records at checkpoint boundaries"
+    by_shard: dict[int, list[int]] = {}
+    for r in records:
+        by_shard.setdefault(r["shard"], []).append(r["step"])
+        assert r["nonfinite"] == 0 and r["l2"] > 0
+        assert r.get("note"), "lane records carry the job id"
+    assert set(by_shard) == {0, 1}  # both rows, slot-indexed
+    for steps in by_shard.values():
+        # strictly increasing step trail per row (one record per
+        # boundary the row was active at, mid-trajectory)
+        assert steps == sorted(steps) and len(set(steps)) == len(steps)
+        assert len(steps) >= 3
+
+
+# ---------------------------------------------------------------------------
+# the bisect machinery
+# ---------------------------------------------------------------------------
+
+
+def _rec(probe, step, shard, l2, seq, **kw):
+    base = {"probe": probe, "step": step, "shard": shard, "l2": l2,
+            "mean": l2 / 10.0, "absmax": l2 / 2.0, "nonfinite": 0,
+            "checksum": int(l2 * 1000) & 0xFFFFFFFF, "size": 4,
+            "seq": seq}
+    base.update(kw)
+    return base
+
+
+def test_bisect_streams_reports_first_divergence_in_program_order():
+    a = [_rec("x", -1, -1, 1.0, 0),
+         _rec("y", 0, -1, 2.0, 1),
+         _rec("y", 1, -1, 3.0, 2),
+         _rec("z", 1, -1, 4.0, 3)]
+    b = [_rec("x", -1, -1, 1.0, 0),
+         _rec("y", 0, -1, 2.0, 1),
+         _rec("y", 1, -1, 3.3, 2),      # first real divergence
+         _rec("z", 1, -1, 9.0, 3),      # later, bigger — must NOT win
+         _rec("only_b", 0, 2, 5.0, 4)]
+    report = bisect_mod.bisect_streams(a, b, rtol=1e-3, atol=1e-9)
+    first = report["first_divergence"]
+    assert (first["probe"], first["step"]) == ("y", 1)
+    assert first["field"] == "l2"
+    assert report["divergent"] == 2
+    assert report["compared"] == 4
+    assert report["probes_only_in_b"] == ["only_b"]
+    assert report["probes_only_in_a"] == []
+
+
+def test_bisect_nonfinite_and_checksum_semantics():
+    a = [_rec("p", 0, -1, 1.0, 0)]
+    b_nan = [_rec("p", 0, -1, 1.0, 0, nonfinite=3)]
+    report = bisect_mod.bisect_streams(a, b_nan)
+    assert report["first_divergence"]["field"] == "nonfinite"
+
+    # same floats, different bits: counted, never a divergence
+    b_bits = [_rec("p", 0, -1, 1.0, 0, checksum=42)]
+    report = bisect_mod.bisect_streams(a, b_bits)
+    assert report["divergent"] == 0
+    assert report["bit_only_differences"] == 1
+
+
+def test_bisect_duplicate_keys_keep_first_record():
+    a = [_rec("p", 0, -1, 1.0, 0), _rec("p", 0, -1, 99.0, 1)]
+    b = [_rec("p", 0, -1, 1.0, 0), _rec("p", 0, -1, 55.0, 1)]
+    assert bisect_mod.bisect_streams(a, b)["divergent"] == 0
+
+
+def test_fixture_pair_localizes_planted_divergence(monkeypatch):
+    """The CI gate's in-process twin: the intentionally-divergent scan
+    pair must bisect to exactly the planted (step, probe)."""
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "fixture")
+    stream_a, stream_b, context = bisect_mod.run_fixture(steps=6)
+    assert len(stream_a) == 7 and len(stream_b) == 7  # 6 carry + 1 out
+    report = bisect_mod.bisect_streams(stream_a, stream_b)
+    first = report["first_divergence"]
+    assert first is not None
+    assert first["probe"] == "fixture.carry"
+    assert first["step"] == bisect_mod.FIXTURE_DIVERGE_STEP
+    assert context["planted_step"] == bisect_mod.FIXTURE_DIVERGE_STEP
+    # carry steps before the perturbation agree bit-for-bit (the final
+    # fixture.out summary diverges too, downstream — expected)
+    clean = [d for d in report["divergences"]
+             if d["probe"] == "fixture.carry"
+             and d["step"] < bisect_mod.FIXTURE_DIVERGE_STEP]
+    assert clean == []
+
+
+def test_debug_payload_shape(monkeypatch):
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "p")
+    numerics.RING.record("p.x", step=2, shard=0, l2=1.0)
+    payload = numerics.debug_payload(probe_prefix="p.", limit=10)
+    assert payload["enabled"] is True
+    assert payload["filter"] == "p"
+    assert payload["ring"]["depth"] == 1
+    assert [r["probe"] for r in payload["records"]] == ["p.x"]
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions (PR 11 code review)
+# ---------------------------------------------------------------------------
+
+
+def test_off_values_disable_instead_of_fingerprinting(monkeypatch):
+    """CHIASWARM_NUMERICS=0 (off/false/no) must mean OFF: no cache-key
+    fingerprint (no silent full retrace), enabled=False on the debug
+    payload — not 'enabled but matching no probe'."""
+    from chiaswarm_tpu.core.compile_cache import static_cache_key
+
+    base = static_cache_key(1, "t", {"a": 1})
+    for off in ("0", "off", "false", "no", "OFF", "False"):
+        monkeypatch.setenv("CHIASWARM_NUMERICS", off)
+        assert not numerics.enabled(), off
+        assert not numerics.enabled_for("diffusion.eps"), off
+        assert numerics.fingerprint() == "", off
+        assert static_cache_key(1, "t", {"a": 1}) == base, off
+
+
+def test_enabled_for_is_bidirectional_for_family_guards(monkeypatch):
+    """A per-probe filter (attn.q) must satisfy the call site's FAMILY
+    guard (enabled_for('attn') traces the taps in) while each tap still
+    filters itself — so CHIASWARM_NUMERICS=attn.q records exactly q."""
+    monkeypatch.setenv("CHIASWARM_NUMERICS", "attn.q")
+    assert numerics.enabled_for("attn")      # family guard passes
+    assert numerics.enabled_for("attn.q")    # the probe itself
+    assert not numerics.enabled_for("attn.k")
+    assert not numerics.enabled_for("ring.hop_partial")
+
+    import jax
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.ops.attention import attention
+
+    q = jnp.ones((1, 8, 2, 4))
+    jax.block_until_ready(jax.jit(
+        lambda q: attention(q, q, q))(q))
+    numerics.flush()
+    probes = {r["probe"] for r in numerics.RING.snapshot()}
+    assert probes == {"attn.q"}, probes
+
+
+def test_snapshot_limit_zero_returns_nothing():
+    ring = numerics.NumericsRing(capacity=8)
+    for i in range(3):
+        ring.record("p", step=i)
+    assert ring.snapshot(limit=0) == []
+    assert len(ring.snapshot(limit=2)) == 2
+    assert len(ring.snapshot()) == 3
+
+
+def test_bisect_first_divergence_robust_to_callback_arrival_order():
+    """ordered=False callbacks can land out of program order: a step-5
+    record arriving before step-3 must not steal 'first divergence',
+    and pre-/post-loop unstepped probes keep their program position."""
+    a = [_rec("pre", -1, -1, 1.0, 0),       # pre-loop (e.g. text ctx)
+         _rec("c", 5, -1, 6.0, 1),          # step 5 ARRIVED first
+         _rec("c", 3, -1, 4.0, 2),          # step 3 arrived late
+         _rec("post", -1, -1, 9.0, 3)]      # post-loop output summary
+    b = [_rec("pre", -1, -1, 1.0, 0),
+         _rec("c", 5, -1, 7.0, 1),          # diverges
+         _rec("c", 3, -1, 4.4, 2),          # diverges EARLIER in program
+         _rec("post", -1, -1, 11.0, 3)]
+    report = bisect_mod.bisect_streams(a, b, rtol=1e-3)
+    first = report["first_divergence"]
+    assert (first["probe"], first["step"]) == ("c", 3)
+    # stepped records order by step regardless of arrival; the step-5
+    # record never outranks step 3
+    steps = [d["step"] for d in report["divergences"]
+             if d["probe"] == "c"]
+    assert steps == [3, 5]
+    # the pre-loop probe keeps its position before every stepped record
+    assert report["divergences"][0]["probe"] != "pre"

@@ -355,13 +355,35 @@ def test_worker_serves_metrics_and_traces_endpoints():
                     # error, never a crash
                     assert resp.status == 500
                     assert (await resp.json())["status"] == "error"
+                # swarmlens (ISSUE 11): the numerics flight-recorder view
+                async with session.get(f"{base}/debug/numerics") as resp:
+                    assert resp.status == 200
+                    numerics_payload = await resp.json()
+                async with session.get(
+                        f"{base}/debug/numerics?limit=abc") as resp:
+                    assert resp.status == 400
         finally:
             worker.request_stop()
             await asyncio.wait_for(task, timeout=20)
             await hive.stop()
-        return health, metrics_body, chrome, tree, worker
+        return health, metrics_body, chrome, tree, numerics_payload, worker
 
-    health, body, chrome, tree, worker = asyncio.run(scenario())
+    health, body, chrome, tree, numerics_payload, worker = \
+        asyncio.run(scenario())
+
+    # /debug/numerics: the payload distinguishes "empty because taps are
+    # off" from "empty because nothing recorded" — CHIASWARM_NUMERICS is
+    # unset in the suite, so enabled=False and the ring is bounded+empty
+    assert numerics_payload["enabled"] is False
+    assert numerics_payload["records"] == []
+    assert numerics_payload["ring"]["capacity"] >= 1
+    assert "traced_probes" in numerics_payload
+    # the measured hang-budget suggestion rides /healthz guard (ISSUE
+    # 11 satellite): with no lane steps yet it reports measured=False
+    # and the CURRENT prior knobs, never invented numbers
+    suggestion = health["guard"]["suggested_hang_budget"]
+    assert suggestion["measured"] in (False, True)
+    assert "current" in suggestion
 
     # /healthz read-through view unchanged (PR-2/PR-3 keys intact)
     for key in ("jobs_failed", "jobs_retried", "results_dead_lettered",
@@ -633,3 +655,100 @@ def test_lane_occupancy_histogram_semantics():
 
     assert obs_metrics.REGISTRY.get(
         "chiaswarm_stepper_lane_occupancy_ratio") is global_hist
+
+
+# ---------------------------------------------------------------------------
+# swarmlens (ISSUE 11): numerics ring + histogram percentiles
+# ---------------------------------------------------------------------------
+
+
+def test_numerics_ring_bounded_eviction_keeps_newest():
+    """The flight-recorder ring is bounded: the oldest records evict,
+    seq numbers stay monotonic, and the eviction counter tells the
+    operator the window was exceeded."""
+    from chiaswarm_tpu.obs.numerics import NumericsRing
+
+    ring = NumericsRing(capacity=4)
+    for i in range(10):
+        ring.record("p", step=i, l2=float(i))
+    records = ring.snapshot()
+    assert len(records) == 4
+    assert [r["step"] for r in records] == [6, 7, 8, 9]
+    assert [r["seq"] for r in records] == [6, 7, 8, 9]
+    stats = ring.stats()
+    assert stats["total"] == 10 and stats["evicted"] == 6
+    assert stats["depth"] == 4 and stats["capacity"] == 4
+
+    # prefix filter + limit serve the /debug/numerics query params
+    ring.record("other.probe", step=99)
+    assert [r["probe"] for r in ring.snapshot(probe_prefix="other")] == \
+        ["other.probe"]
+    assert len(ring.snapshot(limit=2)) == 2
+
+    # drain is snapshot+clear (the bisect driver's per-run capture)
+    drained = ring.drain()
+    assert len(drained) == 4 and len(ring) == 0
+
+
+def test_numerics_ring_records_are_json_and_dumpable(tmp_path):
+    from chiaswarm_tpu.obs import numerics
+
+    ring = numerics.NumericsRing(capacity=8)
+    ring.record("a.b", step=1, shard=2, l2=1.5, mean=0.5, absmax=2.0,
+                nonfinite=0, checksum=123, size=64, note="job-1")
+    path = tmp_path / "run.jsonl"
+    n = numerics.dump(str(path), ring.snapshot())
+    assert n == 1
+    loaded = numerics.load_dump(str(path))
+    assert loaded[0]["probe"] == "a.b" and loaded[0]["note"] == "job-1"
+
+
+def test_histogram_percentile_interpolation():
+    """Bucket-interpolated quantiles: the primitive behind the BENCH
+    step-seconds percentiles and the measured hang-budget suggestion."""
+    from chiaswarm_tpu.obs.metrics import Histogram
+
+    hist = Histogram("h", buckets=(1.0, 2.0, 4.0, 8.0))
+    assert hist.percentile(0.5) is None  # empty series
+    for v in (0.5, 1.5, 1.5, 3.0):
+        hist.observe(v)
+    # rank 2 of 4 lands in the (1, 2] bucket (2 obs): interpolated
+    assert hist.percentile(0.5) == pytest.approx(1.5)
+    assert hist.percentile(1.0) == pytest.approx(4.0)
+    # overflow mass clamps to the last finite bound
+    hist.observe(100.0)
+    assert hist.percentile(0.99) == pytest.approx(8.0)
+    pct = hist.percentiles((0.5, 0.99))
+    assert set(pct) == {"p50", "p99"}
+
+    labeled = Histogram("l", labelnames=("k",), buckets=(1.0, 2.0))
+    labeled.observe(0.5, k="a")
+    assert labeled.percentile(0.5, k="a") == pytest.approx(0.5)
+    assert labeled.percentile(0.5, k="other") is None
+
+
+def test_suggest_hang_budget_measured_vs_prior():
+    """Below the sample floor the suggestion refuses to guess; above it
+    the knobs derive from p50/p99 with documented clamps (ISSUE 11 —
+    the PR-10 'priors, not measurements' carry-over closed)."""
+    from chiaswarm_tpu.obs.metrics import Histogram
+    from chiaswarm_tpu.serving.guard import suggest_hang_budget
+
+    hist = Histogram("s", buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+    out = suggest_hang_budget(hist)
+    assert out["measured"] is False and out["samples"] == 0
+    assert out["current"]["factor"] == 20.0  # the documented prior
+
+    for _ in range(60):
+        hist.observe(0.04)
+    for _ in range(4):
+        hist.observe(0.4)  # a heavy tail: p99 lands past p50
+    out = suggest_hang_budget(hist)
+    assert out["measured"] is True and out["samples"] == 64
+    s = out["suggested"]
+    assert 4.0 <= s["factor"] <= 20.0
+    assert s["floor_s"] >= 1.0
+    assert s["ceil_s"] >= s["floor_s"]
+    assert s["ceil_s"] <= out["current"]["ceil_s"]
+    # measured floor tracks the tail, and sits far below the 30 s prior
+    assert s["floor_s"] < out["current"]["floor_s"]

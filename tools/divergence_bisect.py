@@ -1,0 +1,368 @@
+"""First-divergence bisect: a sharded program vs its unsharded twin.
+
+The swarmlens payoff tool (ISSUE 11). The GSPMD divergence family
+(ROADMAP item 1) presents as "the pixels differ" after a full denoise —
+useless for debugging. With the numerics taps on, both runs emit
+per-step per-shard summaries (obs/numerics.py), and this driver aligns
+the two streams record-for-record and reports the FIRST (step, probe,
+shard) where they disagree beyond tolerance — turning a five-round
+mystery into a named line of code to stare at.
+
+Configs:
+
+- ``seq_parallel``  the known-failing latency-mode config
+  (tests/test_multichip_serving.py::test_seq_parallel_serving_matches_
+  single_chip): random/tiny on a data=2 x seq=4 mesh with
+  CHIASWARM_RING_MIN_TOKENS=1 vs the single-chip run. Probes:
+  ``diffusion.*`` (global program state) + ``ring.*`` (per-shard
+  per-hop partials, sharded run only — drill-down context).
+- ``shard_rows``    the CHIASWARM_STEPPER_SHARD_ROWS lane twin: one
+  4-row job stepped through a lane with rows sharded over the data
+  axis vs the same job unsharded, compared through the ``lane_row``
+  checkpoint-boundary probes (CHIASWARM_STEPPER_CKPT_EVERY=1).
+- ``fixture``       a tiny intentionally-divergent scan program (the CI
+  gate): twin B perturbs its carry at a known step, and the driver must
+  localize exactly that (step, probe) — proving the tap -> ring ->
+  align -> bisect machinery end to end without any real model.
+
+Usage (CPU host or TPU)::
+
+    python tools/divergence_bisect.py --config seq_parallel [--steps 4]
+        [--rtol 2e-4] [--atol 1e-6] [--json out.json]
+
+Exit codes: 0 = ran and reported; 3 = fixture mode failed to localize
+the planted divergence (the CI failure signal); 1 = error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_env() -> None:
+    """Mirror tests/conftest.py on CPU hosts: a virtual 8-device
+    platform, set BEFORE jax imports. A real TPU pod keeps its own
+    platform (the operator exports nothing)."""
+    if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+
+# ---------------------------------------------------------------------------
+# stream alignment + comparison (pure functions — unit-tested without jax)
+# ---------------------------------------------------------------------------
+
+#: float summary fields compared under tolerance, in report order
+FLOAT_FIELDS = ("l2", "mean", "absmax")
+
+
+def record_key(rec: dict) -> tuple:
+    return (rec["probe"], rec["step"], rec["shard"])
+
+
+def index_stream(stream: list[dict]) -> dict[tuple, dict]:
+    """(probe, step, shard) -> FIRST record (a rerun of the same
+    program appends duplicate keys; the first belongs to the compared
+    execution)."""
+    out: dict[tuple, dict] = {}
+    for rec in stream:
+        out.setdefault(record_key(rec), rec)
+    return out
+
+
+def compare_records(a: dict, b: dict, *, rtol: float,
+                    atol: float) -> str | None:
+    """The field where ``a`` and ``b`` diverge beyond tolerance, or
+    None. Non-finite counts compare exactly — a NaN appearing in one
+    stream is a divergence regardless of magnitude tolerance."""
+    if a.get("nonfinite", 0) != b.get("nonfinite", 0):
+        return "nonfinite"
+    for field in FLOAT_FIELDS:
+        va, vb = float(a.get(field, 0.0)), float(b.get(field, 0.0))
+        if abs(va - vb) > atol + rtol * max(abs(va), abs(vb)):
+            return field
+    return None
+
+
+def _rel_err(a: float, b: float) -> float:
+    denom = max(abs(a), abs(b), 1e-30)
+    return abs(a - b) / denom
+
+
+def _program_order_key(by_a: dict[tuple, dict]):
+    """Sort key approximating PROGRAM order from stream A.
+
+    Taps emit with ``ordered=False`` — host arrival (``seq``) is not
+    guaranteed to match execution order, so a late-arriving step-3
+    record must not lose "first divergence" to a step-5 record that
+    beat it to the ring. Stepped records order primarily by their own
+    ``step``; unstepped (step = -1) records take the step of the last
+    STEPPED record that arrived before them (so a pre-loop text-encode
+    probe sorts before step 0 and a post-loop output probe after the
+    last step), with arrival ``seq`` breaking ties."""
+    eff: dict[tuple, int] = {}
+    last_stepped = -1
+    for key, rec in sorted(by_a.items(), key=lambda kv: kv[1]["seq"]):
+        if rec["step"] >= 0:
+            last_stepped = rec["step"]
+            eff[key] = rec["step"]
+        else:
+            eff[key] = last_stepped
+    return lambda k: (eff.get(k, -1), by_a[k]["seq"])
+
+
+def bisect_streams(stream_a: list[dict], stream_b: list[dict], *,
+                   rtol: float = 2e-4, atol: float = 1e-6) -> dict:
+    """Align two tap streams and report the first divergent key.
+
+    Keys present in only one stream are context, not divergence (the
+    unsharded twin never runs ring attention, so ``ring.*`` probes are
+    expected to be B-only). Comparison order approximates stream A's
+    PROGRAM order (:func:`_program_order_key`), so "first" means first
+    executed, robust to unordered callback arrival."""
+    by_a, by_b = index_stream(stream_a), index_stream(stream_b)
+    shared = [k for k in sorted(by_a, key=_program_order_key(by_a))
+              if k in by_b]
+    only_a = sorted({k[0] for k in by_a if k not in by_b})
+    only_b = sorted({k[0] for k in by_b if k not in by_a})
+    divergent: list[dict] = []
+    bit_only = 0
+    for key in shared:
+        a, b = by_a[key], by_b[key]
+        field = compare_records(a, b, rtol=rtol, atol=atol)
+        if field is not None:
+            divergent.append({
+                "probe": key[0], "step": key[1], "shard": key[2],
+                "field": field,
+                "a": {f: a.get(f) for f in FLOAT_FIELDS + ("nonfinite",)},
+                "b": {f: b.get(f) for f in FLOAT_FIELDS + ("nonfinite",)},
+                "rel_err": round(_rel_err(a.get(field, 0.0),
+                                          b.get(field, 0.0)), 8)
+                if field != "nonfinite" else None,
+            })
+        elif a.get("checksum") != b.get("checksum"):
+            # floats agree under tolerance but content bits differ —
+            # normal for reordered partitioned reductions; counted so
+            # a bit-exactness audit can see it
+            bit_only += 1
+    report = {
+        "compared": len(shared),
+        "divergent": len(divergent),
+        "bit_only_differences": bit_only,
+        "tolerances": {"rtol": rtol, "atol": atol},
+        "probes_only_in_a": only_a,
+        "probes_only_in_b": only_b,
+        "first_divergence": divergent[0] if divergent else None,
+        "divergences": divergent[:20],
+    }
+    return report
+
+
+# ---------------------------------------------------------------------------
+# paired runs
+# ---------------------------------------------------------------------------
+
+
+def _drain_ring():
+    from chiaswarm_tpu.obs import numerics
+
+    numerics.flush()
+    # shared-structure probes (attn.*) count call sites from zero per
+    # run, so twin call indices align
+    numerics.TAPS.reset_trace_seq()
+    return numerics.RING.drain()
+
+
+def run_seq_parallel(steps: int) -> tuple[list[dict], list[dict], dict]:
+    """The failing latency-mode config: single-chip vs data=2 x seq=4."""
+    os.environ.setdefault("CHIASWARM_NUMERICS", "diffusion,ring")
+    os.environ["CHIASWARM_RING_MIN_TOKENS"] = "1"
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.obs import numerics
+    from chiaswarm_tpu.pipelines import GenerateRequest
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 2, "seq": 4}))
+    req = GenerateRequest(prompt="a lighthouse", steps=steps, height=64,
+                          width=64, seed=21, guidance_scale=5.0)
+    numerics.RING.clear()
+    registry.pipeline("random/tiny")(req)
+    stream_a = _drain_ring()
+    registry.pipeline("random/tiny", mesh=pool.slots[0].mesh)(req)
+    stream_b = _drain_ring()
+    context = {"mesh": {"data": 2, "seq": 4}, "family": "tiny",
+               "steps": steps, "size": 64, "seed": 21,
+               "ring_min_tokens": 1}
+    return stream_a, stream_b, context
+
+
+def run_shard_rows(steps: int) -> tuple[list[dict], list[dict], dict]:
+    """The lane twin: one 4-row job through an unsharded lane vs the
+    same job with rows sharded over the data axis
+    (CHIASWARM_STEPPER_SHARD_ROWS=1), compared via the per-row
+    checkpoint-boundary probes at every step."""
+    os.environ.setdefault("CHIASWARM_NUMERICS", "lane_row")
+    os.environ["CHIASWARM_STEPPER_CKPT_EVERY"] = "1"
+    os.environ["CHIASWARM_STEPPER_LANE_WIDTH"] = "4"
+
+    import jax
+
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.obs import numerics
+    from chiaswarm_tpu.serving.stepper import StepScheduler
+
+    # the FAILING mesh is dp x tp (the bench slot shape): on a pure
+    # data mesh the sharded lane is bit-identical to its twin — the
+    # divergence needs the second (model) axis, exactly like the
+    # seq-parallel family needs data x seq (r06 bisect finding)
+    if len(jax.devices()) >= 8:
+        mesh_spec = {"data": 4, "model": 2}
+        mesh = build_mesh(MeshSpec(dict(mesh_spec)))
+    else:
+        n_dev = min(4, len(jax.devices()))
+        mesh_spec = {"data": n_dev}
+        mesh = build_mesh(MeshSpec(dict(mesh_spec)),
+                          devices=jax.devices()[:n_dev])
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+    pipe = registry.pipeline("tiny", mesh=mesh)
+
+    def one_run(shard_rows: bool) -> list[dict]:
+        os.environ["CHIASWARM_STEPPER_SHARD_ROWS"] = \
+            "1" if shard_rows else "0"
+        sched = StepScheduler()
+        numerics.RING.clear()
+        fut = sched.submit_request(
+            pipe, prompt="bisect twin", steps=steps, guidance_scale=7.5,
+            height=64, width=64, rows=4, seed=77)
+        fut.result(timeout=600)[0].wait()
+        sched.shutdown()
+        return _drain_ring()
+
+    stream_a = one_run(False)
+    stream_b = one_run(True)
+    context = {"mesh": mesh_spec, "family": "tiny", "steps": steps,
+               "rows": 4, "size": 64, "seed": 77, "ckpt_every": 1}
+    return stream_a, stream_b, context
+
+
+FIXTURE_DIVERGE_STEP = 3
+
+
+def run_fixture(steps: int = 6) -> tuple[list[dict], list[dict], dict]:
+    """Tiny intentionally-divergent scan pair: twin B's carry is
+    perturbed at step FIXTURE_DIVERGE_STEP. The CI gate asserts the
+    bisect localizes exactly that step on the ``fixture.carry`` probe."""
+    os.environ.setdefault("CHIASWARM_NUMERICS", "fixture")
+
+    import jax
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.obs import numerics
+
+    def make_run(perturb: float):
+        def fn(x):
+            def body(carry, i):
+                carry = carry * 1.01 + 0.001
+                carry = jnp.where(i == FIXTURE_DIVERGE_STEP,
+                                  carry + perturb, carry)
+                carry = numerics.tap("fixture.carry", carry, step=i)
+                return carry, None
+            out, _ = jax.lax.scan(body, x, jnp.arange(steps))
+            return numerics.tap("fixture.out", out)
+
+        numerics.RING.clear()
+        jax.block_until_ready(jax.jit(fn)(jnp.ones((8, 8))))
+        return _drain_ring()
+
+    stream_a = make_run(0.0)
+    stream_b = make_run(1e-2)
+    context = {"steps": steps, "planted_step": FIXTURE_DIVERGE_STEP}
+    return stream_a, stream_b, context
+
+
+CONFIGS = {
+    "seq_parallel": run_seq_parallel,
+    "shard_rows": run_shard_rows,
+    "fixture": run_fixture,
+}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="bisect a sharded program against its unsharded "
+                    "twin via swarmlens numerics taps")
+    parser.add_argument("--config", choices=sorted(CONFIGS),
+                        default="fixture")
+    parser.add_argument("--steps", type=int, default=None,
+                        help="denoise/scan steps (default: 4 for model "
+                             "configs, 6 for the fixture)")
+    parser.add_argument("--rtol", type=float, default=2e-4)
+    parser.add_argument("--atol", type=float, default=1e-6)
+    parser.add_argument("--json", default=None,
+                        help="also write the full report to this path")
+    parser.add_argument("--dump-streams", default=None,
+                        help="write both raw streams (JSONL) under this "
+                             "prefix: <prefix>.a.jsonl / <prefix>.b.jsonl")
+    args = parser.parse_args()
+
+    _ensure_env()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    steps = args.steps or (6 if args.config == "fixture" else 4)
+    stream_a, stream_b, context = CONFIGS[args.config](steps)
+
+    report = bisect_streams(stream_a, stream_b, rtol=args.rtol,
+                            atol=args.atol)
+    report["config"] = args.config
+    report["context"] = context
+    report["stream_sizes"] = {"a": len(stream_a), "b": len(stream_b)}
+
+    if args.dump_streams:
+        from chiaswarm_tpu.obs import numerics
+
+        numerics.dump(args.dump_streams + ".a.jsonl", stream_a)
+        numerics.dump(args.dump_streams + ".b.jsonl", stream_b)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+
+    first = report["first_divergence"]
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if first:
+        print(f"\nFIRST DIVERGENCE: step {first['step']}, probe "
+              f"{first['probe']}, shard {first['shard']} "
+              f"({first['field']}: {first['a'][first['field']]} vs "
+              f"{first['b'][first['field']]})", file=sys.stderr)
+    else:
+        print("\nno divergence beyond tolerance", file=sys.stderr)
+
+    if args.config == "fixture":
+        ok = (first is not None
+              and first["probe"] == "fixture.carry"
+              and first["step"] == FIXTURE_DIVERGE_STEP)
+        if not ok:
+            print("fixture gate FAILED: planted divergence at step "
+                  f"{FIXTURE_DIVERGE_STEP} was not localized",
+                  file=sys.stderr)
+            return 3
+        print("fixture gate ok: planted divergence localized",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

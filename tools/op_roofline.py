@@ -1,25 +1,27 @@
 """Per-fusion roofline table for the headline SDXL-1024 denoise program.
 
-VERDICT r2 item #2's alternative "done" criterion: show, per conv fusion,
-how close the compiled program runs to ITS OWN roofline — the max of its
-compute time (FLOPs / peak MXU throughput) and its memory time (HBM bytes
-/ peak bandwidth). A fusion near 100% of that bound has no headroom left
-in user code; a fusion far below it marks where XLA's conv scheduling
-leaves time on the table.
+Thin CLI over ``chiaswarm_tpu.obs.hlocost`` (swarmlens, ISSUE 11) — the
+HLO cost model, the profiler join, and the attainment math all live in
+the library now, where ``benchmark.py`` stamps them into BENCH json and
+``tests/test_op_roofline.py`` costs canned HLO fixtures without a TPU.
+This script keeps the operator workflow:
+
+VERDICT r2 item #2's alternative "done" criterion: show, per conv
+fusion, how close the compiled program runs to ITS OWN roofline — the
+max of its compute time (FLOPs / peak MXU throughput) and its memory
+time (HBM bytes / peak bandwidth). A fusion near 100% of that bound has
+no headroom left in user code; a fusion far below it marks where XLA's
+conv scheduling leaves time on the table.
 
 Method (no TF/tensorboard dependency; works through the axon tunnel,
 where ``--xla_dump_to`` would land on the far side):
-1. patch the pipelines' ``toplevel_jit`` with an AOT-capturing wrapper,
-   so the generate program's LoadedExecutable is in hand and
-   ``runtime_executable().get_hlo_text()`` yields the exact scheduled HLO
-   the chip runs;
+1. patch the pipelines' ``toplevel_jit`` with the library's AOT-capturing
+   :class:`~chiaswarm_tpu.obs.hlocost.ProgramCapture`, so the generate
+   program's LoadedExecutable is in hand and its scheduled HLO readable;
 2. profile ONE generate call with ``jax.profiler.trace`` and read the
-   device plane's "XLA Ops" line via ``jax.profiler.ProfileData`` —
-   per-HLO-op device durations and occurrence counts (while-loop body ops
-   appear once per denoise step, so counts fold the 30 steps in);
-3. statically cost each fusion from that HLO: conv FLOPs from
-   window/dim_labels/feature_group_count, dot FLOPs from contracting
-   dims, HBM bytes from the fusion signature's operand+result shapes;
+   device plane's per-HLO-op durations (while-loop body ops appear once
+   per denoise step, so counts fold the 30 steps in);
+3. statically cost each fusion from that HLO;
 4. print achieved TFLOP/s, both roofline components, and percent-of-
    roofline per fusion, heaviest first, plus program totals.
 
@@ -27,257 +29,30 @@ Usage (real chip):
     python tools/op_roofline.py [--steps 30] [--size 1024] [--family sdxl]
 Peak numbers default to TPU v5e (197 bf16 TFLOP/s, 819 GB/s) and are
 overridable via CHIASWARM_PEAK_TFLOPS / CHIASWARM_PEAK_GBPS for other
-generations. Results belong in BASELINE.md.
+generations. Results belong in BASELINE.md — and, since ISSUE 11, ride
+every BENCH run as the per-config ``roofline`` block.
 """
 
 from __future__ import annotations
 
 import argparse
 import glob
-import math
 import os
-import re
 import sys
 import tempfile
 
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
-    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
-}
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-_SHAPE_RE = re.compile(r"\b(pred|[su]\d+|bf16|f16|f32|f64)\[([\d,]*)\]")
-_NAME_RE = re.compile(r"%([\w.-]+)")
-_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(.+)$")
-
-
-def _shape_dims(dtype_dims: tuple[str, str]):
-    dtype, dims = dtype_dims
-    return dtype, [int(d) for d in dims.split(",") if d]
-
-
-def _shape_bytes(dtype: str, dims: list[int]) -> int:
-    return math.prod(dims, start=1) * _DTYPE_BYTES.get(dtype, 4)
-
-
-def build_shape_map(text: str) -> dict[str, tuple[str, list[int]]]:
-    """instruction name -> (dtype, dims) of its (first) result shape.
-
-    Scheduled HLO prints operands as bare ``%names`` (no inline shapes),
-    so operand shapes must be resolved through the defining instruction.
-    """
-    shape_map: dict[str, tuple[str, list[int]]] = {}
-    for line in text.splitlines():
-        d = _DEF_RE.match(line)
-        if not d:
-            continue
-        m = _SHAPE_RE.search(d.group(2))
-        if m:
-            shape_map[d.group(1)] = _shape_dims(m.groups())
-    return shape_map
-
-
-def _operand_shapes(line: str, opcode: str,
-                    shape_map) -> list[tuple[str, list[int]]]:
-    """(dtype, dims) of each operand of ``opcode`` on ``line`` — inline
-    shapes when the printer emitted them, the definition map otherwise."""
-    start = line.find(opcode + "(")
-    if start < 0:
-        return []
-    seg = line[start + len(opcode) + 1:]
-    # the operand list ends at the first ")" outside {} layout braces and
-    # outside nested "(" groups (tuple-typed inline shapes)
-    brace = paren = 0
-    end = len(seg)
-    for i, ch in enumerate(seg):
-        if ch == "{":
-            brace += 1
-        elif ch == "}":
-            brace -= 1
-        elif brace == 0 and ch == "(":
-            paren += 1
-        elif brace == 0 and ch == ")":
-            if paren:
-                paren -= 1
-            else:
-                end = i
-                break
-    seg = seg[:end]
-    inline = _SHAPE_RE.findall(seg)
-    names = _NAME_RE.findall(seg)
-    if inline and len(inline) >= len(names):
-        return [_shape_dims(s) for s in inline]
-    return [shape_map[n] for n in names if n in shape_map]
-
-
-def _conv_flops(line: str, shape_map) -> float:
-    """FLOPs of one HLO convolution instruction (per execution):
-    2 * out_elems * window_elems * in_features / feature_group_count."""
-    m = _SHAPE_RE.search(line.split("=", 1)[-1])
-    if not m:
-        return 0.0
-    _, out_dims = _shape_dims(m.groups())
-    out_elems = math.prod(out_dims, start=1)
-
-    window = re.search(r"window={[^}]*?size=([\dx]+)", line)
-    window_elems = 1
-    if window:
-        for d in window.group(1).split("x"):
-            window_elems *= int(d)
-
-    labels = re.search(r"dim_labels=(\S+?)->", line)
-    groups = re.search(r"feature_group_count=(\d+)", line)
-    group_n = int(groups.group(1)) if groups else 1
-
-    in_features = 1
-    operands = _operand_shapes(line, "convolution", shape_map)
-    if labels and len(operands) >= 2:
-        lhs_rhs = labels.group(1).split("_")
-        if len(lhs_rhs) == 2:
-            rhs_spec = lhs_rhs[1]  # e.g. "01io"
-            rhs_dims = operands[1][1]
-            i_pos = rhs_spec.find("i")
-            if 0 <= i_pos < len(rhs_dims):
-                in_features = rhs_dims[i_pos]
-    return 2.0 * out_elems * window_elems * in_features / group_n
-
-
-def _dot_flops(line: str, shape_map) -> float:
-    """FLOPs of one HLO dot: 2 * out_elems * prod(contracting dims)."""
-    m = _SHAPE_RE.search(line.split("=", 1)[-1])
-    if not m:
-        return 0.0
-    _, out_dims = _shape_dims(m.groups())
-    out_elems = math.prod(out_dims, start=1)
-    contract = re.search(r"lhs_contracting_dims={([\d,]*)}", line)
-    operands = _operand_shapes(line, "dot", shape_map)
-    k = 1
-    if contract and contract.group(1) and operands:
-        lhs_dims = operands[0][1]
-        for idx in contract.group(1).split(","):
-            i = int(idx)
-            if i < len(lhs_dims):
-                k *= lhs_dims[i]
-    return 2.0 * out_elems * k
-
-
-def _flash_flops(line: str, shape_map) -> float:
-    """Attention FLOPs of a flash custom call: 2*BH*L*S*D for QK^T plus
-    the same for PV — 4*BH*L*S*D. The kernel folds heads into the lead
-    dim and pads L/S to its block lattice, so operands are
-    (B*H, L_pad, D) (ops/flash_attention.py) — padded work is real
-    compute and is costed as such."""
-    operands = [dims for _, dims in
-                _operand_shapes(line, "custom-call", shape_map)
-                if len(dims) == 3]
-    if len(operands) < 2:
-        return 0.0
-    bh, l, d = operands[0]
-    s = operands[1][1]
-    return 4.0 * bh * l * s * d
-
-
-def _io_bytes(line: str, opcode: str, shape_map) -> int:
-    """HBM traffic estimate of one instruction: result + operand shapes,
-    each touched once."""
-    total = 0
-    m = _SHAPE_RE.search(line.split("=", 1)[-1])
-    if m:
-        total += _shape_bytes(*_shape_dims(m.groups()))
-    for dtype, dims in _operand_shapes(line, opcode, shape_map):
-        total += _shape_bytes(dtype, dims)
-    return total
-
-
-def parse_hlo_text(text: str) -> dict[str, dict]:
-    """fusion/conv/dot name -> {flops, bytes, kind} from scheduled HLO."""
-    shape_map = build_shape_map(text)
-
-    # computation name -> [total conv+dot flops inside it, kind]
-    comp_flops: dict[str, list] = {}
-    current = None
-    for line in text.splitlines():
-        header = re.match(
-            r"\s*(?:ENTRY\s+)?%?([\w.-]+)\s+\(.*\)\s*->\s*.+\{\s*$", line)
-        if header:
-            current = header.group(1)
-            continue
-        if line.startswith("}"):
-            current = None
-            continue
-        if current is None:
-            continue
-        if " convolution(" in line:
-            entry = comp_flops.setdefault(current, [0.0, "conv"])
-            entry[0] += _conv_flops(line, shape_map)
-        elif re.search(r"\bdot\(", line):
-            entry = comp_flops.setdefault(current, [0.0, "dot"])
-            entry[0] += _dot_flops(line, shape_map)
-            if entry[1] == "conv":
-                entry[1] = "mixed"
-
-    fusions: dict[str, dict] = {}
-    for line in text.splitlines():
-        m = re.match(r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*.*?\bfusion\(",
-                     line)
-        if not m:
-            # bare convs/dots outside fusions still deserve a row
-            b = re.match(
-                r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*.*?\b"
-                r"(convolution|dot)\(", line)
-            if b:
-                op = b.group(2)
-                flops = (_conv_flops(line, shape_map)
-                         if op == "convolution"
-                         else _dot_flops(line, shape_map))
-                fusions[b.group(1)] = {
-                    "flops": flops,
-                    "bytes": _io_bytes(line, op, shape_map),
-                    "kind": "conv" if op == "convolution" else "dot"}
-            elif "custom-call" in line and "flash_attention" in line:
-                c = re.match(r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=", line)
-                if c:
-                    fusions[c.group(1)] = {
-                        "flops": _flash_flops(line, shape_map),
-                        "bytes": _io_bytes(line, "custom-call", shape_map),
-                        "kind": "flash"}
-            continue
-        name = m.group(1)
-        called = re.search(r"calls=%?([\w.-]+)", line)
-        flops, kind = 0.0, "other"
-        if called and called.group(1) in comp_flops:
-            flops, kind = comp_flops[called.group(1)]
-        # HBM traffic estimate: every operand + the result, touched once
-        # (fusions stream operands from HBM exactly once)
-        fusions[name] = {"flops": flops,
-                         "bytes": _io_bytes(line, "fusion", shape_map),
-                         "kind": kind}
-    return fusions
-
-
-def collect_op_times(xplane_path: str) -> dict[str, dict]:
-    """op name -> {total_ps, count} from the TPU device plane."""
-    from jax.profiler import ProfileData
-
-    pd = ProfileData.from_file(xplane_path)
-    times: dict[str, dict] = {}
-    for plane in pd.planes:
-        if not plane.name.startswith("/device:TPU"):
-            continue
-        for line in plane.lines:
-            if line.name != "XLA Ops":
-                continue
-            for event in line.events:
-                stats = dict(event.stats)
-                dur = stats.get("device_duration_ps")
-                if dur is None:
-                    continue
-                name = event.name.split(" = ")[0].lstrip("%")
-                entry = times.setdefault(
-                    name, {"total_ps": 0, "count": 0,
-                           "signature": event.name})
-                entry["total_ps"] += int(dur)
-                entry["count"] += 1
-    return times
+from chiaswarm_tpu.obs.hlocost import (  # noqa: E402
+    ProgramCapture,
+    attainment_rows,
+    collect_op_times,
+    compiled_hlo_text,
+    conv_attainment_summary,
+    default_peaks,
+    parse_hlo_text,
+)
 
 
 def main() -> None:
@@ -300,13 +75,9 @@ def main() -> None:
     parser.add_argument("--frames", type=int, default=14)
     args = parser.parse_args()
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-
     import jax
 
-    peak_tflops = float(os.environ.get("CHIASWARM_PEAK_TFLOPS", "197"))
-    peak_gbps = float(os.environ.get("CHIASWARM_PEAK_GBPS", "819"))
+    peak_tflops, peak_gbps = default_peaks()
 
     import chiaswarm_tpu.pipelines.diffusion as diffusion_mod
     from chiaswarm_tpu.core import compat
@@ -316,26 +87,7 @@ def main() -> None:
         GenerateRequest,
     )
 
-    # AOT-capture every toplevel program the pipeline builds so the exact
-    # scheduled HLO is readable afterward (the pipeline imported the name
-    # at module load, so patch the module attribute, not compile_cache)
-    real_toplevel_jit = diffusion_mod.toplevel_jit
-    executables: list = []
-
-    def capturing_toplevel_jit(fn, **kwargs):
-        jitted = real_toplevel_jit(fn, **kwargs)
-        slot = {"compiled": None}
-
-        def wrapper(*args):
-            if slot["compiled"] is None:
-                slot["compiled"] = jitted.lower(*args).compile()
-                executables.append(slot)
-            return slot["compiled"](*args)
-
-        return wrapper
-
-    diffusion_mod.toplevel_jit = capturing_toplevel_jit
-
+    capture = ProgramCapture()
     on_tpu = jax.default_backend() == "tpu"
     size = args.size if on_tpu else 64
     steps = args.steps if on_tpu else 2
@@ -349,122 +101,86 @@ def main() -> None:
             VideoComponents,
         )
 
-        video_mod.toplevel_jit = capturing_toplevel_jit
-        fam = "svd_img2vid" if on_tpu else "tiny_svd"
-        vc = VideoComponents.random_host(fam, seed=0)
-        vc.params = jax.device_put(vc.params, jax.devices()[0])
-        ipipe = Img2VidPipeline(vc)
-        height = size
-        width = args.width or size
-        frames = args.frames if on_tpu else 4
-        cond = np.random.default_rng(0).integers(
-            0, 255, (height, width, 3), dtype=np.uint8)
-        print(f"compiling img2vid {height}x{width} {frames}f {steps} "
-              f"steps ...", file=sys.stderr)
-        ipipe(cond, num_frames=frames, steps=steps, height=height,
-              width=width, seed=0)  # compile + warm
-        trace_dir = tempfile.mkdtemp(prefix="xplane_")
-        with compat.profiler_trace(trace_dir):
+        with capture.patching(diffusion_mod, video_mod):
+            fam = "svd_img2vid" if on_tpu else "tiny_svd"
+            vc = VideoComponents.random_host(fam, seed=0)
+            vc.params = jax.device_put(vc.params, jax.devices()[0])
+            ipipe = Img2VidPipeline(vc)
+            height = size
+            width = args.width or size
+            frames = args.frames if on_tpu else 4
+            cond = np.random.default_rng(0).integers(
+                0, 255, (height, width, 3), dtype=np.uint8)
+            print(f"compiling img2vid {height}x{width} {frames}f {steps} "
+                  f"steps ...", file=sys.stderr)
             ipipe(cond, num_frames=frames, steps=steps, height=height,
-                  width=width, seed=0)
-        _report(trace_dir, executables, args, peak_tflops, peak_gbps)
+                  width=width, seed=0)  # compile + warm
+            trace_dir = tempfile.mkdtemp(prefix="xplane_")
+            with compat.profiler_trace(trace_dir):
+                ipipe(cond, num_frames=frames, steps=steps, height=height,
+                      width=width, seed=0)
+        _report(trace_dir, capture, args, peak_tflops, peak_gbps)
         return
 
     family = args.family if on_tpu else "tiny"
 
-    c = Components.random_host(family, seed=0)
-    c.params = jax.device_put(c.params, jax.devices()[0])
-    pipe = DiffusionPipeline(c)
-    controlnet = control_image = None
-    if args.controlnet:
-        import numpy as np
+    with capture.patching(diffusion_mod):
+        c = Components.random_host(family, seed=0)
+        c.params = jax.device_put(c.params, jax.devices()[0])
+        pipe = DiffusionPipeline(c)
+        controlnet = control_image = None
+        if args.controlnet:
+            import numpy as np
 
-        from chiaswarm_tpu.pipelines.components import ControlNetBundle
+            from chiaswarm_tpu.pipelines.components import ControlNetBundle
 
-        controlnet = ControlNetBundle.random_host(family, seed=1)
-        controlnet.params = jax.device_put(controlnet.params,
-                                           jax.devices()[0])
-        control_image = np.random.default_rng(0).integers(
-            0, 255, (size, size, 3), dtype=np.uint8)
-    req = GenerateRequest(prompt="roofline probe", steps=steps,
-                          height=size, width=size, batch=1, seed=0,
-                          guidance_scale=7.0, controlnet=controlnet,
-                          control_image=control_image)
-    print(f"compiling {family}{'+controlnet' if args.controlnet else ''} "
-          f"{size}px {steps} steps ...", file=sys.stderr)
-    pipe(req)  # compile + warm
+            controlnet = ControlNetBundle.random_host(family, seed=1)
+            controlnet.params = jax.device_put(controlnet.params,
+                                               jax.devices()[0])
+            control_image = np.random.default_rng(0).integers(
+                0, 255, (size, size, 3), dtype=np.uint8)
+        req = GenerateRequest(prompt="roofline probe", steps=steps,
+                              height=size, width=size, batch=1, seed=0,
+                              guidance_scale=7.0, controlnet=controlnet,
+                              control_image=control_image)
+        print(f"compiling {family}"
+              f"{'+controlnet' if args.controlnet else ''} "
+              f"{size}px {steps} steps ...", file=sys.stderr)
+        pipe(req)  # compile + warm
 
-    trace_dir = tempfile.mkdtemp(prefix="xplane_")
-    with compat.profiler_trace(trace_dir):
-        pipe(req)
-    _report(trace_dir, executables, args, peak_tflops, peak_gbps)
+        trace_dir = tempfile.mkdtemp(prefix="xplane_")
+        with compat.profiler_trace(trace_dir):
+            pipe(req)
+    _report(trace_dir, capture, args, peak_tflops, peak_gbps)
 
 
-def _report(trace_dir, executables, args, peak_tflops, peak_gbps) -> None:
+def _report(trace_dir, capture: ProgramCapture, args,
+            peak_tflops, peak_gbps) -> None:
     xplane = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
     if not xplane:
         raise FileNotFoundError("profiler produced no xplane.pb")
 
     times = collect_op_times(xplane[0])
-    if not executables:
+    if not capture.executables:
         raise RuntimeError("no toplevel program captured")
     hlo_text = max(
-        (s["compiled"].runtime_executable().get_hlo_text()
-         for s in executables), key=len)
+        (compiled_hlo_text(compiled) for compiled in capture.executables),
+        key=len)
     costs = parse_hlo_text(hlo_text)
-
-    def is_container(name: str) -> bool:
-        # a while/conditional event SPANS its body ops, which also appear
-        # on the same line — counting both would double-book the time
-        return name.split(".")[0] in ("while", "conditional", "call")
-
-    rows = []
-    total_ps = sum(t["total_ps"] for name, t in times.items()
-                   if not is_container(name))
-    for name, t in times.items():
-        if is_container(name):
-            continue
-        cost = costs.get(name) or {}
-        secs = t["total_ps"] * 1e-12
-        flops = cost.get("flops", 0.0) * t["count"]
-        bts = cost.get("bytes", 0) * t["count"]
-        t_compute = flops / (peak_tflops * 1e12)
-        t_bw = bts / (peak_gbps * 1e9)
-        t_roof = max(t_compute, t_bw)
-        kind = cost.get("kind", "other")
-        if kind == "other" and "flash" in name:
-            kind = "flash"
-        rows.append({
-            "name": name, "kind": kind, "count": t["count"],
-            "ms": secs * 1e3,
-            "gflop": flops / 1e9, "mb": bts / 1e6,
-            "tflops": (flops / secs / 1e12) if secs else 0.0,
-            "bound": "flops" if t_compute >= t_bw else "hbm",
-            "roof_pct": (100.0 * t_roof / secs) if secs else 0.0,
-            "share_pct": 100.0 * t["total_ps"] / max(total_ps, 1),
-        })
-    rows.sort(key=lambda r: -r["ms"])
-
-    conv_rows = [r for r in rows if r["kind"] in ("conv", "mixed")]
-    conv_ms = sum(r["ms"] for r in conv_rows)
-    # a fusion whose static cost model exceeds its measured time by >1.2x
-    # is MIS-COSTED (e.g. a multi-conv fusion double-counted, or a
-    # rematerialized op the profiler books elsewhere) — folding it into
-    # the attainment average would report >100% nonsense; report it
-    # separately instead
-    sane = [r for r in conv_rows if r["roof_pct"] <= 120.0]
-    sane_ms = sum(r["ms"] for r in sane)
-    weighted_roof = (sum(r["roof_pct"] * r["ms"] for r in sane)
-                     / max(sane_ms, 1e-9))
-    n_miscosted = len(conv_rows) - len(sane)
+    rows = attainment_rows(times, costs, peak_tflops=peak_tflops,
+                           peak_gbps=peak_gbps)
+    summary = conv_attainment_summary(rows)
 
     print(f"\ndevice op time total (containers excluded): "
-          f"{total_ps * 1e-9:.1f} ms; conv fusions: {conv_ms:.1f} ms "
-          f"({100 * conv_ms / max(total_ps * 1e-9, 1e-9):.0f}%), "
-          f"time-weighted conv roofline attainment: {weighted_roof:.0f}% "
-          f"over {sane_ms:.1f} ms"
-          + (f" ({n_miscosted} fusions excluded as mis-costed, "
-             f"{conv_ms - sane_ms:.1f} ms)" if n_miscosted else ""))
+          f"{summary['total_ms']:.1f} ms; conv fusions: "
+          f"{summary['conv_ms']:.1f} ms "
+          f"({summary['conv_share_pct']:.0f}%), "
+          f"time-weighted conv roofline attainment: "
+          f"{summary['weighted_conv_roof_pct']:.0f}% "
+          f"over {summary['sane_ms']:.1f} ms"
+          + (f" ({summary['miscosted_fusions']} fusions excluded as "
+             f"mis-costed, {summary['miscosted_ms']:.1f} ms)"
+             if summary["miscosted_fusions"] else ""))
     print(f"peaks: {peak_tflops:.0f} TFLOP/s, {peak_gbps:.0f} GB/s "
           f"(CHIASWARM_PEAK_TFLOPS/GBPS to override)\n")
     header = (f"{'op':<40} {'kind':>5} {'n':>4} {'ms':>8} {'GFLOP':>9} "

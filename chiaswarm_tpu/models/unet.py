@@ -358,6 +358,25 @@ class UNet(nn.Module):
 
     ``down_residuals``/``mid_residual`` inputs accept ControlNet residual
     injections (models/controlnet.py) — ``None`` for plain generation.
+
+    DeepCache seam (ISSUE 12, Ma et al. 2023): adjacent denoise steps
+    share slow-changing DEEP features, so the step-collapse subsystem
+    (pipelines/diffusion.py) caches the up-path activation entering the
+    shallowest (level 0) up block and replays it on designated steps:
+
+    - ``return_deep=True`` runs the full network and ALSO returns that
+      activation — the cache-refresh step. Static flag: the default
+      trace is byte-identical to the pre-seam network.
+    - ``cached_deep`` (the captured activation) runs the SHALLOW replay:
+      conv_in + the level-0 down blocks recompute (they feed the level-0
+      skip connections), every deeper level, the mid block, and the
+      deeper up path are SKIPPED, and the cached activation splices in
+      where the level-1 upsample output would arrive. For SDXL that
+      skips the transformer-heavy levels entirely — the dominant cost
+      of a denoise step.
+
+    Both variants keep the exact submodule names of the full path, so
+    one parameter tree serves all three traces.
     """
 
     config: UNetConfig
@@ -379,10 +398,20 @@ class UNet(nn.Module):
         # (B,) int noise level (x4-upscaler) or (B, class_proj_dim) float
         # FiLM vector (AudioLDM text_embeds)
         class_labels: jnp.ndarray | None = None,
-    ) -> jnp.ndarray:
+        # DeepCache seam (static at trace time; see class docstring)
+        cached_deep: jnp.ndarray | None = None,
+        return_deep: bool = False,
+    ) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
         cfg = self.config
         dtype = self.dtype
         channels = list(cfg.block_out_channels)
+        if (cached_deep is not None or return_deep) and len(channels) < 2:
+            raise ValueError(
+                "DeepCache needs a deep/shallow split: this UNet has a "
+                "single resolution level")
+        if cached_deep is not None and return_deep:
+            raise ValueError("shallow replay already carries the cache; "
+                             "return_deep only applies to full passes")
 
         temb = time_conditioning(cfg, dtype, timesteps, added_cond,
                                  class_labels)
@@ -392,20 +421,52 @@ class UNet(nn.Module):
 
         x = nn.Conv(channels[0], (3, 3), padding=1, dtype=dtype,
                     name="conv_in")(sample)
-        x, skips = down_trunk(cfg, dtype, x, temb, context)
 
-        if down_residuals is not None:
-            skips = [s + r for s, r in zip(skips, down_residuals)]
+        if cached_deep is not None:
+            # ---- shallow replay: level-0 down blocks only (they feed
+            # the level-0 skips), then the cached deep activation stands
+            # in for the whole level>=1 + mid + deeper-up computation
+            ch0 = channels[0]
+            depth0 = cfg.transformer_depth[0]
+            heads0, head_dim0 = cfg.heads_for(ch0, 0)
+            skips = [x]
+            for j in range(cfg.layers_per_block):
+                x = ResnetBlock(ch0, dtype,
+                                name=f"down_0_resnets_{j}")(x, temb)
+                if depth0 > 0:
+                    x = SpatialTransformer(
+                        depth0, heads0, head_dim0,
+                        cfg.use_linear_projection, dtype, cfg.attn_impl,
+                        cfg.cross_attention_dim is not None,
+                        name=f"down_0_attentions_{j}",
+                    )(x, context)
+                skips.append(x)
+            if down_residuals is not None:
+                # only the level-0 residuals have matching skips here
+                skips = [s + r for s, r in zip(skips, down_residuals)]
+            x = cached_deep.astype(dtype)
+            up_levels: list[int] = [0]
+        else:
+            x, skips = down_trunk(cfg, dtype, x, temb, context)
 
-        x = mid_trunk(cfg, dtype, x, temb, context)
-        if mid_residual is not None:
-            x = x + mid_residual
+            if down_residuals is not None:
+                skips = [s + r for s, r in zip(skips, down_residuals)]
+
+            x = mid_trunk(cfg, dtype, x, temb, context)
+            if mid_residual is not None:
+                x = x + mid_residual
+            up_levels = list(range(len(channels) - 1, -1, -1))
 
         # ---- up path (mirrors down, consumes skips)
-        for rev, ch in enumerate(reversed(channels)):
-            level = len(channels) - 1 - rev
+        deep = None
+        for level in up_levels:
+            ch = channels[level]
             depth = cfg.transformer_depth[level]
             heads, head_dim = cfg.heads_for(ch, level)
+            if level == 0 and return_deep:
+                # the activation the shallow replay will splice back in:
+                # the level-1 upsample output entering the level-0 blocks
+                deep = x
             for j in range(cfg.layers_per_block + 1):
                 skip = skips.pop()
                 x = jnp.concatenate([x, skip], axis=-1)
@@ -426,4 +487,6 @@ class UNet(nn.Module):
         x = nn.silu(x).astype(dtype)
         x = nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
                     name="conv_out")(x)
+        if return_deep:
+            return x, deep
         return x

@@ -188,6 +188,14 @@ def _format_stable_diffusion_args(args: dict[str, Any]) -> FormatResult:
     args.setdefault("num_inference_steps", DEFAULT_STEPS)
     # server-named diffusers scheduler class -> our sampler registry
     args["scheduler_type"] = parameters.pop("scheduler_type", None)
+    # DeepCache step-level reuse (ISSUE 12): a per-job schedule (list of
+    # ladder indices or "every:N"); tuple-ized so the burst coalescer
+    # can hash it as part of COALESCE_KEYS
+    reuse = parameters.pop("reuse_schedule", None)
+    if reuse is not None:
+        args["reuse_schedule"] = (tuple(reuse)
+                                  if isinstance(reuse, (list, tuple))
+                                  else reuse)
     _strip_unsupported(args, parameters)
     return diffusion_callback, args
 

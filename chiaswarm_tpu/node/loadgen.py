@@ -112,12 +112,17 @@ class WorkloadProfile:
 
 
 #: the default mix the ISSUE names: txt2img burst, img2img trickle,
-#: inpaint + ControlNet tail. Service times are the synthetic stand-in
-#: scale (hermetic runs); real-pipeline factories ignore them.
+#: inpaint + ControlNet tail — plus the few-step class (ISSUE 12):
+#: LCM/turbo-style 2–8 step jobs are interactive traffic, so they carry
+#: the SHORTEST deadline in the mix and the smallest service time
+#: (steps x per-step cost collapses ~7x vs the 30-step baseline).
+#: Service times are the synthetic stand-in scale (hermetic runs);
+#: real-pipeline factories ignore them.
 DEFAULT_PROFILES: tuple[WorkloadProfile, ...] = (
-    WorkloadProfile("txt2img", 0.60, 2.0, (10, 30), 0.10),
-    WorkloadProfile("img2img", 0.25, 2.5, (10, 25), 0.13),
-    WorkloadProfile("inpaint", 0.10, 3.0, (10, 25), 0.16),
+    WorkloadProfile("txt2img", 0.50, 2.0, (10, 30), 0.10),
+    WorkloadProfile("txt2img_fewstep", 0.15, 0.8, (2, 8), 0.04),
+    WorkloadProfile("img2img", 0.22, 2.5, (10, 25), 0.13),
+    WorkloadProfile("inpaint", 0.08, 3.0, (10, 25), 0.16),
     WorkloadProfile("controlnet", 0.05, 3.0, (15, 30), 0.20),
 )
 
@@ -145,8 +150,13 @@ DEADLINE_MARGIN = 1.5
 #: relative denoise cost per model family (sd15 = 1.0; sdxl from the
 #: BASELINE.md step-time ratio at default sizes, tiny from the test
 #: family's measured share) — scales the synthetic service model the
-#: same way the family scales the real denoise loop
-FAMILY_COST_FACTORS = {"tiny": 0.12, "sd15": 1.0, "sdxl": 3.2}
+#: same way the family scales the real denoise loop. ``sdxl_turbo``
+#: (ISSUE 12) is the few-step-distilled SDXL class: the per-step cost
+#: stays SDXL's 3.2 but 4 steps replace 30, so 3.2 x 4/30 ≈ 0.43 —
+#: the family-deadline table prices few-step jobs at their collapsed
+#: cost instead of billing them the 30-step budget.
+FAMILY_COST_FACTORS = {"tiny": 0.12, "sd15": 1.0, "sdxl": 3.2,
+                       "sdxl_turbo": 0.43, "sd_turbo": 0.13}
 
 
 def model_family(name: Any) -> str:
@@ -155,6 +165,11 @@ def model_family(name: Any) -> str:
     model-config registry (the worker side uses the real catalog,
     node/worker.py::_model_family)."""
     lowered = str(name or "").lower()
+    if "turbo" in lowered or "lcm" in lowered or "lightning" in lowered:
+        # the distilled few-step classes, checked BEFORE the "xl" hint
+        # ("sdxl-turbo" names both); non-XL distillations (sd-turbo,
+        # sd15-lcm) price at the SD-class per-step cost, not SDXL's
+        return "sdxl_turbo" if "xl" in lowered else "sd_turbo"
     if "xl" in lowered:
         return "sdxl"
     if "tiny" in lowered:
@@ -192,8 +207,12 @@ def sweep_deadline_table(seed: Any = "swarmload", *,
 
 #: the shipped per-family deadline defaults — sweep_deadline_table()'s
 #: output at the default seed (pinned defaults == winner,
-#: tests/test_loadgen.py::test_family_deadline_defaults_pinned)
-DEFAULT_FAMILY_DEADLINES = {"sd15": 0.713, "sdxl": 2.257, "tiny": 0.086}
+#: tests/test_loadgen.py::test_family_deadline_defaults_pinned).
+#: ``sdxl_turbo`` (ISSUE 12) prices the few-step-distilled SDXL class
+#: at its collapsed step count — ~7x tighter than full SDXL.
+DEFAULT_FAMILY_DEADLINES = {"sd15": 0.713, "sd_turbo": 0.094,
+                            "sdxl": 2.257, "sdxl_turbo": 0.31,
+                            "tiny": 0.086}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -346,6 +365,13 @@ def generate_schedule(population: UserPopulation,
             # frames (ISSUE 10 satellite / ROADMAP 5a)
             "content_type": content_type,
         }
+        if profile.name == "txt2img_fewstep":
+            # the few-step class IS the lcm-kind CFG-free path
+            # (ISSUE 12): real-pipeline runs must exercise the fewstep
+            # lane eligibility + per-row CFG-free combine, not a short
+            # dpm job wearing the class name
+            job["guidance_scale"] = 1.0
+            job["parameters"] = {"scheduler_type": "LCMScheduler"}
         out.append(ScheduledJob(at_s=t, user_id=user.user_id,
                                 workload=profile.name, job=job))
         n += 1
@@ -537,6 +563,32 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     killed: dict[str, Any] = {}
     t_start = time.perf_counter()
 
+    # contention probe (ISSUE 12 deflake): the harness runs on real
+    # wall clocks, so a contended CI host stretches every latency in
+    # the report — including the deadline-conformance numbers the
+    # acceptance gate asserts on. A SEPARATE daemon thread samples how
+    # late time.sleep fires during the run (factor ~1.0 on an idle
+    # host); the gate then bounds latency ratios against the measured
+    # factor instead of absolute wall clock. Deliberately NOT an
+    # asyncio task on the harness loop: loop lag caused by the code
+    # under test must count against the gate, not loosen it — the
+    # thread sees only host-level scheduling delay.
+    import threading
+
+    overshoots: list[float] = []
+    probe_stop = threading.Event()
+
+    def _contention_probe() -> None:
+        tick = 0.02
+        while not probe_stop.is_set():
+            t0 = time.perf_counter()
+            time.sleep(tick)
+            overshoots.append((time.perf_counter() - t0) / tick)
+
+    probe = threading.Thread(target=_contention_probe,
+                             name="loadgen-contention-probe", daemon=True)
+    probe.start()
+
     async def maybe_kill() -> None:
         # first leaseholder found after the threshold dies NOW:
         # partition (nothing it uploads lands) + cancel (the process
@@ -577,6 +629,8 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
             await asyncio.sleep(0.05)
     finally:
         duration_s = time.perf_counter() - t_start
+        probe_stop.set()
+        probe.join(timeout=1.0)
         for worker in workers:
             worker.request_stop()
         await asyncio.gather(*(asyncio.wait_for(t, timeout=30)
@@ -587,6 +641,21 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     report = score_run(hive, issued, workers, ordered,
                        duration_s=duration_s)
     report["kill"] = killed or None
+    # measured host-contention factor (>= 1.0; ~1.0 idle). The gate's
+    # contention-adjusted deadline clause scales its bound by this, so
+    # a contended host loosens the bound by exactly the measured sleep
+    # stretch — never by an arbitrary fudge.
+    factor = (max(1.0, percentile(overshoots, 0.9))
+              if overshoots else 1.0)
+    report["contention"] = {
+        "sleep_overshoot_p90": (round(percentile(overshoots, 0.9), 4)
+                                if overshoots else 1.0),
+        "samples": len(overshoots),
+        "factor": round(factor, 4),
+    }
+    ad = report["admitted_deadline"]
+    ad["p99_within_deadline_contention_adjusted"] = bool(
+        ad["p99_latency_over_deadline"] <= factor)
     return report
 
 

@@ -467,6 +467,64 @@ def lane_admissions_counter(registry: Registry | None = None) -> Counter:
         labelnames=("workload",))
 
 
+# ---- step-collapse families (ISSUE 12, swarmturbo) ----
+#
+# The 15x headline gap is steps x full-UNet; these families measure the
+# collapse of that product directly. Incremented by BOTH execution
+# paths — the lane driver per dispatch (serving/stepper.py) and the
+# solo submit per job (pipelines/diffusion.py) — on the process-global
+# REGISTRY, pre-seeded at import by those modules.
+
+#: how one per-row UNet evaluation was served: ``full`` runs the whole
+#: network (and refreshes the DeepCache deep-feature cache when reuse
+#: is compiled in); ``reuse`` replays the cached deep activation and
+#: recomputes only the shallow level-0 blocks
+STEPPER_UNET_EVAL_MODES = ("full", "reuse")
+
+#: per-image UNet-eval buckets: pow2 over the step-capacity lattice —
+#: a 30-step baseline lands in (16, 32]; the 4-step few-step family in
+#: (2, 4]; DeepCache-on rows land wherever their refresh cadence puts
+#: the full-eval count
+UNET_EVAL_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+
+def unet_evals_counter(registry: Registry | None = None) -> Counter:
+    """Per-row UNet evaluations by mode (``full`` vs DeepCache
+    ``reuse``). THE step-collapse cost signal: the full-mode rate IS
+    the chip-time driver (a reuse eval costs only the shallow level-0
+    blocks), so full/(full+reuse) is the fraction of the old per-step
+    cost the traffic still pays."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_stepper_unet_evals_total",
+        "per-row UNet evaluations, by mode (full vs DeepCache reuse)",
+        labelnames=("mode",))
+
+
+def steps_skipped_counter(registry: Registry | None = None) -> Counter:
+    """Denoise steps whose deep UNet blocks were skipped via DeepCache
+    feature reuse (per row). Zero with ``CHIASWARM_DEEPCACHE`` off or
+    no per-job ``reuse_schedule`` — a zero here while reuse jobs flow
+    means misaligned lane mates kept forcing full evals (check the
+    lane admission mix)."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_stepper_steps_skipped_total",
+        "denoise steps served from the DeepCache deep-feature cache "
+        "(per row)")
+
+
+def unet_evals_per_image_histogram(
+        registry: Registry | None = None) -> Histogram:
+    """FULL UNet evaluations each finished image actually paid —
+    observed once per row at retirement (lanes) or submit (solo). The
+    distribution the ≥4x step-collapse acceptance reads: a 30-step
+    baseline observes 30, the lcm 4-step family 4, DeepCache rows
+    their refresh count."""
+    return (registry or REGISTRY).histogram(
+        "chiaswarm_stepper_unet_evals_per_image",
+        "full UNet evaluations per finished image",
+        buckets=UNET_EVAL_BUCKETS)
+
+
 # ---- HBM model-residency families (ISSUE 8, serving/residency.py) ----
 #
 # The residency manager owns the ledger; these helpers only declare the

@@ -24,7 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any
+import os
+from typing import Any, Iterable
 
 import jax
 import jax.numpy as jnp
@@ -59,8 +60,100 @@ from chiaswarm_tpu.schedulers import (
     scale_model_input,
     scale_model_input_rows,
 )
+from chiaswarm_tpu.obs.metrics import (
+    STEPPER_UNET_EVAL_MODES,
+    steps_skipped_counter,
+    unet_evals_counter,
+    unet_evals_per_image_histogram,
+)
 from chiaswarm_tpu.schedulers.common import ScheduleConfig
 from chiaswarm_tpu.schedulers.sampling import SamplerState, init_sampler_state
+
+# ---- step collapse: DeepCache feature reuse (ISSUE 12) -----------------
+#
+# The denoise loop's dominant cost is steps x full-UNet. DeepCache (Ma
+# et al. 2023) observes that the DEEP UNet features change slowly across
+# adjacent steps: on designated steps the deep blocks are skipped and
+# their cached activation is replayed, only the shallow level-0 blocks
+# recompute (models/unet.py documents the seam). Master switch is
+# ``CHIASWARM_DEEPCACHE``; the schedule itself is PER JOB
+# (``GenerateRequest.reuse_schedule`` / the job's ``reuse_schedule``
+# parameter) and rides as a TRACED table, so changing it never
+# recompiles — the executable is keyed only by the static ``reuse``
+# flag, and with the env off the lowered program is byte-identical to
+# the pre-reuse build (the PR-11 taps-off gate pattern).
+
+ENV_DEEPCACHE = "CHIASWARM_DEEPCACHE"
+
+#: step-collapse observability (obs/metrics.py, ISSUE 12): per-row UNet
+#: evaluations by mode, deep-blocks-skipped steps, and the per-image
+#: full-eval histogram — pre-seeded so dashboards see zeroes from the
+#: first scrape (the ISSUE-6 convention)
+_UNET_EVALS = unet_evals_counter()
+_STEPS_SKIPPED = steps_skipped_counter()
+_EVALS_PER_IMAGE = unet_evals_per_image_histogram()
+for _mode in STEPPER_UNET_EVAL_MODES:
+    _UNET_EVALS.inc(0, mode=_mode)
+_STEPS_SKIPPED.inc(0)
+
+
+def deepcache_enabled() -> bool:
+    """DeepCache feature reuse is OPT-IN (quality-gated like int8
+    weights, ISSUE 8): with the env unset/off every per-job
+    ``reuse_schedule`` is ignored and the compiled programs are the
+    pre-reuse builds bit for bit."""
+    return os.environ.get(ENV_DEEPCACHE, "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def normalize_reuse_schedule(steps: int, schedule: Iterable[int] | str,
+                             start_step: int = 0) -> tuple[int, ...]:
+    """Canonicalize a per-job DeepCache reuse schedule.
+
+    Accepts an iterable of ladder indices (the steps whose deep blocks
+    replay the cache) or the compact cadence form ``"every:N"`` —
+    refresh the cache every Nth executed step, reuse the rest (N=3
+    skips 2 of every 3 deep passes). Indices must lie strictly inside
+    ``(start_step, steps)``: the first executed step has no cache to
+    reuse, and out-of-range indices are a caller error, not a silent
+    no-op. Returns a sorted, deduplicated tuple — the canonical form
+    checkpoints record and resume validation compares
+    (serving/stepper.py::_validate_resume)."""
+    if isinstance(schedule, str):
+        text = schedule.strip().lower()
+        if not text.startswith("every:"):
+            raise ValueError(
+                f"reuse_schedule string must be 'every:N', got "
+                f"{schedule!r}")
+        try:
+            cadence = int(text.split(":", 1)[1])
+        except ValueError as exc:
+            raise ValueError(
+                f"reuse_schedule cadence in {schedule!r} is not an "
+                f"integer") from exc
+        if cadence < 2:
+            raise ValueError("reuse cadence must be >= 2 (1 would never "
+                             "refresh the cache)")
+        schedule = [i for i in range(start_step + 1, steps)
+                    if (i - start_step) % cadence != 0]
+    try:
+        out = sorted({int(i) for i in schedule})
+    except (TypeError, ValueError) as exc:
+        # a bare int / None entries must stay a ValueError: the lane
+        # path converts ValueError to LaneReject and the solo path's
+        # canonical user error is classified fatal-bad-request — a
+        # TypeError here would escape into the breaker taxonomy and
+        # let K malformed requests quarantine a healthy model
+        raise ValueError(
+            f"reuse_schedule must be 'every:N' or an iterable of "
+            f"ladder indices, got {schedule!r}") from exc
+    for i in out:
+        if not start_step < i < steps:
+            raise ValueError(
+                f"reuse step {i} outside the executed ladder "
+                f"({start_step}, {steps}) — the first executed step "
+                f"must run the full UNet to fill the cache")
+    return tuple(out)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +203,12 @@ class GenerateRequest:
     control_scale: float = 1.0             # traced; never recompiles
     # instruct-pix2pix dual guidance (image_conditioned families)
     image_guidance_scale: float = 1.5      # traced; never recompiles
+    # DeepCache step-level feature reuse (ISSUE 12): ladder indices
+    # whose deep UNet blocks replay the cached activation, or the
+    # "every:N" cadence form — see normalize_reuse_schedule. Ignored
+    # unless CHIASWARM_DEEPCACHE is on; rides as a traced table, so
+    # per-job schedules never recompile.
+    reuse_schedule: tuple[int, ...] | str | None = None
 
 
 def _make_text_encode(text_encoders):
@@ -273,7 +372,8 @@ class DiffusionPipeline:
     def _build_fn(self, *, batch: int, height: int, width: int, steps: int,
                   start_step: int, sampler: SamplerConfig, use_cfg: bool,
                   has_init: bool, has_mask: bool, tiled: bool,
-                  has_control: bool = False, has_noise: bool = False):
+                  has_control: bool = False, has_noise: bool = False,
+                  reuse: bool = False):
         # capture only the static module descriptions — NOT the Components
         # bundle, whose .params would otherwise stay pinned by the
         # executable-cache closure after the param LRU evicts them
@@ -300,10 +400,17 @@ class DiffusionPipeline:
         encode_text = _make_text_encode(text_encoders)
 
         pix2pix = fam.image_conditioned
+        if reuse and (pix2pix or has_control):
+            # dual-CFG conditioning and the ControlNet trunk both feed
+            # the deep blocks per step — skipping those blocks while
+            # still paying their conditioning is incoherent; submit()
+            # never requests this combination
+            raise ValueError("DeepCache reuse supports the plain "
+                             "txt2img/img2img/inpaint programs only")
 
         def fn(params, ids, neg_ids, sample_keys, guidance, init_latent,
                mask, control_params, control_cond, control_scale,
-               image_guidance, noise_override):
+               image_guidance, noise_override, reuse_tab=None):
             # int8 weight residency (convert/quantize.py): dequantize AT
             # USE, inside the traced program — HBM holds the int8 codes,
             # XLA fuses the casts into the consumers. No-op on fp trees.
@@ -372,8 +479,42 @@ class DiffusionPipeline:
                 if use_cfg:
                     cond_emb = jnp.concatenate([cond_emb, cond_emb], axis=0)
 
+            if reuse:
+                # DeepCache carry: the deep activation for the (CFG-
+                # expanded) batch + a validity flag. Both branches of the
+                # lax.cond are compiled ONCE — the per-step reuse_tab
+                # lookup selects at run time, so any schedule rides the
+                # same executable and only the taken branch executes.
+                cache0 = jnp.zeros(
+                    ((2 * batch if use_cfg else batch), lh, lw,
+                     fam.unet.block_out_channels[1]), unet.dtype)
+
+                def unet_reuse_eval(inp_b, t_b, ctx_b, added_b, cache, ok,
+                                    i):
+                    reuse_now = jnp.logical_and(reuse_tab[i], ok)
+
+                    def shallow(ops):
+                        inp_b, t_b, cache = ops
+                        out = unet.apply(params["unet"], inp_b, t_b,
+                                         ctx_b, added_b,
+                                         cached_deep=cache)
+                        return out, cache
+
+                    def full(ops):
+                        inp_b, t_b, _cache = ops
+                        return unet.apply(params["unet"], inp_b, t_b,
+                                          ctx_b, added_b,
+                                          return_deep=True)
+
+                    out, cache = jax.lax.cond(reuse_now, shallow, full,
+                                              (inp_b, t_b, cache))
+                    return out, cache, jnp.ones((), bool)
+
             def body(carry, idx):
-                x, state, carry_keys = carry
+                if reuse:
+                    x, state, carry_keys, cache, cache_ok = carry
+                else:
+                    x, state, carry_keys = carry
                 i = idx + start_step
                 inp = scale_model_input(sched, x, i)
                 if pix2pix:
@@ -396,8 +537,12 @@ class DiffusionPipeline:
                         down_res, mid_res = control_net.apply(
                             control_params["net"], inp2, t2, ctx, cond_emb,
                             added, control_scale)
-                    out = unet.apply(params["unet"], inp2, t2, ctx, added,
-                                     down_res, mid_res)
+                    if reuse:
+                        out, cache, cache_ok = unet_reuse_eval(
+                            inp2, t2, ctx, added, cache, cache_ok, i)
+                    else:
+                        out = unet.apply(params["unet"], inp2, t2, ctx,
+                                         added, down_res, mid_res)
                     eps_u, eps_c = jnp.split(out, 2, axis=0)
                     eps = eps_u + guidance * (eps_c - eps_u)
                 else:
@@ -407,8 +552,12 @@ class DiffusionPipeline:
                         down_res, mid_res = control_net.apply(
                             control_params["net"], inp, t1, ctx, cond_emb,
                             added, control_scale)
-                    eps = unet.apply(params["unet"], inp, t1, ctx, added,
-                                     down_res, mid_res)
+                    if reuse:
+                        eps, cache, cache_ok = unet_reuse_eval(
+                            inp, t1, ctx, added, cache, cache_ok, i)
+                    else:
+                        eps = unet.apply(params["unet"], inp, t1, ctx,
+                                         added, down_res, mid_res)
                 eps = _numerics.tap("diffusion.eps", eps, step=i)
                 keys, skeys = jax.vmap(
                     lambda k: tuple(jax.random.split(k)))(carry_keys)
@@ -424,13 +573,16 @@ class DiffusionPipeline:
                     x = reproject_known(sched, i, x, known, mask, renoise)
                 # the scheduler carry: the value the next step consumes
                 x = _numerics.tap("diffusion.latents", x, step=i)
+                if reuse:
+                    return (x, state, keys, cache, cache_ok), None
                 return (x, state, keys), None
 
             n_steps = steps - start_step
-            (x, _, _), _ = jax.lax.scan(
-                body, (x, init_sampler_state(x), sample_keys),
-                jnp.arange(n_steps)
-            )
+            carry0 = ((x, init_sampler_state(x), sample_keys, cache0,
+                       jnp.zeros((), bool)) if reuse
+                      else (x, init_sampler_state(x), sample_keys))
+            carry_out, _ = jax.lax.scan(body, carry0, jnp.arange(n_steps))
+            x = carry_out[0]
             x = _numerics.tap("diffusion.final_latents", x)
 
             if tiled:
@@ -559,7 +711,7 @@ class DiffusionPipeline:
 
     def stepper_step_fn(self, *, batch: int, height: int, width: int,
                         steps_cap: int, sampler: SamplerConfig,
-                        has_control: bool = False):
+                        has_control: bool = False, reuse: bool = False):
         """ONE denoise step over a full lane of ``batch`` rows.
 
         Per-row traced state: latents, carry keys, step index, start
@@ -584,11 +736,24 @@ class DiffusionPipeline:
         conditioning-scale vector. Control lanes are keyed by bundle
         (serving/stepper.py), so every row shares the branch params
         while conditioning images/scales stay per row.
+
+        ``reuse`` compiles the DeepCache branch in (ISSUE 12): the lane
+        additionally carries per-row cached deep activations (uncond +
+        cond halves) and takes a scalar ``reuse_now`` flag the DRIVER
+        decides host-side — True only when every active row's schedule
+        wants reuse at its current step AND holds a valid cache (so the
+        lax.cond stays a scalar branch the compiled program executes
+        one side of; mixed lanes degrade to full evals, never to wrong
+        math). Reuse lanes are keyed separately, so with the env off
+        every lane runs this program's pre-reuse build unchanged.
         """
         fam = self.c.family
         unet = self.c.unet
         lh, lw = self._latent_hw(height, width)
         needs_xl = fam.unet.addition_embed_dim is not None
+        if reuse and has_control:
+            raise ValueError("DeepCache reuse lanes do not take the "
+                             "ControlNet branch")
 
         control_net = None
         if has_control:
@@ -600,7 +765,8 @@ class DiffusionPipeline:
             def fn(params, ctx_u, ctx_c, pooled_u, pooled_c, x, carry_keys,
                    idx, start_idx, sigmas_tab, ts_tab, guidance,
                    old_denoised, active, known, mask, mask_on,
-                   control_params, cond, cscale):
+                   control_params, cond, cscale,
+                   cache_u=None, cache_c=None, reuse_now=None):
                 params = dequantize_tree(params)
                 control_params = dequantize_tree(control_params)
                 sched_rows = SamplingSchedule(sigmas=sigmas_tab,
@@ -630,10 +796,37 @@ class DiffusionPipeline:
                     down_res, mid_res = control_net.apply(
                         control_params["net"], inp2, t2, ctx, cond2,
                         added, scale2)
-                out = unet.apply(params["unet"], inp2, t2, ctx, added,
-                                 down_res, mid_res)
+                if reuse:
+                    cache2 = jnp.concatenate([cache_u, cache_c], axis=0)
+
+                    def shallow(ops):
+                        inp2, t2, cache2 = ops
+                        out = unet.apply(params["unet"], inp2, t2, ctx,
+                                         added, cached_deep=cache2)
+                        return out, cache2
+
+                    def full(ops):
+                        inp2, t2, _cache2 = ops
+                        return unet.apply(params["unet"], inp2, t2, ctx,
+                                          added, return_deep=True)
+
+                    out, cache2 = jax.lax.cond(reuse_now, shallow, full,
+                                               (inp2, t2, cache2))
+                    cache_u_next, cache_c_next = jnp.split(cache2, 2,
+                                                           axis=0)
+                else:
+                    out = unet.apply(params["unet"], inp2, t2, ctx, added,
+                                     down_res, mid_res)
                 eps_u, eps_c = jnp.split(out, 2, axis=0)
-                eps = eps_u + guidance.reshape(-1, 1, 1, 1) * (eps_c - eps_u)
+                # per-row CFG combine; guidance <= 1 selects the pure
+                # conditional prediction — the CFG-free few-step mode
+                # (lcm rows, schedulers/sampling.py FEWSTEP_KINDS).
+                # For guidance > 1 the selected value is the identical
+                # expression as before, so existing rows keep their
+                # solo trajectories bit for bit.
+                g = guidance.reshape(-1, 1, 1, 1)
+                eps = jnp.where(g > 1.0, eps_u + g * (eps_c - eps_u),
+                                eps_c)
                 both = jax.vmap(jax.random.split)(carry_keys)
                 keys, skeys = both[:, 0], both[:, 1]
                 step_noise = jax.vmap(lambda k: jax.random.normal(
@@ -662,16 +855,23 @@ class DiffusionPipeline:
                 new_old = jnp.where(act, state.old_denoised, old_denoised)
                 keys = jnp.where(active.reshape(-1, 1), keys, carry_keys)
                 idx_next = idx + active.astype(idx.dtype)
+                if reuse:
+                    return (x_next, keys, idx_next, new_old,
+                            cache_u_next, cache_c_next)
                 return x_next, keys, idx_next, new_old
 
             return seq_parallel_wrap(toplevel_jit(fn), self.c.params)
 
+        # the reuse flag joins the static key only when set, so every
+        # pre-existing lane bucket keeps its historical key (and cached
+        # executable) byte for byte
+        statics = {"batch": batch, "height": height,
+                   "width": width, "steps_cap": steps_cap,
+                   "sampler": sampler, "has_control": has_control}
+        if reuse:
+            statics["reuse"] = True
         return GLOBAL_CACHE.cached_executable(
-            static_cache_key(id(self.c), "stepper_step",
-                             {"batch": batch, "height": height,
-                              "width": width, "steps_cap": steps_cap,
-                              "sampler": sampler,
-                              "has_control": has_control}), build)
+            static_cache_key(id(self.c), "stepper_step", statics), build)
 
     def stepper_control_embed_fn(self, *, height: int, width: int):
         """(embed_params, cond (1, H, W, 3) in [0, 1]) -> (1, lh, lw, C0)
@@ -867,6 +1067,17 @@ class DiffusionPipeline:
                         init_latent,
                         NamedSharding(mesh, P("data", None, None, None)))
 
+            # DeepCache (ISSUE 12): the per-job reuse schedule engages
+            # only behind the env switch and never for the dual-CFG /
+            # ControlNet programs; OFF means the pre-reuse executable
+            # bit for bit (same static key, no reuse table traced in)
+            schedule: tuple[int, ...] = ()
+            if req.reuse_schedule and deepcache_enabled() \
+                    and not fam.image_conditioned and not has_control:
+                schedule = normalize_reuse_schedule(
+                    steps, req.reuse_schedule, start_step)
+            reuse = bool(schedule)
+
             has_noise = req.init_noise is not None
             noise_arr = jnp.zeros((1,), jnp.float32)  # placeholder
             if has_noise:
@@ -903,12 +1114,15 @@ class DiffusionPipeline:
             enc_span.end()
         with span("step", steps=steps, batch=batch), \
                 annotate("swarm.generate"):
+            # ``reuse`` joins the static set only when ON: every plain
+            # request keeps its historical cache key (and executable)
             fn = self._get_fn(
                 batch=batch, height=height, width=width, steps=steps,
                 start_step=start_step, sampler=sampler, use_cfg=use_cfg,
                 has_init=has_init, has_mask=has_mask,
                 tiled=req.tiled_decode,
                 has_control=has_control, has_noise=has_noise,
+                **({"reuse": True} if reuse else {}),
             )
             # one independent key per batch row: fold the row index into
             # the row's seed, so row b is reproducible at ANY batch size
@@ -923,7 +1137,7 @@ class DiffusionPipeline:
             sample_keys = jnp.stack(
                 [jax.random.fold_in(key_for_seed(int(s)), int(r))
                  for s, r in pairs])
-            img = fn(
+            args = [
                 self.c.params,
                 ids,
                 neg,
@@ -936,7 +1150,22 @@ class DiffusionPipeline:
                 jnp.float32(req.control_scale),
                 jnp.float32(req.image_guidance_scale),
                 noise_arr,
-            )
+            ]
+            if reuse:
+                tab = np.zeros(steps, bool)
+                tab[list(schedule)] = True
+                args.append(jnp.asarray(tab))
+            img = fn(*args)
+        # step-collapse accounting (ISSUE 12): FULL UNet evals each image
+        # pays — the cost term BENCH's >=4x reduction gate reads — plus
+        # the live counter/histogram families
+        full_evals = (steps - start_step) - len(schedule)
+        _UNET_EVALS.inc(req.batch * full_evals, mode="full")
+        if schedule:
+            _UNET_EVALS.inc(req.batch * len(schedule), mode="reuse")
+            _STEPS_SKIPPED.inc(req.batch * len(schedule))
+        for _ in range(req.batch):
+            _EVALS_PER_IMAGE.observe(full_evals)
         config = {
             "model_name": self.c.model_name,
             "family": fam.name,
@@ -945,6 +1174,8 @@ class DiffusionPipeline:
             # ladder position actually executed (img2img strength maps to
             # a start index; the quantization is an observable contract)
             "denoise_steps": steps - start_step,
+            "unet_evals": full_evals,
+            "steps_skipped": len(schedule),
             "guidance_scale": float(req.guidance_scale),
             "size": [req.height, req.width],
             "compiled_size": [height, width],
@@ -953,6 +1184,8 @@ class DiffusionPipeline:
                      "inpaint" if has_mask else
                      "img2img" if has_init else "txt2img"),
         }
+        if schedule:
+            config["reuse_schedule"] = list(schedule)
         if fam.image_conditioned:
             config["image_guidance_scale"] = float(req.image_guidance_scale)
         if has_control:

@@ -18,6 +18,7 @@ from chiaswarm_tpu.schedulers.common import (
     velocity_target,
 )
 from chiaswarm_tpu.schedulers.sampling import (
+    FEWSTEP_KINDS,
     SamplerConfig,
     SamplingSchedule,
     make_sampling_schedule,
@@ -33,6 +34,7 @@ from chiaswarm_tpu.schedulers.sampling import (
 )
 
 __all__ = [
+    "FEWSTEP_KINDS",
     "NoiseSchedule",
     "make_noise_schedule",
     "add_noise",

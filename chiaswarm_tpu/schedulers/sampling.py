@@ -36,11 +36,25 @@ from chiaswarm_tpu.schedulers.common import (
 class SamplerConfig:
     """Static sampler selection — part of the jit cache key."""
 
-    kind: str = "dpmpp_2m"  # "euler" | "ddim" | "euler_ancestral" | "dpmpp_2m" | "heun"
+    kind: str = "dpmpp_2m"  # "euler" | "ddim" | "euler_ancestral" | "dpmpp_2m" | "heun" | "lcm"
     use_karras_sigmas: bool = True
     timestep_spacing: str = "leading"  # "leading" | "trailing" | "linspace"
     steps_offset: int = 1
     prediction_type: str = "epsilon"
+
+
+#: sampler kinds whose contract is the FEW-STEP regime (2–8 steps,
+#: guidance embedded by distillation — CFG optional, guidance <= 1 is
+#: the native mode). Lane eligibility consults this set: a guidance<=1
+#: job of one of these kinds still rides a lane (workloads/diffusion.py)
+#: instead of falling to the solo no-CFG program.
+FEWSTEP_KINDS = frozenset({"lcm"})
+
+#: LCM boundary-condition constants (Luo et al. 2023): sigma_data is the
+#: consistency-model data scale, timestep_scaling the distillation pin —
+#: both fixed by the published LCM/LCM-LoRA training recipe, not tunables.
+LCM_SIGMA_DATA = 0.5
+LCM_TIMESTEP_SCALING = 10.0
 
 
 class SamplingSchedule(NamedTuple):
@@ -163,6 +177,24 @@ def sampler_step(
         sigma_down = jnp.sqrt(jnp.maximum(sigma_next ** 2 - sigma_up ** 2, 0.0))
         d = (x - denoised) / sigma
         x_next = x + (sigma_down - sigma) * d + noise.astype(compute) * sigma_up
+    elif config.kind == "lcm":
+        # Latent Consistency Model multistep (Luo et al. 2023): the
+        # boundary condition blends the VP-space sample with the x0
+        # estimate via c_skip/c_out (exact identity at sigma -> 0), then
+        # the sampler re-noises FULLY onto the next ladder level — not
+        # ancestral's partial sigma_up. Each step lands on a
+        # self-consistent x0 estimate, which is why 2–8 steps suffice
+        # for a distilled checkpoint. ``denoised`` is rebound to the
+        # boundary-conditioned value so the final-step override below
+        # returns it (LCMScheduler's last step emits denoised, no noise).
+        if noise is None:
+            raise ValueError("lcm requires noise")
+        ts = sched.timesteps[i] * LCM_TIMESTEP_SCALING
+        c_skip = LCM_SIGMA_DATA ** 2 / (ts ** 2 + LCM_SIGMA_DATA ** 2)
+        c_out = ts / jnp.sqrt(ts ** 2 + LCM_SIGMA_DATA ** 2)
+        sample_vp = x / jnp.sqrt(sigma ** 2 + 1.0)
+        denoised = c_skip * sample_vp + c_out * denoised
+        x_next = denoised + noise.astype(compute) * sigma_next
     elif config.kind == "dpmpp_2m":
         # DPM-Solver++(2M), data-prediction multistep, sigma domain.
         t_fn = lambda s: -jnp.log(jnp.maximum(s, 1e-10))
@@ -256,6 +288,12 @@ SAMPLERS: dict[str, str] = {
     "KDPM2DiscreteScheduler": "dpmpp_2m",
     "LMSDiscreteScheduler": "euler",
     "DDPMScheduler": "euler_ancestral",
+    # few-step family (ISSUE 12): LCM-distilled checkpoints and the
+    # trajectory-consistency variant resolve onto the lcm boundary-
+    # condition step — the hive requests them by class name exactly
+    # like every other scheduler
+    "LCMScheduler": "lcm",
+    "TCDScheduler": "lcm",
 }
 
 
@@ -264,6 +302,19 @@ def resolve(name: str | None, *, prediction_type: str = "epsilon",
     """Map a hive-supplied diffusers scheduler class name to a SamplerConfig
     (parity with get_type-based resolution at swarm/job_arguments.py:143-148)."""
     kind = SAMPLERS.get(name or "", "dpmpp_2m")
+    if kind == "lcm":
+        # the timestep-SHIFTED few-step ladder: trailing spacing lands
+        # the first step at t=999 (the distillation boundary) and the
+        # last near the data end — LCMScheduler's lcm-origin ladder
+        # selects the same suffix. Karras respacing would move the
+        # boundary timesteps the distillation pinned, so it is forced
+        # off for this kind regardless of the caller's default.
+        return SamplerConfig(
+            kind=kind,
+            use_karras_sigmas=False,
+            timestep_spacing="trailing",
+            prediction_type=prediction_type,
+        )
     return SamplerConfig(
         kind=kind,
         use_karras_sigmas=use_karras_sigmas,

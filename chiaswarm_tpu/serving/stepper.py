@@ -104,11 +104,15 @@ import numpy as np
 from chiaswarm_tpu.obs import numerics as _numerics
 from chiaswarm_tpu.obs.metrics import (
     REGISTRY,
+    STEPPER_UNET_EVAL_MODES,
     arrival_rate_gauge,
     lane_admissions_counter,
     lane_occupancy_histogram,
     lane_resizes_counter,
     resume_step_histogram,
+    steps_skipped_counter,
+    unet_evals_counter,
+    unet_evals_per_image_histogram,
 )
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
@@ -157,6 +161,13 @@ _CKPT_SECONDS = REGISTRY.histogram(
 _LANE_RESIZES = lane_resizes_counter()
 _ARRIVAL_RATE = arrival_rate_gauge()
 _LANE_ADMISSIONS = lane_admissions_counter()
+# step-collapse families (ISSUE 12): per-row UNet evals by mode, deep-
+# blocks-skipped steps, and the per-image full-eval histogram — shared
+# with the solo path (pipelines/diffusion.py increments the same
+# process-global families per submitted job)
+_UNET_EVALS = unet_evals_counter()
+_STEPS_SKIPPED = steps_skipped_counter()
+_EVALS_PER_IMAGE = unet_evals_per_image_histogram()
 
 ENV_ENABLE = "CHIASWARM_STEPPER"
 ENV_LANE_WIDTH = "CHIASWARM_STEPPER_LANE_WIDTH"
@@ -180,6 +191,9 @@ for _direction in ("grow", "shrink"):
     _LANE_RESIZES.inc(0, direction=_direction)
 for _workload in WORKLOADS:
     _LANE_ADMISSIONS.inc(0, workload=_workload)
+for _mode in STEPPER_UNET_EVAL_MODES:
+    _UNET_EVALS.inc(0, mode=_mode)
+_STEPS_SKIPPED.inc(0)
 
 
 # ---- resume-state packing ------------------------------------------------
@@ -277,6 +291,15 @@ class _RowJob:                    # must never compare device/numpy fields
     mask0: Any = None           # (n, lh, lw, 1) latent mask, 1=regenerate
     cond0: Any = None           # (n, lh, lw, C0) pre-embedded hint
     cscale: float = 1.0         # ControlNet conditioning scale
+    # DeepCache step-level reuse (ISSUE 12): the canonical per-job
+    # schedule plus resume state — cached deep activations (uncond/cond
+    # halves), cache validity, and the skipped-steps tally so a resumed
+    # row's per-image eval accounting stays whole-trajectory
+    reuse_schedule: tuple[int, ...] = ()
+    cache_u0: Any = None        # (n, lh, lw, C1) restored deep cache
+    cache_c0: Any = None
+    cache_ok0: bool = False
+    skipped0: int = 0
 
     @property
     def idx0(self) -> int:
@@ -383,7 +406,8 @@ class Lane:
     def __init__(self, sched: "StepScheduler", key: tuple, pipe,
                  *, width: int, height: int, width_px: int,
                  steps_cap: int, sampler, control: Any = None,
-                 width_bounds: tuple[int, int] | None = None) -> None:
+                 width_bounds: tuple[int, int] | None = None,
+                 reuse: bool = False) -> None:
         self._sched = sched
         self.key = key
         self.pipe = pipe
@@ -395,6 +419,10 @@ class Lane:
         # ControlNet lanes are keyed by bundle: every row shares the
         # branch params; hint embeddings + scales stay per row
         self.ctrl = control
+        # DeepCache lanes (ISSUE 12) compile the reuse branch in and
+        # carry per-row deep-feature caches; keyed separately so plain
+        # lanes keep the pre-reuse program
+        self.reuse = bool(reuse)
         self.lane_id = next(Lane._ids)
         self._cond = threading.Condition()
         self._pending: collections.deque[_RowJob] = collections.deque()
@@ -424,6 +452,13 @@ class Lane:
         self._h_active = np.zeros(self.width, bool)
         self._h_mask_on = np.zeros(self.width, bool)
         self._h_cscale = np.ones(self.width, np.float32)
+        # DeepCache row state (reuse lanes only; kept allocated either
+        # way so the resize remap stays uniform): which ladder steps
+        # each row's schedule wants reused, whether its cache is valid
+        # (a full step ran since admission), and its skipped tally
+        self._h_reuse = np.zeros((self.width, self.steps_cap), bool)
+        self._h_cache_ok = np.zeros(self.width, bool)
+        self._h_skipped = np.zeros(self.width, np.int64)
         self._dev = None  # device state dict, allocated at first admission
         self._mesh = None
         self._deferred_counts: list[dict] = []
@@ -686,6 +721,15 @@ class Lane:
                      jnp.zeros((self.width,) + job.cond0.shape[1:],
                                job.cond0.dtype)),
         }
+        if self.reuse:
+            # per-row cached deep activations (uncond/cond halves) —
+            # the DeepCache carry the step program refreshes on full
+            # steps and replays on reuse steps
+            c1 = self.pipe.c.family.unet.block_out_channels[1]
+            cache_row = jnp.zeros((self.width, lh, lw, c1),
+                                  self.pipe.c.unet.dtype)
+            self._dev["cache_u"] = cache_row
+            self._dev["cache_c"] = cache_row
         self._sync_tables()
 
     def _place_rows(self) -> None:
@@ -751,7 +795,8 @@ class Lane:
             # compiling outputs, so the barrier is correctness, not style.
             for arr in (job.x0, job.keys0, job.ctx_u, job.ctx_c,
                         job.pooled_u, job.pooled_c, job.old0,
-                        job.known0, job.mask0, job.cond0):
+                        job.known0, job.mask0, job.cond0,
+                        job.cache_u0, job.cache_c0):
                 if arr is not None:
                     arr.block_until_ready()
             slots, free = free[:job.n_rows], free[job.n_rows:]
@@ -783,6 +828,20 @@ class Lane:
                 else job.mask0)
             if job.cond0 is not None:
                 dev["cond"] = dev["cond"].at[sel].set(job.cond0)
+            if self.reuse:
+                # a fresh row starts cache-invalid (its first step runs
+                # the full network); a resumed row restores its cache +
+                # validity + skipped tally exactly as checkpointed
+                dev["cache_u"] = dev["cache_u"].at[sel].set(
+                    0.0 if job.cache_u0 is None else job.cache_u0)
+                dev["cache_c"] = dev["cache_c"].at[sel].set(
+                    0.0 if job.cache_c0 is None else job.cache_c0)
+                self._h_reuse[sel, :] = False
+                for step_j in job.reuse_schedule:
+                    if 0 <= int(step_j) < self.steps_cap:
+                        self._h_reuse[sel, int(step_j)] = True
+                self._h_cache_ok[sel] = bool(job.cache_ok0)
+                self._h_skipped[sel] = int(job.skipped0)
             self._h_idx[sel] = job.idx0
             self._h_start[sel] = job.start_step
             self._h_mask_on[sel] = job.mask0 is not None
@@ -856,7 +915,8 @@ class Lane:
         self._deferred_counts.append(dict(lane_resizes=1))
         old_h = (self._h_start, self._h_idx, self._h_sig, self._h_ts,
                  self._h_guid, self._h_active, self._h_mask_on,
-                 self._h_cscale)
+                 self._h_cscale, self._h_reuse, self._h_cache_ok,
+                 self._h_skipped)
         self._h_start = np.zeros(self.width, np.int32)
         self._h_idx = np.zeros(self.width, np.int32)
         self._h_sig = np.ones((self.width, self.steps_cap + 1), np.float32)
@@ -865,9 +925,13 @@ class Lane:
         self._h_active = np.zeros(self.width, bool)
         self._h_mask_on = np.zeros(self.width, bool)
         self._h_cscale = np.ones(self.width, np.float32)
+        self._h_reuse = np.zeros((self.width, self.steps_cap), bool)
+        self._h_cache_ok = np.zeros(self.width, bool)
+        self._h_skipped = np.zeros(self.width, np.int64)
         new_mirrors = (self._h_start, self._h_idx, self._h_sig, self._h_ts,
                        self._h_guid, self._h_active, self._h_mask_on,
-                       self._h_cscale)
+                       self._h_cscale, self._h_reuse, self._h_cache_ok,
+                       self._h_skipped)
         for new_s, (old_s, _) in enumerate(occupied):
             for old_m, new_m in zip(old_h, new_mirrors):
                 new_m[new_s] = old_m[old_s]
@@ -909,8 +973,21 @@ class Lane:
         fn = self.pipe.stepper_step_fn(
             batch=self.width, height=self.height, width=self.width_px,
             steps_cap=self.steps_cap, sampler=self.sampler,
-            has_control=self.ctrl is not None)
+            has_control=self.ctrl is not None, reuse=self.reuse)
         import jax.numpy as jnp
+
+        # DeepCache step decision (ISSUE 12), made HOST-side from the
+        # mirrors this driver owns: skip the deep blocks only when EVERY
+        # active row's schedule wants reuse at its current step AND
+        # holds a valid cache. The flag rides as a traced scalar, so
+        # the decision never recompiles; misaligned lane mates degrade
+        # the step to a full eval — more compute, never wrong math.
+        reuse_now = False
+        if self.reuse and self._h_active.any():
+            step_of = np.minimum(self._h_idx, self.steps_cap - 1)
+            wants = self._h_reuse[np.arange(self.width), step_of]
+            reuse_now = bool(np.all(
+                ~self._h_active | (wants & self._h_cache_ok)))
 
         ctrl_params = (self.ctrl.params if self.ctrl is not None
                        else {"zero": jnp.zeros((1,), jnp.float32)})
@@ -956,7 +1033,7 @@ class Lane:
         fired = False
         try:
             with annotate("swarm.lane.step"):
-                dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
+                base_args = (
                     self.pipe.c.params,
                     dev["ctx_u"], dev["ctx_c"], dev["pooled_u"],
                     dev["pooled_c"],
@@ -966,6 +1043,14 @@ class Lane:
                     dev["known"], dev["mask"], dev["mask_on"],
                     ctrl_params, dev["cond"], dev["cscale"],
                 )
+                if self.reuse:
+                    (dev["x"], dev["keys"], dev["idx"], dev["old"],
+                     dev["cache_u"], dev["cache_c"]) = fn(
+                        *base_args, dev["cache_u"], dev["cache_c"],
+                        jnp.asarray(reuse_now))
+                else:
+                    dev["x"], dev["keys"], dev["idx"], dev["old"] = fn(
+                        *base_args)
             wedge_s = chaos.wedge_at(chaos_step)
             if wedge_s > 0:  # scripted wedged-compiled-call stand-in
                 log.warning("chaos: wedging lane %d step %d for %.1fs",
@@ -1014,6 +1099,19 @@ class Lane:
                             this_step)
                 dev["x"] = dev["x"].at[row].set(jnp.nan)
         active = int(self._h_active.sum())
+        if self.reuse and reuse_now:
+            # this dispatch replayed the deep cache: every active row
+            # skipped its deep blocks — the step-collapse tally the
+            # per-image eval accounting and /metrics families read
+            self._h_skipped[self._h_active] += 1
+            _UNET_EVALS.inc(active, mode="reuse")
+            _STEPS_SKIPPED.inc(active)
+            self._sched._count(steps_reused=1, row_steps_reused=active)
+        else:
+            if self.reuse:
+                # a full step refreshed every active row's cache
+                self._h_cache_ok[self._h_active] = True
+            _UNET_EVALS.inc(active, mode="full")
         self._h_idx[self._h_active] += 1
         self.steps_executed += 1
         self._sched._count(steps_executed=1, row_steps_active=active,
@@ -1086,10 +1184,7 @@ class Lane:
                 compiled_hw=(self.height, self.width_px),
                 requested_hw=(self.height, self.width_px),
                 requested_batch=job.n_rows)
-            self._release_rows(job)
-            changed = True
-            self._sched._count(rows_completed=job.n_rows)
-            self._handoff.append((job, pending, {
+            info = {
                 "lane": self.lane_id,
                 "lane_width": self.width,
                 "admitted_at_step": job.admitted_at_step,
@@ -1097,7 +1192,22 @@ class Lane:
                 # the fleet-invariant proof point: >0 means this job was
                 # redelivered and resumed mid-trajectory, not restarted
                 "resume_step": job.resume_step,
-            }))
+            }
+            # per-image UNet-eval accounting (ISSUE 12): full evals this
+            # row actually paid over its WHOLE trajectory (the skipped
+            # tally survives resume), observed once per row
+            skipped = (int(self._h_skipped[job.slots[0]])
+                       if self.reuse and job.slots else 0)
+            evals = (job.steps - job.start_step) - skipped
+            for _ in range(job.n_rows):
+                _EVALS_PER_IMAGE.observe(evals)
+            if self.reuse:
+                info["unet_evals"] = evals
+                info["steps_skipped"] = skipped
+            self._release_rows(job)
+            changed = True
+            self._sched._count(rows_completed=job.n_rows)
+            self._handoff.append((job, pending, info))
         for job in expired:
             self._release_rows(job)
             changed = True
@@ -1145,7 +1255,7 @@ class Lane:
         t0 = time.perf_counter()
         # one transfer for the whole lane, sliced per job below
         x = np.asarray(self._dev["x"])
-        keys = old = None
+        keys = old = cache_u = cache_c = None
         written = 0
         poisoned: list[_RowJob] = []
         for job in jobs.values():
@@ -1186,6 +1296,25 @@ class Lane:
                 "keys": pack_array(keys[sel]),
                 "old": pack_array(old[sel]),
             }
+            if self.reuse:
+                # DeepCache resume state (ISSUE 12): the deep-feature
+                # caches + validity + skipped tally ride the snapshot,
+                # so a redelivered row replays the EXACT remaining
+                # reuse decisions — bit-identical to the uninterrupted
+                # run. The schedule itself is recorded for validation:
+                # a tampered schedule must restart clean, never finish
+                # a different trajectory under this job's identity.
+                if cache_u is None:
+                    cache_u = np.asarray(self._dev["cache_u"])
+                    cache_c = np.asarray(self._dev["cache_c"])
+                state.update({
+                    "reuse_schedule": [int(j) for j in
+                                       job.reuse_schedule],
+                    "cache_u": pack_array(cache_u[sel]),
+                    "cache_c": pack_array(cache_c[sel]),
+                    "cache_ok": bool(self._h_cache_ok[sel[0]]),
+                    "skipped": int(self._h_skipped[sel[0]]),
+                })
             self._ckpt_mem[id(job)] = state
             if self._spool is None:
                 continue
@@ -1486,7 +1615,8 @@ class StepScheduler:
                        init_image: Any = None, strength: float = 0.8,
                        mask: Any = None,
                        controlnet: Any = None, control_image: Any = None,
-                       control_scale: float = 1.0) -> Future:
+                       control_scale: float = 1.0,
+                       reuse_schedule: Any = None) -> Future:
         """Prepare a job's rows (tokenize, encode, ladder, initial noise
         — plus, per workload: init-latent VAE encode, latent-mask
         quantization, ControlNet hint embedding) and hand them to the
@@ -1524,10 +1654,17 @@ class StepScheduler:
         )
         from chiaswarm_tpu.schedulers import make_sampling_schedule, resolve
 
+        from chiaswarm_tpu.schedulers.sampling import FEWSTEP_KINDS
+
         fam = pipe.c.family
         if fam.kind != "sd" or fam.image_conditioned:
             raise LaneReject(f"family {fam.name!r} does not ride lanes")
-        if float(guidance_scale) <= 1.0:
+        sampler = resolve(scheduler, prediction_type=fam.prediction_type)
+        if float(guidance_scale) <= 1.0 and sampler.kind not in \
+                FEWSTEP_KINDS:
+            # few-step kinds are guidance-embedded (ISSUE 12): their
+            # CFG-free mode rides lanes — the per-row combine selects
+            # the pure conditional prediction for guidance <= 1 rows
             raise LaneReject("guidance <= 1 runs the solo (no-CFG) program")
         if mask is not None and init_image is None:
             raise LaneReject("inpainting requires an init image")
@@ -1561,9 +1698,28 @@ class StepScheduler:
         if rows > bounds_hi:
             raise LaneReject(
                 f"{rows} rows exceed the lane width cap {bounds_hi}")
-        sampler = resolve(scheduler, prediction_type=fam.prediction_type)
+        # DeepCache (ISSUE 12): the per-job schedule engages only behind
+        # the env switch and never alongside the ControlNet branch —
+        # schedule-carrying jobs ride reuse-keyed lanes whose program
+        # compiles the cache branch in; everything else keeps the plain
+        # lane program untouched
+        reuse: tuple[int, ...] = ()
+        if reuse_schedule:
+            from chiaswarm_tpu.pipelines.diffusion import (
+                deepcache_enabled,
+                normalize_reuse_schedule,
+            )
+
+            if deepcache_enabled() and controlnet is None:
+                try:
+                    reuse = normalize_reuse_schedule(
+                        steps, reuse_schedule, start_step)
+                except ValueError as exc:
+                    # the solo path raises the canonical user error
+                    raise LaneReject(str(exc)) from exc
         key = (id(pipe.c), height, width, cap, sampler,
-               None if controlnet is None else id(controlnet))
+               None if controlnet is None else id(controlnet),
+               bool(reuse))
         lane_rows = self.initial_width(rows, height, width)
         limit = self._width_limits.get(key)
         if limit is not None and limit < lane_rows:
@@ -1582,7 +1738,8 @@ class StepScheduler:
                     pipe, resume, steps=steps, rows=rows,
                     height=height, width=width,
                     guidance=float(guidance_scale),
-                    start=start_step, workload=workload)
+                    start=start_step, workload=workload,
+                    reuse_schedule=reuse)
             except ResumeReject as exc:
                 log.error("resume state for job %s rejected (%s); "
                           "restarting at step 0", job_id, exc)
@@ -1630,6 +1787,9 @@ class StepScheduler:
                         controlnet.params["embed"],
                         jnp.asarray(np.clip(cond, 0.0, 1.0))[None])
                 cond_rows = jnp.repeat(emb, rows, axis=0)
+            cache_u0 = cache_c0 = None
+            cache_ok0 = False
+            skipped0 = 0
             if restored is not None:
                 # redelivered rows: the context re-encodes (it is a pure
                 # function of the prompt), but latents/keys/history come
@@ -1637,6 +1797,14 @@ class StepScheduler:
                 carry_rows = jnp.asarray(restored["keys"])
                 x0_rows = jnp.asarray(restored["x"])
                 old_rows = jnp.asarray(restored["old"])
+                if reuse and "cache_u" in restored:
+                    # DeepCache resume: the deep caches + validity +
+                    # skipped tally splice back in, so the remaining
+                    # reuse decisions replay bit-identically
+                    cache_u0 = jnp.asarray(restored["cache_u"])
+                    cache_c0 = jnp.asarray(restored["cache_c"])
+                    cache_ok0 = bool(restored["cache_ok"])
+                    skipped0 = int(restored["skipped"])
             else:
                 # per-row noise keys: fold the row index into the job's
                 # seed — exactly the solo program's key derivation, so
@@ -1666,16 +1834,21 @@ class StepScheduler:
             known0=init_rows if mask is not None else None,
             mask0=mask_rows, cond0=cond_rows,
             cscale=float(control_scale),
+            reuse_schedule=reuse,
+            cache_u0=cache_u0, cache_c0=cache_c0,
+            cache_ok0=cache_ok0, skipped0=skipped0,
             deadline=time.monotonic() + (deadline_s if deadline_s is not None
                                          else self.row_deadline_s()))
         self._enqueue(key, pipe, job, lane_rows, height, width, cap, sampler,
-                      control=controlnet, bounds=(bounds_lo, bounds_hi))
+                      control=controlnet, bounds=(bounds_lo, bounds_hi),
+                      reuse=bool(reuse))
         return job.future
 
     def _validate_resume(self, pipe, resume: dict[str, Any], *,
                          steps: int, rows: int, height: int, width: int,
                          guidance: float, start: int = 0,
                          workload: str = "txt2img",
+                         reuse_schedule: tuple[int, ...] = (),
                          ) -> tuple[int, dict[str, np.ndarray]]:
         """Check a redelivered job's checkpoint against the job it claims
         to resume; returns (step, restored host arrays) or raises
@@ -1746,10 +1919,51 @@ class StepScheduler:
             raise ResumeReject(
                 f"key array {keys.dtype}{keys.shape} != expected "
                 f"{template.dtype}{(rows,) + template.shape}")
-        return step, {"x": x, "keys": keys, "old": old}
+        restored: dict[str, Any] = {"x": x, "keys": keys, "old": old}
+        # DeepCache identity (ISSUE 12): a checkpoint stepped under a
+        # DIFFERENT reuse schedule walked a different trajectory — it
+        # must not finish under this job's identity. Tampered schedules
+        # and missing/corrupt cache state restart clean.
+        try:
+            ck_reuse = tuple(int(j) for j in
+                             (resume.get("reuse_schedule") or ()))
+        except (TypeError, ValueError) as exc:
+            raise ResumeReject(
+                f"corrupt reuse_schedule: {exc}") from exc
+        if ck_reuse != tuple(reuse_schedule):
+            raise ResumeReject(
+                f"reuse-schedule mismatch: checkpoint {list(ck_reuse)}, "
+                f"job {list(reuse_schedule)}")
+        if reuse_schedule:
+            try:
+                cache_u = unpack_array(resume["cache_u"])
+                cache_c = unpack_array(resume["cache_c"])
+                cache_ok = bool(resume["cache_ok"])
+                skipped = int(resume["skipped"])
+            except (KeyError, TypeError, ValueError) as exc:
+                raise ResumeReject(
+                    f"corrupt DeepCache state: {exc}") from exc
+            c1 = pipe.c.family.unet.block_out_channels[1]
+            cache_dtype = np.dtype(pipe.c.unet.dtype)
+            want = (rows, lh, lw, c1)
+            if cache_u.shape != want or cache_c.shape != want:
+                raise ResumeReject(
+                    f"deep-cache shape {cache_u.shape} != {want}")
+            if cache_u.dtype != cache_dtype or \
+                    cache_c.dtype != cache_dtype:
+                raise ResumeReject(
+                    f"deep-cache dtype {cache_u.dtype}, lanes carry "
+                    f"{cache_dtype}")
+            if not 0 <= skipped < steps:
+                raise ResumeReject(
+                    f"skipped tally {skipped} outside [0, {steps})")
+            restored.update(cache_u=cache_u, cache_c=cache_c,
+                            cache_ok=cache_ok, skipped=skipped)
+        return step, restored
 
     def _enqueue(self, key, pipe, job, lane_rows, height, width, cap,
-                 sampler, control=None, bounds=None) -> None:
+                 sampler, control=None, bounds=None,
+                 reuse: bool = False) -> None:
         created = False
         with self._lock:
             lane = self._lanes.get(key)
@@ -1769,7 +1983,8 @@ class StepScheduler:
             if lane is None or not lane.try_enqueue(job):
                 lane = Lane(self, key, pipe, width=lane_rows, height=height,
                             width_px=width, steps_cap=cap, sampler=sampler,
-                            control=control, width_bounds=bounds)
+                            control=control, width_bounds=bounds,
+                            reuse=reuse)
                 self._lanes[key] = lane
                 created = True
                 if not lane.try_enqueue(job):  # pragma: no cover

@@ -48,6 +48,7 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
                        textual_inversion: str | None = None,
                        lora: str | None = None,
                        cross_attention_scale: float = 1.0,
+                       reuse_schedule: Any = None,
                        outputs: tuple[str, ...] = ("primary",),
                        **_ignored: Any):
     # ``lora`` + ``cross_attention_scale`` are the reference's per-job LoRA
@@ -124,6 +125,11 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
         image_guidance_scale=float(image_guidance_scale
                                    if image_guidance_scale is not None
                                    else 1.5),
+        # DeepCache step-level reuse (ISSUE 12): engages only behind
+        # CHIASWARM_DEEPCACHE; the pipeline normalizes and quality-gates
+        reuse_schedule=(tuple(reuse_schedule)
+                        if isinstance(reuse_schedule, (list, tuple))
+                        else reuse_schedule),
     )
     # coarse phase checkpoints (ISSUE 6): the solo program has no step
     # boundary to snapshot at (encode/denoise/decode fuse into one
@@ -197,7 +203,7 @@ def diffusion_callback(slot, model_name: str, *, seed: int,
 
 COALESCE_KEYS = ("num_inference_steps", "guidance_scale", "height",
                  "width", "scheduler_type", "textual_inversion", "lora",
-                 "cross_attention_scale", "strength")
+                 "cross_attention_scale", "strength", "reuse_schedule")
 # ControlNet conditions on the image (different program); pix2pix jobs
 # carry image_guidance_scale (dual-CFG family, kept solo). Plain img2img
 # and inpaint DO coalesce since r5: per-job init stacks + per-job
@@ -242,7 +248,17 @@ def stepper_eligible(kwargs: dict[str, Any]) -> bool:
         return False  # pix2pix dual CFG / strength remap stays solo
     guidance = kwargs.get("guidance_scale")
     if guidance is not None and float(guidance) <= 1.0:
-        return False  # solo compiles the no-CFG program
+        # few-step kinds (ISSUE 12) are guidance-embedded: their native
+        # CFG-free mode still rides lanes — the lane program's per-row
+        # combine selects the pure conditional prediction
+        from chiaswarm_tpu.schedulers.sampling import (
+            FEWSTEP_KINDS,
+            SAMPLERS,
+        )
+
+        if SAMPLERS.get(kwargs.get("scheduler_type") or "") not in \
+                FEWSTEP_KINDS:
+            return False  # solo compiles the no-CFG program
     if kwargs.get("mask_image") is not None \
             and kwargs.get("controlnet_model_name") is not None:
         return False  # invalid combination — solo raises the user error
@@ -371,7 +387,8 @@ def stepper_submit(slot, registry: ModelRegistry, kwargs: dict[str, Any],
         resume=resume,
         init_image=image, strength=strength, mask=mask,
         controlnet=controlnet, control_image=control_image,
-        control_scale=cscale)
+        control_scale=cscale,
+        reuse_schedule=kwargs.get("reuse_schedule"))
     sampler = resolve(kwargs.get("scheduler_type"),
                       prediction_type=fam.prediction_type)
     return StepperTicket(
@@ -526,6 +543,11 @@ def diffusion_coalesced_callback(slot, model_name: str, *, seed: int,
         strength=float(opt("strength", 0.75)),
         mask=mask_stack,
         tiled_decode=max(int(height), int(width)) > 1024,
+        # part of the coalesce key, so every member shares one schedule
+        reuse_schedule=(tuple(shared["reuse_schedule"])
+                        if isinstance(shared.get("reuse_schedule"),
+                                      (list, tuple))
+                        else shared.get("reuse_schedule")),
     )
     t0 = time.perf_counter()
     images, base_config = pipe(req)

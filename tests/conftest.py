@@ -88,6 +88,10 @@ def pytest_configure(config):
         "markers",
         "slow: compile-heavy test, excluded from the default fast tier "
         "(run with --slow or CHIASWARM_SLOW=1)")
+    config.addinivalue_line(
+        "markers",
+        "solo: exercises the per-job (non-lane) path — the CI "
+        "stepper-off leg re-runs this subset with CHIASWARM_STEPPER=0")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,566 @@
+"""swarmturbo (ISSUE 12): the step-collapse gates.
+
+Two halves, both attacking the steps x full-UNet product the 15x
+headline gap is made of:
+
+- **Few-step sampler family** — the ``lcm`` kind (boundary-condition
+  step, timestep-shifted trailing ladder, guidance-embedded/CFG-free
+  mode): registry resolution, schedule shape, the final-step boundary
+  condition, and THE gate — a 4-step lcm row spliced into a running
+  lane is solo-trajectory-exact (the PR-3 splice-equivalence pattern),
+  including at guidance 1.0, where the lane's per-row combine selects
+  the pure conditional prediction.
+- **DeepCache feature reuse** — ``CHIASWARM_DEEPCACHE`` + per-job
+  ``reuse_schedule``: OFF is bit-identical to pre-reuse behavior (same
+  executable, zero new compiles, identical images), ON passes the
+  PSNR/SSIM quality gate vs the full-step reference, schedules ride as
+  traced tables (no recompile per schedule), lanes match their solo
+  twins, checkpoints carry the cache so a mid-schedule resume is
+  bit-identical, and a tampered schedule in the resume payload
+  restarts clean through ``_validate_resume``.
+
+Admission still compiles nothing once the lcm/reuse lane buckets are
+warm (the compile-cache counter gate), and the stepper-off CI leg runs
+the ``solo``-marked subset with CHIASWARM_STEPPER=0 to prove few-step
+jobs serve correctly through the per-job path.
+
+Tiering: tier-1's wall-clock budget has no room for more compiles
+(the suite already runs ~95% of it), so every compile-heavy gate here
+is ``slow``-marked and ALWAYS runs in the dedicated CI step
+(test.yml "Fast-sampling suite", ``--slow``); the default tier keeps
+the host-side units plus the cheap off-gate/solo checks.
+
+Runs on the hermetic CPU platform (tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from chiaswarm_tpu.core.compile_cache import GLOBAL_CACHE
+from chiaswarm_tpu.pipelines import (
+    Components,
+    DiffusionPipeline,
+    GenerateRequest,
+)
+from chiaswarm_tpu.pipelines.diffusion import (
+    deepcache_enabled,
+    normalize_reuse_schedule,
+)
+from chiaswarm_tpu.schedulers import FEWSTEP_KINDS, SAMPLERS, resolve
+from chiaswarm_tpu.serving.stepper import LaneReject, StepScheduler
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    return DiffusionPipeline(Components.random("tiny", seed=0))
+
+
+def _wait_steps(sched: StepScheduler, n: int, timeout: float = 120.0):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if sched.stats().get("steps_executed", 0) >= n:
+            return
+        time.sleep(0.005)
+    raise AssertionError(
+        f"scheduler never reached {n} steps: {sched.stats()}")
+
+
+def _close(lane_img: np.ndarray, solo_img: np.ndarray) -> None:
+    # the PR-3 splice-equivalence tolerance: agreement to uint8
+    # quantization across different compiled batch shapes
+    diff = np.abs(lane_img.astype(int) - solo_img.astype(int))
+    assert diff.max() <= 3 and (diff <= 1).mean() > 0.99, (
+        diff.max(), (diff <= 1).mean())
+
+
+# ---------------------------------------------------------------------------
+# the lcm sampler kind: registration + schedule + step math
+# ---------------------------------------------------------------------------
+
+
+def test_lcm_registered_and_resolves_shifted_schedule():
+    """Catalog-level registration: the hive requests the few-step
+    family by diffusers class name like every other scheduler, and the
+    resolved config pins the timestep-SHIFTED trailing ladder with
+    karras respacing forced off (the distillation contract)."""
+    assert SAMPLERS["LCMScheduler"] == "lcm"
+    assert SAMPLERS["TCDScheduler"] == "lcm"
+    assert "lcm" in FEWSTEP_KINDS
+    cfg = resolve("LCMScheduler")
+    assert cfg.kind == "lcm"
+    assert cfg.timestep_spacing == "trailing"
+    assert cfg.use_karras_sigmas is False
+    # the shifted ladder lands its FIRST step on the training boundary
+    from chiaswarm_tpu.schedulers.sampling import make_for
+
+    _, sched = make_for("sd", 4, cfg)
+    ts = np.asarray(sched.timesteps)
+    sig = np.asarray(sched.sigmas)
+    assert ts.shape == (4,) and sig.shape == (5,)
+    assert ts[0] == pytest.approx(999.0)       # boundary timestep
+    assert np.all(np.diff(ts) < 0)             # descending
+    assert np.all(np.diff(sig) < 0) and sig[-1] == 0.0
+
+
+def test_lcm_step_boundary_condition():
+    """The lcm step: full re-noise onto the next level, and at
+    sigma_next == 0 it returns the boundary-conditioned x0 exactly
+    (LCMScheduler's final step emits denoised, no noise)."""
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.schedulers.sampling import (
+        init_sampler_state,
+        make_for,
+        sampler_step,
+    )
+
+    cfg = resolve("LCMScheduler")
+    _, sched = make_for("sd", 2, cfg)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, 4, 4, 4)), jnp.float32)
+    eps = jnp.asarray(rng.standard_normal((1, 4, 4, 4)), jnp.float32)
+    noise = jnp.asarray(rng.standard_normal((1, 4, 4, 4)), jnp.float32)
+    state = init_sampler_state(x)
+    # step 0: re-noised by sigma[1] — must depend on the noise argument
+    x1a, _ = sampler_step(cfg, sched, 0, x, eps, state, noise=noise)
+    x1b, _ = sampler_step(cfg, sched, 0, x, eps, state,
+                          noise=jnp.zeros_like(noise))
+    assert not np.allclose(np.asarray(x1a), np.asarray(x1b))
+    # final step (sigma_next == 0): noise-independent boundary output
+    x2a, _ = sampler_step(cfg, sched, 1, x, eps, state, noise=noise)
+    x2b, _ = sampler_step(cfg, sched, 1, x, eps, state,
+                          noise=jnp.zeros_like(noise))
+    np.testing.assert_array_equal(np.asarray(x2a), np.asarray(x2b))
+    assert np.isfinite(np.asarray(x2a)).all()
+
+
+@pytest.mark.solo
+def test_lcm_solo_four_step_cfg_free(tiny_pipe):
+    """The solo path serves a 4-step guidance-embedded (CFG-free) lcm
+    job: the no-CFG program compiles, the config records the kind and
+    the collapsed per-image eval count."""
+    imgs, cfg = tiny_pipe(GenerateRequest(
+        prompt="turbo", steps=4, guidance_scale=1.0, height=64,
+        width=64, seed=11, scheduler="LCMScheduler"))
+    assert imgs.shape == (1, 64, 64, 3)
+    assert np.isfinite(imgs).all()
+    assert cfg["scheduler"] == "lcm"
+    assert cfg["unet_evals"] == 4 and cfg["steps_skipped"] == 0
+
+
+@pytest.mark.slow
+def test_lcm_lane_rows_match_solo_trajectory(tiny_pipe):
+    """THE few-step gate (PR-3 pattern): a 4-step CFG-free lcm row
+    splices into a running lcm lane mid-flight and matches its solo run
+    — as does its longer lane-mate. Guidance 1.0 RIDES the lane (the
+    relaxed eligibility for FEWSTEP_KINDS)."""
+    sched = StepScheduler()
+    base = sched.stats().get("steps_executed", 0)
+    fa = sched.submit_request(
+        tiny_pipe, prompt="lcm long", steps=8, guidance_scale=1.0,
+        height=64, width=64, rows=1, seed=21, scheduler="LCMScheduler")
+    _wait_steps(sched, base + 1)
+    fb = sched.submit_request(
+        tiny_pipe, prompt="lcm fast", steps=4, guidance_scale=1.0,
+        height=64, width=64, rows=1, seed=22, scheduler="LCMScheduler")
+    pending_b, info_b = fb.result(timeout=300)
+    pending_a, info_a = fa.result(timeout=300)
+    img_a, img_b = pending_a.wait(), pending_b.wait()
+    assert info_b["lane"] == info_a["lane"]
+    assert 1 <= info_b["admitted_at_step"] < 8
+
+    solo_a, _ = tiny_pipe(GenerateRequest(
+        prompt="lcm long", steps=8, guidance_scale=1.0, height=64,
+        width=64, seed=21, scheduler="LCMScheduler"))
+    solo_b, _ = tiny_pipe(GenerateRequest(
+        prompt="lcm fast", steps=4, guidance_scale=1.0, height=64,
+        width=64, seed=22, scheduler="LCMScheduler"))
+    _close(img_a, solo_a)
+    _close(img_b, solo_b)
+    # CFG'd lcm rows ride the same lane program too
+    fc = sched.submit_request(
+        tiny_pipe, prompt="lcm cfg", steps=4, guidance_scale=5.0,
+        height=64, width=64, rows=1, seed=23, scheduler="LCMScheduler")
+    img_c = fc.result(timeout=300)[0].wait()
+    solo_c, _ = tiny_pipe(GenerateRequest(
+        prompt="lcm cfg", steps=4, guidance_scale=5.0, height=64,
+        width=64, seed=23, scheduler="LCMScheduler"))
+    _close(img_c, solo_c)
+    sched.shutdown()
+
+
+def test_non_fewstep_low_guidance_still_rejected(tiny_pipe):
+    """The guidance relaxation is SCOPED to the few-step kinds: a
+    low-guidance dpm job still runs the solo no-CFG program."""
+    sched = StepScheduler()
+    with pytest.raises(LaneReject):
+        sched.submit_request(tiny_pipe, prompt="x", steps=4,
+                             guidance_scale=1.0, height=64, width=64,
+                             rows=1, seed=1)
+    sched.shutdown()
+
+
+@pytest.mark.slow
+def test_fewstep_admission_compiles_nothing_once_warm(
+        tiny_pipe, monkeypatch):
+    """The compile-cache counter gate: once the lcm lane bucket is
+    warm, 4-step jobs with new step counts/guidance/seeds splice in
+    with ZERO new executables — few-step serving is admission-
+    compatible with the existing lane machinery."""
+    monkeypatch.setenv("CHIASWARM_STEPPER_LANE_WIDTH", "4")
+    sched = StepScheduler()
+    sched.submit_request(
+        tiny_pipe, prompt="warm", steps=6, guidance_scale=1.0,
+        height=64, width=64, rows=1, seed=1,
+        scheduler="LCMScheduler").result(timeout=300)[0].wait()
+    before = GLOBAL_CACHE.executables.stats["misses"]
+    futs = [sched.submit_request(
+        tiny_pipe, prompt=f"fewstep {i}", steps=steps,
+        guidance_scale=g, height=64, width=64, rows=1, seed=40 + i,
+        scheduler="LCMScheduler")
+        for i, (steps, g) in enumerate([(4, 1.0), (2, 1.0), (8, 4.0)])]
+    for fut in futs:
+        fut.result(timeout=300)[0].wait()
+    after = GLOBAL_CACHE.executables.stats["misses"]
+    assert after == before, (before, after)
+    sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# DeepCache: the off-gate, the quality gate, traced schedules, lanes
+# ---------------------------------------------------------------------------
+
+
+def test_reuse_schedule_normalization():
+    assert normalize_reuse_schedule(8, (4, 2, 4)) == (2, 4)
+    assert normalize_reuse_schedule(8, "every:2") == (1, 3, 5, 7)
+    assert normalize_reuse_schedule(8, "every:3", 2) == (3, 4, 6, 7)
+    assert normalize_reuse_schedule(8, ()) == ()
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, (0,))    # first step fills the cache
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, (8,))    # past the ladder
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, (2,), 2)  # at the start index
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, "every:1")
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, "sometimes")
+    # malformed payloads stay ValueError (the user-error taxonomy):
+    # a TypeError escaping here would feed the model circuit breaker
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, 2)          # bare int, not a list
+    with pytest.raises(ValueError):
+        normalize_reuse_schedule(8, [None, 2])  # null entries
+
+
+@pytest.mark.solo
+def test_deepcache_off_is_bit_identical(tiny_pipe):
+    """THE off-gate (the PR-11 taps-off pattern): with
+    CHIASWARM_DEEPCACHE unset a request carrying a reuse_schedule hits
+    the SAME cached executable as the plain request (zero new
+    compiles) and returns bit-identical images — pre-PR behavior
+    exactly."""
+    assert not deepcache_enabled()
+    req = dict(prompt="offgate", steps=5, guidance_scale=7.5,
+               height=64, width=64, seed=9)
+    base, base_cfg = tiny_pipe(GenerateRequest(**req))
+    before = (GLOBAL_CACHE.executables.stats["misses"],
+              GLOBAL_CACHE.executables.stats["hits"])
+    off, off_cfg = tiny_pipe(GenerateRequest(**req,
+                                             reuse_schedule=(2, 4)))
+    after = (GLOBAL_CACHE.executables.stats["misses"],
+             GLOBAL_CACHE.executables.stats["hits"])
+    assert after[0] == before[0], "env-off reuse request compiled"
+    assert after[1] > before[1], "env-off reuse request missed the cache"
+    np.testing.assert_array_equal(base, off)
+    assert off_cfg["unet_evals"] == base_cfg["unet_evals"] == 5
+    assert "reuse_schedule" not in off_cfg
+
+
+@pytest.mark.slow
+def test_unet_seam_default_lowering_is_byte_identical():
+    """The DeepCache seam is ZERO-cost at trace time when off (the
+    PR-11 taps-off invariance pattern applied to the model seam): a
+    UNet lowered with the seam arguments at their defaults is
+    byte-identical HLO to one lowered without mentioning them."""
+    import jax
+    import jax.numpy as jnp
+
+    from chiaswarm_tpu.models.configs import get_family
+    from chiaswarm_tpu.models.unet import UNet
+
+    fam = get_family("tiny")
+    unet = UNet(fam.unet)
+    key_x, key_ctx, key_init = jax.random.split(jax.random.PRNGKey(0), 3)
+    x = jax.random.normal(key_x, (1, 8, 8, 4))
+    t = jnp.ones((1,), jnp.float32)
+    ctx = jax.random.normal(key_ctx, (1, 7, fam.unet.cross_attention_dim))
+    params = unet.init(key_init, x, t, ctx)
+    plain = jax.jit(
+        lambda p, a, b, c: unet.apply(p, a, b, c)
+    ).lower(params, x, t, ctx).as_text()
+    seamed = jax.jit(
+        lambda p, a, b, c: unet.apply(p, a, b, c, cached_deep=None,
+                                      return_deep=False)
+    ).lower(params, x, t, ctx).as_text()
+    assert plain == seamed
+
+
+@pytest.mark.slow
+def test_deepcache_quality_gate(tiny_pipe, monkeypatch):
+    """THE quality gate (the int8 pattern): DeepCache-on output at an
+    every:2 cadence stays within PSNR >= 30 dB / SSIM >= 0.9 of the
+    same-seed full-step reference on the tiny family."""
+    from chiaswarm_tpu.obs.quality import quality_report
+
+    req = dict(prompt="quality", steps=10, guidance_scale=7.5,
+               height=64, width=64, seed=17)
+    ref, _ = tiny_pipe(GenerateRequest(**req))
+    monkeypatch.setenv("CHIASWARM_DEEPCACHE", "1")
+    out, cfg = tiny_pipe(GenerateRequest(**req, reuse_schedule="every:2"))
+    assert cfg["unet_evals"] == 5 and cfg["steps_skipped"] == 5
+    report = quality_report(out, ref)
+    assert report["passed"], report
+
+
+@pytest.mark.slow
+def test_deepcache_schedule_is_traced_not_static(tiny_pipe, monkeypatch):
+    """Changing the reuse schedule (same steps) must NOT recompile:
+    the schedule rides as a traced table, only the static reuse flag
+    keys the executable."""
+    monkeypatch.setenv("CHIASWARM_DEEPCACHE", "1")
+    req = dict(prompt="traced", steps=6, guidance_scale=7.5,
+               height=64, width=64, seed=2)
+    tiny_pipe(GenerateRequest(**req, reuse_schedule=(2,)))  # warm
+    before = GLOBAL_CACHE.executables.stats["misses"]
+    _, cfg_a = tiny_pipe(GenerateRequest(**req, reuse_schedule=(2, 4)))
+    _, cfg_b = tiny_pipe(GenerateRequest(**req,
+                                         reuse_schedule="every:2"))
+    after = GLOBAL_CACHE.executables.stats["misses"]
+    assert after == before, (before, after)
+    assert cfg_a["unet_evals"] == 4
+    assert cfg_b["reuse_schedule"] == [1, 3, 5]
+
+
+@pytest.mark.slow
+def test_deepcache_lane_matches_solo_and_counts_evals(
+        tiny_pipe, monkeypatch):
+    """A reuse-schedule job rides a reuse-keyed lane and matches its
+    solo DeepCache twin (single-job lane: the lane-wide decision
+    aligns with the row's schedule), with the per-image eval
+    accounting in the lane info and the obs counters moving."""
+    from chiaswarm_tpu.obs.metrics import REGISTRY
+
+    monkeypatch.setenv("CHIASWARM_DEEPCACHE", "1")
+    evals = REGISTRY.get("chiaswarm_stepper_unet_evals_total")
+    skipped = REGISTRY.get("chiaswarm_stepper_steps_skipped_total")
+    before_reuse = evals.value(mode="reuse")
+    before_skip = skipped.value()
+    sched = StepScheduler()
+    fut = sched.submit_request(
+        tiny_pipe, prompt="dc lane", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=5, reuse_schedule=(2, 4))
+    pending, info = fut.result(timeout=300)
+    img = pending.wait()
+    assert info["unet_evals"] == 4 and info["steps_skipped"] == 2
+    solo, solo_cfg = tiny_pipe(GenerateRequest(
+        prompt="dc lane", steps=6, guidance_scale=7.5, height=64,
+        width=64, seed=5, reuse_schedule=(2, 4)))
+    assert solo_cfg["unet_evals"] == 4
+    _close(img, solo)
+    assert evals.value(mode="reuse") >= before_reuse + 2
+    assert skipped.value() >= before_skip + 2
+    # scheduler-level reuse counters rode along
+    stats = sched.stats()
+    assert stats.get("steps_reused", 0) >= 2
+    assert stats.get("row_steps_reused", 0) >= 2
+    sched.shutdown()
+
+
+@pytest.mark.slow
+def test_deepcache_lane_admission_compiles_nothing_once_warm(
+        tiny_pipe, monkeypatch):
+    """Reuse-schedule jobs splice into the warm reuse lane bucket with
+    zero new executables — schedules and step counts ride per row."""
+    monkeypatch.setenv("CHIASWARM_DEEPCACHE", "1")
+    monkeypatch.setenv("CHIASWARM_STEPPER_LANE_WIDTH", "4")
+    sched = StepScheduler()
+    sched.submit_request(
+        tiny_pipe, prompt="warm", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=1,
+        reuse_schedule=(2,)).result(timeout=300)[0].wait()
+    before = GLOBAL_CACHE.executables.stats["misses"]
+    futs = [sched.submit_request(
+        tiny_pipe, prompt=f"dc {i}", steps=steps, guidance_scale=g,
+        height=64, width=64, rows=1, seed=60 + i,
+        reuse_schedule=schedule)
+        for i, (steps, g, schedule) in enumerate(
+            [(6, 5.0, (3, 4)), (4, 7.5, (2,)), (7, 6.0, "every:2")])]
+    for fut in futs:
+        fut.result(timeout=300)[0].wait()
+    after = GLOBAL_CACHE.executables.stats["misses"]
+    assert after == before, (before, after)
+    sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# resume across a reuse schedule (the PR-6 gate extended)
+# ---------------------------------------------------------------------------
+
+
+class _SpoolSlot:
+    data_width = 1
+
+    def __init__(self, spool):
+        self._checkpoint_spool = spool
+
+
+@pytest.mark.slow
+def test_resume_mid_reuse_schedule_is_bit_identical(
+        tiny_pipe, tmp_path, monkeypatch):
+    """A lane checkpointed MID-reuse-schedule and redelivered resumes
+    bit-identical to the uninterrupted run: the snapshot carries the
+    deep caches + validity + skipped tally, so every remaining reuse
+    decision replays exactly (the PR-6 resume-equivalence gate over
+    the new state)."""
+    from chiaswarm_tpu.node.resilience import CheckpointSpool
+
+    monkeypatch.setenv("CHIASWARM_DEEPCACHE", "1")
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    schedule = (2, 3, 5, 6)
+    spool = CheckpointSpool(tmp_path / "ckpt")
+    sched = StepScheduler(_SpoolSlot(spool))
+    fut = sched.submit_request(
+        tiny_pipe, prompt="resume reuse", steps=8, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=77, job_id="rr-1",
+        reuse_schedule=schedule)
+    pending, info = fut.result(timeout=300)
+    imgs_fresh = pending.wait()
+    assert info["unet_evals"] == 4 and info["steps_skipped"] == 4
+
+    ckpt = spool.load("rr-1")
+    assert ckpt is not None and ckpt["kind"] == "lane"
+    assert ckpt["reuse_schedule"] == list(schedule)
+    assert {"cache_u", "cache_c", "cache_ok", "skipped"} <= set(ckpt)
+    assert 1 <= ckpt["step"] < 8
+
+    sched2 = StepScheduler()
+    fut2 = sched2.submit_request(
+        tiny_pipe, prompt="resume reuse", steps=8, guidance_scale=7.5,
+        height=64, width=64, rows=1,
+        seed=0,  # resume must not re-derive keys from the seed
+        job_id="rr-1", resume=ckpt, reuse_schedule=schedule)
+    pending2, info2 = fut2.result(timeout=300)
+    assert info2["resume_step"] == ckpt["step"] >= 1
+    # whole-trajectory accounting survives the resume
+    assert info2["unet_evals"] == 4 and info2["steps_skipped"] == 4
+    assert np.array_equal(pending2.wait(), imgs_fresh)
+    sched.shutdown()
+    sched2.shutdown()
+
+
+@pytest.mark.slow
+def test_resume_rejects_tampered_reuse_schedule(
+        tiny_pipe, tmp_path, monkeypatch):
+    """A tampered (or stripped) reuse_schedule in the resume payload
+    restarts CLEAN via _validate_resume: a checkpoint stepped under a
+    different schedule walked a different trajectory and must never
+    finish under this job's identity."""
+    from chiaswarm_tpu.node.resilience import CheckpointSpool
+
+    monkeypatch.setenv("CHIASWARM_DEEPCACHE", "1")
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    schedule = (2, 4)
+    spool = CheckpointSpool(tmp_path / "ckpt2")
+    sched = StepScheduler(_SpoolSlot(spool))
+    sched.submit_request(
+        tiny_pipe, prompt="tamper reuse", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=31, job_id="tr-1",
+        reuse_schedule=schedule).result(timeout=300)[0].wait()
+    ckpt = spool.load("tr-1")
+    assert ckpt is not None
+
+    sched2 = StepScheduler()
+    # tampered schedule -> rejected, clean restart
+    tampered = dict(ckpt)
+    tampered["reuse_schedule"] = [2, 3]
+    fut = sched2.submit_request(
+        tiny_pipe, prompt="tamper reuse", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=31, resume=tampered,
+        reuse_schedule=schedule)
+    pending, info = fut.result(timeout=300)
+    assert info["resume_step"] == 0
+    assert sched2.stats().get("resumes_rejected", 0) == 1
+    assert pending.wait().shape == (1, 64, 64, 3)
+    # corrupt cache state -> rejected the same way
+    garbage = dict(ckpt)
+    garbage["cache_u"] = {"dtype": "float32", "shape": [1], "b64": "!!!"}
+    fut2 = sched2.submit_request(
+        tiny_pipe, prompt="tamper reuse", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=31, resume=garbage,
+        reuse_schedule=schedule)
+    _, info2 = fut2.result(timeout=300)
+    assert info2["resume_step"] == 0
+    assert sched2.stats().get("resumes_rejected", 0) == 2
+    # a reuse checkpoint offered to a schedule-less job -> clean restart
+    sched3 = StepScheduler()
+    fut3 = sched3.submit_request(
+        tiny_pipe, prompt="tamper reuse", steps=6, guidance_scale=7.5,
+        height=64, width=64, rows=1, seed=31, resume=dict(ckpt))
+    _, info3 = fut3.result(timeout=300)
+    assert info3["resume_step"] == 0
+    assert sched3.stats().get("resumes_rejected", 0) == 1
+    sched.shutdown()
+    sched2.shutdown()
+    sched3.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the executor path (the stepper-off CI leg runs the solo-marked subset)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.solo
+def test_executor_serves_fewstep_job_end_to_end(monkeypatch, tmp_path):
+    """A formatted lcm job runs through the real executor — lanes on
+    (default) or off (the CI stepper-off leg sets CHIASWARM_STEPPER=0)
+    — and produces a completed envelope with the collapsed step
+    count. Proves the few-step family serves through WHICHEVER path
+    the routing picks."""
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.node.executor import synchronous_do_work
+    from chiaswarm_tpu.node.registry import ModelRegistry
+
+    pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                    devices=jax.devices()[:1])
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+    job = {
+        "id": "fewstep-e2e",
+        "model_name": "tiny",
+        "workflow": "txt2img",
+        "prompt": "a fast fox",
+        "num_inference_steps": 4,
+        "guidance_scale": 1.0,
+        "height": 64, "width": 64,
+        "seed": 9,
+        "content_type": "image/png",
+        "parameters": {"scheduler_type": "LCMScheduler"},
+    }
+    result = synchronous_do_work(job, pool.slots[0], registry)
+    cfg = result["pipeline_config"]
+    assert cfg.get("error") is None, cfg
+    assert cfg["scheduler"] == "lcm"
+    assert cfg["steps"] == 4
+    assert result["artifacts"]

@@ -475,6 +475,21 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert "# TYPE chiaswarm_guard_device_health gauge" in body
     assert "chiaswarm_stepper_lanes_condemned_total 0" in body
     assert "chiaswarm_stepper_rows_invalid_total 0" in body
+    # ...step-collapse families (ISSUE 12, swarmturbo): UNet evals by
+    # mode, DeepCache-skipped steps, and the per-image full-eval
+    # histogram — label vocabularies pre-seeded, series process-
+    # cumulative (other suites may have stepped lanes already, so
+    # assert presence, not zero, for the mode-labeled counter)...
+    from chiaswarm_tpu.obs.metrics import STEPPER_UNET_EVAL_MODES
+
+    assert "# TYPE chiaswarm_stepper_unet_evals_total counter" in body
+    for mode in STEPPER_UNET_EVAL_MODES:
+        assert (f'chiaswarm_stepper_unet_evals_total{{mode="{mode}"}}'
+                in body), mode
+    assert "# TYPE chiaswarm_stepper_steps_skipped_total counter" in body
+    assert "chiaswarm_stepper_steps_skipped_total" in body
+    assert ("# TYPE chiaswarm_stepper_unet_evals_per_image histogram"
+            in body)
     assert "guard" in health and health["guard"]["enabled"] is True
     assert health["guard"]["restart_requested"] is False
     assert "chips_in_service" in health

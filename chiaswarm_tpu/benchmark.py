@@ -671,6 +671,12 @@ def _bench_load_harness(*, on_tpu: bool, attn: str) -> dict:
         "kill": report["kill"],
         # measured per-family deadline suggestions (ISSUE 10 satellite)
         "suggested_deadlines": report["suggested_deadlines"],
+        # swarmsight (ISSUE 13): per-family deadline-budget attribution
+        # (where each family's end-to-end seconds went, by phase, with
+        # the miss-table argmax) + the /api/fleet aggregate snapshot —
+        # the observed data plane the item-5 autoscaler will consume
+        "budget_attribution": report["budget_attribution"],
+        "fleet": report["fleet"],
         # the satellite's tuning story: sweep tables + the winners the
         # shipped defaults were landed from
         "sweeps": {

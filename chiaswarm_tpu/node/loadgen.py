@@ -66,6 +66,8 @@ from typing import Any, Callable, Iterable, Sequence
 from chiaswarm_tpu.node.minihive import MiniHive
 from chiaswarm_tpu.node.output_processor import make_text_result
 from chiaswarm_tpu.node.resilience import classify_result
+from chiaswarm_tpu.obs import trace as obs_trace
+from chiaswarm_tpu.obs.flight import ATTRIBUTION_PHASES
 
 log = logging.getLogger("chiaswarm.loadgen")
 
@@ -443,7 +445,17 @@ class SyntheticExecutor:
         return base * (1.0 + self.jitter * (2.0 * rng.random() - 1.0))
 
     async def _run_one(self, job: dict[str, Any]) -> dict[str, Any]:
+        # the synthetic service time stands in for the denoise loop, so
+        # it records as a "step" span under the job's execute phase —
+        # the flight record's budget attribution (ISSUE 13) then books
+        # it as steps, not unattributed residue. Manual child (not
+        # span()): custom executors run on the event loop where the
+        # trace contextvar is never activated.
+        trace = obs_trace.job_trace(job)
+        step = trace.tail().child("step") if trace is not None else None
         await asyncio.sleep(self._service(job))
+        if step is not None:
+            step.end()
         self.executed.append(str(job.get("id")))
         return {
             "id": job.get("id"),
@@ -731,6 +743,13 @@ def score_run(hive: LoadHive, issued: Sequence[str], workers: Sequence[Any],
     family_by_id = {str(s.job["id"]): model_family(s.job.get("model_name"))
                     for s in schedule}
     family_latencies: dict[str, list[float]] = {}
+    # deadline-budget attribution (swarmsight, ISSUE 13): per-family
+    # phase decompositions folded from the hive's flight records — one
+    # bucket over every completed job, one over the deadline MISSES so
+    # the conformance report can name the dominant overshoot phase
+    flights = getattr(hive, "flights", None)
+    fam_attr: dict[str, dict[str, list[float]]] = {}
+    fam_miss_attr: dict[str, dict[str, list[float]]] = {}
     outcomes = {"ok": 0, "shed": 0, "abandoned": len(hive.abandoned)}
     end_to_end: dict[str, list[float]] = {}
     admitted: dict[str, list[float]] = {}
@@ -760,9 +779,17 @@ def score_run(hive: LoadHive, issued: Sequence[str], workers: Sequence[Any],
             admitted.setdefault(workload, []).append(latency)
             admitted_latencies.append(latency)
         if submitted is not None:
-            family_latencies.setdefault(
-                family_by_id.get(job_id, "sd15"), []).append(
-                    settled - submitted)
+            family = family_by_id.get(job_id, "sd15")
+            family_latencies.setdefault(family, []).append(
+                settled - submitted)
+            attribution = None
+            if flights is not None:
+                record = flights.get(job_id)
+                attribution = (record or {}).get("attribution")
+            if attribution:
+                bucket = fam_attr.setdefault(family, {})
+                for phase, seconds in attribution["phases"].items():
+                    bucket.setdefault(phase, []).append(float(seconds))
             # deadline conformance is END TO END (submit -> settle):
             # queue age rides every delivery as "queued_s", so a worker
             # that admits a stale job owns the whole budget it spent.
@@ -776,12 +803,42 @@ def score_run(hive: LoadHive, issued: Sequence[str], workers: Sequence[Any],
                 deadline_ratios.append(e2e / deadline)
                 if e2e > deadline:
                     deadline_violations.append(job_id)
+                    if attribution:
+                        miss = fam_miss_attr.setdefault(family, {})
+                        for phase, seconds in \
+                                attribution["phases"].items():
+                            miss.setdefault(phase, []).append(
+                                float(seconds))
 
     def fold(samples: dict[str, list[float]]) -> dict[str, dict]:
         return {w: {"p50": round(percentile(v, 0.50), 4),
                     "p99": round(percentile(v, 0.99), 4),
                     "n": len(v)}
                 for w, v in sorted(samples.items())}
+
+    def attribution_table(samples: dict[str, dict[str, list[float]]]
+                          ) -> dict[str, dict]:
+        """Per-family budget-attribution table: mean seconds + share
+        per phase, plus the argmax phase (ISSUE 13 — the table the
+        BENCH load_harness config stamps)."""
+        table: dict[str, dict] = {}
+        for family, phases in sorted(samples.items()):
+            mean = {phase: round(sum(vals) / max(1, len(vals)), 4)
+                    for phase, vals in sorted(phases.items())}
+            total = sum(mean.values())
+            table[family] = {
+                "n": max((len(v) for v in phases.values()), default=0),
+                "mean_s": mean,
+                "share": {phase: round(v / total, 4) if total else 0.0
+                          for phase, v in mean.items()},
+                # None when nothing was measured: an argmax over
+                # all-zero means would crown the first phase and send
+                # an operator chasing a queue that never dominated
+                "dominant_phase": (max(
+                    ATTRIBUTION_PHASES,
+                    key=lambda p: mean.get(p, 0.0)) if total else None),
+            }
+        return table
 
     mix: dict[str, int] = {}
     for item in schedule:
@@ -851,6 +908,19 @@ def score_run(hive: LoadHive, issued: Sequence[str], workers: Sequence[Any],
         # executors step no lanes, so those runs report measured=False
         # rather than inventing numbers from simulated service times
         "suggested_hang_budget": _suggest_hang_budget(),
+        # per-family deadline-BUDGET attribution (swarmsight, ISSUE 13):
+        # where each family's end-to-end seconds actually went, folded
+        # from the flight records; misses get their own table so a p99
+        # overshoot names a phase, not just a number
+        "budget_attribution": {
+            "families": attribution_table(fam_attr),
+            "misses": attribution_table(fam_miss_attr),
+        },
+        # the /api/fleet aggregate at scoring time — the observed data
+        # plane (arrival rates, occupancy, chips, residency, overload)
+        # the ROADMAP item-5 autoscaler consumes
+        "fleet": (hive.fleet_snapshot()
+                  if hasattr(hive, "fleet_snapshot") else None),
         "workers": {w.settings.worker_name: _worker_snapshot(w)
                     for w in workers},
         "hive": hive.stats(),
@@ -865,6 +935,14 @@ def score_run(hive: LoadHive, issued: Sequence[str], workers: Sequence[Any],
                              for w, n in sorted(mix.items())},
         },
     }
+    # the deadline-conformance satellite (ISSUE 13): each family's p99
+    # miss points at a PHASE — the miss-table argmax rides next to the
+    # suggested deadline so "raise the budget" and "fix the phase" are
+    # distinguishable actions
+    for family, entry in report["suggested_deadlines"]["families"].items():
+        miss = report["budget_attribution"]["misses"].get(family)
+        entry["dominant_overshoot_phase"] = (miss["dominant_phase"]
+                                             if miss else None)
     return report
 
 

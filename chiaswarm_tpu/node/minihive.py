@@ -49,6 +49,7 @@ from typing import Any, Callable, Iterable
 
 from chiaswarm_tpu.node.chaos import ChaoticHive
 from chiaswarm_tpu.node.resilience import REDISPATCH_KINDS, classify_result
+from chiaswarm_tpu.obs import flight as obs_flight
 from chiaswarm_tpu.obs.metrics import Registry
 
 log = logging.getLogger("chiaswarm.minihive")
@@ -107,8 +108,21 @@ class MiniHive(ChaoticHive):
         self.known_workers: set[str] = set()
         self.worker_seen: dict[str, float] = {}  # last poll/heartbeat
         self.partitioned: set[str] = set()
+        # swarmsight (ISSUE 13): the per-job flight recorder (trace
+        # context out, span digests in, hive-clock event timeline,
+        # settle-time budget attribution) + the fleet plane — latest
+        # per-worker metric snapshot pushed by heartbeats, and the
+        # hive's own observed-arrival EWMA (the item-5 autoscaler's
+        # demand signal)
+        self.flights = obs_flight.FlightRecorder()
+        self.fleet: dict[str, dict[str, Any]] = {}
+        self._submit_rate = obs_flight.RateEwma(window_s=30.0)
         self._app.router.add_post("/api/heartbeat", self._heartbeat)
         self._app.router.add_get("/api/stats", self._stats_endpoint)
+        self._app.router.add_get("/api/fleet", self._fleet_endpoint)
+        self._app.router.add_get("/api/flight", self._flights_endpoint)
+        self._app.router.add_get("/api/flight/{job_id}",
+                                 self._flight_endpoint)
         # per-hive registry (hermetic, like the worker's): the snapshot
         # is the accounting tests reconcile against the result lists
         self.metrics = Registry()
@@ -149,7 +163,12 @@ class MiniHive(ChaoticHive):
 
     def submit(self, job: dict[str, Any]) -> None:
         job_id = str(job.get("id"))
-        self.submitted_at.setdefault(job_id, self._clock())
+        now = self._clock()
+        self.submitted_at.setdefault(job_id, now)
+        # flight record opens at submit (idempotent for resubmitted
+        # ids); the observed-arrival EWMA feeds /api/fleet
+        self.flights.open(job_id, job, t=now)
+        self._submit_rate.note(now)
         super().submit(job)
 
     # ---- chaos controls -------------------------------------------------
@@ -179,11 +198,16 @@ class MiniHive(ChaoticHive):
             lease = self.leases.pop(job_id)
             self._leases_expired.inc()
             self.excluded.setdefault(job_id, set()).add(lease["worker"])
+            self.flights.note(job_id, "lease_expired", t=now,
+                              worker=lease["worker"],
+                              attempt=lease["attempt"])
             if self.attempts.get(job_id, 0) >= self.max_attempts:
                 log.error("job %s abandoned after %d deliveries",
                           job_id, self.attempts.get(job_id, 0))
                 self.abandoned.append(job_id)
                 self._abandoned.inc()
+                self.flights.note(job_id, "abandoned", t=now,
+                                  attempts=self.attempts.get(job_id, 0))
                 # GC like the settle path does: an abandoned job's
                 # latent-sized checkpoint blob is never resumed again
                 self.checkpoints.pop(job_id, None)
@@ -193,6 +217,7 @@ class MiniHive(ChaoticHive):
                         lease["worker"], lease["attempt"])
             self.pending_jobs.append(lease["job"])
             self._redelivered.inc()
+            self.flights.note(job_id, "redelivered", t=now)
             redelivered.append(job_id)
         return redelivered
 
@@ -285,6 +310,20 @@ class MiniHive(ChaoticHive):
             checkpoint = self.checkpoints.get(job_id)
             if checkpoint is not None:
                 payload["resume"] = checkpoint
+            # swarmsight (ISSUE 13): every delivery carries the job's
+            # trace context — trace_id for the whole lifetime, a span
+            # id for THIS attempt — and the grant lands on the flight
+            # record's hive-clock timeline
+            resume_step = None
+            if isinstance(checkpoint, dict):
+                try:
+                    resume_step = int(checkpoint.get("step") or 0) or None
+                except (TypeError, ValueError):
+                    resume_step = None
+            payload[obs_flight.TRACE_CTX_KEY] = self.flights.grant(
+                job_id, attempt=attempt, worker=worker_name,
+                t=self._clock(), queued_s=payload.get("queued_s"),
+                resume_step=resume_step)
             out.append(payload)
         return out
 
@@ -294,11 +333,20 @@ class MiniHive(ChaoticHive):
                        worker_name: str) -> dict[str, Any]:
         self.sweep()
         job_id = str(result.get("id"))
+        # swarmsight (ISSUE 13): the worker's span digest is popped OFF
+        # the envelope into the flight record — every upload's, even a
+        # duplicate's or a refusal's (they are attempts in the story) —
+        # so stored/settled results keep their historical shape
+        digest = result.pop(obs_flight.SPAN_DIGEST_KEY, None)
+        if digest is not None:
+            self.flights.add_digest(job_id, digest)
         if job_id in self.completed:
             # the redelivery race settled already: ack idempotently so
             # the uploader stops retrying, but never double-count
             self.duplicate_results.append(result)
             self._duplicates.inc()
+            self.flights.note(job_id, "duplicate_upload",
+                              t=self._clock(), worker=worker_name)
             log.info("duplicate result for %s from %s acked (job already "
                      "settled)", job_id, worker_name or "unknown")
             return {"status": "duplicate"}
@@ -329,6 +377,8 @@ class MiniHive(ChaoticHive):
                 self.leases.pop(job_id, None)
                 self.pending_jobs.append(lease["job"])
             self._redispatched.inc(kind=kind)
+            self.flights.note(job_id, "redispatched", t=self._clock(),
+                              kind=kind, worker=refuser or None)
             log.warning("job %s refused by %s (%s); redispatching with "
                         "the refuser excluded", job_id,
                         refuser or "unknown", kind)
@@ -346,6 +396,8 @@ class MiniHive(ChaoticHive):
             # tests/test_minihive.py holds at harness scale)
             self.abandoned.remove(job_id)
             self._salvaged.inc()
+            self.flights.note(job_id, "salvaged", t=self._clock(),
+                              worker=worker_name)
             log.warning("job %s salvaged by a straggler upload after "
                         "abandonment", job_id)
         self.completed[job_id] = result
@@ -356,6 +408,26 @@ class MiniHive(ChaoticHive):
         self.pending_jobs = [j for j in self.pending_jobs
                              if str(j.get("id")) != job_id]
         self._completed.inc()
+        # the exactly-once settle closes the flight record and computes
+        # its deadline-budget attribution (obs/flight.py)
+        settle_attempt = None
+        if isinstance(digest, dict):
+            # a LATE upload can settle attempt 1 while attempt 2 is in
+            # flight: the digest knows which attempt's work this is.
+            # Coerced defensively — the field crossed the wire from a
+            # possibly version-skewed worker, and a garbage value must
+            # degrade to the lease books, never crash an already-
+            # counted settle into an unsettled flight record
+            try:
+                settle_attempt = int(digest.get("attempt"))
+            except (TypeError, ValueError):
+                settle_attempt = None
+        self.flights.settle(
+            job_id, t=self._clock(),
+            worker=worker_name or str(result.get("worker_name") or ""),
+            outcome=kind or "ok",
+            attempt=settle_attempt
+            if settle_attempt is not None else self.attempts.get(job_id))
         return {"status": "ok"}
 
     # ---- heartbeats ------------------------------------------------------
@@ -375,6 +447,14 @@ class MiniHive(ChaoticHive):
         self.worker_seen[worker_name] = self._clock()
         self.sweep()
         self._heartbeats.inc()
+        # fleet plane (ISSUE 13): heartbeats may push a per-worker
+        # metric snapshot (arrival EWMAs, lane occupancy, chips in
+        # service, residency ledger, overload state) — stored latest-
+        # wins and aggregated at GET /api/fleet
+        metrics = payload.get("metrics")
+        if isinstance(metrics, dict):
+            self.fleet[worker_name] = {"at": self._clock(),
+                                       "metrics": metrics}
         expiry = self._clock() + self.lease_s
         lost: list[str] = []
         for entry in payload.get("jobs") or []:
@@ -403,6 +483,14 @@ class MiniHive(ChaoticHive):
             if checkpoint is not None:
                 self.checkpoints[job_id] = checkpoint
                 self._ckpt_stored.inc()
+                # checkpoint marker on the flight timeline: the worker
+                # only re-pushes on change, so this is progress, not
+                # heartbeat noise
+                step = None
+                if isinstance(checkpoint, dict):
+                    step = checkpoint.get("step")
+                self.flights.note(job_id, "checkpoint", t=self._clock(),
+                                  worker=worker_name, step=step)
         return web.json_response({"status": "ok", "lost": lost})
 
     # ---- observability ---------------------------------------------------
@@ -421,9 +509,88 @@ class MiniHive(ChaoticHive):
             "abandoned": list(self.abandoned),
             "checkpoints": sorted(self.checkpoints),
             "metrics": self.metrics.snapshot(),
+            "flights": self.flights.snapshot(),
+        }
+
+    def fleet_snapshot(self) -> dict[str, Any]:
+        """The ``GET /api/fleet`` aggregate: latest per-worker metric
+        snapshots (heartbeat-pushed) plus hive-level queue state and the
+        observed-arrival EWMA — the data plane the ROADMAP item-5
+        capacity planner consumes (arrival rates, occupancy, chips in
+        service, residency, health, all in one place)."""
+        now = self._clock()
+        live = self.live_workers()
+        workers: dict[str, Any] = {}
+        for name, entry in sorted(self.fleet.items()):
+            workers[name] = dict(
+                entry["metrics"],
+                age_s=round(max(0.0, now - entry["at"]), 3),
+                live=name in live,
+                partitioned=name in self.partitioned,
+                leased_jobs=len(self.leased_ids(name)))
+        # aggregate over LIVE, reachable workers only: a dead worker's
+        # last snapshot stays in the per-worker map (debugging), but
+        # counting its chips/arrival rate forever would overstate fleet
+        # capacity to exactly the autoscaler this plane feeds
+        active = {name: w for name, w in workers.items()
+                  if w["live"] and not w["partitioned"]}
+
+        def total(key: str) -> float:
+            value = sum(float(w.get(key) or 0.0)
+                        for w in active.values())
+            return round(value, 4)
+
+        return {
+            "at_s": round(now, 6),
+            "workers": workers,
+            "aggregate": {
+                "workers_reporting": len(workers),
+                "workers_live": len(live),
+                "chips_in_service": int(total("chips_in_service")),
+                "arrival_rate_rows_s": total("arrival_rate_rows_s"),
+                "lane_occupancy_mean": round(
+                    total("lane_occupancy") / max(1, len(active)), 4),
+                "queue_depth": int(total("queue_depth")),
+                "inflight_jobs": int(total("inflight_jobs")),
+                "jobs_done": int(total("jobs_done")),
+                "jobs_shed": int(total("jobs_shed")),
+                "workers_in_brownout": sum(
+                    1 for w in active.values()
+                    if (w.get("overload") or {}).get("state")
+                    == "brownout"),
+                "observed_arrival_jobs_s": round(
+                    self._submit_rate.rate(now), 4),
+                "pending_jobs": len(self.pending_jobs),
+                "leased_jobs": len(self.leases),
+                "completed_jobs": len(self.completed),
+                "abandoned_jobs": len(self.abandoned),
+            },
         }
 
     async def _stats_endpoint(self, request):
         from aiohttp import web
 
         return web.json_response(self.stats())
+
+    async def _fleet_endpoint(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.fleet_snapshot())
+
+    async def _flights_endpoint(self, request):
+        from aiohttp import web
+
+        return web.json_response(dict(self.flights.snapshot(),
+                                      jobs=self.flights.job_ids()))
+
+    async def _flight_endpoint(self, request):
+        from aiohttp import web
+
+        job_id = request.match_info.get("job_id", "")
+        record = self.flights.get(job_id)
+        if record is None:
+            return web.json_response(
+                {"status": "unknown",
+                 "error": f"no flight record for job {job_id!r} (evicted "
+                          f"or never submitted)"}, status=404)
+        return web.json_response(record)

@@ -257,6 +257,17 @@ class OverloadController:
 
     # ---- observability ------------------------------------------------
 
+    def fleet_view(self) -> dict[str, Any]:
+        """Compact overload state for the fleet-plane heartbeat
+        (ISSUE 13, node/worker.py::_fleet_metrics -> GET /api/fleet):
+        just the fields an autoscaler reads — brownout state, shed
+        volume, and the per-workflow service EWMAs that price this
+        node's capacity."""
+        snap = self.snapshot()
+        return {"state": snap["state"],
+                "sheds_total": snap["sheds_total"],
+                "service_ewma_s": snap["service_ewma_s"]}
+
     def snapshot(self) -> dict[str, Any]:
         """The /healthz ``overload`` key (node/worker.py)."""
         now = self._clock()

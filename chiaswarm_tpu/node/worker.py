@@ -46,6 +46,7 @@ from typing import Any
 import aiohttp
 import jax
 
+from chiaswarm_tpu.obs import flight as obs_flight
 from chiaswarm_tpu.obs import metrics as obs_metrics
 from chiaswarm_tpu.obs import profiling as obs_profiling
 from chiaswarm_tpu.obs import trace as obs_trace
@@ -591,6 +592,55 @@ class Worker:
             data["models"] = model_states()
         return data
 
+    def _fleet_metrics(self) -> dict[str, Any]:
+        """Compact per-worker snapshot the heartbeat pushes to the hive's
+        fleet plane (ISSUE 13; served aggregated at ``GET /api/fleet``):
+        demand (arrival EWMA), supply (lane occupancy, chips in
+        service), state (overload, residency ledger) — the observed
+        inputs the ROADMAP item-5 autoscaler closes its loop on. Cheap
+        host dicts only; any failure degrades to a partial snapshot."""
+        data: dict[str, Any] = {
+            "queue_depth": self.work_queue.qsize(),
+            "inflight_jobs": len(self._inflight),
+            "jobs_done": self.jobs_done,
+            "jobs_shed": self.stats.jobs_shed,
+            "jobs_failed": self.stats.jobs_failed,
+            "chips_in_service": sum(
+                len(_slot_devices(slot)) or 1 for slot in self.pool),
+        }
+        try:
+            stepper = self._stepper_health()
+            data.update(
+                arrival_rate_rows_s=float(
+                    stepper.get("arrival_rate") or 0.0),
+                lane_occupancy=float(
+                    stepper.get("lane_occupancy") or 0.0),
+                padding_waste=float(
+                    stepper.get("padding_waste") or 0.0),
+                lanes_live=int(stepper.get("lanes_live") or 0),
+                step_seconds_ewma=float(
+                    stepper.get("step_seconds_ewma") or 0.0))
+        except Exception:  # lanes absent/stubbed: demand half missing
+            pass
+        try:
+            data["overload"] = self.overload.fleet_view()
+        except Exception:
+            pass
+        residency = getattr(self.registry, "residency", None)
+        if residency is not None:
+            try:
+                snap = residency.snapshot()
+                data["residency"] = {
+                    "resident_models": len(
+                        snap.get("resident_models") or ()),
+                    "resident_bytes": snap.get("resident_bytes", 0),
+                    "budget_bytes": snap.get("budget_bytes", 0),
+                    "evictions": snap.get("evictions", 0),
+                }
+            except Exception:  # stub registries
+                pass
+        return data
+
     def _stepper_health(self) -> dict[str, Any]:
         """Step-scheduler counters next to the resilience stats: lane
         occupancy vs padding waste, rows spliced mid-flight, steps
@@ -641,6 +691,11 @@ class Worker:
         m.gauge("chiaswarm_inflight_jobs",
                 "jobs between poll receipt and settled upload (the "
                 "lease-heartbeat set)").set(len(self._inflight))
+        # swarmsight (ISSUE 13): trace-ring eviction becomes a counter
+        # so a slow scraper SEES that it lost spans (pair with the
+        # /debug/traces?since= cursor instead of scraping faster)
+        obs_metrics.trace_spans_evicted_counter(m).set_to(
+            self.traces.spans_evicted)
         state_code = {"closed": 0, "half_open": 1, "open": 2}
         breaker_state = m.gauge(
             "chiaswarm_breaker_state",
@@ -697,12 +752,30 @@ class Worker:
                 headers={"Content-Type": obs_metrics.CONTENT_TYPE})
 
         async def traces_endpoint(request):
+            # ?since=<seq> is the scrape cursor (ISSUE 13): only traces
+            # pushed after that ring sequence return, and the cursor
+            # block tells the scraper whether eviction opened a gap
+            # since its last visit (oldest_seq > since + 1)
+            since = None
+            if request.query.get("since"):
+                try:
+                    since = int(request.query["since"])
+                except ValueError:
+                    return web.json_response(
+                        {"status": "error",
+                         "error": "since must be an integer ring "
+                                  "sequence number"}, status=400)
+            cursor = self.traces.cursor()
             if request.query.get("format") == "tree":
                 return web.json_response(
-                    {"traces": self.traces.to_dicts()})
+                    {"traces": self.traces.to_dicts(since),
+                     "cursor": cursor})
             # default: chrome-tracing "complete" events — load the body
-            # as-is at https://ui.perfetto.dev
-            return web.json_response(self.traces.to_chrome())
+            # as-is at https://ui.perfetto.dev (the extra cursor key is
+            # ignored by the viewer)
+            doc = self.traces.to_chrome(since)
+            doc["cursor"] = cursor
+            return web.json_response(doc)
 
         async def numerics_endpoint(request):
             # swarmlens flight recorder (ISSUE 11): the bounded ring of
@@ -855,15 +928,34 @@ class Worker:
             # poll, not this one job). Redelivered jobs carry their
             # lineage: delivery attempt + the checkpoint step they
             # resume from (lease-aware hives, node/minihive.py).
+            # ``queued_s`` (the hive's queue-age stamp) and ``attempt``
+            # ride as root-span attributes on EVERY trace, so
+            # /debug/traces answers "how stale was this job" without
+            # the overload estimator being the only reader (ISSUE 13).
             resume = job.get("resume")
+            ctx = job.pop(obs_flight.TRACE_CTX_KEY, None)
+            try:
+                queued_s = max(0.0, float(job.get("queued_s") or 0.0))
+            except (TypeError, ValueError):
+                queued_s = 0.0
             trace = obs_trace.JobTrace(
                 "job", id=job.get("id"),
                 model=str(job.get("model_name") or ""),
                 workflow=str(job.get("workflow") or ""),
                 worker=self.settings.worker_name,
                 attempt=job.get("attempt") or 1,
+                queued_s=round(queued_s, 4),
                 resume_step=(resume.get("step", 0)
                              if isinstance(resume, dict) else 0))
+            if isinstance(ctx, dict) and ctx.get("trace_id"):
+                # JOIN the hive's trace context (swarmsight, ISSUE 13):
+                # this trace becomes the hive-granted attempt span's
+                # child and the upload will carry a span digest for the
+                # hive's flight record. With no context (reference
+                # hive) the trace originates locally and the upload
+                # payload keeps its historical shape — parity.
+                trace.meta["trace_id"] = str(ctx.get("trace_id"))
+                trace.meta["span_id"] = str(ctx.get("span_id") or "")
             trace.phase("poll", http_s=round(poll_http_s, 6))
             obs_trace.attach(job, trace)
             self._inflight[job.get("id")] = time.monotonic()
@@ -986,6 +1078,13 @@ class Worker:
         deduped hive-side; first upload wins either way), but the loss
         is counted and logged so operators see lease churn."""
         interval = float(self.settings.heartbeat_s)
+        # fleet-plane cadence (ISSUE 13): metric snapshots refresh at
+        # most every ~2s — lease keep-alives can beat at 20 Hz in tests,
+        # and re-serializing occupancy/residency state on every beat
+        # would tax exactly the busy loops the plane observes. An
+        # autoscaler reads seconds-scale state; 0 forces the next beat.
+        metrics_every = max(interval, 2.0)
+        last_metrics = float("-inf")
         pushed: dict[Any, int] = {}  # job id -> spool version last pushed
         # leases the hive already told us it reassigned: count + warn
         # ONCE per loss, not once per beat for as long as the local run
@@ -1022,6 +1121,23 @@ class Worker:
                 if not self._inflight:
                     pushed.clear()
                     lost_reported.clear()
+                    # fleet plane (ISSUE 13): an idle worker still
+                    # pushes metrics-only beats (no jobs, no lease
+                    # bookkeeping) so /api/fleet reads fresh occupancy
+                    # and capacity — an autoscaler must see idle
+                    # workers, not just busy ones — at the throttled
+                    # metrics cadence, not the lease cadence
+                    if time.monotonic() - last_metrics < metrics_every:
+                        continue
+                    try:
+                        await self.hive.post_heartbeat(session, {
+                            "worker_name": self.settings.worker_name,
+                            "jobs": [],
+                            "metrics": self._fleet_metrics(),
+                        })
+                        last_metrics = time.monotonic()
+                    except Exception as exc:
+                        log.debug("idle heartbeat failed: %s", exc)
                     continue
                 inflight = list(self._inflight)
                 for job_id in [j for j in pushed if j not in self._inflight]:
@@ -1031,6 +1147,15 @@ class Worker:
                     "worker_name": self.settings.worker_name,
                     "jobs": await asyncio.to_thread(build_jobs, inflight),
                 }
+                if time.monotonic() - last_metrics >= metrics_every:
+                    # fleet plane (ISSUE 13): busy beats carry the
+                    # metric snapshot at the same throttled cadence;
+                    # the hive keeps the latest per worker at
+                    # /api/fleet. Reference hives (no heartbeat
+                    # endpoint) never see it — heartbeats are already
+                    # off there.
+                    payload["metrics"] = self._fleet_metrics()
+                    last_metrics = time.monotonic()
                 try:
                     response = await self.hive.post_heartbeat(session,
                                                               payload)
@@ -1501,6 +1626,21 @@ class Worker:
         result.setdefault("worker_name", self.settings.worker_name)
         if trace is not None:
             trace.phase("upload")
+            if trace.meta.get("trace_id"):
+                # swarmsight (ISSUE 13): a hive that stamped a trace
+                # context gets the span digest back on the envelope —
+                # the worker half of the cross-worker flight record.
+                # Attached BEFORE the upload so a dead-lettered result
+                # replays it later (straggler salvage keeps its story);
+                # never attached without a context, so the reference-
+                # hive wire shape is untouched.
+                try:
+                    result[obs_flight.SPAN_DIGEST_KEY] = \
+                        obs_flight.span_digest(
+                            trace, worker_name=self.settings.worker_name)
+                except Exception as exc:  # telemetry must never block
+                    log.debug("span digest failed for %s: %s",
+                              result.get("id"), exc)
         try:
             with obs_trace.activate(trace):
                 uploaded = await self._upload_with_retry(session, result)

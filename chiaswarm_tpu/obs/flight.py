@@ -1,0 +1,695 @@
+"""swarmsight: cross-worker flight records + the fleet observability plane.
+
+Everything observability built so far is strictly per-process: swarmscope
+(obs/trace.py) gives each worker span trees, swarmlens (obs/numerics.py)
+gives each program numerics — but a job that is shed, redispatched,
+killed mid-lane and resumed on a second worker leaves disconnected
+fragments in three different trace rings and no single answer to "where
+did this job's deadline budget go". This module closes that gap with the
+standard Dapper recipe — context propagation plus hive-side assembly:
+
+- **Trace context**: the hive stamps ``trace_ctx`` (one ``trace_id`` per
+  job, one ``span_id`` per delivery attempt) into every granted payload
+  (:data:`TRACE_CTX_KEY`). The worker JOINs it: its existing
+  :class:`~chiaswarm_tpu.obs.trace.JobTrace` becomes that attempt span's
+  child, originating locally only when the hive sends none — so against
+  a reference hive nothing changes, on the wire or in the trace ring.
+- **Span digests**: every result envelope uploaded for a
+  context-carrying job rides a compact :func:`span_digest` of the
+  worker's span tree (:data:`SPAN_DIGEST_KEY`) — phase boundaries and
+  the named pipeline spans, as offsets on the worker's own
+  ``perf_counter`` timebase plus a wall anchor. The hive pops the digest
+  off the envelope before storing it, so settled results keep their
+  historical shape.
+- **Flight records**: :class:`FlightRecorder` (bounded, hive-side)
+  assembles the authoritative per-job record: submit → grant(attempt,
+  worker) → heartbeat checkpoint markers → shed / redispatch / lease
+  expiry / redelivery / salvage / abandonment → exactly-once settle,
+  each event on the hive clock, with the per-attempt worker digests
+  attached. Served at ``GET /api/flight/<job_id>``
+  (node/minihive.py); ``tools/job_flight.py`` renders one record as a
+  tree, a timeline, or Perfetto JSON spanning workers.
+- **Budget attribution**: at settle, :func:`budget_attribution`
+  decomposes the job's end-to-end latency into named phases —
+  ``hive_queue``, ``admission`` (local queue wait + format + encode),
+  ``lane_wait`` (splice wait behind a full lane), ``steps``, ``decode``,
+  ``upload``, ``retry`` (chip time burned by non-settling attempts) and
+  the ``other`` residue — so a p99 miss points at a phase, not just a
+  number. ``loadgen.score_run`` folds these into per-family tables.
+- **The fleet plane**: heartbeats push per-worker metric snapshots
+  (arrival EWMAs, lane occupancy, chips in service, residency ledger,
+  overload state); the hive aggregates them at ``GET /api/fleet`` —
+  exactly the observed-state data plane the ROADMAP item-5 autoscaler
+  consumes. :class:`RateEwma` is the hive-side observed-arrival
+  estimator.
+
+Per-worker clock alignment: a digest's span offsets live on that
+worker's ``perf_counter`` epoch, which means nothing hive-side. The
+renderers anchor each attempt's offsets at its hive-stamped GRANT time
+and report the residual against the hive-stamped SETTLE
+(``clock_skew_s``) — two anchors, no clock protocol, accurate to one
+poll RTT. Everything here is stdlib-only (the hive, the tools, and the
+tests all run without jax).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import uuid
+from typing import Any, Iterable
+
+#: wire field the hive stamps into every granted job payload:
+#: ``{"trace_id": str, "span_id": str, "attempt": int}``. The worker
+#: pops it at poll receipt (node/worker.py) — it never reaches argument
+#: formatting or a pipeline callback.
+TRACE_CTX_KEY = "trace_ctx"
+
+#: result-envelope field carrying the worker's span digest hive-ward.
+#: Attached ONLY when the job carried a hive trace context, so the
+#: upload payload against a context-less (reference) hive stays
+#: byte-compatible with the pre-swarmsight wire shape (gated by test).
+SPAN_DIGEST_KEY = "span_digest"
+
+ENV_FLIGHT_CAPACITY = "CHIASWARM_FLIGHT_RING"
+
+#: per-record event cap: a pathological job (lease churn every beat)
+#: must not grow one record without bound; drops are counted, loudly
+MAX_EVENTS_PER_FLIGHT = 512
+
+#: per-digest span cap (the digest is a summary, not the full tree)
+MAX_DIGEST_SPANS = 64
+
+#: the attribution phase vocabulary, in render order
+ATTRIBUTION_PHASES = ("hive_queue", "admission", "lane_wait", "steps",
+                      "decode", "upload", "retry", "other")
+
+
+def new_trace_id() -> str:
+    """One id per job lifetime, shared by every attempt's spans."""
+    return uuid.uuid4().hex[:16]
+
+
+def attempt_span_id(trace_id: str, attempt: int) -> str:
+    """Deterministic per-attempt span id: stitching needs no registry
+    round-trip — the attempt number IS the suffix."""
+    return f"{trace_id}.{int(attempt)}"
+
+
+def _small_meta(meta: dict[str, Any]) -> dict[str, Any]:
+    """JSON-safe scalar subset of a span's metadata (digests cross the
+    wire; device arrays and long blobs must not)."""
+    out: dict[str, Any] = {}
+    for key, value in meta.items():
+        if isinstance(value, bool) or isinstance(value, (int, float)):
+            out[key] = value
+        elif isinstance(value, str) and len(value) <= 200:
+            out[key] = value
+    return out
+
+
+def span_digest(trace: Any, worker_name: str = "") -> dict[str, Any]:
+    """Compact, JSON-safe summary of one JobTrace for the result
+    envelope: top-level phases plus the named pipeline spans below them,
+    all as offsets from the root start on this worker's perf_counter
+    timebase. Built at upload START (the upload phase itself is measured
+    hive-side from the grant/settle anchors), so ``duration_s`` covers
+    poll receipt -> upload start."""
+    root = trace.root
+    meta = root.meta
+    phases: list[dict[str, Any]] = []
+    spans: list[dict[str, Any]] = []
+    truncated = False
+    for phase in root.children:
+        phases.append({
+            "name": phase.name,
+            "t0_s": round(phase.t0 - root.t0, 6),
+            "dur_s": round(phase.duration_s, 6),
+        })
+        queue: list[tuple[Any, str]] = [(child, phase.name)
+                                        for child in phase.children]
+        while queue:
+            node, phase_name = queue.pop(0)
+            if len(spans) >= MAX_DIGEST_SPANS:
+                truncated = True
+                break
+            entry: dict[str, Any] = {
+                "name": node.name,
+                "phase": phase_name,
+                "t0_s": round(node.t0 - root.t0, 6),
+                "dur_s": round(node.duration_s, 6),
+            }
+            small = _small_meta(node.meta)
+            if small:
+                entry["meta"] = small
+            spans.append(entry)
+            queue.extend((child, phase_name) for child in node.children)
+    digest: dict[str, Any] = {
+        "trace_id": str(meta.get("trace_id") or ""),
+        "span_id": str(meta.get("span_id") or ""),
+        "attempt": int(meta.get("attempt") or 1),
+        "worker": str(worker_name or meta.get("worker") or ""),
+        "started_at_unix": round(float(trace.started_at_unix), 6),
+        "duration_s": round(root.duration_s, 6),
+        "phases": phases,
+        "spans": spans,
+    }
+    if truncated:
+        digest["spans_truncated"] = True
+    for key in ("queued_s", "resume_step"):
+        if meta.get(key) is not None:
+            try:
+                digest[key] = float(meta[key])
+            except (TypeError, ValueError):
+                pass
+    return digest
+
+
+class RateEwma:
+    """Observed event rate (events/second), exponentially weighted over
+    ``window_s`` on caller-supplied timestamps — the hive's injectable
+    fake clocks work unchanged. The fleet plane's observed-arrival
+    estimator (the quantity the item-5 autoscaler plans against)."""
+
+    def __init__(self, window_s: float = 30.0) -> None:
+        self.window_s = max(1e-6, float(window_s))
+        self._rate = 0.0
+        self._last: float | None = None
+
+    def note(self, now: float, n: float = 1.0) -> None:
+        if self._last is None:
+            self._last = float(now)
+            return
+        dt = max(1e-6, float(now) - self._last)
+        alpha = 1.0 - math.exp(-dt / self.window_s)
+        self._rate += alpha * (float(n) / dt - self._rate)
+        self._last = float(now)
+
+    def rate(self, now: float) -> float:
+        if self._last is None:
+            return 0.0
+        idle = max(0.0, float(now) - self._last)
+        return self._rate * math.exp(-idle / self.window_s)
+
+
+# ---------------------------------------------------------------------------
+# the hive-side recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded per-job flight-record store (hive-side).
+
+    One record per job id, evicting oldest-opened beyond ``capacity``
+    (``CHIASWARM_FLIGHT_RING``, default 2048). All timestamps come from
+    the caller's clock (the hive's injectable monotonic clock), so the
+    whole record lives on ONE timebase; worker digests carry their own
+    perf_counter offsets and are aligned at render time on the
+    grant/settle anchors."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get(ENV_FLIGHT_CAPACITY, "2048")
+                           or 2048)
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._records: collections.OrderedDict[str, dict[str, Any]] = \
+            collections.OrderedDict()
+        self.evicted = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def job_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._records)
+
+    # ---- building ------------------------------------------------------
+
+    def _open_locked(self, job_id: str) -> dict[str, Any]:
+        record = self._records.get(job_id)
+        if record is None:
+            record = {
+                "job_id": job_id,
+                "trace_id": new_trace_id(),
+                "model": "", "workflow": "", "deadline_s": None,
+                "submitted_at": None,
+                "events": [], "events_dropped": 0,
+                "granted": {},      # attempt -> {"t", "worker"}
+                "digests": {},      # attempt -> span digest
+                "settled": None,
+                "attribution": None,
+            }
+            self._records[job_id] = record
+            while len(self._records) > self.capacity:
+                self._records.popitem(last=False)
+                self.evicted += 1
+        return record
+
+    def open(self, job_id: Any, job: dict[str, Any] | None, *,
+             t: float) -> None:
+        """Start (or refresh) a record at hive submit. Idempotent: a
+        resubmitted id keeps its existing trace and history."""
+        with self._lock:
+            record = self._open_locked(str(job_id))
+            if record["submitted_at"] is None:
+                record["submitted_at"] = float(t)
+                self._note_locked(record, t, "submit")
+            if isinstance(job, dict):
+                record["model"] = record["model"] or str(
+                    job.get("model_name") or "")
+                record["workflow"] = record["workflow"] or str(
+                    job.get("workflow") or "txt2img")
+                if record["deadline_s"] is None and job.get("deadline_s"):
+                    try:
+                        record["deadline_s"] = float(job["deadline_s"])
+                    except (TypeError, ValueError):
+                        pass
+
+    @staticmethod
+    def _note_locked(record: dict[str, Any], t: float, event: str,
+                     **fields: Any) -> None:
+        if len(record["events"]) >= MAX_EVENTS_PER_FLIGHT:
+            record["events_dropped"] += 1
+            return
+        entry = {"t": round(float(t), 6), "event": str(event)}
+        for key, value in fields.items():
+            if value is not None:
+                entry[key] = value
+        record["events"].append(entry)
+
+    def note(self, job_id: Any, event: str, *, t: float,
+             **fields: Any) -> None:
+        """Append one hive-clock event (lease expiry, redelivery,
+        checkpoint marker, shed, salvage, ...)."""
+        with self._lock:
+            self._note_locked(self._open_locked(str(job_id)), t, event,
+                              **fields)
+
+    def grant(self, job_id: Any, *, attempt: int, worker: str, t: float,
+              queued_s: float | None = None,
+              resume_step: int | None = None) -> dict[str, Any]:
+        """Record one delivery and return the wire trace context the
+        payload carries (:data:`TRACE_CTX_KEY`)."""
+        with self._lock:
+            record = self._open_locked(str(job_id))
+            attempt = int(attempt)
+            record["granted"][attempt] = {"t": round(float(t), 6),
+                                          "worker": str(worker)}
+            self._note_locked(record, t, "grant", attempt=attempt,
+                              worker=str(worker), queued_s=queued_s,
+                              resume_step=resume_step)
+            return {"trace_id": record["trace_id"],
+                    "span_id": attempt_span_id(record["trace_id"],
+                                               attempt),
+                    "attempt": attempt}
+
+    def add_digest(self, job_id: Any, digest: Any) -> None:
+        """Attach a worker span digest under its attempt (uploads for
+        duplicates and redispatched refusals record too — they are part
+        of the story)."""
+        if not isinstance(digest, dict):
+            return
+        try:
+            attempt = int(digest.get("attempt") or 0)
+        except (TypeError, ValueError):
+            attempt = 0
+        if attempt < 1:
+            # a digest that cannot name its attempt cannot be stitched
+            # — dropping it beats filing an orphan under attempt 0 that
+            # the completeness audit would forever flag
+            return
+        with self._lock:
+            record = self._records.get(str(job_id))
+            if record is None:
+                return
+            record["digests"][attempt] = digest
+
+    def settle(self, job_id: Any, *, t: float, worker: str, outcome: str,
+               attempt: int | None = None) -> None:
+        """The exactly-once settle closes the record and computes the
+        deadline-budget attribution."""
+        with self._lock:
+            record = self._records.get(str(job_id))
+            if record is None:
+                return
+            if record["settled"] is not None:
+                return  # exactly once, here too
+            if attempt is None:
+                attempt = max(record["granted"], default=0)
+            record["settled"] = {"t": round(float(t), 6),
+                                 "worker": str(worker),
+                                 "outcome": str(outcome),
+                                 "attempt": int(attempt)}
+            self._note_locked(record, t, "settled", worker=str(worker),
+                              outcome=str(outcome), attempt=int(attempt))
+            record["attribution"] = budget_attribution(record)
+
+    # ---- reading -------------------------------------------------------
+
+    def get(self, job_id: Any) -> dict[str, Any] | None:
+        """JSON view of one record (attempt maps become sorted lists)."""
+        with self._lock:
+            record = self._records.get(str(job_id))
+            if record is None:
+                return None
+            view = {k: v for k, v in record.items()
+                    if k not in ("granted", "digests")}
+            view["events"] = list(record["events"])
+            view["attempts"] = [
+                dict(record["granted"].get(attempt, {}),
+                     attempt=attempt,
+                     digest=record["digests"].get(attempt))
+                for attempt in sorted(set(record["granted"])
+                                      | set(record["digests"]))
+            ]
+            return view
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            settled = sum(1 for r in self._records.values()
+                          if r["settled"] is not None)
+            return {"records": len(self._records), "settled": settled,
+                    "evicted": self.evicted, "capacity": self.capacity}
+
+    def verify(self, job_ids: Iterable[Any], *,
+               require_settled: bool = True) -> list[str]:
+        """Flight-completeness audit (the nightly soak gate): every id
+        has a record, attempt numbers are gapless from 1, every digest
+        hangs off a granted attempt, and (by default) the record
+        settled. Returns human-readable problems; [] means clean."""
+        problems: list[str] = []
+        with self._lock:
+            for raw in job_ids:
+                job_id = str(raw)
+                record = self._records.get(job_id)
+                if record is None:
+                    problems.append(f"{job_id}: no flight record")
+                    continue
+                attempts = sorted(record["granted"])
+                if attempts != list(range(1, len(attempts) + 1)):
+                    problems.append(
+                        f"{job_id}: attempt gap in grants {attempts}")
+                orphans = sorted(set(record["digests"])
+                                 - set(record["granted"]))
+                if orphans:
+                    problems.append(
+                        f"{job_id}: orphan span digest(s) for "
+                        f"attempt(s) {orphans}")
+                if require_settled and record["settled"] is None:
+                    problems.append(f"{job_id}: never settled")
+                if record["events_dropped"]:
+                    problems.append(
+                        f"{job_id}: {record['events_dropped']} event(s) "
+                        f"dropped at the record cap")
+        return problems
+
+
+# ---------------------------------------------------------------------------
+# deadline-budget attribution
+# ---------------------------------------------------------------------------
+
+
+def _digest_phase_split(digest: dict[str, Any] | None
+                        ) -> dict[str, float]:
+    """Worker-side phase seconds from one span digest: admission (local
+    queue wait + format + encode prep), lane_wait (splice wait stamped
+    by the lane as ``splice_wait_s``), steps, decode."""
+    out = {"admission": 0.0, "lane_wait": 0.0, "steps": 0.0,
+           "decode": 0.0}
+    if not isinstance(digest, dict):
+        return out
+    for phase in digest.get("phases") or ():
+        if phase.get("name") == "poll":
+            out["admission"] += float(phase.get("dur_s") or 0.0)
+    for span in digest.get("spans") or ():
+        name = span.get("name")
+        dur = max(0.0, float(span.get("dur_s") or 0.0))
+        if name in ("format", "encode"):
+            out["admission"] += dur
+        elif name == "step":
+            wait = 0.0
+            meta = span.get("meta")
+            if isinstance(meta, dict):
+                try:
+                    wait = max(0.0, float(meta.get("splice_wait_s")
+                                          or 0.0))
+                except (TypeError, ValueError):
+                    wait = 0.0
+            wait = min(wait, dur)
+            out["lane_wait"] += wait
+            out["steps"] += dur - wait
+        elif name == "decode":
+            out["decode"] += dur
+    return out
+
+
+def budget_attribution(record: dict[str, Any]) -> dict[str, Any] | None:
+    """Decompose one settled record's end-to-end latency into the
+    :data:`ATTRIBUTION_PHASES`. Hive-clock phases (hive_queue, retry,
+    upload) come from the event timeline + grant/settle anchors;
+    worker-side phases come from the settling attempt's digest. The
+    unattributable residue lands in ``other`` — never silently spread
+    over the named phases."""
+    settled = record.get("settled")
+    submitted = record.get("submitted_at")
+    if settled is None or submitted is None:
+        return None
+    t_settle = float(settled["t"])
+    final_attempt = int(settled.get("attempt") or 0)
+    hive_queue = retry = 0.0
+    last_enqueue: float | None = float(submitted)
+    open_grant: tuple[int, float] | None = None  # (attempt, t_granted)
+    for event in record.get("events") or ():
+        kind = event.get("event")
+        t = float(event.get("t") or 0.0)
+        if kind == "grant":
+            if last_enqueue is not None:
+                hive_queue += max(0.0, t - last_enqueue)
+                last_enqueue = None
+            open_grant = (int(event.get("attempt") or 0), t)
+        elif kind in ("redispatched", "redelivered", "lease_expired"):
+            # an attempt's lease ended without settling HERE: its
+            # grant-to-here wall is retry overhead — UNLESS this very
+            # attempt later settles the job (a straggler upload
+            # salvaging after expiry): its time is productive work the
+            # digest already attributes, so booking it as retry would
+            # double-count the same interval
+            if open_grant is not None:
+                attempt, t_granted = open_grant
+                if attempt != final_attempt:
+                    retry += max(0.0, t - t_granted)
+                open_grant = None
+            if kind != "lease_expired" and last_enqueue is None:
+                last_enqueue = t
+            elif kind == "lease_expired":
+                last_enqueue = t
+    digest = (record.get("digests") or {}).get(final_attempt)
+    split = _digest_phase_split(digest)
+    upload = 0.0
+    grant_final = (record.get("granted") or {}).get(final_attempt)
+    if digest is not None and grant_final is not None:
+        # the settle anchor: hive-observed attempt wall minus the
+        # digest's own (poll receipt -> upload start) duration is the
+        # upload leg, network included
+        upload = max(0.0, (t_settle - float(grant_final["t"]))
+                     - float(digest.get("duration_s") or 0.0))
+    total = max(0.0, t_settle - float(submitted))
+    phases = {
+        "hive_queue": hive_queue,
+        "admission": split["admission"],
+        "lane_wait": split["lane_wait"],
+        "steps": split["steps"],
+        "decode": split["decode"],
+        "upload": upload,
+        "retry": retry,
+    }
+    phases["other"] = max(0.0, total - sum(phases.values()))
+    phases = {k: round(v, 6) for k, v in phases.items()}
+    dominant = max(ATTRIBUTION_PHASES, key=lambda p: phases[p]) \
+        if total > 0 else None
+    return {"total_s": round(total, 6), "phases": phases,
+            "dominant_phase": dominant, "attempt": final_attempt,
+            "measured": digest is not None}
+
+
+# ---------------------------------------------------------------------------
+# rendering (tools/job_flight.py is a thin CLI over these)
+# ---------------------------------------------------------------------------
+
+
+def _attempt_anchor(record: dict[str, Any],
+                    attempt: dict[str, Any]) -> float | None:
+    """Hive-clock anchor for one attempt's worker-relative offsets: the
+    grant stamp (offsets start at poll receipt ~ one RTT later)."""
+    t = attempt.get("t")
+    return None if t is None else float(t)
+
+
+def _attempt_skew(record: dict[str, Any],
+                  attempt: dict[str, Any]) -> float | None:
+    """Residual between the settle anchor and grant-anchored digest end
+    — the cross-clock sanity number the renderers surface."""
+    digest = attempt.get("digest")
+    settled = record.get("settled")
+    if not digest or not settled \
+            or settled.get("attempt") != attempt.get("attempt"):
+        return None
+    anchor = _attempt_anchor(record, attempt)
+    if anchor is None:
+        return None
+    return round(float(settled["t"])
+                 - (anchor + float(digest.get("duration_s") or 0.0)), 6)
+
+
+def flight_to_chrome(record: dict[str, Any]) -> dict[str, Any]:
+    """One Perfetto-loadable document for one flight record: the hive
+    event timeline as instant events on pid 0, one pid per WORKER with
+    one tid per attempt, every attempt's spans anchored at its
+    hive-stamped grant. Load the JSON at https://ui.perfetto.dev."""
+    base = float(record.get("submitted_at") or 0.0)
+
+    def us(t: float) -> int:
+        return max(0, int((float(t) - base) * 1e6))
+
+    events: list[dict[str, Any]] = [
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "hive"}},
+    ]
+    for event in record.get("events") or ():
+        args = {k: str(v) for k, v in event.items()
+                if k not in ("t", "event")}
+        events.append({"name": event.get("event", "?"), "ph": "i",
+                       "s": "g", "ts": us(event.get("t") or base),
+                       "pid": 0, "tid": 0, "args": args})
+    worker_pids: dict[str, int] = {}
+    for attempt in record.get("attempts") or ():
+        digest = attempt.get("digest")
+        worker = str(attempt.get("worker")
+                     or (digest or {}).get("worker") or "?")
+        pid = worker_pids.setdefault(worker, len(worker_pids) + 1)
+        if pid == len(worker_pids):  # newly assigned: name the track
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": f"worker {worker}"}})
+        anchor = _attempt_anchor(record, attempt)
+        if digest is None or anchor is None:
+            continue
+        tid = int(attempt.get("attempt") or 1)
+        skew = _attempt_skew(record, attempt)
+        args = {"trace_id": str(record.get("trace_id") or ""),
+                "span_id": str(digest.get("span_id") or "")}
+        if skew is not None:
+            args["clock_skew_s"] = str(skew)
+        events.append({
+            "name": f"attempt {tid}", "ph": "X", "ts": us(anchor),
+            "dur": max(1, int(float(digest.get("duration_s") or 0.0)
+                              * 1e6)),
+            "pid": pid, "tid": tid, "args": args})
+        for entry in list(digest.get("phases") or ()) \
+                + list(digest.get("spans") or ()):
+            events.append({
+                "name": entry.get("name", "?"), "ph": "X",
+                "ts": us(anchor + float(entry.get("t0_s") or 0.0)),
+                "dur": max(1, int(float(entry.get("dur_s") or 0.0)
+                                  * 1e6)),
+                "pid": pid, "tid": tid,
+                "args": {k: str(v) for k, v in
+                         (entry.get("meta") or {}).items()}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _attribution_lines(record: dict[str, Any]) -> list[str]:
+    attribution = record.get("attribution")
+    if not attribution:
+        return ["  (not settled yet — no attribution)"]
+    lines = [f"  total {attribution['total_s']:.3f}s   dominant: "
+             f"{attribution['dominant_phase']}"]
+    total = max(1e-9, float(attribution["total_s"]))
+    for phase in ATTRIBUTION_PHASES:
+        value = float(attribution["phases"].get(phase, 0.0))
+        lines.append(f"  {phase:<11} {value:9.4f}s  "
+                     f"{100.0 * value / total:5.1f}%")
+    return lines
+
+
+def render_tree(record: dict[str, Any]) -> str:
+    """Human-readable nested view: header, hive events, per-attempt
+    span trees, attribution table."""
+    lines = [
+        f"flight {record.get('job_id')}  trace={record.get('trace_id')}",
+        f"  model={record.get('model') or '?'}  "
+        f"workflow={record.get('workflow') or '?'}  "
+        f"deadline_s={record.get('deadline_s')}",
+        "events:",
+    ]
+    base = float(record.get("submitted_at") or 0.0)
+    for event in record.get("events") or ():
+        extra = "  ".join(f"{k}={v}" for k, v in event.items()
+                          if k not in ("t", "event"))
+        lines.append(f"  +{float(event['t']) - base:8.3f}s "
+                     f"{event['event']:<14} {extra}")
+    for attempt in record.get("attempts") or ():
+        n = attempt.get("attempt")
+        worker = attempt.get("worker") or "?"
+        lines.append(f"attempt {n} on {worker}:")
+        digest = attempt.get("digest")
+        if not digest:
+            lines.append("  (no span digest uploaded)")
+            continue
+        skew = _attempt_skew(record, attempt)
+        if skew is not None:
+            lines.append(f"  clock_skew_s={skew}")
+        for phase in digest.get("phases") or ():
+            lines.append(f"  {phase['name']:<10} "
+                         f"+{phase['t0_s']:8.3f}s  "
+                         f"{phase['dur_s']:.4f}s")
+            for span in digest.get("spans") or ():
+                if span.get("phase") == phase["name"]:
+                    lines.append(f"    {span['name']:<10} "
+                                 f"+{span['t0_s']:8.3f}s  "
+                                 f"{span['dur_s']:.4f}s")
+    lines.append("budget attribution:")
+    lines.extend(_attribution_lines(record))
+    return "\n".join(lines)
+
+
+def render_timeline(record: dict[str, Any]) -> str:
+    """One merged hive-clock timeline: hive events and grant-anchored
+    worker spans interleaved in time order across workers."""
+    base = float(record.get("submitted_at") or 0.0)
+    rows: list[tuple[float, str]] = []
+    for event in record.get("events") or ():
+        extra = "  ".join(f"{k}={v}" for k, v in event.items()
+                          if k not in ("t", "event"))
+        rows.append((float(event["t"]) - base,
+                     f"[hive] {event['event']} {extra}".rstrip()))
+    for attempt in record.get("attempts") or ():
+        digest = attempt.get("digest")
+        anchor = _attempt_anchor(record, attempt)
+        if not digest or anchor is None:
+            continue
+        tag = f"[{digest.get('worker') or '?'}#{attempt.get('attempt')}]"
+        for entry in list(digest.get("phases") or ()) \
+                + list(digest.get("spans") or ()):
+            rows.append((anchor - base + float(entry.get("t0_s") or 0.0),
+                         f"{tag} {entry.get('name')} "
+                         f"{float(entry.get('dur_s') or 0.0):.4f}s"))
+    rows.sort(key=lambda r: r[0])
+    lines = [f"timeline {record.get('job_id')} "
+             f"trace={record.get('trace_id')}"]
+    lines.extend(f"+{t:8.3f}s  {text}" for t, text in rows)
+    lines.append("budget attribution:")
+    lines.extend(_attribution_lines(record))
+    return "\n".join(lines)
+
+
+__all__ = [
+    "ATTRIBUTION_PHASES", "FlightRecorder", "MAX_DIGEST_SPANS",
+    "MAX_EVENTS_PER_FLIGHT", "RateEwma", "SPAN_DIGEST_KEY",
+    "TRACE_CTX_KEY", "attempt_span_id", "budget_attribution",
+    "flight_to_chrome", "new_trace_id", "render_timeline", "render_tree",
+    "span_digest",
+]

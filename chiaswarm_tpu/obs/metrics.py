@@ -773,5 +773,21 @@ def guard_quarantined_gauge(registry: Registry | None = None) -> Gauge:
         "devices currently quarantined out of the serving mesh")
 
 
+# ---- swarmsight families (ISSUE 13, obs/flight.py) ----
+
+
+def trace_spans_evicted_counter(
+        registry: Registry | None = None) -> Counter:
+    """Spans dropped from the bounded trace ring by eviction — the
+    signal that a scraper polling ``/debug/traces`` too slowly is
+    LOSING trace data, not that there is none. Pair with the endpoint's
+    ``?since=<seq>`` cursor: a gap between the scraper's last seq and
+    the ring's oldest seq is exactly this eviction window."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_trace_spans_evicted_total",
+        "spans evicted from the bounded trace ring before any scrape "
+        "collected them (use /debug/traces?since= to detect gaps)")
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -151,6 +151,9 @@ class JobTrace:
         # this"); durations never touch it (swarmlint R8)
         self.started_at_unix = time.time()
         self.finished = False
+        # monotone ring sequence number, assigned by TraceRing.push():
+        # the /debug/traces?since=<seq> cursor key (0 = never pushed)
+        self.seq = 0
 
     @property
     def meta(self) -> dict[str, Any]:
@@ -191,6 +194,7 @@ class JobTrace:
 
     def to_dict(self) -> dict[str, Any]:
         return {"started_at_unix": round(self.started_at_unix, 6),
+                "seq": self.seq,
                 "root": self.root.to_dict()}
 
     def to_chrome_events(self, pid: int = 1,
@@ -218,8 +222,19 @@ class JobTrace:
         return events
 
 
+def _span_count(node: Span) -> int:
+    return 1 + sum(_span_count(child) for child in node.children)
+
+
 class TraceRing:
-    """Bounded ring of recently finished traces (newest last)."""
+    """Bounded ring of recently finished traces (newest last).
+
+    Every pushed trace gets a monotone ``seq``; evictions are COUNTED
+    (``spans_evicted`` feeds ``chiaswarm_trace_spans_evicted_total``)
+    and the ``?since=<seq>`` cursor on ``/debug/traces`` lets a scraper
+    detect — rather than silently lose — traces the ring dropped
+    between scrapes: if ``cursor.oldest_seq > since + 1``, the gap is
+    exactly the evicted window."""
 
     def __init__(self, capacity: int | None = None) -> None:
         if capacity is None:
@@ -228,31 +243,57 @@ class TraceRing:
         self._lock = threading.Lock()
         self._traces: collections.deque[JobTrace] = collections.deque(
             maxlen=self.capacity)
+        self._seq = 0
+        self.traces_evicted = 0
+        self.spans_evicted = 0
 
     def push(self, trace: JobTrace) -> None:
         with self._lock:
+            self._seq += 1
+            trace.seq = self._seq
+            if len(self._traces) == self.capacity:
+                oldest = self._traces[0]  # deque maxlen drops it below
+                self.traces_evicted += 1
+                self.spans_evicted += _span_count(oldest.root)
             self._traces.append(trace)
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._traces)
 
-    def traces(self) -> list[JobTrace]:
+    def traces(self, since: int | None = None) -> list[JobTrace]:
+        """Ring contents, oldest first; ``since`` keeps only traces
+        pushed after that sequence number (the scrape cursor)."""
         with self._lock:
-            return list(self._traces)
+            out = list(self._traces)
+        if since is not None:
+            out = [t for t in out if t.seq > int(since)]
+        return out
+
+    def cursor(self) -> dict[str, Any]:
+        """Scraper bookkeeping: pass ``last_seq`` back as ``?since=``;
+        a later ``oldest_seq`` > since + 1 means the ring evicted
+        traces the scraper never saw (count in ``evicted_spans``)."""
+        with self._lock:
+            return {
+                "last_seq": self._seq,
+                "oldest_seq": self._traces[0].seq if self._traces else None,
+                "evicted_traces": self.traces_evicted,
+                "evicted_spans": self.spans_evicted,
+            }
 
     def clear(self) -> None:
         with self._lock:
             self._traces.clear()
 
-    def to_dicts(self) -> list[dict[str, Any]]:
-        return [t.to_dict() for t in self.traces()]
+    def to_dicts(self, since: int | None = None) -> list[dict[str, Any]]:
+        return [t.to_dict() for t in self.traces(since)]
 
-    def to_chrome(self) -> dict[str, Any]:
+    def to_chrome(self, since: int | None = None) -> dict[str, Any]:
         """One Perfetto-loadable document; each trace gets its own tid
         so jobs render as separate tracks."""
         events: list[dict[str, Any]] = []
-        for tid, trace in enumerate(self.traces(), start=1):
+        for tid, trace in enumerate(self.traces(since), start=1):
             events.extend(trace.to_chrome_events(tid=tid))
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 

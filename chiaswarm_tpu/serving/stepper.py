@@ -277,6 +277,13 @@ class _RowJob:                    # must never compare device/numpy fields
     future: Future = dataclasses.field(default_factory=Future)
     admitted_at_step: int = -1
     slots: list[int] = dataclasses.field(default_factory=list)
+    # splice-wait telemetry (swarmsight, ISSUE 13): submit vs admit on
+    # perf_counter, surfaced as ``splice_wait_s`` in the lane info so
+    # the flight record's budget attribution can separate "waited
+    # behind a full lane" from "was stepping"
+    submitted_t: float = dataclasses.field(
+        default_factory=time.perf_counter)
+    admitted_t: float = 0.0
     # redelivered-job resume (ISSUE 6): rows splice in at step
     # ``resume_step`` with restored latents/keys and the multistep
     # history ``old0`` instead of freshly drawn noise at step 0
@@ -858,6 +865,7 @@ class Lane:
                 self._rows[s] = job
             job.slots = slots
             job.admitted_at_step = self.steps_executed
+            job.admitted_t = time.perf_counter()
             # workload-labeled admission breadth (metric-local lock
             # only — safe under self._cond)
             _LANE_ADMISSIONS.inc(job.n_rows, workload=job.workload)
@@ -1192,6 +1200,11 @@ class Lane:
                 # the fleet-invariant proof point: >0 means this job was
                 # redelivered and resumed mid-trajectory, not restarted
                 "resume_step": job.resume_step,
+                # time the rows waited for a free slot before their
+                # first step (flight-record lane_wait attribution)
+                "splice_wait_s": round(
+                    max(0.0, job.admitted_t - job.submitted_t), 6)
+                if job.admitted_t else 0.0,
             }
             # per-image UNet-eval accounting (ISSUE 12): full evals this
             # row actually paid over its WHOLE trajectory (the skipped

@@ -217,6 +217,29 @@ def test_load_smoke_seeded_zero_loss():
         assert entry["suggested_s"] == pytest.approx(
             entry["p99_s"] * loadgen.DEADLINE_MARGIN, rel=1e-3)
         assert entry["n"] > 0
+        # the conformance satellite (ISSUE 13): each family names its
+        # dominant overshoot phase (None when nothing missed)
+        assert "dominant_overshoot_phase" in entry
+    # swarmsight (ISSUE 13): per-family deadline-budget attribution
+    # folded from the flight records — the synthetic service model
+    # books as "steps", so steps must dominate every family's share —
+    # plus the /api/fleet aggregate snapshot the autoscaler reads
+    from chiaswarm_tpu.obs.flight import ATTRIBUTION_PHASES
+
+    attribution = report["budget_attribution"]["families"]
+    assert attribution, report["budget_attribution"]
+    for family, entry in attribution.items():
+        assert set(entry["mean_s"]) == set(ATTRIBUTION_PHASES), family
+        assert entry["n"] > 0
+        assert entry["dominant_phase"] == "steps", entry
+        assert abs(sum(entry["share"].values()) - 1.0) < 0.02
+    fleet = report["fleet"]
+    assert fleet["aggregate"]["workers_reporting"] == 2
+    assert fleet["aggregate"]["chips_in_service"] == 2
+    # every settled job left a COMPLETE flight record (ISSUE 13
+    # satellite — the soak legs assert the same at scale)
+    hive_stats = report["hive"]
+    assert hive_stats["flights"]["records"] > 0
 
 
 def test_overload_gate_10x_mixed_kill():
@@ -458,10 +481,11 @@ def test_real_lane_load_soak_tiny_family(monkeypatch):
                 allow_random=True),
             pool=pool)
 
+    hive = LoadHive(lease_s=120.0, delay_s=0.0, max_attempts=4,
+                    max_jobs_per_poll=1)
     report = asyncio.run(run_load(
-        schedule, n_workers=2, worker_factory=factory,
-        seed=f"real:{seed}", lease_s=120.0, max_jobs_per_poll=1,
-        settle_timeout_s=900))
+        schedule, n_workers=2, worker_factory=factory, hive=hive,
+        seed=f"real:{seed}", settle_timeout_s=900))
     rec = report["reconciliation"]
     assert rec["zero_loss"], rec
     assert report["outcomes"]["ok"] == len(schedule), report["outcomes"]
@@ -469,6 +493,12 @@ def test_real_lane_load_soak_tiny_family(monkeypatch):
     # the suggested-deadline table now reflects MEASURED tiny-family
     # latencies — the live refinement of the shipped sweep defaults
     assert "tiny" in report["suggested_deadlines"]["families"]
+    # swarmsight (ISSUE 13 satellite): every settled REAL-lane soak job
+    # has a complete flight record, and the real-pipeline digests carry
+    # lane step spans the budget attribution books as steps
+    assert hive.flights.verify(list(hive.completed)) == []
+    attribution = report["budget_attribution"]["families"]
+    assert attribution["tiny"]["mean_s"]["steps"] > 0, attribution
 
 
 # ---------------------------------------------------------------------------
@@ -486,10 +516,11 @@ def test_load_soak_diurnal_fleet_kill():
     schedule = build_scenario(seed=f"load-soak:{seed}", n_users=2000,
                               duration_s=6.0,
                               rate_jobs_s=max(20, jobs_scale // 3))
+    hive = LoadHive(lease_s=4.0, delay_s=0.0, max_attempts=4,
+                    max_jobs_per_poll=4)
     report = asyncio.run(run_load(
-        schedule, n_workers=3, seed=f"load-soak:{seed}", lease_s=4.0,
-        max_jobs_per_poll=4, kill=KillPlan(after_frac=0.4),
-        settle_timeout_s=600))
+        schedule, n_workers=3, seed=f"load-soak:{seed}", hive=hive,
+        kill=KillPlan(after_frac=0.4), settle_timeout_s=600))
     assert report["reconciliation"]["zero_loss"], report["reconciliation"]
     assert report["admitted_deadline"]["p99_within_deadline"], \
         report["admitted_deadline"]
@@ -497,3 +528,7 @@ def test_load_soak_diurnal_fleet_kill():
     # every settled envelope is a classified outcome the taxonomy knows
     hive_stats = report["hive"]
     assert hive_stats["pending"] == 0 and not hive_stats["leased"]
+    # swarmsight (ISSUE 13 satellite): every SETTLED soak job left a
+    # complete flight record (no orphan spans, no attempt gaps);
+    # abandoned-by-policy jobs keep their unsettled records
+    assert hive.flights.verify(list(hive.completed)) == []

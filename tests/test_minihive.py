@@ -809,6 +809,24 @@ def test_fleet_worker_kill_mid_lane_resumes_from_checkpoint(monkeypatch):
     assert _counter(hive, "chiaswarm_hive_checkpoints_stored_total") >= 1
     assert _counter(hive, "chiaswarm_hive_jobs_redelivered_total") >= 1
 
+    # swarmsight (ISSUE 13): the SAME kill/resume run must leave ONE
+    # stitched flight record for the victim job spanning both workers —
+    # grant(1, victim) -> checkpoint markers -> redelivery ->
+    # grant(2, survivor) -> exactly-once settle, attempt chain gapless
+    # (tests/test_flight.py carries the full dedicated gate)
+    assert hive.flights.verify(["lane-0", "lane-1", "lane-2"]) == []
+    record = hive.flights.get(victim_job)
+    events = [e["event"] for e in record["events"]]
+    assert events.count("settled") == 1 and "checkpoint" in events
+    grants = [e for e in record["events"] if e["event"] == "grant"]
+    assert [g["attempt"] for g in grants][:2] == [1, 2]
+    assert grants[0]["worker"] == victim
+    assert record["settled"]["worker"] != victim
+    digests = {a["attempt"]: a["digest"]
+               for a in record["attempts"] if a["digest"]}
+    assert float(digests[record["settled"]["attempt"]]
+                 .get("resume_step") or 0) >= 1
+
 
 # ---------------------------------------------------------------------------
 # nightly fleet soak (satellite 5): seeded kills at scale
@@ -890,6 +908,9 @@ def test_fleet_soak_three_workers_kill_faults():
     if victim is not None:
         assert _counter(hive,
                         "chiaswarm_hive_jobs_redelivered_total") >= 0
+    # swarmsight (ISSUE 13 satellite): every settled soak job carries a
+    # COMPLETE flight record — no orphan span digests, no attempt gaps
+    assert hive.flights.verify(issued) == []
 
 
 @pytest.mark.slow
@@ -1019,3 +1040,9 @@ def test_fleet_soak_mixed_workload_lanes_kill_resume(monkeypatch):
                        + s.get("rows_admitted_inpaint", 0)
                        for s in survivor_stats)
     assert admitted_img >= 1, survivor_stats
+    # swarmsight (ISSUE 13 satellite): complete flight records for
+    # every settled soak job, incl. the killed-and-resumed one
+    assert hive.flights.verify([j["id"] for j in jobs]) == []
+    flight = hive.flights.get(victim_job)
+    assert flight["settled"]["worker"] != victim
+    assert [e["event"] for e in flight["events"]].count("settled") == 1

@@ -221,6 +221,17 @@ def test_trace_ring_eviction_keeps_newest():
     assert tree[0]["root"]["meta"]["id"] == "t2"
     assert "started_at_unix" in tree[0]
 
+    # eviction accounting + the ?since= cursor (ISSUE 13 satellite):
+    # 5 pushed into capacity 3 evicts 2 root-only traces (2 spans); the
+    # cursor exposes the gap a slow scraper must detect
+    assert ring.traces_evicted == 2 and ring.spans_evicted == 2
+    cursor = ring.cursor()
+    assert cursor["last_seq"] == 5 and cursor["oldest_seq"] == 3
+    assert cursor["evicted_spans"] == 2
+    assert [t.meta["id"] for t in ring.traces(since=3)] == ["t3", "t4"]
+    assert [t["seq"] for t in ring.to_dicts(since=3)] == [4, 5]
+    assert ring.to_chrome(since=5)["traceEvents"] == []
+
 
 def test_trace_rides_job_dicts_via_attach_detach():
     job = {"id": "x"}
@@ -346,6 +357,21 @@ def test_worker_serves_metrics_and_traces_endpoints():
                 async with session.get(
                         f"{base}/debug/traces?format=tree") as resp:
                     tree = await resp.json()
+                # ISSUE 13 satellite: the ?since= scrape cursor — a
+                # caught-up scraper gets zero traces back, a bad value
+                # is an explicit 400, and the cursor block carries the
+                # eviction counters gap detection needs
+                async with session.get(f"{base}/debug/traces"
+                                       f"?format=tree&since=0") as resp:
+                    tree_since = await resp.json()
+                last_seq = tree_since["cursor"]["last_seq"]
+                async with session.get(
+                        f"{base}/debug/traces?format=tree"
+                        f"&since={last_seq}") as resp:
+                    tree_tail = await resp.json()
+                async with session.get(
+                        f"{base}/debug/traces?since=abc") as resp:
+                    assert resp.status == 400
                 async with session.get(
                         f"{base}/debug/profile?seconds=abc") as resp:
                     assert resp.status == 400
@@ -366,10 +392,22 @@ def test_worker_serves_metrics_and_traces_endpoints():
             worker.request_stop()
             await asyncio.wait_for(task, timeout=20)
             await hive.stop()
-        return health, metrics_body, chrome, tree, numerics_payload, worker
+        return (health, metrics_body, chrome, tree, tree_since,
+                tree_tail, numerics_payload, worker)
 
-    health, body, chrome, tree, numerics_payload, worker = \
-        asyncio.run(scenario())
+    (health, body, chrome, tree, tree_since, tree_tail,
+     numerics_payload, worker) = asyncio.run(scenario())
+
+    # the scrape cursor (ISSUE 13): since=0 returns both traces with
+    # their ring seqs; since=last returns none; nothing evicted yet so
+    # the counter reads zero and the oldest seq is still 1
+    assert len(tree_since["traces"]) == 2
+    assert [t["seq"] for t in tree_since["traces"]] == [1, 2]
+    assert tree_since["cursor"]["last_seq"] == 2
+    assert tree_since["cursor"]["oldest_seq"] == 1
+    assert tree_since["cursor"]["evicted_spans"] == 0
+    assert tree_tail["traces"] == []
+    assert tree_tail["cursor"]["last_seq"] == 2
 
     # /debug/numerics: the payload distinguishes "empty because taps are
     # off" from "empty because nothing recorded" — CHIASWARM_NUMERICS is
@@ -498,6 +536,9 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert "# TYPE chiaswarm_compiles_total counter" in body
     assert 'chiaswarm_hive_requests_total{endpoint="results",result="ok"}' \
         in body
+    # ...the trace-ring eviction counter (ISSUE 13 satellite): present
+    # at zero from scrape one so a scraper can alert on span loss...
+    assert "chiaswarm_trace_spans_evicted_total 0" in body
     # ...phase latency histograms fed by the finished traces
     assert 'chiaswarm_job_phase_seconds_bucket{phase="upload",le="+Inf"}' \
         in body
@@ -507,6 +548,82 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert {"job", "poll", "execute", "upload"} <= names
     assert {t["root"]["name"] for t in tree["traces"]} == {"job"}
     assert len(worker.traces) == 2
+
+
+def test_fleet_endpoint_schema_from_heartbeat_scrape():
+    """ISSUE 13 satellite: a heartbeating worker's metric snapshot
+    lands in ``GET /api/fleet`` with the schema the item-5 autoscaler
+    reads — per-worker demand/supply/state plus the hive aggregate."""
+    import time as _time
+
+    import aiohttp
+
+    from chiaswarm_tpu.node.chaos import ChaoticExecutor
+    from chiaswarm_tpu.node.minihive import MiniHive
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.node.settings import Settings
+    from chiaswarm_tpu.node.worker import Worker
+
+    class StubSlot:
+        depth = 2
+        data_width = 1
+
+        def descriptor(self):
+            return "stub"
+
+    async def scenario():
+        hive = MiniHive(lease_s=30.0, delay_s=0.01)
+        uri = await hive.start()
+        hive.submit({"id": "fleet-1", "model_name": "m/ok",
+                     "prompt": "p", "workflow": "txt2img",
+                     "content_type": "application/json"})
+        worker = Worker(
+            settings=Settings(
+                hive_uri=uri, hive_token="t", worker_name="fleet-obs",
+                install_signal_handlers=False, heartbeat_s=0.05,
+                poll_busy_s=0.02, poll_idle_s=0.04,
+                drain_timeout_s=5.0, result_drain_timeout_s=5.0),
+            pool=[StubSlot()],
+            registry=ModelRegistry(catalog=[], allow_random=True),
+            executor=ChaoticExecutor())
+        task = asyncio.create_task(worker.run())
+        try:
+            await hive.wait_for_results(1, timeout=30)
+            deadline = _time.monotonic() + 30
+            while "fleet-obs" not in hive.fleet and \
+                    _time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            async with aiohttp.ClientSession() as session:
+                async with session.get(f"{hive.uri}/api/fleet") as resp:
+                    assert resp.status == 200
+                    snap = await resp.json()
+        finally:
+            worker.request_stop()
+            await asyncio.wait_for(task, timeout=20)
+            await hive.stop()
+        return snap
+
+    snap = asyncio.run(scenario())
+    assert set(snap) == {"at_s", "workers", "aggregate"}
+    entry = snap["workers"]["fleet-obs"]
+    for key in ("queue_depth", "inflight_jobs", "jobs_done", "jobs_shed",
+                "chips_in_service", "overload", "age_s", "live",
+                "partitioned", "leased_jobs"):
+        assert key in entry, key
+    assert entry["live"] is True and entry["partitioned"] is False
+    assert set(entry["overload"]) == {"state", "sheds_total",
+                                      "service_ewma_s"}
+    aggregate = snap["aggregate"]
+    for key in ("workers_reporting", "workers_live", "chips_in_service",
+                "arrival_rate_rows_s", "lane_occupancy_mean",
+                "queue_depth", "inflight_jobs", "jobs_done", "jobs_shed",
+                "workers_in_brownout", "observed_arrival_jobs_s",
+                "pending_jobs", "leased_jobs", "completed_jobs",
+                "abandoned_jobs"):
+        assert key in aggregate, key
+    assert aggregate["workers_reporting"] == 1
+    assert aggregate["completed_jobs"] == 1
+    json.dumps(snap)
 
 
 # ---------------------------------------------------------------------------

@@ -122,6 +122,7 @@ class ChaoticHive:
         self._app.router.add_get("/assets/mask.png", self._asset_mask)
         self._runner = None
         self.uri = ""
+        self.port = 0
 
     # ---- job injection ----
 
@@ -243,21 +244,36 @@ class ChaoticHive:
 
     # ---- lifecycle ----
 
-    async def start(self) -> str:
+    async def start(self, port: int = 0) -> str:
+        """Serve on ``port`` (0 = ephemeral). A RESTARTED hive
+        (swarmdurable, node/minihive.py::restart_hive) passes the dead
+        hive's port so riding-through workers — whose hive URI is fixed
+        at construction — heal on their next poll."""
         from aiohttp import web
 
         self._runner = web.AppRunner(self._app,
                                      access_log=None)  # quiet chaos noise
         await self._runner.setup()
-        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        site = web.TCPSite(self._runner, "127.0.0.1", max(0, int(port)))
         await site.start()
-        port = site._server.sockets[0].getsockname()[1]
-        self.uri = f"http://127.0.0.1:{port}"
+        self.port = site._server.sockets[0].getsockname()[1]
+        self.uri = f"http://127.0.0.1:{self.port}"
         return self.uri
 
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+
+    async def die(self) -> int:
+        """The SIGKILL chaos seam (swarmdurable): stop serving NOW.
+        Sockets close under in-flight requests (clients see resets, not
+        graceful errors) and nothing is flushed or said goodbye to —
+        whatever a journal already committed is all that survives.
+        Returns the port that just went dark."""
+        port = self.port
+        await self.stop()
+        self._runner = None
+        return port
 
     async def wait_for_results(self, n: int, timeout: float = 60.0) -> None:
         async def _wait():

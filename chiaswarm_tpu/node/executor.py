@@ -36,6 +36,7 @@ from chiaswarm_tpu.node.resilience import (
     classify_exception,
 )
 from chiaswarm_tpu.obs import trace as obs_trace
+from chiaswarm_tpu.node.hivelog import HIVE_EPOCH_KEY
 from chiaswarm_tpu.obs.flight import TRACE_CTX_KEY
 from chiaswarm_tpu.obs.profiling import job_profile
 from chiaswarm_tpu.obs.trace import span
@@ -125,10 +126,12 @@ def _format(job: dict[str, Any], registry: ModelRegistry):
     """-> (job_id, content_type, callback, kwargs) or a fatal result."""
     job = dict(job)
     job.pop(obs_trace.TRACE_KEY, None)  # never a pipeline kwarg
-    # the hive's trace context is normally popped at poll receipt
-    # (node/worker.py); strip it defensively for directly-injected jobs
-    # (tests, resubmissions) — like the trace itself, never a kwarg
+    # the hive's trace context and epoch stamp are normally popped at
+    # poll receipt (node/worker.py); strip them defensively for
+    # directly-injected jobs (tests, resubmissions) — like the trace
+    # itself, never a kwarg
     job.pop(TRACE_CTX_KEY, None)
+    job.pop(HIVE_EPOCH_KEY, None)
     job_id = job.pop("id", None)
     content_type = job.get("content_type", "image/jpeg")
     try:

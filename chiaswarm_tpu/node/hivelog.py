@@ -1,0 +1,497 @@
+"""swarmdurable: the hive's write-ahead log — crash-safe queue state.
+
+Every fault arc so far (PR-2 worker ladder, PR-6 fleet leases, PR-10
+gray failures) hardened the WORKER side; the hive — the job queue of
+record (swarm/worker.py:58-110 long-poll contract) — was still one
+in-memory process whose crash lost jobs, leases, checkpoints, and
+flight records. This module is the standard WAL + deterministic-replay
+recipe the checkpoint/recovery literature applies to training
+orchestrators (Orbax-style save/restore, Pathways-style resilient
+dispatch), sized for the mini-hive:
+
+- **Journal**: :class:`HiveJournal` is an append-only JSONL log under a
+  directory (operators: ``<root>/hive/``). Every
+  :class:`~chiaswarm_tpu.node.minihive.MiniHive` state transition —
+  submit, grant(attempt, worker), heartbeat checkpoint custody,
+  shed/redispatch/lease-expiry/salvage/abandon, exactly-once settle —
+  appends one record ``{"seq": n, "ev": ..., ...}``; the hive commits
+  the batch (write + flush + one fsync) BEFORE acking the request, so
+  an acked transition is durable by construction.
+- **Segments + compaction**: the log rotates into bounded segments
+  (``wal-<first seq>.jsonl``); :meth:`write_snapshot` captures the
+  hive's full state at a sequence point and prunes the segments it
+  covers, bounding recovery time. Replay(snapshot + tail) must equal
+  replay(full log) — the compaction-equivalence gate in
+  tests/test_durability.py.
+- **Repairing replay**: :meth:`replay` is how a killed hive comes back
+  (``MiniHive.recover``). A SIGKILL can tear the final record mid-write;
+  replay stops at the last COMPLETE entry and parks the torn tail as a
+  ``.bad`` file, counted — never parsed, never silently dropped (the
+  PR-6 CheckpointSpool convention). A corrupt or out-of-sequence record
+  mid-log parks everything from the corruption onward the same way:
+  recovery is the longest consistent prefix, deterministically.
+- **Epochs**: each journal attachment bumps a monotone ``hive_epoch``
+  (persisted in a tiny ``EPOCH.json`` sidecar so it survives even a
+  compacted log). The hive stamps the epoch into every granted payload
+  (:data:`HIVE_EPOCH_KEY`) and workers echo it on uploads, so a
+  recovered hive can tell a pre-crash grant's late upload (settled once,
+  counted as epoch salvage) from a live one, and a stale worker's
+  heartbeat is rejected by the epoch handshake.
+
+Knobs (env, all optional): ``CHIASWARM_HIVE_JOURNAL_SEGMENT_BYTES``
+(rotation threshold, default 4 MiB), ``CHIASWARM_HIVE_JOURNAL_FSYNC``
+(``0`` trades durability for speed in harness runs),
+``CHIASWARM_HIVE_JOURNAL_COMPACT_EVERY`` (auto-snapshot cadence in
+records, default 4096, ``0`` = manual only).
+
+Stdlib-only and synchronous, like the rest of the hive plane — the
+journal, recovery, and the durability tests all run without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger("chiaswarm.hivelog")
+
+#: wire field a journaled hive stamps into every granted payload and a
+#: worker echoes on its uploads (node/worker.py pops it at poll receipt,
+#: exactly like the swarmsight trace context). NEVER stamped without a
+#: journal, so the reference-hive wire shape stays byte-compatible.
+HIVE_EPOCH_KEY = "hive_epoch"
+
+ENV_SEGMENT_BYTES = "CHIASWARM_HIVE_JOURNAL_SEGMENT_BYTES"
+ENV_FSYNC = "CHIASWARM_HIVE_JOURNAL_FSYNC"
+ENV_COMPACT_EVERY = "CHIASWARM_HIVE_JOURNAL_COMPACT_EVERY"
+
+_SEGMENT_PREFIX = "wal-"
+_SEGMENT_SUFFIX = ".jsonl"
+_SNAPSHOT_PREFIX = "snapshot-"
+_SNAPSHOT_SUFFIX = ".json"
+_EPOCH_FILE = "EPOCH.json"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        raw = os.environ.get(name)
+        return int(raw) if raw not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "off", "false", "no")
+
+
+def _segment_name(first_seq: int) -> str:
+    return f"{_SEGMENT_PREFIX}{int(first_seq):012d}{_SEGMENT_SUFFIX}"
+
+
+def _snapshot_name(seq: int) -> str:
+    return f"{_SNAPSHOT_PREFIX}{int(seq):012d}{_SNAPSHOT_SUFFIX}"
+
+
+def _name_seq(path: Path, prefix: str, suffix: str) -> int | None:
+    name = path.name
+    if not (name.startswith(prefix) and name.endswith(suffix)):
+        return None
+    try:
+        return int(name[len(prefix):-len(suffix)])
+    except ValueError:
+        return None
+
+
+class HiveJournal:
+    """Append-only JSONL write-ahead log with batch commits, segment
+    rotation, compaction snapshots, and a repairing replay. One journal
+    owns one directory; concurrent writers are not supported (the hive
+    is one process — that being the failure mode this exists for).
+
+    ``append`` buffers; :meth:`commit` writes the batch, flushes, and
+    fsyncs once — the hive calls it at the end of each request handler,
+    so durability costs one fsync per *batch* of transitions, not one
+    per record.
+    """
+
+    def __init__(self, directory: Path | str, *,
+                 segment_bytes: int | None = None,
+                 fsync: bool | None = None,
+                 compact_every: int | None = None) -> None:
+        self.directory = Path(directory)
+        self.segment_bytes = max(4096, int(
+            segment_bytes if segment_bytes is not None
+            else _env_int(ENV_SEGMENT_BYTES, 4 * 1024 * 1024)))
+        self.fsync = (fsync if fsync is not None
+                      else _env_flag(ENV_FSYNC, True))
+        self.compact_every = max(0, int(
+            compact_every if compact_every is not None
+            else _env_int(ENV_COMPACT_EVERY, 4096)))
+        self._buffer: list[str] = []
+        self._fh = None
+        self._segment_path: Path | None = None
+        self._segment_size = 0
+        # counters (mirrored into the hive's metrics registry)
+        self.records_written = 0
+        self.records_since_snapshot = 0
+        self.fsyncs = 0
+        self.bytes_written = 0
+        self.tails_parked = 0
+        self.snapshots_written = 0
+        self.segments_pruned = 0
+        self._next_seq = self._scan_next_seq()
+
+    # ---- layout ---------------------------------------------------------
+
+    def _segments(self) -> list[Path]:
+        if not self.directory.is_dir():
+            return []
+        out = [p for p in self.directory.iterdir()
+               if _name_seq(p, _SEGMENT_PREFIX, _SEGMENT_SUFFIX) is not None]
+        return sorted(out)
+
+    def _snapshots(self) -> list[Path]:
+        if not self.directory.is_dir():
+            return []
+        out = [p for p in self.directory.iterdir()
+               if _name_seq(p, _SNAPSHOT_PREFIX, _SNAPSHOT_SUFFIX)
+               is not None]
+        return sorted(out)
+
+    def _scan_next_seq(self) -> int:
+        """Cheap startup scan: the next seq continues after the last
+        parseable record of the newest segment (a torn tail there is
+        repaired by :meth:`replay` before anything appends)."""
+        last = 0
+        for snap in self._snapshots():
+            last = max(last, _name_seq(snap, _SNAPSHOT_PREFIX,
+                                       _SNAPSHOT_SUFFIX) or 0)
+        segments = self._segments()
+        if segments:
+            tail = segments[-1]
+            first = _name_seq(tail, _SEGMENT_PREFIX, _SEGMENT_SUFFIX) or 1
+            last = max(last, first - 1)
+            try:
+                for line in tail.read_text(encoding="utf-8").splitlines():
+                    try:
+                        record = json.loads(line)
+                        last = max(last, int(record.get("seq") or 0))
+                    except (json.JSONDecodeError, TypeError, ValueError):
+                        break  # torn/corrupt tail: replay() repairs it
+            except OSError:
+                pass
+        return last + 1
+
+    @property
+    def last_seq(self) -> int:
+        return self._next_seq - 1
+
+    # ---- epoch sidecar --------------------------------------------------
+
+    def stored_epoch(self) -> int:
+        """Highest epoch ever attached to this journal (0 = fresh). The
+        sidecar survives compaction, so epochs stay monotone even when
+        the epoch records themselves were pruned into a snapshot."""
+        path = self.directory / _EPOCH_FILE
+        try:
+            payload = json.loads(path.read_text(encoding="utf-8"))
+            return max(0, int(payload.get("epoch") or 0))
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            return 0
+
+    def _store_epoch(self, epoch: int) -> None:
+        self.directory.mkdir(parents=True, exist_ok=True)
+        path = self.directory / _EPOCH_FILE
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({"version": 1, "epoch": int(epoch)}),
+                       encoding="utf-8")
+        tmp.replace(path)
+
+    def begin_epoch(self, epoch: int, *, t: float) -> None:
+        """Record one epoch attachment: sidecar first (monotone even if
+        the crash lands between the two writes), then the journal record
+        the replay stream carries."""
+        self._store_epoch(epoch)
+        self.append("epoch", epoch=int(epoch), t=t)
+        self.commit()
+
+    # ---- appending ------------------------------------------------------
+
+    def append(self, ev: str, **fields: Any) -> int:
+        """Buffer one record; returns its assigned seq. Nothing touches
+        disk until :meth:`commit` — callers batch per request."""
+        seq = self._next_seq
+        self._next_seq += 1
+        record = {"seq": seq, "ev": str(ev)}
+        record.update(fields)
+        self._buffer.append(json.dumps(record, sort_keys=True))
+        return seq
+
+    def _open_segment(self, first_seq: int) -> None:
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._segment_path = self.directory / _segment_name(first_seq)
+        self._fh = open(self._segment_path, "ab")
+        self._segment_size = self._fh.tell()
+
+    def rotate(self) -> None:
+        """Close the open segment; the next commit starts a fresh one
+        (recovery always rotates so appends never extend a repaired
+        file)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        self._segment_path = None
+        self._segment_size = 0
+
+    def commit(self) -> int:
+        """Write buffered records, flush, fsync once. Returns the
+        number of records made durable. The caller acks its request
+        only after this returns — write-ahead, then answer.
+
+        A failed write/fsync keeps the batch buffered (seqs are already
+        assigned; dropping it would leave a permanent sequence gap that
+        replay treats as corruption) and rolls the segment back to its
+        known-good prefix, so a retrying commit can never leave a torn
+        record followed by a duplicate."""
+        if not self._buffer:
+            return 0
+        if self._fh is None or self._segment_size >= self.segment_bytes:
+            self.rotate()
+            self._open_segment(self._next_seq - len(self._buffer))
+        payload = ("\n".join(self._buffer) + "\n").encode("utf-8")
+        n = len(self._buffer)
+        try:
+            self._fh.write(payload)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+                self.fsyncs += 1
+        except OSError:
+            try:
+                self._fh.truncate(self._segment_size)
+            except OSError:
+                # cannot even roll back: abandon this segment so the
+                # retry opens a fresh one (the torn tail is parked at
+                # the next recovery)
+                self.rotate()
+            raise
+        self._buffer.clear()
+        self._segment_size += len(payload)
+        self.bytes_written += len(payload)
+        self.records_written += n
+        self.records_since_snapshot += n
+        return n
+
+    def close(self) -> None:
+        self.commit()
+        self.rotate()
+
+    # ---- compaction -----------------------------------------------------
+
+    def write_snapshot(self, state: dict[str, Any], *, epoch: int,
+                       t: float, prune: bool = True) -> Path:
+        """Capture the hive's full state at the current sequence point
+        and prune every segment the snapshot covers. ``state`` must be
+        exactly what :meth:`replay` hands back for the hive to restore —
+        replay(snapshot + tail) ≡ replay(full log) is gated by test
+        (``prune=False`` keeps the covered segments so the gate can run
+        both paths over one journal)."""
+        self.commit()  # the snapshot covers everything appended so far
+        seq = self.last_seq
+        self.directory.mkdir(parents=True, exist_ok=True)
+        path = self.directory / _snapshot_name(seq)
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({"version": 1, "seq": seq,
+                                   "epoch": int(epoch), "t": float(t),
+                                   "state": state}, sort_keys=True),
+                       encoding="utf-8")
+        if self.fsync:
+            with open(tmp, "rb") as fh:
+                os.fsync(fh.fileno())
+        tmp.replace(path)
+        self.snapshots_written += 1
+        self.records_since_snapshot = 0
+        # prune: a segment is covered when every record in it has
+        # seq <= snapshot seq — i.e. the NEXT segment starts at or
+        # before seq + 1. Rotate first so the open segment is closed.
+        self.rotate()
+        if not prune:
+            log.info("hive journal snapshot at seq %d (%s; segments "
+                     "kept)", seq, path.name)
+            return path
+        segments = self._segments()
+        for i, segment in enumerate(segments):
+            nxt = (_name_seq(segments[i + 1], _SEGMENT_PREFIX,
+                             _SEGMENT_SUFFIX)
+                   if i + 1 < len(segments) else self._next_seq)
+            if nxt is not None and nxt <= seq + 1:
+                try:
+                    segment.unlink()
+                    self.segments_pruned += 1
+                except OSError as exc:
+                    log.warning("could not prune covered segment %s: %s",
+                                segment, exc)
+        # older snapshots are superseded
+        for snap in self._snapshots():
+            if snap.name != _snapshot_name(seq):
+                try:
+                    snap.unlink()
+                except OSError:
+                    pass
+        log.info("hive journal snapshot at seq %d (%s)", seq, path.name)
+        return path
+
+    def maybe_compact(self) -> bool:
+        """Auto-compaction trigger: True when the caller should snapshot
+        now (``compact_every`` records appended since the last one)."""
+        return (self.compact_every > 0
+                and self.records_since_snapshot >= self.compact_every)
+
+    # ---- replay ---------------------------------------------------------
+
+    def _park(self, path: Path, good_bytes: int, reason: str) -> None:
+        """Park everything past ``good_bytes`` of ``path`` as a sibling
+        ``.bad`` file and truncate the segment to its good prefix —
+        loud, counted, never reparsed (the CheckpointSpool convention)."""
+        try:
+            data = path.read_bytes()
+        except OSError as exc:
+            log.error("cannot read %s for repair (%s)", path, exc)
+            return
+        bad = data[good_bytes:]
+        if not bad:
+            return
+        bad_path = path.with_suffix(path.suffix
+                                    + f".{self.tails_parked}.bad")
+        try:
+            bad_path.write_bytes(bad)
+            if good_bytes:
+                with open(path, "r+b") as fh:
+                    fh.truncate(good_bytes)
+            else:
+                path.unlink()
+        except OSError as exc:
+            log.error("cannot park bad tail of %s (%s)", path, exc)
+            return
+        self.tails_parked += 1
+        log.error("hive journal: parked %d byte(s) of %s as %s (%s)",
+                  len(bad), path.name, bad_path.name, reason)
+
+    def _load_snapshot(self) -> dict[str, Any] | None:
+        for snap in reversed(self._snapshots()):
+            try:
+                payload = json.loads(snap.read_text(encoding="utf-8"))
+                if isinstance(payload, dict) and \
+                        isinstance(payload.get("state"), dict):
+                    return payload
+            except (OSError, json.JSONDecodeError) as exc:
+                log.error("unreadable snapshot %s (%s); parking as .bad",
+                          snap, exc)
+                try:
+                    snap.replace(snap.with_suffix(snap.suffix + ".bad"))
+                except OSError:
+                    pass
+                self.tails_parked += 1
+        return None
+
+    def replay(self, *, repair: bool = True
+               ) -> tuple[dict[str, Any] | None, list[dict[str, Any]]]:
+        """Read the journal back: ``(snapshot, tail_records)``.
+
+        ``snapshot`` is the newest readable snapshot payload (or None);
+        ``tail_records`` are every complete record after it, in seq
+        order, stopping at the first torn / unparseable / out-of-
+        sequence record. With ``repair`` (the recovery path) the bad
+        remainder — the rest of that segment AND every later segment —
+        is parked ``.bad`` so future appends and replays see only the
+        consistent prefix; ``repair=False`` is the read-only inspection
+        view."""
+        snapshot = self._load_snapshot()
+        after_seq = int(snapshot["seq"]) if snapshot else 0
+        records: list[dict[str, Any]] = []
+        # the snapshot pins the ladder at its seq; a fresh log pins it
+        # at the first record seen (normally 1)
+        expected = after_seq + 1 if snapshot else None
+        broken = False
+        for segment in self._segments():
+            if broken:
+                if repair:
+                    self._park(segment, 0, "after a corrupt record")
+                continue
+            try:
+                data = segment.read_bytes()
+            except OSError as exc:
+                log.error("unreadable segment %s (%s)", segment, exc)
+                broken = True
+                continue
+            offset = 0
+            for raw in data.split(b"\n"):
+                if not raw:
+                    offset += 1  # the newline the empty split consumed
+                    continue
+                # a complete record is terminated by its newline; the
+                # final chunk of a torn write has none
+                torn = data[offset + len(raw):
+                            offset + len(raw) + 1] != b"\n"
+                try:
+                    record = json.loads(raw.decode("utf-8"))
+                    seq = int(record["seq"])
+                except (json.JSONDecodeError, UnicodeDecodeError,
+                        KeyError, TypeError, ValueError):
+                    record, seq = None, None
+                ok = record is not None and not torn
+                if ok and seq <= after_seq:
+                    offset += len(raw) + 1  # pre-snapshot: covered
+                    continue
+                if ok and expected is None:
+                    expected = seq
+                if not ok or seq != expected:
+                    reason = ("torn final record" if torn
+                              else "corrupt record" if record is None
+                              else f"sequence gap (want {expected}, "
+                                   f"got {seq})")
+                    if repair:
+                        self._park(segment, offset, reason)
+                    broken = True
+                    break
+                offset += len(raw) + 1
+                records.append(record)
+                expected += 1
+        if repair:
+            # crash semantics: appends never committed died with the
+            # process; and after parking, the journal continues at
+            # exactly last-good + 1 (a parked gap must not leave a
+            # permanent hole every future replay would stop at)
+            self._buffer.clear()
+            if records:
+                self._next_seq = int(records[-1]["seq"]) + 1
+            else:
+                self._next_seq = after_seq + 1
+            self.rotate()  # recovery never extends a repaired segment
+        elif records:
+            self._next_seq = max(self._next_seq,
+                                 int(records[-1]["seq"]) + 1)
+        return (snapshot, records)
+
+    # ---- observability --------------------------------------------------
+
+    def snapshot_counters(self) -> dict[str, int]:
+        return {
+            "records_written": self.records_written,
+            "bytes_written": self.bytes_written,
+            "fsyncs": self.fsyncs,
+            "tails_parked": self.tails_parked,
+            "snapshots_written": self.snapshots_written,
+            "segments_pruned": self.segments_pruned,
+            "segments": len(self._segments()),
+            "last_seq": self.last_seq,
+        }
+
+
+__all__ = ["HIVE_EPOCH_KEY", "HiveJournal"]

@@ -542,6 +542,22 @@ class KillPlan:
     after_frac: float = 0.5
 
 
+@dataclasses.dataclass(frozen=True)
+class RosterPlan:
+    """Scripted fleet churn (ROADMAP item 5 residue, ISSUE 14
+    satellite): workers JOIN and LEAVE mid-run, exercising the capacity
+    model and ``GET /api/fleet`` under elastic rosters rather than only
+    kills. Each entry is a fraction of the schedule: at ``join_at``
+    fractions a NEW worker (from the same factory) starts polling; at
+    ``leave_at`` fractions one running worker drains GRACEFULLY
+    (request_stop — in-flight jobs complete and upload; nothing
+    redelivers) and leaves. Distinct from :class:`KillPlan` on purpose:
+    an autoscaler's scale-down is a drain, not a preemption."""
+
+    join_at: tuple[float, ...] = ()
+    leave_at: tuple[float, ...] = ()
+
+
 async def run_load(schedule: Sequence[ScheduledJob], *,
                    n_workers: int = 3,
                    worker_factory: Callable[[str, str], Any] | None = None,
@@ -550,6 +566,7 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
                    max_jobs_per_poll: int = 2,
                    max_attempts: int = 4,
                    kill: KillPlan | None = None,
+                   roster: "RosterPlan | None" = None,
                    time_scale: float = 1.0,
                    settle_timeout_s: float = 300.0,
                    seed: Any = "swarmload") -> dict[str, Any]:
@@ -573,6 +590,18 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
                                                      kill.after_frac)))
                if kill is not None else None)
     killed: dict[str, Any] = {}
+    # fleet churn (ISSUE 14 satellite): scripted joins/leaves become
+    # per-index thresholds like the kill plan; events are recorded for
+    # the report so a soak can assert the churn actually happened
+    def _fracs_to_indices(fracs) -> list[int]:
+        return sorted(math.ceil(len(ordered) * max(0.0, min(1.0, f)))
+                      for f in (fracs or ()))
+
+    joins_due = _fracs_to_indices(roster.join_at if roster else ())
+    leaves_due = _fracs_to_indices(roster.leave_at if roster else ())
+    roster_events: list[dict[str, Any]] = []
+    joined_n = 0
+    departed: set[str] = set()
     t_start = time.perf_counter()
 
     # contention probe (ISSUE 12 deflake): the harness runs on real
@@ -618,6 +647,49 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
                             len(leased))
                 return
 
+    async def apply_roster(done: int) -> None:
+        nonlocal joined_n
+        while joins_due and done >= joins_due[0]:
+            joins_due.pop(0)
+            joined_n += 1
+            name = f"load-{seed}-join{joined_n}"
+            worker = factory(uri, name)
+            workers.append(worker)
+            tasks[name] = asyncio.create_task(worker.run())
+            roster_events.append({"at_job": done, "action": "join",
+                                  "worker": name})
+            log.info("roster: %s joined after %d submissions", name,
+                     done)
+        while leaves_due and done >= leaves_due[0]:
+            # first worker still serving (never killed, never left)
+            candidate = next(
+                (w for w in workers
+                 if w.settings.worker_name not in departed
+                 and w.settings.worker_name != killed.get("worker")),
+                None)
+            if candidate is None:
+                leaves_due.clear()
+                break
+            leaves_due.pop(0)
+            name = candidate.settings.worker_name
+            departed.add(name)
+            candidate.request_stop()  # graceful: drains, uploads, exits
+            # shield: a slow drain must NOT be cancelled into a covert
+            # kill (that would redeliver its jobs and contradict the
+            # clean "leave" this records) — on timeout the worker keeps
+            # draining and the final cleanup reaps it; the event says so
+            drained = True
+            try:
+                await asyncio.wait_for(asyncio.shield(tasks[name]),
+                                       timeout=60)
+            except Exception:
+                drained = tasks[name].done()
+            roster_events.append({"at_job": done, "action": "leave",
+                                  "worker": name, "drained": drained})
+            log.info("roster: %s %s after %d submissions", name,
+                     "drained and left" if drained
+                     else "leaving (drain still in progress)", done)
+
     try:
         for i, item in enumerate(ordered):
             target = t_start + item.at_s * max(1e-3, float(time_scale))
@@ -627,8 +699,10 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
             hive.submit_job(dict(item.job))
             if kill_at is not None and not killed and i + 1 >= kill_at:
                 await maybe_kill()
+            await apply_roster(i + 1)
         if kill_at is not None and not killed:
             await maybe_kill()
+        await apply_roster(len(ordered))
 
         deadline = time.monotonic() + float(settle_timeout_s)
         while time.monotonic() < deadline:
@@ -653,6 +727,10 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     report = score_run(hive, issued, workers, ordered,
                        duration_s=duration_s)
     report["kill"] = killed or None
+    # fleet-churn record (ISSUE 14 satellite): the roster satellite's
+    # proof that /api/fleet (score_run's "fleet" stamp) and the
+    # capacity model saw an ELASTIC fleet, not a static one
+    report["roster"] = roster_events or None
     # measured host-contention factor (>= 1.0; ~1.0 idle). The gate's
     # contention-adjusted deadline clause scales its bound by this, so
     # a contended host loosens the bound by exactly the measured sleep

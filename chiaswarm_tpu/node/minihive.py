@@ -30,10 +30,26 @@ lease-and-redeliver recipe of large-scale serving systems:
   reference-parity taxonomy tension ROADMAP carried since PR 2 — a
   node-local model-unavailable is a routing problem, not a fatal error.
 
+- **Durability** (swarmdurable, ISSUE 14): with a
+  :class:`~chiaswarm_tpu.node.hivelog.HiveJournal` attached, every
+  state transition above is journaled (write-ahead, fsync'd batch per
+  request) and a killed hive rebuilds its queue, lease books,
+  checkpoints, and flight records by deterministic replay
+  (:meth:`MiniHive.recover`). Each attachment bumps a monotone
+  ``hive_epoch`` stamped into every granted payload and echoed on
+  uploads: a recovered hive accepts a pre-crash grant's late upload
+  exactly once (counted as epoch salvage), dedupes against the
+  journaled settle set, and rejects a stale worker's heartbeat via the
+  epoch handshake. Without a journal nothing is stamped — the wire
+  shape stays byte-compatible with the reference contract (gated by
+  test).
+
 Chaos composition: all of :class:`ChaoticHive`'s scripted poll/result
 faults still apply, plus :meth:`partition`/:meth:`heal` cut one worker
 off from every endpoint (its requests see connection resets) — the
-deterministic stand-in for a network partition outliving the lease.
+deterministic stand-in for a network partition outliving the lease —
+and :func:`kill_hive`/:func:`restart_hive` SIGKILL the hive itself
+mid-flight and bring it back from its journal on the same port.
 
 Like the chaos harness, this is product code: operators smoke a
 multi-worker build against one MiniHive in one process
@@ -48,6 +64,7 @@ import time
 from typing import Any, Callable, Iterable
 
 from chiaswarm_tpu.node.chaos import ChaoticHive
+from chiaswarm_tpu.node.hivelog import HIVE_EPOCH_KEY, HiveJournal
 from chiaswarm_tpu.node.resilience import REDISPATCH_KINDS, classify_result
 from chiaswarm_tpu.obs import flight as obs_flight
 from chiaswarm_tpu.obs.metrics import Registry
@@ -84,6 +101,7 @@ class MiniHive(ChaoticHive):
                  max_attempts: int = 4,
                  max_jobs_per_poll: int = 0,
                  redispatch_kinds: frozenset[str] = REDISPATCH_KINDS,
+                 journal: HiveJournal | None = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         super().__init__(poll_faults, result_faults, delay_s)
         self.lease_s = float(lease_s)
@@ -160,16 +178,93 @@ class MiniHive(ChaoticHive):
             "chiaswarm_hive_jobs_salvaged_total",
             "abandoned jobs settled late by a straggler upload "
             "(chip time recovered; the job leaves the abandoned list)")
+        # swarmdurable (ISSUE 14): journal / recovery / epoch families
+        self._recoveries = m.counter(
+            "chiaswarm_hive_recoveries_total",
+            "times this hive state was rebuilt by journal replay")
+        self._epoch_salvaged = m.counter(
+            "chiaswarm_hive_epoch_salvage_total",
+            "pre-epoch uploads (granted before a hive restart) settled "
+            "exactly once after recovery — billing parity across crashes")
+        self._stale_epoch_beats = m.counter(
+            "chiaswarm_hive_stale_epoch_heartbeats_total",
+            "heartbeats rejected by the epoch handshake (sender still "
+            "on a pre-restart epoch)")
+        self._epoch_gauge = m.gauge(
+            "chiaswarm_hive_epoch",
+            "current hive epoch (0 = journaling off; bumps on every "
+            "journal attach / recovery)")
+        self._journal_records = m.counter(
+            "chiaswarm_hive_journal_records_total",
+            "state transitions made durable in the write-ahead log")
+        self._journal_fsyncs = m.counter(
+            "chiaswarm_hive_journal_fsyncs_total",
+            "batched journal commits fsync'd to disk")
+        self._journal_parked = m.counter(
+            "chiaswarm_hive_journal_parked_total",
+            "torn/corrupt journal tails parked as .bad at recovery")
+        self._journal_snapshots = m.counter(
+            "chiaswarm_hive_journal_snapshots_total",
+            "compaction snapshots written (segments pruned behind them)")
+        # journal OFF (the default) stamps nothing: wire parity with the
+        # reference contract. recover() attaches with the replayed epoch
+        # instead of coming through here.
+        self.journal: HiveJournal | None = None
+        self.hive_epoch = 0
+        if journal is not None:
+            self.journal = journal
+            if journal.last_seq > 0:
+                # attaching to a journal with prior life (e.g. a torn
+                # tail from a crash): run the repairing replay FIRST so
+                # this epoch never appends after bytes a future
+                # recovery would park — hivelog's documented invariant
+                journal.replay()
+            self.hive_epoch = journal.stored_epoch() + 1
+            journal.begin_epoch(self.hive_epoch, t=self._clock())
+            self._epoch_gauge.set(self.hive_epoch)
 
     def submit(self, job: dict[str, Any]) -> None:
         job_id = str(job.get("id"))
         now = self._clock()
         self.submitted_at.setdefault(job_id, now)
         # flight record opens at submit (idempotent for resubmitted
-        # ids); the observed-arrival EWMA feeds /api/fleet
-        self.flights.open(job_id, job, t=now)
+        # ids); the observed-arrival EWMA feeds /api/fleet. With a
+        # journal, the trace id rides the submit record so a recovered
+        # hive reopens the SAME trace.
+        trace_id = self.flights.trace_id_of(job_id)
+        if trace_id is None and self.journal is not None:
+            trace_id = obs_flight.new_trace_id()
+        self.flights.open(job_id, job, t=now, trace_id=trace_id)
         self._submit_rate.note(now)
+        self._journal("submit", id=job_id, t=now, job=job,
+                      trace_id=trace_id)
         super().submit(job)
+        self._journal_commit()
+
+    # ---- the write-ahead log (swarmdurable, ISSUE 14) -------------------
+
+    def _journal(self, ev: str, **fields: Any) -> None:
+        if self.journal is not None:
+            self.journal.append(ev, **fields)
+
+    def _journal_commit(self) -> None:
+        """Make the current batch durable (one fsync); the caller acks
+        its request only after this returns. Auto-compacts once the
+        tail outgrows ``CHIASWARM_HIVE_JOURNAL_COMPACT_EVERY``."""
+        if self.journal is None:
+            return
+        self.journal.commit()
+        if self.journal.maybe_compact():
+            self.compact()
+
+    def compact(self):
+        """Write a compaction snapshot of the full hive state and prune
+        the journal segments it covers. replay(snapshot + tail) must
+        equal replay(full log) — gated by tests/test_durability.py."""
+        if self.journal is None:
+            return None
+        return self.journal.write_snapshot(
+            self.dump_state(), epoch=self.hive_epoch, t=self._clock())
 
     # ---- chaos controls -------------------------------------------------
 
@@ -201,6 +296,9 @@ class MiniHive(ChaoticHive):
             self.flights.note(job_id, "lease_expired", t=now,
                               worker=lease["worker"],
                               attempt=lease["attempt"])
+            self._journal("lease_expired", id=job_id, t=now,
+                          worker=lease["worker"],
+                          attempt=lease["attempt"])
             if self.attempts.get(job_id, 0) >= self.max_attempts:
                 log.error("job %s abandoned after %d deliveries",
                           job_id, self.attempts.get(job_id, 0))
@@ -208,6 +306,8 @@ class MiniHive(ChaoticHive):
                 self._abandoned.inc()
                 self.flights.note(job_id, "abandoned", t=now,
                                   attempts=self.attempts.get(job_id, 0))
+                self._journal("abandoned", id=job_id, t=now,
+                              attempts=self.attempts.get(job_id, 0))
                 # GC like the settle path does: an abandoned job's
                 # latent-sized checkpoint blob is never resumed again
                 self.checkpoints.pop(job_id, None)
@@ -218,7 +318,9 @@ class MiniHive(ChaoticHive):
             self.pending_jobs.append(lease["job"])
             self._redelivered.inc()
             self.flights.note(job_id, "redelivered", t=now)
+            self._journal("redelivered", id=job_id, t=now)
             redelivered.append(job_id)
+        self._journal_commit()  # no-op when nothing expired
         return redelivered
 
     def expire_worker(self, worker_name: str) -> list[str]:
@@ -320,11 +422,23 @@ class MiniHive(ChaoticHive):
                     resume_step = int(checkpoint.get("step") or 0) or None
                 except (TypeError, ValueError):
                     resume_step = None
+            # swarmdurable (ISSUE 14): a journaled hive stamps its epoch
+            # into the payload (the worker echoes it on upload) and
+            # makes the grant durable BEFORE the payload leaves;
+            # without a journal neither key exists — wire parity.
+            epoch = self.hive_epoch if self.journal is not None else None
+            if epoch is not None:
+                payload[HIVE_EPOCH_KEY] = epoch
             payload[obs_flight.TRACE_CTX_KEY] = self.flights.grant(
                 job_id, attempt=attempt, worker=worker_name,
                 t=self._clock(), queued_s=payload.get("queued_s"),
-                resume_step=resume_step)
+                resume_step=resume_step, epoch=epoch)
+            self._journal("grant", id=job_id, t=self._clock(),
+                          attempt=attempt, worker=worker_name,
+                          queued_s=payload.get("queued_s"),
+                          resume_step=resume_step, epoch=epoch)
             out.append(payload)
+        self._journal_commit()
         return out
 
     # ---- settling (ChaoticHive seam) ------------------------------------
@@ -333,6 +447,16 @@ class MiniHive(ChaoticHive):
                        worker_name: str) -> dict[str, Any]:
         self.sweep()
         job_id = str(result.get("id"))
+        # swarmdurable (ISSUE 14): the worker echoes the grant's epoch
+        # stamp; popped like the digest so stored results keep their
+        # historical shape. A pre-epoch stamp on a settling upload is
+        # the crash-straddling case — counted as epoch salvage below.
+        upload_epoch = result.pop(HIVE_EPOCH_KEY, None)
+        try:
+            upload_epoch = (None if upload_epoch is None
+                            else int(upload_epoch))
+        except (TypeError, ValueError):
+            upload_epoch = None
         # swarmsight (ISSUE 13): the worker's span digest is popped OFF
         # the envelope into the flight record — every upload's, even a
         # duplicate's or a refusal's (they are attempts in the story) —
@@ -340,13 +464,20 @@ class MiniHive(ChaoticHive):
         digest = result.pop(obs_flight.SPAN_DIGEST_KEY, None)
         if digest is not None:
             self.flights.add_digest(job_id, digest)
+            self._journal("digest", id=job_id, t=self._clock(),
+                          digest=digest)
         if job_id in self.completed:
             # the redelivery race settled already: ack idempotently so
-            # the uploader stops retrying, but never double-count
+            # the uploader stops retrying, but never double-count —
+            # journal-backed across epochs: a recovered hive's replayed
+            # settle set dedupes pre-crash grants' retried uploads too
             self.duplicate_results.append(result)
             self._duplicates.inc()
             self.flights.note(job_id, "duplicate_upload",
                               t=self._clock(), worker=worker_name)
+            self._journal("duplicate", id=job_id, t=self._clock(),
+                          worker=worker_name)
+            self._journal_commit()
             log.info("duplicate result for %s from %s acked (job already "
                      "settled)", job_id, worker_name or "unknown")
             return {"status": "duplicate"}
@@ -379,6 +510,10 @@ class MiniHive(ChaoticHive):
             self._redispatched.inc(kind=kind)
             self.flights.note(job_id, "redispatched", t=self._clock(),
                               kind=kind, worker=refuser or None)
+            self._journal("redispatched", id=job_id, t=self._clock(),
+                          kind=kind, worker=refuser or None,
+                          requeued=bool(held_by_refuser))
+            self._journal_commit()
             log.warning("job %s refused by %s (%s); redispatching with "
                         "the refuser excluded", job_id,
                         refuser or "unknown", kind)
@@ -398,6 +533,8 @@ class MiniHive(ChaoticHive):
             self._salvaged.inc()
             self.flights.note(job_id, "salvaged", t=self._clock(),
                               worker=worker_name)
+            self._journal("salvaged", id=job_id, t=self._clock(),
+                          worker=worker_name)
             log.warning("job %s salvaged by a straggler upload after "
                         "abandonment", job_id)
         self.completed[job_id] = result
@@ -408,6 +545,20 @@ class MiniHive(ChaoticHive):
         self.pending_jobs = [j for j in self.pending_jobs
                              if str(j.get("id")) != job_id]
         self._completed.inc()
+        # epoch salvage (ISSUE 14): a settling upload for a grant from a
+        # PREVIOUS epoch — work that straddled the hive crash lands
+        # exactly once, never double-counted (billing parity)
+        from_epoch = None
+        if upload_epoch is not None and self.journal is not None \
+                and upload_epoch < self.hive_epoch:
+            from_epoch = upload_epoch
+            self._epoch_salvaged.inc()
+            self.flights.note(job_id, "epoch_salvage", t=self._clock(),
+                              from_epoch=from_epoch,
+                              epoch=self.hive_epoch)
+            log.warning("job %s settled by a pre-epoch upload (granted "
+                        "in epoch %d, settled in epoch %d)", job_id,
+                        from_epoch, self.hive_epoch)
         # the exactly-once settle closes the flight record and computes
         # its deadline-budget attribution (obs/flight.py)
         settle_attempt = None
@@ -422,12 +573,21 @@ class MiniHive(ChaoticHive):
                 settle_attempt = int(digest.get("attempt"))
             except (TypeError, ValueError):
                 settle_attempt = None
+        settle_worker = worker_name or str(result.get("worker_name") or "")
+        resolved_attempt = (settle_attempt if settle_attempt is not None
+                            else self.attempts.get(job_id))
         self.flights.settle(
-            job_id, t=self._clock(),
-            worker=worker_name or str(result.get("worker_name") or ""),
-            outcome=kind or "ok",
-            attempt=settle_attempt
-            if settle_attempt is not None else self.attempts.get(job_id))
+            job_id, t=self._clock(), worker=settle_worker,
+            outcome=kind or "ok", attempt=resolved_attempt,
+            epoch=self.hive_epoch if self.journal is not None else None)
+        # write-ahead: the settle is durable before the ack leaves, so a
+        # crash between counting and answering can never double-settle
+        self._journal("settled", id=job_id, t=self._clock(),
+                      worker=settle_worker, outcome=kind or "ok",
+                      attempt=resolved_attempt,
+                      epoch=self.hive_epoch if self.journal is not None
+                      else None, from_epoch=from_epoch)
+        self._journal_commit()
         return {"status": "ok"}
 
     # ---- heartbeats ------------------------------------------------------
@@ -455,6 +615,33 @@ class MiniHive(ChaoticHive):
         if isinstance(metrics, dict):
             self.fleet[worker_name] = {"at": self._clock(),
                                        "metrics": metrics}
+        # epoch handshake (swarmdurable, ISSUE 14): a beat claiming a
+        # PRE-restart epoch is stale — its lease claims and checkpoint
+        # pushes describe a hive that no longer exists. Reject the
+        # whole beat (no extension, no custody), report every claimed
+        # job lost, and hand back the current epoch so the worker
+        # re-registers; its next beat (new epoch) is served normally.
+        if self.journal is not None:
+            claimed = payload.get("hive_epoch")
+            try:
+                claimed = None if claimed is None else int(claimed)
+            except (TypeError, ValueError):
+                claimed = None
+            if claimed is not None and claimed != self.hive_epoch:
+                self._stale_epoch_beats.inc()
+                stale_jobs = payload.get("jobs") or []
+                for entry in stale_jobs:
+                    if entry.get("checkpoint") is not None:
+                        self._ckpt_stale.inc()
+                log.warning("stale-epoch heartbeat from %s (claimed %s, "
+                            "current %d); rejecting its lease claims",
+                            worker_name, claimed, self.hive_epoch)
+                return web.json_response({
+                    "status": "stale_epoch",
+                    "hive_epoch": self.hive_epoch,
+                    "lost": [str(entry.get("id"))
+                             for entry in stale_jobs],
+                })
         expiry = self._clock() + self.lease_s
         lost: list[str] = []
         for entry in payload.get("jobs") or []:
@@ -485,13 +672,329 @@ class MiniHive(ChaoticHive):
                 self._ckpt_stored.inc()
                 # checkpoint marker on the flight timeline: the worker
                 # only re-pushes on change, so this is progress, not
-                # heartbeat noise
+                # heartbeat noise. Custody is journaled — a recovered
+                # hive redelivers WITH this resume state, which is the
+                # whole point of pushing it here.
                 step = None
                 if isinstance(checkpoint, dict):
                     step = checkpoint.get("step")
                 self.flights.note(job_id, "checkpoint", t=self._clock(),
                                   worker=worker_name, step=step)
-        return web.json_response({"status": "ok", "lost": lost})
+                self._journal("checkpoint", id=job_id, t=self._clock(),
+                              worker=worker_name, checkpoint=checkpoint)
+        self._journal_commit()
+        ack: dict[str, Any] = {"status": "ok", "lost": lost}
+        if self.journal is not None:
+            ack["hive_epoch"] = self.hive_epoch
+        return web.json_response(ack)
+
+    # ---- crash-safe recovery (swarmdurable, ISSUE 14) -------------------
+
+    #: counters that represent journaled state transitions — dumped into
+    #: compaction snapshots and rebuilt identically by tail replay, so
+    #: /api/stats reconciles across restarts. Liveness chatter
+    #: (heartbeats, stale rejections) is deliberately NOT here: it is
+    #: per-process, not state.
+    _DURABLE_COUNTERS = (
+        ("leases_granted", "_leases_granted"),
+        ("leases_expired", "_leases_expired"),
+        ("redelivered", "_redelivered"),
+        ("completed", "_completed"),
+        ("duplicates", "_duplicates"),
+        ("abandoned", "_abandoned"),
+        ("salvaged", "_salvaged"),
+        ("ckpt_stored", "_ckpt_stored"),
+        ("epoch_salvaged", "_epoch_salvaged"),
+    )
+
+    @staticmethod
+    def _settle_marker(job_id: str, result: dict[str, Any]
+                       ) -> dict[str, Any]:
+        """Compact dedupe marker for a settled job — what snapshots and
+        replay rebuild ``completed`` entries as (full artifact payloads
+        never enter the journal; the settle SET is the durable truth)."""
+        if result.get("recovered"):
+            return dict(result)
+        return {"id": job_id,
+                "worker_name": str(result.get("worker_name") or ""),
+                "outcome": result_error_kind(result) or "ok",
+                "recovered": True}
+
+    def _counter_dump(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            name: getattr(self, attr).value()
+            for name, attr in self._DURABLE_COUNTERS
+        }
+        out["redispatched"] = {
+            key[0]: value
+            for key, value in self._redispatched.series().items()
+        }
+        return out
+
+    def _counter_restore(self, dump: dict[str, Any]) -> None:
+        for name, attr in self._DURABLE_COUNTERS:
+            try:
+                getattr(self, attr).inc(max(0.0, float(
+                    dump.get(name) or 0.0)))
+            except (TypeError, ValueError):
+                continue
+        for kind, value in (dump.get("redispatched") or {}).items():
+            try:
+                self._redispatched.inc(max(0.0, float(value)), kind=kind)
+            except (TypeError, ValueError):
+                continue
+
+    def dump_state(self) -> dict[str, Any]:
+        """JSON-safe full-state capture for a compaction snapshot.
+        Settled results dump as dedupe markers, never artifacts —
+        replay(snapshot + tail) must equal replay(full log), which the
+        marker normalization here guarantees (both paths rebuild the
+        same marker shape)."""
+        return {
+            "version": 1,
+            "pending": [dict(job) for job in self.pending_jobs],
+            "issued": list(self.issued_ids),
+            "leases": {
+                job_id: {"worker": lease["worker"],
+                         "attempt": lease["attempt"],
+                         "job": dict(lease["job"])}
+                for job_id, lease in self.leases.items()
+            },
+            "attempts": dict(self.attempts),
+            "excluded": {job_id: sorted(workers)
+                         for job_id, workers in self.excluded.items()},
+            "checkpoints": dict(self.checkpoints),
+            "completed": {job_id: self._settle_marker(job_id, result)
+                          for job_id, result in self.completed.items()},
+            "abandoned": list(self.abandoned),
+            "submitted_at": dict(self.submitted_at),
+            "duplicates": [
+                {"id": str(r.get("id")),
+                 "worker_name": str(r.get("worker_name") or ""),
+                 "recovered": True}
+                for r in self.duplicate_results
+            ],
+            "known_workers": sorted(self.known_workers),
+            "counters": self._counter_dump(),
+            "flights": self.flights.dump(),
+        }
+
+    def _restore_state(self, state: dict[str, Any],
+                       jobs: dict[str, dict[str, Any]]) -> None:
+        self.pending_jobs = [dict(job)
+                             for job in state.get("pending") or ()]
+        self.issued_ids = [str(j) for j in state.get("issued") or ()]
+        for job in self.pending_jobs:
+            jobs[str(job.get("id"))] = job
+        for job_id, entry in (state.get("leases") or {}).items():
+            job = dict(entry.get("job") or {})
+            jobs[str(job_id)] = job
+            self.leases[str(job_id)] = {
+                "job": job, "worker": str(entry.get("worker") or ""),
+                "attempt": int(entry.get("attempt") or 1),
+                "expires_at": float("-inf"),  # recover() re-times these
+            }
+        self.attempts.update({str(k): int(v) for k, v in
+                              (state.get("attempts") or {}).items()})
+        for job_id, workers in (state.get("excluded") or {}).items():
+            self.excluded[str(job_id)] = {str(w) for w in workers}
+        self.checkpoints.update(state.get("checkpoints") or {})
+        for job_id, marker in (state.get("completed") or {}).items():
+            # one marker per settle, shared between the dedupe map and
+            # the upload list — exactly the live _record_result shape,
+            # so uploaded_ids() stays exactly-once across restarts
+            self.completed[str(job_id)] = marker
+            self.results.append(marker)
+        self.abandoned.extend(str(j)
+                              for j in state.get("abandoned") or ())
+        self.submitted_at.update(
+            {str(k): float(v)
+             for k, v in (state.get("submitted_at") or {}).items()})
+        self.duplicate_results.extend(state.get("duplicates") or ())
+        self.known_workers.update(
+            str(w) for w in state.get("known_workers") or ())
+        self._counter_restore(state.get("counters") or {})
+        self.flights.restore(state.get("flights") or {})
+
+    def _apply_journal_event(self, record: dict[str, Any],
+                             jobs: dict[str, dict[str, Any]]) -> None:
+        """Replay ONE journaled transition into hive state — the exact
+        mirror of the live mutation paths, counters included, so a
+        recovered /api/stats reconciles with the settle lists."""
+        ev = str(record.get("ev") or "")
+        t = float(record.get("t") or 0.0)
+        job_id = (None if record.get("id") is None
+                  else str(record.get("id")))
+        if ev == "submit":
+            job = dict(record.get("job") or {})
+            jobs[job_id] = job
+            self.submitted_at.setdefault(job_id, t)
+            self.flights.open(job_id, job, t=t,
+                              trace_id=record.get("trace_id"))
+            self._submit_rate.note(t)
+            self.pending_jobs.append(job)
+            self.issued_ids.append(job_id)
+        elif ev == "grant":
+            attempt = int(record.get("attempt") or 1)
+            worker = str(record.get("worker") or "")
+            job = jobs.get(job_id)
+            if job is None:
+                log.warning("journal grant for unknown job %s; skipped",
+                            job_id)
+                return
+            self.attempts[job_id] = attempt
+            self.pending_jobs = [j for j in self.pending_jobs
+                                 if str(j.get("id")) != job_id]
+            self.leases[job_id] = {
+                "job": job, "worker": worker, "attempt": attempt,
+                "expires_at": t + self.lease_s,
+            }
+            self.known_workers.add(worker)
+            self._leases_granted.inc()
+            self.flights.grant(job_id, attempt=attempt, worker=worker,
+                               t=t, queued_s=record.get("queued_s"),
+                               resume_step=record.get("resume_step"),
+                               epoch=record.get("epoch"))
+        elif ev == "checkpoint":
+            checkpoint = record.get("checkpoint")
+            self.checkpoints[job_id] = checkpoint
+            self._ckpt_stored.inc()
+            step = (checkpoint.get("step")
+                    if isinstance(checkpoint, dict) else None)
+            self.flights.note(job_id, "checkpoint", t=t,
+                              worker=record.get("worker"), step=step)
+        elif ev == "lease_expired":
+            self.leases.pop(job_id, None)
+            self._leases_expired.inc()
+            self.excluded.setdefault(job_id, set()).add(
+                str(record.get("worker") or ""))
+            self.flights.note(job_id, "lease_expired", t=t,
+                              worker=record.get("worker"),
+                              attempt=record.get("attempt"))
+        elif ev == "redelivered":
+            job = jobs.get(job_id)
+            if job is not None:
+                self.pending_jobs.append(job)
+            self._redelivered.inc()
+            self.flights.note(job_id, "redelivered", t=t)
+        elif ev == "abandoned":
+            self.abandoned.append(job_id)
+            self._abandoned.inc()
+            self.checkpoints.pop(job_id, None)
+            self.flights.note(job_id, "abandoned", t=t,
+                              attempts=record.get("attempts"))
+        elif ev == "redispatched":
+            kind = str(record.get("kind") or "")
+            worker = record.get("worker")
+            if worker:
+                self.excluded.setdefault(job_id, set()).add(str(worker))
+            if record.get("requeued"):
+                lease = self.leases.pop(job_id, None)
+                if lease is not None:
+                    self.pending_jobs.append(lease["job"])
+            self._redispatched.inc(kind=kind)
+            self.flights.note(job_id, "redispatched", t=t, kind=kind,
+                              worker=worker or None)
+        elif ev == "duplicate":
+            self.duplicate_results.append(
+                {"id": job_id,
+                 "worker_name": str(record.get("worker") or ""),
+                 "recovered": True})
+            self._duplicates.inc()
+            self.flights.note(job_id, "duplicate_upload", t=t,
+                              worker=record.get("worker"))
+        elif ev == "salvaged":
+            if job_id in self.abandoned:
+                self.abandoned.remove(job_id)
+            self._salvaged.inc()
+            self.flights.note(job_id, "salvaged", t=t,
+                              worker=record.get("worker"))
+        elif ev == "digest":
+            self.flights.add_digest(job_id, record.get("digest"))
+        elif ev == "settled":
+            worker = str(record.get("worker") or "")
+            outcome = str(record.get("outcome") or "ok")
+            marker = {"id": job_id, "worker_name": worker,
+                      "outcome": outcome, "recovered": True}
+            self.completed[job_id] = marker
+            self.results.append(marker)
+            self.leases.pop(job_id, None)
+            self.checkpoints.pop(job_id, None)
+            self.pending_jobs = [j for j in self.pending_jobs
+                                 if str(j.get("id")) != job_id]
+            self._completed.inc()
+            if record.get("from_epoch") is not None:
+                self._epoch_salvaged.inc()
+                self.flights.note(job_id, "epoch_salvage", t=t,
+                                  from_epoch=record.get("from_epoch"),
+                                  epoch=record.get("epoch"))
+            self.flights.settle(job_id, t=t, worker=worker,
+                                outcome=outcome,
+                                attempt=record.get("attempt"),
+                                epoch=record.get("epoch"))
+        elif ev == "epoch":
+            pass  # consumed by recover()'s epoch fold
+        else:
+            log.warning("unknown journal event %r (seq %s) ignored",
+                        ev, record.get("seq"))
+
+    @classmethod
+    def recover(cls, journal: HiveJournal, *,
+                lease_grace_s: float = 0.0,
+                **kwargs: Any) -> "MiniHive":
+        """Rebuild a hive from its journal: restore the newest snapshot,
+        replay the tail (repairing torn/corrupt records into ``.bad``
+        parks), bump the epoch, and re-attach the journal for the new
+        life. Pre-crash leases are restored EXPIRED (or with
+        ``lease_grace_s``): the workers holding them watched the hive
+        die and assumed as much (HiveSession ride-through), so the
+        first sweep redelivers those jobs — with their journaled resume
+        checkpoints — while any late pre-epoch upload still settles
+        exactly once as epoch salvage."""
+        kwargs.pop("journal", None)
+        hive = cls(**kwargs)
+        snapshot, records = journal.replay()
+        epoch_seen = journal.stored_epoch()
+        jobs: dict[str, dict[str, Any]] = {}
+        if snapshot is not None:
+            epoch_seen = max(epoch_seen, int(snapshot.get("epoch") or 0))
+            hive._restore_state(snapshot.get("state") or {}, jobs)
+        for record in records:
+            if record.get("ev") == "epoch":
+                try:
+                    epoch_seen = max(epoch_seen,
+                                     int(record.get("epoch") or 0))
+                except (TypeError, ValueError):
+                    pass
+                continue
+            try:
+                hive._apply_journal_event(record, jobs)
+            except Exception:  # one bad record must not lose the rest
+                log.exception("journal replay failed on seq %s; record "
+                              "skipped", record.get("seq"))
+        now = hive._clock()
+        expiry = (now + lease_grace_s if lease_grace_s > 0
+                  else float("-inf"))
+        for lease in hive.leases.values():
+            lease["expires_at"] = expiry
+        hive.hive_epoch = epoch_seen + 1
+        hive.journal = journal
+        journal.begin_epoch(hive.hive_epoch, t=now)
+        hive._recoveries.inc()
+        hive._epoch_gauge.set(hive.hive_epoch)
+        # the restart lands on every open story: a stitched flight
+        # record shows the epoch bump between its attempts
+        for job_id in hive.flights.unsettled_ids():
+            hive.flights.note(job_id, "hive_recovered", t=now,
+                              epoch=hive.hive_epoch)
+        log.warning(
+            "hive recovered from journal %s: epoch %d, %d pending, "
+            "%d expired lease(s) to redeliver, %d completed marker(s), "
+            "%d checkpoint(s), %d abandoned", journal.directory,
+            hive.hive_epoch, len(hive.pending_jobs), len(hive.leases),
+            len(hive.completed), len(hive.checkpoints),
+            len(hive.abandoned))
+        return hive
 
     # ---- observability ---------------------------------------------------
 
@@ -499,6 +1002,13 @@ class MiniHive(ChaoticHive):
         """Lease-table view + the counter snapshot — the registry the
         exactly-once tests reconcile against the result lists."""
         self.sweep()
+        if self.journal is not None:
+            # mirror the WAL's own counters into the registry snapshot
+            counters = self.journal.snapshot_counters()
+            self._journal_records.set_to(counters["records_written"])
+            self._journal_fsyncs.set_to(counters["fsyncs"])
+            self._journal_parked.set_to(counters["tails_parked"])
+            self._journal_snapshots.set_to(counters["snapshots_written"])
         return {
             "pending": len(self.pending_jobs),
             "leased": {job_id: {"worker": lease["worker"],
@@ -508,6 +1018,9 @@ class MiniHive(ChaoticHive):
             "duplicates": len(self.duplicate_results),
             "abandoned": list(self.abandoned),
             "checkpoints": sorted(self.checkpoints),
+            "hive_epoch": self.hive_epoch,
+            "journal": (None if self.journal is None
+                        else self.journal.snapshot_counters()),
             "metrics": self.metrics.snapshot(),
             "flights": self.flights.snapshot(),
         }
@@ -594,3 +1107,40 @@ class MiniHive(ChaoticHive):
                  "error": f"no flight record for job {job_id!r} (evicted "
                           f"or never submitted)"}, status=404)
         return web.json_response(record)
+
+
+# ---------------------------------------------------------------------------
+# hive-side chaos seams (swarmdurable, ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+async def kill_hive(hive: MiniHive) -> int:
+    """SIGKILL the hive in-process: stop serving NOW, mid-whatever.
+    In-flight requests see dropped connections; every worker's next
+    poll/upload/heartbeat fails (flipping its HiveSession into OUTAGE
+    ride-through). The hive OBJECT survives only so the test can read
+    what was lost — the recovery contract is that nothing in memory
+    matters, only what the journal committed. Returns the port so
+    :func:`restart_hive` can come back where the workers are looking."""
+    port = await hive.die()
+    # detach the journal: the dead object must never append again (a
+    # stray sweep()/stats() on it would interleave with the recovered
+    # hive's writes), and nothing it buffered uncommitted survives —
+    # exactly like a real SIGKILL
+    hive.journal = None
+    log.warning("hive killed on port %d (in-memory state is now "
+                "garbage; the journal is the only survivor)", port)
+    return port
+
+
+async def restart_hive(journal: HiveJournal, *, port: int,
+                       hive_cls: type | None = None,
+                       lease_grace_s: float = 0.0,
+                       **kwargs: Any) -> MiniHive:
+    """Bring a killed hive back from its journal ON THE SAME PORT, so
+    riding-through workers (whose hive URI is fixed) heal on their next
+    poll. ``hive_cls`` lets harnesses restart subclasses (LoadHive)."""
+    cls = hive_cls or MiniHive
+    hive = cls.recover(journal, lease_grace_s=lease_grace_s, **kwargs)
+    await hive.start(port=port)
+    return hive

@@ -514,6 +514,96 @@ class BreakerBoard:
 
 
 # ---------------------------------------------------------------------------
+# hive session (swarmdurable, ISSUE 14: hive-outage ride-through)
+# ---------------------------------------------------------------------------
+
+
+def hive_reachable_error(exc: BaseException) -> bool:
+    """True when the error PROVES the hive answered: an HTTP 4xx client
+    response (aiohttp sets ``.status``). A reachable hive rejecting a
+    request is a protocol problem, not an outage — it must neither grow
+    the outage streak (a reference hive 404ing heartbeats would
+    otherwise flip the session while polls succeed) nor count as a
+    healing success (nothing healed)."""
+    status = getattr(exc, "status", None)
+    return isinstance(status, int) and 400 <= status < 500
+
+
+class HiveSession:
+    """The worker's view of hive reachability: ONLINE until
+    ``outage_after`` consecutive poll/upload/heartbeat failures flip it
+    to OUTAGE, and back on the first success ("healed").
+
+    Ride-through semantics the worker attaches to the flip
+    (node/worker.py): leases are ASSUMED LOST (a dead hive cannot
+    extend them; a journaled hive's recovery voids them anyway),
+    in-flight work runs to completion, results spool to the
+    DeadLetterSpool after a single upload attempt, and the heal
+    triggers a LIVE spool replay — paid chip time rides out the outage
+    and lands the moment the hive is back. The capped poll backoff
+    (PR 2) already paces the probing; this class only names the state
+    so the ladder, the spool, and the operator signals agree on it.
+
+    Stdlib-only and synchronous like the rest of this module.
+    """
+
+    def __init__(self, *, outage_after: int = 3,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.outage_after = max(1, int(outage_after))
+        self._clock = clock
+        self.state = "online"
+        self.consecutive_failures = 0
+        self.outages = 0
+        self.outage_started_at: float | None = None
+        self.last_outage_s = 0.0
+        self.last_failure_source = ""
+
+    @property
+    def in_outage(self) -> bool:
+        return self.state == "outage"
+
+    def note_failure(self, source: str = "poll") -> bool:
+        """Record one hive-unreachable failure; True exactly when this
+        one flipped the session into OUTAGE (the caller logs and counts
+        the assumed-lost leases once, not per failure)."""
+        self.consecutive_failures += 1
+        self.last_failure_source = str(source)
+        if self.state == "online" \
+                and self.consecutive_failures >= self.outage_after:
+            self.state = "outage"
+            self.outages += 1
+            self.outage_started_at = self._clock()
+            return True
+        return False
+
+    def note_success(self) -> bool:
+        """Record one successful hive exchange; True exactly when it
+        HEALED an outage (the caller replays the dead-letter spool)."""
+        self.consecutive_failures = 0
+        if self.state != "outage":
+            return False
+        self.state = "online"
+        if self.outage_started_at is not None:
+            self.last_outage_s = max(
+                0.0, self._clock() - self.outage_started_at)
+        self.outage_started_at = None
+        return True
+
+    def snapshot(self) -> dict[str, Any]:
+        out = {
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "outages": self.outages,
+            "last_outage_s": round(self.last_outage_s, 3),
+            "last_failure_source": self.last_failure_source,
+        }
+        if self.outage_started_at is not None:
+            out["outage_age_s"] = round(
+                max(0.0, self._clock() - self.outage_started_at), 3)
+        return out
+
+
+# ---------------------------------------------------------------------------
 # dead-letter spool
 # ---------------------------------------------------------------------------
 
@@ -778,6 +868,17 @@ _STAT_HELP = {
                  "(overloaded, redispatched by a lease-aware hive)",
     "polls_backpressured": "poll-loop waits inserted by queue-depth "
                            "backpressure before over-committing",
+    # hive-outage ride-through (ISSUE 14, swarmdurable): state the
+    # worker keeps while the hive is DOWN, distinct from per-request
+    # failures — an outage is one incident however many polls it eats
+    "hive_outages": "consecutive-failure streaks that flipped the hive "
+                    "session into OUTAGE ride-through",
+    "leases_assumed_lost": "in-flight leases written off when the hive "
+                           "session flipped to OUTAGE (work rides "
+                           "through; results spool and replay on heal)",
+    "hive_epoch_changes": "hive epoch bumps observed on grants or "
+                          "heartbeat acks (the hive recovered from its "
+                          "journal since we last spoke)",
 }
 
 
@@ -815,6 +916,9 @@ class ResilienceStats:
     leases_lost = _stat_property("leases_lost")
     jobs_shed = _stat_property("jobs_shed")
     polls_backpressured = _stat_property("polls_backpressured")
+    hive_outages = _stat_property("hive_outages")
+    leases_assumed_lost = _stat_property("leases_assumed_lost")
+    hive_epoch_changes = _stat_property("hive_epoch_changes")
 
     def __init__(self, registry: Any = None) -> None:
         from chiaswarm_tpu.obs.metrics import Registry

@@ -106,6 +106,12 @@ class Settings:
     # 0 there (its timeout detector stays the only failure story).
     heartbeat_s: float = 0.0
     checkpoint_dir: str = ""            # default <root>/checkpoints/<worker>
+    # hive-outage ride-through (ISSUE 14, node/resilience.py::
+    # HiveSession): this many CONSECUTIVE poll/upload/heartbeat
+    # failures flip the session to OUTAGE — leases assumed lost,
+    # in-flight work completes, results spool after one upload attempt,
+    # and the spool replays LIVE the moment the hive heals
+    hive_outage_after: int = 3
     # ---- HBM model residency (serving/residency.py, ISSUE 8) ----
     # explicit resident-param budget in bytes; 0 = auto (the
     # CHIASWARM_RESIDENCY_BUDGET env var, else the classic HBM fraction

@@ -61,6 +61,7 @@ from chiaswarm_tpu.node.executor import (
     single_chip_rows,
 )
 from chiaswarm_tpu.node.hive import BadWorkerError, HiveClient
+from chiaswarm_tpu.node.hivelog import HIVE_EPOCH_KEY
 from chiaswarm_tpu.node.logging_setup import setup_logging
 from chiaswarm_tpu.node.overload import OverloadController
 from chiaswarm_tpu.node.registry import ModelRegistry
@@ -71,10 +72,12 @@ from chiaswarm_tpu.node.resilience import (
     BreakerBoard,
     CheckpointSpool,
     DeadLetterSpool,
+    HiveSession,
     ResilienceStats,
     backoff_delay,
     classify_exception,
     classify_result,
+    hive_reachable_error,
 )
 from chiaswarm_tpu.node.settings import Settings, load_settings
 from chiaswarm_tpu.serving.guard import (
@@ -263,6 +266,25 @@ class Worker:
             on_probe=getattr(self.registry, "unquarantine", None),
             persist_path=self._breaker_state_path())
         self.dead_letters = DeadLetterSpool(self._dead_letter_dir())
+        # ---- hive-outage ride-through (ISSUE 14, swarmdurable) ----
+        # consecutive poll/upload/heartbeat failures flip the session
+        # into OUTAGE: leases assumed lost, in-flight work runs to
+        # completion, results spool after a single upload attempt, and
+        # the first success HEALS — triggering a LIVE dead-letter
+        # replay (today's startup-only replay, without the restart)
+        self.hive_session = HiveSession(
+            outage_after=self.settings.hive_outage_after)
+        # dead-letter files currently riding the result queue: the live
+        # replay must never enqueue a spooled envelope twice
+        self._replayed_paths: set[str] = set()
+        self._dl_replayed = obs_metrics.dead_letter_replayed_counter(
+            self.metrics)
+        for when in obs_metrics.DEAD_LETTER_REPLAY_WHEN:
+            self._dl_replayed.inc(0, when=when)
+        # the hive epoch last seen on a grant or heartbeat ack (None
+        # against a journal-less hive); echoed on uploads so a
+        # recovered hive dedupes pre-crash grants exactly once
+        self._last_hive_epoch: int | None = None
         # ---- fleet durability (ISSUE 6) ----
         # resume-state spool next to the dead-letter spool (same
         # per-worker namespacing); lanes snapshot into it via the slot
@@ -422,18 +444,80 @@ class Worker:
             except (NotImplementedError, RuntimeError, ValueError):
                 pass
 
-    def _replay_dead_letters(self) -> None:
-        """Re-queue results spooled by a previous run: upload durability
-        across restarts. The file is only discarded after ITS upload
-        succeeds (node/worker.py::_deliver)."""
+    def _replay_dead_letters(self, when: str = "startup") -> int:
+        """Re-queue spooled results for upload. ``startup`` is the PR-2
+        path (worker restarted under a hive outage); ``live`` is the
+        ISSUE-14 ride-through — the hive healed mid-run, so the spool
+        drains NOW instead of waiting for the next worker restart. A
+        file is only discarded after ITS upload succeeds (_deliver);
+        ``_replayed_paths`` keeps a file that is already riding the
+        result queue from enqueueing twice."""
+        replayed = 0
         for path, result in self.dead_letters.replay():
-            result["_dead_letter_path"] = str(path)
+            key = str(path)
+            if key in self._replayed_paths:
+                continue  # already in the queue from an earlier replay
+            self._replayed_paths.add(key)
+            result["_dead_letter_path"] = key
             self.result_queue.put_nowait(result)
             self.stats.results_replayed += 1
-        if self.stats.results_replayed:
-            log.warning("replaying %d dead-letter result(s) from %s",
-                        self.stats.results_replayed,
-                        self.dead_letters.directory)
+            self._dl_replayed.inc(when=when)
+            replayed += 1
+        if replayed:
+            log.warning("replaying %d dead-letter result(s) from %s "
+                        "(%s)", replayed, self.dead_letters.directory,
+                        when)
+        return replayed
+
+    # ---- hive-session bookkeeping (ISSUE 14) ----
+
+    def _note_hive_ok(self) -> None:
+        """A poll/upload/heartbeat reached the hive and succeeded; a
+        heal drains the dead-letter spool live — spooled chip time
+        lands the moment the hive is back, no restart needed."""
+        if self.hive_session.note_success():
+            log.warning(
+                "hive healed after %.1fs outage; replaying the "
+                "dead-letter spool live",
+                self.hive_session.last_outage_s)
+            self._replay_dead_letters(when="live")
+
+    def _note_hive_failure(self, source: str, exc: Exception) -> None:
+        """A poll/upload/heartbeat could not reach the hive. An HTTP
+        4xx is excluded — the hive ANSWERED (a reference hive 404ing
+        heartbeats must not read as an outage while polls succeed)."""
+        if hive_reachable_error(exc):
+            return
+        if self.hive_session.note_failure(source):
+            assumed = len(self._inflight)
+            self.stats.hive_outages += 1
+            if assumed:
+                self.stats.leases_assumed_lost += assumed
+            log.error(
+                "hive OUTAGE after %d consecutive %s failure(s); %d "
+                "in-flight lease(s) assumed lost — work rides through, "
+                "results spool to dead-letter and replay on heal",
+                self.hive_session.consecutive_failures, source, assumed)
+
+    def _note_hive_epoch(self, raw: Any) -> int | None:
+        """Track the hive epoch stamped on grants/heartbeat acks; a
+        bump means the hive recovered from its journal since we last
+        spoke — every pre-bump lease is void (the recovered hive
+        redelivers them), which the ride-through already assumed."""
+        try:
+            epoch = None if raw is None else int(raw)
+        except (TypeError, ValueError):
+            return None
+        if epoch is None:
+            return None
+        previous = self._last_hive_epoch
+        if previous is not None and epoch != previous:
+            self.stats.hive_epoch_changes += 1
+            log.warning("hive epoch %d -> %d: the hive recovered from "
+                        "its journal; pre-recovery leases are void and "
+                        "their jobs will redeliver", previous, epoch)
+        self._last_hive_epoch = epoch
+        return epoch
 
     async def run(self) -> None:
         self.startup()
@@ -561,6 +645,11 @@ class Worker:
             "checkpoints_written": self.checkpoints.written,
             "checkpoints_corrupt_skipped": self.checkpoints.corrupt_skipped,
             "inflight_jobs": len(self._inflight),
+            # hive-outage ride-through (ISSUE 14): the session state
+            # machine + the last hive epoch seen — the edge-side view
+            # of a hive incident and its journal recovery
+            "hive_session": self.hive_session.snapshot(),
+            "hive_epoch": self._last_hive_epoch,
         }
         data.update(self.stats.snapshot())
         data["stepper"] = self._stepper_health()
@@ -691,6 +780,11 @@ class Worker:
         m.gauge("chiaswarm_inflight_jobs",
                 "jobs between poll receipt and settled upload (the "
                 "lease-heartbeat set)").set(len(self._inflight))
+        # hive-outage ride-through (ISSUE 14): the session state gauge
+        # next to the outage/assumed-lost counters ResilienceStats
+        # already renders
+        obs_metrics.hive_session_state_gauge(self.metrics).set(
+            1 if self.hive_session.in_outage else 0)
         # swarmsight (ISSUE 13): trace-ring eviction becomes a counter
         # so a slow scraper SEES that it lost spans (pair with the
         # /debug/traces?since= cursor instead of scraping faster)
@@ -881,11 +975,15 @@ class Worker:
         try:
             jobs = await self.hive.get_work(session)
         except BadWorkerError as exc:
+            # the hive ANSWERED (flagged us): reachable, not an outage
+            self._note_hive_ok()
             log.error("hive flagged this worker: %s", exc)
             return self._poll_backoff.next()
         except Exception as exc:
+            self._note_hive_failure("poll", exc)
             log.warning("poll failed: %s", exc)
             return self._poll_backoff.next()
+        self._note_hive_ok()
         self._poll_backoff.reset()
         poll_http_s = time.perf_counter() - t_poll
         if jobs:
@@ -934,6 +1032,12 @@ class Worker:
             # the overload estimator being the only reader (ISSUE 13).
             resume = job.get("resume")
             ctx = job.pop(obs_flight.TRACE_CTX_KEY, None)
+            # swarmdurable (ISSUE 14): the journaled hive's epoch stamp
+            # is popped like the trace context (never reaches argument
+            # formatting) and rides the trace to the upload, where the
+            # envelope echoes it — the recovered hive's dedupe key
+            epoch = self._note_hive_epoch(
+                job.pop(HIVE_EPOCH_KEY, None))
             try:
                 queued_s = max(0.0, float(job.get("queued_s") or 0.0))
             except (TypeError, ValueError):
@@ -947,6 +1051,8 @@ class Worker:
                 queued_s=round(queued_s, 4),
                 resume_step=(resume.get("step", 0)
                              if isinstance(resume, dict) else 0))
+            if epoch is not None:
+                trace.meta[HIVE_EPOCH_KEY] = epoch
             if isinstance(ctx, dict) and ctx.get("trace_id"):
                 # JOIN the hive's trace context (swarmsight, ISSUE 13):
                 # this trace becomes the hive-granted attempt span's
@@ -1129,14 +1235,23 @@ class Worker:
                     # metrics cadence, not the lease cadence
                     if time.monotonic() - last_metrics < metrics_every:
                         continue
+                    idle_payload = {
+                        "worker_name": self.settings.worker_name,
+                        "jobs": [],
+                        "metrics": self._fleet_metrics(),
+                    }
+                    if self._last_hive_epoch is not None:
+                        idle_payload[HIVE_EPOCH_KEY] = \
+                            self._last_hive_epoch
                     try:
-                        await self.hive.post_heartbeat(session, {
-                            "worker_name": self.settings.worker_name,
-                            "jobs": [],
-                            "metrics": self._fleet_metrics(),
-                        })
+                        ack = await self.hive.post_heartbeat(
+                            session, idle_payload)
+                        self._note_hive_ok()
+                        if isinstance(ack, dict):
+                            self._note_hive_epoch(ack.get(HIVE_EPOCH_KEY))
                         last_metrics = time.monotonic()
                     except Exception as exc:
+                        self._note_hive_failure("heartbeat", exc)
                         log.debug("idle heartbeat failed: %s", exc)
                     continue
                 inflight = list(self._inflight)
@@ -1147,6 +1262,12 @@ class Worker:
                     "worker_name": self.settings.worker_name,
                     "jobs": await asyncio.to_thread(build_jobs, inflight),
                 }
+                if self._last_hive_epoch is not None:
+                    # the epoch handshake (ISSUE 14): a recovered hive
+                    # rejects beats claiming a pre-restart epoch — the
+                    # ack below hands back the current one, so the NEXT
+                    # beat re-registers under it
+                    payload[HIVE_EPOCH_KEY] = self._last_hive_epoch
                 if time.monotonic() - last_metrics >= metrics_every:
                     # fleet plane (ISSUE 13): busy beats carry the
                     # metric snapshot at the same throttled cadence;
@@ -1159,6 +1280,7 @@ class Worker:
                 try:
                     response = await self.hive.post_heartbeat(session,
                                                               payload)
+                    self._note_hive_ok()
                     # a malformed 2xx body (non-dict JSON, non-list
                     # "lost") counts as a failed beat, NOT a loop exit:
                     # one bad proxy answer must never kill the keep-alive
@@ -1168,9 +1290,11 @@ class Worker:
                         raise TypeError("non-list 'lost' in heartbeat "
                                         f"response: {lost_raw!r}")
                     reported = {str(j) for j in lost_raw}
+                    self._note_hive_epoch(response.get(HIVE_EPOCH_KEY))
                 except Exception as exc:
                     # reference hives have no heartbeat endpoint, and a
                     # partitioned hive is exactly when we keep beating
+                    self._note_hive_failure("heartbeat", exc)
                     log.debug("heartbeat failed: %s", exc)
                     continue
                 self.stats.lease_heartbeats += 1
@@ -1626,6 +1750,16 @@ class Worker:
         result.setdefault("worker_name", self.settings.worker_name)
         if trace is not None:
             trace.phase("upload")
+            # swarmdurable (ISSUE 14): echo the grant's hive-epoch
+            # stamp so a recovered hive can tell a pre-crash grant's
+            # upload (settled once as epoch salvage) from a live one.
+            # Stamped BEFORE the upload attempts so a spooled envelope
+            # keeps it — a dead-letter replay after the restart still
+            # carries its original epoch. Never stamped when the hive
+            # sent none: reference wire shape untouched.
+            if trace.meta.get(HIVE_EPOCH_KEY) is not None:
+                result.setdefault(HIVE_EPOCH_KEY,
+                                  trace.meta[HIVE_EPOCH_KEY])
             if trace.meta.get("trace_id"):
                 # swarmsight (ISSUE 13): a hive that stamped a trace
                 # context gets the span digest back on the envelope —
@@ -1655,13 +1789,18 @@ class Worker:
         if uploaded:
             if spooled is not None:
                 self.dead_letters.discard(spooled)
+                self._replayed_paths.discard(str(spooled))
             # GC on ack (ISSUE 6 satellite): the job settled, its resume
             # checkpoint is stale by definition
             self.checkpoints.discard(result.get("id"))
         elif spooled is None:
             self.dead_letters.spool(result)
             self.stats.results_dead_lettered += 1
-        # a replayed result that failed again keeps its existing file
+        else:
+            # a replayed result that failed again keeps its existing
+            # file — and leaves the in-queue set, so the NEXT heal's
+            # live replay picks it up again
+            self._replayed_paths.discard(str(spooled))
         self._settle_inflight(result)
         self._finish_trace(trace, result,
                            settled="uploaded" if uploaded else "dead_letter")
@@ -1709,13 +1848,25 @@ class Worker:
         for attempt in range(1, retries + 1):
             try:
                 response = await self.hive.post_result(session, result)
+                self._note_hive_ok()
                 log.info("uploaded result %s: %s", result.get("id"),
                          response)
                 return True
             except Exception as exc:
+                self._note_hive_failure("upload", exc)
                 self.stats.upload_retries += 1
                 log.warning("result upload attempt %d/%d failed: %s",
                             attempt, retries, exc)
+                if self.hive_session.in_outage:
+                    # ride-through (ISSUE 14): during a declared outage
+                    # the full retry ladder only delays the spool (and
+                    # the next result behind it). One probe per result
+                    # keeps testing the hive; the spool replays LIVE on
+                    # heal, so giving up early costs nothing.
+                    log.warning("hive in outage; spooling result %s "
+                                "after a single attempt",
+                                result.get("id"))
+                    return False
                 if attempt < retries:
                     await asyncio.sleep(backoff_delay(
                         attempt, self.settings.upload_retry_delay_s,

@@ -227,6 +227,14 @@ class FlightRecorder:
         with self._lock:
             return list(self._records)
 
+    def trace_id_of(self, job_id: Any) -> str | None:
+        """Existing record's trace id (None when unknown) — the journal
+        stamps it into the submit event so recovery keeps one trace per
+        job across hive restarts."""
+        with self._lock:
+            record = self._records.get(str(job_id))
+            return None if record is None else record["trace_id"]
+
     # ---- building ------------------------------------------------------
 
     def _open_locked(self, job_id: str) -> dict[str, Any]:
@@ -250,11 +258,17 @@ class FlightRecorder:
         return record
 
     def open(self, job_id: Any, job: dict[str, Any] | None, *,
-             t: float) -> None:
+             t: float, trace_id: str | None = None) -> None:
         """Start (or refresh) a record at hive submit. Idempotent: a
-        resubmitted id keeps its existing trace and history."""
+        resubmitted id keeps its existing trace and history.
+        ``trace_id`` pins the id instead of minting one — journal replay
+        (node/minihive.py::MiniHive.recover) restores records under
+        their pre-crash trace ids, so a story that spans a hive restart
+        stays ONE trace."""
         with self._lock:
             record = self._open_locked(str(job_id))
+            if trace_id:
+                record["trace_id"] = str(trace_id)
             if record["submitted_at"] is None:
                 record["submitted_at"] = float(t)
                 self._note_locked(record, t, "submit")
@@ -291,17 +305,23 @@ class FlightRecorder:
 
     def grant(self, job_id: Any, *, attempt: int, worker: str, t: float,
               queued_s: float | None = None,
-              resume_step: int | None = None) -> dict[str, Any]:
+              resume_step: int | None = None,
+              epoch: int | None = None) -> dict[str, Any]:
         """Record one delivery and return the wire trace context the
-        payload carries (:data:`TRACE_CTX_KEY`)."""
+        payload carries (:data:`TRACE_CTX_KEY`). ``epoch`` is the
+        journaled hive's grant epoch (swarmdurable): a record whose
+        grants carry two different epochs provably spans a hive
+        restart."""
         with self._lock:
             record = self._open_locked(str(job_id))
             attempt = int(attempt)
-            record["granted"][attempt] = {"t": round(float(t), 6),
-                                          "worker": str(worker)}
+            granted = {"t": round(float(t), 6), "worker": str(worker)}
+            if epoch is not None:
+                granted["epoch"] = int(epoch)
+            record["granted"][attempt] = granted
             self._note_locked(record, t, "grant", attempt=attempt,
                               worker=str(worker), queued_s=queued_s,
-                              resume_step=resume_step)
+                              resume_step=resume_step, epoch=epoch)
             return {"trace_id": record["trace_id"],
                     "span_id": attempt_span_id(record["trace_id"],
                                                attempt),
@@ -329,9 +349,11 @@ class FlightRecorder:
             record["digests"][attempt] = digest
 
     def settle(self, job_id: Any, *, t: float, worker: str, outcome: str,
-               attempt: int | None = None) -> None:
+               attempt: int | None = None,
+               epoch: int | None = None) -> None:
         """The exactly-once settle closes the record and computes the
-        deadline-budget attribution."""
+        deadline-budget attribution. ``epoch`` stamps which hive epoch
+        counted the settle (swarmdurable)."""
         with self._lock:
             record = self._records.get(str(job_id))
             if record is None:
@@ -344,9 +366,69 @@ class FlightRecorder:
                                  "worker": str(worker),
                                  "outcome": str(outcome),
                                  "attempt": int(attempt)}
+            if epoch is not None:
+                record["settled"]["epoch"] = int(epoch)
             self._note_locked(record, t, "settled", worker=str(worker),
-                              outcome=str(outcome), attempt=int(attempt))
+                              outcome=str(outcome), attempt=int(attempt),
+                              epoch=epoch)
             record["attribution"] = budget_attribution(record)
+
+    # ---- durability (swarmdurable: compaction snapshots) ---------------
+
+    def dump(self) -> dict[str, Any]:
+        """JSON-safe full-state dump for the hive journal's compaction
+        snapshot (node/hivelog.py): records in ring order plus the
+        eviction counter. Attempt-keyed maps serialize with string keys
+        (JSON has no int keys); :meth:`restore` coerces them back."""
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "evicted": self.evicted,
+                "records": [
+                    {k: ({str(a): e for a, e in v.items()}
+                         if k in ("granted", "digests") else v)
+                     for k, v in record.items()}
+                    for record in self._records.values()
+                ],
+            }
+
+    def restore(self, dump: dict[str, Any]) -> None:
+        """Rebuild the ring from :meth:`dump` (journal snapshot replay).
+        Replaces current contents; capacity stays this instance's own
+        (the env knob may legitimately differ across restarts)."""
+        if not isinstance(dump, dict):
+            return
+        with self._lock:
+            self._records.clear()
+            self.evicted = int(dump.get("evicted") or 0)
+            for raw in dump.get("records") or ():
+                if not isinstance(raw, dict) or raw.get("job_id") is None:
+                    continue
+                record = dict(raw)
+                for key in ("granted", "digests"):
+                    coerced: dict[int, Any] = {}
+                    for a, entry in (record.get(key) or {}).items():
+                        try:
+                            coerced[int(a)] = entry
+                        except (TypeError, ValueError):
+                            continue
+                    record[key] = coerced
+                record.setdefault("events", [])
+                record.setdefault("events_dropped", 0)
+                record.setdefault("settled", None)
+                record.setdefault("attribution", None)
+                self._records[str(record["job_id"])] = record
+            while len(self._records) > self.capacity:
+                self._records.popitem(last=False)
+                self.evicted += 1
+
+    def unsettled_ids(self) -> list[str]:
+        """Open (never-settled) records — the set a recovering hive
+        marks with its epoch-bump event so a stitched story shows the
+        restart between the attempts."""
+        with self._lock:
+            return [job_id for job_id, record in self._records.items()
+                    if record["settled"] is None]
 
     # ---- reading -------------------------------------------------------
 

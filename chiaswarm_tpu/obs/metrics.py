@@ -789,5 +789,45 @@ def trace_spans_evicted_counter(
         "collected them (use /debug/traces?since= to detect gaps)")
 
 
+# ---- swarmdurable families (ISSUE 14, node/hivelog.py) ----
+#
+# Worker-side: the hive-session outage families live on each worker's
+# registry (hermetic, like guard/overload). Hive-side journal families
+# live on the hive's own registry (node/minihive.py) — /api/stats is
+# their scrape, not /metrics.
+
+#: when a dead-letter envelope was replayed back into the upload queue:
+#: ``startup`` (the PR-2 path — the worker process restarted) vs
+#: ``live`` (ISSUE 14 — the hive healed mid-run and the spool drained
+#: without a restart)
+DEAD_LETTER_REPLAY_WHEN = ("startup", "live")
+
+
+def dead_letter_replayed_counter(
+        registry: Registry | None = None) -> Counter:
+    """Dead-letter envelopes re-queued for upload, split by when: a
+    ``live`` count rising during an incident is the ride-through
+    working (spooled chip time landing the moment the hive heals); a
+    ``startup`` count means the outage outlived the worker process.
+    Complements ``chiaswarm_results_replayed_total`` (the undifferen-
+    tiated PR-2 total, kept for dashboard compatibility)."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_dead_letter_replayed_total",
+        "dead-letter results re-queued for upload, by replay moment",
+        labelnames=("when",))
+
+
+def hive_session_state_gauge(registry: Registry | None = None) -> Gauge:
+    """The worker's hive-session state: 0 = online, 1 = OUTAGE
+    ride-through (leases assumed lost, in-flight work completing,
+    results spooling). THE page-the-operator signal for a hive-side
+    incident as seen from the fleet's edge — every worker's gauge
+    flipping together is a hive outage; one worker alone is a
+    partition."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_hive_session_state",
+        "worker's hive reachability state (0=online, 1=outage)")
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -34,6 +34,7 @@ from chiaswarm_tpu.node.loadgen import (
     DiurnalCurve,
     KillPlan,
     LoadHive,
+    RosterPlan,
     SyntheticExecutor,
     UserPopulation,
     build_scenario,
@@ -240,6 +241,43 @@ def test_load_smoke_seeded_zero_loss():
     # satellite — the soak legs assert the same at scale)
     hive_stats = report["hive"]
     assert hive_stats["flights"]["records"] > 0
+
+
+def test_load_churn_roster_join_leave():
+    """ISSUE 14 satellite (ROADMAP item 5 residue): a scripted roster —
+    one worker JOINS mid-run, one LEAVES by graceful drain — keeps
+    zero-loss exactly-once settlement, records both churn events, and
+    the fleet plane + capacity model see the elastic roster (the
+    joined worker reports; the departed one drops out of the live
+    aggregate), not just a static fleet."""
+    seed = "load-churn"
+    schedule = build_scenario(seed=seed, n_users=300, duration_s=2.5,
+                              rate_jobs_s=25)
+    hive = LoadHive(lease_s=3.0, delay_s=0.0, max_attempts=4,
+                    max_jobs_per_poll=2)
+    report = asyncio.run(run_load(
+        schedule, n_workers=2, seed=seed, hive=hive,
+        roster=RosterPlan(join_at=(0.25,), leave_at=(0.6,)),
+        settle_timeout_s=120))
+    assert report["reconciliation"]["zero_loss"], report["reconciliation"]
+    events = report["roster"]
+    assert [e["action"] for e in events] == ["join", "leave"]
+    joined, departed = events[0]["worker"], events[1]["worker"]
+    assert joined != departed
+    assert events[0]["at_job"] <= events[1]["at_job"]
+    assert events[1]["drained"] is True  # a leave is a DRAIN, not a kill
+    # the joined worker actually served: it reports in the fleet
+    # per-worker map and settled at least one job
+    assert joined in report["fleet"]["workers"]
+    settlers = {str(r.get("worker_name") or "") for r in hive.results}
+    assert joined in settlers, sorted(settlers)
+    # the departed worker served before its drain, and the drain is not
+    # a kill: every job it held completed and uploaded (zero-loss above
+    # already proves exactly-once; nothing is left pending or leased)
+    assert departed in settlers, sorted(settlers)
+    hive_stats = report["hive"]
+    assert hive_stats["pending"] == 0 and not hive_stats["leased"]
+    assert report["capacity"]["jobs_per_s_per_chip"] > 0
 
 
 def test_overload_gate_10x_mixed_kill():
@@ -510,7 +548,9 @@ def test_real_lane_load_soak_tiny_family(monkeypatch):
 def test_load_soak_diurnal_fleet_kill():
     """Nightly soak: one diurnal-curve fleet run at soak scale, seeded
     from the run id (CHIASWARM_SOAK_SEED) for exact replay, with a
-    mid-run worker kill. Gate: zero loss + admitted-deadline p99."""
+    mid-run worker kill AND a scripted roster churn leg (ISSUE 14
+    satellite): one worker joins mid-run, one drains and leaves. Gate:
+    zero loss + admitted-deadline p99 under the elastic fleet."""
     seed = os.environ.get("CHIASWARM_SOAK_SEED", "load-soak-default")
     jobs_scale = int(os.environ.get("CHIASWARM_SOAK_JOBS", "120"))
     schedule = build_scenario(seed=f"load-soak:{seed}", n_users=2000,
@@ -520,8 +560,15 @@ def test_load_soak_diurnal_fleet_kill():
                     max_jobs_per_poll=4)
     report = asyncio.run(run_load(
         schedule, n_workers=3, seed=f"load-soak:{seed}", hive=hive,
-        kill=KillPlan(after_frac=0.4), settle_timeout_s=600))
+        kill=KillPlan(after_frac=0.4),
+        roster=RosterPlan(join_at=(0.3,), leave_at=(0.7,)),
+        settle_timeout_s=600))
     assert report["reconciliation"]["zero_loss"], report["reconciliation"]
+    # the churn leg actually churned: both events recorded, and the
+    # kill victim was never the leave candidate (the plan skips it)
+    assert [e["action"] for e in report["roster"]] == ["join", "leave"]
+    if report["kill"]:
+        assert report["roster"][1]["worker"] != report["kill"]["worker"]
     assert report["admitted_deadline"]["p99_within_deadline"], \
         report["admitted_deadline"]
     assert report["capacity"]["jobs_per_s_per_chip"] > 0

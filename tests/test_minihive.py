@@ -28,6 +28,7 @@ import pytest
 
 from chiaswarm_tpu.node.chaos import ChaoticExecutor
 from chiaswarm_tpu.node.executor import error_result
+from chiaswarm_tpu.node.hivelog import HiveJournal
 from chiaswarm_tpu.node.minihive import MiniHive, result_error_kind
 from chiaswarm_tpu.node.registry import ModelRegistry
 from chiaswarm_tpu.node.settings import Settings
@@ -317,16 +318,27 @@ def test_redispatch_on_model_unavailable_error_kind():
     assert bounded.uploaded_ids() == ["j3"]
 
 
-def test_stats_reconciliation_exactly_once_at_harness_scale():
+@pytest.mark.parametrize("restart", [False, True],
+                         ids=["static", "hive_restart"])
+def test_stats_reconciliation_exactly_once_at_harness_scale(
+        restart, tmp_path):
     """ISSUE 9 satellite: the ``GET /api/stats`` registry snapshot stays
     exactly-once-consistent at swarmload scale — thousands of settled
     jobs churned through 4 rotating workers on a fake clock, with
     duplicates, late uploads after redelivery, overload/model refusals,
     and lease-expiry abandonment injected throughout. The counters must
-    reconcile with the settle lists to the job."""
+    reconcile with the settle lists to the job.
+
+    The ``hive_restart`` variant (ISSUE 14 satellite) journals the run
+    and crashes the hive mid-churn — the replacement is rebuilt purely
+    by journal replay (counters included) and the SAME reconciliation
+    must hold across the restart, to the job."""
     clock = [0.0]
+    journal_dir = tmp_path / "recon-hive"
     hive = MiniHive(lease_s=5.0, max_attempts=3, max_jobs_per_poll=8,
-                    clock=lambda: clock[0])
+                    clock=lambda: clock[0],
+                    journal=(HiveJournal(journal_dir, fsync=False)
+                             if restart else None))
     n = 3000
     for i in range(n):
         hive.submit(_job(f"scale-{i}"))
@@ -338,6 +350,7 @@ def test_stats_reconciliation_exactly_once_at_harness_scale():
     salvaged = 0
     refusals = 0
     step = 0
+    restarted = False
 
     def record(result, worker):
         # mirror the salvage bookkeeping: ANY settle landing on an
@@ -353,6 +366,17 @@ def test_stats_reconciliation_exactly_once_at_harness_scale():
 
     while True:
         clock[0] += 0.5
+        if restart and not restarted and len(hive.completed) >= n // 2:
+            # the mid-churn crash (ISSUE 14): the live hive object is
+            # garbage from here — the replacement is rebuilt purely by
+            # journal replay, counters included, and the reconciliation
+            # below must hold across the epoch bump
+            hive.journal = None  # SIGKILL: nothing else ever commits
+            hive = MiniHive.recover(
+                HiveJournal(journal_dir, fsync=False),
+                lease_s=5.0, max_attempts=3, max_jobs_per_poll=8,
+                clock=lambda: clock[0])
+            restarted = True
         worker = workers[step % len(workers)]
         step += 1
         handed = hive._take_jobs(worker)
@@ -436,6 +460,14 @@ def test_stats_reconciliation_exactly_once_at_harness_scale():
     assert injected_dupes > 20 and late_uploads > 20 and refusals > 20
     assert salvaged > 0, "the salvage path never exercised"
     assert abandoned, "the abandonment path never exercised"
+    if restart:
+        # the crash actually happened, the replacement is a REPLAYED
+        # hive (epoch bumped, recovery counted), and every assertion
+        # above reconciled journal-rebuilt counters with live ones
+        assert restarted, "the mid-run hive restart never triggered"
+        assert hive.hive_epoch == 2
+        assert counter("chiaswarm_hive_recoveries_total") == 1
+        assert stats["journal"]["records_written"] > 0
 
 
 # ---------------------------------------------------------------------------

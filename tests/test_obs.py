@@ -539,6 +539,21 @@ def test_worker_serves_metrics_and_traces_endpoints():
     # ...the trace-ring eviction counter (ISSUE 13 satellite): present
     # at zero from scrape one so a scraper can alert on span loss...
     assert "chiaswarm_trace_spans_evicted_total 0" in body
+    # ...swarmdurable families (ISSUE 14): the dead-letter replay
+    # counter split by moment (live = hive healed mid-run, startup =
+    # the PR-2 worker-restart path) and the hive-session outage gauge —
+    # vocabularies pre-seeded from scrape one, and the healthy run
+    # above replayed nothing...
+    from chiaswarm_tpu.obs.metrics import DEAD_LETTER_REPLAY_WHEN
+
+    for when in DEAD_LETTER_REPLAY_WHEN:
+        assert (f'chiaswarm_dead_letter_replayed_total{{when="{when}"}} 0'
+                in body), when
+    assert "chiaswarm_hive_session_state 0" in body
+    assert "chiaswarm_hive_outages_total 0" in body
+    assert "chiaswarm_leases_assumed_lost_total 0" in body
+    assert health["hive_session"]["state"] == "online"
+    assert health["hive_epoch"] is None  # journal-less reference hive
     # ...phase latency histograms fed by the finished traces
     assert 'chiaswarm_job_phase_seconds_bucket{phase="upload",le="+Inf"}' \
         in body

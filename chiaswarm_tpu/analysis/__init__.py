@@ -42,8 +42,19 @@ R9    host-sync-reachability  R1 across module boundaries: host syncs
                               reachable from jit through the whole-program
                               call graph (full chain in the finding)
 R10   sharding-spec-drift     PartitionSpec/shard_map/collective axis names
-                              bound by a real mesh; in_specs arity matches
-                              the callee signature
+                              bound by THE mesh instance the site runs on
+                              (per-mesh-instance universes); in_specs arity
+                              matches the callee signature
+R11   replicated-psum         no psum/psum_scatter over an axis the operand
+                              is provably replicated on (the product is
+                              complete on every shard — the all-reduce
+                              multiplies by the axis size)
+R12   unreduced-out-spec      shard_map out_specs never claims replication
+                              over an axis the returned value still varies
+                              on (partial sums don't escape mislabeled)
+R13   donation-drift          a buffer donated to a jitted wrapper is never
+                              read after the call (compiled half: the HLO
+                              alias table kept the donation — shard_audit)
 ====  ======================  ===============================================
 
 **The project index** (``analysis/project.py``, "swarmflow"): R1-R8 are
@@ -62,13 +73,35 @@ carry a ``chain:`` trace (entry point -> ... -> sink) in text, ``--json``
 and ``--sarif`` output; the baseline key deliberately excludes the chain
 so grandfathered entries survive unrelated reroutes of intermediate hops.
 
+**The shardflow layer** (``analysis/shardflow.py``, "swarmproof"):
+R11/R12/R13 go one level deeper than the index's *facts* — an abstract
+interpreter over the summaries' flow IR tracks, per value, the set of
+mesh axes it varies over vs is replicated over (the vma lattice jax's
+``shard_map`` checker enforces at trace time), entering at every
+``shard_map`` site, binding ``in_specs`` to parameter abstractions,
+descending through the R9 call machinery (named callees, lambdas,
+``functools.partial``, ``lax.scan``/``while``/``fori``/``cond`` bodies,
+nested closures) with memoized per-context summaries, applying
+collective transfer functions (``psum``/``all_gather`` remove the axis;
+``ppermute`` keeps it; ``axis_index`` introduces it), and checking
+``out_specs`` claims on the way out. Mesh instances are resolved per
+site (``project.py`` records ``Mesh(...)`` literals as *closed*
+universes, ``MeshSpec``-built meshes as *open*), so distinct meshes are
+distinct domains. The analysis is two-sided (``may`` ⊇ ``must``) and
+conservative: anything unresolvable is silent. The compiled-side twin
+(``analysis/hlocheck.py`` + ``tools/shard_audit.py``) audits what XLA
+actually lowered — collective census, matmul dtype census, donation
+aliasing — against pinned per-program contracts
+(``tools/contracts/tiny.json`` in CI).
+
 Baseline workflow: first adoption of a rule grandfathers existing findings
 into ``.swarmlint-baseline.json`` (``--write-baseline``). New findings fail;
 fixing a baselined finding makes its entry stale, which fails under
 ``--strict`` until the entry is deleted — the baseline can only shrink.
 ``--changed-only`` lints just the files changed vs the merge base with
 origin/main plus their reverse-dependency closure from the import graph
-(pre-commit); ``--sarif FILE`` exports new findings for GitHub code
+(pre-commit; editing a mesh-defining module additionally re-lints every
+sharding consumer — axes travel through parameters, not imports); ``--sarif FILE`` exports new findings for GitHub code
 scanning with chains as codeFlows.
 """
 

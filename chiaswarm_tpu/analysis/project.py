@@ -44,7 +44,8 @@ from chiaswarm_tpu.analysis.rules import (
     JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes, resolves_to,
 )
 
-SCHEMA = 2  # v2: dispatch-table facts ("tables", "@table:" call targets)
+SCHEMA = 4  # v4: shardflow facts (mesh instances, spec axes, flow with
+#     conditional-arm "br" paths, donations)
 DEFAULT_CACHE_NAME = ".swarmflow-cache.json"
 
 #: cross-chip collective primitives and the axis-name argument position
@@ -60,6 +61,23 @@ _COLLECTIVES: dict[str, int] = {
 _SPEC_NAMES = ("jax.sharding.PartitionSpec", "PartitionSpec")
 _MESH_NAMES = ("jax.sharding.Mesh", "Mesh")
 _MESHSPEC_NAMES = ("MeshSpec",)
+_BUILD_MESH_NAMES = ("build_mesh",)
+
+
+def _donate_decl(call: ast.Call) -> tuple[list[int], list[str]]:
+    """donate_argnums / donate_argnames literals of a jit-wrapper call."""
+    from chiaswarm_tpu.analysis.rules.jit_hygiene import (
+        _int_elems, _str_elems,
+    )
+
+    nums: list[int] = []
+    names: list[str] = []
+    for k in call.keywords:
+        if k.arg == "donate_argnums":
+            nums = _int_elems(k.value)
+        elif k.arg == "donate_argnames":
+            names = _str_elems(k.value)
+    return nums, names
 
 
 # ---------------------------------------------------------------------------
@@ -210,6 +228,271 @@ class _Summarizer:
             return fn, consumed
         return self.resolve(node), consumed
 
+    def callee_with_kwargs(self, node: ast.AST
+                           ) -> tuple[str | None, int, dict]:
+        """Like :meth:`callable_target` but also surfaces the KEYWORD
+        axisrefs bound by ``functools.partial`` wrapping —
+        ``partial(ring_attention, axis_name=SEQ_AXIS)`` yields
+        ``("…ring_attention", 0, {"axis_name": {"ref": …}})`` so the
+        shardflow interpreter can bind the callee's axis parameter."""
+        consumed = 0
+        pkw: dict[str, Any] = {}
+        while isinstance(node, ast.Call):
+            fn = self.resolve(node.func)
+            if resolves_to(fn, "functools.partial", "partial") and node.args:
+                consumed += len(node.args) - 1
+                for k in node.keywords:
+                    if k.arg:
+                        refs = _axisref(k.value, self.resolve)
+                        pkw.setdefault(k.arg,
+                                       refs[0] if len(refs) == 1 else None)
+                node = node.args[0]
+                continue
+            return fn, consumed, pkw
+        return self.resolve(node), consumed, pkw
+
+    # -- expression encoding (shardflow flow IR) --------------------------
+    #
+    # Each function body is summarized as an ordered list of steps over a
+    # tiny JSON expression IR, enough for the abstract sharding
+    # interpreter (analysis/shardflow.py) to replay dataflow without the
+    # AST. Encodings:
+    #
+    #   {"n": name}              local variable reference
+    #   {"d": dotted}            import-resolved non-local reference
+    #   {"k": str|None}          constant (string constants kept: axis
+    #                            names assigned to locals must resolve)
+    #   {"t": [enc, …]}          tuple/list literal (unpack-aware)
+    #   {"u": [enc, …]}          union of sub-values (any operator)
+    #   {"alt": [enc, enc]}      either/or (IfExp): may=∪, must=∩
+    #   {"c": dotted, "x": […], "kwx": {…}, "ln": n[, "dn": […]]}
+    #                            call; "dn" = positions donated by an
+    #                            inline jit wrapper applied on the spot
+
+    _ENC_DEPTH = 14
+
+    def _enc_names(self, node: ast.AST) -> dict:
+        out = []
+        seen: set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id not in seen:
+                seen.add(n.id)
+                out.append({"d": self.aliases[n.id]}
+                           if n.id in self.aliases else {"n": n.id})
+        return {"u": out}
+
+    def _enc(self, node: ast.AST, depth: int = 0) -> dict:
+        if depth > self._ENC_DEPTH:
+            return self._enc_names(node)
+        e = lambda n: self._enc(n, depth + 1)  # noqa: E731
+        if isinstance(node, ast.Constant):
+            return {"k": node.value if isinstance(node.value, str) else None}
+        if isinstance(node, ast.Name):
+            if node.id in self.aliases:
+                return {"d": self.aliases[node.id]}
+            return {"n": node.id}
+        if isinstance(node, ast.Attribute):
+            dotted = self.resolve(node)
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in self.aliases \
+                    and dotted:
+                return {"d": dotted}
+            if isinstance(base, ast.Name):
+                # attribute of a local value (x.T, x.shape): the value's
+                # varying axes flow through, the attribute name doesn't
+                return {"u": [{"n": base.id}]}
+            return {"u": [e(base)]}
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return {"t": [e(x) for x in node.elts]}
+        if isinstance(node, ast.Starred):
+            return e(node.value)
+        if isinstance(node, ast.IfExp):
+            return {"alt": [e(node.body), e(node.orelse)]}
+        if isinstance(node, ast.Call):
+            return self._enc_call(node, depth)
+        if isinstance(node, ast.BinOp):
+            return {"u": [e(node.left), e(node.right)]}
+        if isinstance(node, ast.UnaryOp):
+            return {"u": [e(node.operand)]}
+        if isinstance(node, ast.BoolOp):
+            return {"u": [e(v) for v in node.values]}
+        if isinstance(node, ast.Compare):
+            return {"u": [e(node.left)] + [e(c) for c in node.comparators]}
+        if isinstance(node, ast.Subscript):
+            return {"u": [e(node.value), e(node.slice)]}
+        if isinstance(node, ast.Dict):
+            return {"u": [e(v) for v in node.values if v is not None]}
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return {"k": None}  # a function value carries no varying axes
+        return self._enc_names(node)
+
+    def _enc_call(self, node: ast.Call, depth: int) -> dict:
+        e = lambda n: self._enc(n, depth + 1)  # noqa: E731
+        func = node.func
+        # inline donating wrapper: toplevel_jit(f, donate_argnums=…)(x)
+        if isinstance(func, ast.Call):
+            inner_t = self.resolve(func.func)
+            if resolves_to(inner_t, *JIT_WRAPPERS):
+                nums, names = _donate_decl(func)
+                target = (self.resolve(func.args[0])
+                          if func.args else None)
+                rec: dict[str, Any] = {
+                    "c": target, "x": [e(a) for a in node.args],
+                    "kwx": {k.arg: e(k.value) for k in node.keywords
+                            if k.arg},
+                    "ln": node.lineno,
+                }
+                if nums or names:
+                    rec["dn"] = nums
+                    rec["dnn"] = names
+                return rec
+        target, consumed = self.callable_target(node)
+        if target is None or (isinstance(func, ast.Attribute)
+                              and not self._import_rooted(func)):
+            # method call on a value (x.astype(…)) or unresolvable
+            # callee: the result unions the receiver and every argument
+            parts = []
+            if isinstance(func, ast.Attribute):
+                parts.append(e(func))
+            elif target is None:
+                parts.append(e(func))
+            parts += [e(a) for a in node.args]
+            parts += [e(k.value) for k in node.keywords]
+            return {"u": parts}
+        return {
+            "c": target,
+            "x": [e(a) for a in node.args],
+            "kwx": {k.arg: e(k.value) for k in node.keywords if k.arg},
+            "ln": node.lineno,
+        }
+
+    def _import_rooted(self, node: ast.Attribute) -> bool:
+        base = node
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        return isinstance(base, ast.Name) and base.id in self.aliases
+
+    # -- flow steps --------------------------------------------------------
+    def _flow(self, info: FunctionInfo) -> list[dict]:
+        node = info.node
+        if isinstance(node, ast.Lambda):
+            return [{"ln": node.lineno, "r": self._enc(node.body)}]
+        steps: list[dict] = []
+
+        def stmt_targets(t: ast.AST) -> list[str]:
+            if isinstance(t, ast.Name):
+                return [t.id]
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out = []
+                for el in t.elts:
+                    out.extend(stmt_targets(el))
+                return out
+            if isinstance(t, ast.Starred):
+                return stmt_targets(t.value)
+            return []
+
+        def walk(body: list[ast.stmt],
+                 branch: tuple[str, ...] = ()) -> None:
+            # ``branch`` is the conditional-arm path of every step in
+            # this body: one "<line>:<arm>" element per enclosing
+            # If/loop/Try arm. Steps inside an arm carry it as "br" —
+            # the interpreter weak-updates (join, must cleared against
+            # prior bindings) instead of overwriting, and the donation
+            # pass refuses to chain across mutually exclusive arms.
+            def emit(step: dict) -> None:
+                if branch:
+                    step["br"] = list(branch)
+                steps.append(step)
+
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # separate scopes, separate flow entries
+                if isinstance(stmt, ast.Assign):
+                    tg: list[str] = []
+                    struct: ast.AST | None = None
+                    for t in stmt.targets:
+                        tg.extend(stmt_targets(t))
+                        struct = struct or t
+                    step = {"ln": stmt.lineno, "a": tg,
+                            "e": self._enc(stmt.value)}
+                    # remember the (single) target structure so tuple
+                    # unpacks can map elementwise
+                    if len(stmt.targets) == 1 and isinstance(
+                            stmt.targets[0], (ast.Tuple, ast.List)):
+                        step["tt"] = [stmt_targets(el) for el in
+                                      stmt.targets[0].elts]
+                    emit(step)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    emit({"ln": stmt.lineno,
+                          "a": stmt_targets(stmt.target),
+                          "e": self._enc(stmt.value)})
+                elif isinstance(stmt, ast.AugAssign):
+                    tg = stmt_targets(stmt.target)
+                    emit({"ln": stmt.lineno, "a": tg,
+                          "e": {"u": [self._enc(stmt.target),
+                                      self._enc(stmt.value)]}})
+                elif isinstance(stmt, ast.Return):
+                    emit({"ln": stmt.lineno,
+                          "r": (self._enc(stmt.value)
+                                if stmt.value is not None
+                                else {"k": None})})
+                elif isinstance(stmt, ast.Expr):
+                    emit({"ln": stmt.lineno,
+                          "e": self._enc(stmt.value)})
+                elif isinstance(stmt, ast.For):
+                    # loop body and else BOTH execute on a completed
+                    # loop: non-exclusive "b"/"e" arms (still
+                    # conditional — zero iterations skip the body)
+                    emit({"ln": stmt.lineno,
+                          "a": stmt_targets(stmt.target),
+                          "e": {"u": [self._enc(stmt.iter)]}})
+                    walk(stmt.body, branch + (f"{stmt.lineno}:b",))
+                    walk(stmt.orelse, branch + (f"{stmt.lineno}:e",))
+                    continue
+                elif isinstance(stmt, ast.If):
+                    # numeric arms: truly mutually exclusive
+                    emit({"ln": stmt.lineno,
+                          "e": self._enc(stmt.test)})
+                    walk(stmt.body, branch + (f"{stmt.lineno}:0",))
+                    walk(stmt.orelse, branch + (f"{stmt.lineno}:1",))
+                    continue
+                elif isinstance(stmt, ast.While):
+                    emit({"ln": stmt.lineno,
+                          "e": self._enc(stmt.test)})
+                    walk(stmt.body, branch + (f"{stmt.lineno}:b",))
+                    walk(stmt.orelse, branch + (f"{stmt.lineno}:e",))
+                    continue
+                elif isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        tg = (stmt_targets(item.optional_vars)
+                              if item.optional_vars is not None else [])
+                        emit({"ln": stmt.lineno, "a": tg,
+                              "e": self._enc(item.context_expr)})
+                    walk(stmt.body, branch)
+                    continue
+                elif isinstance(stmt, ast.Try):
+                    # the try body may execute partially and its
+                    # handler runs AFTER it — body "b" and handlers
+                    # "h<i>" are non-exclusive arms (a donation in the
+                    # body is live in the handler); SIBLING handlers
+                    # are exclusive with each other; orelse shares the
+                    # body's arm; finally always runs
+                    walk(stmt.body, branch + (f"{stmt.lineno}:b",))
+                    for i, h in enumerate(stmt.handlers):
+                        walk(h.body, branch + (f"{stmt.lineno}:h{i}",))
+                    walk(stmt.orelse,
+                         branch + (f"{stmt.lineno}:b",))
+                    walk(stmt.finalbody, branch)
+                    continue
+                elif isinstance(stmt, (ast.Assert, ast.Raise, ast.Delete)):
+                    continue
+        walk(node.body)
+        return steps
+
     # -- summary ----------------------------------------------------------
     def summarize(self) -> dict:
         ctx = self.ctx
@@ -232,7 +515,10 @@ class _Summarizer:
             "names": by_name,
         }
         summary.update(self._jit_entries(ctx, functions))
+        self._collect_spec_vars(ctx.tree)
         summary.update(self._sharding_facts(ctx))
+        summary["meshes"] = self._mesh_instances(ctx)
+        summary["donations"] = self._donations(ctx)
         return summary
 
     def _func_summary(self, info: FunctionInfo) -> dict:
@@ -264,6 +550,7 @@ class _Summarizer:
             "calls": calls,
             "methods": methods,
             "sync": sync,
+            "flow": self._flow(info),
         }
 
     def _calls(self, info: FunctionInfo) -> tuple[list[dict], list[str]]:
@@ -434,6 +721,200 @@ class _Summarizer:
                         roots.extend(local)
         return {"jit_roots": sorted(set(roots)), "jit_refs": refs}
 
+    # -- spec / mesh variable maps (shardflow) ----------------------------
+    def _collect_spec_vars(self, tree: ast.Module) -> None:
+        """Map (enclosing symbol, var) -> axes facts for local
+        ``spec = P(…)`` and ``ms = MeshSpec({…})`` assignments, so
+        shard_map sites that pass specs/meshes through variables still
+        resolve (ops/attention.py's ``in_specs=(spec, spec, spec)``)."""
+        self._spec_vars: dict[tuple[str, str], dict] = {}
+        self._meshspec_vars: dict[tuple[str, str], list[dict]] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            key = (self.ctx.symbol_for(node), node.targets[0].id)
+            t, _ = self.callable_target(node.value)
+            if resolves_to(t, *_SPEC_NAMES):
+                self._spec_vars[key] = self._spec_axes(node.value)
+            elif resolves_to(t, *_MESHSPEC_NAMES):
+                axes = self._meshspec_axes(node.value)
+                if axes is not None:
+                    self._meshspec_vars[key] = axes
+
+    def _spec_axes(self, call: ast.Call) -> dict:
+        """Per-spec {"may": axisrefs, "must": axisrefs}: ``may`` is every
+        axis the spec can mention; ``must`` only the unconditional
+        dimensions (an ``IfExp`` dim contributes to may alone)."""
+        may: list[dict] = []
+        must: list[dict] = []
+
+        def add(refs, into):
+            for r in refs:
+                if r not in into:
+                    into.append(r)
+
+        for dim in call.args:
+            refs = _axisref(dim, self.resolve)
+            add(refs, may)
+            if isinstance(dim, (ast.Constant, ast.Name, ast.Attribute,
+                                ast.Tuple, ast.List)):
+                add(refs, must)
+        return {"may": may, "must": must}
+
+    def _spec_axes_of(self, node: ast.AST, symbol: str) -> dict | None:
+        """Axes facts of one in_specs element: a P(…) call, a local spec
+        variable, or None (replicated). None return = unresolvable."""
+        if isinstance(node, ast.Constant) and node.value is None:
+            return {"may": [], "must": []}
+        if isinstance(node, ast.Call):
+            t, _ = self.callable_target(node)
+            if resolves_to(t, *_SPEC_NAMES):
+                return self._spec_axes(node)
+            return None
+        if isinstance(node, ast.Name):
+            for key in ((symbol, node.id), ("<module>", node.id)):
+                if key in self._spec_vars:
+                    return self._spec_vars[key]
+        return None
+
+    def _out_axes(self, node: ast.AST, symbol: str) -> dict | None:
+        """Aggregate {"may": axisrefs} over a whole out_specs expression
+        (tuples of specs union). None = some element unresolvable, and
+        the unreduced-out-spec check must stay silent."""
+        got = self._spec_axes_of(node, symbol)
+        if got is not None:
+            return {"may": got["may"]}
+        if isinstance(node, (ast.Tuple, ast.List)):
+            may: list[dict] = []
+            for el in node.elts:
+                sub = self._out_axes(el, symbol)
+                if sub is None:
+                    return None
+                for r in sub["may"]:
+                    if r not in may:
+                        may.append(r)
+            return {"may": may}
+        return None
+
+    def _meshspec_axes(self, call: ast.Call) -> list[dict] | None:
+        kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+        shape = kwargs.get("shape")
+        if shape is None and call.args:
+            shape = call.args[0]
+        if isinstance(shape, ast.Dict):
+            axes: list[dict] = []
+            for key in shape.keys:
+                if key is not None:
+                    axes.extend(_axisref(key, self.resolve))
+            return axes
+        return None
+
+    def _mesh_axes_from_call(self, call: ast.Call,
+                             symbol: str) -> tuple[list[dict], bool] | None:
+        """(axes refs, open) of a mesh-producing call, or None.
+
+        ``open`` is True for ``MeshSpec``-derived meshes: core/mesh.py's
+        ``build_mesh`` materializes EVERY axis in the (default) axis
+        order at size >= 1, so unmentioned vocabulary axes still exist on
+        the mesh and must not be flagged against it. A raw
+        ``Mesh(devices, axis_names)`` literal is closed — its axis_names
+        are exactly the universe."""
+        t, _ = self.callable_target(call)
+        kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+        if resolves_to(t, *_MESH_NAMES):
+            ax = kwargs.get("axis_names")
+            if ax is None and len(call.args) >= 2:
+                ax = call.args[1]
+            if ax is not None:
+                return _axisref(ax, self.resolve), False
+            return None
+        if resolves_to(t, *_MESHSPEC_NAMES):
+            axes = self._meshspec_axes(call)
+            return (axes, True) if axes is not None else None
+        if resolves_to(t, *_BUILD_MESH_NAMES):
+            spec = kwargs.get("spec")
+            if spec is None and call.args:
+                spec = call.args[0]
+            if isinstance(spec, ast.Call):
+                return self._mesh_axes_from_call(spec, symbol)
+            if isinstance(spec, ast.Name):
+                for key in ((symbol, spec.id), ("<module>", spec.id)):
+                    if key in self._meshspec_vars:
+                        return self._meshspec_vars[key], True
+        return None
+
+    def _mesh_instances(self, ctx: ModuleContext) -> list[dict]:
+        """Named mesh constructions: ``mesh = Mesh(…)`` /
+        ``mesh = build_mesh(MeshSpec({…}))`` — the per-mesh-instance axis
+        universes the R10 extension and the shardflow interpreter bind
+        shard_map sites against."""
+        out: list[dict] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            symbol = ctx.symbol_for(node)
+            got = self._mesh_axes_from_call(node.value, symbol)
+            if got is None:
+                continue
+            axes, open_ = got
+            out.append({"var": node.targets[0].id, "symbol": symbol,
+                        "line": node.lineno, "axes": axes, "open": open_})
+        return out
+
+    def _mesh_ref(self, node: ast.AST | None, symbol: str) -> dict | None:
+        """How a shard_map site names its mesh: a local/module variable
+        ({"name"}), an import-resolved path ({"ref"}), an inline
+        construction ({"axes", "open"}), or None (unresolvable — the
+        per-instance checks fall back to the global universe)."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in self.aliases:
+                return {"ref": self.aliases[node.id]}
+            return {"name": node.id}
+        if isinstance(node, ast.Attribute):
+            dotted = self.resolve(node)
+            if dotted and self._import_rooted(node):
+                return {"ref": dotted}
+            return None
+        if isinstance(node, ast.Call):
+            got = self._mesh_axes_from_call(node, symbol)
+            if got is not None:
+                axes, open_ = got
+                return {"axes": axes, "open": open_, "line": node.lineno}
+        return None
+
+    # -- donation facts (R13) ---------------------------------------------
+    def _donations(self, ctx: ModuleContext) -> list[dict]:
+        """jit-wrapper call sites that declare buffer donation, plus the
+        variable the wrapper is bound to (``STEP = toplevel_jit(step,
+        donate_argnums=(0,))``) so use-after-donate tracks the wrapper
+        across modules through exports."""
+        out: list[dict] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t, _ = self.callable_target(node)
+            if not resolves_to(t, *JIT_WRAPPERS):
+                continue
+            nums, names = _donate_decl(node)
+            if not nums and not names:
+                continue
+            var = None
+            parent = self.ctx.parents.get(node)
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                    and isinstance(parent.targets[0], ast.Name):
+                var = parent.targets[0].id
+            fname = self.resolve(node.args[0]) if node.args else None
+            out.append({"line": node.lineno, "col": node.col_offset,
+                        "symbol": ctx.symbol_for(node), "var": var,
+                        "fname": fname, "nums": nums, "names": names})
+        return out
+
     # -- sharding facts ----------------------------------------------------
     def _sharding_facts(self, ctx: ModuleContext) -> dict:
         mesh_axes: list[dict] = []
@@ -479,10 +960,13 @@ class _Summarizer:
             elif resolves_to(t, "shard_map"):
                 callee = None
                 pconsumed = 0
+                pkw: dict[str, Any] = {}
                 if node.args:
-                    callee, pconsumed = self.callable_target(node.args[0])
+                    callee, pconsumed, pkw = self.callee_with_kwargs(
+                        node.args[0])
                 rec: dict[str, Any] = {**loc, "callee": callee,
                                        "pconsumed": pconsumed,
+                                       "pkw": pkw,
                                        "in_arity": None}
                 if node.args and isinstance(node.args[0], ast.Lambda):
                     la = node.args[0].args
@@ -491,9 +975,29 @@ class _Summarizer:
                         "ndef": len(la.defaults),
                         "vararg": la.vararg is not None,
                     }
+                    info = ctx._func_by_node.get(node.args[0])
+                    if info is not None:
+                        rec["callee_lam"] = info.qualname
+                symbol = loc["symbol"]
+                mesh = kwargs.get("mesh")
+                if mesh is None and len(node.args) >= 2:
+                    mesh = node.args[1]
+                rec["mesh"] = self._mesh_ref(mesh, symbol)
                 in_specs = kwargs.get("in_specs")
+                if in_specs is None and len(node.args) >= 3:
+                    in_specs = node.args[2]
                 if isinstance(in_specs, (ast.Tuple, ast.List)):
                     rec["in_arity"] = len(in_specs.elts)
+                    rec["in_axes"] = [self._spec_axes_of(el, symbol)
+                                      for el in in_specs.elts]
+                elif in_specs is not None:
+                    # a single spec (pytree prefix): applies to every arg
+                    rec["in_single"] = self._spec_axes_of(in_specs, symbol)
+                out_specs = kwargs.get("out_specs")
+                if out_specs is None and len(node.args) >= 4:
+                    out_specs = node.args[3]
+                if out_specs is not None:
+                    rec["out_axes"] = self._out_axes(out_specs, symbol)
                 shard_maps.append(rec)
             else:
                 resolved_op = None
@@ -844,12 +1348,24 @@ class ProjectIndex:
 
     def reverse_closure(self, seeds: Iterable[str]) -> set[str]:
         """``seeds`` (relpaths) plus every file that transitively imports
-        one of them — the set a pre-commit run must re-lint."""
+        one of them — the set a pre-commit run must re-lint.
+
+        Mesh-constant provenance rides on top of the import graph: a
+        module that DEFINES mesh vocabulary (mesh instances or axis
+        constants) is consumed by every module with sharding facts even
+        when no import edge exists (``parallel/ring_attention.py`` reads
+        its axis through a parameter, never importing ``core/mesh.py``) —
+        so editing a mesh-defining seed re-lints every sharding consumer,
+        and the R10–R12 verdicts can never go stale under
+        ``--changed-only``."""
         rdeps: dict[str, set[str]] = {}
         for rel in self.summaries:
             for dep in self.module_deps(rel):
                 rdeps.setdefault(dep, set()).add(rel)
         out = {s for s in seeds if s in self.summaries}
+        if any(self._defines_mesh(rel) for rel in out):
+            out |= {rel for rel in self.summaries
+                    if self._consumes_sharding(rel)}
         frontier = list(out)
         while frontier:
             rel = frontier.pop()
@@ -858,6 +1374,79 @@ class ProjectIndex:
                     out.add(dependent)
                     frontier.append(dependent)
         return out
+
+    def _defines_mesh(self, rel: str) -> bool:
+        s = self.summaries[rel]
+        return bool(s.get("meshes") or s.get("mesh_axes"))
+
+    def _consumes_sharding(self, rel: str) -> bool:
+        s = self.summaries[rel]
+        return bool(s.get("specs") or s.get("shard_maps")
+                    or s.get("collectives"))
+
+    # -- mesh instances (per-mesh-instance universes, R10 extension) -------
+    def _mesh_var(self, module: str, var: str,
+                  symbol: str | None = None,
+                  _seen: frozenset = frozenset()) -> dict | None:
+        """A mesh definition record for ``var`` in ``module``: prefer the
+        definition inside ``symbol``'s scope, else module scope, else
+        follow a top-level re-export of the name."""
+        if (module, var) in _seen:
+            return None
+        _seen = _seen | {(module, var)}
+        rel = self.modules.get(module)
+        if rel is None:
+            return None
+        s = self.summaries[rel]
+        hits = [m for m in s.get("meshes", ()) if m["var"] == var]
+        for want in ([symbol] if symbol else []) + ["<module>"]:
+            for m in hits:
+                if m["symbol"] == want:
+                    return dict(m, module=module, rel=rel)
+        target = s["exports"].get(var)
+        if target and "." in target:
+            head, _, tail = target.rpartition(".")
+            got = self.resolve_qual(head)
+            if got and got[0] == "module":
+                return self._mesh_var(got[1], tail, None, _seen)
+        return None
+
+    def resolve_mesh(self, module: str, symbol: str,
+                     meshref: dict | None) -> dict | None:
+        """Resolve a shard_map site's mesh reference to an instance:
+        ``{"axes": set[str], "open": bool, "hop": (rel, line, qual)}``.
+        None = unresolvable — callers fall back to the global universe.
+        Instances with any unresolvable axis ref resolve to None (a
+        partial universe would produce indefensible findings)."""
+        if not meshref:
+            return None
+        rec = None
+        owner = module
+        if "name" in meshref:
+            rec = self._mesh_var(module, meshref["name"], symbol)
+        elif "ref" in meshref:
+            dotted = meshref["ref"]
+            head, _, tail = dotted.rpartition(".")
+            got = self.resolve_qual(head) if head else None
+            if got and got[0] == "module":
+                rec = self._mesh_var(got[1], tail, None)
+        elif "axes" in meshref:
+            rec = {"axes": meshref["axes"], "open": meshref.get("open", True),
+                   "module": module, "rel": self.modules.get(module),
+                   "line": meshref.get("line", 0), "var": "<inline>",
+                   "symbol": symbol}
+        if rec is None:
+            return None
+        owner = rec["module"]
+        axes: set[str] = set()
+        for ref in rec["axes"]:
+            v = self.resolve_axis(ref, owner)
+            if v is None:
+                return None
+            axes.add(v)
+        hop = (rec.get("rel") or self.modules.get(owner, ""),
+               rec.get("line", 0), f"{owner}.{rec.get('var', '?')}")
+        return {"axes": axes, "open": bool(rec.get("open")), "hop": hop}
 
     # -- misc --------------------------------------------------------------
     def axis_universe(self) -> dict[str, list[str]]:

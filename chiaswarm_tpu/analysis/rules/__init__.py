@@ -81,6 +81,7 @@ from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
     recompile,
     scan_carry,
     sharding_drift,
+    shardflow_rules,
     sync_reach,
     wallclock,
 )

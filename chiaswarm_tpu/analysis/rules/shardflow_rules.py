@@ -1,0 +1,84 @@
+"""R11/R12/R13 — the shardflow value-semantics rules (swarmproof).
+
+R10 checks that axis names are *spelled* right; these three check that
+sharding is *meant* right, via the abstract vma interpreter in
+``analysis/shardflow.py`` (see its module docstring for the domain and
+transfer functions):
+
+- **R11 replicated-psum** — a ``psum``/``psum_scatter`` over an axis the
+  operand is provably replicated on: the product is already complete on
+  every shard, so the all-reduce multiplies it by the axis size. This is
+  the static face of the r06-bisected seq-parallel divergence (K/V
+  projections of a replicated text ctx coming out exactly ``seq``× too
+  large under a two-axis shard_map).
+- **R12 unreduced-out-spec** — a shard_map ``out_specs`` claiming
+  replication over an axis the returned value still (provably) varies
+  on: a per-shard partial value escapes the boundary mislabeled as
+  replicated.
+- **R13 donation-drift** — a buffer donated at a jit-wrapper call site
+  (``donate_argnums``/``donate_argnames``, wrapper possibly built in
+  another module and followed through re-exports) that the caller reads
+  after the call: XLA has reused its memory. The compiled-side half
+  (declared donation the lowered HLO shows undonated) lives in
+  ``analysis/hlocheck.py`` / ``tools/shard_audit.py`` and reports under
+  the same rule name.
+
+All three are conservative: unresolvable specs, meshes, axes or callees
+are silent — a lint must not invent semantics it cannot defend.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from chiaswarm_tpu.analysis.core import Finding, ProjectRule, register
+
+if TYPE_CHECKING:  # the index arrives at check time; no runtime dep
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+
+@register
+class ReplicatedPsum(ProjectRule):
+    code = "R11"
+    name = "replicated-psum"
+    description = ("psum/psum_scatter over an axis the operand is "
+                   "provably replicated on multiplies by the axis size "
+                   "(abstract vma interpretation, whole-program)")
+
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        from chiaswarm_tpu.analysis import shardflow
+
+        for f in shardflow.results(index).findings:
+            if f.rule == self.name:
+                yield f
+
+
+@register
+class UnreducedOutSpec(ProjectRule):
+    code = "R12"
+    name = "unreduced-out-spec"
+    description = ("shard_map out_specs claiming replication over an "
+                   "axis the returned value still varies on — a partial "
+                   "sum escapes the boundary (abstract vma "
+                   "interpretation)")
+
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        from chiaswarm_tpu.analysis import shardflow
+
+        for f in shardflow.results(index).findings:
+            if f.rule == self.name:
+                yield f
+
+
+@register
+class DonationDrift(ProjectRule):
+    code = "R13"
+    name = "donation-drift"
+    description = ("a buffer donated to a jitted wrapper "
+                   "(donate_argnums, wrapper resolved across modules) "
+                   "is read after the call — XLA reused its memory")
+
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        from chiaswarm_tpu.analysis import shardflow
+
+        yield from shardflow.donation_findings(index)

@@ -65,6 +65,8 @@ class ShardingSpecDrift(ProjectRule):
             yield from self._unknown_axes(index, s, universe, known)
             for rec in s["shard_maps"]:
                 yield from self._arity(index, module, rel, rec)
+                yield from self._instance(index, module, rel, rec,
+                                          universe)
         yield from self._collectives(index, universe, known)
 
     # ---- unknown axis names in specs -----------------------------------
@@ -130,6 +132,55 @@ class ShardingSpecDrift(ProjectRule):
             symbol=rec["symbol"],
             chain=(caller_hop, callee_hop),
         )
+
+    # ---- per-mesh-instance axis universes (swarmproof extension) -------
+    def _instance(self, index, module, rel, rec,
+                  universe) -> Iterator[Finding]:
+        """Axis names in a shard_map's in/out specs must be bound by THE
+        mesh instance the site runs on, not merely by *some* mesh in the
+        project — a ``data``-only ``Mesh`` literal does not sanction
+        ``seq`` specs just because an unrelated ``seq`` mesh exists.
+
+        Only CLOSED instances (raw ``Mesh(devices, axis_names)``
+        literals) constrain the check: ``MeshSpec``-built meshes carry
+        every vocabulary axis at size >= 1 (core/mesh.py), so any
+        project-known axis is legal on them. Axes unknown to the whole
+        project are already reported by the global check — this one only
+        fires on names the global universe KNOWS but this instance does
+        not bind, which is exactly the R10 imprecision the per-instance
+        extension retires."""
+        inst = index.resolve_mesh(module, rec["symbol"], rec.get("mesh"))
+        if inst is None or inst["open"]:
+            return
+        specs = list(rec.get("in_axes") or [])
+        if rec.get("in_single") is not None:
+            specs.append(rec["in_single"])
+        if rec.get("out_axes") is not None:
+            specs.append(rec["out_axes"])
+        caller_hop = (rel, rec["line"], f"{module}.{rec['symbol']}")
+        flagged: set[str] = set()
+        for spec in specs:
+            if spec is None:
+                continue
+            for ref in spec["may"]:
+                axis = index.resolve_axis(ref, module)
+                if axis is None or axis in flagged:
+                    continue
+                if axis in universe and axis not in inst["axes"]:
+                    flagged.add(axis)
+                    have = ", ".join(sorted(inst["axes"])) or "none"
+                    yield Finding(
+                        rule=self.name, path=rel,
+                        line=rec["line"], col=rec["col"],
+                        message=(f"shard_map spec uses axis {axis!r} "
+                                 f"but its mesh instance "
+                                 f"'{inst['hop'][2]}' binds only "
+                                 f"[{have}] — another mesh defining "
+                                 f"{axis!r} elsewhere does not apply "
+                                 f"here"),
+                        symbol=rec["symbol"],
+                        chain=(caller_hop, inst["hop"]),
+                    )
 
     # ---- collectives reading parameter-borne axis names ----------------
     def _collectives(self, index, universe, known) -> Iterator[Finding]:

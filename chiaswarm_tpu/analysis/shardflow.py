@@ -1,0 +1,869 @@
+"""shardflow — abstract sharding interpreter over the swarmflow index.
+
+The GSPMD divergence family (ROADMAP item 1) is a *value-semantics* bug
+class: a replicated operand crosses a two-axis ``shard_map`` boundary,
+gets multiplied into a product that is already complete on every shard,
+and an ``all-reduce`` over the second axis then multiplies the result by
+the axis size (the r06 bisect's exact ``seq``× K/V blow-up). R10 checks
+axis-name *spelling*; nothing checked axis *semantics*. This module runs
+the same varying-axes discipline jax's own shard_map vma checker applies
+at trace time — as a whole-program static pass over the swarmflow
+project index, no jax import, no tracing.
+
+**The abstract domain.** Every value is abstracted to the set of mesh
+axes it *varies over* (distinct per-shard content) vs is *replicated
+over* (identical on every shard along that axis) — the vma lattice. The
+analysis is may/must two-sided so one-sided conclusions stay sound under
+conditional specs (``P(DATA if b % dp == 0 else None, SEQ, …)``):
+
+- ``may``: upper bound — axes the value *can* vary over. An axis outside
+  ``may`` is **provably replicated**: summing it with ``psum`` multiplies
+  by the axis size (rule R11 ``replicated-psum``).
+- ``must``: lower bound — axes the value *definitely* varies over. An
+  axis inside ``must`` that the site's ``out_specs`` claims replicated,
+  with no collective having reduced it, escapes as a partial sum /
+  per-shard value mislabeled replicated (rule R12 ``unreduced-out-spec``).
+
+**Transfer functions** (mirroring shard_map's vma rules):
+
+- ``in_specs`` bind a parameter's axes: mentioned axes → varying,
+  unmentioned mesh axes → replicated. Conditional dims contribute to
+  ``may`` only.
+- arithmetic / unknown ops: union (varying is infectious).
+- ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``/``psum_scatter``
+  over axis *a*: *a* leaves the varying sets (the result is identical on
+  every shard along *a*).
+- ``ppermute``/``pshuffle``/``all_to_all``: varying sets unchanged.
+- ``axis_index(a)``: introduces {*a*}.
+- either/or joins (``IfExp``): ``may`` unions, ``must`` intersects.
+- closures and ``functools.partial``-bound operands: replicated (shard_map
+  broadcasts captured values — which is exactly why a psum over them is
+  the 4.000× mislabel).
+
+**Per-mesh-instance universes** (the carried R10 extension): each
+``Mesh(…)`` literal / ``build_mesh(MeshSpec({…}))`` assignment is its own
+axis universe, resolved per shard_map site through locals, module
+constants and re-exports — a ``data``×``seq`` mesh and a pure-``seq``
+mesh are distinct domains, so the family signature "one sharded axis
+fine, two axes wrong" is expressible, and axis names from unrelated
+meshes no longer pool into one global soup. ``MeshSpec``-derived meshes
+are *open* (core/mesh.py materializes every vocabulary axis at size ≥ 1);
+raw ``Mesh`` literals are *closed*.
+
+Interpretation enters at every ``shard_map`` site, descends through the
+R9 call-graph machinery (named callees, lambdas, ``functools.partial``,
+``jax.lax.scan``/``while_loop``/``fori_loop``/``cond`` bodies, nested
+closures) with memoized per-context summaries, and reports findings with
+full entry → sink chains.
+
+R13 ``donation-drift`` rides the same flow IR: a buffer donated at a
+jit-wrapper call site (``donate_argnums``/``donate_argnames``, declared
+where the wrapper is built — possibly another module, followed through
+re-exports) that the caller READS after the call is garbage on TPU; the
+compiled-side twin (``analysis/hlocheck.py``) verifies declared donation
+actually materialized in the lowered program's aliasing table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable
+
+from chiaswarm_tpu.analysis.core import Finding
+from chiaswarm_tpu.analysis.project import _COLLECTIVES, ProjectIndex
+from chiaswarm_tpu.analysis.rules import resolves_to
+
+R11 = "replicated-psum"
+R12 = "unreduced-out-spec"
+R13 = "donation-drift"
+
+#: collectives whose result is invariant over the named axis
+_REMOVES_AXIS = ("jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax",
+                 "jax.lax.pmin", "jax.lax.all_gather",
+                 "jax.lax.psum_scatter")
+#: sum-like reductions where reducing an already-invariant value
+#: multiplies it by the axis size — the exact r06 mislabel
+_R11_OPS = ("jax.lax.psum", "jax.lax.psum_scatter")
+#: collectives that move shards around but keep the value varying
+_KEEPS_AXIS = ("jax.lax.ppermute", "jax.lax.pshuffle",
+               "jax.lax.all_to_all")
+
+_MAX_DEPTH = 10
+
+
+# ---------------------------------------------------------------------------
+# the abstract domain
+
+
+@dataclasses.dataclass(frozen=True)
+class VMA:
+    """Varying-mesh-axes abstraction of one value: ``may`` ⊇ ``must``."""
+
+    may: frozenset[str] = frozenset()
+    must: frozenset[str] = frozenset()
+
+    @staticmethod
+    def empty() -> "VMA":
+        return _EMPTY
+
+    @staticmethod
+    def top(universe: Iterable[str]) -> "VMA":
+        return VMA(may=frozenset(universe))
+
+    @staticmethod
+    def combine(*vmas: "VMA") -> "VMA":
+        """Arithmetic/dataflow meet: varying is infectious on both sides
+        (if either operand definitely varies, the result does)."""
+        may: frozenset[str] = frozenset()
+        must: frozenset[str] = frozenset()
+        for v in vmas:
+            may |= v.may
+            must |= v.must
+        return VMA(may, must)
+
+    @staticmethod
+    def join(a: "VMA", b: "VMA") -> "VMA":
+        """Either/or control join: ``may`` unions, ``must`` intersects."""
+        return VMA(a.may | b.may, a.must & b.must)
+
+    def remove(self, axis: str) -> "VMA":
+        return VMA(self.may - {axis}, self.must - {axis})
+
+    def introduce(self, axis: str) -> "VMA":
+        return VMA(self.may | {axis}, self.must | {axis})
+
+
+_EMPTY = VMA()
+
+
+class _State:
+    """Per-function environment: name → VMA, name → axis string, with an
+    outer chain for nested closures (a scan body reading the enclosing
+    function's ``q`` / ``axis_name``)."""
+
+    def __init__(self, env: dict[str, VMA], axes: dict[str, str],
+                 outer: "_State | None" = None):
+        self.env = env
+        self.axes = axes
+        self.outer = outer
+
+    def lookup(self, name: str) -> VMA | None:
+        st: _State | None = self
+        while st is not None:
+            if name in st.env:
+                return st.env[name]
+            st = st.outer
+        return None
+
+    def axis_of(self, name: str) -> str | None:
+        st: _State | None = self
+        while st is not None:
+            if name in st.axes:
+                return st.axes[name]
+            st = st.outer
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+
+
+@dataclasses.dataclass
+class _SiteCtx:
+    """Interpretation context for one function activation."""
+
+    module: str
+    qual: str
+    rel: str
+    universe: frozenset[str]
+    chain: tuple[tuple[str, int, str], ...]
+    depth: int
+
+
+class ShardflowAnalysis:
+    """One run over the index: interprets every shard_map site and
+    collects R11/R12 findings. Rules share a single analysis via
+    :func:`results`."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.findings: list[Finding] = []
+        self._seen: set[tuple] = set()
+        self._memo: dict[tuple, VMA] = {}
+        self._active: set[tuple] = set()
+        self._global_universe = frozenset(index.axis_universe())
+
+    # -- entry -------------------------------------------------------------
+    def run(self) -> "ShardflowAnalysis":
+        for rel in sorted(self.index.summaries):
+            s = self.index.summaries[rel]
+            for rec in s.get("shard_maps", ()):
+                self._site(rel, s, rec)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self
+
+    def _emit(self, finding: Finding) -> None:
+        key = (finding.rule, finding.path, finding.line, finding.col,
+               finding.message)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.findings.append(finding)
+
+    # -- per-site ----------------------------------------------------------
+    def _site(self, rel: str, s: dict, rec: dict) -> None:
+        module = s["module"]
+        inst = self.index.resolve_mesh(module, rec["symbol"],
+                                       rec.get("mesh"))
+        if inst is None:
+            universe = self._global_universe
+        elif inst["open"]:
+            # open (MeshSpec-built) meshes carry the whole vocabulary;
+            # widening to the global universe keeps TOP conservative
+            universe = frozenset(inst["axes"]) | self._global_universe
+        else:
+            universe = frozenset(inst["axes"])
+        if not universe:
+            return  # no meshes anywhere: nothing to vary over
+
+        callee = self._site_callee(module, rec)
+        if callee is None:
+            return
+        f = self.index.funcs.get(callee)
+        arity = len(f["pargs"]) if f else 0
+        params = self._bind_params(module, rec, universe, arity)
+        if params is None:
+            return
+        args, axes_kw = params
+        site_hop = (rel, rec["line"], f"{module}.{rec['symbol']}")
+        ret = self._interpret(callee, args, {}, axes_kw, universe,
+                              (site_hop,), 0, outer=None)
+
+        # R12: the site's out_specs claim replication over an axis the
+        # returned value still (provably) varies on
+        out = rec.get("out_axes")
+        if out is None or ret is None:
+            return
+        out_may: set[str] = set()
+        for ref in out["may"]:
+            v = self.index.resolve_axis(ref, module)
+            if v is None:
+                return  # unresolvable out spec: stay silent
+            out_may.add(v)
+        leaked = sorted((ret.must & universe) - out_may)
+        if leaked:
+            f = self.index.funcs.get(callee)
+            callee_hop = (self.index.modules[callee[0]],
+                          f["line"] if f else 0,
+                          f"{callee[0]}.{callee[1]}")
+            self._emit(Finding(
+                rule=R12, path=rel, line=rec["line"], col=rec["col"],
+                message=(f"out_specs claims replication over "
+                         f"{'/'.join(repr(a) for a in leaked)} but the "
+                         f"returned value still varies over "
+                         f"{'/'.join(repr(a) for a in leaked)} — a "
+                         f"per-shard partial value escapes mislabeled as "
+                         f"replicated (reduce it with psum/all_gather or "
+                         f"shard the out spec)"),
+                symbol=rec["symbol"],
+                chain=(site_hop, callee_hop),
+            ))
+
+    def _bind_params(self, module: str, rec: dict,
+                     universe: frozenset[str], arity: int,
+                     ) -> tuple[list[VMA], dict[str, str]] | None:
+        """Positional VMAs from in_specs plus axis-string kwargs from
+        functools.partial wrapping. None = no spec facts at all."""
+        top = VMA.top(universe)
+
+        def of(spec: dict | None) -> VMA:
+            if spec is None:
+                return top
+            may: set[str] = set()
+            must: set[str] = set()
+            for ref in spec["may"]:
+                v = self.index.resolve_axis(ref, module)
+                if v is None:
+                    return top  # unresolvable axis: assume anything
+                may.add(v)
+            for ref in spec["must"]:
+                v = self.index.resolve_axis(ref, module)
+                if v is not None:
+                    must.add(v)
+            return VMA(frozenset(may) & universe,
+                       frozenset(must) & universe)
+
+        args: list[VMA]
+        if rec.get("in_axes") is not None:
+            args = [of(spec) for spec in rec["in_axes"]]
+        elif rec.get("in_single") is not None:
+            one = of(rec["in_single"])
+            # pytree-prefix spec: applies to every callee parameter
+            args = [one] * max(arity, 1)
+        else:
+            return None
+        # partial-bound leading positionals are closures: replicated
+        args = [VMA.empty()] * rec.get("pconsumed", 0) + args
+
+        axes_kw: dict[str, str] = {}
+        for name, ref in (rec.get("pkw") or {}).items():
+            v = self.index.resolve_axis(ref, module) if ref else None
+            if v is not None:
+                axes_kw[name] = v
+        return args, axes_kw
+
+    def _site_callee(self, module: str,
+                     rec: dict) -> tuple[str, str] | None:
+        if rec.get("callee_lam"):
+            key = (module, rec["callee_lam"])
+            return key if key in self.index.funcs else None
+        if not rec.get("callee"):
+            return None
+        targets = self.index.func_targets(module, rec["callee"])
+        return targets[0] if len(targets) == 1 else None
+
+    # -- function interpretation ------------------------------------------
+    def _interpret(self, key: tuple[str, str], args: list[VMA],
+                   kwargs: dict[str, VMA], axes_kw: dict[str, str],
+                   universe: frozenset[str],
+                   chain: tuple[tuple[str, int, str], ...],
+                   depth: int, outer: _State | None) -> VMA | None:
+        f = self.index.funcs.get(key)
+        if f is None or depth > _MAX_DEPTH:
+            return None
+        memo_key = (
+            key,
+            tuple((tuple(sorted(v.may)), tuple(sorted(v.must)))
+                  for v in args),
+            tuple(sorted((k, (tuple(sorted(v.may)), tuple(sorted(v.must))))
+                         for k, v in kwargs.items())),
+            tuple(sorted(axes_kw.items())),
+            tuple(sorted(universe)),
+        )
+        # closures read the enclosing activation's bindings, which the
+        # memo key cannot capture — a cached summary from one shard_map
+        # site must never answer for another site's different closure
+        # environment, so closure activations are re-interpreted per
+        # call (bounded by depth) and only closed functions memoize
+        if outer is None and memo_key in self._memo:
+            return self._memo[memo_key]
+        active_key = memo_key if outer is None else memo_key + (id(outer),)
+        if active_key in self._active:
+            return VMA.top(universe)  # recursion: unknown but bounded
+        self._active.add(active_key)
+
+        env: dict[str, VMA] = {}
+        axes: dict[str, str] = {}
+        params = list(f["pargs"])
+        if f["meth"] and params:
+            env[params[0]] = VMA.empty()
+            params = params[1:]
+        for i, p in enumerate(params):
+            env[p] = args[i] if i < len(args) else VMA.empty()
+            if p in kwargs:  # passed by keyword to a positional param
+                env[p] = kwargs[p]
+        for p in f["kwonly"]:
+            if p in kwargs:
+                env[p] = kwargs[p]
+            if p in axes_kw:
+                axes[p] = axes_kw[p]
+                env.setdefault(p, VMA.empty())
+        for p, v in axes_kw.items():
+            if p in f["pargs"]:
+                axes[p] = v
+        # axis strings can also arrive positionally/by-keyword as values
+        st = _State(env, axes, outer)
+
+        rel = self.index.modules[key[0]]
+        ctx = _SiteCtx(module=key[0], qual=key[1], rel=rel,
+                       universe=universe,
+                       chain=chain + ((rel, f["line"],
+                                       f"{key[0]}.{key[1]}"),),
+                       depth=depth)
+        ret: VMA | None = None
+        for step in f.get("flow", ()):
+            if "r" in step:
+                vma, _ = self._eval(step["r"], st, ctx)
+                ret = vma if ret is None else VMA.join(ret, vma)
+                continue
+            targets = step.get("a", ())
+            enc = step.get("e")
+            if enc is None:
+                continue
+            # a step inside a conditional arm ("br") may not execute:
+            # weak update — JOIN with the prior binding (may unions,
+            # must intersects) instead of overwriting, so an if/else
+            # can never strong-kill a varying axis from `may`
+            cond = bool(step.get("br"))
+
+            def bind(name: str, vma: VMA) -> None:
+                if cond:
+                    old = st.lookup(name)
+                    if old is not None:
+                        vma = VMA.join(old, vma)
+                st.env[name] = vma
+
+            if not targets:
+                self._eval(enc, st, ctx)
+                continue
+            tt = step.get("tt")
+            if tt and isinstance(enc, dict) and "t" in enc \
+                    and len(enc["t"]) == len(tt):
+                for names, sub in zip(tt, enc["t"]):
+                    vma, axis = self._eval(sub, st, ctx)
+                    for n in names:
+                        bind(n, vma)
+                    if axis is not None and len(names) == 1 and (
+                            not cond or st.axis_of(names[0])
+                            in (None, axis)):
+                        st.axes[names[0]] = axis
+                continue
+            vma, axis = self._eval(enc, st, ctx)
+            for n in targets:
+                bind(n, vma)
+                if not cond:
+                    st.axes.pop(n, None)
+            if axis is not None and len(targets) == 1:
+                if not cond or st.axis_of(targets[0]) in (None, axis):
+                    st.axes[targets[0]] = axis
+                else:
+                    st.axes.pop(targets[0], None)
+        result = ret if ret is not None else VMA.empty()
+        self._active.discard(active_key)
+        if outer is None:
+            self._memo[memo_key] = result
+        return result
+
+    # -- expression evaluation --------------------------------------------
+    def _eval(self, enc: Any, st: _State,
+              ctx: _SiteCtx) -> tuple[VMA, str | None]:
+        if not isinstance(enc, dict):
+            return VMA.empty(), None
+        if "k" in enc:
+            v = enc["k"]
+            return VMA.empty(), v if isinstance(v, str) else None
+        if "n" in enc:
+            name = enc["n"]
+            vma = st.lookup(name)
+            axis = st.axis_of(name)
+            if axis is None:
+                axis = self.index.resolve_axis({"ref": name}, ctx.module)
+            return (vma if vma is not None else VMA.empty()), axis
+        if "d" in enc:
+            return VMA.empty(), self.index.resolve_axis(
+                {"ref": enc["d"]}, ctx.module)
+        if "t" in enc:
+            return VMA.combine(*(self._eval(e, st, ctx)[0]
+                                 for e in enc["t"])), None
+        if "u" in enc:
+            return VMA.combine(*(self._eval(e, st, ctx)[0]
+                                 for e in enc["u"])), None
+        if "alt" in enc:
+            a, ax_a = self._eval(enc["alt"][0], st, ctx)
+            b, ax_b = self._eval(enc["alt"][1], st, ctx)
+            return VMA.join(a, b), ax_a if ax_a == ax_b else None
+        if "c" in enc:
+            return self._eval_call(enc, st, ctx)
+        return VMA.empty(), None
+
+    def _axis_arg(self, enc: dict, op: str, st: _State,
+                  ctx: _SiteCtx) -> str | None:
+        got, unresolved = self._axis_args(enc, op, st, ctx)
+        return got[0] if len(got) == 1 and not unresolved else None
+
+    def _axis_args(self, enc: dict, op: str, st: _State,
+                   ctx: _SiteCtx) -> tuple[list[str], bool]:
+        """(resolved axis names, any-unresolved) of a collective's axis
+        argument — ``psum(x, ("data", "seq"))`` names several axes."""
+        kwx = enc.get("kwx") or {}
+        if "axis_name" in kwx:
+            arg = kwx["axis_name"]
+        else:
+            pos = _COLLECTIVES[op]
+            x = enc.get("x") or []
+            arg = x[pos] if pos < len(x) else None
+        if arg is None:
+            return [], True
+        elems = (arg["t"] if isinstance(arg, dict) and "t" in arg
+                 else [arg])
+        out: list[str] = []
+        unresolved = False
+        for el in elems:
+            axis = self._eval(el, st, ctx)[1]
+            if axis is None:
+                unresolved = True
+            elif axis not in out:
+                out.append(axis)
+        return out, unresolved
+
+    def _eval_call(self, enc: dict, st: _State,
+                   ctx: _SiteCtx) -> tuple[VMA, str | None]:
+        dotted = enc.get("c")
+        x = enc.get("x") or []
+        kwx = enc.get("kwx") or {}
+
+        op = None
+        for cand in _COLLECTIVES:
+            if resolves_to(dotted, cand):
+                op = cand
+                break
+        if op is not None:
+            return self._collective(enc, op, st, ctx), None
+
+        got = self._control_flow(dotted, enc, st, ctx)
+        if got is not None:
+            return got, None
+
+        target = self._resolve_callee(dotted, ctx)
+        if target is not None:
+            return self._project_call(target, enc, st, ctx)
+
+        # unknown op: varying is infectious through every argument
+        parts = [self._eval(e, st, ctx)[0] for e in x]
+        parts += [self._eval(e, st, ctx)[0] for e in kwx.values()]
+        return VMA.combine(*parts), None
+
+    def _collective(self, enc: dict, op: str, st: _State,
+                    ctx: _SiteCtx) -> VMA:
+        x = enc.get("x") or []
+        axes, unresolved = self._axis_args(enc, op, st, ctx)
+        if op == "jax.lax.axis_index":
+            if (len(axes) == 1 and not unresolved
+                    and axes[0] in ctx.universe):
+                return VMA(frozenset({axes[0]}), frozenset({axes[0]}))
+            return VMA.top(ctx.universe)
+        if op == "axis_size":
+            return VMA.empty()
+        value = self._eval(x[0], st, ctx)[0] if x else VMA.empty()
+        targets = [a for a in axes if a in ctx.universe]
+        if not targets and not unresolved:
+            return value  # foreign axes only: hands off
+        short = op.rsplit(".", 1)[-1]
+        if op in _R11_OPS:
+            for axis in targets:
+                if axis in value.may:
+                    continue
+                self._emit(Finding(
+                    rule=R11, path=ctx.rel, line=enc.get("ln", 0), col=0,
+                    message=(f"{short} over {axis!r} of a value that is "
+                             f"replicated over {axis!r} — the product is "
+                             f"already complete on every shard, so this "
+                             f"all-reduce multiplies it by the axis size "
+                             f"(the GSPMD partial-sum/replication "
+                             f"mislabel)"),
+                    symbol=ctx.qual,
+                    chain=ctx.chain + ((ctx.rel, enc.get("ln", 0),
+                                        f"{ctx.module}.{ctx.qual}"),),
+                ))
+        if op in _REMOVES_AXIS:
+            for axis in targets:
+                value = value.remove(axis)
+            if unresolved:
+                # an axis we could not name may ALSO have been reduced:
+                # nothing provably still-varies (protects R12), while
+                # `may` keeps its upper bound
+                value = VMA(value.may, frozenset())
+        return value
+
+    def _control_flow(self, dotted: str | None, enc: dict, st: _State,
+                      ctx: _SiteCtx) -> VMA | None:
+        x = enc.get("x") or []
+        kwx = enc.get("kwx") or {}
+
+        def pick(pos: int, name: str):
+            """Positional-or-keyword operand of the lax call."""
+            if pos < len(x):
+                return x[pos]
+            return kwx.get(name)
+
+        def val(node) -> VMA:
+            return (self._eval(node, st, ctx)[0] if node is not None
+                    else VMA.empty())
+
+        def fallback() -> VMA:
+            # the operands we cannot structurally place still flow:
+            # varying is infectious through every argument (a missing
+            # operand must never read as "provably replicated")
+            parts = [val(e) for e in x] + [val(e) for e in kwx.values()]
+            return VMA.combine(*parts)
+
+        def interp_fn(fn_enc, fn_args: list[VMA]) -> VMA | None:
+            key = self._fn_ref(fn_enc, ctx)
+            if key is None:
+                return None
+            nested = key[0] == ctx.module and key[1].startswith(
+                ctx.qual + ".")
+            return self._interpret(key, fn_args, {}, {}, ctx.universe,
+                                   ctx.chain, ctx.depth + 1,
+                                   outer=st if nested else None)
+
+        if resolves_to(dotted, "jax.lax.scan"):
+            fn = pick(0, "f")
+            if fn is None:
+                return fallback()
+            carry = val(pick(1, "init"))
+            xs = val(pick(2, "xs"))
+            body = interp_fn(fn, [carry, xs])
+            return VMA.combine(carry, body) if body is not None \
+                else VMA.combine(carry, xs)
+        if resolves_to(dotted, "jax.lax.while_loop"):
+            fn = pick(1, "body_fun")
+            init = val(pick(2, "init_val"))
+            body = interp_fn(fn, [init]) if fn is not None else None
+            if fn is None and "init_val" not in kwx and len(x) < 3:
+                return fallback()
+            return VMA.combine(init, body) if body is not None else init
+        if resolves_to(dotted, "jax.lax.fori_loop"):
+            fn = pick(2, "body_fun")
+            init = val(pick(3, "init_val"))
+            body = (interp_fn(fn, [VMA.empty(), init])
+                    if fn is not None else None)
+            if fn is None and "init_val" not in kwx and len(x) < 4:
+                return fallback()
+            return VMA.combine(init, body) if body is not None else init
+        if resolves_to(dotted, "jax.lax.cond"):
+            ops = [self._eval(e, st, ctx)[0] for e in x[3:]]
+            t = interp_fn(pick(1, "true_fun"), ops)
+            f = interp_fn(pick(2, "false_fun"), ops)
+            if t is not None and f is not None:
+                return VMA.join(t, f)
+            return VMA.combine(*ops) if ops else fallback()
+        return None
+
+    def _fn_ref(self, enc: Any, ctx: _SiteCtx) -> tuple[str, str] | None:
+        """A function-valued expression to a project function key,
+        preferring a nested definition inside the current scope (scan
+        bodies are closures)."""
+        if not isinstance(enc, dict):
+            return None
+        if "n" in enc:
+            name = enc["n"]
+            nested = (ctx.module, f"{ctx.qual}.{name}")
+            if nested in self.index.funcs:
+                return nested
+            targets = self.index.func_targets(ctx.module, name)
+            return targets[0] if len(targets) == 1 else None
+        if "d" in enc:
+            targets = self.index.func_targets(ctx.module, enc["d"])
+            return targets[0] if len(targets) == 1 else None
+        return None
+
+    def _resolve_callee(self, dotted: str | None,
+                        ctx: _SiteCtx) -> tuple[str, str] | None:
+        if not dotted:
+            return None
+        nested = (ctx.module, f"{ctx.qual}.{dotted}")
+        if "." not in dotted and nested in self.index.funcs:
+            return nested
+        targets = self.index.func_targets(ctx.module, dotted)
+        return targets[0] if len(targets) == 1 else None
+
+    def _project_call(self, key: tuple[str, str], enc: dict, st: _State,
+                      ctx: _SiteCtx) -> tuple[VMA, str | None]:
+        x = enc.get("x") or []
+        kwx = enc.get("kwx") or {}
+        args: list[VMA] = []
+        axes_kw: dict[str, str] = {}
+        f = self.index.funcs.get(key)
+        pargs = f["pargs"] if f else []
+        for i, e in enumerate(x):
+            vma, axis = self._eval(e, st, ctx)
+            args.append(vma)
+            if axis is not None and i < len(pargs):
+                axes_kw[pargs[i]] = axis
+        kwargs: dict[str, VMA] = {}
+        for name, e in kwx.items():
+            vma, axis = self._eval(e, st, ctx)
+            kwargs[name] = vma
+            if axis is not None:
+                axes_kw[name] = axis
+        nested = key[0] == ctx.module and key[1].startswith(ctx.qual + ".")
+        ret = self._interpret(key, args, kwargs, axes_kw, ctx.universe,
+                              ctx.chain, ctx.depth + 1,
+                              outer=st if nested else None)
+        if ret is None:
+            return VMA.combine(*args, *kwargs.values()), None
+        return ret, None
+
+
+def results(index: ProjectIndex) -> ShardflowAnalysis:
+    """The (cached) shardflow analysis for an index — R11 and R12 share
+    one interpretation pass per lint run."""
+    cached = getattr(index, "_shardflow", None)
+    if cached is None:
+        cached = ShardflowAnalysis(index).run()
+        index._shardflow = cached
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# R13 donation-drift (static half): use-after-donate through the flow IR
+
+
+def _exclusive_arms(a: tuple, b: tuple) -> bool:
+    """True when two flow steps sit in arms of the same statement that
+    can never BOTH execute in one activation: the two arms of an ``if``
+    (numeric ids) or two sibling ``except`` handlers ("h<i>" ids). A
+    loop body and its ``else`` ("b"/"e"), or a try body and its handler
+    ("b"/"h<i>"), DO both execute — never exclusive."""
+    for x, y in zip(a, b):
+        if x == y:
+            continue
+        line_x, _, arm_x = x.partition(":")
+        line_y, _, arm_y = y.partition(":")
+        if line_x != line_y:
+            return False
+        return ((arm_x.isdigit() and arm_y.isdigit())
+                or (arm_x.startswith("h") and arm_y.startswith("h")))
+    return False
+
+
+def _collect_names(enc: Any, out: set[str]) -> None:
+    if not isinstance(enc, dict):
+        return
+    if "n" in enc:
+        out.add(enc["n"])
+        return
+    for sub in enc.get("x", ()):
+        _collect_names(sub, out)
+    for sub in (enc.get("kwx") or {}).values():
+        _collect_names(sub, out)
+    for k in ("t", "u", "alt"):
+        for sub in enc.get(k, ()):
+            _collect_names(sub, out)
+
+
+class _DonationPass:
+    """Cross-module wrapper table + per-function ordered walk."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        self.findings: list[Finding] = []
+        # (module, var) -> donation record, module-scope wrappers only
+        self.wrappers: dict[tuple[str, str], dict] = {}
+        for rel in sorted(index.summaries):
+            s = index.summaries[rel]
+            for d in s.get("donations", ()):
+                if d.get("var") and d["symbol"] == "<module>":
+                    self.wrappers[(s["module"], d["var"])] = dict(
+                        d, rel=rel, module=s["module"])
+
+    def run(self) -> "_DonationPass":
+        for rel in sorted(self.index.summaries):
+            s = self.index.summaries[rel]
+            local = {d["var"]: dict(d, rel=rel, module=s["module"])
+                     for d in s.get("donations", ())
+                     if d.get("var") and d["symbol"] != "<module>"}
+            for qual, f in s["functions"].items():
+                self._function(rel, s, qual, f, local)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return self
+
+    def _wrapper_for(self, module: str, dotted: str,
+                     _seen: frozenset = frozenset()) -> dict | None:
+        if (module, dotted) in _seen:
+            return None
+        _seen = _seen | {(module, dotted)}
+        if "." not in dotted:
+            hit = self.wrappers.get((module, dotted))
+            if hit is not None:
+                return hit
+            rel = self.index.modules.get(module)
+            target = (self.index.summaries[rel]["exports"].get(dotted)
+                      if rel else None)
+            if target and "." in target:
+                return self._wrapper_for(module, target, _seen)
+            return None
+        head, _, tail = dotted.rpartition(".")
+        got = self.index.resolve_qual(head)
+        if got and got[0] == "module":
+            return self._wrapper_for(got[1], tail, _seen)
+        return None
+
+    def _donations_in(self, enc: Any, module: str, symbol: str,
+                      local: dict) -> Iterable[tuple[dict, set[str], int]]:
+        """(wrapper record, donated names, call line) per donating call
+        inside one expression."""
+        if not isinstance(enc, dict):
+            return
+        if "c" in enc:
+            x = enc.get("x") or []
+            kwx = enc.get("kwx") or {}
+            rec = None
+            if "dn" in enc or "dnn" in enc:  # inline-jitted donation
+                rec = {"nums": enc.get("dn", []),
+                       "names": enc.get("dnn", []),
+                       "rel": self.index.modules.get(module, ""),
+                       "module": module, "line": enc.get("ln", 0),
+                       "symbol": symbol, "var": enc.get("c") or "<jit>",
+                       "fname": enc.get("c")}
+            elif enc.get("c"):
+                rec = local.get(enc["c"]) if "." not in enc["c"] else None
+                if rec is None:
+                    rec = self._wrapper_for(module, enc["c"])
+            if rec is not None:
+                donated: set[str] = set()
+                for pos in rec.get("nums", ()):
+                    if pos < len(x):
+                        _collect_names(x[pos], donated)
+                for name in rec.get("names", ()):
+                    if name in kwx:
+                        _collect_names(kwx[name], donated)
+                if donated:
+                    yield rec, donated, enc.get("ln", 0)
+            for sub in x:
+                yield from self._donations_in(sub, module, symbol, local)
+            for sub in kwx.values():
+                yield from self._donations_in(sub, module, symbol, local)
+            return
+        for k in ("t", "u", "alt"):
+            for sub in enc.get(k, ()):
+                yield from self._donations_in(sub, module, symbol, local)
+
+    def _function(self, rel: str, s: dict, qual: str, f: dict,
+                  local: dict) -> None:
+        module = s["module"]
+        pending: dict[str, tuple[dict, int, tuple]] = {}
+        for step in f.get("flow", ()):
+            enc = step.get("e", step.get("r"))
+            if enc is None:
+                continue
+            br = tuple(step.get("br") or ())
+            used: set[str] = set()
+            _collect_names(enc, used)
+            for name in sorted(used & set(pending)):
+                wrec, call_line, donate_br = pending[name]
+                if _exclusive_arms(donate_br, br):
+                    continue  # an if-arm read never sees the else-arm
+                    # donation — the donation stays pending for
+                    # compatible later reads
+                del pending[name]
+                hop_def = (wrec["rel"], wrec["line"],
+                           f"{wrec['module']}.{wrec['var']}")
+                hop_call = (rel, call_line, f"{module}.{qual}")
+                hop_use = (rel, step["ln"], f"{module}.{qual}")
+                self.findings.append(Finding(
+                    rule=R13, path=rel, line=step["ln"], col=0,
+                    message=(f"buffer {name!r} was donated to jitted "
+                             f"'{wrec.get('fname') or wrec['var']}' "
+                             f"(donate_argnums/argnames declared at "
+                             f"{wrec['rel']}:{wrec['line']}) and is read "
+                             f"after the call — XLA has reused its "
+                             f"memory; rebind the result or drop the "
+                             f"donation"),
+                    symbol=qual,
+                    chain=(hop_def, hop_call, hop_use),
+                ))
+            for wrec, donated, line in self._donations_in(
+                    enc, module, qual, local):
+                for name in donated:
+                    pending[name] = (wrec, line, br)
+            for t in step.get("a", ()):
+                pending.pop(t, None)
+
+
+def donation_findings(index: ProjectIndex) -> list[Finding]:
+    cached = getattr(index, "_shardflow_donations", None)
+    if cached is None:
+        cached = _DonationPass(index).run().findings
+        index._shardflow_donations = cached
+    return cached

@@ -117,6 +117,16 @@ def _bench_diffusion(pipe, *, size: int, steps: int, batch: int, iters: int,
             out["roofline"] = hlocost.static_program_report(
                 hlo, steps=int(config.get("denoise_steps", steps)),
                 achieved_s=p50)
+            # swarmproof (ISSUE 15): the same captured program's HLO
+            # contract facts — collective counts (any collective in a
+            # single-chip config is a compiler surprise; an all-reduce
+            # in a ring config is the runtime face of R11), matmul
+            # dtype census, and what survived of buffer donation —
+            # stamped per config so drift across rounds is a BENCH
+            # diff, not a TPU postmortem
+            from chiaswarm_tpu.analysis import hlocheck
+
+            out["hlo_contract"] = hlocheck.census(hlo)
 
     if pipelined:
         # steady-state: keep one job in flight while fetching the last

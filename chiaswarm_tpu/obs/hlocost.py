@@ -200,6 +200,23 @@ _COMP_HEADER_RE = re.compile(
     r"\s*(?:ENTRY\s+)?%?([\w.-]+)\s+\(.*\)\s*->\s*.+\{\s*$")
 
 
+def iter_instruction_lines(text: str):
+    """Yield (computation name, raw line) for every instruction line in
+    an HLO dump ("" at module scope). The one place the computation
+    bracketing logic lives — :func:`parse_hlo_text` and the contract
+    checker (``analysis/hlocheck.py``) both walk HLO through it."""
+    current = None
+    for line in text.splitlines():
+        header = _COMP_HEADER_RE.match(line)
+        if header:
+            current = header.group(1)
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        yield (current or ""), line
+
+
 def called_computations(text: str) -> set[str]:
     """Computation names referenced by ``calls=`` (fused computations).
     Instructions INSIDE them also parse as bare conv/dot rows — fine for
@@ -233,16 +250,8 @@ def parse_hlo_text(text: str) -> dict[str, dict]:
 
     # computation name -> [total conv+dot flops inside it, kind]
     comp_flops: dict[str, list] = {}
-    current = None
-    for line in text.splitlines():
-        header = _COMP_HEADER_RE.match(line)
-        if header:
-            current = header.group(1)
-            continue
-        if line.startswith("}"):
-            current = None
-            continue
-        if current is None:
+    for current, line in iter_instruction_lines(text):
+        if not current:
             continue
         if " convolution(" in line:
             entry = comp_flops.setdefault(current, [0.0, "conv"])
@@ -254,16 +263,7 @@ def parse_hlo_text(text: str) -> dict[str, dict]:
                 entry[1] = "mixed"
 
     fusions: dict[str, dict] = {}
-    current = None
-    for line in text.splitlines():
-        header = _COMP_HEADER_RE.match(line)
-        if header:
-            current = header.group(1)
-            continue
-        if line.startswith("}"):
-            current = None
-            continue
-        comp = current or ""
+    for comp, line in iter_instruction_lines(text):
         m = re.match(r"\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*.*?\bfusion\(",
                      line)
         if not m:

@@ -1,0 +1,15 @@
+"""R13 donation-drift: reading a buffer after donating it to a jitted
+wrapper defined in another module, next to the clean rebinding twin."""
+
+from donpkg.wrappers import step
+
+
+def bad_read_after_donate(latents, eps):
+    out = step(latents, eps)
+    # latents was donated at the call above: XLA has reused its memory
+    return out + latents.mean()
+
+
+def clean_rebound(latents, eps):
+    latents = step(latents, eps)
+    return latents * 2.0

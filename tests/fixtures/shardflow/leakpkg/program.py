@@ -1,0 +1,35 @@
+"""R12 unreduced-out-spec: a per-shard partial sum escapes a shard_map
+boundary whose out_specs claims it is replicated, next to the clean
+twin that reduces before returning."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chiaswarm_tpu.core.compat import shard_map
+
+MESH = Mesh(np.array(jax.devices()[:4]), ("seq",))
+
+
+def partial_logits(x):
+    # per-shard partial reduction: still varies over seq
+    return x.sum(axis=-1)
+
+
+def reduced_logits(x):
+    return jax.lax.psum(x.sum(axis=-1), "seq")
+
+
+def bad_escape(x):
+    # out_specs P() claims the result is replicated over seq, but each
+    # shard returns ITS partial sum — callers read shard-0's garbage.
+    fn = shard_map(partial_logits, mesh=MESH, in_specs=(P("seq"),),
+                   out_specs=P())
+    return fn(x)
+
+
+def clean_reduced(x):
+    # the psum clears seq from the varying set: P() is now honest.
+    fn = shard_map(reduced_logits, mesh=MESH, in_specs=(P("seq"),),
+                   out_specs=P())
+    return fn(x)

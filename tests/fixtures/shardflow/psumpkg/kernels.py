@@ -1,0 +1,11 @@
+"""The K/V projection shape from parallel/ring_attention.py's entry:
+project, then all-reduce the product over the ring axis. Whether that
+psum is a reduction or a multiplication depends entirely on what the
+shard_map boundary fed in — which is the r06 bug class."""
+
+import jax
+
+
+def kv_projection(ctx, w, *, axis_name):
+    kv = ctx @ w
+    return jax.lax.psum(kv, axis_name)

@@ -1,0 +1,9 @@
+"""Two distinct mesh instances: the divergence-family trigger shape
+(two-axis) and the pure-seq twin that has always been bit-exact."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+RING = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "seq"))
+SEQ_ONLY = Mesh(np.array(jax.devices()[:4]), ("seq",))

@@ -1,0 +1,39 @@
+"""Three bindings of the same kernel. Only the two-axis one is wrong:
+the replicated ctx makes the product complete on every seq shard, so
+the kernel's psum multiplies K/V by exactly the seq size."""
+
+from functools import partial
+
+from jax.sharding import PartitionSpec as P
+
+from chiaswarm_tpu.core.compat import shard_map
+from psumpkg.kernels import kv_projection
+from psumpkg.mesh import RING, SEQ_ONLY
+
+
+def bad_two_axis(ctx, w):
+    # ctx is sharded over data ONLY: replicated over seq. The product
+    # is already complete on every seq shard — the kernel's psum over
+    # seq multiplies it by 4 (R11 replicated-psum).
+    fn = shard_map(partial(kv_projection, axis_name="seq"), mesh=RING,
+                   in_specs=(P("data", None), P()),
+                   out_specs=P("data", None))
+    return fn(ctx, w)
+
+
+def clean_single_axis(ctx, w):
+    # same mesh, single sharded axis: ctx varies over seq, so the psum
+    # is a genuine reduction of per-shard partials.
+    fn = shard_map(partial(kv_projection, axis_name="seq"), mesh=RING,
+                   in_specs=(P(None, "seq"), P()),
+                   out_specs=P(None, None))
+    return fn(ctx, w)
+
+
+def clean_pure_seq_mesh(ctx, w):
+    # the pure-seq twin (bit-identical in the r06 bisect): one mesh
+    # axis, varying operand, legitimate psum.
+    fn = shard_map(partial(kv_projection, axis_name="seq"),
+                   mesh=SEQ_ONLY, in_specs=(P("seq"), P()),
+                   out_specs=P())
+    return fn(ctx, w)

@@ -1,0 +1,25 @@
+"""The retired R10 imprecision: before per-mesh-instance universes, the
+'seq' defined by seqside.py's mesh pooled into one global soup and
+sanctioned this spec — which names an axis THIS mesh does not bind."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chiaswarm_tpu.core.compat import shard_map
+
+DATA_MESH = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+
+def shard_over_wrong_axis(x):
+    # 'seq' exists in the project (seqside.SEQ_MESH) but not on
+    # DATA_MESH — jax raises at trace time; R10 must catch it statically
+    fn = shard_map(lambda a: a, mesh=DATA_MESH, in_specs=(P("seq"),),
+                   out_specs=P("seq"))
+    return fn(x)
+
+
+def shard_over_bound_axis(x):
+    fn = shard_map(lambda a: a, mesh=DATA_MESH, in_specs=(P("data"),),
+                   out_specs=P("data"))
+    return fn(x)

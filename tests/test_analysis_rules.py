@@ -1532,3 +1532,644 @@ def test_allow_marker_sanctions_sync_site_for_r1_and_r9():
             return x.mean().item()
         """)
     assert len(list(_get_rule("R9").check_project(unmarked_idx))) == 1
+
+
+# --------------------------------- swarmproof (R11/R12/R13, ISSUE 15)
+
+from chiaswarm_tpu.analysis.shardflow import VMA
+
+SHARDFLOW_FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                                  "shardflow")
+
+
+def _copy_shardflow(tmp_path, name):
+    dst = tmp_path / name
+    shutil.copytree(os.path.join(SHARDFLOW_FIXTURES, name), dst)
+    return dst
+
+
+def test_vma_lattice_combine_join_and_collective_transfer():
+    """The abstract domain's algebra: combine (dataflow meet) is
+    infectious on both sides, join (control merge) keeps only the
+    definite intersection, collectives remove/introduce axes on both."""
+    a = VMA(frozenset({"data", "seq"}), frozenset({"data"}))
+    b = VMA(frozenset({"seq"}), frozenset({"seq"}))
+
+    c = VMA.combine(a, b)
+    assert c.may == {"data", "seq"} and c.must == {"data", "seq"}
+
+    j = VMA.join(a, b)
+    assert j.may == {"data", "seq"} and j.must == set()
+
+    r = c.remove("seq")  # psum/all_gather over seq
+    assert r.may == {"data"} and r.must == {"data"}
+
+    i = VMA.empty().introduce("seq")  # axis_index("seq")
+    assert i.may == i.must == {"seq"}
+
+    top = VMA.top({"data", "seq"})
+    assert top.may == {"data", "seq"} and top.must == set()
+    assert VMA.combine() == VMA.empty()
+
+
+def test_r11_flags_distilled_seq_parallel_fixture(tmp_path):
+    """THE acceptance fixture: two-axis shard_map, replicated operand,
+    complete product all-reduced over seq — R11 fires with the full
+    entry→sink chain; the single-axis twin and the pure-seq-mesh twin
+    stay green."""
+    pkg = _copy_shardflow(tmp_path, "psumpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R11"])
+    assert r.exit_code == 1 and len(r.new) == 1
+    f = r.new[0]
+    assert f.rule == "replicated-psum"
+    assert f.path == "psumpkg/kernels.py" and f.symbol == "kv_projection"
+    assert "'seq'" in f.message and "axis size" in f.message
+    # entry (the shard_map site) → kernel → the psum line itself
+    assert [hop[2] for hop in f.chain] == [
+        "psumpkg.program.bad_two_axis", "psumpkg.kernels.kv_projection",
+        "psumpkg.kernels.kv_projection"]
+    assert f.chain[0][0] == "psumpkg/program.py" and f.chain[0][1] > 0
+    assert f.chain[-1] == ("psumpkg/kernels.py", f.line,
+                          "psumpkg.kernels.kv_projection")
+    assert "chain:" in f.render()
+
+
+def test_r11_cli_acceptance_chain_in_text_json_and_sarif(tmp_path):
+    """The ISSUE acceptance clause: the R11 chain renders in all three
+    output formats (text, --json, --sarif codeFlows)."""
+    pkg = _copy_shardflow(tmp_path, "psumpkg")
+    base = [sys.executable, "-m", "chiaswarm_tpu.analysis", "--select",
+            "R11", "--no-cache"]
+    proc = subprocess.run(base + [str(pkg)], capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "replicated-psum" in proc.stdout
+    assert "chain: psumpkg.program.bad_two_axis" in proc.stdout
+
+    proc = subprocess.run(base + ["--json", str(pkg)],
+                          capture_output=True, text=True, timeout=300)
+    doc = json.loads(proc.stdout)
+    assert len(doc) == 1 and len(doc[0]["chain"]) == 3
+    assert doc[0]["chain"][0][2] == "psumpkg.program.bad_two_axis"
+
+    sarif = tmp_path / "out.sarif"
+    proc = subprocess.run(base + ["--sarif", str(sarif), str(pkg)],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1
+    res = json.loads(sarif.read_text())["runs"][0]["results"]
+    assert len(res) == 1 and res[0]["ruleId"] == "replicated-psum"
+    flow = res[0]["codeFlows"][0]["threadFlows"][0]["locations"]
+    assert [h["location"]["message"]["text"] for h in flow] == [
+        "psumpkg.program.bad_two_axis", "psumpkg.kernels.kv_projection",
+        "psumpkg.kernels.kv_projection"]
+
+
+def test_r12_flags_partial_sum_escape_clean_twin_silent(tmp_path):
+    pkg = _copy_shardflow(tmp_path, "leakpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R12"])
+    assert r.exit_code == 1 and len(r.new) == 1
+    f = r.new[0]
+    assert f.rule == "unreduced-out-spec" and f.symbol == "bad_escape"
+    assert "out_specs claims replication" in f.message
+    # chain: the shard_map site, then the callee whose return leaks
+    assert [hop[2] for hop in f.chain] == [
+        "leakpkg.program.bad_escape", "leakpkg.program.partial_logits"]
+
+
+def test_r13_cross_module_donation_drift(tmp_path):
+    pkg = _copy_shardflow(tmp_path, "donpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R13"])
+    assert r.exit_code == 1 and len(r.new) == 1
+    f = r.new[0]
+    assert f.rule == "donation-drift"
+    assert f.path == "donpkg/caller.py"
+    assert f.symbol == "bad_read_after_donate"
+    assert "'latents'" in f.message and "donpkg/wrappers.py" in f.message
+    # chain: wrapper definition → donating call → the read-after-donate
+    assert [hop[0] for hop in f.chain] == [
+        "donpkg/wrappers.py", "donpkg/caller.py", "donpkg/caller.py"]
+    assert f.chain[1][1] < f.chain[2][1]
+
+
+def test_r10_two_mesh_instances_do_not_pool_axes(tmp_path):
+    """The retired R10 imprecision (ISSUE 15 satellite): a seq-only mesh
+    in one module must not sanction 'seq' specs on a data-only Mesh
+    literal's shard_map in another — and the chain names the instance."""
+    pkg = _copy_shardflow(tmp_path, "twomesh")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R10"])
+    assert r.exit_code == 1 and len(r.new) == 1
+    f = r.new[0]
+    assert f.symbol == "shard_over_wrong_axis"
+    assert "'seq'" in f.message and "binds only [data]" in f.message
+    # chain hop 2 is the mesh instance definition
+    assert f.chain[1][0] == "twomesh/dataside.py"
+    assert "DATA_MESH" in f.chain[1][2]
+    # the legitimate seq-mesh user and the bound-axis twin stay green
+    assert all(x.symbol not in ("shard_over_seq", "shard_over_bound_axis")
+               for x in r.new)
+
+
+def test_r11_through_scan_body_closure():
+    """The real trigger shape: the psum sits in a scan body closing over
+    the shard_map callee's parameters (parallel/ring_attention.py's
+    structure) — interpretation must descend through lax.scan into the
+    closure with the caller's bindings visible."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/ring.py", """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from chiaswarm_tpu.core.compat import shard_map
+
+            MESH = Mesh(np.array(jax.devices()).reshape(2, 4),
+                        ("data", "seq"))
+
+            def kernel(q, w, *, axis_name):
+                def hop(carry, _):
+                    kv = q @ w
+                    return carry + jax.lax.psum(kv, axis_name), None
+                out, _ = jax.lax.scan(hop, q * 0.0, None, length=4)
+                return out
+
+            def enter(q, w):
+                from functools import partial
+                fn = shard_map(partial(kernel, axis_name="seq"),
+                               mesh=MESH,
+                               in_specs=(P("data", None), P()),
+                               out_specs=P("data", None))
+                return fn(q, w)
+            """),
+    )
+    fs = list(_get_rule("R11").check_project(idx))
+    assert len(fs) == 1
+    assert fs[0].symbol.endswith("hop")
+    quals = [hop[2] for hop in fs[0].chain]
+    assert quals[0] == "pkg.ring.enter"
+    assert "pkg.ring.kernel" in quals
+
+
+def test_r11_conditional_spec_contributes_may_only():
+    """P(DATA if cond else None, SEQ): the value MAY vary over data, so
+    a psum over data must stay silent (one-sided soundness) — while the
+    psum over the definitely-replicated axis still fires."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from chiaswarm_tpu.core.compat import shard_map
+
+            MESH = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                        ("data", "seq", "model"))
+
+            def k(x, b):
+                return jax.lax.psum(x, "data")
+
+            def enter(x, b, flag):
+                fn = shard_map(
+                    k, mesh=MESH,
+                    in_specs=(P("data" if flag else None, "seq"), P()),
+                    out_specs=P(None, "seq"))
+                return fn(x, b)
+            """),
+    )
+    assert list(_get_rule("R11").check_project(idx)) == []
+
+    idx2 = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from chiaswarm_tpu.core.compat import shard_map
+
+            MESH = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                        ("data", "seq", "model"))
+
+            def k(x, b):
+                return jax.lax.psum(x, "model")
+
+            def enter(x, b, flag):
+                fn = shard_map(
+                    k, mesh=MESH,
+                    in_specs=(P("data" if flag else None, "seq"), P()),
+                    out_specs=P(None, "seq"))
+                return fn(x, b)
+            """),
+    )
+    fs = list(_get_rule("R11").check_project(idx2))
+    assert len(fs) == 1 and "'model'" in fs[0].message
+
+
+def test_r12_all_gather_clears_the_varying_axis():
+    """all_gather (like psum) makes the value invariant over the axis:
+    an out_specs replication claim after it is honest."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from chiaswarm_tpu.core.compat import shard_map
+
+            MESH = Mesh(np.array(jax.devices()[:4]), ("seq",))
+
+            def gathered(x):
+                return jax.lax.all_gather(x, "seq")
+
+            def enter(x):
+                fn = shard_map(gathered, mesh=MESH,
+                               in_specs=(P("seq"),), out_specs=P())
+                return fn(x)
+            """),
+    )
+    assert list(_get_rule("R12").check_project(idx)) == []
+
+
+def test_r11_axis_index_introduces_varying():
+    """axis_index(a) VARIES over a by construction — summing it over a
+    is legitimate and must stay silent."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from chiaswarm_tpu.core.compat import shard_map
+
+            MESH = Mesh(np.array(jax.devices()[:4]), ("seq",))
+
+            def k(x):
+                shard = jax.lax.axis_index("seq")
+                return jax.lax.psum(shard, "seq") + x
+
+            def enter(x):
+                fn = shard_map(k, mesh=MESH, in_specs=(P("seq"),),
+                               out_specs=P("seq"))
+                return fn(x)
+            """),
+    )
+    assert list(_get_rule("R11").check_project(idx)) == []
+
+
+def test_shardflow_baseline_lifecycle(tmp_path):
+    """R11 findings ride the standard shrink-only baseline: finding →
+    grandfathered → fixed → stale entry fails --strict. (The baseline is
+    written by a full-rule run — --write-baseline refuses --select — so
+    the fixture's module-scope jax.devices() R4 findings ride along and
+    stay VALID across the R11 fix, proving staleness is per-entry.)"""
+    pkg = _copy_shardflow(tmp_path, "psumpkg")
+    bl = tmp_path / "baseline.json"
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R11"])
+    assert r.exit_code == 1 and len(r.new) == 1
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 0
+    doc = json.loads(bl.read_text())
+    entries = [e for e in doc["findings"]
+               if e["rule"] == "replicated-psum"]
+    assert len(entries) == 1
+    assert set(entries[0]) == {"rule", "path", "symbol", "message",
+                               "count"}  # identity only, no chain hops
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R11"], strict=True)
+    assert r.exit_code == 0 and len(r.suppressed) == 1
+
+    # fix: shard the operand over seq — the psum becomes a reduction
+    prog = pkg / "program.py"
+    fixed = prog.read_text().replace('in_specs=(P("data", None), P()),',
+                                     'in_specs=(P("data", "seq"), P()),')
+    assert fixed != prog.read_text()
+    prog.write_text(fixed)
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R11"], strict=True)
+    assert r.exit_code == 1 and not r.new
+    assert len(r.stale) == 1 and "replicated-psum" in r.stale[0]
+
+
+def test_changed_only_mesh_definitions_expand_to_sharding_consumers(
+        tmp_path):
+    """ISSUE 15 small fix: editing a module that DEFINES mesh vocabulary
+    must re-lint every sharding consumer even without an import edge
+    (parallel/ring_attention.py reads its axis through a parameter and
+    never imports core/mesh.py) — while non-sharding islands stay out of
+    the fast path."""
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True)
+
+    _write(tmp_path, "pkg/__init__.py", "")
+    meshdef = _write(tmp_path, "pkg/meshdef.py", textwrap.dedent("""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        MESH = Mesh(np.array(jax.devices()[:2]), ("data",))
+        """))
+    _write(tmp_path, "pkg/ring.py", textwrap.dedent("""
+        import jax
+
+        def rotate(x, *, axis_name):
+            return jax.lax.ppermute(x, axis_name, [(0, 1)])
+        """))
+    _write(tmp_path, "pkg/island.py", "z = 1\n")
+    git("init", "-q")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "seed")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+
+    # edit ONLY the mesh-defining module
+    meshdef.write_text(meshdef.read_text().replace(
+        '("data",)', '("data", "seq")').replace("[:2]", "[:4]"))
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, select=["R10"])
+    assert r.exit_code == 0, r.report
+    # meshdef + the collective-bearing consumer; the island is skipped
+    assert r.checked_files == 2 and r.total_files == 4
+
+    # a non-mesh edit keeps the narrow closure
+    _write(tmp_path, "pkg/island.py", "z = 2\n")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "mesh")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+    (tmp_path / "pkg/island.py").write_text("z = 3\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, select=["R10"])
+    assert r.checked_files == 1
+
+
+# ------------------- swarmproof review-hardening regressions (5 fixes)
+
+
+def _two_axis_header():
+    return textwrap.dedent("""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from chiaswarm_tpu.core.compat import shard_map
+
+        MESH = Mesh(np.array(jax.devices()).reshape(2, 4),
+                    ("data", "seq"))
+        """)
+
+
+def test_r11_closure_memo_is_per_site_not_order_dependent():
+    """Code-review regression: a scan-body closure's summary must not be
+    memoized across shard_map sites — the closure reads the ENCLOSING
+    activation's bindings, which differ per site. The clean site
+    interpreting FIRST must not swallow the buggy site's finding."""
+    def kernel(name):
+        return textwrap.dedent(f"""
+            def {name}(q, w):
+                def hop(carry, _):
+                    kv = q @ w
+                    return carry + jax.lax.psum(kv, "seq"), None
+                out, _ = jax.lax.scan(hop, q * 0.0, None, length=4)
+                return out
+            """)
+
+    def enter(name, callee, spec):
+        return textwrap.dedent(f"""
+            def {name}(q, w):
+                fn = shard_map({callee}, mesh=MESH,
+                               in_specs=({spec}, P()),
+                               out_specs=P("data", None))
+                return fn(q, w)
+            """)
+
+    body = (_two_axis_header()
+            + kernel("k_clean") + kernel("k_bad")
+            # the CLEAN site (operand varies over seq) interprets first
+            + enter("a_clean", "k_clean", 'P("data", "seq")')
+            + enter("b_bad", "k_bad", 'P("data", None)'))
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", body))
+    fs = list(_get_rule("R11").check_project(idx))
+    assert len(fs) == 1 and fs[0].chain[0][2] == "pkg.m.b_bad"
+
+    # SAME kernel from both sites: the memo must still not leak the
+    # clean activation's closure verdict into the bad one
+    body2 = (_two_axis_header() + kernel("k")
+             + enter("a_clean", "k", 'P("data", "seq")')
+             + enter("b_bad", "k", 'P("data", None)'))
+    idx2 = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", body2))
+    fs2 = list(_get_rule("R11").check_project(idx2))
+    assert len(fs2) == 1 and fs2[0].chain[0][2] == "pkg.m.b_bad"
+
+
+def test_r11_keyword_passed_positional_param_binds():
+    """Code-review regression: helper(x=x) passing a varying value by
+    keyword to a POSITIONAL parameter must bind it — not default the
+    parameter to replicated and flag a sound psum."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", _two_axis_header() + textwrap.dedent("""
+            def helper(x):
+                return jax.lax.psum(x, "seq")
+
+            def k(x, w):
+                return helper(x=x)
+
+            def enter(x, w):
+                fn = shard_map(k, mesh=MESH,
+                               in_specs=(P("data", "seq"), P()),
+                               out_specs=P("data", None))
+                return fn(x, w)
+            """)),
+    )
+    assert list(_get_rule("R11").check_project(idx)) == []
+
+
+def test_r11_branch_assignment_joins_instead_of_overwriting():
+    """Code-review regression: `if flag: y = x` / `else: y = zeros`
+    must JOIN (y MAY vary) — the else arm must not strong-kill the
+    varying axis and produce a false-positive R11."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", _two_axis_header() + textwrap.dedent("""
+            def k(x, w, flag):
+                if flag:
+                    y = x
+                else:
+                    y = x * 0.0 + 1.0
+                    y = w
+                return jax.lax.psum(y, "seq")
+
+            def enter(x, w, flag):
+                fn = shard_map(k, mesh=MESH,
+                               in_specs=(P("data", "seq"), P(), P()),
+                               out_specs=P("data", None))
+                return fn(x, w, flag)
+            """)),
+    )
+    assert list(_get_rule("R11").check_project(idx)) == []
+
+
+def test_r13_mutually_exclusive_arms_do_not_chain():
+    """Code-review regression: a donation in the if-arm must not chain
+    to a read in the else-arm (they never both execute), while a read
+    AFTER the conditional still flags."""
+    header = """
+        import jax
+
+        step = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        """
+    exclusive = header + """
+        def caller(buf, fast):
+            if fast:
+                out = step(buf)
+            else:
+                out = buf + 1.0
+            return out
+        """
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", exclusive))
+    assert list(_get_rule("R13").check_project(idx)) == []
+
+    after = header + """
+        def caller(buf, fast):
+            if fast:
+                out = step(buf)
+            else:
+                out = buf + 1.0
+            return out + buf.mean()
+        """
+    idx2 = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", after))
+    fs = list(_get_rule("R13").check_project(idx2))
+    assert len(fs) == 1 and fs[0].rule == "donation-drift"
+
+
+def test_r11_pytree_prefix_spec_covers_every_callee_param():
+    """Code-review regression: a single (pytree-prefix) in_specs applies
+    to EVERY callee parameter — the 9th argument of a wide kernel must
+    not silently bind replicated."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", _two_axis_header() + textwrap.dedent("""
+            def k(a1, a2, a3, a4, a5, a6, a7, a8, a9):
+                return jax.lax.psum(a9, "seq")
+
+            def enter(args):
+                fn = shard_map(k, mesh=MESH, in_specs=P("data", "seq"),
+                               out_specs=P("data", "seq"))
+                return fn(*args)
+            """)),
+    )
+    assert list(_get_rule("R11").check_project(idx)) == []
+
+
+def test_r12_tuple_axis_psum_reduces_every_named_axis():
+    """Second-review regression: psum(x, ("data", "seq")) removes BOTH
+    axes from the varying set — out_specs=P() after it is honest, and a
+    psum over only ONE of two varying axes still leaks the other."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", _two_axis_header() + textwrap.dedent("""
+            def k(x):
+                return jax.lax.psum(x, ("data", "seq"))
+
+            def enter(x):
+                fn = shard_map(k, mesh=MESH,
+                               in_specs=(P("data", "seq"),),
+                               out_specs=P())
+                return fn(x)
+            """)),
+    )
+    assert list(_get_rule("R12").check_project(idx)) == []
+
+    idx2 = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", _two_axis_header() + textwrap.dedent("""
+            def k(x):
+                return jax.lax.psum(x, ("seq",))
+
+            def enter(x):
+                fn = shard_map(k, mesh=MESH,
+                               in_specs=(P("data", "seq"),),
+                               out_specs=P())
+                return fn(x)
+            """)),
+    )
+    fs = list(_get_rule("R12").check_project(idx2))
+    assert len(fs) == 1 and "'data'" in fs[0].message
+
+
+def test_r11_keyword_invoked_scan_is_not_replicated():
+    """Second-review regression: lax.scan called with keyword operands
+    (f=, init=, xs=) must flow the carry's varying axes — not default
+    the loop result to 'provably replicated' and flag a sound psum."""
+    idx = _index_of(
+        ("pkg/__init__.py", ""),
+        ("pkg/m.py", _two_axis_header() + textwrap.dedent("""
+            def k(x):
+                def hop(carry, _):
+                    return carry + 1.0, None
+                out, _ = jax.lax.scan(f=hop, init=x, xs=None, length=4)
+                return jax.lax.psum(out, "seq")
+
+            def enter(x):
+                fn = shard_map(k, mesh=MESH,
+                               in_specs=(P("data", "seq"),),
+                               out_specs=P("data", None))
+                return fn(x)
+            """)),
+    )
+    assert list(_get_rule("R11").check_project(idx)) == []
+
+
+def test_r13_try_handler_reads_the_body_donation():
+    """Second-review regression: a try body's donation IS live in its
+    except handler (the body ran first) — must flag; sibling handlers
+    are exclusive with each other — must not chain; a loop's else runs
+    after the body — must flag."""
+    header = textwrap.dedent("""
+        import jax
+
+        step = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        """)
+    handler_read = header + textwrap.dedent("""
+        def caller(buf):
+            try:
+                out = step(buf)
+            except Exception:
+                return buf.mean()
+            return out
+        """)
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", handler_read))
+    fs = list(_get_rule("R13").check_project(idx))
+    assert len(fs) == 1 and fs[0].rule == "donation-drift"
+
+    sibling_handlers = header + textwrap.dedent("""
+        def caller(buf, risky):
+            try:
+                out = risky(buf)
+            except ValueError:
+                out = step(buf)
+            except TypeError:
+                out = buf + 1.0
+            return out
+        """)
+    idx2 = _index_of(("pkg/__init__.py", ""),
+                     ("pkg/m.py", sibling_handlers))
+    assert list(_get_rule("R13").check_project(idx2)) == []
+
+    loop_else = header + textwrap.dedent("""
+        def caller(buf, xs):
+            for x in xs:
+                out = step(buf)
+            else:
+                return buf.mean()
+            return out
+        """)
+    idx3 = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", loop_else))
+    fs3 = list(_get_rule("R13").check_project(idx3))
+    assert len(fs3) == 1

@@ -198,3 +198,108 @@ def test_program_capture_keys_by_signature():
     hlo = cap.largest_hlo()
     assert hlo and "HloModule" in hlo
     assert len(cap.mark()) == 2 and cap.mark() == []
+
+
+# ------------------- swarmproof compiled-side contracts (ISSUE 15):
+# analysis/hlocheck.py audits lowered programs against declared
+# collective/dtype/donation contracts — same canned-fixture stance,
+# no jax needed.
+
+from chiaswarm_tpu.analysis import hlocheck
+
+
+_HLO_RING = """\
+HloModule jit_ring, input_output_alias={ {}: (0, {}, may-alias), {1}: (2, {}) }, is_scheduled=true
+
+ENTRY %main (q: f32[2,8,128], k: f32[2,8,128], v: f32[2,8,128]) -> f32[2,8,128] {
+  %q = f32[2,8,128]{2,1,0} parameter(0)
+  %k = f32[2,8,128]{2,1,0} parameter(1)
+  %v = f32[2,8,128]{2,1,0} parameter(2)
+  %cp.1 = f32[2,8,128]{2,1,0} collective-permute(%k), channel_id=1, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %cp-start.2 = f32[2,8,128]{2,1,0} collective-permute-start(%v), channel_id=2, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %cp-done.2 = f32[2,8,128]{2,1,0} collective-permute-done(%cp-start.2)
+  %scores = f32[2,8,8]{2,1,0} dot(%q, %cp.1), lhs_contracting_dims={2}, rhs_contracting_dims={2}
+  %mixed = bf16[2,8,8]{2,1,0} dot(%q, %q), lhs_contracting_dims={2}, rhs_contracting_dims={2}
+  %ar.3 = f32[2,8,8]{2,1,0} all-reduce(%scores), channel_id=3, replica_groups={{0,1,2,3}}, to_apply=%add
+  %ag-start.4 = f32[2,8,128]{2,1,0} all-gather-start(%q), channel_id=4, replica_groups=[2,4]<=[8], dimensions={1}
+  %ag-done.4 = f32[2,8,128]{2,1,0} all-gather-done(%ag-start.4)
+  ROOT %out = f32[2,8,128]{2,1,0} dot(%ar.3, %cp-done.2), lhs_contracting_dims={2}, rhs_contracting_dims={1}
+}
+"""
+
+
+def test_collective_census_counts_async_once_with_group_sizes():
+    obs = hlocheck.collective_census(_HLO_RING)
+    # the sync cp counts once, the -start/-done pair once more; the
+    # -done halves never double-count
+    assert obs["collective-permute"]["count"] == 2
+    assert obs["all-reduce"]["count"] == 1
+    assert obs["all-reduce"]["group_sizes"] == [4]   # {{0,1,2,3}}
+    assert obs["all-gather"]["count"] == 1
+    assert obs["all-gather"]["group_sizes"] == [4]   # [2,4]<=[8] iota
+    assert "all-to-all" not in obs
+
+
+def test_matmul_dtype_census_and_donated_params():
+    assert hlocheck.matmul_dtype_census(_HLO_RING) == {"f32": 2,
+                                                      "bf16": 1}
+    # the alias table names params 0 and 2; 1 was dropped by XLA
+    assert hlocheck.donated_param_indices(_HLO_RING) == [0, 2]
+    assert hlocheck.donated_param_indices(_HLO) == []
+
+
+def test_audit_flags_unexpected_collective():
+    """A single-chip contract (max_total 0) catches ANY lowered
+    collective — the compiler-surprise face of R11."""
+    violations = hlocheck.audit_hlo(_HLO_RING,
+                                    {"collectives": {"max_total": 0}},
+                                    program="solo")
+    assert len(violations) == 1
+    v = violations[0]
+    assert v["check"] == "collective-budget"
+    assert v["rule"] == "replicated-psum" and v["program"] == "solo"
+    assert "4 collective(s)" in v["message"]
+
+
+def test_audit_per_op_min_max_bounds():
+    contract = {"collectives": {
+        "collective-permute": {"min": 3},   # ring didn't lower enough
+        "all-reduce": {"max": 0},           # the r06 smoking gun
+    }}
+    msgs = [v["message"]
+            for v in hlocheck.audit_hlo(_HLO_RING, contract)]
+    assert len(msgs) == 2
+    assert any("only 2 collective-permute(s)" in m for m in msgs)
+    assert any("1 all-reduce(s)" in m for m in msgs)
+
+
+def test_audit_dtype_drift():
+    violations = hlocheck.audit_hlo(
+        _HLO_RING, {"dtype": {"forbid": ["f32"], "allow_ops": 1}})
+    assert len(violations) == 1
+    assert violations[0]["rule"] == "dtype-drift"
+    assert "2 f32" in violations[0]["message"]
+    # within the allowance: silent
+    assert hlocheck.audit_hlo(
+        _HLO_RING, {"dtype": {"forbid": ["f32"], "allow_ops": 2}}) == []
+
+
+def test_audit_donation_drop_is_r13s_compiled_face():
+    violations = hlocheck.audit_hlo(
+        _HLO_RING, {"donation": {"require_params": [0, 1, 2]}})
+    assert len(violations) == 1
+    assert violations[0]["rule"] == "donation-drift"
+    assert "[1]" in violations[0]["message"]
+    assert hlocheck.audit_hlo(
+        _HLO_RING, {"donation": {"require_params": [0, 2]}}) == []
+
+
+def test_audit_programs_reports_census_and_unknown_is_record_only():
+    report = hlocheck.audit_programs(
+        {"ring": _HLO_RING, "mystery": _HLO},
+        {"programs": {"ring": {"collectives": {"all-reduce": {"max": 0}}}}})
+    assert not report["ok"]
+    assert [v["program"] for v in report["violations"]] == ["ring"]
+    # census is recorded for every program, contracted or not
+    assert report["programs"]["mystery"]["collectives"] == {}
+    assert report["programs"]["ring"]["donated_params"] == [0, 2]

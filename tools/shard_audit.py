@@ -1,0 +1,225 @@
+"""shard_audit — verify lowered programs against declared HLO contracts.
+
+The operator face of swarmproof's compiled side (analysis/hlocheck.py):
+builds the tiny-family programs the repo actually serves, captures their
+post-optimization HLO through ``obs/hlocost.ProgramCapture``, and audits
+the observed collective counts / matmul dtypes / donation aliasing
+against a pinned contract file. CI runs it against
+``tools/contracts/tiny.json`` (the test.yml "HLO contract" step); on a
+TPU deployment, point ``--contract`` at a pod-specific file that pins
+the real mesh's collective budget.
+
+Programs:
+
+- ``solo``       one tiny txt2img generate program, single device — the
+                 no-collectives baseline (any collective lowered into a
+                 single-chip program is a compiler surprise worth failing
+                 CI over).
+- ``lane``       the stepper's lane executables (encode / row-init /
+                 step / decode lattice programs) for one 2-row tiny job,
+                 single device — same budget.
+- ``ring``       the seq-parallel ring attention shard_map on a pure
+                 seq=4 mesh — MUST lower collective-permutes (the ring)
+                 and MUST NOT lower an all-reduce: an all-reduce over
+                 ``seq`` in this program is the runtime face of R11
+                 ``replicated-psum`` (the r06 4.000x divergence).
+- ``ring2axis``  the same ring bound on a data=2 x seq=4 mesh — the
+                 divergence family's trigger shape (two-axis shard_map);
+                 same contract as ``ring``.
+
+How this relates to ``tools/divergence_bisect.py``: the bisect localizes
+*where numerics first diverge at runtime*; this audit checks *what the
+compiler lowered* before anything runs. When the bisect names a step, the
+audit's collective census of the same program is the first thing to read.
+
+Exit codes: 0 = contract satisfied · 1 = violations · 2 = build error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _ensure_env() -> None:
+    """Mirror tests/conftest.py on CPU hosts: a virtual 8-device
+    platform, set BEFORE jax imports (same stance as
+    tools/divergence_bisect.py)."""
+    if os.environ.get("JAX_PLATFORMS", "") in ("", "cpu"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+
+DEFAULT_CONTRACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "contracts", "tiny.json")
+
+
+# ---------------------------------------------------------------------------
+# program builders: name -> HLO text
+
+
+def build_solo() -> str:
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.obs import hlocost
+    from chiaswarm_tpu.pipelines import GenerateRequest
+    import chiaswarm_tpu.pipelines.diffusion as diffusion_mod
+
+    registry = ModelRegistry(catalog=[], allow_random=True)
+    req = GenerateRequest(prompt="a lighthouse", steps=2, height=64,
+                          width=64, seed=7, guidance_scale=5.0)
+    cap = hlocost.ProgramCapture()
+    with cap.patching(diffusion_mod):
+        registry.pipeline("random/tiny")(req)
+    hlo = cap.largest_hlo()
+    if not hlo:
+        raise RuntimeError("solo capture produced no executable")
+    return hlo
+
+
+def build_lane() -> str:
+    os.environ.setdefault("CHIASWARM_STEPPER_LANE_WIDTH", "2")
+    from chiaswarm_tpu.node.registry import ModelRegistry
+    from chiaswarm_tpu.obs import hlocost
+    from chiaswarm_tpu.serving.stepper import StepScheduler
+    import chiaswarm_tpu.pipelines.diffusion as diffusion_mod
+
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+    pipe = registry.pipeline("tiny")
+    cap = hlocost.ProgramCapture()
+    with cap.patching(diffusion_mod):
+        sched = StepScheduler()
+        try:
+            fut = sched.submit_request(
+                pipe, prompt="audit lane", steps=2, guidance_scale=7.5,
+                height=64, width=64, rows=2, seed=11)
+            fut.result(timeout=600)[0].wait()
+        finally:
+            sched.shutdown()
+    hlo = cap.largest_hlo()
+    if not hlo:
+        raise RuntimeError("lane capture produced no executable "
+                           "(did the job ride the solo path?)")
+    return hlo
+
+
+def _build_ring(mesh_shape: dict) -> str:
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from chiaswarm_tpu.core.compat import shard_map
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.obs.hlocost import compiled_hlo_text
+    from chiaswarm_tpu.parallel.ring_attention import ring_attention
+
+    n = 1
+    for v in mesh_shape.values():
+        n *= v
+    mesh = build_mesh(MeshSpec(dict(mesh_shape)),
+                      devices=jax.devices()[:n])
+    b, l, h, d = 2, 32, 2, 16
+    spec = P("data" if mesh_shape.get("data", 1) > 1 else None,
+             "seq", None, None)
+    fn = shard_map(partial(ring_attention, axis_name="seq"),
+                   mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    args = [jnp.zeros((b, l, h, d), jnp.float32) for _ in range(3)]
+    compiled = jax.jit(fn).lower(*args).compile()
+    return compiled_hlo_text(compiled)
+
+
+def build_ring() -> str:
+    return _build_ring({"seq": 4})
+
+
+def build_ring2axis() -> str:
+    return _build_ring({"data": 2, "seq": 4})
+
+
+BUILDERS = {
+    "solo": build_solo,
+    "lane": build_lane,
+    "ring": build_ring,
+    "ring2axis": build_ring2axis,
+}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="audit lowered tiny-family programs against a "
+                    "pinned HLO contract (collectives, dtypes, donation)")
+    parser.add_argument("--programs", default="solo,lane,ring,ring2axis",
+                        help="comma-separated subset of: "
+                             + ",".join(sorted(BUILDERS)))
+    parser.add_argument("--contract", default=DEFAULT_CONTRACT,
+                        help="contract JSON (default: "
+                             "tools/contracts/tiny.json)")
+    parser.add_argument("--json", default=None,
+                        help="also write the full report to this path")
+    parser.add_argument("--dump-hlo", default=None,
+                        help="write each captured HLO under this prefix: "
+                             "<prefix>.<program>.hlo.txt")
+    args = parser.parse_args()
+
+    _ensure_env()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    from chiaswarm_tpu.analysis import hlocheck
+
+    try:
+        with open(args.contract, "r", encoding="utf-8") as fh:
+            contract = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"shard_audit: cannot read contract {args.contract}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    names = [p.strip() for p in args.programs.split(",") if p.strip()]
+    unknown = sorted(set(names) - set(BUILDERS))
+    if unknown:
+        print(f"shard_audit: unknown program(s) {unknown}; have "
+              f"{sorted(BUILDERS)}", file=sys.stderr)
+        return 2
+
+    programs: dict[str, str] = {}
+    for name in names:
+        try:
+            programs[name] = BUILDERS[name]()
+        except Exception as exc:  # noqa: BLE001 — a build failure IS the report
+            print(f"shard_audit: building {name!r} failed: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            return 2
+        if args.dump_hlo:
+            path = f"{args.dump_hlo}.{name}.hlo.txt"
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(programs[name])
+
+    report = hlocheck.audit_programs(programs, contract)
+    report["contract"] = args.contract
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report, indent=2, sort_keys=True))
+
+    for v in report["violations"]:
+        print(f"VIOLATION [{v['rule']}] {v['program']}: {v['message']}",
+              file=sys.stderr)
+    if report["ok"]:
+        print("shard_audit: contract satisfied", file=sys.stderr)
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,18 @@ R12   unreduced-out-spec      shard_map out_specs never claims replication
 R13   donation-drift          a buffer donated to a jitted wrapper is never
                               read after the call (compiled half: the HLO
                               alias table kept the donation — shard_audit)
+R14   cross-thread-device-    a jit-produced (async-dispatched) value is
+      handoff                 synchronized (block_until_ready/.copy()) before
+                              it is published to state another execution
+                              root consumes
+R15   unguarded-shared-       state written under a lock somewhere is never
+      mutation                mutated lock-free on a concurrent root (the
+                              RacerD mostly-locked discipline)
+R16   lock-order-inversion    no two concurrent roots take the same lock
+                              pair in opposite (ABBA) order
+R17   await-or-blocking-      no await while holding a threading lock; no
+      under-lock              time.sleep/socket/subprocess on the event loop
+                              (executor-dispatched helpers exempt)
 ====  ======================  ===============================================
 
 **The project index** (``analysis/project.py``, "swarmflow"): R1-R8 are
@@ -94,6 +106,26 @@ actually lowered — collective census, matmul dtype census, donation
 aliasing — against pinned per-program contracts
 (``tools/contracts/tiny.json`` in CI).
 
+**The raceflow layer** (``analysis/raceflow.py``, "swarmrace"): R14-R17
+are the third interpreter over the same index — where shardflow asks
+*what axes a value varies over*, raceflow asks *which execution roots a
+statement runs under and which locks it holds*. A thread-topology pass
+roots the call graph at every statically resolvable spawn site
+(``threading.Thread``/``Timer``, ``run_in_executor``,
+``asyncio.create_task`` and every coroutine sharing one event-loop
+root, ``io_callback``/``weakref.finalize`` registrations); a
+lock-discipline pass models ``with lock:`` regions (instance-attribute,
+module-global and parameter-passed locks), computes per-access guard
+sets with RacerD-style entry-held credit (a ``*_locked`` helper whose
+every recorded call site holds the lock counts as guarded), and builds
+the lock-order graph; a handoff pass taints jit-wrapper results flowing
+into shared containers. The three project interpreters are deliberately
+layered on ONE summary extraction (``project.py``, ``SCHEMA``-versioned
+cache): swarmflow resolves *names and calls*, shardflow adds *value
+semantics*, raceflow adds *execution context* — each reuses the
+call-graph machinery, chain rendering, and the baseline/marker
+conventions of the layer below.
+
 Baseline workflow: first adoption of a rule grandfathers existing findings
 into ``.swarmlint-baseline.json`` (``--write-baseline``). New findings fail;
 fixing a baselined finding makes its entry stale, which fails under
@@ -101,8 +133,11 @@ fixing a baselined finding makes its entry stale, which fails under
 ``--changed-only`` lints just the files changed vs the merge base with
 origin/main plus their reverse-dependency closure from the import graph
 (pre-commit; editing a mesh-defining module additionally re-lints every
-sharding consumer — axes travel through parameters, not imports); ``--sarif FILE`` exports new findings for GitHub code
-scanning with chains as codeFlows.
+sharding consumer — axes travel through parameters, not imports — and
+editing a module that defines an execution root or lock re-lints every
+module with concurrency facts, since roots and guards cross module
+boundaries without import edges too); ``--sarif FILE`` exports new
+findings for GitHub code scanning with chains as codeFlows.
 """
 
 from chiaswarm_tpu.analysis.core import (

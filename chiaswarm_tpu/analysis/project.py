@@ -41,11 +41,11 @@ from typing import Any, Iterable
 
 from chiaswarm_tpu.analysis.core import FunctionInfo, ModuleContext
 from chiaswarm_tpu.analysis.rules import (
-    JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes, resolves_to,
+    CALLBACK_WRAPPERS, JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes, resolves_to,
 )
 
-SCHEMA = 4  # v4: shardflow facts (mesh instances, spec axes, flow with
-#     conditional-arm "br" paths, donations)
+SCHEMA = 5  # v5: raceflow concurrency facts (spawns, lock regions, shared
+#     attribute accesses, device-handoff taint) + custom_vjp registrations
 DEFAULT_CACHE_NAME = ".swarmflow-cache.json"
 
 #: cross-chip collective primitives and the axis-name argument position
@@ -62,6 +62,63 @@ _SPEC_NAMES = ("jax.sharding.PartitionSpec", "PartitionSpec")
 _MESH_NAMES = ("jax.sharding.Mesh", "Mesh")
 _MESHSPEC_NAMES = ("MeshSpec",)
 _BUILD_MESH_NAMES = ("build_mesh",)
+
+# -- raceflow vocabulary ----------------------------------------------------
+#
+# Lock constructors and their kind: threading kinds participate in every
+# rule; "alock" (asyncio primitives) counts as a guard for R15 but never
+# as a lock the event loop may park on (R17) — awaiting an asyncio lock
+# is its intended use.
+_LOCK_CTORS: dict[str, str] = {
+    "threading.Lock": "lock", "threading.RLock": "rlock",
+    "threading.Condition": "cond", "threading.Semaphore": "sem",
+    "threading.BoundedSemaphore": "sem",
+    "asyncio.Lock": "alock", "asyncio.Condition": "alock",
+    "asyncio.Semaphore": "alock", "asyncio.BoundedSemaphore": "alock",
+}
+
+#: module-level container constructors: a global bound to one is shared
+#: mutable state the concurrency rules must track
+_MUTABLE_CTORS = (
+    "dict", "list", "set", "collections.deque", "deque",
+    "collections.OrderedDict", "collections.defaultdict",
+    "collections.Counter", "queue.Queue", "queue.SimpleQueue",
+    "queue.LifoQueue", "queue.PriorityQueue",
+)
+
+#: container methods that mutate the receiver (shared-state writes)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "add", "update",
+    "insert", "remove", "discard", "clear", "pop", "popleft",
+    "popitem", "setdefault", "put", "put_nowait",
+})
+
+#: calls/methods that force a device array resident on host — they END a
+#: device-handoff taint chain (ROADMAP: sync at admission, producer-side)
+_CONC_SYNCERS = (
+    "jax.block_until_ready", "jax.device_get",
+    "numpy.asarray", "numpy.array", "numpy.copy",
+)
+_CONC_SYNC_METHODS = frozenset(
+    {"block_until_ready", "copy", "item", "tolist"})
+
+#: calls that block the calling OS thread (R17 vocabulary; exact match —
+#: ``Condition.wait`` is deliberately absent, it releases its lock)
+_CONC_BLOCKING = frozenset({
+    "time.sleep", "socket.create_connection", "urllib.request.urlopen",
+    "subprocess.run", "subprocess.check_call", "subprocess.check_output",
+    "subprocess.call", "os.system", "select.select",
+    "requests.get", "requests.post", "requests.request",
+})
+
+#: inline suppressions, same convention as ``swarmlens: allow-host-sync``:
+#: the marker covers its own line, or the line below a comment-only line
+_CONC_ALLOW_MARKERS = {
+    "handoff": "swarmlens: allow-cross-thread-handoff",
+    "unguarded": "swarmlens: allow-unguarded-mutation",
+    "lockorder": "swarmlens: allow-lock-order",
+    "blocking": "swarmlens: allow-blocking-under-lock",
+}
 
 
 def _donate_decl(call: ast.Call) -> tuple[list[int], list[str]]:
@@ -519,6 +576,8 @@ class _Summarizer:
         summary.update(self._sharding_facts(ctx))
         summary["meshes"] = self._mesh_instances(ctx)
         summary["donations"] = self._donations(ctx)
+        summary["conc"] = self._conc_facts(ctx)
+        summary["customvjp"] = self._customvjp_facts(ctx)
         return summary
 
     def _func_summary(self, info: FunctionInfo) -> dict:
@@ -547,6 +606,7 @@ class _Summarizer:
             "kwreq": [arg.arg for arg, d in zip(a.kwonlyargs, a.kw_defaults)
                       if d is None],
             "meth": first in ("self", "cls"),
+            "isasync": isinstance(node, ast.AsyncFunctionDef),
             "calls": calls,
             "methods": methods,
             "sync": sync,
@@ -720,6 +780,564 @@ class _Summarizer:
                         local = by_name.get(dotted, [])
                         roots.extend(local)
         return {"jit_roots": sorted(set(roots)), "jit_refs": refs}
+
+    # -- concurrency facts (raceflow) -------------------------------------
+    #
+    # One extra summary key, ``conc``, carries everything the raceflow
+    # interpreter (analysis/raceflow.py) needs — the flow IR above stays
+    # untouched. Lock tokens are strings: ``s:Cls.attr`` (an instance
+    # attribute, class resolved at extraction), ``g:NAME`` (module
+    # global), ``p:name`` (a lock received as a parameter — only
+    # meaningful once a call site substitutes it), ``d:dotted`` (an
+    # imported lock, absolute path). Shared-state tokens are ``a:Cls.X``
+    # / ``g:NAME``; raceflow prefixes the module to both namespaces.
+
+    def _conc_facts(self, ctx: ModuleContext) -> dict:
+        tree = ctx.tree
+        classnames = {n.name for n in ast.walk(tree)
+                      if isinstance(n, ast.ClassDef)}
+        lockdefs = self._lockdefs(ctx, classnames)
+        mod_locks = {d["attr"] for d in lockdefs if not d["cls"]}
+        cls_locks = {(d["cls"], d["attr"]) for d in lockdefs if d["cls"]}
+        jattrs, jitw, jitfuncs = self._jit_values(ctx, classnames)
+        gmut = self._mutable_globals(tree)
+        spawns = self._spawn_sites(ctx)
+        funcs: dict[str, dict] = {}
+        for info in ctx.functions:
+            facts = self._conc_func(ctx, info, classnames, cls_locks,
+                                    mod_locks, gmut, jattrs, jitw, jitfuncs)
+            if facts:
+                funcs[info.qualname] = facts
+        out: dict[str, Any] = {}
+        if spawns:
+            out["spawns"] = spawns
+        if lockdefs:
+            out["lockdefs"] = lockdefs
+        if funcs:
+            out["funcs"] = funcs
+        allow = self._allow_lines(ctx)
+        if allow:
+            out["allow"] = allow
+        return out
+
+    def _owning_class(self, ctx: ModuleContext, node: ast.AST,
+                      classnames: set[str]) -> str | None:
+        head = ctx.symbol_for(node).split(".")[0]
+        return head if head in classnames else None
+
+    def _lockdefs(self, ctx: ModuleContext,
+                  classnames: set[str]) -> list[dict]:
+        out: list[dict] = []
+        top = set(ctx.tree.body)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                continue
+            t, _ = self.callable_target(node.value)
+            kind = next((k for name, k in _LOCK_CTORS.items()
+                         if resolves_to(t, name)), None)
+            if kind is None:
+                continue
+            alias = None
+            args = node.value.args
+            if kind in ("cond", "alock") and args:
+                # Condition(self._lock) shares its sibling's identity
+                a0 = args[0]
+                if (isinstance(a0, ast.Attribute)
+                        and isinstance(a0.value, ast.Name)
+                        and a0.value.id in ("self", "cls")):
+                    alias = a0.attr
+                elif isinstance(a0, ast.Name):
+                    alias = a0.id
+            tgt = node.targets[0]
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in ("self", "cls")):
+                c = self._owning_class(ctx, node, classnames)
+                if c:
+                    out.append({"cls": c, "attr": tgt.attr, "kind": kind,
+                                "ln": node.lineno, "alias": alias})
+            elif isinstance(tgt, ast.Name) and node in top:
+                out.append({"cls": "", "attr": tgt.id, "kind": kind,
+                            "ln": node.lineno, "alias": alias})
+        return out
+
+    def _jit_values(self, ctx: ModuleContext, classnames: set[str],
+                    ) -> tuple[dict[str, set[str]], set[str], set[str]]:
+        """Names whose CALL dispatches compiled work: ``self.X = jit(f)``
+        attributes per class, module-level ``F = jit(f)`` globals, and
+        ``@jit``-decorated function names — the R14 taint producers."""
+        jattrs: dict[str, set[str]] = {}
+        jitw: set[str] = set()
+        top = set(ctx.tree.body)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                continue
+            t, _ = self.callable_target(node.value)
+            if not resolves_to(t, *JIT_WRAPPERS):
+                continue
+            tgt = node.targets[0]
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in ("self", "cls")):
+                c = self._owning_class(ctx, node, classnames)
+                if c:
+                    jattrs.setdefault(c, set()).add(tgt.attr)
+            elif isinstance(tgt, ast.Name) and node in top:
+                jitw.add(tgt.id)
+        jitfuncs: set[str] = set()
+        for info in ctx.functions:
+            if isinstance(info.node,
+                          (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in info.node.decorator_list:
+                    t, _ = self.callable_target(dec)
+                    if resolves_to(t, *JIT_WRAPPERS):
+                        jitfuncs.add(info.node.name)
+        return jattrs, jitw, jitfuncs
+
+    def _mutable_globals(self, tree: ast.Module) -> set[str]:
+        muts: set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                tgt, val = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                tgt, val = node.target.id, node.value
+            else:
+                continue
+            if isinstance(val, (ast.Dict, ast.List, ast.Set)):
+                muts.add(tgt)
+            elif isinstance(val, ast.Call):
+                t, _ = self.callable_target(val)
+                if t in _MUTABLE_CTORS:
+                    muts.add(tgt)
+        for node in ast.walk(tree):
+            # ``global NAME`` + assignment = shared scalar state
+            if isinstance(node, ast.Global):
+                muts.update(node.names)
+        return muts
+
+    def _spawn_sites(self, ctx: ModuleContext) -> list[dict]:
+        out: list[dict] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = self.resolve(node.func)
+            # method-name detection survives unresolvable receivers:
+            # ``asyncio.get_running_loop().run_in_executor(...)``
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            kind = tgt = None
+            if resolves_to(t, "threading.Thread"):
+                kind, tgt = "thread", kw.get("target")
+            elif resolves_to(t, "threading.Timer"):
+                kind = "thread"
+                tgt = (node.args[1] if len(node.args) > 1
+                       else kw.get("function"))
+            elif attr == "run_in_executor":
+                kind = "exec"
+                tgt = node.args[1] if len(node.args) > 1 else None
+            elif (resolves_to(t, "asyncio.create_task",
+                              "asyncio.ensure_future", "asyncio.run")
+                  or attr in ("create_task", "ensure_future")):
+                kind = "task"
+                tgt = node.args[0] if node.args else None
+            elif resolves_to(t, *CALLBACK_WRAPPERS):
+                kind = "cb"
+                tgt = node.args[0] if node.args else kw.get("callback")
+            elif resolves_to(t, "weakref.finalize"):
+                kind = "fin"
+                tgt = node.args[1] if len(node.args) > 1 else None
+            if kind is None or tgt is None:
+                continue
+            while isinstance(tgt, ast.Call):
+                # functools.partial(fn, ...) spawns fn; a plain call
+                # (create_task(self._poll())) spawns its callee
+                inner = self.resolve(tgt.func)
+                if resolves_to(inner, "functools.partial", "partial") \
+                        and tgt.args:
+                    tgt = tgt.args[0]
+                else:
+                    tgt = tgt.func
+            ref = self.resolve(tgt)
+            if ref is None:
+                continue
+            out.append({"k": kind, "t": ref, "ln": node.lineno,
+                        "symbol": ctx.symbol_for(node)})
+        return out
+
+    def _allow_lines(self, ctx: ModuleContext) -> dict[str, list[int]]:
+        out: dict[str, list[int]] = {}
+        for i, text in enumerate(ctx.source.splitlines(), start=1):
+            for kind, marker in _CONC_ALLOW_MARKERS.items():
+                if marker in text:
+                    lines = out.setdefault(kind, [])
+                    lines.append(i)
+                    if text.lstrip().startswith("#"):
+                        lines.append(i + 1)
+        return out
+
+    def _conc_func(self, ctx: ModuleContext, info: FunctionInfo,
+                   classnames: set[str],
+                   cls_locks: set[tuple[str, str]], mod_locks: set[str],
+                   gmut: set[str], jattrs: dict[str, set[str]],
+                   jitw: set[str], jitfuncs: set[str]) -> dict | None:
+        """Per-function event stream: lock regions entered (``acq``),
+        awaits (``aw``), blocking calls (``bl``), shared-state accesses
+        (``at``), device-handoff publishes (``ho``) and lock-relevant
+        calls (``cw``) — every event tagged with the held-lock stack."""
+        node = info.node
+        if isinstance(node, ast.Lambda):
+            return None
+        qual = info.qualname
+        head = qual.split(".")[0]
+        cls = head if head in classnames else None
+        a = node.args
+        params = [arg.arg for arg in a.posonlyargs + a.args + a.kwonlyargs]
+        cls_jattrs = jattrs.get(cls, set()) if cls else set()
+        localfns = {i.node.name for i in ctx.functions
+                    if isinstance(i.node,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef))}
+        facts: dict[str, list] = {"acq": [], "aw": [], "bl": [],
+                                  "at": [], "ho": [], "cw": []}
+        held: list[str] = []
+        lock_alias: dict[str, str] = {}  # local = self._lock one-hop alias
+        local_jitw: set[str] = set()
+        tainted: dict[str, str] = {}     # local -> producing dispatch
+        g_decl: set[str] = set()
+        for n in own_nodes(node):
+            if isinstance(n, ast.Global):
+                g_decl.update(n.names)
+
+        def lock_token(e: ast.AST) -> str | None:
+            if (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id in ("self", "cls")):
+                return f"s:{cls}.{e.attr}" if cls else None
+            if isinstance(e, ast.Name):
+                nid = e.id
+                if nid in lock_alias:
+                    return lock_alias[nid]
+                if nid in mod_locks:
+                    return "g:" + nid
+                if nid in params:
+                    return "p:" + nid
+                if nid in self.aliases and "." in self.aliases[nid]:
+                    return "d:" + self.aliases[nid]
+                return None
+            if isinstance(e, ast.Attribute):
+                base = e
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id in self.aliases:
+                    dotted = self.resolve(e)
+                    if dotted:
+                        return "d:" + dotted
+            return None
+
+        def producer_of(call: ast.Call) -> str | None:
+            func = call.func
+            if isinstance(func, ast.Call):  # inline jit(f)(x)
+                inner, _ = self.callable_target(func)
+                if resolves_to(inner, *JIT_WRAPPERS):
+                    return inner or "jit"
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("self", "cls")
+                    and func.attr in cls_jattrs):
+                return "self." + func.attr
+            if isinstance(func, ast.Name):
+                nid = func.id
+                if nid in local_jitw or nid in jitw or nid in jitfuncs:
+                    return nid
+            return None
+
+        def taint_of(e: ast.AST | None) -> str | None:
+            if e is None:
+                return None
+            if isinstance(e, ast.Call):
+                t = self.resolve(e.func)
+                if t in _CONC_SYNCERS:
+                    return None
+                if (isinstance(e.func, ast.Attribute)
+                        and e.func.attr in _CONC_SYNC_METHODS
+                        and not e.args and not e.keywords):
+                    return None
+                p = producer_of(e)
+                if p:
+                    return p
+                for sub in list(e.args) + [k.value for k in e.keywords]:
+                    got = taint_of(sub)
+                    if got:
+                        return got
+                if isinstance(e.func, ast.Attribute):
+                    return taint_of(e.func.value)
+                return None
+            if isinstance(e, ast.Name):
+                return tainted.get(e.id)
+            if isinstance(e, ast.Await):
+                return taint_of(e.value)
+            if isinstance(e, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return None
+            for child in ast.iter_child_nodes(e):
+                got = taint_of(child)
+                if got:
+                    return got
+            return None
+
+        def attr_key(e: ast.AST) -> str | None:
+            if (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id in ("self", "cls") and cls):
+                return f"a:{cls}.{e.attr}"
+            if isinstance(e, ast.Name) and e.id in gmut:
+                return "g:" + e.id
+            return None
+
+        def rec_at(key: str, w: int, ln: int) -> None:
+            facts["at"].append({"n": key, "w": w, "ln": ln,
+                                "held": list(held)})
+
+        def do_call(call: ast.Call, ln: int) -> None:
+            ln = getattr(call, "lineno", ln)
+            t = self.resolve(call.func)
+            if t in _CONC_BLOCKING:
+                facts["bl"].append({"t": t, "ln": ln, "held": list(held)})
+            func = call.func
+            if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+                key = attr_key(func.value)
+                if key:
+                    rec_at(key, 1, ln)
+                    via = None
+                    for sub in (list(call.args)
+                                + [k.value for k in call.keywords]):
+                        via = taint_of(sub)
+                        if via:
+                            break
+                    if via:
+                        facts["ho"].append({"n": key, "ln": ln,
+                                            "via": via})
+            target = None
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in ("self", "cls")):
+                target = "self." + func.attr
+            elif t and not t.startswith(("self.", "cls.")):
+                target = t
+            la = {str(i): tok for i, sub in enumerate(call.args)
+                  if (tok := lock_token(sub))}
+            # self/local-function calls are recorded even lock-free: the
+            # caller-held intersection (raceflow) needs EVERY call site
+            # of a ``*_locked``-style helper, not just the guarded ones
+            local_call = target is not None and (
+                target.startswith(("self.", "cls."))
+                or ("." not in target and target in localfns))
+            if target and (held or la or local_call):
+                facts["cw"].append({"t": target, "ln": ln,
+                                    "held": list(held), "la": la})
+            if isinstance(func, ast.Attribute):
+                scan(func.value, ln)
+            for sub in call.args:
+                scan(sub, ln)
+            for k in call.keywords:
+                scan(k.value, ln)
+
+        def scan(e: ast.AST | None, ln: int) -> None:
+            if e is None or isinstance(e, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef,
+                                           ast.Lambda, ast.ClassDef)):
+                return
+            ln = getattr(e, "lineno", ln)
+            if isinstance(e, ast.Await):
+                facts["aw"].append({"ln": ln, "held": list(held)})
+                scan(e.value, ln)
+                return
+            if isinstance(e, ast.Call):
+                do_call(e, ln)
+                return
+            key = attr_key(e)
+            if key is not None and isinstance(getattr(e, "ctx", None),
+                                              ast.Load):
+                rec_at(key, 0, ln)
+                return
+            for child in ast.iter_child_nodes(e):
+                scan(child, ln)
+
+        def do_store(tgt: ast.AST, via: str | None, ln: int) -> None:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                for e in tgt.elts:
+                    do_store(e, via, ln)
+                return
+            if isinstance(tgt, ast.Starred):
+                do_store(tgt.value, via, ln)
+                return
+            if isinstance(tgt, ast.Subscript):
+                key = attr_key(tgt.value)
+                if key:
+                    rec_at(key, 1, ln)
+                    if via:
+                        facts["ho"].append({"n": key, "ln": ln,
+                                            "via": via})
+                scan(tgt.slice, ln)
+                return
+            key = attr_key(tgt)
+            if key is None:
+                return
+            if key.startswith("g:") and isinstance(tgt, ast.Name) \
+                    and tgt.id not in g_decl:
+                return  # a local shadowing a mutable-global name
+            rec_at(key, 1, ln)
+            if via:
+                facts["ho"].append({"n": key, "ln": ln, "via": via})
+
+        def do_stmts(stmts: list) -> None:
+            for st in stmts:
+                do_stmt(st)
+
+        def do_stmt(st: ast.stmt) -> None:
+            ln = getattr(st, "lineno", 0)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                return
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                if isinstance(st, ast.AsyncWith):
+                    facts["aw"].append({"ln": ln, "held": list(held)})
+                got: list[str] = []
+                for item in st.items:
+                    ce = item.context_expr
+                    tok = (lock_token(ce)
+                           if not isinstance(ce, ast.Call) else None)
+                    if tok is not None:
+                        facts["acq"].append({"l": tok, "ln": ln,
+                                             "held": list(held)})
+                        held.append(tok)
+                        got.append(tok)
+                    else:
+                        scan(ce, ln)
+                do_stmts(st.body)
+                for tok in got:
+                    held.remove(tok)
+                return
+            if isinstance(st, ast.Assign):
+                via = taint_of(st.value)
+                if len(st.targets) == 1 \
+                        and isinstance(st.targets[0], ast.Name) \
+                        and st.targets[0].id not in g_decl:
+                    nid = st.targets[0].id
+                    tok = (lock_token(st.value)
+                           if not isinstance(st.value, ast.Call) else None)
+                    if tok:
+                        lock_alias[nid] = tok
+                    else:
+                        lock_alias.pop(nid, None)
+                    if isinstance(st.value, ast.Call):
+                        it, _ = self.callable_target(st.value)
+                        if resolves_to(it, *JIT_WRAPPERS):
+                            local_jitw.add(nid)
+                    if via:
+                        tainted[nid] = via
+                    else:
+                        tainted.pop(nid, None)
+                for tgt in st.targets:
+                    do_store(tgt, via, ln)
+                scan(st.value, ln)
+                return
+            if isinstance(st, ast.AugAssign):
+                do_store(st.target, taint_of(st.value), ln)
+                scan(st.value, ln)
+                return
+            if isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    do_store(st.target, taint_of(st.value), ln)
+                    scan(st.value, ln)
+                return
+            if isinstance(st, ast.Expr):
+                v = st.value
+                if isinstance(v, ast.Call):
+                    # a bare sync statement clears the named value:
+                    # ``jax.block_until_ready(y)`` / ``y.block_until_ready()``
+                    t = self.resolve(v.func)
+                    if t in _CONC_SYNCERS and v.args \
+                            and isinstance(v.args[0], ast.Name):
+                        tainted.pop(v.args[0].id, None)
+                    if (isinstance(v.func, ast.Attribute)
+                            and v.func.attr in _CONC_SYNC_METHODS
+                            and isinstance(v.func.value, ast.Name)):
+                        tainted.pop(v.func.value.id, None)
+                scan(v, ln)
+                return
+            if isinstance(st, (ast.If, ast.While)):
+                scan(st.test, ln)
+                do_stmts(st.body)
+                do_stmts(st.orelse)
+                return
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                if isinstance(st, ast.AsyncFor):
+                    facts["aw"].append({"ln": ln, "held": list(held)})
+                scan(st.iter, ln)
+                do_store(st.target, None, ln)
+                do_stmts(st.body)
+                do_stmts(st.orelse)
+                return
+            if isinstance(st, ast.Try):
+                do_stmts(st.body)
+                for h in st.handlers:
+                    do_stmts(h.body)
+                do_stmts(st.orelse)
+                do_stmts(st.finalbody)
+                return
+            if isinstance(st, ast.Return):
+                scan(st.value, ln)
+                return
+            if isinstance(st, ast.Delete):
+                for tgt in st.targets:
+                    key = (attr_key(tgt)
+                           or (attr_key(tgt.value)
+                               if isinstance(tgt, ast.Subscript) else None))
+                    if key:
+                        rec_at(key, 1, ln)
+                return
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    scan(child, ln)
+                elif isinstance(child, ast.stmt):
+                    do_stmt(child)
+                elif hasattr(child, "body"):  # match_case and friends
+                    do_stmts(getattr(child, "body"))
+
+        do_stmts(node.body)
+        facts = {k: v for k, v in facts.items() if v}
+        return facts or None
+
+    # -- custom_vjp / custom_jvp registrations (shardflow satellite) ------
+    def _customvjp_facts(self, ctx: ModuleContext) -> list[dict]:
+        """``f.defvjp(fwd, bwd)`` / ``f.defjvp(...)`` sites: the primal
+        and its companion functions, so shardflow can explore collective
+        use inside custom-derivative bodies the call graph never reaches
+        through ordinary calls."""
+        out: list[dict] = []
+        for call in ast.walk(ctx.tree):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in ("defvjp", "defjvp", "defjvps")):
+                continue
+            primal = self.resolve(call.func.value)
+            if primal is None or primal.startswith(("self.", "cls.")):
+                continue
+            fns = []
+            for a in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(a, (ast.Name, ast.Attribute)):
+                    r = self.resolve(a)
+                    if r and not r.startswith(("self.", "cls.")):
+                        fns.append(r)
+            if fns:
+                out.append({"p": primal, "fns": fns, "ln": call.lineno})
+        return out
 
     # -- spec / mesh variable maps (shardflow) ----------------------------
     def _collect_spec_vars(self, tree: ast.Module) -> None:
@@ -1366,6 +1984,15 @@ class ProjectIndex:
         if any(self._defines_mesh(rel) for rel in out):
             out |= {rel for rel in self.summaries
                     if self._consumes_sharding(rel)}
+        # Same provenance rule for concurrency vocabulary: a module that
+        # DEFINES an execution root or a lock changes the thread topology
+        # every raceflow verdict depends on, so editing it re-lints every
+        # module with concurrency facts of its own (lock regions, spawns,
+        # handoffs — attribute-only modules can't host an R14–R17 finding
+        # and stay out).
+        if any(self._defines_conc(rel) for rel in out):
+            out |= {rel for rel in self.summaries
+                    if self._consumes_conc(rel)}
         frontier = list(out)
         while frontier:
             rel = frontier.pop()
@@ -1383,6 +2010,18 @@ class ProjectIndex:
         s = self.summaries[rel]
         return bool(s.get("specs") or s.get("shard_maps")
                     or s.get("collectives"))
+
+    def _defines_conc(self, rel: str) -> bool:
+        conc = self.summaries[rel].get("conc") or {}
+        return bool(conc.get("spawns") or conc.get("lockdefs"))
+
+    def _consumes_conc(self, rel: str) -> bool:
+        conc = self.summaries[rel].get("conc") or {}
+        if conc.get("spawns") or conc.get("lockdefs"):
+            return True
+        return any(f.get("acq") or f.get("aw") or f.get("bl")
+                   or f.get("ho") or f.get("cw")
+                   for f in (conc.get("funcs") or {}).values())
 
     # -- mesh instances (per-mesh-instance universes, R10 extension) -------
     def _mesh_var(self, module: str, var: str,

@@ -1,0 +1,612 @@
+"""raceflow — whole-program concurrency proofs over the swarmflow index.
+
+The reference worker is one asyncio process; this reproduction runs six
+concurrent execution roots (event loop, executor job threads, watchdog
+monitor, lane decode threads, residency prefetch daemon, loadgen probe).
+Every concurrency bug the repo has shipped-and-fixed — PR 3's live-numpy
+/ in-flight-array container hazards, PR 10's fired-vs-condemn race — was
+found dynamically. raceflow encodes those disciplines statically, the
+third interpreter over the swarmflow project index (swarmflow builds the
+call graph, shardflow replays value sharding, raceflow replays *who runs
+where holding what*). Pure stdlib, no jax import.
+
+Two passes, four rules:
+
+**Thread topology.** Every statically resolvable execution-root site —
+``threading.Thread(target=...)``, ``loop.run_in_executor(...)``,
+``io_callback``/``pure_callback`` bodies, ``weakref.finalize`` callbacks
+— becomes a distinct root; all ``async def`` functions (plus
+``create_task`` targets) seed the shared *event loop* root. A BFS from
+each root's seeds over the call graph yields, per function, the set of
+roots that may execute it. Two accesses race only when their root sets
+contain two *different* roots; a single-rooted program is silent by
+construction.
+
+**Lock discipline.** ``with self._lock:`` regions (locks resolved
+through instance attributes, module globals, imports and — for the
+lock-order pass — parameters), with ``Condition(self._lock)`` aliasing
+folded to the underlying lock. Every summarized event carries the
+held-lock stack at that program point.
+
+Rules (all conservative: unresolvable targets/locks are silent):
+
+- **R14 cross-thread-device-handoff** — a value produced by a jit/lane
+  dispatch is published into a shared container/attribute without
+  ``block_until_ready``/``.copy()``/``np.asarray`` while another root
+  consumes that state: PR 3's two container hazards as lint findings.
+  The fix is producer-side (ROADMAP: sync at admission; resolve futures
+  only once outputs are resident).
+- **R15 unguarded-shared-mutation** — RacerD-style mostly-locked
+  inference: state written under a lock on some path but mutated
+  lock-free on a concurrent root's path (``__init__`` writes exempt —
+  the object is not yet shared).
+- **R16 lock-order-inversion** — ABBA: lock A held while taking B in
+  one root, B held while taking A in another (interprocedural, with
+  one-level substitution of locks passed as parameters).
+- **R17 await-or-blocking-under-lock** — ``await`` (or blocking I/O)
+  while holding a ``threading`` lock parks the event loop with the lock
+  held; plus ``time.sleep``/socket I/O lexically inside a coroutine or
+  in a sync function a coroutine calls directly.
+
+Findings carry full root→site chains (the spawn site, then the call
+path) rendered in text/JSON/SARIF exactly like R9–R13, and key into the
+shrink-only baseline. Suppressions: ``# swarmlens: allow-<kind>``
+markers (``allow-cross-thread-handoff``, ``allow-unguarded-mutation``,
+``allow-lock-order``, ``allow-blocking-under-lock``) on the finding line
+or the comment line above, each stating the invariant that makes the
+site safe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING
+
+from chiaswarm_tpu.analysis.core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+R14 = "cross-thread-device-handoff"
+R15 = "unguarded-shared-mutation"
+R16 = "lock-order-inversion"
+R17 = "await-or-blocking-under-lock"
+
+_ROOT_NOUN = {"thread": "thread root", "exec": "executor root",
+              "cb": "host-callback root", "fin": "finalizer root"}
+#: lock kinds an OS thread can park on (asyncio primitives excluded)
+_THREADING_KINDS = frozenset({"lock", "rlock", "cond", "sem"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Root:
+    rid: str
+    label: str
+    kind: str
+    #: spawn-site chain hop (relpath, line, qualname); None for the loop
+    hop: tuple[str, int, str] | None
+
+
+class RaceflowAnalysis:
+    """Run the topology + lock passes and evaluate R14–R17.
+
+    Build once per index via :func:`results`; ``findings`` holds every
+    violation, tagged with the rule name, sorted by location.
+    """
+
+    def __init__(self, index: "ProjectIndex"):
+        self.index = index
+        self.findings: list[Finding] = []
+        self._collect()
+        self._topology()
+        self._entry_held()
+        self._shared()
+        self._r14()
+        self._r15()
+        self._r16()
+        self._r17()
+        self.findings.sort(
+            key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    # -- facts -------------------------------------------------------------
+    def _collect(self) -> None:
+        idx = self.index
+        self.conc: dict[str, dict] = {}          # module -> conc summary
+        self.lockkind: dict[str, str] = {}       # canonical token -> kind
+        self.lockalias: dict[str, str] = {}      # Condition(sibling) folds
+        self.allow: dict[str, dict[str, set[int]]] = {}
+        for rel in sorted(idx.summaries):
+            s = idx.summaries[rel]
+            m = s["module"]
+            conc = s.get("conc") or {}
+            self.conc[m] = conc
+            for d in conc.get("lockdefs", ()):
+                tok = (f"{m}.{d['cls']}.{d['attr']}" if d["cls"]
+                       else f"{m}.{d['attr']}")
+                self.lockkind[tok] = d["kind"]
+                if d.get("alias"):
+                    self.lockalias[tok] = (
+                        f"{m}.{d['cls']}.{d['alias']}" if d["cls"]
+                        else f"{m}.{d['alias']}")
+            al = conc.get("allow") or {}
+            if al:
+                self.allow[rel] = {k: set(v) for k, v in al.items()}
+
+    def _allowed(self, rel: str, kind: str, *lines: int) -> bool:
+        have = self.allow.get(rel, {}).get(kind, ())
+        return any(ln in have for ln in lines)
+
+    def canon(self, tok: str, module: str) -> str | None:
+        """Canonical lock identity for a summarizer token (alias-chased);
+        None for parameter locks and unresolvable expressions. A
+        canonical token is *known* iff it appears in ``lockkind`` —
+        unknown tokens still suppress "unguarded" verdicts (holding
+        *something* is not lock-free) but never serve as evidence."""
+        if tok.startswith(("s:", "g:")):
+            out = f"{module}.{tok[2:]}"
+        elif tok.startswith("d:"):
+            out = tok[2:]
+        else:  # p: parameter — meaningful only via call-site substitution
+            return None
+        for _ in range(4):
+            nxt = self.lockalias.get(out)
+            if nxt is None:
+                break
+            out = nxt
+        return out
+
+    def _known_held(self, held: list[str], module: str,
+                    kinds: frozenset | None = None) -> list[str]:
+        out = []
+        for h in held:
+            c = self.canon(h, module)
+            if c and c in self.lockkind and (
+                    kinds is None or self.lockkind[c] in kinds):
+                out.append(c)
+        return out
+
+    # -- thread topology ---------------------------------------------------
+    def _topology(self) -> None:
+        idx = self.index
+        self.roots: dict[str, Root] = {}
+        self.parent: dict[str, dict] = {}
+        seeds: dict[str, set] = {}
+        loop_seeds = {key for key, f in idx.funcs.items()
+                      if f.get("isasync")}
+        for m in sorted(self.conc):
+            rel = idx.modules.get(m)
+            for sp in self.conc[m].get("spawns", ()):
+                targets = self._spawn_targets(m, sp["t"])
+                if not targets:
+                    continue
+                if sp["k"] == "task":
+                    # coroutines scheduled on the one event loop — same
+                    # root as every other coroutine
+                    loop_seeds.update(targets)
+                    continue
+                rid = f"{sp['k']}:{m}.{sp['symbol']}:{sp['t']}"
+                if rid not in self.roots:
+                    self.roots[rid] = Root(
+                        rid=rid, kind=sp["k"],
+                        label=(f"the {_ROOT_NOUN[sp['k']]} spawned in "
+                               f"{m}.{sp['symbol']}"),
+                        hop=(rel, sp["ln"], f"{m}.{sp['symbol']}"))
+                seeds.setdefault(rid, set()).update(targets)
+        if loop_seeds:
+            self.roots["loop"] = Root("loop", "the event loop", "loop",
+                                      None)
+            seeds["loop"] = loop_seeds
+        self.rootfns: dict[tuple[str, str], set[str]] = {}
+        self.nonloop_seeds: set[tuple[str, str]] = set()
+        for rid in sorted(seeds):
+            if rid != "loop":
+                self.nonloop_seeds |= seeds[rid]
+            par = idx.reach_with_parents(seeds[rid])
+            self.parent[rid] = par
+            for key in par:
+                self.rootfns.setdefault(key, set()).add(rid)
+
+    def _spawn_targets(self, module: str,
+                       t: str) -> list[tuple[str, str]]:
+        idx = self.index
+        if t.startswith(("self.", "cls.")):
+            name = t.split(".", 1)[1]
+            if "." in name:
+                return []
+            rel = idx.modules.get(module)
+            if rel is None:
+                return []
+            quals = idx.summaries[rel]["names"].get(name, [])
+            return [(module, q) for q in quals]
+        return list(idx.func_targets(module, t))
+
+    def _concurrent(self, ra: set[str],
+                    rb: set[str]) -> tuple[str, str] | None:
+        for x in sorted(ra):
+            for y in sorted(rb):
+                if x != y:
+                    return x, y
+        return None
+
+    def _chain(self, rid: str | None, key: tuple[str, str],
+               sink: tuple[str, int, str]) -> tuple:
+        hops: list[tuple[str, int, str]] = []
+        if rid is not None:
+            root = self.roots[rid]
+            if root.hop is not None:
+                hops.append(root.hop)
+            par = self.parent.get(rid, {})
+            if key in par:
+                hops.extend(self.index.chain(par, key))
+        if not hops or hops[-1][:2] != sink[:2]:
+            hops.append(sink)
+        return tuple(hops)
+
+    # -- caller-held lock context --------------------------------------------
+    def _entry_held(self) -> None:
+        """Locks provably held at ENTRY to each function: the
+        intersection, over every recorded call site, of the caller's
+        lexical held stack plus the caller's own entry set (fixpoint).
+        This is how ``_evict_locked``-style helpers — lock taken by the
+        caller, never lexically in the helper — get guard credit instead
+        of a false R15. Over-approximates guarding only (a caller the
+        summarizer could not resolve contributes nothing to the
+        intersection-breaking side), so it can hide a racy helper whose
+        unguarded caller is invisible — never invent a race."""
+        callers: dict[tuple[str, str],
+                      list[tuple[tuple[str, str], frozenset]]] = {}
+        for m in sorted(self.conc):
+            for q, f in (self.conc[m].get("funcs") or {}).items():
+                key = (m, q)
+                for cw in f.get("cw", ()):
+                    helds = frozenset(self._known_held(cw["held"], m))
+                    for g in self._call_targets(m, cw["t"]):
+                        if g != key:
+                            callers.setdefault(g, []).append((key, helds))
+        entry: dict[tuple[str, str], set[str] | None] = {
+            g: None for g in callers}  # None = top (not yet constrained)
+        for _ in range(32):
+            changed = False
+            for g, recs in callers.items():
+                acc: set[str] | None = None
+                for ck, helds in recs:
+                    ev = entry.get(ck)  # callers outside the map: empty
+                    base = set() if ck not in entry else ev
+                    if base is None:
+                        continue  # still top: identity for intersection
+                    contrib = helds | base
+                    acc = set(contrib) if acc is None else acc & contrib
+                if acc != entry[g] and acc is not None:
+                    entry[g] = acc
+                    changed = True
+            if not changed:
+                break
+        self.entry: dict[tuple[str, str], set[str]] = {
+            g: v for g, v in entry.items() if v}
+
+    def _entry_locks(self, key: tuple[str, str],
+                     kinds: frozenset | None = None) -> list[str]:
+        out = self.entry.get(key, ())
+        if kinds is None:
+            return sorted(out)
+        return sorted(c for c in out if self.lockkind.get(c) in kinds)
+
+    # -- shared-state table --------------------------------------------------
+    def _shared(self) -> None:
+        idx = self.index
+        self.acc: dict[str, list[dict]] = {}
+        self.hand: dict[str, list[dict]] = {}
+        for m in sorted(self.conc):
+            rel = idx.modules.get(m)
+            funcs = self.conc[m].get("funcs") or {}
+            for q in sorted(funcs):
+                f = funcs[q]
+                key = (m, q)
+                roots = self.rootfns.get(key, set())
+                sym = f"{m}.{q}"
+                entry = self._entry_locks(key)
+                for at in f.get("at", ()):
+                    tok = f"{m}.{at['n'][2:]}"
+                    self.acc.setdefault(tok, []).append({
+                        "key": key, "rel": rel, "q": q, "sym": sym,
+                        "w": at["w"], "ln": at["ln"], "roots": roots,
+                        "held_any": bool(at["held"]) or bool(entry),
+                        "heldc": sorted(set(
+                            self._known_held(at["held"], m)) | set(entry)),
+                    })
+                for ho in f.get("ho", ()):
+                    tok = f"{m}.{ho['n'][2:]}"
+                    self.hand.setdefault(tok, []).append({
+                        "key": key, "rel": rel, "q": q, "sym": sym,
+                        "ln": ho["ln"], "via": ho["via"], "roots": roots,
+                    })
+
+    # -- R14 -----------------------------------------------------------------
+    def _r14(self) -> None:
+        for tok in sorted(self.hand):
+            consumers = self.acc.get(tok, [])
+            attr = tok.rsplit(".", 1)[-1]
+            for ho in self.hand[tok]:
+                if not ho["roots"]:
+                    continue
+                if self._allowed(ho["rel"], "handoff", ho["ln"]):
+                    continue
+                hit = None
+                for a in consumers:
+                    if (a["rel"], a["ln"]) == (ho["rel"], ho["ln"]):
+                        continue
+                    pair = self._concurrent(ho["roots"], a["roots"])
+                    if pair:
+                        hit = (a, pair)
+                        break
+                if hit is None:
+                    continue
+                a, (rp, rc) = hit
+                msg = (f"in-flight device value from {ho['via']}(...) is "
+                       f"published to shared '{attr}' without "
+                       f"block_until_ready/.copy()/np.asarray — "
+                       f"{self.roots[rc].label} consumes it in {a['sym']} "
+                       f"while the dispatch may still be running; sync "
+                       f"before publishing (producer-side, the PR-3 "
+                       f"container discipline)")
+                self.findings.append(Finding(
+                    rule=R14, path=ho["rel"], line=ho["ln"], col=0,
+                    message=msg, symbol=ho["q"],
+                    chain=self._chain(rp, ho["key"],
+                                      (ho["rel"], ho["ln"], ho["sym"]))))
+
+    # -- R15 -----------------------------------------------------------------
+    def _r15(self) -> None:
+        for tok in sorted(self.acc):
+            accs = self.acc[tok]
+            locked_writes = [a for a in accs if a["w"] and a["heldc"]]
+            if not locked_writes:
+                continue
+            attr = tok.rsplit(".", 1)[-1]
+            lw = locked_writes[0]
+            lock = lw["heldc"][0]
+            for w in accs:
+                if not w["w"] or w["held_any"] or not w["roots"]:
+                    continue
+                if w["q"].rsplit(".", 1)[-1] in ("__init__", "__new__",
+                                                 "__del__"):
+                    continue  # not yet / no longer shared
+                if self._allowed(w["rel"], "unguarded", w["ln"]):
+                    continue
+                hit = None
+                for o in accs:
+                    if (o["rel"], o["ln"]) == (w["rel"], w["ln"]):
+                        continue
+                    pair = self._concurrent(w["roots"], o["roots"])
+                    if pair:
+                        hit = pair
+                        break
+                if hit is None:
+                    continue
+                rw, ro = hit
+                msg = (f"'{attr}' is written under {lock} in "
+                       f"{lw['sym']} but mutated lock-free here on "
+                       f"{self.roots[rw].label} while "
+                       f"{self.roots[ro].label} also touches it — "
+                       f"mostly-locked discipline violated; take the "
+                       f"lock or state the invariant with "
+                       f"'swarmlens: allow-unguarded-mutation'")
+                self.findings.append(Finding(
+                    rule=R15, path=w["rel"], line=w["ln"], col=0,
+                    message=msg, symbol=w["q"],
+                    chain=self._chain(sorted(w["roots"])[0], w["key"],
+                                      (w["rel"], w["ln"], w["sym"]))))
+
+    # -- R16 -----------------------------------------------------------------
+    def _acquire_closure(self) -> dict[tuple[str, str], set[str]]:
+        """Canonical locks each function may acquire, transitively over
+        the call graph (parameter locks excluded — substituted only at
+        direct call sites)."""
+        own: dict[tuple[str, str], set[str]] = {}
+        for m in self.conc:
+            for q, f in (self.conc[m].get("funcs") or {}).items():
+                toks = {c for a in f.get("acq", ())
+                        for c in self._known_held([a["l"]], m)}
+                if toks:
+                    own[(m, q)] = toks
+        clos = {k: set(v) for k, v in own.items()}
+        edges = self.index.edges()
+        for _ in range(32):  # fixpoint; depth-bounded for safety
+            changed = False
+            for key, outs in edges.items():
+                acc = clos.get(key, set())
+                for o in outs:
+                    extra = clos.get(o)
+                    if extra and not extra <= acc:
+                        clos[key] = acc = acc | extra
+                        changed = True
+            if not changed:
+                break
+        return clos
+
+    def _r16(self) -> None:
+        idx = self.index
+        clos = self._acquire_closure()
+        edges_out: dict[tuple[str, str], list[dict]] = {}
+
+        def add(a: str, b: str, site: dict) -> None:
+            if a != b:
+                edges_out.setdefault((a, b), []).append(site)
+
+        for m in sorted(self.conc):
+            rel = idx.modules.get(m)
+            for q, f in sorted((self.conc[m].get("funcs") or {}).items()):
+                key = (m, q)
+                roots = self.rootfns.get(key, set())
+                base = {"rel": rel, "key": key, "sym": f"{m}.{q}",
+                        "roots": roots}
+                entry = self._entry_locks(key)
+                for a in f.get("acq", ()):
+                    inner = self._known_held([a["l"]], m)
+                    if not inner:
+                        continue
+                    for h in set(self._known_held(a["held"], m)) | set(
+                            entry):
+                        add(h, inner[0], {**base, "ln": a["ln"]})
+                for cw in f.get("cw", ()):
+                    helds = sorted(set(self._known_held(cw["held"], m))
+                                   | set(entry))
+                    if not helds:
+                        continue
+                    for g in self._call_targets(m, cw["t"]):
+                        acquired = set(clos.get(g, ()))
+                        acquired |= self._substituted(m, cw, g)
+                        for c in acquired:
+                            for h in helds:
+                                add(h, c, {**base, "ln": cw["ln"]})
+        seen_pairs: set[tuple[str, str]] = set()
+        for (a, b) in sorted(edges_out):
+            if a > b or (b, a) not in edges_out:
+                continue
+            if (a, b) in seen_pairs:
+                continue
+            seen_pairs.add((a, b))
+            hit = None
+            for s1 in edges_out[(a, b)]:
+                for s2 in edges_out[(b, a)]:
+                    if (s1["rel"], s1["ln"]) == (s2["rel"], s2["ln"]):
+                        continue
+                    if self._allowed(s1["rel"], "lockorder", s1["ln"]) \
+                            or self._allowed(s2["rel"], "lockorder",
+                                             s2["ln"]):
+                        continue
+                    pair = self._concurrent(s1["roots"], s2["roots"])
+                    if pair:
+                        hit = (s1, s2, pair)
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            s1, s2, (r1, r2) = hit
+            msg = (f"lock-order inversion: {a} is held while taking {b} "
+                   f"here, but {s2['sym']} takes {b} then {a} — "
+                   f"{self.roots[r1].label} and {self.roots[r2].label} "
+                   f"can deadlock (ABBA); pick one global order")
+            chain = self._chain(r1, s1["key"],
+                                (s1["rel"], s1["ln"], s1["sym"]))
+            chain = chain + ((s2["rel"], s2["ln"], s2["sym"]),)
+            self.findings.append(Finding(
+                rule=R16, path=s1["rel"], line=s1["ln"], col=0,
+                message=msg, symbol=s1["key"][1], chain=chain))
+
+    def _call_targets(self, module: str, t: str) -> list[tuple[str, str]]:
+        if t.startswith(("self.", "cls.")):
+            return self._spawn_targets(module, t)
+        return list(self.index.func_targets(module, t))
+
+    def _substituted(self, module: str, cw: dict,
+                     g: tuple[str, str]) -> set[str]:
+        """Locks a callee acquires through a parameter, resolved with the
+        caller's argument tokens (one level)."""
+        la = cw.get("la") or {}
+        if not la:
+            return set()
+        gf = self.index.funcs.get(g)
+        gconc = self.conc.get(g[0], {}).get("funcs", {}).get(g[1])
+        if gf is None or gconc is None:
+            return set()
+        offset = 1 if (cw["t"].startswith(("self.", "cls."))
+                       and gf.get("meth")) else 0
+        out: set[str] = set()
+        for a in gconc.get("acq", ()):
+            if not a["l"].startswith("p:"):
+                continue
+            pname = a["l"][2:]
+            if pname not in gf["pargs"]:
+                continue
+            pos = gf["pargs"].index(pname) - offset
+            tok = la.get(str(pos))
+            if tok is None:
+                continue
+            out.update(self._known_held([tok], module))
+        return out
+
+    # -- R17 -----------------------------------------------------------------
+    def _r17(self) -> None:
+        idx = self.index
+        edges = idx.edges()
+        async_caller: dict[tuple[str, str], tuple[str, str]] = {}
+        for key, f in idx.funcs.items():
+            if f.get("isasync"):
+                for o in sorted(edges.get(key, ())):
+                    async_caller.setdefault(o, key)
+        for m in sorted(self.conc):
+            rel = idx.modules.get(m)
+            for q, f in sorted((self.conc[m].get("funcs") or {}).items()):
+                key = (m, q)
+                fn = idx.funcs.get(key)
+                isasync = bool(fn and fn.get("isasync"))
+                sym = f"{m}.{q}"
+                roots = self.rootfns.get(key, set())
+                rid = ("loop" if "loop" in roots
+                       else sorted(roots)[0] if roots else None)
+                entry = self._entry_locks(key, _THREADING_KINDS)
+                for aw in f.get("aw", ()):
+                    locks = (self._known_held(aw["held"], m,
+                                              _THREADING_KINDS)
+                             + entry)
+                    if not locks:
+                        continue
+                    if self._allowed(rel, "blocking", aw["ln"]):
+                        continue
+                    msg = (f"'await' while holding threading lock "
+                           f"{locks[0]} — the coroutine parks with the "
+                           f"lock held and every root contending for it "
+                           f"deadlocks against the event loop; release "
+                           f"before awaiting")
+                    self.findings.append(Finding(
+                        rule=R17, path=rel, line=aw["ln"], col=0,
+                        message=msg, symbol=q,
+                        chain=self._chain(rid, key,
+                                          (rel, aw["ln"], sym))))
+                for bl in f.get("bl", ()):
+                    if self._allowed(rel, "blocking", bl["ln"]):
+                        continue
+                    locks = (self._known_held(bl["held"], m,
+                                              _THREADING_KINDS)
+                             + entry)
+                    if locks and (roots or isasync):
+                        msg = (f"blocking call {bl['t']} while holding "
+                               f"{locks[0]} — every other root "
+                               f"contending for the lock waits out the "
+                               f"sleep/IO; move it outside the region")
+                    elif isasync:
+                        msg = (f"blocking call {bl['t']} inside "
+                               f"coroutine {sym} stalls the event loop "
+                               f"(and every lane poll behind it) — use "
+                               f"the asyncio equivalent or "
+                               f"run_in_executor")
+                    elif key in async_caller and "loop" in roots \
+                            and key not in self.nonloop_seeds:
+                        # a function some site explicitly dispatches to
+                        # a thread/executor is exempt: the "direct call"
+                        # edge is usually that registration site itself
+                        ac = async_caller[key]
+                        msg = (f"blocking call {bl['t']} in {sym}, "
+                               f"called directly from coroutine "
+                               f"{ac[0]}.{ac[1]} — stalls the event "
+                               f"loop; use the asyncio equivalent or "
+                               f"run_in_executor")
+                    else:
+                        continue
+                    self.findings.append(Finding(
+                        rule=R17, path=rel, line=bl["ln"], col=0,
+                        message=msg, symbol=q,
+                        chain=self._chain(rid, key,
+                                          (rel, bl["ln"], sym))))
+
+
+def results(index: "ProjectIndex") -> RaceflowAnalysis:
+    """Analysis for ``index``, computed once and cached on it — R14–R17
+    share one topology/lock-discipline run per lint invocation."""
+    cached = getattr(index, "_raceflow", None)
+    if cached is None:
+        cached = RaceflowAnalysis(index)
+        index._raceflow = cached
+    return cached

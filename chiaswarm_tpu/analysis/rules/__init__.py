@@ -78,6 +78,7 @@ from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
     host_sync,
     jit_hygiene,
     prng,
+    raceflow_rules,
     recompile,
     scan_carry,
     sharding_drift,

@@ -1,0 +1,75 @@
+"""R14–R17 — the raceflow concurrency rules (swarmrace).
+
+R1–R13 prove what the *values* do; these four prove what the *threads*
+do, via the thread-topology + lock-discipline interpreter in
+``analysis/raceflow.py`` (see its module docstring for the domain):
+
+- **R14 cross-thread-device-handoff** — an in-flight device value
+  (produced by a jit/lane dispatch) published to shared state one root
+  writes and another consumes, with no ``block_until_ready``/``.copy()``
+  on the producing path: PR 3's two container hazards as lint findings.
+- **R15 unguarded-shared-mutation** — mostly-locked state mutated
+  lock-free on a concurrent root's path (the PR-10 fired-vs-condemn
+  shape), RacerD-style.
+- **R16 lock-order-inversion** — ABBA cycles in the lock-order graph
+  across concurrent roots.
+- **R17 await-or-blocking-under-lock** — a ``threading`` lock held
+  across ``await``, or ``time.sleep``/socket I/O on the event loop.
+
+All four are conservative: single-rooted programs, unresolvable spawn
+targets and unknown locks are silent — a lint must not invent a thread
+topology it cannot defend.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from chiaswarm_tpu.analysis.core import Finding, ProjectRule, register
+
+if TYPE_CHECKING:  # the index arrives at check time; no runtime dep
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+
+class _RaceflowRule(ProjectRule):
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        from chiaswarm_tpu.analysis import raceflow
+
+        for f in raceflow.results(index).findings:
+            if f.rule == self.name:
+                yield f
+
+
+@register
+class CrossThreadDeviceHandoff(_RaceflowRule):
+    code = "R14"
+    name = "cross-thread-device-handoff"
+    description = ("a device value still in flight is published to "
+                   "shared state consumed on another execution root — "
+                   "sync (block_until_ready/.copy()) before publishing")
+
+
+@register
+class UnguardedSharedMutation(_RaceflowRule):
+    code = "R15"
+    name = "unguarded-shared-mutation"
+    description = ("state written under a lock on some paths but "
+                   "mutated lock-free on a concurrent root's path "
+                   "(mostly-locked inference)")
+
+
+@register
+class LockOrderInversion(_RaceflowRule):
+    code = "R16"
+    name = "lock-order-inversion"
+    description = ("two locks taken in opposite orders on concurrent "
+                   "roots (ABBA) — a deadlock waiting for load")
+
+
+@register
+class AwaitOrBlockingUnderLock(_RaceflowRule):
+    code = "R17"
+    name = "await-or-blocking-under-lock"
+    description = ("a threading lock held across await, or blocking "
+                   "sleep/IO inside a coroutine — parks the event loop "
+                   "(and everyone contending for the lock)")

@@ -54,7 +54,12 @@ Interpretation enters at every ``shard_map`` site, descends through the
 R9 call-graph machinery (named callees, lambdas, ``functools.partial``,
 ``jax.lax.scan``/``while_loop``/``fori_loop``/``cond`` bodies, nested
 closures) with memoized per-context summaries, and reports findings with
-full entry → sink chains.
+full entry → sink chains. ``custom_vjp``/``custom_jvp`` primals carry
+their registered fwd/bwd/jvp companions along: jax dispatches those
+bodies inside the same shard_map context with no visible call edge, so
+the interpreter explores them whenever the primal is reached, binding
+every companion parameter to the combined varying-ness of the primal's
+arguments (residuals/cotangents derive from them).
 
 R13 ``donation-drift`` rides the same flow IR: a buffer donated at a
 jit-wrapper call site (``donate_argnums``/``donate_argnames``, declared
@@ -192,6 +197,26 @@ class ShardflowAnalysis:
         self._memo: dict[tuple, VMA] = {}
         self._active: set[tuple] = set()
         self._global_universe = frozenset(index.axis_universe())
+        # custom_vjp/custom_jvp registrations: primal key -> companion
+        # (fwd/bwd/jvp) keys + the defvjp site as a chain hop. jax calls
+        # the companions, not user code, so the ordinary call graph
+        # never reaches their bodies.
+        self._customvjp: dict[tuple[str, str],
+                              list[tuple[tuple[str, str],
+                                         tuple[str, int, str]]]] = {}
+        for rel in sorted(index.summaries):
+            s = index.summaries[rel]
+            module = s["module"]
+            for rec in s.get("customvjp", ()):
+                ptargets = index.func_targets(module, rec["p"])
+                if len(ptargets) != 1:
+                    continue
+                hop = (rel, rec["ln"], f"{module}.{rec['p']}.defvjp")
+                lst = self._customvjp.setdefault(ptargets[0], [])
+                for ref in rec["fns"]:
+                    for t in index.func_targets(module, ref):
+                        if t != ptargets[0]:
+                            lst.append((t, hop))
 
     # -- entry -------------------------------------------------------------
     def run(self) -> "ShardflowAnalysis":
@@ -379,6 +404,7 @@ class ShardflowAnalysis:
                        chain=chain + ((rel, f["line"],
                                        f"{key[0]}.{key[1]}"),),
                        depth=depth)
+        self._explore_customvjp(key, args, kwargs, universe, ctx)
         ret: VMA | None = None
         for step in f.get("flow", ()):
             if "r" in step:
@@ -432,6 +458,33 @@ class ShardflowAnalysis:
         if outer is None:
             self._memo[memo_key] = result
         return result
+
+    def _explore_customvjp(self, key: tuple[str, str], args: list[VMA],
+                           kwargs: dict[str, VMA],
+                           universe: frozenset[str],
+                           ctx: _SiteCtx) -> None:
+        """When an interpreted function is a ``custom_vjp``/``custom_jvp``
+        primal, its fwd/bwd/jvp companions run inside the *same*
+        shard_map context — jax dispatches them, so no call edge exists.
+        Explore each companion for side effects only (a psum over a
+        replicated residual in the bwd body is the same axis-size
+        mislabel as in the primal); residual/cotangent plumbing is
+        opaque, so every companion parameter gets the combined
+        varying-ness of the primal's arguments (sound upper bound for
+        ``may``; ``must`` stays whatever definitely varied)."""
+        comps = self._customvjp.get(key)
+        if not comps:
+            return
+        vmas = list(args) + list(kwargs.values())
+        bound = VMA.combine(*vmas) if vmas else VMA.top(universe)
+        for comp_key, hop in comps:
+            f = self.index.funcs.get(comp_key)
+            if f is None:
+                continue
+            nparams = max(len(f["pargs"]), 1)
+            self._interpret(comp_key, [bound] * nparams, {}, {},
+                            universe, ctx.chain + (hop,),
+                            ctx.depth + 1, outer=None)
 
     # -- expression evaluation --------------------------------------------
     def _eval(self, enc: Any, st: _State,

@@ -47,6 +47,11 @@ def _build(source: Path, out: Path) -> None:
     cmd = ["g++", "-O2", "-shared", "-fPIC", str(source), "-lz",
            "-o", str(tmp)]
     try:
+        # one-time cold-path compile, deliberately under _LOCK: every
+        # contender needs the library and must wait for the build anyway;
+        # serializing here IS the double-checked init (load() re-checks
+        # _LIB/_TRIED under the same lock). Never runs on the event loop.
+        # swarmlens: allow-blocking-under-lock
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)
     finally:

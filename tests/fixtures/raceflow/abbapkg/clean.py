@@ -1,0 +1,24 @@
+"""Clean twin: both threads honor the same C -> D order (its own lock
+pair — sharing A/B with workers.py would pair with *that* module's
+inverted edge, which is exactly what R16 is for)."""
+
+import threading
+
+C = threading.Lock()
+D = threading.Lock()
+
+
+def first():
+    with C:
+        with D:
+            pass
+
+
+def second():
+    with C:
+        with D:
+            pass
+
+
+threading.Thread(target=first, daemon=True).start()
+threading.Thread(target=second, daemon=True).start()

@@ -1,0 +1,4 @@
+import threading
+
+A = threading.Lock()
+B = threading.Lock()

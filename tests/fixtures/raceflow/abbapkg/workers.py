@@ -1,0 +1,22 @@
+"""Textbook ABBA: two threads acquire the same two module locks in
+opposite order — each can hold one and wait forever on the other."""
+
+import threading
+
+from abbapkg.locks import A, B
+
+
+def forward():
+    with A:
+        with B:  # R16: A -> B here ...
+            pass
+
+
+def backward():
+    with B:
+        with A:  # ... B -> A on the other thread
+            pass
+
+
+threading.Thread(target=forward, daemon=True).start()
+threading.Thread(target=backward, daemon=True).start()

@@ -1,0 +1,15 @@
+"""Clean twin: an asyncio.Lock may span awaits (it suspends, not
+blocks), and sleeping means awaiting asyncio.sleep."""
+
+import asyncio
+
+ALOCK = asyncio.Lock()
+
+
+async def tick():
+    async with ALOCK:
+        await asyncio.sleep(0.1)
+
+
+async def nap():
+    await asyncio.sleep(1.0)

@@ -1,0 +1,18 @@
+"""Both R17 shapes: a threading (not asyncio) lock held across an
+``await`` blocks every other task that wants the lock for the whole
+suspension; ``time.sleep`` in a coroutine freezes the entire loop."""
+
+import asyncio
+import threading
+import time
+
+LOCK = threading.Lock()
+
+
+async def tick():
+    with LOCK:
+        await asyncio.sleep(0.1)  # R17: threading lock across await
+
+
+async def nap():
+    time.sleep(1.0)  # R17: blocking call on the event loop

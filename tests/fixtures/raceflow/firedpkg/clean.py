@@ -1,0 +1,20 @@
+"""Clean twin: both writers take the Condition."""
+
+import threading
+
+
+class SafeWatch:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.fired = False
+        threading.Thread(target=self._monitor, daemon=True).start()
+        threading.Thread(target=self._reset_loop, daemon=True).start()
+
+    def _monitor(self):
+        with self._cv:
+            self.fired = True
+            self._cv.notify_all()
+
+    def _reset_loop(self):
+        with self._cv:
+            self.fired = False

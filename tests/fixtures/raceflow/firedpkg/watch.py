@@ -1,0 +1,21 @@
+"""PR-10's fired-vs-condemn shape: ``fired`` is Condition-guarded on
+the monitor path, but the reset path writes it bare — the exact
+mostly-locked discipline break RacerD keys on."""
+
+import threading
+
+
+class Watch:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.fired = False
+        threading.Thread(target=self._monitor, daemon=True).start()
+        threading.Thread(target=self._reset_loop, daemon=True).start()
+
+    def _monitor(self):
+        with self._cv:
+            self.fired = True
+            self._cv.notify_all()
+
+    def _reset_loop(self):
+        self.fired = False  # R15: unguarded write to guarded state

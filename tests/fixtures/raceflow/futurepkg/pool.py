@@ -1,0 +1,27 @@
+"""PR-3's second container hazard: an executor job parks a jit result
+in a request-keyed dict; the async poller that pops it runs on the
+event loop, which never synchronized with the dispatching thread."""
+
+import asyncio
+
+import jax
+
+
+@jax.jit
+def _decode(x):
+    return x + 1
+
+
+class Pool:
+    def __init__(self):
+        self._results = {}
+
+    async def submit(self, key, x):
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._job, key, x)
+
+    def _job(self, key, x):
+        self._results[key] = _decode(x)  # R14: in-flight value shared
+
+    async def poll(self, key):
+        return self._results.pop(key, None)

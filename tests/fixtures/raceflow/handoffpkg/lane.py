@@ -1,0 +1,25 @@
+"""PR-3's first container hazard: jit dispatch is async, so the value a
+worker thread drops into a shared container may still be in flight when
+another root picks it up — the result materializes later, on a thread
+the consumer never synchronized with."""
+
+import collections
+import threading
+
+import jax
+
+
+class Lane:
+    def __init__(self):
+        self._out = collections.deque()
+        self._step = jax.jit(lambda x: x * 2)
+        threading.Thread(target=self._drive, daemon=True).start()
+
+    def _drive(self):
+        y = self._step(1.0)
+        self._out.append(y)  # R14: device value published cross-thread
+
+    async def poll(self):
+        if self._out:
+            return self._out.popleft()
+        return None

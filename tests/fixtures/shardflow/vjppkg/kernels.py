@@ -1,0 +1,25 @@
+"""A custom_vjp matmul whose backward pass all-reduces the weight
+gradient over ``seq``. No user code ever calls ``matmul_bwd`` — jax
+dispatches it inside the same shard_map context as the primal — so
+whether that psum is a reduction or a multiplication is decided
+entirely by the primal's in_specs."""
+
+import jax
+
+
+@jax.custom_vjp
+def matmul(ctx, w):
+    return ctx @ w
+
+
+def matmul_fwd(ctx, w):
+    return ctx @ w, (ctx, w)
+
+
+def matmul_bwd(res, g):
+    ctx, w = res
+    dw = jax.lax.psum(ctx.T @ g, "seq")
+    return g @ w.T, dw
+
+
+matmul.defvjp(matmul_fwd, matmul_bwd)

@@ -1,0 +1,5 @@
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+RING = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "seq"))

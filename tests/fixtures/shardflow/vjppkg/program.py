@@ -1,0 +1,29 @@
+"""Two bindings of the custom_vjp kernel. The data-only one is wrong:
+ctx is replicated over seq, so the residuals the bwd psums over seq
+are already complete on every seq shard — the gradient comes back
+multiplied by the seq size, through a call edge no call graph sees."""
+
+from jax.sharding import PartitionSpec as P
+
+from chiaswarm_tpu.core.compat import shard_map
+from vjppkg.kernels import matmul
+from vjppkg.mesh import RING
+
+
+def bad_replicated_grad(ctx, w):
+    # ctx sharded over data ONLY: replicated over seq. The bwd body's
+    # psum over seq multiplies dw by 4 (R11 via the defvjp edge).
+    fn = shard_map(matmul, mesh=RING,
+                   in_specs=(P("data", None), P()),
+                   out_specs=P("data", None))
+    return fn(ctx, w)
+
+
+def clean_seq_varying(ctx, w):
+    # ctx varies over seq: the bwd psum is a genuine reduction of
+    # per-shard partial gradients, and the (still seq-varying) primal
+    # output leaves labeled seq-sharded.
+    fn = shard_map(matmul, mesh=RING,
+                   in_specs=(P(None, "seq"), P()),
+                   out_specs=P(None, "seq"))
+    return fn(ctx, w)

@@ -2173,3 +2173,379 @@ def test_r13_try_handler_reads_the_body_donation():
     idx3 = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", loop_else))
     fs3 = list(_get_rule("R13").check_project(idx3))
     assert len(fs3) == 1
+
+
+# --------------------------------------------- swarmrace (R14-R17)
+
+RACEFLOW_FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                                 "raceflow")
+
+
+def _copy_raceflow(tmp_path, name):
+    dst = tmp_path / name
+    shutil.copytree(os.path.join(RACEFLOW_FIXTURES, name), dst)
+    return dst
+
+
+def test_r14_thread_publishes_inflight_jit_value(tmp_path):
+    """PR-3's first container hazard: a worker thread appends a
+    jit-produced value to a shared deque the event loop pops — R14 with
+    the spawn-site -> publish chain; the block_until_ready twin is
+    green."""
+    pkg = _copy_raceflow(tmp_path, "handoffpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R14"])
+    assert r.exit_code == 1 and len(r.new) == 1, r.report
+    f = r.new[0]
+    assert f.rule == "cross-thread-device-handoff"
+    assert f.path == "handoffpkg/lane.py"
+    assert "'_out'" in f.message and "block_until_ready" in f.message
+    # spawn site (the root) -> the thread body -> the publish itself
+    assert [hop[2] for hop in f.chain] == [
+        "handoffpkg.lane.Lane.__init__", "handoffpkg.lane.Lane._drive",
+        "handoffpkg.lane.Lane._drive"]
+    assert f.chain[-1] == ("handoffpkg/lane.py", f.line,
+                           "handoffpkg.lane.Lane._drive")
+    assert "chain:" in f.render()
+
+
+def test_r14_executor_job_parks_result_in_shared_dict(tmp_path):
+    """The second PR-3 hazard: run_in_executor job stores a jit result
+    into a request-keyed dict an async poller pops; the .copy() twin is
+    green."""
+    pkg = _copy_raceflow(tmp_path, "futurepkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R14"])
+    assert r.exit_code == 1 and len(r.new) == 1, r.report
+    f = r.new[0]
+    assert f.path == "futurepkg/pool.py" and "'_results'" in f.message
+    assert [hop[2] for hop in f.chain] == [
+        "futurepkg.pool.Pool.submit", "futurepkg.pool.Pool._job",
+        "futurepkg.pool.Pool._job"]
+
+
+def test_r15_fired_vs_condemn_mostly_locked(tmp_path):
+    """PR-10's fired flag: Condition-guarded on the monitor path,
+    written bare on the reset path (R15); the guarded twin is green."""
+    pkg = _copy_raceflow(tmp_path, "firedpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R15"])
+    assert r.exit_code == 1 and len(r.new) == 1, r.report
+    f = r.new[0]
+    assert f.rule == "unguarded-shared-mutation"
+    assert f.path == "firedpkg/watch.py"
+    assert "'fired'" in f.message
+    assert "firedpkg.watch.Watch._monitor" in f.message
+    assert [hop[2] for hop in f.chain] == [
+        "firedpkg.watch.Watch.__init__",
+        "firedpkg.watch.Watch._reset_loop",
+        "firedpkg.watch.Watch._reset_loop"]
+
+
+def test_r16_abba_across_modules(tmp_path):
+    """Two module locks taken in opposite order by two threads (the
+    locks live in a module neither worker imports for spawning) — R16
+    chains both sides; the same-order twin with its own lock pair is
+    green."""
+    pkg = _copy_raceflow(tmp_path, "abbapkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R16"])
+    assert r.exit_code == 1 and len(r.new) == 1, r.report
+    f = r.new[0]
+    assert f.rule == "lock-order-inversion"
+    assert f.path == "abbapkg/workers.py"
+    assert "abbapkg.locks.A" in f.message and "abbapkg.locks.B" in f.message
+    quals = [hop[2] for hop in f.chain]
+    assert quals[0] == "abbapkg.workers.<module>"      # the spawn site
+    assert quals[-1] == "abbapkg.workers.backward"     # the inverted edge
+    assert "abbapkg.workers.forward" in quals
+
+
+def test_r17_await_and_blocking_shapes(tmp_path):
+    """Both R17 shapes in one package: threading lock held across an
+    await, and time.sleep inside a coroutine; the asyncio.Lock twin is
+    green."""
+    pkg = _copy_raceflow(tmp_path, "blockpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R17"])
+    assert r.exit_code == 1 and len(r.new) == 2, r.report
+    by_line = sorted(r.new, key=lambda f: f.line)
+    assert all(f.path == "blockpkg/svc.py" for f in by_line)
+    assert "'await' while holding threading lock" in by_line[0].message
+    assert "blockpkg.svc.LOCK" in by_line[0].message
+    assert "time.sleep" in by_line[1].message
+    assert "event loop" in by_line[1].message
+
+
+def test_r15_entry_held_credits_locked_helpers():
+    """RacerD-style guard inference: a ``*_locked`` helper whose every
+    call site holds the lock writes WITH the lock — no R15; the same
+    shape with a genuinely bare writer on another root still fires."""
+    guarded = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+                threading.Thread(target=self._worker).start()
+                threading.Thread(target=self._other).start()
+
+            def _worker(self):
+                with self._lock:
+                    self._push_locked(1)
+
+            def _push_locked(self, x):
+                self.items.append(x)
+
+            def _other(self):
+                with self._lock:
+                    self.items.append(2)
+        """
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/box.py", guarded))
+    assert list(_get_rule("R15").check_project(idx)) == []
+
+    bare = guarded.replace("""
+            def _other(self):
+                with self._lock:
+                    self.items.append(2)
+        """, """
+            def _other(self):
+                self.items.append(2)
+        """)
+    idx2 = _index_of(("pkg/__init__.py", ""), ("pkg/box.py", bare))
+    fs = list(_get_rule("R15").check_project(idx2))
+    assert len(fs) == 1 and "'items'" in fs[0].message
+
+
+def test_r17_executor_dispatched_blocking_helper_is_exempt():
+    """A sync helper the coroutine hands to run_in_executor runs OFF
+    the loop — no R17; the same helper called directly still fires.
+    (The real-tree shape: node/worker.py dispatching
+    obs/profiling.capture.)"""
+    dispatched = """
+        import asyncio
+        import time
+
+        def capture():
+            time.sleep(1.0)
+
+        async def runner():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, capture)
+        """
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", dispatched))
+    assert list(_get_rule("R17").check_project(idx)) == []
+
+    direct = """
+        import time
+
+        def capture():
+            time.sleep(1.0)
+
+        async def runner():
+            capture()
+        """
+    idx2 = _index_of(("pkg/__init__.py", ""), ("pkg/m.py", direct))
+    fs = list(_get_rule("R17").check_project(idx2))
+    assert len(fs) == 1 and "time.sleep" in fs[0].message
+
+
+def test_r14_allow_marker_suppresses():
+    """# swarmlens: allow-cross-thread-handoff on (or above) the publish
+    line documents an intentional handoff and silences R14."""
+    src = """
+        import collections
+        import threading
+
+        import jax
+
+        class Lane:
+            def __init__(self):
+                self._out = collections.deque()
+                self._step = jax.jit(lambda x: x * 2)
+                threading.Thread(target=self._drive).start()
+
+            def _drive(self):
+                y = self._step(1.0)
+                # consumer re-synchronizes; see poll()
+                # swarmlens: allow-cross-thread-handoff
+                self._out.append(y)
+
+            async def poll(self):
+                return self._out.popleft()
+        """
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/lane.py", src))
+    assert list(_get_rule("R14").check_project(idx)) == []
+
+
+def test_raceflow_baseline_lifecycle(tmp_path):
+    """R14 findings ride the shrink-only baseline: finding ->
+    grandfathered -> fixed -> stale entry fails --strict."""
+    pkg = _copy_raceflow(tmp_path, "handoffpkg")
+    bl = tmp_path / "baseline.json"
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R14"])
+    assert r.exit_code == 1 and len(r.new) == 1
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 0
+    doc = json.loads(bl.read_text())
+    entries = [e for e in doc["findings"]
+               if e["rule"] == "cross-thread-device-handoff"]
+    assert len(entries) == 1
+    assert set(entries[0]) == {"rule", "path", "symbol", "message",
+                               "count"}  # identity only, no chain hops
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R14"], strict=True)
+    assert r.exit_code == 0 and len(r.suppressed) == 1
+
+    # fix: synchronize before publishing — the finding disappears and
+    # its baseline entry goes stale
+    lane = pkg / "lane.py"
+    fixed = lane.read_text().replace(
+        "y = self._step(1.0)",
+        "y = jax.block_until_ready(self._step(1.0))")
+    assert fixed != lane.read_text()
+    lane.write_text(fixed)
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R14"], strict=True)
+    assert r.exit_code == 1 and not r.new
+    assert len(r.stale) == 1 and "cross-thread-device-handoff" in r.stale[0]
+
+
+def test_raceflow_cli_chain_in_text_json_and_sarif(tmp_path):
+    """The acceptance clause: R14's root->site chain renders in all
+    three output formats (text, --json, --sarif codeFlows)."""
+    pkg = _copy_raceflow(tmp_path, "handoffpkg")
+    base = [sys.executable, "-m", "chiaswarm_tpu.analysis", "--select",
+            "R14", "--no-cache"]
+    proc = subprocess.run(base + [str(pkg)], capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "cross-thread-device-handoff" in proc.stdout
+    assert "chain: handoffpkg.lane.Lane.__init__" in proc.stdout
+
+    proc = subprocess.run(base + ["--json", str(pkg)],
+                          capture_output=True, text=True, timeout=300)
+    doc = json.loads(proc.stdout)
+    assert len(doc) == 1 and len(doc[0]["chain"]) == 3
+    assert doc[0]["chain"][0][2] == "handoffpkg.lane.Lane.__init__"
+
+    sarif = tmp_path / "out.sarif"
+    proc = subprocess.run(base + ["--sarif", str(sarif), str(pkg)],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1
+    res = json.loads(sarif.read_text())["runs"][0]["results"]
+    assert len(res) == 1
+    assert res[0]["ruleId"] == "cross-thread-device-handoff"
+    flow = res[0]["codeFlows"][0]["threadFlows"][0]["locations"]
+    assert [h["location"]["message"]["text"] for h in flow] == [
+        "handoffpkg.lane.Lane.__init__", "handoffpkg.lane.Lane._drive",
+        "handoffpkg.lane.Lane._drive"]
+
+
+def test_changed_only_conc_definitions_expand_to_conc_consumers(tmp_path):
+    """ISSUE 16 satellite: editing a module that DEFINES an execution
+    root or lock must re-lint every cross-root consumer even without an
+    import edge — while conc-free islands stay out of the fast path."""
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True)
+
+    _write(tmp_path, "pkg/__init__.py", "")
+    hub = _write(tmp_path, "pkg/hub.py", textwrap.dedent("""
+        import threading
+
+        LOCK = threading.Lock()
+
+        def seed():
+            pass
+
+        threading.Thread(target=seed, daemon=True).start()
+        """))
+    _write(tmp_path, "pkg/user.py", textwrap.dedent("""
+        import time
+
+        def slow():
+            time.sleep(0.1)
+        """))
+    _write(tmp_path, "pkg/island.py", "z = 1\n")
+    git("init", "-q")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "seed")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+
+    # edit ONLY the spawn/lock-defining module
+    hub.write_text(hub.read_text() + "\nEXTRA = 1\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, select=["R17"])
+    assert r.exit_code == 0, r.report
+    # hub + the blocking-call consumer; the island is skipped
+    assert r.checked_files == 2 and r.total_files == 4
+
+    # a non-conc edit keeps the narrow closure
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "hub")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+    (tmp_path / "pkg/island.py").write_text("z = 2\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, select=["R17"])
+    assert r.checked_files == 1
+
+
+def test_r11_custom_vjp_bwd_explored_through_defvjp(tmp_path):
+    """ISSUE 16 satellite: the bwd body of a custom_vjp primal has no
+    visible call edge — shardflow follows the defvjp registration, so
+    the data-only binding's replicated-residual psum fires (with the
+    registration as a chain hop) while the seq-varying twin stays
+    green."""
+    pkg = _copy_shardflow(tmp_path, "vjppkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R11", "R12"])
+    assert r.exit_code == 1 and len(r.new) == 1, r.report
+    f = r.new[0]
+    assert f.rule == "replicated-psum"
+    assert f.path == "vjppkg/kernels.py"
+    assert "'seq'" in f.message
+    assert [hop[2] for hop in f.chain] == [
+        "vjppkg.program.bad_replicated_grad", "vjppkg.kernels.matmul",
+        "vjppkg.kernels.matmul.defvjp", "vjppkg.kernels.matmul_bwd",
+        "vjppkg.kernels.matmul_bwd"]
+
+
+def test_r17_native_build_allow_marker_is_load_bearing():
+    """Burn-down regression: native/__init__.py runs subprocess.run
+    under _LOCK deliberately (one-time cold-path compile, documented
+    with an allow-marker). Stripping the marker must resurface R17
+    through the entry-held chain load() -> _build() — proving the
+    marker suppresses a live finding rather than decorating dead
+    code."""
+    src_path = os.path.join(os.path.dirname(__file__), "..",
+                            "chiaswarm_tpu", "native", "__init__.py")
+    with open(src_path) as fh:
+        src = fh.read()
+    assert "swarmlens: allow-blocking-under-lock" in src
+    driver = """
+        import threading
+
+        from pkg.native import load
+
+        threading.Thread(target=load, daemon=True).start()
+        """
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/native.py", src),
+                    ("pkg/driver.py", driver))
+    assert list(_get_rule("R17").check_project(idx)) == []
+
+    stripped = "\n".join(
+        line for line in src.splitlines()
+        if "swarmlens: allow-blocking-under-lock" not in line) + "\n"
+    idx2 = _index_of(("pkg/__init__.py", ""), ("pkg/native.py", stripped),
+                     ("pkg/driver.py", driver))
+    fs = list(_get_rule("R17").check_project(idx2))
+    assert len(fs) == 1 and "subprocess.run" in fs[0].message
+    assert "pkg.native._LOCK" in fs[0].message

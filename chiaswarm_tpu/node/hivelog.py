@@ -64,6 +64,16 @@ log = logging.getLogger("chiaswarm.hivelog")
 #: journal, so the reference-hive wire shape stays byte-compatible.
 HIVE_EPOCH_KEY = "hive_epoch"
 
+#: wire field a FEDERATED hive shard stamps into granted payloads
+#: (swarmfed, ISSUE 17 — node/federation.py): the owning shard's index,
+#: echoed on uploads so a multiplexed worker routes each result to the
+#: shard that holds the lease. Stamped ONLY when the federation has
+#: H > 1 shards — a single shard (or a plain MiniHive) keeps the
+#: reference wire shape byte-identical, like the epoch stamp above.
+#: Defined here (not in federation.py) so the worker's import graph
+#: never touches the hive-side federation module.
+HIVE_SHARD_KEY = "hive_shard"
+
 ENV_SEGMENT_BYTES = "CHIASWARM_HIVE_JOURNAL_SEGMENT_BYTES"
 ENV_FSYNC = "CHIASWARM_HIVE_JOURNAL_FSYNC"
 ENV_COMPACT_EVERY = "CHIASWARM_HIVE_JOURNAL_COMPACT_EVERY"

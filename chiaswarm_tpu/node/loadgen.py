@@ -60,9 +60,11 @@ import logging
 import math
 import os
 import random
+import threading
 import time
 from typing import Any, Callable, Iterable, Sequence
 
+from chiaswarm_tpu.node.federation import FederatedHive, ShardHive
 from chiaswarm_tpu.node.minihive import MiniHive
 from chiaswarm_tpu.node.output_processor import make_text_result
 from chiaswarm_tpu.node.resilience import classify_result
@@ -418,6 +420,59 @@ class LoadHive(MiniHive):
         return ack
 
 
+class _ShardLoad(ShardHive, LoadHive):
+    """One federated load shard: ShardHive's steal/forward seams
+    stacked over LoadHive's timing stamps. steal_to's cooperative
+    ``super()._take_jobs`` resolves through LoadHive here, so STOLEN
+    grants stamp ``granted_at`` exactly like owned ones, and a
+    forwarded wrong-shard upload settles (and stamps ``settled_at``)
+    on the owner — the scorer never sees federation seams."""
+
+
+class _StitchedFlights:
+    """score_run's flight view over a federation: each lookup routes
+    to the job's OWNING shard (the only book that flight lives in)."""
+
+    def __init__(self, federation: "FederatedLoadHive") -> None:
+        self._federation = federation
+
+    def get(self, job_id: Any) -> dict | None:
+        shard = self._federation.owner_shard(job_id)
+        return None if shard is None else shard.flights.get(job_id)
+
+    def verify(self, job_ids: Iterable[Any]) -> list:
+        return self._federation.verify_flights(job_ids)
+
+
+class FederatedLoadHive(FederatedHive):
+    """The federation wired for the load harness (swarmfed, ISSUE 17):
+    _ShardLoad shards plus the merged timing views :func:`score_run`
+    folds. Everything else — routing, stealing, per-shard journals —
+    is stock FederatedHive."""
+
+    def __init__(self, n_shards: int = 3, **kwargs: Any) -> None:
+        kwargs.setdefault("hive_cls", _ShardLoad)
+        super().__init__(n_shards, **kwargs)
+
+    def _merged(self, attr: str) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for shard in self.shards:
+            out.update(getattr(shard, attr, {}))
+        return out
+
+    @property
+    def granted_at(self) -> dict[str, float]:
+        return self._merged("granted_at")
+
+    @property
+    def settled_at(self) -> dict[str, float]:
+        return self._merged("settled_at")
+
+    @property
+    def flights(self) -> _StitchedFlights:
+        return _StitchedFlights(self)
+
+
 class SyntheticExecutor:
     """Executor seam stand-in with deterministic per-workload service
     times (the load-harness analog of ChaoticExecutor: exercises the
@@ -558,8 +613,64 @@ class RosterPlan:
     leave_at: tuple[float, ...] = ()
 
 
+class ContentionProbe:
+    """Host-contention sampler (ISSUE 12, promoted to a reusable class
+    for the ISSUE 17 guard-gate deflake): a daemon THREAD measures how
+    late ``time.sleep`` fires while a harness runs (~1.0 on an idle
+    host). Timing gates bound their clauses against the measured
+    factor instead of absolute wall clock, so a contended CI host
+    loosens a bound by exactly the measured sleep stretch — never by
+    an arbitrary fudge. Deliberately NOT an asyncio task on the
+    harness loop: loop lag caused by the code under test must count
+    against the gate, not loosen it — the thread sees only host-level
+    scheduling delay."""
+
+    def __init__(self, tick_s: float = 0.02) -> None:
+        self.tick_s = max(1e-4, float(tick_s))
+        self.overshoots: list[float] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._sample, name="contention-probe", daemon=True)
+
+    def _sample(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            time.sleep(self.tick_s)
+            self.overshoots.append(
+                (time.perf_counter() - t0) / self.tick_s)
+
+    def start(self) -> "ContentionProbe":
+        self._thread.start()
+        return self
+
+    def stop(self) -> float:
+        """Stop sampling; returns the factor (callers may also keep
+        reading :attr:`factor` afterwards)."""
+        self._stop.set()
+        self._thread.join(timeout=1.0)
+        return self.factor
+
+    @property
+    def factor(self) -> float:
+        """p90 sleep overshoot, floored at 1.0 (a bound scaled by this
+        can loosen under contention but never tighten below nominal)."""
+        if not self.overshoots:
+            return 1.0
+        return max(1.0, percentile(self.overshoots, 0.9))
+
+    def report(self) -> dict[str, Any]:
+        return {
+            "sleep_overshoot_p90": (round(
+                percentile(self.overshoots, 0.9), 4)
+                if self.overshoots else 1.0),
+            "samples": len(self.overshoots),
+            "factor": round(self.factor, 4),
+        }
+
+
 async def run_load(schedule: Sequence[ScheduledJob], *,
                    n_workers: int = 3,
+                   n_shards: int = 1,
                    worker_factory: Callable[[str, str], Any] | None = None,
                    hive: LoadHive | None = None,
                    lease_s: float = 5.0,
@@ -573,13 +684,28 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     """Drive ``schedule`` through a LoadHive + ``n_workers`` Workers;
     returns :func:`score_run`'s report (plus the kill record). The
     harness owns worker lifecycle end to end — every worker drains (or
-    is killed by plan) before scoring."""
+    is killed by plan) before scoring.
+
+    ``n_shards > 1`` (swarmfed, ISSUE 17) drives the SAME schedule
+    through a :class:`FederatedLoadHive` instead: jobs route by the
+    stable hash, workers multiplex one session per shard (the
+    comma-joined shard uris parse back through Settings.hive_uris),
+    and idle shards steal from deep ones — the report's reconciliation
+    and latency folds are fleet-wide."""
     if hive is None:
-        hive = LoadHive(lease_s=lease_s, delay_s=0.0,
-                        max_attempts=max_attempts,
-                        max_jobs_per_poll=max_jobs_per_poll)
+        if int(n_shards) > 1:
+            hive = FederatedLoadHive(
+                int(n_shards), lease_s=lease_s, delay_s=0.0,
+                max_attempts=max_attempts,
+                max_jobs_per_poll=max_jobs_per_poll)
+        else:
+            hive = LoadHive(lease_s=lease_s, delay_s=0.0,
+                            max_attempts=max_attempts,
+                            max_jobs_per_poll=max_jobs_per_poll)
     factory = worker_factory or default_worker_factory(seed=seed)
     uri = await hive.start()
+    if hasattr(hive, "worker_uri"):  # federation: workers dial shards
+        uri = hive.worker_uri()
     workers = [factory(uri, f"load-{seed}-w{i}")
                for i in range(max(1, int(n_workers)))]
     tasks = {w.settings.worker_name: asyncio.create_task(w.run())
@@ -607,28 +733,9 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     # contention probe (ISSUE 12 deflake): the harness runs on real
     # wall clocks, so a contended CI host stretches every latency in
     # the report — including the deadline-conformance numbers the
-    # acceptance gate asserts on. A SEPARATE daemon thread samples how
-    # late time.sleep fires during the run (factor ~1.0 on an idle
-    # host); the gate then bounds latency ratios against the measured
-    # factor instead of absolute wall clock. Deliberately NOT an
-    # asyncio task on the harness loop: loop lag caused by the code
-    # under test must count against the gate, not loosen it — the
-    # thread sees only host-level scheduling delay.
-    import threading
-
-    overshoots: list[float] = []
-    probe_stop = threading.Event()
-
-    def _contention_probe() -> None:
-        tick = 0.02
-        while not probe_stop.is_set():
-            t0 = time.perf_counter()
-            time.sleep(tick)
-            overshoots.append((time.perf_counter() - t0) / tick)
-
-    probe = threading.Thread(target=_contention_probe,
-                             name="loadgen-contention-probe", daemon=True)
-    probe.start()
+    # acceptance gate asserts on; the gate bounds latency ratios
+    # against the measured factor instead of absolute wall clock.
+    probe = ContentionProbe().start()
 
     async def maybe_kill() -> None:
         # first leaseholder found after the threshold dies NOW:
@@ -715,8 +822,7 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
             await asyncio.sleep(0.05)
     finally:
         duration_s = time.perf_counter() - t_start
-        probe_stop.set()
-        probe.join(timeout=1.0)
+        probe.stop()
         for worker in workers:
             worker.request_stop()
         await asyncio.gather(*(asyncio.wait_for(t, timeout=30)
@@ -735,17 +841,10 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     # contention-adjusted deadline clause scales its bound by this, so
     # a contended host loosens the bound by exactly the measured sleep
     # stretch — never by an arbitrary fudge.
-    factor = (max(1.0, percentile(overshoots, 0.9))
-              if overshoots else 1.0)
-    report["contention"] = {
-        "sleep_overshoot_p90": (round(percentile(overshoots, 0.9), 4)
-                                if overshoots else 1.0),
-        "samples": len(overshoots),
-        "factor": round(factor, 4),
-    }
+    report["contention"] = probe.report()
     ad = report["admitted_deadline"]
     ad["p99_within_deadline_contention_adjusted"] = bool(
-        ad["p99_latency_over_deadline"] <= factor)
+        ad["p99_latency_over_deadline"] <= probe.factor)
     return report
 
 

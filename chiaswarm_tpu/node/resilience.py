@@ -548,9 +548,15 @@ class HiveSession:
     """
 
     def __init__(self, *, outage_after: int = 3,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "") -> None:
         self.outage_after = max(1, int(outage_after))
         self._clock = clock
+        # swarmfed (ISSUE 17): a multiplexed worker holds one session
+        # PER HIVE SHARD — the label tells their log lines and health
+        # snapshots apart (empty for the single-hive worker: snapshot
+        # shape unchanged)
+        self.name = str(name)
         self.state = "online"
         self.consecutive_failures = 0
         self.outages = 0
@@ -597,6 +603,8 @@ class HiveSession:
             "last_outage_s": round(self.last_outage_s, 3),
             "last_failure_source": self.last_failure_source,
         }
+        if self.name:
+            out["name"] = self.name
         if self.outage_started_at is not None:
             out["outage_age_s"] = round(
                 max(0.0, self._clock() - self.outage_started_at), 3)

@@ -52,6 +52,11 @@ class Settings:
     """
 
     hive_uri: str = "https://chiaswarm.ai"
+    # swarmfed (ISSUE 17): a federated control plane is a LIST of shard
+    # uris — explicit here, or packed comma-separated into hive_uri
+    # (which keeps single-uri plumbing like the loadgen worker factory
+    # working unchanged). Empty = un-federated; hive_uris() resolves.
+    hive_shard_uris: tuple = ()
     hive_token: str = ""
     worker_name: str = "tpu-worker"
     log_level: str = "INFO"
@@ -172,6 +177,17 @@ class Settings:
         if not workflow:
             return default
         return float(table.get(str(workflow), default))
+
+    def hive_uris(self) -> list[str]:
+        """The control-plane uris this worker multiplexes across
+        (swarmfed, ISSUE 17): the explicit shard list when set, else
+        ``hive_uri`` split on commas. A plain single uri yields a
+        one-element list — the un-federated wire behavior."""
+        if self.hive_shard_uris:
+            return [str(uri).strip() for uri in self.hive_shard_uris
+                    if str(uri).strip()]
+        return [part.strip() for part in str(self.hive_uri).split(",")
+                if part.strip()]
 
     @staticmethod
     def _legacy_key_map() -> dict[str, str]:

@@ -61,7 +61,7 @@ from chiaswarm_tpu.node.executor import (
     single_chip_rows,
 )
 from chiaswarm_tpu.node.hive import BadWorkerError, HiveClient
-from chiaswarm_tpu.node.hivelog import HIVE_EPOCH_KEY
+from chiaswarm_tpu.node.hivelog import HIVE_EPOCH_KEY, HIVE_SHARD_KEY
 from chiaswarm_tpu.node.logging_setup import setup_logging
 from chiaswarm_tpu.node.overload import OverloadController
 from chiaswarm_tpu.node.registry import ModelRegistry
@@ -88,6 +88,35 @@ from chiaswarm_tpu.serving.guard import (
 )
 
 log = logging.getLogger("chiaswarm.worker")
+
+
+class _HiveShard:
+    """One hive shard from the worker's side (swarmfed, ISSUE 17): its
+    own client, its own outage session (ride-through flips PER SHARD —
+    a dead shard degrades only its own traffic while polls continue
+    against the rest), its own dead-letter spool namespace, its own
+    poll backoff, and its own epoch handshake (each shard recovers from
+    its own journal, so epochs are per-shard truth). A single-hive
+    worker holds exactly one of these — shard 0 — and the Worker's
+    ``hive``/``hive_session``/``dead_letters`` properties alias it, so
+    the pre-federation surface is unchanged."""
+
+    def __init__(self, *, index: int, uri: str, client: Any,
+                 session: HiveSession, spool: DeadLetterSpool,
+                 backoff: Backoff) -> None:
+        self.index = int(index)
+        self.uri = str(uri)
+        self.client = client
+        self.session = session
+        self.spool = spool
+        self.backoff = backoff
+        # the hive epoch last seen on THIS shard's grants/heartbeat
+        # acks (None against a journal-less shard); echoed on uploads
+        # routed here so a recovered shard dedupes pre-crash grants
+        self.last_epoch: int | None = None
+        # fleet-plane cadence throttle, per shard (each shard serves
+        # its own /api/fleet slice of this worker's snapshots)
+        self.last_metrics = float("-inf")
 
 
 def _burst_key(job: dict) -> tuple | None:
@@ -169,10 +198,12 @@ class Worker:
             attn_impl="auto" if self.settings.use_flash_attention else "xla"
         )
         self.pool = pool if pool is not None else self._default_pool()
-        self.hive = hive or HiveClient(
-            self.settings.hive_uri, self.settings.hive_token,
-            self.settings.worker_name,
-        )
+        # swarmfed (ISSUE 17): the control plane may be H hive shards
+        # (settings.hive_uris() — an explicit list, or commas in
+        # hive_uri); the worker multiplexes one session bundle per
+        # shard. An injected ``hive`` client (the chaos/test seam)
+        # pins a single bundle around it.
+        self.shards: list[_HiveShard] = self._build_hive_shards(hive)
         self._executor = executor
         # queue bound = total in-flight capacity: per slot, the larger of
         # its pipeline depth (transfer/compute overlap) and its data-axis
@@ -245,12 +276,6 @@ class Worker:
         # process exit status: 0, or GUARD_RESTART_EXIT_CODE after the
         # restart rung's graceful drain (supervisors restart-on-73)
         self.exit_code = 0
-        # deterministic per-worker jitter: chaos runs reproduce exactly,
-        # while distinct workers still decorrelate from each other
-        self._poll_backoff = Backoff(
-            base=self.settings.poll_backoff_base_s,
-            cap=self.settings.poll_backoff_cap_s,
-            seed=f"poll:{self.settings.worker_name}")
         self._retry_rng = random.Random(
             f"retry:{self.settings.worker_name}")
         # the registry mirror tolerates stub registries without
@@ -265,26 +290,20 @@ class Worker:
             on_close=getattr(self.registry, "unquarantine", None),
             on_probe=getattr(self.registry, "unquarantine", None),
             persist_path=self._breaker_state_path())
-        self.dead_letters = DeadLetterSpool(self._dead_letter_dir())
-        # ---- hive-outage ride-through (ISSUE 14, swarmdurable) ----
-        # consecutive poll/upload/heartbeat failures flip the session
-        # into OUTAGE: leases assumed lost, in-flight work runs to
-        # completion, results spool after a single upload attempt, and
-        # the first success HEALS — triggering a LIVE dead-letter
-        # replay (today's startup-only replay, without the restart)
-        self.hive_session = HiveSession(
-            outage_after=self.settings.hive_outage_after)
-        # dead-letter files currently riding the result queue: the live
-        # replay must never enqueue a spooled envelope twice
+        # dead-letter files currently riding the result queue: ONE set
+        # across every shard's spool — the live replay must never
+        # enqueue a spooled envelope twice, whichever shard healed
         self._replayed_paths: set[str] = set()
         self._dl_replayed = obs_metrics.dead_letter_replayed_counter(
             self.metrics)
         for when in obs_metrics.DEAD_LETTER_REPLAY_WHEN:
             self._dl_replayed.inc(0, when=when)
-        # the hive epoch last seen on a grant or heartbeat ack (None
-        # against a journal-less hive); echoed on uploads so a
-        # recovered hive dedupes pre-crash grants exactly once
-        self._last_hive_epoch: int | None = None
+        # per-shard session-state gauge (swarmfed, ISSUE 17): rendered
+        # with zeroes from scrape one, one series per configured shard
+        shard_gauge = obs_metrics.hive_shard_session_state_gauge(
+            self.metrics)
+        for shard in self.shards:
+            shard_gauge.set(0, shard=str(shard.index))
         # ---- fleet durability (ISSUE 6) ----
         # resume-state spool next to the dead-letter spool (same
         # per-worker namespacing); lanes snapshot into it via the slot
@@ -304,6 +323,10 @@ class Worker:
         # jobs between poll receipt and settled upload — the id set the
         # heartbeat keeps leased (insertion-ordered for stable payloads)
         self._inflight: dict[Any, float] = {}
+        # swarmfed (ISSUE 17): which shard OWNS each in-flight job's
+        # lease (stolen grants arrive via one shard's poll but belong
+        # to the owner) — heartbeats and uploads route by this
+        self._inflight_shard: dict[Any, int] = {}
         # ---- HBM residency (ISSUE 8, serving/residency.py) ----
         # push the operator's settings into the registry's ledger: an
         # explicit budget override, and the prefetch toggle (idle polls
@@ -342,6 +365,67 @@ class Worker:
         # workers sharing one settings root) must never replay — and then
         # DELETE — each other's spooled results
         return settings_root() / "dead_letter" / self._spool_dirname()
+
+    def _shard_dead_letter_dir(self, index: int) -> Path:
+        """Per-shard spool namespacing (swarmfed, ISSUE 17): shard 0
+        keeps the historical directory (the breaker state file is its
+        sibling, and single-hive workers never see a suffix); shards
+        beyond it suffix the dirname so one shard's heal never replays
+        — and then deletes — envelopes owed to another."""
+        base = self._dead_letter_dir()
+        if index <= 0:
+            return base
+        return base.parent / f"{base.name}__shard{index}"
+
+    def _build_hive_shards(self, hive: Any | None) -> list[_HiveShard]:
+        uris = self.settings.hive_uris() or [self.settings.hive_uri]
+        if hive is not None:
+            # an injected client (chaos/test seam) IS the control
+            # plane: one bundle, whatever the settings say
+            uris = uris[:1]
+        shards: list[_HiveShard] = []
+        for index, uri in enumerate(uris):
+            client = hive if hive is not None else HiveClient(
+                uri, self.settings.hive_token, self.settings.worker_name)
+            # shard 0 keeps the historical backoff seed so single-hive
+            # chaos schedules reproduce exactly; further shards
+            # decorrelate from it AND from each other
+            seed = (f"poll:{self.settings.worker_name}" if index == 0
+                    else f"poll:{self.settings.worker_name}:{index}")
+            shards.append(_HiveShard(
+                index=index, uri=uri, client=client,
+                session=HiveSession(
+                    outage_after=self.settings.hive_outage_after,
+                    name=f"shard{index}" if len(uris) > 1 else ""),
+                spool=DeadLetterSpool(self._shard_dead_letter_dir(index)),
+                backoff=Backoff(
+                    base=self.settings.poll_backoff_base_s,
+                    cap=self.settings.poll_backoff_cap_s,
+                    seed=seed)))
+        return shards
+
+    # single-hive compatibility surface: shard 0 IS the pre-federation
+    # worker state (read-only views — nothing may rebind these)
+
+    @property
+    def hive(self) -> Any:
+        return self.shards[0].client
+
+    @property
+    def hive_session(self) -> HiveSession:
+        return self.shards[0].session
+
+    @property
+    def dead_letters(self) -> DeadLetterSpool:
+        return self.shards[0].spool
+
+    @property
+    def _poll_backoff(self) -> Backoff:
+        return self.shards[0].backoff
+
+    @property
+    def _last_hive_epoch(self) -> int | None:
+        return self.shards[0].last_epoch
 
     def _default_pool(self) -> ChipPool:
         """One slot over all chips. An explicit ``mesh_shape`` setting
@@ -444,79 +528,109 @@ class Worker:
             except (NotImplementedError, RuntimeError, ValueError):
                 pass
 
-    def _replay_dead_letters(self, when: str = "startup") -> int:
+    def _replay_dead_letters(self, when: str = "startup",
+                             shards: list[_HiveShard] | None = None
+                             ) -> int:
         """Re-queue spooled results for upload. ``startup`` is the PR-2
         path (worker restarted under a hive outage); ``live`` is the
-        ISSUE-14 ride-through — the hive healed mid-run, so the spool
-        drains NOW instead of waiting for the next worker restart. A
-        file is only discarded after ITS upload succeeds (_deliver);
+        ISSUE-14 ride-through — a hive (shard) healed mid-run, so ITS
+        spool drains NOW instead of waiting for the next worker restart
+        (swarmfed: a per-shard heal replays only that shard's spool —
+        envelopes owed to a still-dead shard stay put). A file is only
+        discarded after ITS upload succeeds (_deliver);
         ``_replayed_paths`` keeps a file that is already riding the
         result queue from enqueueing twice."""
         replayed = 0
-        for path, result in self.dead_letters.replay():
-            key = str(path)
-            if key in self._replayed_paths:
-                continue  # already in the queue from an earlier replay
-            self._replayed_paths.add(key)
-            result["_dead_letter_path"] = key
-            self.result_queue.put_nowait(result)
-            self.stats.results_replayed += 1
-            self._dl_replayed.inc(when=when)
-            replayed += 1
-        if replayed:
-            log.warning("replaying %d dead-letter result(s) from %s "
-                        "(%s)", replayed, self.dead_letters.directory,
-                        when)
+        multiplexed = len(self.shards) > 1
+        for shard in (self.shards if shards is None else shards):
+            found = 0
+            for path, result in shard.spool.replay():
+                key = str(path)
+                if key in self._replayed_paths:
+                    continue  # already riding from an earlier replay
+                self._replayed_paths.add(key)
+                result["_dead_letter_path"] = key
+                if multiplexed:
+                    # route the replayed envelope to the shard whose
+                    # spool held it (already stamped when its grant
+                    # carried a shard key; stamped here for shutdown-
+                    # spooled envelopes that never reached _deliver)
+                    result.setdefault(HIVE_SHARD_KEY, shard.index)
+                self.result_queue.put_nowait(result)
+                self.stats.results_replayed += 1
+                self._dl_replayed.inc(when=when)
+                found += 1
+            if found:
+                log.warning("replaying %d dead-letter result(s) from %s "
+                            "(%s)", found, shard.spool.directory, when)
+            replayed += found
         return replayed
 
-    # ---- hive-session bookkeeping (ISSUE 14) ----
+    # ---- hive-session bookkeeping (ISSUE 14; per-shard since 17) ----
 
-    def _note_hive_ok(self) -> None:
-        """A poll/upload/heartbeat reached the hive and succeeded; a
-        heal drains the dead-letter spool live — spooled chip time
-        lands the moment the hive is back, no restart needed."""
-        if self.hive_session.note_success():
+    def _note_hive_ok(self, shard: _HiveShard | None = None) -> None:
+        """A poll/upload/heartbeat reached this shard and succeeded; a
+        heal drains the shard's dead-letter spool live — spooled chip
+        time lands the moment the shard is back, no restart needed."""
+        shard = shard if shard is not None else self.shards[0]
+        if shard.session.note_success():
             log.warning(
-                "hive healed after %.1fs outage; replaying the "
+                "hive%s healed after %.1fs outage; replaying its "
                 "dead-letter spool live",
-                self.hive_session.last_outage_s)
-            self._replay_dead_letters(when="live")
+                f" shard {shard.index}" if len(self.shards) > 1 else "",
+                shard.session.last_outage_s)
+            self._replay_dead_letters(when="live", shards=[shard])
 
-    def _note_hive_failure(self, source: str, exc: Exception) -> None:
-        """A poll/upload/heartbeat could not reach the hive. An HTTP
+    def _note_hive_failure(self, source: str, exc: Exception,
+                           shard: _HiveShard | None = None) -> None:
+        """A poll/upload/heartbeat could not reach this shard. An HTTP
         4xx is excluded — the hive ANSWERED (a reference hive 404ing
         heartbeats must not read as an outage while polls succeed)."""
         if hive_reachable_error(exc):
             return
-        if self.hive_session.note_failure(source):
-            assumed = len(self._inflight)
+        shard = shard if shard is not None else self.shards[0]
+        if shard.session.note_failure(source):
+            # only THIS shard's leases are assumed lost: jobs owned by
+            # the surviving shards keep their heartbeat coverage (the
+            # blast-radius bound federation exists for)
+            assumed = sum(
+                1 for job_id in self._inflight
+                if self._inflight_shard.get(job_id, 0) == shard.index)
             self.stats.hive_outages += 1
             if assumed:
                 self.stats.leases_assumed_lost += assumed
             log.error(
-                "hive OUTAGE after %d consecutive %s failure(s); %d "
+                "hive%s OUTAGE after %d consecutive %s failure(s); %d "
                 "in-flight lease(s) assumed lost — work rides through, "
                 "results spool to dead-letter and replay on heal",
-                self.hive_session.consecutive_failures, source, assumed)
+                f" shard {shard.index}" if len(self.shards) > 1 else "",
+                shard.session.consecutive_failures, source, assumed)
 
-    def _note_hive_epoch(self, raw: Any) -> int | None:
-        """Track the hive epoch stamped on grants/heartbeat acks; a
-        bump means the hive recovered from its journal since we last
-        spoke — every pre-bump lease is void (the recovered hive
-        redelivers them), which the ride-through already assumed."""
+    def _note_hive_epoch(self, raw: Any,
+                         shard: _HiveShard | None = None) -> int | None:
+        """Track the epoch stamped on a shard's grants/heartbeat acks;
+        a bump means THAT shard recovered from its journal since we
+        last spoke — every pre-bump lease it held is void (the
+        recovered shard redelivers them), which the ride-through
+        already assumed. Epochs are per-shard truth: shard 2 restarting
+        must not void shard 1's leases."""
         try:
             epoch = None if raw is None else int(raw)
         except (TypeError, ValueError):
             return None
         if epoch is None:
             return None
-        previous = self._last_hive_epoch
+        shard = shard if shard is not None else self.shards[0]
+        previous = shard.last_epoch
         if previous is not None and epoch != previous:
             self.stats.hive_epoch_changes += 1
-            log.warning("hive epoch %d -> %d: the hive recovered from "
-                        "its journal; pre-recovery leases are void and "
-                        "their jobs will redeliver", previous, epoch)
-        self._last_hive_epoch = epoch
+            log.warning("hive%s epoch %d -> %d: the hive recovered "
+                        "from its journal; pre-recovery leases are void "
+                        "and their jobs will redeliver",
+                        f" shard {shard.index}"
+                        if len(self.shards) > 1 else "",
+                        previous, epoch)
+        shard.last_epoch = epoch
         return epoch
 
     async def run(self) -> None:
@@ -537,8 +651,17 @@ class Worker:
         ]
         result_task = asyncio.create_task(self._result_worker(),
                                           name="results")
-        poll_task = asyncio.create_task(self._poll_loop(), name="poll")
-        tasks = slot_tasks + [result_task, poll_task]
+        # one poll loop per hive shard (swarmfed, ISSUE 17): each runs
+        # its own backoff/outage state, so a dead shard slows only its
+        # own loop while the rest keep feeding the work queue
+        poll_tasks = [
+            asyncio.create_task(self._poll_loop(shard),
+                                name=(f"poll{shard.index}"
+                                      if len(self.shards) > 1
+                                      else "poll"))
+            for shard in self.shards
+        ]
+        tasks = slot_tasks + [result_task] + poll_tasks
         if float(self.settings.heartbeat_s or 0) > 0:
             # heartbeats outlive the poll loop on purpose: they keep the
             # leases of draining in-flight jobs alive until the final
@@ -547,7 +670,7 @@ class Worker:
                                              name="heartbeat"))
         try:
             await self._stop.wait()
-            await self._shutdown(poll_task, slot_tasks, result_task)
+            await self._shutdown(poll_tasks, slot_tasks, result_task)
         finally:
             for task in tasks:
                 task.cancel()
@@ -560,15 +683,18 @@ class Worker:
                 await health_runner.cleanup()
             self._remove_signal_handlers(loop, signals)
 
-    async def _shutdown(self, poll_task, slot_tasks, result_task) -> None:
+    async def _shutdown(self, poll_tasks, slot_tasks, result_task) -> None:
         """Graceful drain: polling halts first, in-flight slots finish,
         queued results upload — each phase bounded by its timeout so a
         wedged dependency cannot hold the process hostage."""
         log.info("stopping: polling halts; %d queued job(s) + in-flight "
                  "work drain, then %d pending result(s) upload",
                  self.work_queue.qsize(), self.result_queue.qsize())
-        poll_task.cancel()
-        await asyncio.gather(poll_task, return_exceptions=True)
+        if not isinstance(poll_tasks, (list, tuple)):
+            poll_tasks = [poll_tasks]
+        for poll_task in poll_tasks:
+            poll_task.cancel()
+        await asyncio.gather(*poll_tasks, return_exceptions=True)
         self._draining.set()
         try:
             await asyncio.wait_for(
@@ -609,9 +735,20 @@ class Worker:
             except asyncio.QueueEmpty:
                 return
             trace = obs_trace.detach(result)  # never serializes to disk
+            if len(self.shards) > 1:
+                # stamp the owner shard before serializing (the
+                # _deliver path does this pre-upload; these envelopes
+                # never got there) so the replay routes correctly
+                owner = None
+                if trace is not None:
+                    owner = trace.meta.get(HIVE_SHARD_KEY)
+                if owner is None:
+                    owner = self._inflight_shard.get(result.get("id"))
+                if owner is not None:
+                    result.setdefault(HIVE_SHARD_KEY, int(owner))
             spooled = result.pop("_dead_letter_path", None)
             if spooled is None:  # replayed results already have a file
-                self.dead_letters.spool(result)
+                self._result_shard(result).spool.spool(result)
                 self.stats.results_dead_lettered += 1
             # same settling as _deliver's cancelled-upload path: a job
             # dead-lettered by shutdown still counts in jobs_total and
@@ -638,8 +775,10 @@ class Worker:
             "results_pending": self.result_queue.qsize(),
             # degradation-ladder observability (node/resilience.py)
             "breakers": self.breakers.states(),
-            "dead_letter_depth": self.dead_letters.depth(),
-            "poll_consecutive_errors": self._poll_backoff.failures,
+            "dead_letter_depth": sum(shard.spool.depth()
+                                     for shard in self.shards),
+            "poll_consecutive_errors": max(shard.backoff.failures
+                                           for shard in self.shards),
             # fleet durability (ISSUE 6): resume-state spool + lease view
             "checkpoint_depth": self.checkpoints.depth(),
             "checkpoints_written": self.checkpoints.written,
@@ -650,6 +789,18 @@ class Worker:
             # of a hive incident and its journal recovery
             "hive_session": self.hive_session.snapshot(),
             "hive_epoch": self._last_hive_epoch,
+            # swarmfed (ISSUE 17): the multiplexed view — one session/
+            # epoch/spool entry per hive shard (a single-hive worker
+            # shows its one shard; the keys above stay its aliases)
+            "hive_shards": [
+                {"shard": shard.index,
+                 "uri": shard.uri,
+                 "session": shard.session.snapshot(),
+                 "hive_epoch": shard.last_epoch,
+                 "dead_letter_depth": shard.spool.depth(),
+                 "poll_consecutive_errors": shard.backoff.failures}
+                for shard in self.shards
+            ],
         }
         data.update(self.stats.snapshot())
         data["stepper"] = self._stepper_health()
@@ -762,11 +913,12 @@ class Worker:
                   "jobs that completed execution on this worker").set_to(
             self.jobs_done)
         m.gauge("chiaswarm_dead_letter_depth",
-                "result envelopes spooled on disk").set(
-            self.dead_letters.depth())
+                "result envelopes spooled on disk (all shard spools)").set(
+            sum(shard.spool.depth() for shard in self.shards))
         m.gauge("chiaswarm_poll_consecutive_errors",
-                "current poll-loop error streak (drives the backoff)").set(
-            self._poll_backoff.failures)
+                "current poll-loop error streak (drives the backoff; "
+                "worst shard)").set(
+            max(shard.backoff.failures for shard in self.shards))
         # fleet durability (ISSUE 6): checkpoint spool + lease signals
         m.gauge("chiaswarm_checkpoint_depth",
                 "in-flight resume checkpoints on disk").set(
@@ -782,9 +934,17 @@ class Worker:
                 "lease-heartbeat set)").set(len(self._inflight))
         # hive-outage ride-through (ISSUE 14): the session state gauge
         # next to the outage/assumed-lost counters ResilienceStats
-        # already renders
+        # already renders. Federated (ISSUE 17): the overall gauge
+        # means "ANY shard in outage" (shard-0-equivalent at H=1) and
+        # the labeled family carries the per-shard truth.
         obs_metrics.hive_session_state_gauge(self.metrics).set(
-            1 if self.hive_session.in_outage else 0)
+            1 if any(shard.session.in_outage for shard in self.shards)
+            else 0)
+        shard_gauge = obs_metrics.hive_shard_session_state_gauge(
+            self.metrics)
+        for shard in self.shards:
+            shard_gauge.set(1 if shard.session.in_outage else 0,
+                            shard=str(shard.index))
         # swarmsight (ISSUE 13): trace-ring eviction becomes a counter
         # so a slow scraper SEES that it lost spans (pair with the
         # /debug/traces?since= cursor instead of scraping faster)
@@ -927,7 +1087,8 @@ class Worker:
 
     # ---- tasks ----
 
-    async def _poll_loop(self) -> None:
+    async def _poll_loop(self, shard: _HiveShard | None = None) -> None:
+        shard = shard if shard is not None else self.shards[0]
         async with aiohttp.ClientSession() as session:
             while not self._stop.is_set():
                 # natural backpressure: wait for queue space — but keep
@@ -957,7 +1118,7 @@ class Worker:
                         except asyncio.TimeoutError:
                             pass
                         continue
-                delay = await self._ask_for_work(session)
+                delay = await self._ask_for_work(session, shard)
                 # self-healing ladder (ISSUE 10): apply any rungs the
                 # device guard queued since the last poll — cache
                 # flush, device quarantine (mesh shrink), restart
@@ -967,24 +1128,30 @@ class Worker:
                 except asyncio.TimeoutError:
                     pass
 
-    async def _ask_for_work(self, session: aiohttp.ClientSession) -> float:
-        """One poll; returns the next delay. Errors back off exponentially
-        with jitter (capped at hive.POLL_ERROR_S by default) and the
-        schedule resets on the first successful poll."""
+    async def _ask_for_work(self, session: aiohttp.ClientSession,
+                            shard: _HiveShard | None = None) -> float:
+        """One poll against one shard; returns the next delay. Errors
+        back off exponentially with jitter (capped at hive.POLL_ERROR_S
+        by default) and the schedule resets on the first successful
+        poll. A federated shard's handout may include a STOLEN job —
+        granted (and journaled) by a deeper-backlog peer; its payload
+        carries that owner's shard index and epoch, so heartbeats and
+        the upload route to the shard that actually holds the lease."""
+        shard = shard if shard is not None else self.shards[0]
         t_poll = time.perf_counter()
         try:
-            jobs = await self.hive.get_work(session)
+            jobs = await shard.client.get_work(session)
         except BadWorkerError as exc:
             # the hive ANSWERED (flagged us): reachable, not an outage
-            self._note_hive_ok()
+            self._note_hive_ok(shard)
             log.error("hive flagged this worker: %s", exc)
-            return self._poll_backoff.next()
+            return shard.backoff.next()
         except Exception as exc:
-            self._note_hive_failure("poll", exc)
+            self._note_hive_failure("poll", exc, shard)
             log.warning("poll failed: %s", exc)
-            return self._poll_backoff.next()
-        self._note_hive_ok()
-        self._poll_backoff.reset()
+            return shard.backoff.next()
+        self._note_hive_ok(shard)
+        shard.backoff.reset()
         poll_http_s = time.perf_counter() - t_poll
         if jobs:
             # poll-loop / step-boundary merge (ISSUE 7c): tell each
@@ -1032,12 +1199,27 @@ class Worker:
             # the overload estimator being the only reader (ISSUE 13).
             resume = job.get("resume")
             ctx = job.pop(obs_flight.TRACE_CTX_KEY, None)
+            # swarmfed (ISSUE 17): a federated grant names its OWNING
+            # shard (a stolen job arrives via this shard's poll but its
+            # lease, journal entry, and epoch all live on the owner).
+            # Popped like the epoch stamp — never reaches argument
+            # formatting — and rides the trace to the upload router.
+            owner_raw = job.pop(HIVE_SHARD_KEY, None)
+            try:
+                owner_index = (shard.index if owner_raw is None
+                               else int(owner_raw))
+            except (TypeError, ValueError):
+                owner_index = shard.index
+            owner = (self.shards[owner_index]
+                     if 0 <= owner_index < len(self.shards) else shard)
             # swarmdurable (ISSUE 14): the journaled hive's epoch stamp
             # is popped like the trace context (never reaches argument
             # formatting) and rides the trace to the upload, where the
-            # envelope echoes it — the recovered hive's dedupe key
+            # envelope echoes it — the recovered hive's dedupe key.
+            # Tracked against the OWNER: the epoch is that shard's
+            # journal generation, whoever's poll delivered the grant.
             epoch = self._note_hive_epoch(
-                job.pop(HIVE_EPOCH_KEY, None))
+                job.pop(HIVE_EPOCH_KEY, None), owner)
             try:
                 queued_s = max(0.0, float(job.get("queued_s") or 0.0))
             except (TypeError, ValueError):
@@ -1053,6 +1235,11 @@ class Worker:
                              if isinstance(resume, dict) else 0))
             if epoch is not None:
                 trace.meta[HIVE_EPOCH_KEY] = epoch
+            if owner_raw is not None:
+                # only federated grants carry a shard; the meta stamp
+                # routes the upload envelope to the owner (parity: an
+                # un-federated grant stamps nothing anywhere)
+                trace.meta[HIVE_SHARD_KEY] = owner.index
             if isinstance(ctx, dict) and ctx.get("trace_id"):
                 # JOIN the hive's trace context (swarmsight, ISSUE 13):
                 # this trace becomes the hive-granted attempt span's
@@ -1065,6 +1252,7 @@ class Worker:
             trace.phase("poll", http_s=round(poll_http_s, 6))
             obs_trace.attach(job, trace)
             self._inflight[job.get("id")] = time.monotonic()
+            self._inflight_shard[job.get("id")] = owner.index
             await self.work_queue.put(job)
         if jobs:
             return float(self.settings.poll_busy_s)
@@ -1189,8 +1377,9 @@ class Worker:
         # and re-serializing occupancy/residency state on every beat
         # would tax exactly the busy loops the plane observes. An
         # autoscaler reads seconds-scale state; 0 forces the next beat.
+        # The throttle clock lives per shard (shard.last_metrics): each
+        # shard serves its own /api/fleet slice of this worker.
         metrics_every = max(interval, 2.0)
-        last_metrics = float("-inf")
         pushed: dict[Any, int] = {}  # job id -> spool version last pushed
         # leases the hive already told us it reassigned: count + warn
         # ONCE per loss, not once per beat for as long as the local run
@@ -1219,6 +1408,33 @@ class Worker:
                 jobs.append({"id": job_id, "checkpoint": checkpoint})
             return jobs
 
+        async def idle_beat(shard: _HiveShard) -> None:
+            # fleet plane (ISSUE 13): a worker with nothing in flight
+            # ON THIS SHARD still pushes metrics-only beats (no jobs,
+            # no lease bookkeeping) so its /api/fleet reads fresh
+            # occupancy and capacity — an autoscaler must see idle
+            # workers, not just busy ones — at the throttled metrics
+            # cadence, not the lease cadence
+            if time.monotonic() - shard.last_metrics < metrics_every:
+                return
+            idle_payload = {
+                "worker_name": self.settings.worker_name,
+                "jobs": [],
+                "metrics": self._fleet_metrics(),
+            }
+            if shard.last_epoch is not None:
+                idle_payload[HIVE_EPOCH_KEY] = shard.last_epoch
+            try:
+                ack = await shard.client.post_heartbeat(
+                    session, idle_payload)
+                self._note_hive_ok(shard)
+                if isinstance(ack, dict):
+                    self._note_hive_epoch(ack.get(HIVE_EPOCH_KEY), shard)
+                shard.last_metrics = time.monotonic()
+            except Exception as exc:
+                self._note_hive_failure("heartbeat", exc, shard)
+                log.debug("idle heartbeat failed: %s", exc)
+
         async with aiohttp.ClientSession() as session:
             while True:
                 await asyncio.sleep(interval)
@@ -1227,75 +1443,79 @@ class Worker:
                 if not self._inflight:
                     pushed.clear()
                     lost_reported.clear()
-                    # fleet plane (ISSUE 13): an idle worker still
-                    # pushes metrics-only beats (no jobs, no lease
-                    # bookkeeping) so /api/fleet reads fresh occupancy
-                    # and capacity — an autoscaler must see idle
-                    # workers, not just busy ones — at the throttled
-                    # metrics cadence, not the lease cadence
-                    if time.monotonic() - last_metrics < metrics_every:
-                        continue
-                    idle_payload = {
-                        "worker_name": self.settings.worker_name,
-                        "jobs": [],
-                        "metrics": self._fleet_metrics(),
-                    }
-                    if self._last_hive_epoch is not None:
-                        idle_payload[HIVE_EPOCH_KEY] = \
-                            self._last_hive_epoch
-                    try:
-                        ack = await self.hive.post_heartbeat(
-                            session, idle_payload)
-                        self._note_hive_ok()
-                        if isinstance(ack, dict):
-                            self._note_hive_epoch(ack.get(HIVE_EPOCH_KEY))
-                        last_metrics = time.monotonic()
-                    except Exception as exc:
-                        self._note_hive_failure("heartbeat", exc)
-                        log.debug("idle heartbeat failed: %s", exc)
+                    for shard in self.shards:
+                        await idle_beat(shard)
                     continue
                 inflight = list(self._inflight)
                 for job_id in [j for j in pushed if j not in self._inflight]:
                     pushed.pop(job_id, None)
                 lost_reported &= {str(j) for j in inflight}
-                payload = {
-                    "worker_name": self.settings.worker_name,
-                    "jobs": await asyncio.to_thread(build_jobs, inflight),
-                }
-                if self._last_hive_epoch is not None:
-                    # the epoch handshake (ISSUE 14): a recovered hive
-                    # rejects beats claiming a pre-restart epoch — the
-                    # ack below hands back the current one, so the NEXT
-                    # beat re-registers under it
-                    payload[HIVE_EPOCH_KEY] = self._last_hive_epoch
-                if time.monotonic() - last_metrics >= metrics_every:
-                    # fleet plane (ISSUE 13): busy beats carry the
-                    # metric snapshot at the same throttled cadence;
-                    # the hive keeps the latest per worker at
-                    # /api/fleet. Reference hives (no heartbeat
-                    # endpoint) never see it — heartbeats are already
-                    # off there.
-                    payload["metrics"] = self._fleet_metrics()
-                    last_metrics = time.monotonic()
-                try:
-                    response = await self.hive.post_heartbeat(session,
-                                                              payload)
-                    self._note_hive_ok()
-                    # a malformed 2xx body (non-dict JSON, non-list
-                    # "lost") counts as a failed beat, NOT a loop exit:
-                    # one bad proxy answer must never kill the keep-alive
-                    # for the rest of the process lifetime
-                    lost_raw = response.get("lost") or []
-                    if not isinstance(lost_raw, list):
-                        raise TypeError("non-list 'lost' in heartbeat "
-                                        f"response: {lost_raw!r}")
-                    reported = {str(j) for j in lost_raw}
-                    self._note_hive_epoch(response.get(HIVE_EPOCH_KEY))
-                except Exception as exc:
-                    # reference hives have no heartbeat endpoint, and a
-                    # partitioned hive is exactly when we keep beating
-                    self._note_hive_failure("heartbeat", exc)
-                    log.debug("heartbeat failed: %s", exc)
+                # swarmfed (ISSUE 17): one beat per shard, each naming
+                # only the jobs whose lease that shard OWNS (a stolen
+                # job heartbeats to its owner, not the shard whose poll
+                # delivered it) under that shard's own epoch handshake.
+                # A dead shard fails only its own beat — the rest keep
+                # their leases alive (per-shard outage independence).
+                by_owner: dict[int, list] = {}
+                for job_id in inflight:
+                    by_owner.setdefault(
+                        self._inflight_shard.get(job_id, 0),
+                        []).append(job_id)
+                reported: set[str] = set()
+                any_beat_ok = False
+                for shard in self.shards:
+                    owned = by_owner.get(shard.index)
+                    if not owned:
+                        # nothing leased here: keep the shard's fleet
+                        # plane fresh at the metrics cadence
+                        await idle_beat(shard)
+                        continue
+                    payload = {
+                        "worker_name": self.settings.worker_name,
+                        "jobs": await asyncio.to_thread(
+                            build_jobs, owned),
+                    }
+                    if shard.last_epoch is not None:
+                        # the epoch handshake (ISSUE 14): a recovered
+                        # hive rejects beats claiming a pre-restart
+                        # epoch — the ack below hands back the current
+                        # one, so the NEXT beat re-registers under it
+                        payload[HIVE_EPOCH_KEY] = shard.last_epoch
+                    if time.monotonic() - shard.last_metrics \
+                            >= metrics_every:
+                        # fleet plane (ISSUE 13): busy beats carry the
+                        # metric snapshot at the same throttled
+                        # cadence; the hive keeps the latest per worker
+                        # at /api/fleet. Reference hives (no heartbeat
+                        # endpoint) never see it — heartbeats are
+                        # already off there.
+                        payload["metrics"] = self._fleet_metrics()
+                        shard.last_metrics = time.monotonic()
+                    try:
+                        response = await shard.client.post_heartbeat(
+                            session, payload)
+                        self._note_hive_ok(shard)
+                        # a malformed 2xx body (non-dict JSON, non-list
+                        # "lost") counts as a failed beat, NOT a loop
+                        # exit: one bad proxy answer must never kill
+                        # the keep-alive for the rest of the process
+                        # lifetime
+                        lost_raw = response.get("lost") or []
+                        if not isinstance(lost_raw, list):
+                            raise TypeError(
+                                "non-list 'lost' in heartbeat "
+                                f"response: {lost_raw!r}")
+                        reported |= {str(j) for j in lost_raw}
+                        self._note_hive_epoch(
+                            response.get(HIVE_EPOCH_KEY), shard)
+                        any_beat_ok = True
+                    except Exception as exc:
+                        # reference hives have no heartbeat endpoint,
+                        # and a partitioned hive is exactly when we
+                        # keep beating
+                        self._note_hive_failure("heartbeat", exc, shard)
+                        log.debug("heartbeat failed: %s", exc)
+                if not any_beat_ok:
                     continue
                 self.stats.lease_heartbeats += 1
                 reported &= {str(j) for j in inflight}
@@ -1760,6 +1980,14 @@ class Worker:
             if trace.meta.get(HIVE_EPOCH_KEY) is not None:
                 result.setdefault(HIVE_EPOCH_KEY,
                                   trace.meta[HIVE_EPOCH_KEY])
+            # swarmfed (ISSUE 17): echo the grant's owner-shard stamp
+            # the same way — the upload routes to the shard that holds
+            # the lease (a stolen job's owner, not its delivery path),
+            # and a spooled envelope keeps the routing for its replay.
+            # Never stamped when the hive sent none: wire parity.
+            if trace.meta.get(HIVE_SHARD_KEY) is not None:
+                result.setdefault(HIVE_SHARD_KEY,
+                                  trace.meta[HIVE_SHARD_KEY])
             if trace.meta.get("trace_id"):
                 # swarmsight (ISSUE 13): a hive that stamped a trace
                 # context gets the span digest back on the envelope —
@@ -1775,26 +2003,28 @@ class Worker:
                 except Exception as exc:  # telemetry must never block
                     log.debug("span digest failed for %s: %s",
                               result.get("id"), exc)
+        shard = self._result_shard(result)
         try:
             with obs_trace.activate(trace):
-                uploaded = await self._upload_with_retry(session, result)
+                uploaded = await self._upload_with_retry(session, result,
+                                                         shard)
         except asyncio.CancelledError:
             # shutdown cancelled us mid-upload: persist before dying
             if spooled is None:
-                self.dead_letters.spool(result)
+                shard.spool.spool(result)
                 self.stats.results_dead_lettered += 1
             self._settle_inflight(result)
             self._finish_trace(trace, result, settled="dead_letter")
             raise
         if uploaded:
             if spooled is not None:
-                self.dead_letters.discard(spooled)
+                shard.spool.discard(spooled)
                 self._replayed_paths.discard(str(spooled))
             # GC on ack (ISSUE 6 satellite): the job settled, its resume
             # checkpoint is stale by definition
             self.checkpoints.discard(result.get("id"))
         elif spooled is None:
-            self.dead_letters.spool(result)
+            shard.spool.spool(result)
             self.stats.results_dead_lettered += 1
         else:
             # a replayed result that failed again keeps its existing
@@ -1805,10 +2035,27 @@ class Worker:
         self._finish_trace(trace, result,
                            settled="uploaded" if uploaded else "dead_letter")
 
+    def _result_shard(self, result: dict) -> _HiveShard:
+        """Which shard an upload belongs to: the envelope's owner-shard
+        echo first (stamped from the grant; survives spool + replay),
+        the in-flight routing table second, shard 0 otherwise (the
+        single-hive worker always lands here)."""
+        raw = result.get(HIVE_SHARD_KEY)
+        if raw is None:
+            raw = self._inflight_shard.get(result.get("id"))
+        try:
+            index = 0 if raw is None else int(raw)
+        except (TypeError, ValueError):
+            index = 0
+        if 0 <= index < len(self.shards):
+            return self.shards[index]
+        return self.shards[0]
+
     def _settle_inflight(self, result: dict) -> None:
         """The job left this worker's hands (uploaded or dead-lettered):
         stop heartbeating its lease."""
         self._inflight.pop(result.get("id"), None)
+        self._inflight_shard.pop(result.get("id"), None)
 
     def _finish_trace(self, trace, result: dict, settled: str) -> None:
         """Close a job's span tree, publish it to the worker's trace
@@ -1843,21 +2090,23 @@ class Worker:
             self.overload.note_service(trace.meta.get("workflow"),
                                        service_s / attempt_jobs)
 
-    async def _upload_with_retry(self, session, result) -> bool:
+    async def _upload_with_retry(self, session, result,
+                                 shard: _HiveShard | None = None) -> bool:
+        shard = shard if shard is not None else self.shards[0]
         retries = max(1, int(self.settings.upload_retries))
         for attempt in range(1, retries + 1):
             try:
-                response = await self.hive.post_result(session, result)
-                self._note_hive_ok()
+                response = await shard.client.post_result(session, result)
+                self._note_hive_ok(shard)
                 log.info("uploaded result %s: %s", result.get("id"),
                          response)
                 return True
             except Exception as exc:
-                self._note_hive_failure("upload", exc)
+                self._note_hive_failure("upload", exc, shard)
                 self.stats.upload_retries += 1
                 log.warning("result upload attempt %d/%d failed: %s",
                             attempt, retries, exc)
-                if self.hive_session.in_outage:
+                if shard.session.in_outage:
                     # ride-through (ISSUE 14): during a declared outage
                     # the full retry ladder only delays the spool (and
                     # the next result behind it). One probe per result

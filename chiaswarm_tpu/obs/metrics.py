@@ -829,5 +829,19 @@ def hive_session_state_gauge(registry: Registry | None = None) -> Gauge:
         "worker's hive reachability state (0=online, 1=outage)")
 
 
+def hive_shard_session_state_gauge(
+        registry: Registry | None = None) -> Gauge:
+    """The per-shard half of the session signal (swarmfed, ISSUE 17):
+    a multiplexed worker holds one HiveSession per hive shard, and this
+    family shows exactly WHICH shard's traffic is riding through an
+    outage while the rest keep serving. The unlabeled gauge above stays
+    the page-the-operator any-shard-down rollup (shard-0-equivalent on
+    a single-hive worker)."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_hive_shard_session_state",
+        "worker's per-shard hive session (0=online, 1=outage)",
+        ("shard",))
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

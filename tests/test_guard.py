@@ -591,6 +591,7 @@ def test_fleet_gate_wedge_and_nan_settle_exactly_once(monkeypatch):
 
     from chiaswarm_tpu.core.chip_pool import ChipPool
     from chiaswarm_tpu.core.mesh import MeshSpec
+    from chiaswarm_tpu.node.loadgen import ContentionProbe
     from chiaswarm_tpu.node.minihive import MiniHive
     from chiaswarm_tpu.node.registry import ModelRegistry
     from chiaswarm_tpu.node.settings import Settings
@@ -648,6 +649,15 @@ def test_fleet_gate_wedge_and_nan_settle_exactly_once(monkeypatch):
                 pool=pool))
         tasks = [asyncio.create_task(w.run()) for w in workers]
         bodies = []
+        # contention probe (ISSUE 17 deflake, the PR-12 pattern): on a
+        # 1-core container the GIL-contended warm-up inflates each
+        # scheduler's honest-step EWMA, and the hang budget (EWMA x
+        # factor) inflates with it — a FIXED 15 s wedge can then land
+        # UNDER the budget and never condemn. Sampling host contention
+        # across the warm-up and scaling the wedge seconds by the
+        # measured factor keeps the wedge/budget margin the test was
+        # designed with; the settlement clauses below are untouched.
+        probe = ContentionProbe().start()
         try:
             # PHASE 1 (warm-up, chaos unarmed, generous cold budgets):
             # the same job SHAPES the gate jobs use (steps 4 lands in
@@ -662,11 +672,14 @@ def test_fleet_gate_wedge_and_nan_settle_exactly_once(monkeypatch):
                             strength=0.8))
             await hive.wait_for_results(3, timeout=600)
 
-            # PHASE 2: arm the wedge (15 s, fired 5 post-arm steps in
-            # — its job has checkpoints by then) and the NaN poison
+            # PHASE 2: arm the wedge (15 s nominal, scaled by the
+            # measured contention factor; fired 5 post-arm steps in —
+            # its job has checkpoints by then) and the NaN poison
             # (row 0, 2 post-arm steps in), then release the gate
             # jobs: mixed workloads, two txt2img + one img2img
-            monkeypatch.setenv(guard.ENV_CHAOS_WEDGE, "5:15.0")
+            wedge_s = 15.0 * probe.stop()
+            monkeypatch.setenv(guard.ENV_CHAOS_WEDGE,
+                               f"5:{wedge_s:.2f}")
             monkeypatch.setenv(guard.ENV_CHAOS_NAN, "2:0")
             guard.reset_chaos()
             hive.submit(job("gate", 0))

@@ -554,6 +554,12 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert "chiaswarm_leases_assumed_lost_total 0" in body
     assert health["hive_session"]["state"] == "online"
     assert health["hive_epoch"] is None  # journal-less reference hive
+    # ...swarmfed families (ISSUE 17): the per-shard half of the
+    # session signal — one series per configured shard (a plain
+    # hive_uri is shard 0 of 1), zeroed from scrape one so a
+    # dashboard can tell "shard outage" from "series missing"...
+    assert "# TYPE chiaswarm_hive_shard_session_state gauge" in body
+    assert 'chiaswarm_hive_shard_session_state{shard="0"} 0' in body
     # ...phase latency histograms fed by the finished traces
     assert 'chiaswarm_job_phase_seconds_bucket{phase="upload",le="+Inf"}' \
         in body
@@ -563,6 +569,37 @@ def test_worker_serves_metrics_and_traces_endpoints():
     assert {"job", "poll", "execute", "upload"} <= names
     assert {t["root"]["name"] for t in tree["traces"]} == {"job"}
     assert len(worker.traces) == 2
+
+
+def test_federation_front_metric_families_preseeded():
+    """swarmfed (ISSUE 17): the federation front's scrape body carries
+    the per-shard depth/epoch/leased gauges zeroed for EVERY shard and
+    each shard's steal/forward counters pre-seeded — all before any
+    job, poll, or steal, so fleet dashboards see the full shard
+    vocabulary from scrape one."""
+    from chiaswarm_tpu.node.federation import FederatedHive
+
+    fed = FederatedHive(n_shards=3, lease_s=30.0)
+    body = render_all([fed.metrics]
+                      + [shard.metrics for shard in fed.shards])
+
+    assert "# TYPE chiaswarm_hive_shard_depth gauge" in body
+    assert "# TYPE chiaswarm_hive_shard_epoch gauge" in body
+    assert "# TYPE chiaswarm_hive_shard_leased gauge" in body
+    for index in range(3):
+        assert f'chiaswarm_hive_shard_depth{{shard="{index}"}} 0' \
+            in body, index
+        assert f'chiaswarm_hive_shard_epoch{{shard="{index}"}} 0' \
+            in body, index
+        assert f'chiaswarm_hive_shard_leased{{shard="{index}"}} 0' \
+            in body, index
+    # each shard pre-seeds its steal counter with the self-pair and
+    # its forwarded-upload counter at zero
+    assert "# TYPE chiaswarm_hive_steals_total counter" in body
+    for index in range(3):
+        assert (f'chiaswarm_hive_steals_total{{from="{index}",'
+                f'to="{index}"}} 0' in body), index
+    assert "chiaswarm_hive_shard_forwarded_uploads_total 0" in body
 
 
 def test_fleet_endpoint_schema_from_heartbeat_scrape():

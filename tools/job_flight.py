@@ -33,11 +33,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.error
 import urllib.request
 
-from chiaswarm_tpu.obs.flight import (
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from chiaswarm_tpu.obs.flight import (  # noqa: E402
     flight_to_chrome,
     render_timeline,
     render_tree,

@@ -699,6 +699,123 @@ def _bench_load_harness(*, on_tpu: bool, attn: str) -> dict:
     }
 
 
+def _bench_ring_flash(*, on_tpu: bool, iters: int) -> dict:
+    """ISSUE 18 (swarmkernel): the fused ring-flash attainment row.
+
+    Times the seq-parallel self-attention shard_map both ways — the
+    ppermute ring scan (the exactness oracle) and the fused Pallas
+    ring-flash kernel — on the same mesh and shapes, and stamps each
+    kind's p50, static roofline (attainment vs measured p50) and HLO
+    collective census. On a TPU pod the delta IS the DMA/compute
+    overlap; on CPU hosts the fused kind rides Pallas interpret mode,
+    so the speedup number is notional there while the census (the
+    zero-spurious-all-reduce acceptance line) and parity stay exact."""
+    import jax
+    import jax.numpy as jnp
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped": "needs >= 2 devices for a seq mesh",
+                "devices": len(devices)}
+    sp = 4 if len(devices) >= 4 else len(devices)
+
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from chiaswarm_tpu.analysis import hlocheck
+    from chiaswarm_tpu.core.compat import shard_map, shard_map_unchecked
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.obs import hlocost
+    from chiaswarm_tpu.ops.ring_flash_attention import ring_flash_attention
+    from chiaswarm_tpu.parallel.ring_attention import ring_attention
+
+    mesh = build_mesh(MeshSpec({"seq": sp}), devices=devices[:sp])
+    # TPU: the SDXL 1024px self-attention class the kernel targets;
+    # CPU: the tiny hermetic shape (interpret mode is O(slow))
+    b, l, h, d = (2, 4096, 10, 64) if on_tpu else (2, 128, 2, 32)
+    spec = P(None, "seq", None, None)
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, l, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, l, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, l, h, d), jnp.float32)
+
+    kinds = {
+        "ring": shard_map(partial(ring_attention, axis_name="seq"),
+                          mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec),
+        "ring_flash": shard_map_unchecked(
+            partial(ring_flash_attention, axis_name="seq",
+                    mesh_axis_names=tuple(mesh.axis_names)),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec),
+    }
+    out: dict = {"mesh": {"seq": sp}, "shape": [b, l, h, d]}
+    for kind, fn in kinds.items():
+        jitted = jax.jit(fn)
+        compiled = jitted.lower(q, k, v).compile()
+        compiled(q, k, v).block_until_ready()  # warm
+        times = []
+        for _ in range(max(2, iters)):
+            t0 = time.perf_counter()
+            compiled(q, k, v).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        p50 = _percentile50(times)
+        hlo = hlocost.compiled_hlo_text(compiled)
+        row = {"p50_latency_s": round(p50, 5)}
+        if hlo:
+            row["roofline"] = hlocost.static_program_report(
+                hlo, achieved_s=p50)
+            # the ISSUE-18 acceptance line: the fused program's census
+            # must show the collective-permute ring and ZERO spurious
+            # all-reduces (an all-reduce here = the softmax combine
+            # leaked out of the carried state — R11's runtime face)
+            row["hlo_contract"] = hlocheck.census(hlo)
+        out[kind] = row
+    out["speedup_ring_flash_vs_ring"] = round(
+        out["ring"]["p50_latency_s"]
+        / max(out["ring_flash"]["p50_latency_s"], 1e-9), 4)
+    return out
+
+
+def _bench_federated_load(*, on_tpu: bool, attn: str) -> dict:
+    """ISSUE 18 satellite: the federated hive (PR 17) under the same
+    seeded diurnal overload as ``load_harness``, but sharded across a
+    3-shard control plane with multiplexed workers — stamps the
+    fleet-wide end-to-end p50/p99 and the cross-shard steal books so
+    BENCH rounds track whether work stealing keeps shard queues level
+    (steals_total == 0 would mean the empty-poll steal seam went
+    dead). Control-plane only: identical on CPU and TPU hosts."""
+    import asyncio
+
+    from chiaswarm_tpu.node import loadgen
+
+    seed = "swarmfed"  # FIXED, same stance as load_harness
+    schedule = loadgen.build_scenario(seed=seed, n_users=1000,
+                                      duration_s=2.5, rate_jobs_s=120)
+    report = asyncio.run(loadgen.run_load(
+        schedule, n_workers=3, n_shards=3, seed=seed, lease_s=3.0,
+        max_jobs_per_poll=4, settle_timeout_s=180))
+    hive = report["hive"]
+    return {
+        "seed": seed,
+        "n_shards": hive["n_shards"],
+        "offered": report["offered"],
+        "outcomes": report["outcomes"],
+        "zero_loss": report["reconciliation"]["zero_loss"],
+        "admitted_p99_within_deadline":
+            report["admitted_deadline"]["p99_within_deadline"],
+        # fleet-wide latency: per-workload {p50, p99, n} end-to-end
+        "latency_s": report["latency_s"]["end_to_end"],
+        # cross-shard steal books, counted once by their owning shard
+        "steals_total": hive["aggregate"]["steals_total"],
+        "steals": hive["aggregate"]["steals"],
+        "forwarded_uploads": hive["aggregate"]["forwarded_uploads"],
+        "per_shard_completed": [s["completed"] for s in hive["shards"]],
+        "fleet": report["fleet"],
+    }
+
+
 def run_configs(names: list[str], *, on_tpu: bool, iters: int,
                 attn: str) -> dict:
     import jax
@@ -875,6 +992,18 @@ def run_configs(names: list[str], *, on_tpu: bool, iters: int,
         results["load_harness"] = _bench_load_harness(on_tpu=on_tpu,
                                                       attn=attn)
 
+    if "ring_flash" in names:
+        # ISSUE 18 (swarmkernel): fused ring-flash vs ppermute ring —
+        # per-kind p50, roofline attainment, HLO collective census
+        results["ring_flash"] = _bench_ring_flash(on_tpu=on_tpu,
+                                                  iters=iters)
+
+    if "federated_load" in names:
+        # ISSUE 18 satellite: the 3-shard federated hive under the
+        # seeded overload — fleet p50/p99 + cross-shard steal books
+        results["federated_load"] = _bench_federated_load(on_tpu=on_tpu,
+                                                          attn=attn)
+
     return results
 
 
@@ -930,7 +1059,8 @@ def main() -> None:
     if which != "headline":
         names = (["sd15", "sd21", "controlnet", "img2vid", "stepper",
                   "stepper_mixed_workloads", "step_collapse", "txt2vid",
-                  "model_churn", "load_harness"]
+                  "model_churn", "load_harness", "ring_flash",
+                  "federated_load"]
                  if which == "all" else which.split(","))
         configs.update(run_configs(names, on_tpu=on_tpu, iters=iters,
                                    attn=attn))

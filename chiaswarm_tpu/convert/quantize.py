@@ -26,6 +26,20 @@ axis (dense kernels are ``(in, out)``, NHWC convs ``(kh, kw, in, out)``
 clipped to [-127, 127]. Leaves below :data:`MIN_QUANT_SIZE` elements or
 with ndim < 2 (biases, norm gains, time embeddings) stay fp — they are
 noise in the byte count and precision-critical.
+
+Activations (ISSUE 18, the other half of the low-precision arc):
+``CHIASWARM_ACTIVATIONS=int8|fp8`` routes the attention q/k/v operands
+(ops/attention.py) and the UNet block inputs (pipelines/diffusion.py)
+through :func:`fake_quant_activation` — per-TENSOR dynamic absmax
+scaling computed inside the traced program (activations have no ahead-
+of-time calibration moment the way weights do), quantize + dequant at
+use so the surrounding program stays in its serving dtype while XLA is
+free to keep the int8/fp8 codes feeding the matmul on hardware that
+eats them. fp8 engages only where :func:`core.compat.fp8_supported`
+says the chip has it; elsewhere the knob degrades to int8 with a
+one-time warning. Default off: the knob reads at TRACE time and
+``core.compile_cache.static_cache_key`` folds the format in only when
+enabled, so default-off executables stay byte-identical.
 """
 
 from __future__ import annotations
@@ -53,6 +67,92 @@ def weights_format() -> str:
 
 def int8_enabled() -> bool:
     return weights_format() == "int8"
+
+
+# ---------------------------------------------------------------------------
+# activation quantization (CHIASWARM_ACTIVATIONS, ISSUE 18)
+
+ENV_ACTIVATIONS = "CHIASWARM_ACTIVATIONS"
+
+#: int8 symmetric code range and the float8_e4m3fn finite max
+_INT8_MAX = 127.0
+_FP8_E4M3_MAX = 448.0
+
+_warned_fp8 = False
+
+
+def activations_format() -> str:
+    """Activation precision: ``off`` (default) | ``int8`` | ``fp8``.
+    Read at TRACE time; fp8 degrades to int8 (warn once) when
+    :func:`chiaswarm_tpu.core.compat.fp8_supported` says the backend
+    has no fp8 units, so a fleet-wide env roll stays safe on mixed
+    generations."""
+    global _warned_fp8
+    raw = os.environ.get(ENV_ACTIVATIONS, "").strip().lower()
+    if raw in ("", "0", "off", "none", "bf16", "fp32"):
+        return "off"
+    if raw == "fp8":
+        from chiaswarm_tpu.core import compat
+
+        if not compat.fp8_supported():
+            if not _warned_fp8:
+                _warned_fp8 = True
+                log.warning(
+                    "%s=fp8 requested but this backend has no fp8 "
+                    "support (compat.fp8_supported() is False); "
+                    "degrading to int8 activations", ENV_ACTIVATIONS)
+            return "int8"
+        return "fp8"
+    if raw == "int8":
+        return "int8"
+    log.warning("%s=%r not understood (off|int8|fp8); activations stay fp",
+                ENV_ACTIVATIONS, raw)
+    return "off"
+
+
+def activations_enabled() -> bool:
+    return activations_format() != "off"
+
+
+def fake_quant_activation(x: Any, *, tag: str | None = None) -> Any:
+    """Per-tensor dynamic-absmax quantize + dequant-at-use for one
+    activation tensor, applied INSIDE the traced program. Identity when
+    the knob is off (the default serving path traces unchanged) or the
+    input is not a float tensor.
+
+    int8: symmetric round-to-nearest onto [-127, 127]; fp8: scale the
+    tensor so its absmax lands at the e4m3 finite max, cast through the
+    fp8 dtype, and rescale — the standard per-tensor recipe. The absmax
+    is computed on the live values (a traced reduction XLA fuses into
+    the producer), so there is no calibration state to manage and the
+    seam composes with lanes/batching of any width. When swarmlens is
+    recording, the dequantized tensor is tapped as ``act.<tag>`` — the
+    drill-down instrument for a quantized-vs-fp bisect pair."""
+    fmt = activations_format()
+    if fmt == "off":
+        return x
+    dtype = getattr(x, "dtype", None)
+    if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+        return x
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf))
+    if fmt == "int8":
+        scale = jnp.maximum(absmax, 1e-12) / _INT8_MAX
+        q = jnp.clip(jnp.round(xf / scale), -_INT8_MAX, _INT8_MAX)
+        out = (q.astype(jnp.int8).astype(jnp.float32) * scale).astype(dtype)
+    else:
+        from chiaswarm_tpu.core import compat
+
+        f8 = compat.float8_dtype()
+        scale = jnp.maximum(absmax, 1e-12) / _FP8_E4M3_MAX
+        out = ((xf / scale).astype(f8).astype(jnp.float32)
+               * scale).astype(dtype)
+    if tag is not None:
+        from chiaswarm_tpu.obs import numerics as _numerics
+
+        if _numerics.enabled_for("act"):
+            out = _numerics.tap(f"act.{tag}", out)
+    return out
 
 
 def bytes_per_param() -> int:

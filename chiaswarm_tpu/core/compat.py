@@ -208,6 +208,66 @@ def _resolve_io_callback():
     return cb
 
 
+def shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+    """``shard_map`` with replication checking off — required for bodies
+    containing ``pallas_call`` (no replication rule exists for it; the
+    fused ring-flash kernel and its interpret oracle both hit this).
+    The kwarg is ``check_rep`` on the 0.4.x pin and ``check_vma`` on
+    modern jax; this is the one sanctioned spelling of that fork."""
+    sm = __getattr__("shard_map")
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+    except TypeError:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+
+
+# ---------------------------------------------------------------------------
+# hardware capability probes (ISSUE 18): not moved-symbol shims, but the
+# same "one place that knows" stance — convert/quantize.py's activation
+# seam asks HERE whether fp8 is usable rather than sniffing device kinds
+# itself. Plain functions (not lazy attrs) so callers get a stable
+# signature to mock in tests.
+
+#: TPU generations WITHOUT native fp8 matmul support. v5p/v6e and later
+#: accept float8_e4m3fn operands; older chips would silently upcast (or
+#: fail to lower), so the activation seam falls back to int8 there.
+_FP8_LESS_TPUS = ("v2", "v3", "v4", "v5 lite", "v5e")
+
+
+def float8_dtype():
+    """The fp8 activation dtype (e4m3: the forward-pass variant — more
+    mantissa, the weights/activations choice in every mixed-fp8 recipe),
+    or None when this jax build does not ship float8 dtypes."""
+    try:
+        import jax.numpy as jnp
+
+        return jnp.float8_e4m3fn
+    except Exception:
+        return None
+
+
+def fp8_supported() -> bool:
+    """True when fp8 activations can run on the CURRENT backend: the
+    dtype exists AND the accelerator has fp8 matmul units. Non-TPU
+    backends (the hermetic CPU tier) count as supported when the dtype
+    exists — XLA emulates the conversions, which is exactly what the
+    parity tests need; the generation gate only bites on real TPUs."""
+    if float8_dtype() is None:
+        return False
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return True
+        kind = jax.devices()[0].device_kind.lower()
+        return not any(kind.startswith(old) or old in kind
+                       for old in _FP8_LESS_TPUS)
+    except Exception:
+        return False
+
+
 _LAZY = {
     "shard_map": _resolve_shard_map,
     "axis_size": _resolve_axis_size,

@@ -176,6 +176,16 @@ def static_cache_key(owner: int, tag: str, static: dict) -> tuple:
 
     if numerics.enabled():
         key = key + (("numerics", numerics.fingerprint()),)
+
+    # low-precision activations (ISSUE 18): same stance as the numerics
+    # fingerprint — CHIASWARM_ACTIVATIONS changes what the program
+    # traces (fake-quant seams at attention q/k/v and the UNet block
+    # inputs), so an enabled format must never share an executable slot
+    # with the fp trace; with the knob OFF the key stays byte-identical
+    from chiaswarm_tpu.convert import quantize
+
+    if quantize.activations_enabled():
+        key = key + (("activations", quantize.activations_format()),)
     return key
 
 

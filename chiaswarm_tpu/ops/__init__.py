@@ -1,5 +1,18 @@
-"""Compute ops: attention (XLA reference + Pallas flash kernel), fused helpers."""
+"""Compute ops: attention (XLA reference + Pallas flash and fused
+ring-flash kernels), fused helpers."""
 
 from chiaswarm_tpu.ops.attention import attention, AttentionImpl
 
-__all__ = ["attention", "AttentionImpl"]
+__all__ = ["attention", "AttentionImpl", "ring_flash_attention"]
+
+
+def __getattr__(name):
+    # lazy: ring_flash_attention pulls in the Pallas modules; the hot
+    # serving import path should not pay for it until a seq mesh engages
+    if name == "ring_flash_attention":
+        from chiaswarm_tpu.ops.ring_flash_attention import (
+            ring_flash_attention,
+        )
+
+        return ring_flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

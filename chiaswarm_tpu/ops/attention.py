@@ -1,25 +1,44 @@
 """Attention dispatch — the TPU replacement for the reference's xformers
 memory-efficient attention (enabled at swarm/diffusion/diffusion_func.py:86-87).
 
-Four implementations behind one function:
+Five implementations behind one function:
 
-- ``"xla"``      — plain einsum softmax attention; XLA fuses it well for the
-                   small/medium sequence lengths of image latents. Always
-                   correct; the golden reference for kernel tests.
-- ``"flash"``    — Pallas blockwise flash-attention kernel (ops/flash_attention.py),
-                   O(L) memory, targets the MXU; used on TPU for large token
-                   counts (SDXL 1024px self-attention = 4096 tokens, video).
-- ``"ring"``     — sequence-parallel ring attention (parallel/ring_attention.py):
-                   tokens sharded over the mesh's ``seq`` axis, KV blocks
-                   rotated on ICI. Engaged when the pipeline runs under
-                   parallel.context.sequence_parallel on a seq>1 mesh —
-                   self-attention only (cross-attention KV is 77 tokens).
-- ``"auto"``     — ring when a seq-parallel mesh is active and shapes
-                   qualify, else flash on TPU when shapes qualify, else xla.
+- ``"xla"``        — plain einsum softmax attention; XLA fuses it well for
+                     the small/medium sequence lengths of image latents.
+                     Always correct; the golden reference for kernel tests.
+- ``"flash"``      — Pallas blockwise flash-attention kernel
+                     (ops/flash_attention.py), O(L) memory, targets the MXU;
+                     used on TPU for large token counts (SDXL 1024px
+                     self-attention = 4096 tokens, video).
+- ``"ring"``       — sequence-parallel ring attention
+                     (parallel/ring_attention.py): tokens sharded over the
+                     mesh's ``seq`` axis, KV blocks rotated with ppermute.
+                     Engaged when the pipeline runs under
+                     parallel.context.sequence_parallel on a seq>1 mesh —
+                     self-attention only (cross-attention KV is 77 tokens).
+                     The exactness oracle for the fused kernel.
+- ``"ring_flash"`` — fused Pallas ring-flash kernel
+                     (ops/ring_flash_attention.py): the flash inner loop
+                     with the next hop's KV shard streaming in as an async
+                     remote DMA under the compute. The seq-mesh default on
+                     TPU; on CPU it rides Pallas interpret mode and is
+                     opt-in (explicit impl or CHIASWARM_ATTENTION) so the
+                     hermetic tier keeps the cheap ppermute lowering.
+- ``"auto"``       — ring_flash (TPU) / ring (elsewhere) when a
+                     seq-parallel mesh is active and shapes qualify, else
+                     flash on TPU when shapes qualify, else xla.
+                     CHIASWARM_ATTENTION=<kind> overrides the auto pick.
 
 All take (B, L, H, D) query / (B, S, H, D) key-value tensors and return
 (B, L, H, D). Head-batched layouts keep the last dim = head_dim (128-lane
 friendly) and let the kernel tile L/S onto the MXU.
+
+Low-precision activations (ISSUE 18, the PR-8 weight-path residue): with
+CHIASWARM_ACTIVATIONS=int8|fp8 the q/k/v operands pass through
+convert.quantize.fake_quant_activation — per-tensor dynamic-absmax
+quantize + dequant-at-use inside the traced program — BEFORE the
+swarmlens taps, so a bisect of a quantized-vs-fp twin pair localizes the
+first attention layer whose inputs lost too much.
 """
 
 from __future__ import annotations
@@ -32,9 +51,11 @@ import jax.numpy as jnp
 
 from chiaswarm_tpu.obs import numerics as _numerics
 
-AttentionImpl = Literal["auto", "xla", "flash", "ring"]
+AttentionImpl = Literal["auto", "xla", "flash", "ring", "ring_flash"]
 
 _RING_MIN_TOKENS = 1024  # same bar as the flash kernel; env-overridable
+
+_IMPLS = ("auto", "xla", "flash", "ring", "ring_flash")
 
 
 def _ring_min_tokens() -> int:
@@ -43,16 +64,29 @@ def _ring_min_tokens() -> int:
     return int(os.environ.get("CHIASWARM_RING_MIN_TOKENS", _RING_MIN_TOKENS))
 
 
+def _env_impl() -> str | None:
+    """CHIASWARM_ATTENTION: operator override of the ``auto`` pick (the
+    attainment-sweep knob — flip kinds without touching worker config).
+    Explicit ``impl=`` callers are never overridden."""
+    import os
+
+    raw = os.environ.get("CHIASWARM_ATTENTION", "").strip().lower()
+    return raw if raw in _IMPLS else None
+
+
 def _try_ring(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float,
               impl: str) -> jnp.ndarray | None:
     """Sequence-parallel dispatch: shard tokens over the active mesh's
-    ``seq`` axis and run the ppermute ring. None = not eligible.
+    ``seq`` axis and run the ring — the fused ring-flash kernel by
+    default on TPU, the ppermute scan elsewhere. None = not eligible.
 
     The specs compose with the other parallel axes: batch rides ``data``
     and heads ride ``model`` (Megatron head sharding) whenever divisible,
     so a dp x tp x sp mesh needs no resharding beyond the ring itself.
-    Per-shard attention inside the ring is the einsum recurrence — local
-    sequences are L/sp, below the flash kernel's win threshold."""
+    Per-shard attention inside the ppermute ring is the einsum
+    recurrence — local sequences are L/sp, below the flash kernel's win
+    threshold; the fused kernel replaces exactly that inner loop with
+    the blockwise flash recurrence and overlaps the hop DMA with it."""
     from chiaswarm_tpu.parallel.context import active_seq_mesh
 
     mesh = active_seq_mesh()
@@ -65,7 +99,8 @@ def _try_ring(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float,
 
     sizes = dict(mesh.shape)
     sp = sizes.get(SEQ_AXIS, 1)
-    if l % sp or (impl != "ring" and l < _ring_min_tokens()):
+    ring_kinds = ("ring", "ring_flash")
+    if l % sp or (impl not in ring_kinds and l < _ring_min_tokens()):
         return None
     from functools import partial
 
@@ -73,15 +108,40 @@ def _try_ring(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float,
 
     from chiaswarm_tpu.core.compat import shard_map
 
-    from chiaswarm_tpu.parallel.ring_attention import ring_attention
-
     dp, tp = sizes.get(DATA_AXIS, 1), sizes.get(MODEL_AXIS, 1)
     spec = P(DATA_AXIS if dp > 1 and b % dp == 0 else None,
              SEQ_AXIS,
              MODEL_AXIS if tp > 1 and h % tp == 0 else None,
              None)
-    fn = shard_map(partial(ring_attention, axis_name=SEQ_AXIS, scale=scale),
-                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+    # kind choice inside the ring family: the fused kernel is the TPU
+    # default (ROADMAP item 2 — DMA under compute); on CPU meshes auto
+    # keeps the ppermute scan so the hermetic tier's seq-parallel
+    # programs keep their cheap ppermute lowering, and the fused path is
+    # engaged explicitly (impl="ring_flash" / CHIASWARM_ATTENTION) by
+    # the parity suite, the bisect probe configs and the HLO audit.
+    use_fused = (impl == "ring_flash"
+                 or (impl != "ring" and _on_tpu(q)))
+    if use_fused:
+        from chiaswarm_tpu.core.compat import shard_map_unchecked
+
+        from chiaswarm_tpu.ops.ring_flash_attention import (
+            ring_flash_attention,
+        )
+
+        body = partial(ring_flash_attention, axis_name=SEQ_AXIS,
+                       scale=scale,
+                       mesh_axis_names=tuple(mesh.axis_names))
+        # pallas_call has no shard_map replication rule: checking off
+        fn = shard_map_unchecked(body, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec)
+    else:
+        from chiaswarm_tpu.parallel.ring_attention import ring_attention
+
+        body = partial(ring_attention, axis_name=SEQ_AXIS, scale=scale)
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
     return fn(q, k, v)
 
 
@@ -123,6 +183,20 @@ def attention(
         raise ValueError(f"expected (B, L, H, D) tensors, got {q.shape}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    env_forced = False
+    if impl == "auto":
+        env = _env_impl()
+        if env is not None:
+            impl, env_forced = env, True
+
+    # low-precision activations (CHIASWARM_ACTIVATIONS, default off):
+    # identity when disabled — applied BEFORE the taps so the numerics
+    # streams record what the kernels actually consumed
+    from chiaswarm_tpu.convert.quantize import fake_quant_activation
+
+    q = fake_quant_activation(q, tag="attn.q")
+    k = fake_quant_activation(k, tag="attn.k")
+    v = fake_quant_activation(v, tag="attn.v")
 
     # swarmlens (ISSUE 11): per-call-site I/O probes. ``step`` carries a
     # TRACE-time call index — twin programs trace the same module
@@ -150,12 +224,15 @@ def attention(
     out = _try_ring(q, k, v, scale, impl)
     if out is not None:
         return _out_tap(out)
-    if impl == "ring":
+    if impl in ("ring", "ring_flash"):
         from chiaswarm_tpu.parallel.context import active_seq_mesh
 
-        if active_seq_mesh() is None:
+        if active_seq_mesh() is None and not env_forced:
+            # explicit impl= is a caller contract; the env knob is
+            # advisory (a fleet-wide roll must not crash workers whose
+            # mesh has no seq axis — they keep their local paths)
             raise ValueError(
-                "impl='ring' requires an active sequence-parallel mesh "
+                f"impl={impl!r} requires an active sequence-parallel mesh "
                 "(parallel.context.sequence_parallel)")
         # mesh active but shape not divisible by the seq axis:
         # correctness first, fall through to the local paths

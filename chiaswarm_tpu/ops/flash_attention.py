@@ -50,6 +50,16 @@ except Exception:  # pragma: no cover
 
 _NEG_INF = -1e30  # finite stand-in: true -inf breaks exp() on fully-masked rows
 
+
+def _compiler_params(**kwargs):
+    """The Mosaic params dataclass is ``TPUCompilerParams`` on the 0.4.x
+    pin and ``CompilerParams`` on modern jax — resolve whichever ships.
+    (The old spelling here only ever ran on TPU, so CPU CI could not
+    catch the pin mismatch; ring_flash_attention shares this helper.)"""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
 # block-sweep knobs (read once at import): defaults are the tuned v5e
 # values. CHIASWARM_FLASH_VMEM_MB sets the kernel-scoped VMEM cap — the
 # default 24 MB gives the tuned 2048x1024 blocks headroom over XLA's
@@ -71,6 +81,39 @@ _VMEM_MB = int(os.environ.get("CHIASWARM_FLASH_VMEM_MB", "24"))
 _LANES = 128
 
 
+def online_softmax_block_update(q, k, v, m_prev, l_prev, acc_prev, *,
+                                scale: float, kv_len: int, col_offset):
+    """One KV block of the running-softmax recurrence, shared by the
+    local flash kernel below and the fused ring kernel
+    (ops/ring_flash_attention.py). All operands are plain arrays (the
+    callers own the scratch refs): q (bq, d), k/v (bkv, d), m/l (bq, 1)
+    running max/denominator, acc (bq, d) fp32 accumulator. ``col_offset``
+    is the block's first GLOBAL kv column (masks padding past
+    ``kv_len``); it may be a traced scalar in the ring kernel, where the
+    hop index is a grid coordinate. Returns (m_next, l_next, acc_next)
+    — bit-identical math to the pre-refactor inline version."""
+    logits = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    # mask KV positions past the true sequence length (block padding)
+    col = col_offset + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < kv_len, logits, _NEG_INF)
+
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_next)           # rescale of the old partials
+    p = jnp.exp(logits - m_next)               # (bq, bkv) fp32
+    l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_next = acc_prev * alpha + jax.lax.dot_general(
+        p, v.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_next, l_next, acc_next
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
                   scale: float, kv_len: int, block_kv: int):
     """One (q-block, kv-block) tile of the running-softmax recurrence."""
@@ -83,32 +126,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
         acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-
-    logits = jax.lax.dot_general(
-        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
-
-    # mask KV positions past the true sequence length (block padding)
-    col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    logits = jnp.where(col < kv_len, logits, _NEG_INF)
-
-    m_prev = m_scr[:, :1]                      # (bq, 1)
-    l_prev = l_scr[:, :1]
-    m_cur = jnp.max(logits, axis=-1, keepdims=True)
-    m_next = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_next)           # rescale of the old partials
-    p = jnp.exp(logits - m_next)               # (bq, bkv) fp32
-    l_next = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p, v.astype(jnp.float32),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    m_next, l_next, acc_next = online_softmax_block_update(
+        q_ref[0], k_ref[0], v_ref[0],
+        m_scr[:, :1], l_scr[:, :1], acc_scr[:],
+        scale=scale, kv_len=kv_len, col_offset=j * block_kv,
     )
+    acc_scr[:] = acc_next
     m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(l_next, l_scr.shape)
 
@@ -234,7 +257,7 @@ def flash_attention(
     params = {}
     if _HAS_PLTPU and not interpret:
         extra = {"vmem_limit_bytes": _VMEM_MB << 20} if _VMEM_MB else {}
-        params["compiler_params"] = pltpu.CompilerParams(
+        params["compiler_params"] = _compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             **extra,
         )

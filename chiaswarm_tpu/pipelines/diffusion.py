@@ -43,7 +43,10 @@ from chiaswarm_tpu.obs import trace as obs_trace
 from chiaswarm_tpu.obs.profiling import annotate
 from chiaswarm_tpu.obs.trace import span
 from chiaswarm_tpu.parallel.context import seq_parallel_wrap
-from chiaswarm_tpu.convert.quantize import dequantize_tree
+from chiaswarm_tpu.convert.quantize import (
+    dequantize_tree,
+    fake_quant_activation,
+)
 from chiaswarm_tpu.core.rng import key_for_seed
 from chiaswarm_tpu.models.vae import AutoencoderKL
 from chiaswarm_tpu.pipelines.components import Components
@@ -434,6 +437,8 @@ class DiffusionPipeline:
                 if pooled is not None:
                     pooled = jnp.concatenate([npooled, pooled], axis=0)
 
+            ctx = fake_quant_activation(ctx, tag="unet.ctx")
+
             added = None
             if needs_xl:
                 time_ids = jnp.asarray(
@@ -517,6 +522,13 @@ class DiffusionPipeline:
                     x, state, carry_keys = carry
                 i = idx + start_step
                 inp = scale_model_input(sched, x, i)
+                # low-precision activations (CHIASWARM_ACTIVATIONS,
+                # default off = identity): the UNet block input for this
+                # step — every branch below (pix2pix triple, CFG double,
+                # solo) derives its batch from this tensor, so one seam
+                # covers them all; the text context is quantized once
+                # outside the scan
+                inp = fake_quant_activation(inp, tag="unet.in")
                 if pix2pix:
                     inp3 = jnp.concatenate([inp, inp, inp], axis=0)
                     img3 = jnp.concatenate(

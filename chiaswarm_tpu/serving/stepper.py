@@ -1217,18 +1217,28 @@ class Lane:
             if self.reuse:
                 info["unet_evals"] = evals
                 info["steps_skipped"] = skipped
+            # handoff BEFORE releasing the slots: busy() reports
+            # "_pending or _handoff or _rows", so releasing first opens
+            # a window where a draining caller sees an idle lane while
+            # this job's future is still unresolved — drain() returning
+            # True with the future pending was the at-seed stepper
+            # flake on loaded single-core hosts
+            self._handoff.append((job, pending, info))
             self._release_rows(job)
             changed = True
             self._sched._count(rows_completed=job.n_rows)
-            self._handoff.append((job, pending, info))
         for job in expired:
-            self._release_rows(job)
-            changed = True
+            # ordering discipline: the caller wakes on set_exception,
+            # so everything it may read must land first (the expired
+            # count) and the slots must stop counting toward busy()
+            # only after the future resolves (the drain() gap above)
             self._sched._count(rows_expired=job.n_rows)
             if not job.future.done():
                 job.future.set_exception(LaneDeadline(
                     f"row(s) of job {job.job_id} exceeded the in-lane "
                     f"deadline"))
+            self._release_rows(job)
+            changed = True
         if changed:
             with self._cond:
                 self._cond.notify_all()

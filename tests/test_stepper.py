@@ -221,6 +221,17 @@ def test_oom_halves_width_even_after_lane_teardown(tiny_pipe):
 
 
 def test_drain_and_shutdown_retire_lanes(tiny_pipe):
+    # contention probe (ISSUE 18 deflake, the PR-12/PR-17 pattern): on
+    # an oversubscribed CI host the lane thread can hold the step loop
+    # through a GIL-contended device sync, so the FIXED 5 s default
+    # lane.join inside shutdown() can return with the thread still
+    # live and lanes_live lands on a stale nonzero. Sample host
+    # contention across the drain and widen the join deadline by the
+    # measured factor; on a quiet host the factor is 1.0 and the
+    # deadline is unchanged.
+    from chiaswarm_tpu.node.loadgen import ContentionProbe
+
+    probe = ContentionProbe().start()
     sched = StepScheduler()
     fut = sched.submit_request(
         tiny_pipe, prompt="drainee", steps=6, guidance_scale=7.5,
@@ -228,7 +239,7 @@ def test_drain_and_shutdown_retire_lanes(tiny_pipe):
     assert sched.drain(timeout_s=300.0)
     assert fut.done()
     fut.result()[0].wait()
-    sched.shutdown()
+    sched.shutdown(timeout_s=5.0 * probe.stop())
     assert sched.stats()["lanes_live"] == 0
 
 

@@ -16,6 +16,14 @@ Configs:
   CHIASWARM_RING_MIN_TOKENS=1 vs the single-chip run. Probes:
   ``diffusion.*`` (global program state) + ``ring.*`` (per-shard
   per-hop partials, sharded run only — drill-down context).
+- ``seq_parallel_ring_flash``  the same paired run with the sharded
+  twin's rings served by the FUSED kernel
+  (CHIASWARM_ATTENTION=ring_flash, ops/ring_flash_attention.py) — the
+  ISSUE-18 probe point for the item-1 hunt: when ``seq_parallel``
+  diverges, rerun THIS config; a matching (step, probe) indicts the
+  sharding/combine machinery both rings share, a differing one indicts
+  the kernel. Drill-down probes are ``ring_flash.*`` (per-hop carried
+  m/l/acc instead of the ppermute ring's per-hop partials).
 - ``shard_rows``    the CHIASWARM_STEPPER_SHARD_ROWS lane twin: one
   4-row job stepped through a lane with rows sharded over the data
   axis vs the same job unsharded, compared through the ``lane_row``
@@ -206,6 +214,22 @@ def run_seq_parallel(steps: int) -> tuple[list[dict], list[dict], dict]:
     return stream_a, stream_b, context
 
 
+def run_seq_parallel_ring_flash(
+        steps: int) -> tuple[list[dict], list[dict], dict]:
+    """The ``seq_parallel`` pair with the fused ring-flash kernel as
+    the sharded twin's ring (the env knob is advisory, so the
+    single-chip twin simply keeps its local paths). The ``ring`` tap
+    family string-prefix-matches ``ring_flash.*`` too, so the per-hop
+    carried state records without extra env surface."""
+    os.environ["CHIASWARM_ATTENTION"] = "ring_flash"
+    try:
+        stream_a, stream_b, context = run_seq_parallel(steps)
+    finally:
+        os.environ.pop("CHIASWARM_ATTENTION", None)
+    context["attention"] = "ring_flash"
+    return stream_a, stream_b, context
+
+
 def run_shard_rows(steps: int) -> tuple[list[dict], list[dict], dict]:
     """The lane twin: one 4-row job through an unsharded lane vs the
     same job with rows sharded over the data axis
@@ -295,6 +319,7 @@ def run_fixture(steps: int = 6) -> tuple[list[dict], list[dict], dict]:
 
 CONFIGS = {
     "seq_parallel": run_seq_parallel,
+    "seq_parallel_ring_flash": run_seq_parallel_ring_flash,
     "shard_rows": run_shard_rows,
     "fixture": run_fixture,
 }

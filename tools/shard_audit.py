@@ -26,6 +26,16 @@ Programs:
 - ``ring2axis``  the same ring bound on a data=2 x seq=4 mesh — the
                  divergence family's trigger shape (two-axis shard_map);
                  same contract as ``ring``.
+- ``ring_flash`` the fused Pallas ring-flash kernel
+                 (ops/ring_flash_attention.py) on the same pure seq=4
+                 mesh. On this CPU audit the interpret-mode scan drives
+                 the hop kernel with a ppermute rotation, so the census
+                 must show the collective-permute ring and — the ISSUE-18
+                 acceptance line — ZERO spurious all-reduces: an
+                 all-reduce in the fused program would mean the softmax
+                 combine leaked out of the carried (m, l, acc) state.
+- ``ring_flash2axis``  the fused kernel on the data=2 x seq=4 trigger
+                 shape; same contract.
 
 How this relates to ``tools/divergence_bisect.py``: the bisect localizes
 *where numerics first diverge at runtime*; this audit checks *what the
@@ -146,11 +156,50 @@ def build_ring2axis() -> str:
     return _build_ring({"data": 2, "seq": 4})
 
 
+def _build_ring_flash(mesh_shape: dict) -> str:
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from chiaswarm_tpu.core.compat import shard_map_unchecked
+    from chiaswarm_tpu.core.mesh import MeshSpec, build_mesh
+    from chiaswarm_tpu.obs.hlocost import compiled_hlo_text
+    from chiaswarm_tpu.ops.ring_flash_attention import ring_flash_attention
+
+    n = 1
+    for v in mesh_shape.values():
+        n *= v
+    mesh = build_mesh(MeshSpec(dict(mesh_shape)),
+                      devices=jax.devices()[:n])
+    b, l, h, d = 2, 32, 2, 16
+    spec = P("data" if mesh_shape.get("data", 1) > 1 else None,
+             "seq", None, None)
+    fn = shard_map_unchecked(
+        partial(ring_flash_attention, axis_name="seq",
+                mesh_axis_names=tuple(mesh.axis_names)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    args = [jnp.zeros((b, l, h, d), jnp.float32) for _ in range(3)]
+    compiled = jax.jit(fn).lower(*args).compile()
+    return compiled_hlo_text(compiled)
+
+
+def build_ring_flash() -> str:
+    return _build_ring_flash({"seq": 4})
+
+
+def build_ring_flash2axis() -> str:
+    return _build_ring_flash({"data": 2, "seq": 4})
+
+
 BUILDERS = {
     "solo": build_solo,
     "lane": build_lane,
     "ring": build_ring,
     "ring2axis": build_ring2axis,
+    "ring_flash": build_ring_flash,
+    "ring_flash2axis": build_ring_flash2axis,
 }
 
 
@@ -158,7 +207,9 @@ def main() -> int:
     parser = argparse.ArgumentParser(
         description="audit lowered tiny-family programs against a "
                     "pinned HLO contract (collectives, dtypes, donation)")
-    parser.add_argument("--programs", default="solo,lane,ring,ring2axis",
+    parser.add_argument("--programs",
+                        default="solo,lane,ring,ring2axis,"
+                                "ring_flash,ring_flash2axis",
                         help="comma-separated subset of: "
                              + ",".join(sorted(BUILDERS)))
     parser.add_argument("--contract", default=DEFAULT_CONTRACT,

@@ -277,14 +277,21 @@ class FederatedHive:
         self._runner = None
         self.uri = ""
         self.port = 0
+        # swarmplan (ISSUE 19): a FleetPlanner attached to the FRONT
+        # plans fleet-wide over the merged fleet_snapshot; None keeps
+        # the pre-planner surface (404 /api/plan, hint-free acks)
+        self.planner: Any = None
 
     # ---- wiring ---------------------------------------------------------
 
     def attach(self, shard: ShardHive, index: int) -> ShardHive:
         """Wire a shard (fresh or recovered) into the federation at
-        ``index``: the back-reference gives it the router + peers."""
+        ``index``: the back-reference gives it the router + peers —
+        and the fleet planner, so a recovered shard's heartbeat acks
+        resume carrying placement hints without re-attachment."""
         shard.shard_index = int(index)
         shard.federation = self
+        shard.planner = getattr(self, "planner", None)
         if index < len(self.shards):
             self.shards[index] = shard
         return shard
@@ -321,6 +328,8 @@ class FederatedHive:
         self._app = web.Application()
         self._app.router.add_get("/api/stats", self._stats_endpoint)
         self._app.router.add_get("/api/fleet", self._fleet_endpoint)
+        self._app.router.add_get("/api/plan", self._plan_endpoint)
+        self._app.router.add_get("/api/shards", self._shards_endpoint)
         self._app.router.add_get("/api/flight", self._flights_endpoint)
         self._app.router.add_get("/api/flight/{job_id}",
                                  self._flight_endpoint)
@@ -623,6 +632,12 @@ class FederatedHive:
                 "observed_arrival_jobs_s": round(sum(
                     s["aggregate"]["observed_arrival_jobs_s"]
                     for s in per_shard), 4),
+                # per-model demand summed across shards (swarmplan,
+                # ISSUE 19): jobs hash-route by id, so every shard
+                # sees a slice of each model's stream — the fleet-wide
+                # rate the placement plan needs is the sum
+                "model_arrival_jobs_s": self._merged_model_rates(
+                    per_shard),
                 "pending_jobs": sum(
                     s["aggregate"]["pending_jobs"] for s in per_shard),
                 "leased_jobs": sum(
@@ -633,6 +648,30 @@ class FederatedHive:
                     s["aggregate"]["abandoned_jobs"] for s in per_shard),
             },
         }
+
+    @staticmethod
+    def _merged_model_rates(per_shard: list[dict[str, Any]]
+                            ) -> dict[str, float]:
+        merged: dict[str, float] = {}
+        for snapshot in per_shard:
+            rates = snapshot["aggregate"].get("model_arrival_jobs_s") or {}
+            for model, rate in rates.items():
+                merged[model] = merged.get(model, 0.0) + float(rate)
+        return {model: round(rate, 4)
+                for model, rate in sorted(merged.items())}
+
+    # ---- the fleet planner's journal seam (swarmplan, ISSUE 19) ---------
+    #
+    # The front owns no journal; shard 0's book records fleet-wide
+    # intent (the same convention the merged read views follow — one
+    # deterministic home, replayed by that shard's recovery).
+
+    def record_plan(self, decision: dict[str, Any]) -> None:
+        self.shards[0].record_plan(decision)
+
+    @property
+    def last_plan(self) -> dict[str, Any] | None:
+        return self.shards[0].last_plan
 
     # ---- front endpoints ------------------------------------------------
 
@@ -645,6 +684,32 @@ class FederatedHive:
         from aiohttp import web
 
         return web.json_response(self.fleet_snapshot())
+
+    async def _plan_endpoint(self, request):
+        """Fleet-wide ``GET /api/plan`` (swarmplan, ISSUE 19): the
+        supervisor contract served from the front — one poll address
+        for the whole federation."""
+        from aiohttp import web
+
+        if self.planner is None:
+            return web.json_response({"error": "no planner attached"},
+                                     status=404)
+        return web.json_response(self.planner.plan_snapshot())
+
+    async def _shards_endpoint(self, request):
+        """``GET /api/shards`` (ISSUE 19 satellite, PR-17 residue): the
+        front is an aggregation plane, not a proxy — workers must dial
+        the shards directly. This endpoint closes the bootstrap gap: a
+        worker configured with ONE front address fetches the shard uri
+        list here (``bootstrap_shard_uris``) instead of carrying a
+        hand-configured ``hive_shard_uris`` tuple."""
+        from aiohttp import web
+
+        return web.json_response({
+            "n_shards": self.n_shards,
+            "shards": self.shard_uris(),
+            "worker_uri": self.worker_uri(),
+        })
 
     async def _flights_endpoint(self, request):
         from aiohttp import web
@@ -679,3 +744,28 @@ class FederatedHive:
         return web.Response(text=body, content_type="text/plain",
                             charset="utf-8",
                             headers={"X-Content-Type": CONTENT_TYPE})
+
+
+async def bootstrap_shard_uris(front_uri: str, *,
+                               timeout_s: float = 10.0
+                               ) -> tuple[str, ...]:
+    """Resolve a federated front address into the worker-facing shard
+    uri list via ``GET /api/shards`` (ISSUE 19 satellite). The worker
+    consumes this at startup when ``hive_front_uri`` is set — one
+    operator-configured address instead of a hand-maintained shard
+    list that silently goes stale when the federation is resized.
+    Raises on an unreachable front or a body with no shards: serving
+    against a guessed control plane is worse than failing loudly."""
+    import aiohttp
+
+    url = front_uri.rstrip("/") + "/api/shards"
+    timeout = aiohttp.ClientTimeout(total=max(0.1, float(timeout_s)))
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        async with session.get(url) as response:
+            response.raise_for_status()
+            body = await response.json()
+    uris = tuple(str(u) for u in (body.get("shards") or ()) if u)
+    if not uris:
+        raise RuntimeError(
+            f"front {front_uri} returned no shard uris: {body!r}")
+    return uris

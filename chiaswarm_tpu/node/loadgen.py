@@ -613,6 +613,52 @@ class RosterPlan:
     leave_at: tuple[float, ...] = ()
 
 
+@dataclasses.dataclass(frozen=True)
+class AutoscalePlan:
+    """swarmplan (ISSUE 19): run the fleet ELASTICALLY under the
+    hive-side :class:`~chiaswarm_tpu.node.planner.FleetPlanner` instead
+    of a scripted roster. The harness starts ``min_workers``, ticks the
+    planner every ``tick_every_s`` wall seconds, and actuates its
+    decisions through the SAME seams a real deployment uses: scale-up
+    spawns workers from the run's factory (the supervisor leg —
+    real deployments poll ``GET /api/plan``); scale-down drains
+    gracefully (``request_stop`` + lease preemption via
+    ``expire_worker`` — never the kill path; mid-lane rows checkpoint
+    and redeliver-with-resume to survivors). The remaining fields are
+    :class:`~chiaswarm_tpu.node.planner.PlannerConfig` passthrough."""
+
+    min_workers: int = 1
+    max_workers: int = 6
+    tick_every_s: float = 0.25
+    target_utilization: float = 0.6
+    smoothing_window_s: float = 2.0
+    hysteresis: float = 0.15
+    cooldown_up_s: float = 0.5
+    cooldown_down_s: float = 2.5
+    backlog_drain_s: float = 2.0
+    capacity_jobs_s_per_worker: float = 6.0
+    capacity_alpha: float = 0.3
+    replicate_max: int = 3
+
+    def planner_config(self):
+        from chiaswarm_tpu.node.planner import PlannerConfig
+
+        return PlannerConfig(
+            min_workers=int(self.min_workers),
+            max_workers=int(self.max_workers),
+            target_utilization=float(self.target_utilization),
+            smoothing_window_s=float(self.smoothing_window_s),
+            hysteresis=float(self.hysteresis),
+            cooldown_up_s=float(self.cooldown_up_s),
+            cooldown_down_s=float(self.cooldown_down_s),
+            backlog_drain_s=float(self.backlog_drain_s),
+            capacity_jobs_s_per_worker=float(
+                self.capacity_jobs_s_per_worker),
+            capacity_alpha=float(self.capacity_alpha),
+            replicate_max=int(self.replicate_max),
+        )
+
+
 class ContentionProbe:
     """Host-contention sampler (ISSUE 12, promoted to a reusable class
     for the ISSUE 17 guard-gate deflake): a daemon THREAD measures how
@@ -678,6 +724,8 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
                    max_attempts: int = 4,
                    kill: KillPlan | None = None,
                    roster: "RosterPlan | None" = None,
+                   autoscale: "AutoscalePlan | None" = None,
+                   on_submit: Callable[[int, Any], Any] | None = None,
                    time_scale: float = 1.0,
                    settle_timeout_s: float = 300.0,
                    seed: Any = "swarmload") -> dict[str, Any]:
@@ -691,7 +739,14 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     stable hash, workers multiplex one session per shard (the
     comma-joined shard uris parse back through Settings.hive_uris),
     and idle shards steal from deep ones — the report's reconciliation
-    and latency folds are fleet-wide."""
+    and latency folds are fleet-wide.
+
+    ``autoscale`` (swarmplan, ISSUE 19) replaces the static roster with
+    the planner loop: ``n_workers`` is ignored, the fleet starts at
+    ``autoscale.min_workers`` and grows/shrinks per planning tick.
+    Every run (elastic or static) reports ``worker_time`` — summed
+    worker lifetime seconds — so the autoscaler gate can compare
+    worker-hours against static rosters on equal terms."""
     if hive is None:
         if int(n_shards) > 1:
             hive = FederatedLoadHive(
@@ -706,10 +761,27 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     uri = await hive.start()
     if hasattr(hive, "worker_uri"):  # federation: workers dial shards
         uri = hive.worker_uri()
+    initial_n = (max(1, int(autoscale.min_workers))
+                 if autoscale is not None else max(1, int(n_workers)))
     workers = [factory(uri, f"load-{seed}-w{i}")
-               for i in range(max(1, int(n_workers)))]
-    tasks = {w.settings.worker_name: asyncio.create_task(w.run())
-             for w in workers}
+               for i in range(initial_n)]
+    # per-worker lifetime ledger (swarmplan): every task stamps its
+    # start at creation and its stop via done-callback, so the report's
+    # worker-hours mean the same thing for static and elastic fleets
+    worker_started: dict[str, float] = {}
+    worker_stopped: dict[str, float] = {}
+    tasks: dict[str, asyncio.Task] = {}
+
+    def _track(name: str, task: "asyncio.Task") -> "asyncio.Task":
+        worker_started[name] = time.perf_counter()
+        task.add_done_callback(
+            lambda _t, n=name: worker_stopped.setdefault(
+                n, time.perf_counter()))
+        tasks[name] = task
+        return task
+
+    for w in workers:
+        _track(w.settings.worker_name, asyncio.create_task(w.run()))
     ordered = sorted(schedule, key=lambda s: s.at_s)
     issued = [str(s.job["id"]) for s in ordered]
     kill_at = (math.ceil(len(ordered) * max(0.0, min(1.0,
@@ -762,7 +834,7 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
             name = f"load-{seed}-join{joined_n}"
             worker = factory(uri, name)
             workers.append(worker)
-            tasks[name] = asyncio.create_task(worker.run())
+            _track(name, asyncio.create_task(worker.run()))
             roster_events.append({"at_job": done, "action": "join",
                                   "worker": name})
             log.info("roster: %s joined after %d submissions", name,
@@ -797,6 +869,88 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
                      "drained and left" if drained
                      else "leaving (drain still in progress)", done)
 
+    # swarmplan (ISSUE 19): the observe -> decide -> actuate loop. The
+    # planner only DECIDES; this harness is the actuator — the same
+    # division a real deployment has, where a supervisor polls
+    # GET /api/plan and runs the container orchestration.
+    planner = None
+    auto_task: asyncio.Task | None = None
+    auto_events: list[dict[str, Any]] = []
+    auto_sizes: list[list[float]] = []
+    auto_drains: dict[str, asyncio.Task] = {}
+    auto_spawned = 0
+    if autoscale is not None:
+        from chiaswarm_tpu.node.planner import FleetPlanner
+
+        planner = FleetPlanner(hive, autoscale.planner_config())
+
+        def _spawn_auto(count: int) -> None:
+            nonlocal auto_spawned
+            for _ in range(count):
+                auto_spawned += 1
+                name = f"load-{seed}-auto{auto_spawned}"
+                worker = factory(uri, name)
+                workers.append(worker)
+                _track(name, asyncio.create_task(worker.run()))
+                log.info("autoscale: spawned %s", name)
+
+        async def _drain_auto(name: str) -> None:
+            # graceful scale-down, NEVER the kill path: stop polling
+            # (in-flight work checkpoints and uploads), then preempt
+            # the leases so mid-lane rows redeliver-with-resume to
+            # survivors; the hive's exactly-once settle dedupes the
+            # race between the victim's final upload and the resume
+            worker = next((w for w in workers
+                           if w.settings.worker_name == name), None)
+            if worker is not None:
+                worker.request_stop()
+            hive.expire_worker(name)
+            task = tasks.get(name)
+            if task is not None:
+                try:
+                    await asyncio.wait_for(asyncio.shield(task),
+                                           timeout=60)
+                except Exception:
+                    pass
+            log.info("autoscale: drained %s", name)
+
+        async def _autoscale_loop() -> None:
+            while True:
+                await asyncio.sleep(max(1e-3,
+                                        float(autoscale.tick_every_s)))
+                decision = planner.tick()
+                rel_s = round(time.perf_counter() - t_start, 3)
+                auto_sizes.append([rel_s, int(decision["actual"])])
+                if decision["direction"] == "up" and decision["spawn"]:
+                    # spawn against the HARNESS's liveness ledger, not
+                    # the snapshot's: freshly spawned workers take a
+                    # heartbeat to register, and re-spawning for them
+                    # would overshoot the target
+                    alive = sum(
+                        1 for w in workers
+                        if w.settings.worker_name not in departed
+                        and w.settings.worker_name != killed.get(
+                            "worker"))
+                    _spawn_auto(min(int(decision["spawn"]),
+                                    max(0, int(decision["target"])
+                                        - alive)))
+                elif decision["direction"] == "down":
+                    for name in decision["drain"]:
+                        if name in departed or name in auto_drains:
+                            continue
+                        departed.add(name)
+                        auto_drains[name] = asyncio.create_task(
+                            _drain_auto(name))
+                if decision["direction"] != "hold":
+                    auto_events.append({
+                        "rel_s": rel_s,
+                        **{k: decision[k] for k in (
+                            "direction", "reason", "target", "actual",
+                            "spawn", "drain")},
+                    })
+
+        auto_task = asyncio.create_task(_autoscale_loop())
+
     try:
         for i, item in enumerate(ordered):
             target = t_start + item.at_s * max(1e-3, float(time_scale))
@@ -807,6 +961,13 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
             if kill_at is not None and not killed and i + 1 >= kill_at:
                 await maybe_kill()
             await apply_roster(i + 1)
+            if on_submit is not None:
+                # scripted mid-run chaos seam (the swarmplan soak kills
+                # and recovers a shard through it); awaited so the hook
+                # can run kill/restart cycles inline with submission
+                maybe_coro = on_submit(i + 1, hive)
+                if asyncio.iscoroutine(maybe_coro):
+                    await maybe_coro
         if kill_at is not None and not killed:
             await maybe_kill()
         await apply_roster(len(ordered))
@@ -823,11 +984,17 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     finally:
         duration_s = time.perf_counter() - t_start
         probe.stop()
+        if auto_task is not None:
+            auto_task.cancel()
+            await asyncio.gather(auto_task, return_exceptions=True)
         for worker in workers:
             worker.request_stop()
         await asyncio.gather(*(asyncio.wait_for(t, timeout=30)
                                for t in tasks.values()),
                              return_exceptions=True)
+        if auto_drains:
+            await asyncio.gather(*auto_drains.values(),
+                                 return_exceptions=True)
         await hive.stop()
 
     report = score_run(hive, issued, workers, ordered,
@@ -845,7 +1012,113 @@ async def run_load(schedule: Sequence[ScheduledJob], *,
     ad = report["admitted_deadline"]
     ad["p99_within_deadline_contention_adjusted"] = bool(
         ad["p99_latency_over_deadline"] <= probe.factor)
+    # worker-hours ledger (swarmplan): the cost axis of the autoscaler
+    # gate — stamped for EVERY run so static rosters and the elastic
+    # fleet compare on identical accounting
+    end_t = time.perf_counter()
+    per_worker = {
+        name: round(max(0.0, worker_stopped.get(name, end_t) - t0), 3)
+        for name, t0 in sorted(worker_started.items())}
+    total_s = sum(per_worker.values())
+    report["worker_time"] = {
+        "worker_seconds": round(total_s, 3),
+        "worker_hours": round(total_s / 3600.0, 6),
+        "peak_workers": len(per_worker),
+        "per_worker": per_worker,
+    }
+    if autoscale is not None:
+        report["autoscale"] = {
+            "plan": dataclasses.asdict(autoscale),
+            "events": auto_events,
+            "sizes": auto_sizes,
+            "ticks": planner.ticks,
+            "decision": planner.last_decision,
+            "drained": sorted(auto_drains),
+        }
+    else:
+        report["autoscale"] = None
     return report
+
+
+def _comparison_row(label: Any, report: dict[str, Any]) -> dict[str, Any]:
+    """One row of the autoscaler comparison table: service quality
+    (zero-loss, ok count, shed fraction, contention-adjusted deadline
+    conformance) on one side, worker-hours on the other."""
+    rec = report["reconciliation"]
+    out = report["outcomes"]
+    ad = report["admitted_deadline"]
+    issued = max(1, int(rec["issued"]))
+    return {
+        "config": label,
+        "zero_loss": bool(rec["zero_loss"]),
+        "ok": int(out.get("ok", 0)),
+        "shed_frac": round(int(out.get("shed", 0)) / issued, 4),
+        "abandoned": int(out.get("abandoned", 0)),
+        "p99_latency_over_deadline": ad["p99_latency_over_deadline"],
+        "p99_ok": bool(ad["p99_within_deadline_contention_adjusted"]),
+        "worker_seconds": report["worker_time"]["worker_seconds"],
+        "worker_hours": report["worker_time"]["worker_hours"],
+        "peak_workers": report["worker_time"]["peak_workers"],
+    }
+
+
+async def autoscale_comparison(schedule: Sequence[ScheduledJob], *,
+                               autoscale: AutoscalePlan,
+                               static_rosters: Sequence[int],
+                               n_shards: int = 1,
+                               seed: Any = "swarmplan",
+                               shed_slack: float = 0.02,
+                               **run_kwargs: Any) -> dict[str, Any]:
+    """THE swarmplan headline (ISSUE 19 gate + BENCH ``autoscaler``
+    config): drive the SAME seeded schedule once under the planner and
+    once per static roster size, then compare worker-hours among the
+    rosters that actually served the traffic.
+
+    A static roster is **feasible** when it settles with zero loss, its
+    admitted p99 sits within deadline (contention-adjusted, the PR-12
+    clause), and its shed fraction is no worse than the planner's plus
+    ``shed_slack`` — the last clause keeps a tiny roster that sheds
+    half the peak from "winning" on hours while silently serving less
+    traffic (shed fractions compare stably across host speeds, where
+    raw ok counts wobble with planner ramp timing). The gate claim is:
+    planner worker-hours STRICTLY below the cheapest feasible static
+    roster, at equal-or-better service."""
+    planner_report = await run_load(schedule, autoscale=autoscale,
+                                    n_shards=n_shards, seed=seed,
+                                    **run_kwargs)
+    planner_row = _comparison_row("autoscaler", planner_report)
+    static_rows: list[dict[str, Any]] = []
+    for n in static_rosters:
+        static_report = await run_load(schedule, n_workers=int(n),
+                                       n_shards=n_shards,
+                                       seed=f"{seed}-static{n}",
+                                       **run_kwargs)
+        static_rows.append(_comparison_row(int(n), static_report))
+    feasible = [row for row in static_rows
+                if row["zero_loss"] and row["p99_ok"]
+                and row["shed_frac"]
+                <= planner_row["shed_frac"] + float(shed_slack)]
+    best_static = (min(feasible, key=lambda r: r["worker_seconds"])
+                   if feasible else None)
+    gate = {
+        "planner_zero_loss": planner_row["zero_loss"],
+        "planner_p99_ok": planner_row["p99_ok"],
+        "feasible_static": sorted(r["config"] for r in feasible),
+        "best_static": (best_static or {}).get("config"),
+        "best_static_worker_seconds":
+            (best_static or {}).get("worker_seconds"),
+        "planner_worker_seconds": planner_row["worker_seconds"],
+        "planner_beats_best_static": bool(
+            best_static is not None
+            and planner_row["worker_seconds"]
+            < best_static["worker_seconds"]),
+    }
+    return {
+        "planner": planner_row,
+        "static": static_rows,
+        "gate": gate,
+        "planner_report": planner_report,
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -135,9 +135,19 @@ class MiniHive(ChaoticHive):
         self.flights = obs_flight.FlightRecorder()
         self.fleet: dict[str, dict[str, Any]] = {}
         self._submit_rate = obs_flight.RateEwma(window_s=30.0)
+        # swarmplan (ISSUE 19): per-model arrival EWMAs — the demand
+        # split the planner's placement plan ranks models by (the
+        # fleet-level twin of the residency ledger's per-model EWMA)
+        self._model_rates: dict[str, obs_flight.RateEwma] = {}
+        # an attached FleetPlanner (node/planner.py); None keeps exact
+        # wire parity with the pre-planner contract — no /api/plan
+        # body, no placement key on heartbeat acks
+        self.planner: Any = None
+        self.last_plan: dict[str, Any] | None = None
         self._app.router.add_post("/api/heartbeat", self._heartbeat)
         self._app.router.add_get("/api/stats", self._stats_endpoint)
         self._app.router.add_get("/api/fleet", self._fleet_endpoint)
+        self._app.router.add_get("/api/plan", self._plan_endpoint)
         self._app.router.add_get("/api/flight", self._flights_endpoint)
         self._app.router.add_get("/api/flight/{job_id}",
                                  self._flight_endpoint)
@@ -236,10 +246,18 @@ class MiniHive(ChaoticHive):
             trace_id = obs_flight.new_trace_id()
         self.flights.open(job_id, job, t=now, trace_id=trace_id)
         self._submit_rate.note(now)
+        self._note_model_arrival(job, now)
         self._journal("submit", id=job_id, t=now, job=job,
                       trace_id=trace_id)
         super().submit(job)
         self._journal_commit()
+
+    def _note_model_arrival(self, job: dict[str, Any], now: float) -> None:
+        model = str(job.get("model_name") or "")
+        if model:
+            self._model_rates.setdefault(
+                model,
+                obs_flight.RateEwma(window_s=30.0)).note(now)
 
     # ---- the write-ahead log (swarmdurable, ISSUE 14) -------------------
 
@@ -265,6 +283,24 @@ class MiniHive(ChaoticHive):
             return None
         return self.journal.write_snapshot(
             self.dump_state(), epoch=self.hive_epoch, t=self._clock())
+
+    def record_plan(self, decision: dict[str, Any]) -> None:
+        """Make one planner decision durable (swarmplan, ISSUE 19): a
+        ``plan`` journal transition plus a flight note on the
+        ``fleet-planner`` pseudo record. A recovered hive replays the
+        newest decision into :attr:`last_plan`, which a re-attached
+        planner seeds its cooldown clocks and placement from — intent
+        survives the crash without being actuated twice."""
+        t = float(decision.get("at_s") or self._clock())
+        self.last_plan = dict(decision)
+        self.flights.note("fleet-planner", "plan", t=t,
+                          direction=decision.get("direction"),
+                          reason=decision.get("reason"),
+                          target=decision.get("target"),
+                          actual=decision.get("actual"),
+                          drain=list(decision.get("drain") or ()))
+        self._journal("plan", t=t, plan=dict(decision))
+        self._journal_commit()
 
     # ---- chaos controls -------------------------------------------------
 
@@ -686,6 +722,16 @@ class MiniHive(ChaoticHive):
         ack: dict[str, Any] = {"status": "ok", "lost": lost}
         if self.journal is not None:
             ack["hive_epoch"] = self.hive_epoch
+        # swarmplan (ISSUE 19): piggyback the plan's model assignment
+        # for THIS worker on the ack — the worker's residency ledger
+        # warms hinted models on idle polls, so placement shifts ahead
+        # of the traffic instead of behind it. No planner (or no
+        # assignment) adds no key: exact wire parity with the
+        # pre-planner heartbeat contract.
+        if self.planner is not None:
+            placement = self.planner.placement_for(worker_name)
+            if placement:
+                ack["placement"] = list(placement)
         return web.json_response(ack)
 
     # ---- crash-safe recovery (swarmdurable, ISSUE 14) -------------------
@@ -777,6 +823,8 @@ class MiniHive(ChaoticHive):
             "known_workers": sorted(self.known_workers),
             "counters": self._counter_dump(),
             "flights": self.flights.dump(),
+            "last_plan": (None if self.last_plan is None
+                          else dict(self.last_plan)),
         }
 
     def _restore_state(self, state: dict[str, Any],
@@ -815,6 +863,9 @@ class MiniHive(ChaoticHive):
             str(w) for w in state.get("known_workers") or ())
         self._counter_restore(state.get("counters") or {})
         self.flights.restore(state.get("flights") or {})
+        plan = state.get("last_plan")
+        if isinstance(plan, dict):
+            self.last_plan = dict(plan)
 
     def _apply_journal_event(self, record: dict[str, Any],
                              jobs: dict[str, dict[str, Any]]) -> None:
@@ -832,6 +883,7 @@ class MiniHive(ChaoticHive):
             self.flights.open(job_id, job, t=t,
                               trace_id=record.get("trace_id"))
             self._submit_rate.note(t)
+            self._note_model_arrival(job, t)
             self.pending_jobs.append(job)
             self.issued_ids.append(job_id)
         elif ev == "grant":
@@ -932,6 +984,19 @@ class MiniHive(ChaoticHive):
                                 outcome=outcome,
                                 attempt=record.get("attempt"),
                                 epoch=record.get("epoch"))
+        elif ev == "plan":
+            # swarmplan (ISSUE 19): replay the decision into last_plan
+            # (newest wins) and re-note the flight timeline — the exact
+            # mirror of record_plan, so a re-attached planner seeds its
+            # cooldowns from the same intent the dead process journaled
+            plan = dict(record.get("plan") or {})
+            self.last_plan = plan
+            self.flights.note("fleet-planner", "plan", t=t,
+                              direction=plan.get("direction"),
+                              reason=plan.get("reason"),
+                              target=plan.get("target"),
+                              actual=plan.get("actual"),
+                              drain=list(plan.get("drain") or ()))
         elif ev == "epoch":
             pass  # consumed by recover()'s epoch fold
         else:
@@ -1073,6 +1138,11 @@ class MiniHive(ChaoticHive):
                     == "brownout"),
                 "observed_arrival_jobs_s": round(
                     self._submit_rate.rate(now), 4),
+                # per-model demand split (swarmplan, ISSUE 19): what
+                # the planner's placement plan ranks models by
+                "model_arrival_jobs_s": {
+                    model: round(rate.rate(now), 4)
+                    for model, rate in sorted(self._model_rates.items())},
                 "pending_jobs": len(self.pending_jobs),
                 "leased_jobs": len(self.leases),
                 "completed_jobs": len(self.completed),
@@ -1089,6 +1159,18 @@ class MiniHive(ChaoticHive):
         from aiohttp import web
 
         return web.json_response(self.fleet_snapshot())
+
+    async def _plan_endpoint(self, request):
+        """``GET /api/plan`` (swarmplan, ISSUE 19): the supervisor
+        contract — a real deployment's supervisor polls this and
+        converges the fleet on ``decision.target``. 404 when no
+        planner is attached (this hive is not autoscaled)."""
+        from aiohttp import web
+
+        if self.planner is None:
+            return web.json_response({"error": "no planner attached"},
+                                     status=404)
+        return web.json_response(self.planner.plan_snapshot())
 
     async def _flights_endpoint(self, request):
         from aiohttp import web

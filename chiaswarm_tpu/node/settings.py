@@ -36,6 +36,7 @@ _ENV_OVERRIDES = {
     "SWARM_TPU_URI": "hive_uri",
     "SWARM_TPU_TOKEN": "hive_token",
     "SWARM_TPU_WORKERNAME": "worker_name",
+    "SWARM_TPU_FRONT_URI": "hive_front_uri",
     "SWARM_TPU_LOG_LEVEL": "log_level",
 }
 
@@ -57,6 +58,11 @@ class Settings:
     # (which keeps single-uri plumbing like the loadgen worker factory
     # working unchanged). Empty = un-federated; hive_uris() resolves.
     hive_shard_uris: tuple = ()
+    # swarmplan (ISSUE 19 satellite): ONE federated-front address to
+    # bootstrap the shard list from (GET /api/shards) at startup —
+    # overrides any stale hand-configured hive_shard_uris. Empty =
+    # no bootstrap; the explicit list / hive_uri plumbing is used.
+    hive_front_uri: str = ""
     hive_token: str = ""
     worker_name: str = "tpu-worker"
     log_level: str = "INFO"

@@ -203,6 +203,7 @@ class Worker:
         # hive_uri); the worker multiplexes one session bundle per
         # shard. An injected ``hive`` client (the chaos/test seam)
         # pins a single bundle around it.
+        self._hive_injected = hive is not None
         self.shards: list[_HiveShard] = self._build_hive_shards(hive)
         self._executor = executor
         # queue bound = total in-flight capacity: per slot, the larger of
@@ -403,6 +404,29 @@ class Worker:
                     cap=self.settings.poll_backoff_cap_s,
                     seed=seed)))
         return shards
+
+    async def _bootstrap_from_front(self) -> None:
+        """Shard-list bootstrap (ISSUE 19 satellite, PR-17 residue):
+        ``hive_front_uri`` names ONE federated front; the worker
+        resolves it into the live shard uri list via ``GET
+        /api/shards`` and rebuilds its session bundles from that —
+        replacing any stale hand-configured list. An injected hive
+        client (the chaos/test seam) always wins: it IS the control
+        plane. Raises on an unreachable front: polling a guessed
+        shard list would serve the wrong federation silently."""
+        front = str(self.settings.hive_front_uri or "").strip()
+        if not front or self._hive_injected:
+            return
+        from chiaswarm_tpu.node.federation import bootstrap_shard_uris
+
+        uris = await bootstrap_shard_uris(front)
+        if list(uris) == self.settings.hive_uris():
+            return
+        log.info("bootstrapped %d shard uri(s) from front %s",
+                 len(uris), front)
+        self.settings.hive_shard_uris = tuple(uris)
+        self.settings.hive_uri = uris[0]
+        self.shards = self._build_hive_shards(None)
 
     # single-hive compatibility surface: shard 0 IS the pre-federation
     # worker state (read-only views — nothing may rebind these)
@@ -633,7 +657,25 @@ class Worker:
         shard.last_epoch = epoch
         return epoch
 
+    def _note_placement(self, raw: Any) -> None:
+        """Feed a heartbeat ack's ``placement`` hint (swarmplan,
+        ISSUE 19 — the fleet planner's model assignment for THIS
+        worker) into the residency ledger: the next idle poll warms
+        hinted models first, so placement shifts land before the
+        traffic does. Malformed or absent hints are ignored — the
+        hint is advisory, never load-bearing for correctness."""
+        if not isinstance(raw, (list, tuple)) or not raw:
+            return
+        residency = getattr(self.registry, "residency", None)
+        if residency is None:
+            return
+        try:
+            residency.note_placement([str(m) for m in raw])
+        except Exception:  # stub registries
+            log.debug("placement hint dropped", exc_info=True)
+
     async def run(self) -> None:
+        await self._bootstrap_from_front()
         self.startup()
         self._replay_dead_letters()
         # stale resume state from a previous run is superseded by the
@@ -1430,6 +1472,7 @@ class Worker:
                 self._note_hive_ok(shard)
                 if isinstance(ack, dict):
                     self._note_hive_epoch(ack.get(HIVE_EPOCH_KEY), shard)
+                    self._note_placement(ack.get("placement"))
                 shard.last_metrics = time.monotonic()
             except Exception as exc:
                 self._note_hive_failure("heartbeat", exc, shard)
@@ -1508,6 +1551,7 @@ class Worker:
                         reported |= {str(j) for j in lost_raw}
                         self._note_hive_epoch(
                             response.get(HIVE_EPOCH_KEY), shard)
+                        self._note_placement(response.get("placement"))
                         any_beat_ok = True
                     except Exception as exc:
                         # reference hives have no heartbeat endpoint,

@@ -843,5 +843,77 @@ def hive_shard_session_state_gauge(
         ("shard",))
 
 
+# ---- fleet-planner families (swarmplan, ISSUE 19, node/planner.py) ----
+#
+# The autoscaler's control loop is hive-side state, so the families
+# live on the planner's registry (the hive's, usually) — and like the
+# residency/overload families every label vocabulary pre-seeds at
+# planner construction (plus once at module import for the global
+# registry) so a dashboard sees zeros before the first decision.
+
+#: which way a planning tick moved the target
+PLANNER_DIRECTIONS = ("up", "down", "hold")
+
+#: why the tick chose that direction — ``demand`` (the smoothed
+#: arrival rate moved the capacity target), ``backlog`` (the hive-side
+#: queue added a drain term), ``hysteresis`` (inside the deadband),
+#: ``cooldown`` (a recent actuation pinned the fleet), ``bounds``
+#: (min/max fleet clamp engaged), ``steady`` (target == actual)
+PLANNER_REASONS = ("demand", "backlog", "hysteresis", "cooldown",
+                   "bounds", "steady")
+
+
+def planner_target_workers_gauge(registry: Registry | None = None) -> Gauge:
+    """The planner's current target fleet size — what the supervisor
+    contract (``GET /api/plan``) tells a real deployment to converge
+    on. Persistent gap vs the actual gauge below means actuation is
+    lagging (slow cold starts: ROADMAP item 5) or the supervisor is
+    not consuming the plan."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_planner_target_workers",
+        "fleet size the planner wants (the /api/plan target)")
+
+
+def planner_actual_workers_gauge(registry: Registry | None = None) -> Gauge:
+    """Live, reachable workers the planner observed on its last tick
+    (the /api/fleet ``workers_live`` view it planned against)."""
+    return (registry or REGISTRY).gauge(
+        "chiaswarm_planner_actual_workers",
+        "live workers observed by the planner's last tick")
+
+
+def planner_decisions_counter(registry: Registry | None = None) -> Counter:
+    """Planning-tick decisions by direction and reason. A high
+    ``up``+``down`` churn rate with ``reason="demand"`` means the
+    hysteresis band or cooldowns are too tight for the arrival noise;
+    mostly ``hold/steady`` is a converged loop."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_planner_decisions_total",
+        "planning-tick decisions, by direction and reason",
+        labelnames=("direction", "reason"))
+
+
+def planner_placement_moves_counter(
+        registry: Registry | None = None) -> Counter:
+    """Per-worker model assignments that CHANGED between consecutive
+    plans (the placement half of the loop). Each move costs a survivor
+    a warm load — a sustained rate here with flat fleet size means the
+    demand mix is churning faster than residency can follow."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_planner_placement_moves_total",
+        "per-worker model placement assignments changed by a new plan")
+
+
+def planner_worker_hours_counter(
+        registry: Registry | None = None) -> Counter:
+    """Accumulated worker-hours as the planner observes them (actual
+    fleet size x tick interval). THE cost side of the autoscaler's
+    headline: BENCH compares this against every static roster in the
+    swept set."""
+    return (registry or REGISTRY).counter(
+        "chiaswarm_planner_worker_hours_total",
+        "worker-hours accumulated under the planner's watch")
+
+
 #: the Prometheus text exposition content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

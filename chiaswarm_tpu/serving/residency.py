@@ -294,6 +294,12 @@ class ResidencyManager:
         self._quarantined: set[str] = set()
         self._arrivals: dict[str, ArrivalEwma] = {}
         self._recipes: dict[Hashable, _Recipe] = {}
+        # fleet-planner placement hint (swarmplan, ISSUE 19): the
+        # models the current plan assigns this worker, in plan order —
+        # idle-poll prefetch warms these BEFORE the local arrival
+        # ranking, so placement shifts ahead of the traffic
+        self._placement: tuple[str, ...] = ()
+        self.placement_hints = 0
         self._prefetch_thread: threading.Thread | None = None
         # counters mirrored into /healthz snapshots (the metric families
         # are process-global; hermetic views need per-manager numbers)
@@ -771,10 +777,25 @@ class ResidencyManager:
 
     # ---- prefetch (worker idle-poll hook) ------------------------------
 
+    def note_placement(self, models: Any) -> None:
+        """Accept the fleet planner's model assignment for this worker
+        (swarmplan, ISSUE 19 — delivered on heartbeat acks). Purely
+        advisory: it reorders the idle-poll prefetch preference below;
+        it never loads, evicts, or blocks anything by itself."""
+        cleaned = tuple(str(m) for m in (models or ()) if str(m))
+        with self._lock:
+            if cleaned != self._placement:
+                self.placement_hints += 1
+                log.info("placement hint: %s", list(cleaned) or "(clear)")
+            self._placement = cleaned
+
     def note_idle(self) -> bool:
         """The poll loop came back empty: warm-load the hottest evicted
         model that fits the FREE budget, on a daemon thread. Returns
-        True when a prefetch was started."""
+        True when a prefetch was started. Plan-assigned models (a
+        ``note_placement`` hint) outrank the local arrival EWMAs, in
+        plan order — the planner sees fleet-wide demand this worker's
+        local stream has not delivered yet."""
         with self._lock:
             if not self.prefetch_enabled:
                 return False
@@ -784,7 +805,10 @@ class ResidencyManager:
             now = self._clock()
             free = (self.budget_bytes - self._resident_bytes
                     - self._reserved_bytes)
+            hint_order = {model: index
+                          for index, model in enumerate(self._placement)}
             best_key, best_rate = None, 0.0
+            best_hint: tuple[int, Hashable] | None = None
             for key, recipe in self._recipes.items():
                 if key in self._entries or key in self._loading:
                     continue
@@ -795,10 +819,19 @@ class ResidencyManager:
                     continue  # degraded models never prefetch
                 if footprint > free:
                     continue  # prefetch must not evict the working set
+                hint = hint_order.get(recipe.model)
+                if hint is not None and (best_hint is None
+                                         or hint < best_hint[0]):
+                    best_hint = (hint, key)
                 ewma = self._arrivals.get(recipe.model)
                 rate = ewma.rate(now) if ewma is not None else 0.0
                 if rate > best_rate:
                     best_key, best_rate = key, rate
+            if best_hint is not None:
+                best_key = best_hint[1]
+                model = self._recipes[best_key].model
+                ewma = self._arrivals.get(model)
+                best_rate = ewma.rate(now) if ewma is not None else 0.0
             if best_key is None:
                 return False
             recipe = self._recipes[best_key]
@@ -891,6 +924,8 @@ class ResidencyManager:
                 "prefetch_loads": self.prefetch_loads,
                 "bounces": self.bounces,
                 "prefetch_enabled": self.prefetch_enabled,
+                "placement": list(self._placement),
+                "placement_hints": self.placement_hints,
             }
 
 

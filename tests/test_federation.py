@@ -235,6 +235,58 @@ def test_wire_parity_federated_adds_exactly_shard_key(tmp_path):
     assert set(payload) == expected | {HIVE_SHARD_KEY, HIVE_EPOCH_KEY}
 
 
+def test_api_shards_bootstraps_worker_from_one_front_address():
+    """swarmplan satellite (ISSUE 19, PR-17 residue): the front is an
+    aggregation plane, not a proxy — workers must dial the shards
+    directly. ``GET /api/shards`` closes the bootstrap gap: a worker
+    configured with ONE ``hive_front_uri`` resolves the live shard
+    list at startup and rebuilds its session bundles from it,
+    replacing any stale hand-configured list."""
+    import aiohttp
+
+    from chiaswarm_tpu.node.federation import bootstrap_shard_uris
+
+    async def scenario():
+        fed = FederatedHive(n_shards=3, lease_s=30.0)
+        front = await fed.start()
+        try:
+            uris = await bootstrap_shard_uris(front)
+            assert list(uris) == fed.shard_uris() and len(uris) == 3
+            async with aiohttp.ClientSession() as session:
+                async with session.get(front + "/api/shards") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+            assert body["n_shards"] == 3
+            assert body["shards"] == fed.shard_uris()
+            assert body["worker_uri"] == fed.worker_uri()
+
+            # a worker knowing only the front (its configured hive_uri
+            # is a stale guess) comes up multiplexing every shard
+            worker = _worker(fed_settings("http://127.0.0.1:9",
+                                          "boot-w0",
+                                          hive_front_uri=front))
+            await worker._bootstrap_from_front()
+            assert worker.settings.hive_shard_uris == uris
+            assert worker.settings.hive_uris() == list(uris)
+            assert len(worker.shards) == 3
+
+            # an injected hive client is the chaos/test seam and must
+            # always win over the bootstrap
+            class _Stub:
+                pass
+
+            pinned = _worker(fed_settings("http://127.0.0.1:9",
+                                          "boot-w1",
+                                          hive_front_uri=front),
+                             hive=_Stub())
+            await pinned._bootstrap_from_front()
+            assert len(pinned.shards) == 1
+        finally:
+            await fed.stop()
+
+    asyncio.run(scenario())
+
+
 # ---------------------------------------------------------------------------
 # stealing + wrong-shard uploads (direct seam units, no HTTP)
 # ---------------------------------------------------------------------------

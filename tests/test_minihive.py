@@ -860,6 +860,134 @@ def test_fleet_worker_kill_mid_lane_resumes_from_checkpoint(monkeypatch):
                  .get("resume_step") or 0) >= 1
 
 
+def test_planner_drain_mid_lane_graceful_leave_resumes(monkeypatch):
+    """swarmplan scale-down safety (ISSUE 19 satellite, mirroring the
+    ISSUE 6 kill gate): the autoscaler retires a worker holding a
+    mid-lane checkpointed job via the GRACEFUL path — ``request_stop``
+    (finish in-flight, upload, exit) plus ``expire_worker`` lease
+    preemption, never partition/cancel. The preempted job redelivers
+    WITH its checkpoint to a survivor whose lane resumes at step >= 1,
+    while the victim's own drain upload races it — exactly-once
+    settlement absorbs whichever copy lands second."""
+    import jax
+
+    from chiaswarm_tpu.core.chip_pool import ChipPool
+    from chiaswarm_tpu.core.mesh import MeshSpec
+
+    monkeypatch.setenv("CHIASWARM_STEPPER", "1")
+    monkeypatch.setenv("CHIASWARM_STEPPER_CKPT_EVERY", "1")
+    # stretch lane wall time so the drain decision deterministically
+    # lands mid-lane (24 steps x 80 ms >> poll/redeliver latency)
+    monkeypatch.setenv("CHIASWARM_STEPPER_STEP_DELAY_S", "0.08")
+
+    registry = ModelRegistry(
+        catalog=[{"name": "tiny", "family": "tiny", "parameters": {}}],
+        allow_random=True)
+
+    def lane_job(i: int) -> dict:
+        return {"id": f"drain-{i}", "model_name": "tiny",
+                "prompt": f"drain prompt {i}", "seed": 700 + i,
+                "num_inference_steps": 24, "guidance_scale": 7.5,
+                "height": 64, "width": 64, "content_type": "image/png"}
+
+    job_ids = [f"drain-{i}" for i in range(3)]
+
+    async def scenario():
+        hive = MiniHive(lease_s=60.0, delay_s=0.01, max_jobs_per_poll=1)
+        uri = await hive.start()
+        for i in range(3):
+            hive.submit(lane_job(i))
+
+        workers = []
+        for tag in ("a", "b", "c"):
+            pool = ChipPool(n_slots=1, mesh_spec=MeshSpec({"data": 1}),
+                            devices=jax.devices()[:1])
+            workers.append(Worker(
+                settings=fleet_settings(uri, f"drainfleet-{tag}",
+                                        job_deadline_s=600.0,
+                                        heartbeat_s=0.05),
+                registry=registry, pool=pool))
+        tasks = {w.settings.worker_name: asyncio.create_task(w.run())
+                 for w in workers}
+        victim = victim_job = None
+        try:
+            # wait until some job's lane checkpoint (step >= 1) reached
+            # the hive, then drain its lease holder — the planner's
+            # scale-down actuation, verbatim (loadgen._drain_auto)
+            deadline = time.monotonic() + 240
+            while victim is None and time.monotonic() < deadline:
+                for job_id, ckpt in list(hive.checkpoints.items()):
+                    holder = hive.lease_holder(job_id)
+                    if ckpt.get("kind") == "lane" and \
+                            int(ckpt.get("step", 0)) >= 1 and \
+                            holder is not None:
+                        victim_job, victim = job_id, holder
+                        break
+                if victim is None:
+                    await asyncio.sleep(0.02)
+            assert victim is not None, \
+                f"no lane checkpoint ever reached the hive: {hive.stats()}"
+            victim_worker = next(
+                w for w in workers
+                if w.settings.worker_name == victim)
+            victim_worker.request_stop()  # graceful: NOT partitioned,
+            # NOT cancelled — its in-flight lane finishes and uploads
+            assert victim_job in hive.expire_worker(victim)
+
+            await hive.wait_for_results(3, timeout=300)
+        finally:
+            for worker in workers:
+                worker.request_stop()
+            await asyncio.gather(*(asyncio.wait_for(t, timeout=60)
+                                   for t in tasks.values()),
+                                 return_exceptions=True)
+            for worker in workers:
+                for slot in worker.pool:
+                    stepper = getattr(slot, "_stepper", None)
+                    if stepper is not None:
+                        stepper.shutdown()
+            await hive.stop()
+        return hive, workers, victim, victim_job
+
+    hive, workers, victim, victim_job = asyncio.run(scenario())
+
+    # every job settled exactly once — the victim's graceful upload and
+    # the survivor's resumed completion raced, and the settle set
+    # arbitrated; nothing was lost or abandoned by the scale-down
+    uploaded = hive.uploaded_ids()
+    assert sorted(set(uploaded)) == job_ids
+    assert len(uploaded) == len(set(uploaded))
+    assert hive.abandoned == []
+    for result in hive.results:
+        assert result["pipeline_config"].get("error") is None, result
+        assert "fatal_error" not in result
+
+    # the preemption actually moved the job: a second grant went to a
+    # survivor (the victim stays excluded after expire_worker), and a
+    # survivor lane admitted the row WITH resume state
+    assert _counter(hive, "chiaswarm_hive_checkpoints_stored_total") >= 1
+    assert _counter(hive, "chiaswarm_hive_jobs_redelivered_total") >= 1
+    record = hive.flights.get(victim_job)
+    grants = [e for e in record["events"] if e["event"] == "grant"]
+    assert [g["attempt"] for g in grants][:2] == [1, 2]
+    assert grants[0]["worker"] == victim
+    assert grants[1]["worker"] != victim
+    survivor_stats = [
+        slot._stepper.stats()
+        for worker in workers
+        if worker.settings.worker_name != victim
+        for slot in worker.pool
+        if getattr(slot, "_stepper", None) is not None
+    ]
+    assert sum(s.get("rows_resumed", 0) for s in survivor_stats) >= 1
+
+    # the flight book agrees end to end: gapless attempt chains, one
+    # settle per job (whichever copy won), duplicates acked not counted
+    assert hive.flights.verify(job_ids) == []
+    events = [e["event"] for e in record["events"]]
+    assert events.count("settled") == 1 and "checkpoint" in events
+
+
 # ---------------------------------------------------------------------------
 # nightly fleet soak (satellite 5): seeded kills at scale
 # ---------------------------------------------------------------------------

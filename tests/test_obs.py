@@ -602,6 +602,40 @@ def test_federation_front_metric_families_preseeded():
     assert "chiaswarm_hive_shard_forwarded_uploads_total 0" in body
 
 
+def test_planner_metric_families_preseeded_at_import():
+    """swarmplan (ISSUE 19): importing the planner module pre-seeds
+    every ``chiaswarm_planner_*`` family on the GLOBAL registry — the
+    two fleet-size gauges at zero and the decisions counter carrying
+    the full direction x reason label vocabulary — so a dashboard
+    scraping /metrics sees the complete planner surface before the
+    first planning tick ever runs."""
+    import chiaswarm_tpu.node.planner  # noqa: F401  (import = pre-seed)
+    from chiaswarm_tpu.obs.metrics import (
+        PLANNER_DIRECTIONS,
+        PLANNER_REASONS,
+        REGISTRY,
+    )
+
+    body = render_all([REGISTRY])
+    assert "# TYPE chiaswarm_planner_target_workers gauge" in body
+    assert "# TYPE chiaswarm_planner_actual_workers gauge" in body
+    assert "# TYPE chiaswarm_planner_decisions_total counter" in body
+    assert "# TYPE chiaswarm_planner_placement_moves_total counter" \
+        in body
+    assert "# TYPE chiaswarm_planner_worker_hours_total counter" in body
+    assert "chiaswarm_planner_target_workers 0" in body
+    assert "chiaswarm_planner_actual_workers 0" in body
+    # attached planners bind per-hive registries, so the global series
+    # stay zeroed — and the whole label vocabulary is present
+    for direction in PLANNER_DIRECTIONS:
+        for reason in PLANNER_REASONS:
+            assert (f'chiaswarm_planner_decisions_total{{'
+                    f'direction="{direction}",reason="{reason}"}} 0'
+                    in body), (direction, reason)
+    assert "chiaswarm_planner_placement_moves_total 0" in body
+    assert "chiaswarm_planner_worker_hours_total 0" in body
+
+
 def test_fleet_endpoint_schema_from_heartbeat_scrape():
     """ISSUE 13 satellite: a heartbeating worker's metric snapshot
     lands in ``GET /api/fleet`` with the schema the item-5 autoscaler

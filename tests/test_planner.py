@@ -1,0 +1,498 @@
+"""swarmplan (ISSUE 19): the capacity-model-driven fleet autoscaler.
+
+Three tiers:
+
+- **Planning units** (fake clock, no workers): backlog-driven scale-up
+  with cooldown and bounds holds, graceful scale-down with the
+  fewest-leases drain pick and the draining ledger (one slow drain is
+  never re-issued tick after tick), the hysteresis deadband, the
+  Δ-arrival estimator that outruns the hive's 30 s EWMA on a fresh
+  ramp, and deterministic demand-share placement.
+- **Seam units**: the journaled-plan recovery contract (a re-attached
+  planner inherits the dead process's cooldown clocks — intent
+  survives, actuation does not repeat), the ``GET /api/plan``
+  supervisor endpoint (404 without a planner: wire parity), heartbeat
+  acks carrying placement hints only when a plan exists, and the
+  residency ledger warming hinted models ahead of its local arrival
+  ranking.
+- **THE acceptance gate** (slow): a seeded diurnal schedule with a
+  spike, driven once under the planner and once per static roster in
+  the swept set — zero loss, contention-adjusted admitted p99 within
+  deadline, at least one scale-up AND one scale-down actuated, and
+  planner worker-hours strictly below the cheapest feasible static
+  roster. Plus the nightly federated soak: same elastic fleet over 3
+  journaled shards with a seeded mid-run shard SIGKILL/recovery
+  (CHIASWARM_SOAK_SEED replays a CI run exactly).
+
+Everything is hermetic (loopback only) and scripted/seeded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from chiaswarm_tpu.node.hivelog import HiveJournal
+from chiaswarm_tpu.node.minihive import MiniHive
+from chiaswarm_tpu.node.planner import (
+    PLAN_FLIGHT_ID,
+    FleetPlanner,
+    PlannerConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _tmp_root(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWARM_TPU_ROOT", str(tmp_path))
+    return tmp_path
+
+
+def _job(job_id: str, model: str = "shared/tiny", **over):
+    job = {"id": job_id, "model_name": model, "prompt": f"p {job_id}",
+           "num_inference_steps": 2, "height": 64, "width": 64,
+           "content_type": "application/json"}
+    job.update(over)
+    return job
+
+
+def _seed_worker(hive: MiniHive, name: str, now: float,
+                 **metrics) -> None:
+    """Make ``name`` a live fleet member without a real worker: a
+    heartbeat's two side effects (liveness stamp + metric snapshot)."""
+    hive.known_workers.add(name)
+    hive.worker_seen[name] = now
+    hive.fleet[name] = {"at": now,
+                        "metrics": dict({"chips_in_service": 1},
+                                        **metrics)}
+
+
+def _cfg(**over) -> PlannerConfig:
+    base = dict(min_workers=1, max_workers=3, target_utilization=1.0,
+                smoothing_window_s=0.01, hysteresis=0.0,
+                cooldown_up_s=5.0, cooldown_down_s=5.0,
+                backlog_drain_s=1.0, capacity_jobs_s_per_worker=2.0)
+    base.update(over)
+    return PlannerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# planning units (fake clock)
+# ---------------------------------------------------------------------------
+
+
+def test_tick_scales_up_on_backlog_then_cooldown_then_bounds():
+    clock = [0.0]
+    hive = MiniHive(lease_s=10.0, delay_s=0.0, clock=lambda: clock[0])
+    planner = FleetPlanner(hive, _cfg(), clock=lambda: clock[0])
+    assert hive.planner is planner  # attach publishes /api/plan
+    _seed_worker(hive, "w0", 0.0)
+    for i in range(6):
+        hive.submit(_job(f"p{i}", model="m/hot"))
+
+    clock[0] = 1.0
+    decision = planner.tick()
+    # 6 queued jobs / 1 s drain horizon >> the warming arrival EWMA:
+    # the backlog term is what makes the spike visible this early
+    assert decision["direction"] == "up"
+    assert decision["reason"] == "backlog"
+    assert decision["target"] == 3 and decision["actual"] == 1
+    assert decision["spawn"] == 2 and decision["drain"] == []
+    # the sole observed model homes on the sole survivor
+    assert decision["placement"]["w0"] == ["m/hot"]
+    assert planner.placement_for("w0") == ("m/hot",)
+    assert planner.placement_for("missing") == ()
+    # an actuating decision is journaled: last_plan + the flight note
+    # on the fleet-planner pseudo record
+    assert hive.last_plan == decision
+    record = hive.flights.get(PLAN_FLIGHT_ID)
+    assert [e["event"] for e in record["events"]].count("plan") == 1
+
+    # inside the up cooldown the same pressure holds, explicitly
+    clock[0] = 1.5
+    held = planner.tick()
+    assert held["direction"] == "hold" and held["reason"] == "cooldown"
+    assert held["spawn"] == 0
+
+    # cooldown over, fleet at max, demand still wants more: a BOUNDS
+    # hold (operator alert), not a steady one
+    clock[0] = 10.0
+    for name in ("w1", "w2"):
+        _seed_worker(hive, name, 10.0)
+    hive.submit(_job("p6", model="m/hot"))
+    hive.submit(_job("p7", model="m/hot"))
+    bounded = planner.tick()
+    assert bounded["direction"] == "hold"
+    assert bounded["reason"] == "bounds"
+    assert bounded["target"] == 3 and bounded["actual"] == 3
+
+
+def test_tick_scales_down_via_drain_pick_and_draining_ledger():
+    clock = [100.0]
+    hive = MiniHive(lease_s=10.0, delay_s=0.0, clock=lambda: clock[0])
+    planner = FleetPlanner(
+        hive, _cfg(max_workers=5, hysteresis=0.1, cooldown_down_s=5.0),
+        clock=lambda: clock[0])
+    for name in ("wa", "wb", "wc"):
+        _seed_worker(hive, name, 100.0)
+    hive.submit(_job("d1"))
+    [handed] = hive._take_jobs("wc")  # wc holds the only lease
+    assert handed["id"] == "d1"
+
+    clock[0] = 101.0
+    decision = planner.tick()
+    # no demand, no backlog -> min_workers; the TWO surplus workers
+    # drain in one decision, fewest leases first (cheapest preemption),
+    # name tie-break — never the lease holder
+    assert decision["direction"] == "down"
+    assert decision["reason"] == "demand"
+    assert decision["target"] == 1 and decision["actual"] == 3
+    assert decision["drain"] == ["wa", "wb"]
+    assert hive.last_plan["direction"] == "down"
+
+    # next tick: the victims are still heartbeating (a drain takes a
+    # while) but the ledger excludes them — actual already reads 1 and
+    # the drain is NOT re-issued
+    clock[0] = 101.4
+    held = planner.tick()
+    assert held["direction"] == "hold"
+    assert held["actual"] == 1 and held["drain"] == []
+    assert set(planner._draining) == {"wa", "wb"}
+
+    # a victim that actually left (stopped heartbeating) clears its
+    # ledger entry; the still-draining one stays excluded
+    del hive.worker_seen["wa"]
+    clock[0] = 102.0
+    planner.tick()
+    assert set(planner._draining) == {"wb"}
+
+    # one stuck past the 60 s grace window re-enters the live view and
+    # is re-decided (the cooldown long expired; both survivors are
+    # still heartbeating)
+    clock[0] = 162.0
+    _seed_worker(hive, "wb", 162.0)
+    _seed_worker(hive, "wc", 162.0)
+    redecided = planner.tick()
+    assert redecided["direction"] == "down"
+    assert redecided["drain"] == ["wb"]
+
+
+def test_hysteresis_deadband_and_delta_arrival_estimator():
+    clock = [0.0]
+    hive = MiniHive(lease_s=10.0, delay_s=0.0, clock=lambda: clock[0])
+    planner = FleetPlanner(hive, _cfg(max_workers=2, hysteresis=0.6),
+                           clock=lambda: clock[0])
+    for name in ("wa", "wb"):
+        _seed_worker(hive, name, 0.0)
+
+    # anchor tick under a queued burst: demand wants past the ceiling,
+    # the 2-worker fleet is already there -> bounds hold
+    clock[0] = 1.0
+    for i in range(4):
+        hive.submit(_job(f"h{i}"))
+    first = planner.tick()
+    assert first["direction"] == "hold" and first["reason"] == "bounds"
+    hive._take_jobs("wa")  # burst leased away: no backlog term below
+
+    # 4 more submissions over 2 s = 2.0 jobs/s. The hive's own 30 s
+    # EWMA has barely warmed (~0.2), so the planner's Δsubmitted/dt
+    # estimator must carry the reading...
+    clock[0] = 3.0
+    for i in range(4, 8):
+        hive.submit(_job(f"h{i}"))
+    hive._take_jobs("wa")
+    second = planner.tick()
+    assert second["observed_jobs_s"] >= 1.9, second
+    # ...which lands raw demand at ~1 worker: below actual=2 but
+    # inside the 0.6 deadband -> hysteresis hold, nothing drains
+    assert second["direction"] == "hold"
+    assert second["reason"] == "hysteresis"
+    assert second["target"] == 1 and second["actual"] == 2
+    assert second["drain"] == []
+
+
+def test_placement_replicates_hot_models_deterministically():
+    hive = MiniHive(lease_s=10.0, delay_s=0.0, clock=lambda: 0.0)
+    planner = FleetPlanner(hive, PlannerConfig(replicate_max=2))
+    rates = {"m/a": 3.0, "m/b": 1.0, "m/c": 0.5}
+    plan = planner._plan_placement(rates, ["w1", "w0", "w2"])
+    # m/a owns 2/3 of the demand -> 2 homes (replicate_max caps it);
+    # every observed model keeps >= 1 home; homes fill least-loaded
+    # first with a name tie-break
+    assert plan == {"w0": ("m/a", "m/c"), "w1": ("m/a",),
+                    "w2": ("m/b",)}
+    # deterministic under input-order permutations: recovery replays
+    # the exact same plan from the same observations
+    shuffled = dict(reversed(list(rates.items())))
+    assert planner._plan_placement(shuffled, ["w2", "w1", "w0"]) == plan
+    assert planner._plan_placement({}, ["w0"]) == {}
+    assert planner._plan_placement(rates, []) == {}
+
+
+# ---------------------------------------------------------------------------
+# seam units: journal recovery, /api/plan, heartbeat hints, residency
+# ---------------------------------------------------------------------------
+
+
+def test_journaled_plan_seeds_reattached_planner_no_double_actuation(
+        tmp_path):
+    clock = [0.0]
+    journal = HiveJournal(tmp_path / "hive", fsync=False)
+    hive = MiniHive(lease_s=10.0, delay_s=0.0, journal=journal,
+                    clock=lambda: clock[0])
+    cfg = _cfg(max_workers=4, cooldown_up_s=30.0, cooldown_down_s=30.0)
+    planner = FleetPlanner(hive, cfg, clock=lambda: clock[0])
+    _seed_worker(hive, "w0", 0.0)
+    for i in range(6):
+        hive.submit(_job(f"r{i}", model="m/hot"))
+    clock[0] = 1.0
+    decision = planner.tick()
+    assert decision["direction"] == "up" and decision["spawn"] >= 1
+
+    # crash: the process dies with the scale-up decided but the spawns
+    # not yet serving. Recovery replays the plan into last_plan...
+    from chiaswarm_tpu.node.minihive import kill_hive
+
+    asyncio.run(kill_hive(hive))
+    clock[0] = 2.0
+    recovered = MiniHive.recover(
+        HiveJournal(tmp_path / "hive", fsync=False),
+        lease_s=10.0, delay_s=0.0, clock=lambda: clock[0])
+    assert recovered.last_plan is not None
+    assert recovered.last_plan["direction"] == "up"
+    assert recovered.last_plan["target"] == decision["target"]
+    assert recovered.last_plan["at_s"] == decision["at_s"]
+    # ...and the replayed flight timeline carries the decision note
+    record = recovered.flights.get(PLAN_FLIGHT_ID)
+    assert any(e["event"] == "plan" for e in record["events"])
+
+    # a fresh planner attached to the recovered hive treats the dead
+    # process's decision as its own recent one: same pressure, but the
+    # inherited up-cooldown pins the fleet — no double-actuation
+    replanner = FleetPlanner(recovered, cfg, clock=lambda: clock[0])
+    assert recovered.planner is replanner
+    _seed_worker(recovered, "w0", 2.0)
+    clock[0] = 3.0
+    after = replanner.tick()
+    assert after["direction"] == "hold"
+    assert after["reason"] == "cooldown"
+    assert after["spawn"] == 0 and after["drain"] == []
+
+
+def test_api_plan_endpoint_and_heartbeat_placement_ack():
+    import aiohttp
+
+    async def scenario():
+        hive = MiniHive(lease_s=5.0, delay_s=0.0)
+        uri = await hive.start()
+        beat = {"worker_name": "hb-w0",
+                "metrics": {"chips_in_service": 1}, "jobs": []}
+        try:
+            async with aiohttp.ClientSession() as session:
+                # pre-planner wire parity: /api/plan 404s and the
+                # heartbeat ack carries NO placement key at all
+                async with session.get(uri + "/api/plan") as resp:
+                    assert resp.status == 404
+                async with session.post(uri + "/api/heartbeat",
+                                        json=beat) as resp:
+                    assert resp.status == 200
+                    ack = await resp.json()
+                assert ack["status"] == "ok"
+                assert "placement" not in ack
+
+                planner = FleetPlanner(hive, _cfg())
+                hive.submit(_job("plan-1", model="m/hinted"))
+                planner.tick()
+
+                async with session.get(uri + "/api/plan") as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                assert body["ticks"] == 1
+                assert body["config"]["min_workers"] == 1
+                assert body["decision"]["target"] >= 1
+                assert body["decision"]["placement"]["hb-w0"] == \
+                    ["m/hinted"]
+                async with session.post(uri + "/api/heartbeat",
+                                        json=beat) as resp:
+                    ack = await resp.json()
+                assert ack["placement"] == ["m/hinted"]
+        finally:
+            await hive.stop()
+
+    asyncio.run(scenario())
+
+
+def test_residency_placement_hint_outranks_local_arrival_ewma():
+    from chiaswarm_tpu.obs.metrics import Registry
+    from chiaswarm_tpu.serving.residency import ResidencyManager
+
+    class FakeModel:
+        def __init__(self, nbytes: int) -> None:
+            self.nbytes = nbytes
+
+    loads: list[str] = []
+
+    def loader_of(name: str, nbytes: int):
+        def load():
+            loads.append(name)
+            return FakeModel(nbytes)
+
+        return load
+
+    manager = ResidencyManager(
+        budget_bytes=1000, hard_limit_bytes=2000,
+        metrics_registry=Registry(), persist_path=None,
+        reserve_wait_s=0.2)
+    size_of = lambda value: value.nbytes  # noqa: E731
+    for _ in range(5):  # a is the locally-hot model by arrival EWMA
+        manager.acquire("ka", loader_of("a", 400), model="a",
+                        size_of=size_of)
+    manager.acquire("kb", loader_of("b", 400), model="b",
+                    size_of=size_of)
+    manager.set_budget(100)
+    manager.set_budget(1000)
+    assert manager.resident_models() == []
+
+    # the plan says b belongs here: the hint outranks a's hotter EWMA
+    manager.note_placement(["b"])
+    assert manager.placement_hints == 1
+    manager.note_placement(("b",))  # unchanged hint is not re-counted
+    assert manager.placement_hints == 1
+    assert manager.note_idle()
+    deadline = 100
+    while "b" not in manager.resident_models() and deadline:
+        deadline -= 1
+        time.sleep(0.02)
+    assert manager.resident_models() == ["b"], loads
+    snap = manager.snapshot()
+    assert snap["placement"] == ["b"]
+    assert snap["placement_hints"] == 1
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance gate (slow): elastic fleet vs the static roster sweep
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscaler_gate_tracks_diurnal_and_beats_static():
+    """ISSUE 19 acceptance: a seeded diurnal schedule with a spike,
+    driven by ``run_load`` under the planner and under every static
+    roster in the swept set. The planner must lose nothing, keep the
+    contention-adjusted admitted p99 within deadline, actuate at least
+    one scale-up AND one scale-down, and spend STRICTLY fewer
+    worker-hours than the best feasible static roster."""
+    from chiaswarm_tpu.node.loadgen import (
+        AutoscalePlan,
+        DiurnalCurve,
+        UserPopulation,
+        autoscale_comparison,
+        generate_schedule,
+    )
+
+    seed = "swarmplan"
+    population = UserPopulation(n_users=200, seed=f"plan:{seed}")
+    curve = DiurnalCurve(amplitude=0.8, spikes=1, spike_mult=2.0,
+                         seed=f"plan:{seed}")
+    schedule = generate_schedule(population, curve, duration_s=12.0,
+                                 rate_jobs_s=90.0, seed=f"plan:{seed}",
+                                 id_prefix="plangate")
+    plan = AutoscalePlan(min_workers=1, max_workers=5,
+                         tick_every_s=0.2,
+                         capacity_jobs_s_per_worker=40.0,
+                         backlog_drain_s=1.5, cooldown_up_s=0.4,
+                         cooldown_down_s=2.0, smoothing_window_s=1.5)
+    table = asyncio.run(autoscale_comparison(
+        schedule, autoscale=plan, static_rosters=[1, 2, 3, 4, 5],
+        seed=seed, settle_timeout_s=180.0))
+
+    planner_row, gate = table["planner"], table["gate"]
+    report = table["planner_report"]
+    assert planner_row["zero_loss"], report["reconciliation"]
+    assert planner_row["p99_ok"], report["admitted_deadline"]
+    events = report["autoscale"]["events"]
+    assert any(e["direction"] == "up" for e in events), events
+    assert any(e["direction"] == "down" for e in events), events
+    assert report["worker_time"]["peak_workers"] > plan.min_workers
+    # the planner's economics claim, against rosters that actually
+    # served the traffic (zero loss, p99 in deadline, shed parity)
+    assert gate["feasible_static"], table["static"]
+    assert gate["planner_beats_best_static"], {
+        "gate": gate, "static": table["static"]}
+
+
+# ---------------------------------------------------------------------------
+# nightly federated soak (slow): elastic fleet + mid-run shard SIGKILL
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_autoscaler_soak_diurnal_with_shard_kill(tmp_path):
+    """Nightly seeded soak (replay: ``CHIASWARM_SOAK_SEED=<run id>
+    pytest tests/test_planner.py --slow -k soak``): the elastic fleet
+    over 3 journaled shards, one seeded shard SIGKILL'd and recovered
+    from its own journal mid-run. Zero loss fleet-wide across the
+    epoch bump, the planner actuated at least one scale-up, and every
+    settled job's stitched flight record verifies clean."""
+    from chiaswarm_tpu.node.federation import shard_of
+    from chiaswarm_tpu.node.loadgen import (
+        AutoscalePlan,
+        DiurnalCurve,
+        FederatedLoadHive,
+        UserPopulation,
+        generate_schedule,
+        run_load,
+    )
+
+    seed = os.environ.get("CHIASWARM_SOAK_SEED", "plan-soak-default")
+    n_jobs = int(os.environ.get("CHIASWARM_SOAK_JOBS", "600"))
+    duration_s = 10.0
+    population = UserPopulation(n_users=300, seed=f"plansoak:{seed}")
+    curve = DiurnalCurve(amplitude=0.7, spikes=2, spike_mult=2.0,
+                         seed=f"plansoak:{seed}")
+    schedule = generate_schedule(
+        population, curve, duration_s=duration_s,
+        rate_jobs_s=max(10.0, n_jobs / duration_s),
+        seed=f"plansoak:{seed}", id_prefix="plansoak")
+    hive = FederatedLoadHive(3, journal_root=tmp_path / "fed",
+                             journal_fsync=False, lease_s=5.0,
+                             delay_s=0.0, max_attempts=6,
+                             max_jobs_per_poll=2)
+    plan = AutoscalePlan(min_workers=1, max_workers=5,
+                         tick_every_s=0.2,
+                         capacity_jobs_s_per_worker=40.0,
+                         backlog_drain_s=1.5, cooldown_up_s=0.4,
+                         cooldown_down_s=2.0, smoothing_window_s=1.5)
+    victim_shard = shard_of(str(seed), 3)  # seeded, replayable pick
+    kill_at = max(2, len(schedule) // 2)
+    state = {"cycled": False}
+
+    async def chaos(done: int, federation) -> None:
+        if state["cycled"] or done < kill_at:
+            return
+        state["cycled"] = True
+        await federation.kill_shard(victim_shard)
+        await asyncio.sleep(0.3)
+        await federation.restart_shard(victim_shard)
+
+    report = asyncio.run(run_load(
+        schedule, hive=hive, autoscale=plan, on_submit=chaos,
+        seed=f"plansoak-{seed}", settle_timeout_s=600.0))
+
+    assert state["cycled"], "the scripted shard kill never fired"
+    rec = report["reconciliation"]
+    assert rec["zero_loss"], rec
+    events = report["autoscale"]["events"]
+    assert any(e["direction"] == "up" for e in events), events
+    # the killed shard recovered into a bumped epoch; the others kept
+    # their first life
+    epochs = hive.stats()["aggregate"]["epochs"]
+    assert sorted(epochs) == [1, 1, 2], epochs
+    # flight completeness fleet-wide: every settled job's stitched
+    # record replays a gapless grant chain and exactly one settle
+    settled = [str(item.job["id"]) for item in schedule
+               if str(item.job["id"]) in hive.completed]
+    assert settled
+    assert hive.flights.verify(settled) == []

@@ -67,6 +67,17 @@ R16   lock-order-inversion    no two concurrent roots take the same lock
 R17   await-or-blocking-      no await while holding a threading lock; no
       under-lock              time.sleep/socket/subprocess on the event loop
                               (executor-dispatched helpers exempt)
+R18   unkeyed-trace-input     every trace-affecting env knob (read at trace
+                              time, or frozen into a module constant a
+                              traced body loads) is folded into the
+                              executable-cache key
+R19   frozen-env-reread       no env read inside a build/traced scope — it
+                              executes once per cache slot, not per call
+R20   unstable-key-component  no id()/hash()/repr() in the persistent key
+                              surface (cache_fingerprint/artifact_cache_key);
+                              in-process static_cache_key owners may keep id()
+R21   cache-tag-collision     no two distinct build callables share one
+                              (owner, tag, statics) cache vocabulary
 ====  ======================  ===============================================
 
 **The project index** (``analysis/project.py``, "swarmflow"): R1-R8 are
@@ -119,12 +130,26 @@ module-global and parameter-passed locks), computes per-access guard
 sets with RacerD-style entry-held credit (a ``*_locked`` helper whose
 every recorded call site holds the lock counts as guarded), and builds
 the lock-order graph; a handoff pass taints jit-wrapper results flowing
-into shared containers. The three project interpreters are deliberately
-layered on ONE summary extraction (``project.py``, ``SCHEMA``-versioned
-cache): swarmflow resolves *names and calls*, shardflow adds *value
-semantics*, raceflow adds *execution context* — each reuses the
-call-graph machinery, chain rendering, and the baseline/marker
-conventions of the layer below.
+into shared containers.
+
+**The keyflow layer** (``analysis/keyflow.py``, "swarmkey"): R18-R21
+are the fourth interpreter — where raceflow asks *which execution roots
+a statement runs under*, keyflow asks *which inputs the traced program
+consumed and whether the executable-cache key knows*. A keyed-set pass
+BFSes the call graph from the key builders (``static_cache_key``/
+``cache_fingerprint``/``artifact_cache_key``) collecting every env-var
+name that reaches the key; a traced-reach pass roots at the jit entry
+points (an env read there is baked into the executable) and a
+build-scope pass marks factory closures and jit roots (a read there
+runs once per cache slot). The compiled-side twin
+(``tools/key_audit.py``) builds the real tiny programs under each knob
+and asserts executable identity changes iff the key changes. The four
+project interpreters are deliberately layered on ONE summary extraction
+(``project.py``, ``SCHEMA``-versioned cache): swarmflow resolves *names
+and calls*, shardflow adds *value semantics*, raceflow adds *execution
+context*, keyflow adds *input provenance* — each reuses the call-graph
+machinery, chain rendering, and the baseline/marker conventions of the
+layer below.
 
 Baseline workflow: first adoption of a rule grandfathers existing findings
 into ``.swarmlint-baseline.json`` (``--write-baseline``). New findings fail;
@@ -133,10 +158,13 @@ fixing a baselined finding makes its entry stale, which fails under
 ``--changed-only`` lints just the files changed vs the merge base with
 origin/main plus their reverse-dependency closure from the import graph
 (pre-commit; editing a mesh-defining module additionally re-lints every
-sharding consumer — axes travel through parameters, not imports — and
+sharding consumer — axes travel through parameters, not imports —
 editing a module that defines an execution root or lock re-lints every
 module with concurrency facts, since roots and guards cross module
-boundaries without import edges too); ``--sarif FILE`` exports new
+boundaries without import edges too, and editing a key-builder or
+knob-defining module re-lints every compile-cached program site — the
+keyed set and the traced reach are both global properties);
+``--sarif FILE`` exports new
 findings for GitHub code scanning with chains as codeFlows.
 """
 

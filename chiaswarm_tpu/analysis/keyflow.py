@@ -1,0 +1,527 @@
+"""keyflow — trace-input provenance proofs over the swarmflow index.
+
+The worker's serving model rests on one invariant: an executable-cache
+slot is keyed by *everything that changes the traced program*. PRs 11,
+12 and 18 each re-enforced it by hand ("fold into ``static_cache_key``
+only when enabled", with byte-identical-key gates) — keyflow makes the
+bug class statically checkable, the FOURTH interpreter over the
+swarmflow project index (swarmflow builds the call graph, shardflow
+replays value sharding, raceflow replays thread topology, keyflow
+replays *which inputs the trace consumed and whether the key knows*).
+Pure stdlib, no jax import.
+
+Three passes, four rules (plus R6's interprocedural face):
+
+**Keyed set.** The cache-key builders (``static_cache_key``,
+``cache_fingerprint``, ``artifact_cache_key`` — matched by name, so a
+fixture-local builder works) seed a BFS over the call graph; every
+env-var name mentioned in that closure — a SCREAMING_SNAKE string
+literal, a resolved env read, or a string/tuple constant of a builder's
+module (``_TRACE_ENV_KNOBS``) — is *folded into the key*. Conservative
+in the safe direction: over-approximating the keyed set can only silence
+a finding, never invent one.
+
+**Traced reach.** Functions reachable from the jit entry points
+(decorated roots + ``toplevel_jit``/``jax.jit``/``scan`` registration
+sites) run at trace time: an env read there is baked into the
+executable. Build scopes — factory closures handed to
+``cached_executable``/``get_or_create``, and the jit roots themselves —
+are the lexical subset where the read provably happens at most once per
+slot.
+
+Rules (all conservative: dynamic env names and unresolvable targets are
+silent):
+
+- **R18 unkeyed-trace-input** — a trace-affecting env read (direct, or
+  an import-time read frozen into a module constant that a traced
+  function loads) whose var is NOT in the keyed set: a knob flip
+  silently serves the stale executable from a warm slot. The live
+  ``CHIASWARM_ATTENTION`` bug that motivated this pass.
+- **R19 frozen-env-reread** — an env read lexically inside a build/
+  traced scope, written as if live-per-call but executed once per cache
+  slot; hoist to dispatch or fold into the key.
+- **R20 unstable-key-component** — ``id()``/``hash()``/``repr()``
+  flowing into the PERSISTENT key surface (``cache_fingerprint``/
+  ``artifact_cache_key``): stable within a process, different across
+  processes, so a shipped AOT artifact keyed by one can never hit.
+  In-process ``static_cache_key`` owners may keep ``id(self.c)`` — that
+  is the point of having two surfaces.
+- **R21 cache-tag-collision** — two distinct build callables sharing an
+  (owner, tag, statics-vocabulary) triple: their programs land in one
+  slot and the second build silently serves the first's executable.
+
+Findings carry full entry→sink chains (jit registration site → call
+path → env read / key site) rendered in text/JSON/SARIF exactly like
+R9–R17, and key into the shrink-only baseline. Suppressions:
+``# swarmlens: allow-<kind>`` markers (``allow-unkeyed-trace-input``,
+``allow-frozen-env-reread``, ``allow-unstable-key``,
+``allow-tag-collision``) on the finding line or the comment line above,
+each stating the invariant that makes the freeze safe.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterable
+
+from chiaswarm_tpu.analysis.core import Finding
+
+if TYPE_CHECKING:  # pragma: no cover
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+R18 = "unkeyed-trace-input"
+R19 = "frozen-env-reread"
+R20 = "unstable-key-component"
+R21 = "cache-tag-collision"
+R6 = "recompile-hazard"  # the interprocedural face rides this name
+
+_BUILDER_NAMES = frozenset(
+    {"static_cache_key", "cache_fingerprint", "artifact_cache_key"})
+
+
+def _enc_names(enc) -> Iterable[str]:
+    """Bare names referenced by a flow-IR expression tree."""
+    if not isinstance(enc, dict):
+        return
+    if "n" in enc:
+        yield enc["n"]
+    for sub in enc.get("u") or ():
+        yield from _enc_names(sub)
+    for sub in enc.get("x") or ():
+        yield from _enc_names(sub)
+    for sub in (enc.get("kwx") or {}).values():
+        yield from _enc_names(sub)
+
+
+class KeyflowAnalysis:
+    """Run the keyed-set + traced-reach passes and evaluate R18–R21
+    (and R6's interprocedural face).
+
+    Build once per index via :func:`results`; ``findings`` holds every
+    violation, tagged with the rule name, sorted by location.
+    """
+
+    def __init__(self, index: "ProjectIndex"):
+        self.index = index
+        self.findings: list[Finding] = []
+        self._collect()
+        self._keyed_set()
+        self._traced_reach()
+        self._build_scopes()
+        self._r18()
+        self._r19()
+        self._r20()
+        self._r21()
+        self._r6_interproc()
+        seen: set[tuple] = set()
+        uniq: list[Finding] = []
+        for f in self.findings:
+            k = (f.rule, f.path, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                uniq.append(f)
+        self.findings = sorted(
+            uniq, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    # -- facts -------------------------------------------------------------
+    def _collect(self) -> None:
+        idx = self.index
+        self.kf: dict[str, dict] = {}                # rel -> keyflow facts
+        self.allow: dict[str, dict[str, set[int]]] = {}
+        self.lits: dict[tuple[str, str], list[str]] = {}
+        for rel in sorted(idx.summaries):
+            s = idx.summaries[rel]
+            facts = s.get("keyflow") or {}
+            self.kf[rel] = facts
+            self.allow[rel] = {k: set(v) for k, v in
+                               (facts.get("allow") or {}).items()}
+            for qual, names in (facts.get("lits") or {}).items():
+                self.lits[(s["module"], qual)] = names
+
+    def _allowed(self, rel: str, kind: str, *lines: int) -> bool:
+        lns = self.allow.get(rel, {}).get(kind, set())
+        return any(ln in lns for ln in lines)
+
+    def _var_of(self, rec: dict, module: str) -> str | None:
+        """The literal env-var name a read site targets, following
+        constant references across modules; None = dynamic (silent)."""
+        if "var" in rec:
+            return rec["var"]
+        ref = rec.get("ref")
+        if not ref:
+            return None
+        return self.index.resolve_axis({"ref": ref}, module)
+
+    # -- keyed set ---------------------------------------------------------
+    def _keyed_set(self) -> None:
+        idx = self.index
+        builders = sorted(
+            (m, q) for (m, q) in idx.funcs
+            if q.rsplit(".", 1)[-1] in _BUILDER_NAMES)
+        self.builder_mods = sorted({m for m, _ in builders})
+        breach = idx.reach_with_parents(builders)
+        keyed: set[str] = set()
+        for node in breach:
+            keyed.update(self.lits.get(node, ()))
+        # env reads executed while BUILDING the key are folded by
+        # construction (numerics.fingerprint, quantize.activations_format)
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            for rec in self.kf[rel].get("env") or ():
+                if (s["module"], rec.get("fn")) in breach:
+                    v = self._var_of(rec, s["module"])
+                    if v:
+                        keyed.add(v)
+        # a builder module's own constant vocabulary: the
+        # _TRACE_ENV_KNOBS tuple and single-name constants
+        from chiaswarm_tpu.analysis.project import _ENV_NAME_RE
+
+        for m in self.builder_mods:
+            rel = idx.modules.get(m)
+            if rel is None:
+                continue
+            for v in (idx.summaries[rel].get("constants") or {}).values():
+                names = ([v] if isinstance(v, str)
+                         else [r.get("lit") for r in v
+                               if isinstance(r, dict)])
+                keyed.update(n for n in names
+                             if n and _ENV_NAME_RE.match(n))
+        self.keyed = keyed
+
+    # -- traced reach ------------------------------------------------------
+    def _traced_reach(self) -> None:
+        self.roots = self.index.jit_entry_points()
+        self.tparent = self.index.reach_with_parents(self.roots)
+
+    def _entry_chain(self, func: tuple[str, str],
+                     sink: tuple[str, int, str],
+                     ) -> tuple[tuple[str, int, str], ...]:
+        """jit registration site -> call path -> sink."""
+        hops = list(self.index.chain(self.tparent, func))
+        cur = func
+        while self.tparent.get(cur) is not None:
+            cur = self.tparent[cur]
+        regs = self.roots.get(cur) or []
+        if regs and hops:
+            r = regs[0]
+            if (r["relpath"], r["line"]) != (hops[0][0], hops[0][1]):
+                hops.insert(0, (r["relpath"], r["line"], r["symbol"]))
+        if not hops or (hops[-1][0], hops[-1][1]) != (sink[0], sink[1]):
+            hops.append(sink)
+        return tuple(hops)
+
+    # -- build scopes ------------------------------------------------------
+    def _build_scopes(self) -> None:
+        """Function -> registration hop for every build closure (factory
+        arguments of cached_executable/get_or_create) and every jit
+        root: the scopes where an env read runs once per cache slot."""
+        idx = self.index
+        scopes: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            m = s["module"]
+            for b in self.kf[rel].get("builds") or ():
+                hop = (rel, b["ln"], f"{m}.{b['fn']}")
+                target = b["b"]
+                if target.startswith("<lambda>:"):
+                    qual = target[len("<lambda>:"):]
+                    if (m, qual) in idx.funcs:
+                        scopes.setdefault((m, qual), hop)
+                    continue
+                if target.startswith(("self.", "cls.")):
+                    name = target.split(".")[1]
+                    for qual in (s.get("names") or {}).get(name, ()):
+                        scopes.setdefault((m, qual), hop)
+                    continue
+                for node in idx.func_targets(m, target):
+                    scopes.setdefault(node, hop)
+        for node, regs in self.roots.items():
+            if regs:
+                r = regs[0]
+                scopes.setdefault(
+                    node, (r["relpath"], r["line"], r["symbol"]))
+        self.scopes = scopes
+
+    # -- R18 unkeyed-trace-input -------------------------------------------
+    def _r18(self) -> None:
+        idx = self.index
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            m = s["module"]
+            # direct reads on the traced path
+            for rec in self.kf[rel].get("env") or ():
+                fn = rec.get("fn", "<module>")
+                node = (m, fn)
+                if fn == "<module>" or node not in self.tparent:
+                    continue
+                if node in self.scopes:
+                    continue  # lexical build scope: R19's jurisdiction
+                var = self._var_of(rec, m)
+                if var is None or var in self.keyed:
+                    continue
+                if self._allowed(rel, "unkeyed", rec["ln"]):
+                    continue
+                sink = (rel, rec["ln"], f"{m}.{fn}")
+                self.findings.append(Finding(
+                    rule=R18, path=rel, line=rec["ln"], col=0,
+                    message=(
+                        f"trace-affecting env knob {var} is read at "
+                        f"trace time but never folded into the "
+                        f"executable-cache key — a warm cache hit "
+                        f"serves the stale program after a knob flip; "
+                        f"fold it into static_cache_key only-when-set, "
+                        f"or mark the deliberate freeze"),
+                    symbol=fn,
+                    chain=self._entry_chain(node, sink)))
+            # import-time reads frozen into module constants that a
+            # traced function loads
+            for name, cons in (self.kf[rel].get("consts") or {}).items():
+                users = self._const_users(m, name)
+                if not users:
+                    continue
+                for var in cons["vars"]:
+                    if var in self.keyed:
+                        continue
+                    if self._allowed(rel, "unkeyed", cons["ln"]):
+                        continue
+                    user = users[0]
+                    sink = (rel, cons["ln"], f"{m}.{name}")
+                    self.findings.append(Finding(
+                        rule=R18, path=rel, line=cons["ln"], col=0,
+                        message=(
+                            f"env knob {var} is frozen into module "
+                            f"constant {name} at import and traced "
+                            f"through {user[1]} — neither a knob flip "
+                            f"nor a restartless reload can reach a "
+                            f"warm slot; fold it into the cache key "
+                            f"only-when-set, or mark the deliberate "
+                            f"freeze"),
+                        symbol="<module>",
+                        chain=self._entry_chain(user, sink)))
+
+    def _const_users(self, module: str, name: str,
+                     ) -> list[tuple[str, str]]:
+        """Traced-reach functions of ``module`` that load the bare
+        module-global ``name`` (params and locally assigned names
+        excluded — those shadow the global)."""
+        out: list[tuple[str, str]] = []
+        for node in sorted(self.tparent):
+            if node[0] != module:
+                continue
+            f = self.index.funcs.get(node)
+            if f is None or name in f["pargs"] or name in f["kwonly"]:
+                continue
+            assigned = {t for step in f["flow"]
+                        for t in step.get("a") or ()}
+            if name in assigned:
+                continue
+            for step in f["flow"]:
+                found = False
+                for key in ("e", "r"):
+                    if key in step and name in _enc_names(step[key]):
+                        out.append(node)
+                        found = True
+                        break
+                if found:
+                    break
+        return out
+
+    # -- R19 frozen-env-reread ---------------------------------------------
+    def _r19(self) -> None:
+        idx = self.index
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            m = s["module"]
+            for rec in self.kf[rel].get("env") or ():
+                fn = rec.get("fn", "<module>")
+                node = (m, fn)
+                hop = self.scopes.get(node)
+                if hop is None:
+                    continue
+                var = self._var_of(rec, m)
+                if var is None or var in self.keyed:
+                    continue
+                if self._allowed(rel, "frozen", rec["ln"]):
+                    continue
+                sink = (rel, rec["ln"], f"{m}.{fn}")
+                chain = (hop, sink) if (hop[0], hop[1]) != (rel, rec["ln"]) \
+                    else (sink,)
+                self.findings.append(Finding(
+                    rule=R19, path=rel, line=rec["ln"], col=0,
+                    message=(
+                        f"env knob {var} is read inside a build/traced "
+                        f"scope — it executes once per cache slot, so a "
+                        f"warm hit freezes the value the code treats as "
+                        f"live-per-call; hoist the read to dispatch or "
+                        f"fold it into the cache key"),
+                    symbol=fn, chain=chain))
+
+    # -- R20 unstable-key-component ----------------------------------------
+    def _r20(self) -> None:
+        idx = self.index
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            m = s["module"]
+            for site in self.kf[rel].get("fpsites") or ():
+                for part in site.get("unstable") or ():
+                    ln = part.get("ln", site["ln"])
+                    if self._allowed(rel, "unstable", ln, site["ln"]):
+                        continue
+                    what = part["op"] + "(" + (part.get("arg") or "…") + ")"
+                    fn = site.get("fn", "<module>")
+                    self.findings.append(Finding(
+                        rule=R20, path=rel, line=ln, col=0,
+                        message=(
+                            f"process-unstable component {what} flows "
+                            f"into the persistent key surface "
+                            f"({site.get('b', 'cache_fingerprint')}) — "
+                            f"id()/hash()/repr() differ across "
+                            f"processes, so a shipped artifact keyed by "
+                            f"it can never hit; use stable content "
+                            f"(model name, dtype, config tuple). "
+                            f"In-process static_cache_key owners may "
+                            f"keep id()"),
+                        symbol=fn,
+                        chain=((rel, site["ln"], f"{m}.{fn}"),)))
+
+    # -- R21 cache-tag-collision -------------------------------------------
+    def _r21(self) -> None:
+        idx = self.index
+        groups: dict[tuple, list[tuple[str, str, dict]]] = {}
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            m = s["module"]
+            for site in self.kf[rel].get("keysites") or ():
+                tag = site.get("tag")
+                skeys = site.get("skeys")
+                if tag is None or skeys is None:
+                    continue
+                canon = self._owner_key(site, m)
+                if canon is None:
+                    continue
+                groups.setdefault(
+                    (canon, tag, tuple(skeys)), []).append((rel, m, site))
+        for (canon, tag, skeys), sites in sorted(groups.items()):
+            quals = {(m, site["fn"]) for _, m, site in sites}
+            if len(quals) < 2:
+                continue
+            sites = sorted(sites, key=lambda t: (t[0], t[2]["ln"]))
+            first_rel, first_m, first = sites[0]
+            fhop = (first_rel, first["ln"],
+                    f"{first_m}.{first['fn']}")
+            for rel, m, site in sites[1:]:
+                if site["fn"] == first["fn"] and m == first_m:
+                    continue
+                if self._allowed(rel, "collision", site["ln"]) \
+                        or self._allowed(first_rel, "collision",
+                                         first["ln"]):
+                    continue
+                self.findings.append(Finding(
+                    rule=R21, path=rel, line=site["ln"], col=0,
+                    message=(
+                        f"distinct build callables share the "
+                        f"executable-cache vocabulary (owner {canon[1]}, "
+                        f"tag {tag!r}, statics {sorted(skeys)}) with "
+                        f"{first_m}.{first['fn']} — their programs "
+                        f"collide in one slot and the second build "
+                        f"silently serves the first's executable; give "
+                        f"each program a distinct tag"),
+                    symbol=site["fn"],
+                    chain=(fhop,
+                           (rel, site["ln"], f"{m}.{site['fn']}"))))
+
+    @staticmethod
+    def _owner_key(site: dict, module: str) -> tuple | None:
+        o = site.get("owner") or {}
+        k = o.get("k")
+        fn = site.get("fn", "<module>")
+        if k == "lit":
+            return ("lit", o["v"])
+        if k == "ref":
+            v = o["v"]
+            return ("ref", v if "." in v else f"{module}.{v}")
+        if k in ("self", "selfcall"):
+            if "." not in fn:
+                return None
+            return ("self", f"{module}.{fn.split('.')[0]}.{o['v']}")
+        return None
+
+    # -- R6 interprocedural face -------------------------------------------
+    def _r6_interproc(self) -> None:
+        idx = self.index
+        for rel in sorted(self.kf):
+            s = idx.summaries[rel]
+            m = s["module"]
+            for site in self.kf[rel].get("keysites") or ():
+                fn = site.get("fn", "<module>")
+                for ent in site.get("svals") or ():
+                    if ent.get("t") == "display" and not ent.get("allc"):
+                        kind = ("non-hashable container"
+                                if not ent.get("h") else "container")
+                        self.findings.append(Finding(
+                            rule=R6, path=rel, line=site["ln"], col=0,
+                            message=(
+                                f"unbounded-cardinality {kind} built "
+                                f"from varying values fills static key "
+                                f"{ent.get('k')!r} — every distinct "
+                                f"content is a fresh executable slot "
+                                f"and a fresh XLA compile; bucket the "
+                                f"values or key on a bounded enum"),
+                            symbol=fn))
+                    elif ent.get("t") == "param":
+                        self._r6_param(rel, m, site, ent)
+
+    def _r6_param(self, rel: str, module: str, site: dict,
+                  ent: dict) -> None:
+        """A key-site parameter fed straight into the static dict: walk
+        one caller hop — a caller passing a raw request attribute
+        without bucketing reopens the compile-per-job failure mode."""
+        idx = self.index
+        fn = site.get("fn", "<module>")
+        func = (module, fn)
+        f = idx.funcs.get(func)
+        if f is None or ent["p"] not in f["pargs"]:
+            return
+        pidx = f["pargs"].index(ent["p"])
+        for caller in idx.callers_of(func):
+            cf = idx.funcs[caller]
+            if (cf.get("r6") or {}).get("b"):
+                continue  # the caller buckets; cardinality is bounded
+            crel = idx.modules[caller[0]]
+            for call in cf["calls"]:
+                t = call.get("t")
+                if not t or t.startswith("@table:"):
+                    continue
+                if func not in idx.func_targets(caller[0], t):
+                    continue
+                attr = (call.get("rattr") or {}).get(str(pidx))
+                if attr is None:
+                    attr = (call.get("rattrk") or {}).get(ent["p"])
+                if attr is None:
+                    continue
+                self.findings.append(Finding(
+                    rule=R6, path=crel, line=call["line"], col=0,
+                    message=(
+                        f"raw request attribute .{attr} flows through "
+                        f"{fn}'s parameter {ent['p']!r} into the static "
+                        f"cache-key vocabulary — every distinct value "
+                        f"is a fresh XLA compile; snap through "
+                        f"compile_cache.bucket_image_size/bucket_batch "
+                        f"at the call site"),
+                    symbol=caller[1],
+                    chain=(
+                        (crel, call["line"],
+                         f"{caller[0]}.{caller[1]}"),
+                        (idx.modules[module], f["line"],
+                         f"{module}.{fn}"),
+                        (rel, site["ln"], f"{module}.{fn}"))))
+
+
+def results(index: "ProjectIndex") -> KeyflowAnalysis:
+    """The keyflow analysis for ``index``, computed once and cached on
+    the index (R18–R21 plus R6's interprocedural face each filter the
+    same findings list)."""
+    cached = getattr(index, "_keyflow", None)
+    if cached is None:
+        cached = KeyflowAnalysis(index)
+        index._keyflow = cached
+    return cached

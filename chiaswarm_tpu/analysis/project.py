@@ -37,6 +37,7 @@ import collections
 import hashlib
 import json
 import os
+import re
 from typing import Any, Iterable
 
 from chiaswarm_tpu.analysis.core import FunctionInfo, ModuleContext
@@ -44,8 +45,10 @@ from chiaswarm_tpu.analysis.rules import (
     CALLBACK_WRAPPERS, JIT_WRAPPERS, TRACED_WRAPPERS, own_nodes, resolves_to,
 )
 
-SCHEMA = 5  # v5: raceflow concurrency facts (spawns, lock regions, shared
-#     attribute accesses, device-handoff taint) + custom_vjp registrations
+SCHEMA = 6  # v6: keyflow trace-input provenance facts (env reads,
+#     env-tainted module constants, cache-key/fingerprint/build sites,
+#     env-literal pools) + per-function r6 recompile facts + raw-attr
+#     call-argument facts
 DEFAULT_CACHE_NAME = ".swarmflow-cache.json"
 
 #: cross-chip collective primitives and the axis-name argument position
@@ -118,6 +121,37 @@ _CONC_ALLOW_MARKERS = {
     "unguarded": "swarmlens: allow-unguarded-mutation",
     "lockorder": "swarmlens: allow-lock-order",
     "blocking": "swarmlens: allow-blocking-under-lock",
+}
+
+# -- keyflow vocabulary -----------------------------------------------------
+#
+# Trace-input provenance (ISSUE 20): which env knobs / globals flow into
+# a traced program, and which of them the executable-cache key folds.
+
+#: the cache-key builder functions; any function whose name matches is a
+#: keyed-set root — env names it (or its callees) mention ARE folded
+_KEY_BUILDERS = ("static_cache_key",)
+_FP_BUILDERS = ("cache_fingerprint", "artifact_cache_key")
+#: executable-build registration methods: their factory argument is a
+#: build closure a warm cache hit never re-runs
+_BUILD_ATTRS = ("cached_executable", "get_or_create")
+#: process-unstable builtins: fine in an in-process key, poison in a
+#: persistent one
+_UNSTABLE_CALLS = ("id", "hash", "repr")
+#: raw request attributes whose distinct values explode executable
+#: cardinality (the R6 vocabulary; keyflow's interprocedural face)
+_RAW_SHAPE_ATTRS = ("height", "width", "batch", "num_frames")
+#: SCREAMING_SNAKE string literals that look like env-var names; the
+#: keyed set is the union of these over the key builders' call closure
+_ENV_NAME_RE = re.compile(r"^[A-Z][A-Z0-9]*(?:_[A-Z0-9]+)+$")
+
+#: inline suppressions for the keyflow rules, same line convention as
+#: the conc markers: each states the invariant that makes the site safe
+_KEY_ALLOW_MARKERS = {
+    "unkeyed": "swarmlens: allow-unkeyed-trace-input",
+    "frozen": "swarmlens: allow-frozen-env-reread",
+    "unstable": "swarmlens: allow-unstable-key",
+    "collision": "swarmlens: allow-tag-collision",
 }
 
 
@@ -553,6 +587,9 @@ class _Summarizer:
     # -- summary ----------------------------------------------------------
     def summarize(self) -> dict:
         ctx = self.ctx
+        from chiaswarm_tpu.analysis.rules.recompile import self_jit_attrs
+
+        self._r6_jattrs = self_jit_attrs(ctx)
         functions: dict[str, dict] = {}
         by_name: dict[str, list[str]] = {}
         for info in ctx.functions:
@@ -578,6 +615,7 @@ class _Summarizer:
         summary["donations"] = self._donations(ctx)
         summary["conc"] = self._conc_facts(ctx)
         summary["customvjp"] = self._customvjp_facts(ctx)
+        summary["keyflow"] = self._keyflow_facts(ctx)
         return summary
 
     def _func_summary(self, info: FunctionInfo) -> dict:
@@ -592,10 +630,12 @@ class _Summarizer:
         first = ([arg.arg for arg in a.posonlyargs + a.args] or [""])[0]
         calls, methods = self._calls(info)
         from chiaswarm_tpu.analysis.rules.host_sync import sync_sites
+        from chiaswarm_tpu.analysis.rules.recompile import recompile_facts
 
         sync = [{"line": n.lineno, "col": n.col_offset, "what": what}
                 for n, what in sync_sites(self.ctx, info)]
-        return {
+        r6 = recompile_facts(self.ctx, info, self._r6_jattrs)
+        out = {
             "name": name,
             "line": getattr(node, "lineno", 0),
             "npos": npos,
@@ -612,6 +652,9 @@ class _Summarizer:
             "sync": sync,
             "flow": self._flow(info),
         }
+        if r6:
+            out["r6"] = r6
+        return out
 
     def _calls(self, info: FunctionInfo) -> tuple[list[dict], list[str]]:
         calls: list[dict] = []
@@ -670,10 +713,26 @@ class _Summarizer:
             # to `f` with X among its kwargs — exactly what the R10
             # binding check wants, and a conservative call edge (the
             # partial object exists to be invoked)
-            calls.append({
+            rec = {
                 "t": target, "line": node.lineno, "np": len(node.args),
                 "kw": kw, "poslits": poslits,
-            })
+            }
+            # raw request attributes passed as arguments (req.height):
+            # the flow IR drops attribute names, so R6's interprocedural
+            # face needs them recorded at the call site
+            rattr = {str(i): a.attr for i, a in enumerate(node.args)
+                     if isinstance(a, ast.Attribute)
+                     and a.attr in _RAW_SHAPE_ATTRS
+                     and isinstance(a.value, ast.Name)}
+            rattrk = {k.arg: k.value.attr for k in node.keywords
+                      if k.arg and isinstance(k.value, ast.Attribute)
+                      and k.value.attr in _RAW_SHAPE_ATTRS
+                      and isinstance(k.value.value, ast.Name)}
+            if rattr:
+                rec["rattr"] = rattr
+            if rattrk:
+                rec["rattrk"] = rattrk
+            calls.append(rec)
         return calls, sorted(set(methods))
 
     def _table_entries(self, value: ast.AST) -> list[str] | None:
@@ -970,15 +1029,273 @@ class _Summarizer:
                         "symbol": ctx.symbol_for(node)})
         return out
 
-    def _allow_lines(self, ctx: ModuleContext) -> dict[str, list[int]]:
+    def _allow_lines(self, ctx: ModuleContext,
+                     markers: dict[str, str] = _CONC_ALLOW_MARKERS,
+                     ) -> dict[str, list[int]]:
         out: dict[str, list[int]] = {}
         for i, text in enumerate(ctx.source.splitlines(), start=1):
-            for kind, marker in _CONC_ALLOW_MARKERS.items():
+            for kind, marker in markers.items():
                 if marker in text:
                     lines = out.setdefault(kind, [])
                     lines.append(i)
                     if text.lstrip().startswith("#"):
                         lines.append(i + 1)
+        return out
+
+    # -- trace-input provenance facts (keyflow) ----------------------------
+    #
+    # One extra summary key, ``keyflow``, carries everything the keyflow
+    # interpreter (analysis/keyflow.py) needs: environment reads (with
+    # the enclosing function, so the traced-reach pass can classify them
+    # trace-affecting vs host-only), module constants tainted by
+    # import-time env reads, cache-key/fingerprint/build-registration
+    # call sites, and per-function pools of env-name-shaped string
+    # literals (the raw material of the keyed set).
+
+    def _env_read_node(self, node: ast.AST) -> dict | None:
+        """{"ln", "var"?|"ref"?} when ``node`` reads the environment —
+        ``os.environ.get``/``os.getenv`` calls and ``os.environ[...]``
+        subscript loads. ``var`` is a literal env name; ``ref`` a dotted
+        constant reference the interpreter resolves; neither when the
+        name is dynamic (keyflow stays silent on those)."""
+        arg = None
+        if isinstance(node, ast.Call):
+            t = self.resolve(node.func)
+            if t in ("os.environ.get", "os.getenv") and node.args:
+                arg = node.args[0]
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            if self.resolve(node.value) == "os.environ":
+                arg = node.slice
+        if arg is None:
+            return None
+        rec: dict[str, Any] = {"ln": node.lineno}
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            rec["var"] = arg.value
+        elif isinstance(arg, (ast.Name, ast.Attribute)):
+            dotted = self.resolve(arg)
+            if dotted:
+                rec["ref"] = dotted
+        return rec
+
+    def _env_reads(self, ctx: ModuleContext) -> list[dict]:
+        out: list[dict] = []
+        for node in ast.walk(ctx.tree):
+            rec = self._env_read_node(node)
+            if rec is None:
+                continue
+            info = ctx.enclosing_function(node)
+            rec["fn"] = info.qualname if info else "<module>"
+            out.append(rec)
+        return out
+
+    def _env_consts(self, tree: ast.Module) -> dict:
+        """Module constants tainted by import-time env reads, taint
+        propagated through later module-level assignments (the
+        flash-attention ``_ENV_BLOCK_Q`` → ``_DEFAULT_BLOCK_Q`` chain):
+        name -> {"ln", "vars": [env names], "refs": [dotted]}."""
+        tainted: dict[str, dict] = {}
+        for node in tree.body:
+            target, value = None, None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                target, value = node.target.id, node.value
+            if target is None:
+                continue
+            vars_: set[str] = set()
+            refs: set[str] = set()
+            for sub in ast.walk(value):
+                rec = self._env_read_node(sub)
+                if rec is not None:
+                    if "var" in rec:
+                        vars_.add(rec["var"])
+                    if "ref" in rec:
+                        refs.add(rec["ref"])
+                elif isinstance(sub, ast.Name) \
+                        and isinstance(sub.ctx, ast.Load) \
+                        and sub.id in tainted:
+                    vars_.update(tainted[sub.id]["vars"])
+                    refs.update(tainted[sub.id]["refs"])
+            if vars_ or refs:
+                tainted[target] = {"ln": node.lineno,
+                                   "vars": sorted(vars_),
+                                   "refs": sorted(refs)}
+        return tainted
+
+    def _unstable_parts(self, call: ast.Call) -> list[dict]:
+        """Bare ``id()``/``hash()``/``repr()`` calls anywhere in a call's
+        argument subtrees — stable within a process, different across
+        processes (R20's vocabulary)."""
+        out: list[dict] = []
+        for sub in ast.walk(call):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Name) \
+                    and sub.func.id in _UNSTABLE_CALLS \
+                    and sub.func.id not in self.aliases:
+                rec: dict[str, Any] = {"op": sub.func.id, "ln": sub.lineno}
+                if sub.args and isinstance(sub.args[0],
+                                           (ast.Name, ast.Attribute)):
+                    arg = self.resolve(sub.args[0])
+                    if arg:
+                        rec["arg"] = arg
+                out.append(rec)
+        return out
+
+    def _owner_canon(self, node: ast.AST | None) -> dict:
+        """Canonical form of a key site's owner argument, for the R21
+        collision grouping. ``lit``/``ref`` canons collide globally,
+        ``self``/``selfcall`` only within one class (the instance scopes
+        them at runtime); ``call``/``other`` never collide — a lint must
+        not equate values it cannot prove equal."""
+        if node is None:
+            return {"k": "none"}
+        if isinstance(node, ast.Constant):
+            return {"k": "lit", "v": repr(node.value)}
+        if isinstance(node, ast.Call):
+            t = self.resolve(node.func)
+            if t in ("id", "hash") and len(node.args) == 1 \
+                    and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+                inner = self.resolve(node.args[0])
+                if inner and inner.startswith(("self.", "cls.")):
+                    return {"k": "selfcall", "v": f"{t}({inner})"}
+            return {"k": "call"}
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            dotted = self.resolve(node)
+            if dotted and dotted.startswith(("self.", "cls.")):
+                return {"k": "self", "v": dotted}
+            if dotted:
+                return {"k": "ref", "v": dotted}
+        return {"k": "other"}
+
+    def _keysite(self, node: ast.Call, fn: str,
+                 info: FunctionInfo | None) -> dict:
+        args = list(node.args)
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        owner = args[0] if args else kw.get("owner")
+        tagn = args[1] if len(args) > 1 else kw.get("tag")
+        static = args[2] if len(args) > 2 else kw.get("static")
+        rec: dict[str, Any] = {"ln": node.lineno, "fn": fn,
+                               "owner": self._owner_canon(owner)}
+        unstable = self._unstable_parts(node)
+        if unstable:
+            rec["unstable"] = unstable
+        if isinstance(tagn, ast.Constant) and isinstance(tagn.value, str):
+            rec["tag"] = tagn.value
+        if isinstance(static, ast.Dict):
+            params: set[str] = set()
+            assigned: set[str] = set()
+            if info is not None and not isinstance(info.node, ast.Lambda):
+                a = info.node.args
+                params = {x.arg for x in
+                          a.posonlyargs + a.args + a.kwonlyargs}
+                assigned = {n.id for n in own_nodes(info.node)
+                            if isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Store)}
+            skeys: list[str] = []
+            svals: list[dict] = []
+            for k, v in zip(static.keys, static.values):
+                key = (k.value if isinstance(k, ast.Constant)
+                       and isinstance(k.value, str) else None)
+                if key is not None:
+                    skeys.append(key)
+                ent: dict[str, Any] = {"k": key}
+                if isinstance(v, ast.Constant):
+                    ent["t"] = "const"
+                elif isinstance(v, ast.Name) and v.id in params \
+                        and v.id not in assigned:
+                    # a PARAMETER fed straight into the vocabulary: the
+                    # caller decides its cardinality (R6's
+                    # interprocedural face); a reassigned name stays a
+                    # local — the function normalized it itself
+                    ent["t"] = "param"
+                    ent["p"] = v.id
+                elif isinstance(v, (ast.List, ast.Set, ast.Dict,
+                                    ast.Tuple)):
+                    ent["t"] = "display"
+                    ent["h"] = 1 if isinstance(v, ast.Tuple) else 0
+                    varying = any(
+                        isinstance(x, (ast.Name, ast.Attribute, ast.Call))
+                        for x in ast.walk(v) if x is not v)
+                    ent["allc"] = 0 if varying else 1
+                else:
+                    ent["t"] = "other"
+                svals.append(ent)
+            rec["skeys"] = sorted(skeys)
+            rec["svals"] = svals
+        return rec
+
+    def _key_sites(self, ctx: ModuleContext,
+                   ) -> tuple[list[dict], list[dict], list[dict]]:
+        keysites: list[dict] = []
+        fpsites: list[dict] = []
+        builds: list[dict] = []
+        by_node = {i.node: i.qualname for i in ctx.functions}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t, _ = self.callable_target(node)
+            info = ctx.enclosing_function(node)
+            fn = info.qualname if info else "<module>"
+            if resolves_to(t, *_KEY_BUILDERS):
+                keysites.append(self._keysite(node, fn, info))
+            elif resolves_to(t, *_FP_BUILDERS):
+                rec = {"ln": node.lineno, "fn": fn,
+                       "b": (t or "").rsplit(".", 1)[-1]}
+                unstable = self._unstable_parts(node)
+                if unstable:
+                    rec["unstable"] = unstable
+                fpsites.append(rec)
+            elif (resolves_to(t, *_BUILD_ATTRS)
+                  or (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _BUILD_ATTRS)):
+                kw = {k.arg: k.value for k in node.keywords if k.arg}
+                arg = (node.args[1] if len(node.args) > 1
+                       else kw.get("factory") or kw.get("builder"))
+                while isinstance(arg, ast.Call):
+                    inner = self.resolve(arg.func)
+                    if resolves_to(inner, "functools.partial",
+                                   "partial") and arg.args:
+                        arg = arg.args[0]
+                    else:
+                        arg = arg.func
+                b = None
+                if isinstance(arg, ast.Lambda):
+                    b = "<lambda>:" + by_node.get(arg, "")
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    b = self.resolve(arg)
+                if b:
+                    builds.append({"ln": node.lineno, "fn": fn, "b": b})
+        return keysites, fpsites, builds
+
+    def _env_literals(self, ctx: ModuleContext) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for info in ctx.functions:
+            lits = sorted({n.value for n in own_nodes(info.node)
+                           if isinstance(n, ast.Constant)
+                           and isinstance(n.value, str)
+                           and _ENV_NAME_RE.match(n.value)})
+            if lits:
+                out[info.qualname] = lits
+        return out
+
+    def _keyflow_facts(self, ctx: ModuleContext) -> dict:
+        out: dict[str, Any] = {}
+        keysites, fpsites, builds = self._key_sites(ctx)
+        for key, val in (
+            ("env", self._env_reads(ctx)),
+            ("consts", self._env_consts(ctx.tree)),
+            ("keysites", keysites),
+            ("fpsites", fpsites),
+            ("builds", builds),
+            ("lits", self._env_literals(ctx)),
+            ("allow", self._allow_lines(ctx, _KEY_ALLOW_MARKERS)),
+        ):
+            if val:
+                out[key] = val
         return out
 
     def _conc_func(self, ctx: ModuleContext, info: FunctionInfo,
@@ -1993,6 +2310,16 @@ class ProjectIndex:
         if any(self._defines_conc(rel) for rel in out):
             out |= {rel for rel in self.summaries
                     if self._consumes_conc(rel)}
+        # Key-provenance rule (ISSUE 20): a module that DEFINES executable
+        # identity — the cache-key builders themselves, or any env knob a
+        # traced program may read — changes every R18–R21 verdict, so
+        # editing one re-lints every module with key sites, build scopes
+        # or env reads of its own (the keyed set and the traced reach are
+        # both global properties; no import edge need exist between the
+        # knob module and the program it retraces).
+        if any(self._defines_key(rel) for rel in out):
+            out |= {rel for rel in self.summaries
+                    if self._consumes_key(rel)}
         frontier = list(out)
         while frontier:
             rel = frontier.pop()
@@ -2010,6 +2337,20 @@ class ProjectIndex:
         s = self.summaries[rel]
         return bool(s.get("specs") or s.get("shard_maps")
                     or s.get("collectives"))
+
+    def _defines_key(self, rel: str) -> bool:
+        kf = self.summaries[rel].get("keyflow") or {}
+        if kf.get("env") or kf.get("consts"):
+            return True
+        names = self.summaries[rel].get("names") or {}
+        return any(n in names
+                   for n in _KEY_BUILDERS + _FP_BUILDERS)
+
+    def _consumes_key(self, rel: str) -> bool:
+        kf = self.summaries[rel].get("keyflow") or {}
+        return bool(kf.get("keysites") or kf.get("fpsites")
+                    or kf.get("builds") or kf.get("env")
+                    or kf.get("consts"))
 
     def _defines_conc(self, rel: str) -> bool:
         conc = self.summaries[rel].get("conc") or {}

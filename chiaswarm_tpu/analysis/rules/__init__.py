@@ -77,6 +77,7 @@ from chiaswarm_tpu.analysis.rules import (  # noqa: E402,F401  (registration)
     device_init,
     host_sync,
     jit_hygiene,
+    keyflow_rules,
     prng,
     raceflow_rules,
     recompile,

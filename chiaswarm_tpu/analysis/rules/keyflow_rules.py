@@ -1,0 +1,80 @@
+"""R18–R21 — the keyflow executable-identity rules (swarmkey).
+
+R1–R13 prove what the *values* do, R14–R17 what the *threads* do; these
+four prove what the *cache key* knows, via the trace-input provenance
+interpreter in ``analysis/keyflow.py`` (see its module docstring for the
+domain):
+
+- **R18 unkeyed-trace-input** — a trace-affecting env knob (read at
+  trace time, or frozen into a module constant that a traced function
+  loads) that is never folded into the executable-cache key: a knob flip
+  silently serves a stale executable from a warm slot.
+- **R19 frozen-env-reread** — an env read lexically inside a build/
+  traced scope, written as if live-per-call but executed at most once
+  per cache slot.
+- **R20 unstable-key-component** — ``id()``/``hash()``/``repr()`` in
+  the PERSISTENT key surface (``cache_fingerprint``/
+  ``artifact_cache_key``); in-process ``static_cache_key`` owners may
+  keep ``id(self.c)``.
+- **R21 cache-tag-collision** — two distinct build callables sharing an
+  (owner, tag, statics-vocabulary) triple: one slot, two programs.
+
+All four are conservative: dynamic env names, unresolvable references
+and non-canonical owners are silent — a lint must not invent a cache-key
+bug it cannot defend with a chain.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Iterator
+
+from chiaswarm_tpu.analysis.core import Finding, ProjectRule, register
+
+if TYPE_CHECKING:  # the index arrives at check time; no runtime dep
+    from chiaswarm_tpu.analysis.project import ProjectIndex
+
+
+class _KeyflowRule(ProjectRule):
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        from chiaswarm_tpu.analysis import keyflow
+
+        for f in keyflow.results(index).findings:
+            if f.rule == self.name:
+                yield f
+
+
+@register
+class UnkeyedTraceInput(_KeyflowRule):
+    code = "R18"
+    name = "unkeyed-trace-input"
+    description = ("a trace-affecting env knob never reaches the "
+                   "executable-cache key — a warm slot serves the stale "
+                   "program after a knob flip; fold it into "
+                   "static_cache_key only-when-set")
+
+
+@register
+class FrozenEnvReread(_KeyflowRule):
+    code = "R19"
+    name = "frozen-env-reread"
+    description = ("an env read inside a build/traced scope executes "
+                   "once per cache slot, not per call — hoist to "
+                   "dispatch or fold into the key")
+
+
+@register
+class UnstableKeyComponent(_KeyflowRule):
+    code = "R20"
+    name = "unstable-key-component"
+    description = ("id()/hash()/repr() flow into the persistent key "
+                   "surface — unstable across processes, so a shipped "
+                   "artifact keyed by them can never hit")
+
+
+@register
+class CacheTagCollision(_KeyflowRule):
+    code = "R21"
+    name = "cache-tag-collision"
+    description = ("two distinct build callables share the cache "
+                   "owner/tag/statics vocabulary — their programs "
+                   "collide in one executable slot")

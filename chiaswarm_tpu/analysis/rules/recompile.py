@@ -8,8 +8,11 @@ that arbitrary requested sizes snap onto a small compiled lattice; a
 pipeline that feeds ``req.height`` straight into its executable reopens
 the compile-per-job failure mode the cache exists to close.
 
-Heuristic (program layer only — ``pipelines/``, ``workloads/``): a
-function is flagged when it
+Two faces since ISSUE 20:
+
+**Per-function heuristic** (the original AST rule, now replayed from
+summarize-time facts so the rule sees the whole program): a function is
+flagged when it
 
 1. executes compiled code — it calls ``<jit wrapper>(fn)(...)``
    immediately, calls a local name previously bound from a jit wrapper,
@@ -22,17 +25,30 @@ function is flagged when it
 The finding sits on the first raw shape read. Intra-function only: a
 function that merely forwards the request object is fine — the function
 that unpacks shapes next to the executable is the one that must bucket.
+
+**Interprocedural face** (analysis/keyflow.py): the static vocabulary of
+a ``static_cache_key`` call is an executable-cardinality contract, so a
+non-hashable container display built from varying values inside the
+static dict, or a bare key-site parameter that a CALLER feeds straight
+from a raw request attribute without bucketing, is the same hazard one
+call hop away — the per-function pass cannot see it because the read and
+the key site live in different functions. Facts ride the swarmflow
+index; the program layer gate (``pipelines/``/``workloads/``) applies to
+the per-function face only, matching the original rule's jurisdiction.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import TYPE_CHECKING, Iterator
 
 from chiaswarm_tpu.analysis.core import (
-    Finding, FunctionInfo, ModuleContext, Rule, register,
+    Finding, FunctionInfo, ModuleContext, ProjectRule, register,
 )
 from chiaswarm_tpu.analysis.rules import JIT_WRAPPERS, own_nodes, resolves_to
+
+if TYPE_CHECKING:  # the index arrives at check time; no runtime dep
+    from chiaswarm_tpu.analysis.project import ProjectIndex
 
 _TOPLEVEL_PACKAGES = ("chiaswarm_tpu/pipelines/", "chiaswarm_tpu/workloads/")
 _SHAPE_ATTRS = frozenset({"height", "width", "batch", "num_frames"})
@@ -42,87 +58,124 @@ _BUCKET_HELPERS = ("bucket_image_size", "bucket_batch",
 _EXEC_ATTRS = frozenset({"cached_executable", "get_or_create"})
 
 
+# ---------------------------------------------------------------------------
+# summarize-time fact extraction (called by project._Summarizer, the same
+# hook shape as host_sync.sync_sites: the AST is only in hand while the
+# summary is built, and the whole-program pass replays the compact facts)
+
+
+def self_jit_attrs(ctx: ModuleContext) -> set[str]:
+    """Module-wide ``self._fwd = toplevel_jit(...)`` attribute names: the
+    repo's dominant pattern binds executables to SELF in __init__ and
+    calls them from other methods."""
+    attrs: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and resolves_to(
+                ctx.callable_target(node.value), *JIT_WRAPPERS):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and isinstance(
+                        t.value, ast.Name) and t.value.id == "self":
+                    attrs.add(t.attr)
+    return attrs
+
+
+def recompile_facts(ctx: ModuleContext, info: FunctionInfo,
+                    jattrs: set[str]) -> dict | None:
+    """Compact per-function facts: ``x`` (executes compiled code), ``b``
+    (calls a bucketing helper), ``reads`` ([line, col, attr] raw shape
+    reads). None when the function touches none of the vocabulary."""
+    if isinstance(info.node, ast.Lambda):
+        return None
+    executes = False
+    buckets = False
+    jit_bound: set[str] = set()
+    reads: list[list] = []
+    nodes = list(own_nodes(info.node))
+
+    # pass 1: names bound from jit wrappers (AST walk order is not
+    # source order, so bindings must be known before the use pass)
+    for node in nodes:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call) and resolves_to(
+                ctx.callable_target(node.value), *JIT_WRAPPERS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    jit_bound.add(t.id)
+
+    for node in nodes:
+        if isinstance(node, ast.Call):
+            resolved = ctx.resolve_call(node)
+            if resolves_to(resolved, *_BUCKET_HELPERS) or (
+                    resolved and _is_bucket_name(
+                        resolved.rsplit(".", 1)[-1])):
+                buckets = True
+            if isinstance(node.func, ast.Call) and resolves_to(
+                    ctx.resolve_call(node.func), *JIT_WRAPPERS):
+                executes = True  # jax.jit(fn)(args)
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in jit_bound:
+                executes = True  # fn = toplevel_jit(...); fn(args)
+            elif isinstance(node.func, ast.Attribute) and (
+                    node.func.attr in _EXEC_ATTRS
+                    or (node.func.attr in jattrs
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self")):
+                executes = True  # self._fwd(...) bound in __init__
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _SHAPE_ATTRS \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name):
+            reads.append([node.lineno, node.col_offset, node.attr])
+
+    facts: dict = {}
+    if executes:
+        facts["x"] = 1
+    if buckets:
+        facts["b"] = 1
+    if reads:
+        facts["reads"] = reads
+    return facts or None
+
+
 @register
-class RecompileHazard(Rule):
+class RecompileHazard(ProjectRule):
     code = "R6"
     name = "recompile-hazard"
     description = ("raw request shapes (.height/.width/.batch) must pass "
                    "through the shape-bucketing helpers before reaching "
                    "compiled code")
 
-    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
-        if not any(p in ctx.relpath for p in _TOPLEVEL_PACKAGES):
-            return
-        # the repo's dominant pattern binds executables to SELF in
-        # __init__ (self._fwd = toplevel_jit(...)) and calls them from
-        # other methods — collect those attr names module-wide
-        self_jit_attrs: set[str] = set()
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Assign) and isinstance(
-                    node.value, ast.Call) and resolves_to(
-                    ctx.callable_target(node.value), *JIT_WRAPPERS):
-                for t in node.targets:
-                    if isinstance(t, ast.Attribute) and isinstance(
-                            t.value, ast.Name) and t.value.id == "self":
-                        self_jit_attrs.add(t.attr)
-        for info in ctx.functions:
-            if not isinstance(info.node,
-                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        for rel in sorted(index.summaries):
+            if not any(p in rel for p in _TOPLEVEL_PACKAGES):
                 continue
-            yield from self._check_function(ctx, info, self_jit_attrs)
+            s = index.summaries[rel]
+            for qual in sorted(s["functions"]):
+                r6 = s["functions"][qual].get("r6")
+                if not r6 or "x" not in r6 or "b" in r6 \
+                        or not r6.get("reads"):
+                    continue
+                reads = sorted(tuple(r) for r in r6["reads"])
+                line, col, _ = reads[0]
+                attrs = sorted({r[2] for r in reads})
+                yield Finding(
+                    rule=self.name, path=rel, line=line, col=col,
+                    message=(
+                        f"raw request shape attribute(s) "
+                        f"{', '.join(attrs)} reach compiled code without "
+                        f"shape bucketing — every distinct value is a "
+                        f"fresh XLA compile; snap through "
+                        f"compile_cache.bucket_image_size/bucket_batch "
+                        f"first"),
+                    symbol=qual)
+        # interprocedural face: unbounded/non-hashable values flowing
+        # into a static key vocabulary across the call graph
+        from chiaswarm_tpu.analysis import keyflow
 
-    def _check_function(self, ctx: ModuleContext, info: FunctionInfo,
-                        self_jit_attrs: set[str]) -> Iterator[Finding]:
-        executes = False
-        buckets = False
-        jit_bound: set[str] = set()
-        shape_reads: list[ast.Attribute] = []
-        nodes = list(own_nodes(info.node))
-
-        # pass 1: names bound from jit wrappers (AST walk order is not
-        # source order, so bindings must be known before the use pass)
-        for node in nodes:
-            if isinstance(node, ast.Assign) and isinstance(
-                    node.value, ast.Call) and resolves_to(
-                    ctx.callable_target(node.value), *JIT_WRAPPERS):
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        jit_bound.add(t.id)
-
-        for node in nodes:
-            if isinstance(node, ast.Call):
-                resolved = ctx.resolve_call(node)
-                if resolves_to(resolved, *_BUCKET_HELPERS) or (
-                        resolved and _is_bucket_name(
-                            resolved.rsplit(".", 1)[-1])):
-                    buckets = True
-                if isinstance(node.func, ast.Call) and resolves_to(
-                        ctx.resolve_call(node.func), *JIT_WRAPPERS):
-                    executes = True  # jax.jit(fn)(args)
-                elif isinstance(node.func, ast.Name) \
-                        and node.func.id in jit_bound:
-                    executes = True  # fn = toplevel_jit(...); fn(args)
-                elif isinstance(node.func, ast.Attribute) and (
-                        node.func.attr in _EXEC_ATTRS
-                        or (node.func.attr in self_jit_attrs
-                            and isinstance(node.func.value, ast.Name)
-                            and node.func.value.id == "self")):
-                    executes = True  # self._fwd(...) bound in __init__
-            if isinstance(node, ast.Attribute) \
-                    and node.attr in _SHAPE_ATTRS \
-                    and isinstance(node.ctx, ast.Load) \
-                    and isinstance(node.value, ast.Name):
-                shape_reads.append(node)
-
-        if executes and shape_reads and not buckets:
-            first = min(shape_reads, key=lambda n: (n.lineno, n.col_offset))
-            attrs = sorted({n.attr for n in shape_reads})
-            yield self.finding(
-                ctx, first,
-                f"raw request shape attribute(s) {', '.join(attrs)} reach "
-                f"compiled code without shape bucketing — every distinct "
-                f"value is a fresh XLA compile; snap through "
-                f"compile_cache.bucket_image_size/bucket_batch first")
+        for f in keyflow.results(index).findings:
+            if f.rule == self.name:
+                yield f
 
 
 def _is_bucket_name(name: str) -> bool:

@@ -112,6 +112,69 @@ def xla_compiler_options() -> dict[str, str] | None:
     return dict(kv.split("=", 1) for kv in raw.split(",") if "=" in kv)
 
 
+#: Env knobs that change what a pipeline TRACES (swarmkey / ISSUE 20):
+#: attention impl selection and ring threshold are read at trace time
+#: (ops/attention.py), the flash block/VMEM knobs are frozen into module
+#: constants at import (ops/flash_attention.py), ring-flash mode picks
+#: the fused vs scan program (ops/ring_flash_attention.py), and the XLA
+#: options change the compiled artifact itself. Every name here is
+#: folded into static_cache_key ONLY-WHEN-SET — with all knobs unset the
+#: key stays byte-identical to the historical tuple, so default
+#: deployments keep every warm slot (the taps-off stance from ISSUE 11).
+#: CHIASWARM_NUMERICS / CHIASWARM_ACTIVATIONS are deliberately absent:
+#: those already fold their own richer fingerprints conditionally below.
+_TRACE_ENV_KNOBS = (
+    "CHIASWARM_ATTENTION",
+    "CHIASWARM_RING_MIN_TOKENS",
+    "CHIASWARM_RING_FLASH",
+    "CHIASWARM_FLASH_BLOCK_Q",
+    "CHIASWARM_FLASH_BLOCK_KV",
+    "CHIASWARM_FLASH_VMEM_MB",
+    "CHIASWARM_XLA_OPTIONS",
+)
+
+
+def _trace_knobs() -> tuple:
+    """The set-and-nonempty trace-affecting knobs as a sorted-by-table
+    ((name, value), ...) vector — empty tuple in a default environment,
+    so callers can fold it only-when-set."""
+    import os
+
+    return tuple((name, os.environ[name].strip())
+                 for name in _TRACE_ENV_KNOBS
+                 if os.environ.get(name, "").strip())
+
+
+def cache_fingerprint() -> tuple:
+    """Cross-process executable-identity handle for the AOT artifact
+    cache (ROADMAP item 5): compiler provenance (jax/jaxlib/plugin
+    versions) plus the trace-affecting knob vector.
+
+    The in-process key (``static_cache_key``) may embed ``id()``-based
+    owners — stable within a process, meaningless outside it. A
+    serialized artifact needs the opposite: every component stable
+    across processes and machines (R20's jurisdiction). Versions come
+    from package metadata, not ``jax.__version__``, so the lint tier can
+    import this module without jax."""
+    import importlib.metadata
+
+    versions = []
+    for dist in ("jax", "jaxlib", "libtpu", "libtpu-nightly"):
+        try:
+            versions.append((dist, importlib.metadata.version(dist)))
+        except Exception:  # absent plugin: fingerprint just omits it
+            continue
+    return ("chiaswarm-exec-v1", tuple(versions), ("knobs", _trace_knobs()))
+
+
+def artifact_cache_key(tag: str, static: dict) -> tuple:
+    """Content-addressed key for a SHIPPED executable artifact: the
+    persistent fingerprint plus the owner-free static key. The
+    in-process owner id is dropped by construction — it can never leak
+    into a serialized artifact's identity."""
+    return (cache_fingerprint(),) + static_cache_key(0, tag, static)[1:]
+
+
 def toplevel_jit(fn, **kwargs):
     """``jax.jit`` for the pipelines' end-to-end programs, with the
     env-configured compiler options applied."""
@@ -186,6 +249,13 @@ def static_cache_key(owner: int, tag: str, static: dict) -> tuple:
 
     if quantize.activations_enabled():
         key = key + (("activations", quantize.activations_format()),)
+
+    # trace-affecting env knobs (swarmkey / ISSUE 20): same only-when-set
+    # stance — a knob flip must retrace, a default environment must keep
+    # its historical byte-identical key (and every warm slot with it)
+    knobs = _trace_knobs()
+    if knobs:
+        key = key + (("knobs", knobs),)
     return key
 
 

@@ -1,0 +1,12 @@
+"""Local key builder plus the bucketing helper the clean twin uses."""
+
+
+def static_cache_key(owner, tag, static):
+    return (owner, tag, tuple(sorted(static.items())))
+
+
+def bucket_batch(n):
+    p = 1
+    while p < n:
+        p *= 2
+    return p

@@ -1,0 +1,13 @@
+"""Clean twin: the caller snaps the raw attribute onto the bucket
+lattice before it reaches the key-site parameter — bounded slots."""
+
+from cardpkg.cache import bucket_batch, static_cache_key
+
+
+def _get_fn(cache, h):
+    key = static_cache_key(0, "gen_clean", {"h": h})
+    return cache.get_or_create(key, lambda: object())
+
+
+def handle(cache, req):
+    return _get_fn(cache, bucket_batch(req.height))

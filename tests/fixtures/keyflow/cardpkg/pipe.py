@@ -1,0 +1,21 @@
+"""R6's interprocedural face: the raw request attribute and the key
+site live in DIFFERENT functions, so the per-function pass cannot see
+that every distinct req.height mints a fresh executable slot (and a
+fresh XLA compile). Plus the display shape: a list of varying values
+inside the static dict is an unbounded-cardinality key component."""
+
+from cardpkg.cache import static_cache_key
+
+
+def _get_fn(cache, h):
+    key = static_cache_key(0, "gen", {"h": h})
+    return cache.get_or_create(key, lambda: object())
+
+
+def handle(cache, req):
+    return _get_fn(cache, req.height)
+
+
+def _get_fn_sizes(cache, h, w):
+    key = static_cache_key(0, "gen2", {"sizes": [h, w]})
+    return cache.get_or_create(key, lambda: object())

@@ -1,0 +1,6 @@
+"""Local key builder; the collision is in the callers' vocabulary, not
+in the builder itself."""
+
+
+def static_cache_key(owner, tag, static):
+    return (owner, tag, tuple(sorted(static.items())))

@@ -1,0 +1,21 @@
+"""Clean twin: each program gets its own tag — two slots, no aliasing."""
+
+import jax
+
+from collidepkg.cache import static_cache_key
+
+
+class Engine:
+    def __init__(self, cache, components):
+        self._cache = cache
+        self._c = components
+
+    def encode(self, x):
+        key = static_cache_key(id(self._c), "encode", {"h": 64})
+        return self._cache.get_or_create(
+            key, lambda: jax.jit(lambda v: v * 2.0))(x)
+
+    def decode(self, x):
+        key = static_cache_key(id(self._c), "decode", {"h": 64})
+        return self._cache.get_or_create(
+            key, lambda: jax.jit(lambda v: v + 1.0))(x)

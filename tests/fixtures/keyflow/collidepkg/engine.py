@@ -1,0 +1,23 @@
+"""R21: encode and decode build DIFFERENT programs under the SAME
+(owner, tag, statics) vocabulary — both land in one executable slot and
+whichever builds second silently serves the first's program."""
+
+import jax
+
+from collidepkg.cache import static_cache_key
+
+
+class Engine:
+    def __init__(self, cache, components):
+        self._cache = cache
+        self._c = components
+
+    def encode(self, x):
+        key = static_cache_key(id(self._c), "run", {"h": 64})
+        return self._cache.get_or_create(
+            key, lambda: jax.jit(lambda v: v * 2.0))(x)
+
+    def decode(self, x):
+        key = static_cache_key(id(self._c), "run", {"h": 64})
+        return self._cache.get_or_create(
+            key, lambda: jax.jit(lambda v: v + 1.0))(x)

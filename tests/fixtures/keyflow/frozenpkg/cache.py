@@ -1,0 +1,8 @@
+"""Local key builder; the fixture's keyed vocabulary is empty on
+purpose — R19 is about WHERE the read happens, not what the key holds,
+and an unkeyed read inside a build scope is the live-looking-but-frozen
+shape."""
+
+
+def static_cache_key(owner, tag, static):
+    return (owner, tag, tuple(sorted(static.items())))

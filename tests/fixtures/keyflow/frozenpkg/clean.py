@@ -1,0 +1,17 @@
+"""Clean twin: the env is read at DISPATCH and handed to the traced
+body as an argument — every call sees the live value, and the argument
+participates in jit's own argument keying."""
+
+import os
+
+import jax
+
+
+@jax.jit
+def _step(x, scale):
+    return x * scale
+
+
+def run(x):
+    scale = float(os.environ.get("FIXTURE_SCALE", "1.0"))
+    return _step(x, scale)

@@ -1,0 +1,35 @@
+"""R19 both scopes: an env read inside a jitted body, and one inside a
+factory closure handed to get_or_create — each looks live-per-call but
+executes at most once per cache slot, so a warm hit freezes it."""
+
+import os
+
+import jax
+
+from frozenpkg.cache import static_cache_key
+
+
+class Slots:
+    def __init__(self):
+        self._e = {}
+
+    def get_or_create(self, key, factory):
+        if key not in self._e:
+            self._e[key] = factory()
+        return self._e[key]
+
+
+@jax.jit
+def step(x):
+    scale = float(os.environ.get("FIXTURE_SCALE", "1.0"))
+    return x * scale
+
+
+def _build():
+    mode = os.environ.get("FIXTURE_MODE", "fast")
+    return jax.jit(lambda x: x * (2.0 if mode == "fast" else 3.0))
+
+
+def get(slots, owner):
+    key = static_cache_key(owner, "step", {"b": 1})
+    return slots.get_or_create(key, _build)

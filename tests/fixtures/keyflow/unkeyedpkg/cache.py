@@ -1,0 +1,20 @@
+"""Local key builder: keyflow matches builders by name, so this
+package-scoped static_cache_key defines the fixture's keyed vocabulary
+(_TRACE_KNOBS) without importing the real core/compile_cache.py."""
+
+_TRACE_KNOBS = ("FIXTURE_CLEAN_IMPL", "FIXTURE_CLEAN_BLOCK")
+
+
+def _knobs():
+    import os
+
+    return tuple((n, os.environ[n]) for n in _TRACE_KNOBS
+                 if os.environ.get(n))
+
+
+def static_cache_key(owner, tag, static):
+    key = (owner, tag, tuple(sorted(static.items())))
+    knobs = _knobs()
+    if knobs:
+        key = key + (("knobs", knobs),)
+    return key

@@ -1,0 +1,26 @@
+"""Clean twin: the same two shapes, but every knob the trace consumes
+is in the key builder's _TRACE_KNOBS vocabulary — a flip retraces."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from unkeyedpkg.cache import static_cache_key
+
+_CLEAN_BLOCK = int(os.environ.get("FIXTURE_CLEAN_BLOCK", "128"))
+
+
+def _impl():
+    return os.environ.get("FIXTURE_CLEAN_IMPL", "einsum")
+
+
+def _fwd(x):
+    if _impl() == "flash":
+        return x * 2.0
+    return x * jnp.float32(_CLEAN_BLOCK)
+
+
+def build(cache, owner):
+    key = static_cache_key(owner, "fwd", {"b": 1})
+    return cache.get_or_create(key, lambda: jax.jit(_fwd))

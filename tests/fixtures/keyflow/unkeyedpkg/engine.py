@@ -1,0 +1,27 @@
+"""Both R18 faces: a trace-time env read the key never learns about
+(the CHIASWARM_ATTENTION shape), and an import-time read frozen into a
+module constant the traced body loads (the flash-block shape)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from unkeyedpkg.cache import static_cache_key
+
+_BLOCK = int(os.environ.get("FIXTURE_BLOCK", "128"))
+
+
+def _impl():
+    return os.environ.get("FIXTURE_IMPL", "einsum")
+
+
+def _fwd(x):
+    if _impl() == "flash":
+        return x * 2.0
+    return x * jnp.float32(_BLOCK)
+
+
+def build(cache, owner):
+    key = static_cache_key(owner, "fwd", {"b": 1})
+    return cache.get_or_create(key, lambda: jax.jit(_fwd))

@@ -1,0 +1,11 @@
+"""Local key builders for both surfaces: the in-process key (id()-based
+owners welcome) and the persistent artifact key (everything must be
+stable across processes)."""
+
+
+def static_cache_key(owner, tag, static):
+    return (owner, tag, tuple(sorted(static.items())))
+
+
+def artifact_cache_key(tag, parts):
+    return ("exec-v1", tag) + tuple(parts)

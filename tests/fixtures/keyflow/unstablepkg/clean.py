@@ -1,0 +1,17 @@
+"""Clean twin: the persistent key carries stable content — and the
+IN-PROCESS key keeps its id()-based owner, proving the two surfaces are
+judged differently (id(self._c) is the point of having both)."""
+
+from unstablepkg.cache import artifact_cache_key, static_cache_key
+
+
+class Engine:
+    def __init__(self, components):
+        self._c = components
+
+    def key(self, tag):
+        return static_cache_key(id(self._c), tag, {"b": 1})
+
+
+def ship(model, tag):
+    return artifact_cache_key(tag, (model.name, str(model.dtype)))

@@ -1,0 +1,9 @@
+"""R20: id()/repr() flow into the PERSISTENT key surface — stable
+within one process, different in the next, so a shipped artifact keyed
+by them can never hit."""
+
+from unstablepkg.cache import artifact_cache_key
+
+
+def ship(model, tag):
+    return artifact_cache_key(tag, (id(model), repr(model.cfg)))

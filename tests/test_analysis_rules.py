@@ -2549,3 +2549,290 @@ def test_r17_native_build_allow_marker_is_load_bearing():
     fs = list(_get_rule("R17").check_project(idx2))
     assert len(fs) == 1 and "subprocess.run" in fs[0].message
     assert "pkg.native._LOCK" in fs[0].message
+
+
+# --------------------------------------------- swarmkey (R18-R21)
+
+KEYFLOW_FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures",
+                                "keyflow")
+
+
+def _copy_keyflow(tmp_path, name):
+    dst = tmp_path / name
+    shutil.copytree(os.path.join(KEYFLOW_FIXTURES, name), dst)
+    return dst
+
+
+def test_r18_unkeyed_trace_input_both_faces(tmp_path):
+    """The CHIASWARM_ATTENTION bug distilled: a trace-time env read the
+    key never learns about, plus the flash-block shape (import-time read
+    frozen into a module constant the traced body loads). The clean twin
+    reads knobs the local builder's _TRACE_KNOBS folds — green."""
+    pkg = _copy_keyflow(tmp_path, "unkeyedpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R18"])
+    assert r.exit_code == 1 and len(r.new) == 2, r.report
+    const, direct = sorted(r.new, key=lambda f: f.line)
+    assert const.rule == "unkeyed-trace-input"
+    assert const.path == "unkeyedpkg/engine.py"
+    assert "FIXTURE_BLOCK" in const.message and "_BLOCK" in const.message
+    assert const.symbol == "<module>"
+    assert const.chain[-1] == ("unkeyedpkg/engine.py", const.line,
+                               "unkeyedpkg.engine._BLOCK")
+    assert "FIXTURE_IMPL" in direct.message
+    assert direct.symbol == "_impl"
+    # traced root -> the helper -> the read itself
+    assert [hop[2] for hop in direct.chain] == [
+        "unkeyedpkg.engine._fwd", "unkeyedpkg.engine._impl",
+        "unkeyedpkg.engine._impl"]
+    assert "chain:" in direct.render()
+
+
+def test_r19_env_read_inside_build_and_traced_scopes(tmp_path):
+    """Both R19 scopes: a read inside a @jax.jit body and one inside a
+    get_or_create factory — each executes once per slot; the
+    read-at-dispatch twin is green."""
+    pkg = _copy_keyflow(tmp_path, "frozenpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R19"])
+    assert r.exit_code == 1 and len(r.new) == 2, r.report
+    jit_read, factory_read = sorted(r.new, key=lambda f: f.line)
+    assert jit_read.rule == "frozen-env-reread"
+    assert jit_read.path == "frozenpkg/engine.py"
+    assert "FIXTURE_SCALE" in jit_read.message and jit_read.symbol == "step"
+    assert "FIXTURE_MODE" in factory_read.message
+    assert factory_read.symbol == "_build"
+    # build-registration hop -> the frozen read
+    assert [hop[2] for hop in factory_read.chain] == [
+        "frozenpkg.engine.get", "frozenpkg.engine._build"]
+
+
+def test_r20_unstable_component_only_on_persistent_surface(tmp_path):
+    """id()/repr() in artifact_cache_key fire; the clean twin keeps
+    id(self._c) in the IN-PROCESS static_cache_key — the two surfaces
+    are judged differently."""
+    pkg = _copy_keyflow(tmp_path, "unstablepkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R20"])
+    assert r.exit_code == 1 and len(r.new) == 2, r.report
+    assert all(f.rule == "unstable-key-component" for f in r.new)
+    assert all(f.path == "unstablepkg/ship.py" for f in r.new)
+    msgs = sorted(f.message for f in r.new)
+    assert "id(model)" in msgs[0] and "repr(model.cfg)" in msgs[1]
+    assert all("artifact_cache_key" in m for m in msgs)
+
+
+def test_r21_shared_vocabulary_collides(tmp_path):
+    """encode and decode building different programs under one
+    (owner, tag, statics) triple collide; the per-program-tag twin is
+    green."""
+    pkg = _copy_keyflow(tmp_path, "collidepkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R21"])
+    assert r.exit_code == 1 and len(r.new) == 1, r.report
+    f = r.new[0]
+    assert f.rule == "cache-tag-collision"
+    assert f.path == "collidepkg/engine.py" and f.symbol == "Engine.decode"
+    assert "'run'" in f.message and "Engine.encode" in f.message
+    assert [hop[2] for hop in f.chain] == [
+        "collidepkg.engine.Engine.encode",
+        "collidepkg.engine.Engine.decode"]
+
+
+def test_r6_interprocedural_face(tmp_path):
+    """ISSUE 20 satellite: the raw-attr-through-parameter and the
+    unbounded-container-display shapes, one call hop from the key site;
+    the bucket-at-call-site twin is green."""
+    pkg = _copy_keyflow(tmp_path, "cardpkg")
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R6"])
+    assert r.exit_code == 1 and len(r.new) == 2, r.report
+    param, display = sorted(r.new, key=lambda f: f.line)
+    assert param.rule == "recompile-hazard"
+    assert param.path == "cardpkg/pipe.py" and param.symbol == "handle"
+    assert ".height" in param.message and "'h'" in param.message
+    # caller call site -> the key-site function -> the key site
+    assert [hop[2] for hop in param.chain] == [
+        "cardpkg.pipe.handle", "cardpkg.pipe._get_fn",
+        "cardpkg.pipe._get_fn"]
+    assert display.symbol == "_get_fn_sizes"
+    assert "'sizes'" in display.message
+    assert "non-hashable" in display.message
+
+
+def test_keyflow_allow_markers_suppress(tmp_path):
+    """Each keyflow rule has its own swarmlens marker; marking the
+    finding line (or the comment line above) silences exactly it."""
+    pkg = _copy_keyflow(tmp_path, "unkeyedpkg")
+    eng = pkg / "engine.py"
+    eng.write_text(eng.read_text().replace(
+        'return os.environ.get("FIXTURE_IMPL", "einsum")',
+        'return os.environ.get("FIXTURE_IMPL", "einsum")'
+        '  # swarmlens: allow-unkeyed-trace-input'))
+    r = run([str(pkg)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), select=["R18"])
+    assert len(r.new) == 1 and "_BLOCK" in r.new[0].message, r.report
+
+    pkg2 = _copy_keyflow(tmp_path, "frozenpkg")
+    eng2 = pkg2 / "engine.py"
+    eng2.write_text(eng2.read_text().replace(
+        '    mode = os.environ.get("FIXTURE_MODE", "fast")',
+        '    # swarmlens: allow-frozen-env-reread\n'
+        '    mode = os.environ.get("FIXTURE_MODE", "fast")'))
+    r = run([str(pkg2)], baseline_path=str(tmp_path / "b2.json"),
+            root=str(tmp_path), select=["R19"])
+    assert len(r.new) == 1 and "FIXTURE_SCALE" in r.new[0].message
+
+
+def test_keyflow_baseline_lifecycle(tmp_path):
+    """R18 findings ride the shrink-only baseline: finding ->
+    grandfathered -> fixed -> stale entry fails --strict."""
+    pkg = _copy_keyflow(tmp_path, "unkeyedpkg")
+    bl = tmp_path / "baseline.json"
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R18"])
+    assert r.exit_code == 1 and len(r.new) == 2
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            write_baseline=True)
+    assert r.exit_code == 0
+    doc = json.loads(bl.read_text())
+    entries = [e for e in doc["findings"]
+               if e["rule"] == "unkeyed-trace-input"]
+    assert len(entries) == 2
+    assert set(entries[0]) == {"rule", "path", "symbol", "message",
+                               "count"}  # identity only, no chain hops
+
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R18"], strict=True)
+    assert r.exit_code == 0 and len(r.suppressed) == 2
+
+    # fix: stop reading the unkeyed knob — the finding disappears and
+    # its baseline entry goes stale
+    eng = pkg / "engine.py"
+    fixed = eng.read_text().replace(
+        'os.environ.get("FIXTURE_IMPL", "einsum")', '"einsum"')
+    assert fixed != eng.read_text()
+    eng.write_text(fixed)
+    r = run([str(pkg)], baseline_path=str(bl), root=str(tmp_path),
+            select=["R18"], strict=True)
+    assert r.exit_code == 1 and not r.new
+    assert len(r.stale) == 1 and "unkeyed-trace-input" in r.stale[0]
+
+
+def test_keyflow_cli_chain_in_text_json_and_sarif(tmp_path):
+    """The acceptance clause: R18's entry->sink chain renders in all
+    three output formats (text, --json, --sarif codeFlows)."""
+    pkg = _copy_keyflow(tmp_path, "unkeyedpkg")
+    base = [sys.executable, "-m", "chiaswarm_tpu.analysis", "--select",
+            "R18", "--no-cache"]
+    proc = subprocess.run(base + [str(pkg)], capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "unkeyed-trace-input" in proc.stdout
+    assert "chain: unkeyedpkg.engine._fwd" in proc.stdout
+
+    proc = subprocess.run(base + ["--json", str(pkg)],
+                          capture_output=True, text=True, timeout=300)
+    doc = json.loads(proc.stdout)
+    assert len(doc) == 2
+    direct = [f for f in doc if f["symbol"] == "_impl"][0]
+    assert len(direct["chain"]) == 3
+    assert direct["chain"][0][2] == "unkeyedpkg.engine._fwd"
+
+    sarif = tmp_path / "out.sarif"
+    proc = subprocess.run(base + ["--sarif", str(sarif), str(pkg)],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1
+    res = json.loads(sarif.read_text())["runs"][0]["results"]
+    assert len(res) == 2
+    assert {r_["ruleId"] for r_ in res} == {"unkeyed-trace-input"}
+    flows = [r_ for r_ in res if r_["codeFlows"][0]["threadFlows"][0]
+             ["locations"][-1]["location"]["message"]["text"]
+             == "unkeyedpkg.engine._impl"]
+    assert len(flows) == 1
+
+
+def test_changed_only_key_definitions_expand_to_key_consumers(tmp_path):
+    """ISSUE 20 satellite: editing the key-builder module (or any
+    knob-defining module) must re-lint every compile-cached program
+    site even without an import edge — while key-free islands stay out
+    of the fast path."""
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True)
+
+    _write(tmp_path, "pkg/__init__.py", "")
+    hub = _write(tmp_path, "pkg/keys.py", textwrap.dedent("""
+        _TRACE_KNOBS = ("PKG_MODE",)
+
+        def static_cache_key(owner, tag, static):
+            return (owner, tag, tuple(sorted(static.items())))
+        """))
+    _write(tmp_path, "pkg/user.py", textwrap.dedent("""
+        import os
+
+        def impl():
+            return os.environ.get("PKG_IMPL", "fast")
+        """))
+    _write(tmp_path, "pkg/island.py", "z = 1\n")
+    git("init", "-q")
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "seed")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+
+    # edit ONLY the key-defining module
+    hub.write_text(hub.read_text() + "\nEXTRA = 1\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, select=["R18"])
+    assert r.exit_code == 0, r.report
+    # the builder + the env-reading consumer; the island is skipped
+    assert r.checked_files == 2 and r.total_files == 4
+
+    # a key-free edit keeps the narrow closure
+    git("add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-q",
+        "-m", "hub")
+    git("update-ref", "refs/remotes/origin/main", "HEAD")
+    (tmp_path / "pkg/island.py").write_text("z = 2\n")
+    r = run([str(tmp_path)], baseline_path=str(tmp_path / "b.json"),
+            root=str(tmp_path), changed_only=True, select=["R18"])
+    assert r.checked_files == 1
+
+
+def test_r18_attention_knob_fold_is_load_bearing():
+    """Burn-down regression: the live CHIASWARM_ATTENTION finding is
+    fixed by compile_cache._TRACE_ENV_KNOBS, not a marker — removing
+    the knob from the tuple must resurface R18 through the real
+    ops/attention.py chain."""
+    ops_path = os.path.join(os.path.dirname(__file__), "..",
+                            "chiaswarm_tpu", "ops", "attention.py")
+    cc_path = os.path.join(os.path.dirname(__file__), "..",
+                           "chiaswarm_tpu", "core", "compile_cache.py")
+    with open(ops_path) as fh:
+        ops_src = fh.read()
+    with open(cc_path) as fh:
+        cc_src = fh.read()
+    assert '"CHIASWARM_ATTENTION",' in cc_src
+    driver = """
+        import jax
+
+        from pkg.ops import attention
+
+        step = jax.jit(lambda q, k, v: attention(q, k, v))
+        """
+    idx = _index_of(("pkg/__init__.py", ""), ("pkg/ops.py", ops_src),
+                    ("pkg/cc.py", cc_src), ("pkg/driver.py", driver))
+    fs = [f for f in _get_rule("R18").check_project(idx)
+          if "CHIASWARM_ATTENTION" in f.message]
+    assert fs == []
+
+    stripped = cc_src.replace('    "CHIASWARM_ATTENTION",\n', "")
+    assert stripped != cc_src
+    idx2 = _index_of(("pkg/__init__.py", ""), ("pkg/ops.py", ops_src),
+                     ("pkg/cc.py", stripped), ("pkg/driver.py", driver))
+    fs = [f for f in _get_rule("R18").check_project(idx2)
+          if "CHIASWARM_ATTENTION" in f.message]
+    assert len(fs) == 1
+    assert fs[0].symbol == "_env_impl"

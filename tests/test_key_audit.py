@@ -1,0 +1,85 @@
+"""swarmkey's compiled face, in-process: the knob fold in
+static_cache_key, the persistent fingerprint, and the audit tool's
+scenario coverage. The full subprocess sweep (tools/key_audit.py builds
+real programs under each knob) runs as its own CI step; these tests pin
+the key algebra itself so a regression is caught in the unit tier."""
+
+from __future__ import annotations
+
+import pytest
+
+from chiaswarm_tpu.core.compile_cache import (
+    _TRACE_ENV_KNOBS, artifact_cache_key, cache_fingerprint,
+    static_cache_key,
+)
+
+
+@pytest.fixture
+def scrubbed(monkeypatch):
+    for name in _TRACE_ENV_KNOBS:
+        monkeypatch.delenv(name, raising=False)
+    monkeypatch.delenv("CHIASWARM_NUMERICS", raising=False)
+    monkeypatch.delenv("CHIASWARM_ACTIVATIONS", raising=False)
+    return monkeypatch
+
+
+def test_default_key_is_byte_identical_historical_tuple(scrubbed):
+    """The acceptance clause: with every knob at its default the key is
+    the pre-PR 3-tuple — default deployments keep every warm slot."""
+    key = static_cache_key(7, "gen", {"h": 64, "s": "euler"})
+    assert key == (7, "gen", (("h", 64), ("s", "euler")))
+
+
+def test_every_trace_knob_flips_the_key_append_only(scrubbed):
+    base = static_cache_key(7, "gen", {"h": 64})
+    for name in _TRACE_ENV_KNOBS:
+        scrubbed.setenv(name, "1")
+        key = static_cache_key(7, "gen", {"h": 64})
+        scrubbed.delenv(name)
+        assert key != base, name
+        # append-only: the historical prefix survives, so turning the
+        # knob OFF again lands back on the original slot
+        assert key[:3] == base
+        assert key[3] == ("knobs", ((name, "1"),)), name
+
+
+def test_whitespace_only_value_is_not_set(scrubbed):
+    scrubbed.setenv("CHIASWARM_ATTENTION", "   ")
+    assert static_cache_key(1, "t", {}) == (1, "t", ())
+
+
+def test_knob_vector_is_table_ordered_and_value_bearing(scrubbed):
+    scrubbed.setenv("CHIASWARM_RING_FLASH", "scan")
+    scrubbed.setenv("CHIASWARM_ATTENTION", " flash ")
+    key = static_cache_key(1, "tv", {})
+    assert key[3] == ("knobs", (("CHIASWARM_ATTENTION", "flash"),
+                                ("CHIASWARM_RING_FLASH", "scan")))
+
+
+def test_cache_fingerprint_shape_and_stability(scrubbed):
+    fp = cache_fingerprint()
+    assert fp[0] == "chiaswarm-exec-v1"
+    assert dict(fp[1])["jax"]  # version metadata present without jax import
+    assert fp[2] == ("knobs", ())
+    assert fp == cache_fingerprint()
+    scrubbed.setenv("CHIASWARM_ATTENTION", "flash")
+    assert dict((cache_fingerprint()[2],))["knobs"] == (
+        ("CHIASWARM_ATTENTION", "flash"),)
+
+
+def test_artifact_key_drops_the_in_process_owner(scrubbed):
+    """The R20 stance by construction: two processes with different
+    owner ids produce the SAME artifact key for the same program."""
+    a = artifact_cache_key("gen", {"h": 64})
+    assert a[0] == cache_fingerprint()
+    assert a[1:] == static_cache_key(12345, "gen", {"h": 64})[1:]
+    assert a == artifact_cache_key("gen", {"h": 64})
+
+
+def test_audit_scenarios_cover_every_trace_knob():
+    from tools.key_audit import SCENARIOS
+
+    assert set(SCENARIOS) == set(_TRACE_ENV_KNOBS)
+    for knob, (program, value, _) in SCENARIOS.items():
+        assert value.strip(), knob
+        assert program in ("local", "ringmesh", "flash", "none"), knob

@@ -55,4 +55,5 @@ def test_all_rules_are_registered():
     codes = [r.code for r in all_rules()]
     assert codes == ["R1", "R2", "R3", "R4", "R5", "R6", "R7",
                      "R8", "R9", "R10", "R11", "R12", "R13",
-                     "R14", "R15", "R16", "R17"], codes
+                     "R14", "R15", "R16", "R17",
+                     "R18", "R19", "R20", "R21"], codes
